@@ -1,0 +1,37 @@
+"""tensorflow_train_distributed_tpu — a TPU-native distributed training framework.
+
+A ground-up rebuild of the capabilities of ``boyuanf/tensorflow_train_distributed``
+(a GPU-only ``tf.distribute`` training harness: MirroredStrategy /
+MultiWorkerMirroredStrategy over NCCL, ParameterServerStrategy, a Horovod hook,
+and a DTensor 2-D-mesh stretch goal — see SURVEY.md §1–§3) designed TPU-first:
+
+- one SPMD program per training job: ``jax.jit`` + ``NamedSharding`` over a
+  ``jax.sharding.Mesh`` (the reference's strategy class hierarchy collapses into
+  named mesh presets, see ``runtime.mesh``);
+- XLA collectives over ICI/DCN replace the NCCL/gRPC cross-device-ops layer
+  (reference: ``tensorflow/python/distribute/cross_device_ops.py``);
+- a sharded host input pipeline with device prefetch replaces tf.data
+  autoshard/rebatch (reference: ``tensorflow/python/distribute/input_lib.py``);
+- orbax replaces ``tf.train.Checkpoint``/``CheckpointManager``;
+- pallas kernels (flash/ring attention) provide the long-context path the
+  reference lacked.
+
+Public surface is re-exported here for convenience::
+
+    import tensorflow_train_distributed_tpu as ttd
+    mesh = ttd.build_mesh(ttd.MeshConfig(strategy="dp_tp"))
+"""
+
+from tensorflow_train_distributed_tpu.runtime.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    strategy_preset,
+    STRATEGY_PRESETS,
+)
+from tensorflow_train_distributed_tpu.runtime.distributed import (  # noqa: F401
+    DistributedConfig,
+    initialize_distributed,
+    resolve_cluster,
+)
+
+__version__ = "0.1.0"

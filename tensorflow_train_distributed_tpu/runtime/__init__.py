@@ -1,0 +1,17 @@
+"""Runtime core: cluster resolution, distributed init, mesh construction.
+
+TPU-native replacement for the reference's cluster-resolver + strategy-factory
+layer (``tensorflow/python/distribute/cluster_resolver/*``,
+``distribute_lib.py``) — see SURVEY.md §2.2.
+"""
+
+from tensorflow_train_distributed_tpu.runtime.distributed import (  # noqa: F401
+    DistributedConfig,
+    initialize_distributed,
+    resolve_cluster,
+)
+from tensorflow_train_distributed_tpu.runtime.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    strategy_preset,
+)

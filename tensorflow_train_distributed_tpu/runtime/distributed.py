@@ -1,0 +1,200 @@
+"""Cluster resolution and multi-host initialization.
+
+TPU-native equivalent of the reference's cluster-resolver stack
+(``tensorflow/python/distribute/cluster_resolver/cluster_resolver.py:57``,
+``tfconfig_cluster_resolver.py:48``, ``slurm_cluster_resolver.py:164``) and of
+the gRPC control plane that ``tf.train.Server`` / ``TF_CONFIG`` set up.  On
+TPU, all of that collapses into ``jax.distributed.initialize`` + the XLA
+coordination service (the same C++ coordination service TF uses — SURVEY.md
+§2.3): one coordinator address, N processes, heartbeats/barriers/KV for free.
+
+Resolution order (first match wins):
+
+1. Explicit arguments / ``DistributedConfig``.
+2. ``TTD_COORDINATOR`` / ``TTD_NUM_PROCESSES`` / ``TTD_PROCESS_ID`` env vars
+   (this framework's native spelling).
+3. ``TF_CONFIG`` JSON env var — accepted for drop-in compatibility with the
+   reference harness's launch scripts: ``{"cluster": {"worker": [...]},
+   "task": {"type": "worker", "index": k}}`` maps to
+   coordinator=worker[0], num_processes=len(workers), process_id=k.
+4. Slurm env (``SLURM_PROCID`` / ``SLURM_NTASKS`` / ``SLURM_STEP_NODELIST``).
+5. Single-process (no distributed init needed) — the default on one host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Where this process sits in the cluster.
+
+    ``num_processes == 1`` means single-process: ``initialize_distributed``
+    is a no-op (JAX local mode), matching the reference's default of
+    MirroredStrategy on one worker.
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    source: str = "default"
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Chief semantics (reference: ``multi_worker_util.is_chief``)."""
+        return self.process_id == 0
+
+
+def _from_env_native() -> Optional[DistributedConfig]:
+    coord = os.environ.get("TTD_COORDINATOR")
+    nproc = os.environ.get("TTD_NUM_PROCESSES")
+    pid = os.environ.get("TTD_PROCESS_ID")
+    if not (coord and nproc and pid):
+        return None
+    return DistributedConfig(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(pid),
+        source="env:TTD_*",
+    )
+
+
+def _from_tf_config() -> Optional[DistributedConfig]:
+    """Parse the reference harness's ``TF_CONFIG`` cluster spec.
+
+    Mirrors ``TFConfigClusterResolver`` semantics: the ``worker`` job list
+    orders processes; ``chief`` (if present) is process 0 and workers follow.
+    Parameter-server jobs are rejected — the PS path is re-expressed as
+    synchronous SPMD in this framework (SURVEY.md §2.4 "Async PS").
+    """
+    raw = os.environ.get("TF_CONFIG")
+    if not raw:
+        return None
+    try:
+        cfg = json.loads(raw)
+        cluster = cfg.get("cluster", {})
+        task = cfg.get("task", {})
+        if "ps" in cluster:
+            raise ValueError(
+                "TF_CONFIG declares parameter-server tasks; this framework is "
+                "SPMD-only (the reference's ParameterServerStrategy path maps "
+                "to a synchronous data/tensor-parallel mesh — launch every "
+                "task as a 'worker')."
+            )
+        chiefs = list(cluster.get("chief", []))
+        workers = list(cluster.get("worker", []))
+        ordered = chiefs + workers
+        if not ordered:
+            return None
+        ttype = task.get("type", "worker")
+        tindex = int(task.get("index", 0))
+        if ttype == "chief":
+            process_id = tindex
+        elif ttype == "worker":
+            process_id = len(chiefs) + tindex
+        elif ttype == "evaluator":
+            # Reference treats the evaluator as outside the training cluster.
+            return DistributedConfig(source="tf_config:evaluator")
+        else:
+            raise ValueError(f"Unsupported TF_CONFIG task type: {ttype!r}")
+        return DistributedConfig(
+            coordinator_address=ordered[0],
+            num_processes=len(ordered),
+            process_id=process_id,
+            source="env:TF_CONFIG",
+        )
+    except (json.JSONDecodeError, KeyError) as e:
+        raise ValueError(f"Malformed TF_CONFIG: {e}") from e
+
+
+def _expand_first_slurm_node(nodelist: str) -> str:
+    """First hostname from a Slurm nodelist like ``host[3-5,9],other``."""
+    m = re.match(r"([^\[,]+)(\[([^\]]+)\])?", nodelist)
+    if not m:
+        return nodelist.split(",")[0]
+    prefix, _, body = m.groups()
+    if not body:
+        return prefix
+    first = body.split(",")[0].split("-")[0]
+    return prefix + first
+
+
+def _from_slurm() -> Optional[DistributedConfig]:
+    if "SLURM_PROCID" not in os.environ or "SLURM_NTASKS" not in os.environ:
+        return None
+    nproc = int(os.environ["SLURM_NTASKS"])
+    pid = int(os.environ["SLURM_PROCID"])
+    nodelist = os.environ.get(
+        "SLURM_STEP_NODELIST", os.environ.get("SLURM_JOB_NODELIST", "localhost")
+    )
+    coord = f"{_expand_first_slurm_node(nodelist)}:{_DEFAULT_PORT}"
+    return DistributedConfig(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        source="env:SLURM",
+    )
+
+
+def resolve_cluster(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> DistributedConfig:
+    """Resolve this process's cluster position (see module docstring order)."""
+    if num_processes is not None:
+        return DistributedConfig(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id or 0,
+            source="explicit",
+        )
+    for probe in (_from_env_native, _from_tf_config, _from_slurm):
+        cfg = probe()
+        if cfg is not None:
+            return cfg
+    return DistributedConfig()
+
+
+_initialized = False
+
+
+def initialize_distributed(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Initialize the JAX distributed runtime if the cluster is multi-process.
+
+    Replaces the whole reference control plane: ``tf.train.Server`` startup,
+    gRPC master/worker session setup, and collective group-key resolution
+    (``collective_param_resolver_distributed.h``) are all subsumed by the XLA
+    coordination service that ``jax.distributed.initialize`` connects to.
+    Idempotent; safe to call in single-process mode (no-op).
+    """
+    global _initialized
+    cfg = config or resolve_cluster()
+    if cfg.is_multiprocess and not _initialized:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+        _initialized = True
+        logger.info(
+            "jax.distributed initialized: process %d/%d via %s (source=%s)",
+            cfg.process_id, cfg.num_processes, cfg.coordinator_address, cfg.source,
+        )
+    return cfg

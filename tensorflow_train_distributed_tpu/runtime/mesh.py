@@ -1,0 +1,190 @@
+"""Device mesh construction and strategy presets.
+
+The reference exposes a zoo of strategy classes — ``MirroredStrategy``
+(``mirrored_strategy.py:200``), ``MultiWorkerMirroredStrategy``
+(``collective_all_reduce_strategy.py:57``), ``ParameterServerStrategyV2``
+(``parameter_server_strategy_v2.py:77``), a Horovod hook, and DTensor meshes
+(``dtensor/python/layout.py:54``).  On TPU all of those are one thing: an SPMD
+program over a named ``jax.sharding.Mesh``.  What survives of the "strategy"
+concept is a *mesh preset*: a named assignment of the device grid to logical
+parallelism axes.
+
+Axes (any may be size 1):
+
+- ``data``     — pure data parallelism (replicated params, sharded batch).
+- ``fsdp``     — data parallelism with parameters/opt-state sharded over it
+                 (ZeRO-3 style; batch is sharded over data×fsdp jointly).
+- ``tensor``   — tensor/model parallelism (Megatron-style within-layer).
+- ``seq``      — sequence/context parallelism (ring attention / Ulysses).
+- ``expert``   — expert parallelism for MoE layers.
+- ``pipeline`` — pipeline stages.
+
+Presets keep the reference's ``--strategy`` CLI contract meaningful
+(``mirrored`` / ``multi_worker_mirrored`` / ``tpu`` → ``dp``; ``ps`` →
+rejected, see ``distributed._from_tf_config``; ``dtensor`` → ``dp_tp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest-varying, DCN-adjacent) first.
+# Data-parallel axes ride DCN across slices; tensor/seq want the fastest ICI
+# links, so they sit innermost — mesh_utils assigns the last mesh dims to the
+# most tightly coupled device dims.
+AXES = ("pipeline", "data", "fsdp", "expert", "seq", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per logical axis; ``-1`` on at most one axis means "infer".
+
+    ``strategy`` may name a preset (see ``STRATEGY_PRESETS``) in which case
+    unspecified axes come from the preset.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipeline: int = 1
+    strategy: Optional[str] = None
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "pipeline": self.pipeline,
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "expert": self.expert,
+            "seq": self.seq,
+            "tensor": self.tensor,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Concrete per-axis sizes for an ``n_devices`` mesh."""
+        sizes = self.axis_sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"At most one axis may be -1, got {unknown}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[unknown[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"Mesh {sizes} needs {math.prod(sizes.values())} devices, "
+                f"have {n_devices}"
+            )
+        return sizes
+
+
+# --strategy name → MeshConfig. Reference-strategy names map onto their SPMD
+# equivalents so existing launch scripts keep working.
+STRATEGY_PRESETS: dict[str, MeshConfig] = {
+    "dp": MeshConfig(data=-1),
+    "mirrored": MeshConfig(data=-1),                  # reference configs[0]
+    "multi_worker_mirrored": MeshConfig(data=-1),     # reference configs[1]
+    "horovod": MeshConfig(data=-1),                   # reference configs[3]
+    "tpu": MeshConfig(data=-1),                       # reference north-star flag
+    "fsdp": MeshConfig(data=1, fsdp=-1),
+    "dp_fsdp": MeshConfig(data=-1, fsdp=8),
+    "dp_tp": MeshConfig(data=-1, tensor=4),           # DTensor 2-D (data×model)
+    "dtensor": MeshConfig(data=-1, tensor=4),         # reference configs[4]
+    "dp_sp": MeshConfig(data=-1, seq=4),
+    "dp_tp_sp": MeshConfig(data=-1, seq=2, tensor=4),
+    "fsdp_tp": MeshConfig(data=1, fsdp=-1, tensor=4),
+    "dp_ep": MeshConfig(data=-1, expert=4),
+    "dp_pp": MeshConfig(data=-1, pipeline=2),
+}
+
+
+def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:
+    """Look up a preset, shrinking fixed axes to fit small device counts.
+
+    A preset like ``dp_tp`` (tensor=4) on a 2-device test mesh degrades to
+    tensor=2 rather than failing — mirrors the reference's behavior of running
+    any strategy on whatever devices exist.
+    """
+    if name == "ps" or name == "parameter_server":
+        raise ValueError(
+            "ParameterServerStrategy is not supported: this framework is "
+            "SPMD-only. Use --strategy=dp_tp (the DTensor-style mesh the "
+            "reference's north star prescribes for the BERT config)."
+        )
+    if name not in STRATEGY_PRESETS:
+        raise ValueError(
+            f"Unknown strategy {name!r}; available: {sorted(STRATEGY_PRESETS)}"
+        )
+    cfg = STRATEGY_PRESETS[name]
+    if n_devices is None:
+        return cfg
+    sizes = cfg.axis_sizes()
+    fixed_axes = [a for a, s in sizes.items() if s not in (1, -1)]
+    for axis in fixed_axes:
+        while sizes[axis] > 1 and n_devices % sizes[axis]:
+            sizes[axis] //= 2
+        sizes[axis] = max(1, min(sizes[axis], n_devices))
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    while fixed > n_devices or n_devices % fixed:
+        # Shrink the largest fixed axis until the mesh fits.
+        big = max(fixed_axes, key=lambda a: sizes[a], default=None)
+        if big is None or sizes[big] == 1:
+            break
+        sizes[big] //= 2
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+    return MeshConfig(strategy=name, **sizes)
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allow_split_physical_axes: bool = False,
+) -> Mesh:
+    """Build a named ``Mesh`` over the device grid.
+
+    On TPU, ``mesh_utils.create_device_mesh`` lays logical axes onto the
+    physical torus so the innermost axes (tensor/seq) get contiguous ICI
+    neighbours — the TPU-native analog of the reference's
+    ``DeviceAssignment.build`` (``tpu/device_assignment.py:343``) computing
+    replica→core mappings.  On CPU/test backends it falls back to a plain
+    reshape.
+    """
+    if config is None:
+        config = MeshConfig(data=-1)
+    if config.strategy is not None and all(
+        s == 1 for a, s in config.axis_sizes().items() if a != "data"
+    ) and config.data == -1 and config.strategy in STRATEGY_PRESETS:
+        config = strategy_preset(config.strategy, None)
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes,
+        )
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes the global batch is sharded over (data-parallel-like axes)."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or ("data",)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape["data"] * mesh.shape["fsdp"]

@@ -1,0 +1,44 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+TPU-native analog of the reference's test trick of splitting one host device
+into N logical devices (``tensorflow/python/distribute/test_util.py:131``,
+SURVEY.md §4.4): collectives, shardings, and multi-chip layouts all execute
+real code paths on CPU. Env vars must be set before jax imports anywhere.
+"""
+
+import os
+
+# Force CPU: the session env pins JAX_PLATFORMS to the real TPU backend and a
+# sitecustomize imports jax at interpreter startup (so env-var edits here are
+# too late for jax's config snapshot) — override through jax.config instead,
+# before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"  # still set for child processes we fork
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """Default 8-way data-parallel mesh."""
+    from tensorflow_train_distributed_tpu.runtime.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(data=-1))
+
+
+@pytest.fixture(scope="session")
+def mesh_2d():
+    """2×4 data×tensor mesh (the DTensor-style 2-D layout)."""
+    from tensorflow_train_distributed_tpu.runtime.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(data=2, tensor=4))

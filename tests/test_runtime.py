@@ -1,0 +1,145 @@
+"""Runtime core tests: cluster resolution and mesh construction."""
+
+import json
+import math
+
+import jax
+import pytest
+
+from tensorflow_train_distributed_tpu.runtime.distributed import (
+    DistributedConfig,
+    _expand_first_slurm_node,
+    resolve_cluster,
+)
+from tensorflow_train_distributed_tpu.runtime.mesh import (
+    AXES,
+    MeshConfig,
+    batch_axes,
+    build_mesh,
+    strategy_preset,
+)
+
+
+class TestResolveCluster:
+    def test_default_single_process(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID"):
+            monkeypatch.delenv(var, raising=False)
+        cfg = resolve_cluster()
+        assert cfg.num_processes == 1 and not cfg.is_multiprocess
+        assert cfg.is_coordinator
+
+    def test_explicit_args_win(self):
+        cfg = resolve_cluster("host:1234", num_processes=4, process_id=2)
+        assert cfg.coordinator_address == "host:1234"
+        assert cfg.num_processes == 4 and cfg.process_id == 2
+
+    def test_native_env(self, monkeypatch):
+        monkeypatch.setenv("TTD_COORDINATOR", "c:9")
+        monkeypatch.setenv("TTD_NUM_PROCESSES", "16")
+        monkeypatch.setenv("TTD_PROCESS_ID", "7")
+        cfg = resolve_cluster()
+        assert (cfg.coordinator_address, cfg.num_processes, cfg.process_id) == (
+            "c:9", 16, 7,
+        )
+
+    def test_tf_config_worker(self, monkeypatch):
+        monkeypatch.delenv("TTD_COORDINATOR", raising=False)
+        monkeypatch.setenv("TF_CONFIG", json.dumps({
+            "cluster": {"worker": ["a:1", "b:2", "c:3"]},
+            "task": {"type": "worker", "index": 1},
+        }))
+        cfg = resolve_cluster()
+        assert cfg.coordinator_address == "a:1"
+        assert cfg.num_processes == 3 and cfg.process_id == 1
+
+    def test_tf_config_chief_ordering(self, monkeypatch):
+        monkeypatch.delenv("TTD_COORDINATOR", raising=False)
+        monkeypatch.setenv("TF_CONFIG", json.dumps({
+            "cluster": {"chief": ["ch:1"], "worker": ["a:1", "b:2"]},
+            "task": {"type": "worker", "index": 0},
+        }))
+        cfg = resolve_cluster()
+        assert cfg.coordinator_address == "ch:1"
+        assert cfg.num_processes == 3 and cfg.process_id == 1
+
+    def test_tf_config_ps_rejected(self, monkeypatch):
+        monkeypatch.delenv("TTD_COORDINATOR", raising=False)
+        monkeypatch.setenv("TF_CONFIG", json.dumps({
+            "cluster": {"worker": ["a:1"], "ps": ["p:1"]},
+            "task": {"type": "worker", "index": 0},
+        }))
+        with pytest.raises(ValueError, match="SPMD-only"):
+            resolve_cluster()
+
+    def test_slurm(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("SLURM_PROCID", "3")
+        monkeypatch.setenv("SLURM_NTASKS", "8")
+        monkeypatch.setenv("SLURM_STEP_NODELIST", "tpu[12-15]")
+        cfg = resolve_cluster()
+        assert cfg.coordinator_address.startswith("tpu12:")
+        assert cfg.num_processes == 8 and cfg.process_id == 3
+
+    def test_slurm_nodelist_expansion(self):
+        assert _expand_first_slurm_node("h[3-5,9]") == "h3"
+        assert _expand_first_slurm_node("solo") == "solo"
+        assert _expand_first_slurm_node("a1,a2") == "a1"
+
+
+class TestMesh:
+    def test_resolve_infers_one_axis(self):
+        sizes = MeshConfig(data=-1, tensor=2).resolve(8)
+        assert sizes["data"] == 4 and sizes["tensor"] == 2
+        assert math.prod(sizes.values()) == 8
+
+    def test_resolve_rejects_bad_product(self):
+        with pytest.raises(ValueError):
+            MeshConfig(data=3, tensor=3).resolve(8)
+        with pytest.raises(ValueError):
+            MeshConfig(data=-1, tensor=-1).resolve(8)
+
+    def test_build_default_dp(self, mesh8):
+        assert mesh8.shape["data"] == 8
+        assert all(mesh8.shape[a] == 1 for a in AXES if a != "data")
+
+    def test_build_2d(self, mesh_2d):
+        assert mesh_2d.shape["data"] == 2 and mesh_2d.shape["tensor"] == 4
+        assert mesh_2d.devices.size == 8
+
+    def test_presets_reference_names(self):
+        for name in ("mirrored", "multi_worker_mirrored", "horovod", "tpu"):
+            cfg = strategy_preset(name, 8)
+            assert cfg.resolve(8)["data"] == 8, name
+
+    def test_preset_ps_rejected(self):
+        with pytest.raises(ValueError, match="SPMD-only"):
+            strategy_preset("ps", 8)
+
+    def test_preset_shrinks_to_fit(self):
+        # dp_tp wants tensor=4; on 2 devices it must degrade, not die.
+        cfg = strategy_preset("dp_tp", 2)
+        sizes = cfg.resolve(2)
+        assert math.prod(sizes.values()) == 2
+
+    def test_all_presets_build_on_8(self, devices):
+        for name in ("dp", "fsdp", "dp_tp", "dp_sp", "dp_tp_sp", "dtensor",
+                     "dp_fsdp", "fsdp_tp", "dp_ep", "dp_pp"):
+            mesh = build_mesh(strategy_preset(name, 8))
+            assert mesh.devices.size == 8, name
+
+    def test_batch_axes(self, mesh8, mesh_2d):
+        assert batch_axes(mesh8) == ("data",)
+        fsdp_mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+        assert batch_axes(fsdp_mesh) == ("data", "fsdp")
+
+    def test_put_sharded_array(self, mesh_2d):
+        """A NamedSharding over the mesh actually places data."""
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        sharding = NamedSharding(mesh_2d, P("data", "tensor"))
+        arr = jax.device_put(x, sharding)
+        assert len(arr.addressable_shards) == 8
+        assert arr.addressable_shards[0].data.shape == (4, 1)
