@@ -1,0 +1,24 @@
+"""Parallelism: collectives, sharding rules, sequence/context parallelism.
+
+TPU-native replacement for the reference's cross-device-ops layer
+(``tensorflow/python/distribute/cross_device_ops.py``,
+``cross_device_utils.py``, ``ops/collective_ops.py``) and the DTensor layout
+API (``tensorflow/dtensor/python/layout.py``) — see SURVEY.md §2.2/§5.8.
+"""
+
+from tensorflow_train_distributed_tpu.parallel.collectives import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    allreduce_bus_bandwidth,
+    broadcast_from_coordinator,
+    reduce_scatter,
+    ring_permute,
+)
+from tensorflow_train_distributed_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_sharding,
+    make_state_shardings,
+    shard_batch_spec,
+    with_logical_rules,
+)

@@ -1,0 +1,179 @@
+"""Named collective operations over the device mesh.
+
+The reference implements collectives as a Python orchestration layer over
+NCCL/RING C++ kernels: reduction algorithm selection
+(``cross_device_ops.py:252,960,1045``), gradient packing
+(``cross_device_ops.py:712``, ``cross_device_utils.py:679``), ordering tokens
+(``cross_device_utils.py:274``), and graph-level ring/recursive-halving
+builders (``distribute/v1/all_reduce.py:250,422``).  On TPU, every one of
+those jobs belongs to XLA: collectives are single HLO instructions scheduled
+by the compiler, packing/fusion is automatic, and ordering is by construction.
+
+What remains useful at the framework level — and what this module provides —
+is a *named, mesh-aware* API for the cases where code is written per-shard
+(inside ``shard_map``): ring attention's KV rotation, sequence↔head
+all-to-all (Ulysses), expert dispatch, and host-level utilities (variable
+broadcast at init, cross-host metric reduction).  Plus the allreduce
+bus-bandwidth microbenchmark, which is one of the driver's headline metrics
+(BASELINE.md).
+
+All per-shard functions take ``axis`` names bound by an enclosing
+``shard_map``/``pjit``; host-level helpers take the ``Mesh`` explicitly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+AxisNames = str | Sequence[str]
+
+# --- per-shard collectives (use inside shard_map) ---------------------------
+
+
+def all_reduce(x: jax.Array, axis: AxisNames, op: str = "sum") -> jax.Array:
+    """Reduce ``x`` across ``axis``; the TPU face of CollectiveAllReduce.
+
+    Lowers to a single XLA all-reduce over ICI/DCN (the reference's
+    ``CollectiveReduceV2``/NCCL path, ``ops/collective_ops.py:95``).
+    """
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(f"Unsupported reduce op: {op!r}")
+
+
+def all_gather(x: jax.Array, axis: AxisNames, *, gather_dim: int = 0,
+               tiled: bool = True) -> jax.Array:
+    """Concatenate shards along ``gather_dim`` (``CollectiveGatherV2`` analog)."""
+    return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: AxisNames, *, scatter_dim: int = 0
+                   ) -> jax.Array:
+    """Sum across ``axis`` and scatter shards of ``scatter_dim`` back."""
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_dim: int, concat_dim: int
+               ) -> jax.Array:
+    """Reshard between two tensor dimensions across ``axis``.
+
+    The primitive behind Ulysses-style sequence↔head resharding and MoE
+    expert dispatch; the reference's nearest analog is the NCCL all-to-all
+    kernel (``core/kernels/collective_nccl.h`` family) which no Python API
+    exposed.
+    """
+    return jax.lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+
+def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Rotate shards around the ``axis`` ring (``ppermute``).
+
+    The building block of ring attention (SURVEY.md §5.7): each device passes
+    its block to the next neighbour over ICI while computing on the current
+    one.
+    """
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+# --- host-level helpers -----------------------------------------------------
+
+
+def broadcast_from_coordinator(tree):
+    """Replicate a host-local pytree identically on all processes/devices.
+
+    Reference analog: ``HierarchicalTreeBroadcaster`` /
+    ``BroadcastGlobalVariablesHook`` (variable sync at init).  In multi-host
+    JAX this is ``multihost_utils.broadcast_one_to_all``; in single-process
+    mode it is a no-op identity.
+    """
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def host_all_reduce_mean(tree, mesh: Mesh):
+    """Mean of a metrics pytree across every device in the mesh.
+
+    Used by the trainer for cross-replica metric aggregation — the analog of
+    ``Strategy.reduce(MEAN, ...)`` (``distribute_lib.py:1675``).  Metrics
+    produced under pjit are already global (replicated) arrays, so the mean
+    is the identity and this reduces to a host fetch; kept as a named seam so
+    per-shard metric paths can change the reduction later.
+    """
+    del mesh
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+# --- microbenchmark ---------------------------------------------------------
+
+
+def allreduce_bus_bandwidth(
+    mesh: Mesh,
+    axis: str = "data",
+    *,
+    size_mb: float = 64.0,
+    iters: int = 10,
+    warmup: int = 3,
+    dtype=jnp.float32,
+) -> dict:
+    """Measure allreduce algorithmic bus bandwidth over a mesh axis.
+
+    Reports the standard ``2*(k-1)/k * bytes / time`` bus-bandwidth figure
+    where ``bytes`` is the per-rank buffer size (``size_mb``) — the NCCL
+    benchmark convention, making the number directly comparable to the
+    reference's NCCL allreduce measurements (BASELINE.md metric 3).
+    """
+    k = mesh.shape[axis]
+    per_shard = max(1, int(size_mb * 1e6 / np.dtype(dtype).itemsize))
+    spec = P(axis)
+
+    @jax.jit
+    def step(x):
+        def _inner(s):
+            return jax.lax.psum(s, axis)
+
+        return shard_map(
+            _inner, mesh=mesh, in_specs=spec, out_specs=P(),
+            check_vma=False,
+        )(x)
+
+    x = jax.device_put(
+        jnp.ones((k * per_shard,), dtype),
+        NamedSharding(mesh, spec),
+    )
+    for _ in range(warmup):
+        step(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # Per-rank buffer, NOT the k× global array size (NCCL busBW convention).
+    nbytes = per_shard * np.dtype(dtype).itemsize
+    bus_bw = 2 * (k - 1) / k * nbytes / dt if k > 1 else nbytes / dt
+    return {
+        "axis": axis,
+        "devices": k,
+        "message_bytes": nbytes,
+        "time_s": dt,
+        "bus_bandwidth_gbps": bus_bw / 1e9,
+    }

@@ -1,0 +1,152 @@
+"""Logical-axis sharding rules: the DTensor ``Layout`` replacement.
+
+The reference's model-parallel story is DTensor: every tensor carries a
+``Layout`` mapping its dims onto mesh axes (``tensorflow/dtensor/python/
+layout.py:54,352``), with ``pack``/``relayout`` (``api.py:192,412``) to move
+data between layouts, plus ``ShardedVariable`` for PS-style weight sharding
+(``sharded_variable.py:843``).  The TPU-native equivalent is GSPMD: models
+annotate parameters/activations with *logical* axis names
+(``nn.with_logical_partitioning`` / ``nn.with_logical_constraint``), and one
+rules table maps logical names onto mesh axes per strategy.  Change the
+rules, not the model — that is how one model definition runs under dp, fsdp,
+dp×tp, and dp×tp×sp unchanged.
+
+Logical vocabulary used across our model zoo (models may add their own):
+
+- ``batch``   — the global batch dim; sharded over (data, fsdp).
+- ``length``  — sequence/position dim; sharded over seq when SP is on.
+- ``embed``   — model/residual dim; fsdp shards params along it.
+- ``heads``   — attention heads; tensor-parallel.
+- ``kv``      — per-head dim; replicated.
+- ``mlp``     — MLP hidden dim; tensor-parallel.
+- ``vocab``   — embedding/logits vocab dim; tensor-parallel.
+- ``expert``  — MoE expert dim; expert-parallel.
+- ``conv_kernel``/``conv_in``/``conv_out`` — conv filters (ResNet family).
+- ``stage``   — pipeline stage dim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# rule table: logical axis → mesh axis (or tuple of mesh axes, or None)
+LogicalRules = Sequence[tuple[str, Any]]
+
+DEFAULT_RULES: LogicalRules = (
+    ("batch", ("data", "fsdp")),
+    ("length", "seq"),
+    ("embed", "fsdp"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("mlp", "tensor"),
+    ("vocab", "tensor"),
+    ("expert", "expert"),
+    ("conv_kernel", None),
+    ("conv_in", None),
+    ("conv_out", "tensor"),
+    ("stage", "pipeline"),
+    ("norm", None),
+)
+
+
+def _rules_for_mesh(mesh: Mesh, rules: LogicalRules) -> LogicalRules:
+    """Drop mesh axes of size 1 from the rules — sharding over them is a
+    no-op and keeping specs minimal gives XLA cleaner HLO shardings."""
+    out = []
+    for logical, target in rules:
+        if target is None:
+            out.append((logical, None))
+            continue
+        axes = (target,) if isinstance(target, str) else tuple(target)
+        axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        if not axes:
+            out.append((logical, None))
+        elif len(axes) == 1:
+            out.append((logical, axes[0]))
+        else:
+            out.append((logical, axes))
+    return tuple(out)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: LogicalRules = DEFAULT_RULES,
+) -> NamedSharding:
+    """NamedSharding for one array given its logical axis names.
+
+    The per-tensor analog of DTensor ``Layout(spec, mesh)``.
+    """
+    table = dict(_rules_for_mesh(mesh, rules))
+    used: set[str] = set()
+    dims = []
+    for a in logical_axes:
+        target = table.get(a) if a is not None else None
+        if target is None:
+            dims.append(None)
+            continue
+        axes = (target,) if isinstance(target, str) else tuple(target)
+        # A mesh axis may appear only once per array; first dim wins (e.g. an
+        # array annotated (batch, embed) under fsdp rules keeps batch on
+        # (data, fsdp) and leaves embed replicated).
+        axes = tuple(x for x in axes if x not in used)
+        used.update(axes)
+        dims.append(axes[0] if len(axes) == 1 else (axes or None))
+    return NamedSharding(mesh, P(*dims))
+
+
+def with_logical_rules(mesh: Mesh, rules: LogicalRules = DEFAULT_RULES):
+    """Context manager binding flax's logical-axis rules for this mesh.
+
+    Inside it, ``nn.with_logical_constraint`` annotations in model code
+    resolve against ``rules`` — the mechanism by which one model definition
+    serves every strategy preset.
+    """
+    return nn.logical_axis_rules(_rules_for_mesh(mesh, rules))
+
+
+def make_state_shardings(
+    mesh: Mesh,
+    abstract_state: Any,
+    rules: LogicalRules = DEFAULT_RULES,
+):
+    """Sharding pytree for a train state built from flax partition metadata.
+
+    ``abstract_state`` is the ``jax.eval_shape`` of state creation with
+    ``nn.Partitioned`` boxes still attached (``nn.get_partition_spec``
+    extracts the logical PartitionSpecs).  Leaves without metadata are
+    replicated — matching the reference's MirroredVariable default.
+    """
+    logical_specs = nn.get_partition_spec(abstract_state)
+    return nn.logical_to_mesh_sharding(
+        logical_specs, mesh, _rules_for_mesh(mesh, rules)
+    )
+
+
+def shard_batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for host batches: leading dim over every DP-like axis."""
+    from tensorflow_train_distributed_tpu.runtime.mesh import batch_axes
+
+    return P(batch_axes(mesh))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-local batch pytree as a globally-sharded array.
+
+    Single-process: a ``device_put`` with the batch spec.  Multi-host: each
+    process contributes its local shard of the global batch
+    (``jax.make_array_from_process_local_data``) — the TPU-native analog of
+    the reference's per-worker dataset sharding (``input_lib.py:729``).
+    """
+    sharding = NamedSharding(mesh, shard_batch_spec(mesh))
+
+    def _put(x):
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(_put, batch)

@@ -156,10 +156,10 @@ def resolve_cluster(
     process_id: Optional[int] = None,
 ) -> DistributedConfig:
     """Resolve this process's cluster position (see module docstring order)."""
-    if num_processes is not None:
+    if any(v is not None for v in (coordinator_address, num_processes, process_id)):
         return DistributedConfig(
             coordinator_address=coordinator_address,
-            num_processes=num_processes,
+            num_processes=1 if num_processes is None else num_processes,
             process_id=process_id or 0,
             source="explicit",
         )

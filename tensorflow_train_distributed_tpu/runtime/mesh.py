@@ -162,11 +162,13 @@ def build_mesh(
     """
     if config is None:
         config = MeshConfig(data=-1)
-    if config.strategy is not None and all(
-        s == 1 for a, s in config.axis_sizes().items() if a != "data"
-    ) and config.data == -1 and config.strategy in STRATEGY_PRESETS:
-        config = strategy_preset(config.strategy, None)
     devices = list(devices if devices is not None else jax.devices())
+    if config.strategy is not None and config.strategy in STRATEGY_PRESETS and all(
+        s == 1 for a, s in config.axis_sizes().items() if a != "data"
+    ) and config.data == -1:
+        # Bare MeshConfig(strategy=...) — resolve the preset against the real
+        # device count so shrink-to-fit applies (e.g. dp_tp on 1 chip).
+        config = strategy_preset(config.strategy, len(devices))
     sizes = config.resolve(len(devices))
     shape = tuple(sizes[a] for a in AXES)
     if devices[0].platform == "tpu":

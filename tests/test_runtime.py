@@ -33,6 +33,13 @@ class TestResolveCluster:
         assert cfg.coordinator_address == "host:1234"
         assert cfg.num_processes == 4 and cfg.process_id == 2
 
+    def test_explicit_coordinator_only_not_ignored(self, monkeypatch):
+        monkeypatch.setenv("TTD_COORDINATOR", "env:1")
+        monkeypatch.setenv("TTD_NUM_PROCESSES", "2")
+        monkeypatch.setenv("TTD_PROCESS_ID", "1")
+        cfg = resolve_cluster("mine:5")
+        assert cfg.source == "explicit" and cfg.coordinator_address == "mine:5"
+
     def test_native_env(self, monkeypatch):
         monkeypatch.setenv("TTD_COORDINATOR", "c:9")
         monkeypatch.setenv("TTD_NUM_PROCESSES", "16")
@@ -115,6 +122,12 @@ class TestMesh:
     def test_preset_ps_rejected(self):
         with pytest.raises(ValueError, match="SPMD-only"):
             strategy_preset("ps", 8)
+
+    def test_bare_strategy_meshconfig_shrinks(self, devices):
+        # __init__ docstring example: build_mesh(MeshConfig(strategy="dp_tp"))
+        # must resolve the preset against the actual device count.
+        mesh = build_mesh(MeshConfig(strategy="dp_tp"), devices=devices[:2])
+        assert mesh.devices.size == 2
 
     def test_preset_shrinks_to_fit(self):
         # dp_tp wants tensor=4; on 2 devices it must degrade, not die.
