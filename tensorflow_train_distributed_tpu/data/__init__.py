@@ -1,0 +1,26 @@
+"""Input pipeline: per-host sharding, batching, host→device prefetch.
+
+TPU-native replacement for the reference's distributed-input stack:
+``strategy.experimental_distribute_dataset`` → ``DistributedDataset``
+autoshard/rebatch (``tensorflow/python/distribute/input_lib.py:729``,
+``data/ops/options.py:89``, ``data/experimental/ops/distribute.py:219``) and
+the tf.data C++ runtime.  Here the pipeline is host-side Python/numpy over
+random-access sources, sharded per process, with double-buffered transfer to
+device — the "host-side prefetch-to-device" the reference's north star
+prescribes.
+"""
+
+from tensorflow_train_distributed_tpu.data.pipeline import (  # noqa: F401
+    DataConfig,
+    HostDataLoader,
+    prefetch_to_device,
+)
+from tensorflow_train_distributed_tpu.data.datasets import (  # noqa: F401
+    SyntheticBlobs,
+    SyntheticImageNet,
+    SyntheticLM,
+    SyntheticMLM,
+    SyntheticMNIST,
+    SyntheticWMT,
+    get_dataset,
+)

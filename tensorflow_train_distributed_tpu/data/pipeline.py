@@ -1,0 +1,197 @@
+"""Host data loading: deterministic sharded batching + device prefetch.
+
+Reference semantics being reproduced (SURVEY.md §3.5):
+
+- **autoshard** (``input_lib.py:729``; policy ``data/ops/options.py:89``):
+  each worker sees a disjoint 1/num_processes slice of the data.  We shard
+  *by index stride* (DATA policy); FILE-policy sharding belongs to the
+  source.
+- **rebatch** (``batch_sizes_for_worker``
+  ``data/experimental/ops/distribute.py:219``): users specify the *global*
+  batch size; each host produces global/num_processes examples, and the
+  per-device shard falls out of the batch ``NamedSharding``.
+- **prefetch-to-device**: tf.data's device prefetch becomes an async
+  double-buffer pushing the next batch to device while the current step
+  runs (works because jax dispatch is async).
+
+Determinism: shuffling is a seeded per-epoch permutation computed
+identically on every host (same seed ⇒ same permutation ⇒ consistent
+global batches), the analog of tf.data's ``shard-then-shuffle`` with a
+shared seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import queue as queue_lib
+from typing import Any, Iterator, Optional, Protocol
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tensorflow_train_distributed_tpu.parallel.sharding import shard_batch
+
+
+class RandomAccessSource(Protocol):
+    """Minimal source protocol (grain-compatible): len + indexed record."""
+
+    def __len__(self) -> int: ...
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Pipeline configuration (global batch semantics, like the reference)."""
+
+    global_batch_size: int = 32
+    shuffle: bool = True
+    seed: int = 0
+    drop_remainder: bool = True
+    num_epochs: Optional[int] = None  # None = repeat forever
+    prefetch: int = 2
+
+
+class HostDataLoader:
+    """Iterates host-local batches of a sharded source.
+
+    Each process yields ``global_batch_size / process_count`` examples per
+    step, drawn from its index-stride shard — together the processes cover
+    each epoch exactly once (reference DATA autoshard policy).
+    """
+
+    def __init__(
+        self,
+        source: RandomAccessSource,
+        config: DataConfig,
+        *,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ):
+        self.source = source
+        self.config = config
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index
+        )
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+        if config.global_batch_size % self.process_count:
+            raise ValueError(
+                f"global_batch_size={config.global_batch_size} not divisible "
+                f"by process_count={self.process_count}"
+            )
+        self.host_batch_size = config.global_batch_size // self.process_count
+        if not config.drop_remainder:
+            raise NotImplementedError(
+                "drop_remainder=False is not supported: SPMD step functions "
+                "need static shapes (XLA recompiles per shape). Pad the "
+                "source instead."
+            )
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.source)
+        if self.config.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.config.seed, epoch])
+            )
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        # Index-stride autoshard: process p takes order[p::P]. Same
+        # permutation on every host keeps global batches consistent.
+        return order[self.process_index :: self.process_count]
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        epoch = 0
+        while self.config.num_epochs is None or epoch < self.config.num_epochs:
+            order = self._epoch_order(epoch)
+            # Batch count must be identical on every process or multi-host
+            # SPMD deadlocks at the epoch boundary (one process enters the
+            # collective step while another's iterator is exhausted) — derive
+            # it from len(source), not from this process's shard length.
+            n_batches = self.steps_per_epoch()
+            for b in range(n_batches):
+                idx = order[b * self.host_batch_size : (b + 1) * self.host_batch_size]
+                records = [self.source[int(i)] for i in idx]
+                yield {
+                    k: np.stack([r[k] for r in records])
+                    for k in records[0]
+                }
+            epoch += 1
+
+    def steps_per_epoch(self) -> int:
+        per_host = len(self.source) // self.process_count
+        return per_host // self.host_batch_size
+
+    def as_device_iterator(self, mesh: Mesh) -> Iterator[Any]:
+        """Prefetched device iterator using ``config.prefetch`` buffers."""
+        return prefetch_to_device(iter(self), mesh, size=self.config.prefetch)
+
+
+def prefetch_to_device(
+    batches: Iterator[dict[str, np.ndarray]],
+    mesh: Mesh,
+    *,
+    size: int = 2,
+) -> Iterator[Any]:
+    """Async host→device prefetch of globally-sharded batches.
+
+    A background thread stages up to ``size`` batches on device (via
+    ``shard_batch``: NamedSharding over the mesh's DP axes) while compute
+    consumes them — the tf.data ``prefetch_to_device`` analog, hiding
+    host→HBM transfer behind the step.
+    """
+    if size < 1:
+        raise ValueError("prefetch size must be >= 1")
+    q: queue_lib.Queue = queue_lib.Queue(maxsize=size)
+    _END = object()
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def _producer():
+        try:
+            for batch in batches:
+                staged = shard_batch(mesh, batch)
+                while not stop.is_set():
+                    try:
+                        q.put(staged, timeout=0.1)
+                        break
+                    except queue_lib.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # propagate into consumer
+            err.append(e)
+        finally:
+            # Deliver _END even when the queue is momentarily full, but give
+            # up if the consumer has already stopped (nobody will drain).
+            while not stop.is_set():
+                try:
+                    q.put(_END, timeout=0.1)
+                    break
+                except queue_lib.Full:
+                    continue
+
+    t = threading.Thread(target=_producer, daemon=True, name="ttd-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer stopped early (break / exception / GeneratorExit): release
+        # the producer and drop staged batches so HBM isn't pinned.
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue_lib.Empty:
+                break
+        t.join(timeout=5)

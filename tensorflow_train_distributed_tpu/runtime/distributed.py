@@ -157,10 +157,17 @@ def resolve_cluster(
 ) -> DistributedConfig:
     """Resolve this process's cluster position (see module docstring order)."""
     if any(v is not None for v in (coordinator_address, num_processes, process_id)):
+        nproc = 1 if num_processes is None else num_processes
+        pid = process_id or 0
+        if not 0 <= pid < nproc:
+            raise ValueError(
+                f"process_id={pid} out of range for num_processes={nproc}; "
+                "pass num_processes alongside process_id"
+            )
         return DistributedConfig(
             coordinator_address=coordinator_address,
-            num_processes=1 if num_processes is None else num_processes,
-            process_id=process_id or 0,
+            num_processes=nproc,
+            process_id=pid,
             source="explicit",
         )
     for probe in (_from_env_native, _from_tf_config, _from_slurm):

@@ -163,7 +163,7 @@ def build_mesh(
     if config is None:
         config = MeshConfig(data=-1)
     devices = list(devices if devices is not None else jax.devices())
-    if config.strategy is not None and config.strategy in STRATEGY_PRESETS and all(
+    if config.strategy is not None and all(
         s == 1 for a, s in config.axis_sizes().items() if a != "data"
     ) and config.data == -1:
         # Bare MeshConfig(strategy=...) — resolve the preset against the real

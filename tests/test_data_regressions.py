@@ -1,0 +1,73 @@
+"""Regression tests for review findings on the data/runtime layers."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.data import (
+    DataConfig,
+    HostDataLoader,
+    prefetch_to_device,
+)
+from tensorflow_train_distributed_tpu.data.datasets import (
+    SyntheticBlobs,
+    SyntheticImageNet,
+)
+from tensorflow_train_distributed_tpu.runtime.distributed import resolve_cluster
+from tensorflow_train_distributed_tpu.runtime.mesh import MeshConfig, build_mesh
+
+
+def test_equal_batch_count_across_processes_uneven_source():
+    """7 examples / 2 processes: both must see the same number of batches."""
+    src = SyntheticBlobs(num_examples=7)
+    cfg = DataConfig(global_batch_size=2, num_epochs=1)
+    counts = []
+    for p in range(2):
+        loader = HostDataLoader(src, cfg, process_index=p, process_count=2)
+        counts.append(sum(1 for _ in loader))
+        assert counts[-1] == loader.steps_per_epoch()
+    assert counts[0] == counts[1] == 3  # (7//2)//1
+
+
+def test_build_mesh_rejects_unknown_and_ps_strategies(devices):
+    with pytest.raises(ValueError, match="Unknown strategy"):
+        build_mesh(MeshConfig(strategy="dp_tpp"), devices=devices)
+    with pytest.raises(ValueError, match="SPMD-only"):
+        build_mesh(MeshConfig(strategy="ps"), devices=devices)
+
+
+def test_prefetch_early_exit_stops_producer(mesh8):
+    loader = HostDataLoader(SyntheticBlobs(num_examples=64),
+                            DataConfig(global_batch_size=8, num_epochs=None))
+    it = prefetch_to_device(iter(loader), mesh8, size=2)
+    next(it)
+    it.close()  # early consumer exit must unblock + stop the producer
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(t.name == "ttd-prefetch" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    assert not any(t.name == "ttd-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_resolve_cluster_rejects_inconsistent_explicit():
+    with pytest.raises(ValueError, match="out of range"):
+        resolve_cluster(process_id=2)
+
+
+def test_synthetic_imagenet_odd_sizes():
+    for size in (100, 224, 12):
+        ds = SyntheticImageNet(num_examples=1, image_size=size)
+        assert ds[0]["image"].shape == (size, size, 3)
+
+
+def test_config_prefetch_knob_wired(mesh8):
+    loader = HostDataLoader(SyntheticBlobs(num_examples=16),
+                            DataConfig(global_batch_size=8, num_epochs=1,
+                                       prefetch=3))
+    n = sum(1 for _ in loader.as_device_iterator(mesh8))
+    assert n == 2
