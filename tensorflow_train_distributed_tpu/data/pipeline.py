@@ -29,7 +29,7 @@ from typing import Any, Iterator, Optional, Protocol
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 from tensorflow_train_distributed_tpu.parallel.sharding import shard_batch
 
@@ -136,13 +136,15 @@ def prefetch_to_device(
     mesh: Mesh,
     *,
     size: int = 2,
+    spec: Optional[PartitionSpec] = None,
 ) -> Iterator[Any]:
     """Async host→device prefetch of globally-sharded batches.
 
     A background thread stages up to ``size`` batches on device (via
-    ``shard_batch``: NamedSharding over the mesh's DP axes) while compute
-    consumes them — the tf.data ``prefetch_to_device`` analog, hiding
-    host→HBM transfer behind the step.
+    ``shard_batch``: NamedSharding over the mesh's DP axes, or ``spec`` if
+    given — e.g. ``P(None, ("data",))`` when dim 0 is a steps_per_execution
+    scan axis) while compute consumes them — the tf.data
+    ``prefetch_to_device`` analog, hiding host→HBM transfer behind the step.
     """
     if size < 1:
         raise ValueError("prefetch size must be >= 1")
@@ -154,7 +156,7 @@ def prefetch_to_device(
     def _producer():
         try:
             for batch in batches:
-                staged = shard_batch(mesh, batch)
+                staged = shard_batch(mesh, batch, spec=spec)
                 while not stop.is_set():
                     try:
                         q.put(staged, timeout=0.1)

@@ -134,15 +134,19 @@ def shard_batch_spec(mesh: Mesh) -> P:
     return P(batch_axes(mesh))
 
 
-def shard_batch(mesh: Mesh, batch):
+def shard_batch(mesh: Mesh, batch, *, spec: Optional[P] = None):
     """Place a host-local batch pytree as a globally-sharded array.
 
     Single-process: a ``device_put`` with the batch spec.  Multi-host: each
     process contributes its local shard of the global batch
     (``jax.make_array_from_process_local_data``) — the TPU-native analog of
     the reference's per-worker dataset sharding (``input_lib.py:729``).
+    ``spec`` overrides the default leading-dim placement (e.g.
+    ``P(None, ("data",))`` for steps_per_execution super-batches whose dim 0
+    is the scan axis).
     """
-    sharding = NamedSharding(mesh, shard_batch_spec(mesh))
+    sharding = NamedSharding(mesh, shard_batch_spec(mesh) if spec is None
+                             else spec)
 
     def _put(x):
         if jax.process_count() == 1:

@@ -1,0 +1,29 @@
+"""Training loops, callbacks, mixed precision, checkpointing.
+
+TPU-native replacement for the reference's L4 layer: Keras ``Model.fit`` /
+``train_step`` / ``make_train_function`` (``tf_keras/src/engine/
+training.py:1453,1118,1338``), the callback system (``callbacks.py:202``),
+optimizer gradient aggregation (``optimizers/utils.py:23``) and mixed
+precision (``mixed_precision/loss_scale_optimizer.py:587``) — rebuilt as one
+jitted SPMD step function with donation, an optional ``lax.scan`` inner loop
+(the ``steps_per_execution`` analog), and orbax checkpointing.
+"""
+
+from tensorflow_train_distributed_tpu.training.mixed_precision import (  # noqa: F401
+    Policy,
+)
+from tensorflow_train_distributed_tpu.training.train_state import (  # noqa: F401
+    TrainState,
+)
+from tensorflow_train_distributed_tpu.training.trainer import (  # noqa: F401
+    Trainer,
+    TrainerConfig,
+)
+from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    History,
+    JsonlLogger,
+    ProgressLogger,
+    TensorBoardScalars,
+)

@@ -1,0 +1,198 @@
+"""Callback seam for the training loop.
+
+Mirrors the Keras callback contract the reference trains through
+(``tf_keras/src/callbacks.py``: ``CallbackList:202``, ``History:1189``,
+``EarlyStopping:2002``, ``TensorBoard:2371``) with the hooks the SPMD loop
+actually has: train begin/end, step end (post-metrics), epoch end, and
+checkpoint events.  Chief-only side effects are each callback's own
+responsibility via ``jax.process_index() == 0`` — the analog of the
+reference's ``is_chief`` writer gating (``multi_worker_util.py:108``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Mapping, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    """Base class; all hooks optional."""
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self, state):
+        pass
+
+    def on_step_end(self, step: int, metrics: Mapping[str, float]) -> Optional[bool]:
+        """Return True to request an early stop."""
+
+    def on_epoch_end(self, epoch: int, metrics: Mapping[str, float]) -> Optional[bool]:
+        pass
+
+    def on_train_end(self, state):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, trainer=None):
+        self.callbacks = list(callbacks)
+        if trainer is not None:
+            for c in self.callbacks:
+                c.set_trainer(trainer)
+
+    def train_begin(self, state):
+        for c in self.callbacks:
+            c.on_train_begin(state)
+
+    def step_end(self, step, metrics) -> bool:
+        stop = False
+        for c in self.callbacks:
+            stop |= bool(c.on_step_end(step, metrics))
+        return stop
+
+    def epoch_end(self, epoch, metrics) -> bool:
+        stop = False
+        for c in self.callbacks:
+            stop |= bool(c.on_epoch_end(epoch, metrics))
+        return stop
+
+    def train_end(self, state):
+        for c in self.callbacks:
+            c.on_train_end(state)
+
+
+class History(Callback):
+    """Accumulates per-log-interval metrics (Keras ``History`` analog)."""
+
+    def __init__(self):
+        self.steps: list[int] = []
+        self.history: dict[str, list[float]] = {}
+
+    def on_step_end(self, step, metrics):
+        self.steps.append(step)
+        for k, v in metrics.items():
+            self.history.setdefault(k, []).append(float(v))
+
+
+class ProgressLogger(Callback):
+    """Stdout progress lines with step time + throughput (chief only)."""
+
+    def __init__(self, examples_per_step: Optional[int] = None):
+        self.examples_per_step = examples_per_step
+        self._last_time: Optional[float] = None
+        self._last_step: Optional[int] = None
+
+    def on_step_end(self, step, metrics):
+        if jax.process_index() != 0:
+            return
+        now = time.perf_counter()
+        line = f"step {step}"
+        if self._last_time is not None and step > self._last_step:
+            dt = (now - self._last_time) / (step - self._last_step)
+            line += f" | {dt * 1e3:.1f} ms/step"
+            if self.examples_per_step:
+                line += f" | {self.examples_per_step / dt:,.0f} ex/s"
+        self._last_time, self._last_step = now, step
+        for k, v in metrics.items():
+            line += f" | {k}={float(v):.4f}"
+        print(line, flush=True)
+
+
+class JsonlLogger(Callback):
+    """One JSON object per log event — the machine-readable metric stream
+    (replaces tf.summary scalar writing for headless runs); chief only."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._fh = None
+
+    def on_train_begin(self, state):
+        if jax.process_index() == 0 and self.path:
+            self._fh = open(self.path, "a")
+
+    def on_step_end(self, step, metrics):
+        if jax.process_index() != 0:
+            return
+        rec = {"step": step, **{k: float(v) for k, v in metrics.items()},
+               "ts": time.time()}
+        out = self._fh or sys.stdout
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+
+    def on_train_end(self, state):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class EarlyStopping(Callback):
+    """Stop when ``monitor`` hasn't improved for ``patience`` events
+    (Keras ``EarlyStopping:2002`` analog, evaluated per log interval)."""
+
+    def __init__(self, monitor: str = "loss", patience: int = 10,
+                 min_delta: float = 0.0, mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.monitor, self.patience = monitor, patience
+        self.min_delta, self.mode = min_delta, mode
+        self.best: Optional[float] = None
+        self.wait = 0
+
+    def on_step_end(self, step, metrics):
+        if self.monitor not in metrics:
+            return
+        cur = float(metrics[self.monitor])
+        better = (
+            self.best is None
+            or (self.mode == "min" and cur < self.best - self.min_delta)
+            or (self.mode == "max" and cur > self.best + self.min_delta)
+        )
+        if better:
+            self.best, self.wait = cur, 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            logger.info("EarlyStopping: %s plateaued at %s", self.monitor,
+                        self.best)
+            return True
+
+
+class TensorBoardScalars(Callback):
+    """Write scalars to TensorBoard event files via flax's writer.
+
+    Same viewer the reference's ``TensorBoard`` callback feeds; import is
+    lazy and failure-tolerant because the summary writer is an optional
+    dependency surface.
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._writer = None
+
+    def on_train_begin(self, state):
+        if jax.process_index() != 0:
+            return
+        try:
+            from flax.metrics import tensorboard
+
+            self._writer = tensorboard.SummaryWriter(self.logdir)
+        except Exception as e:  # no TB backend in env → degrade gracefully
+            logger.warning("TensorBoard writer unavailable (%s); skipping", e)
+
+    def on_step_end(self, step, metrics):
+        if self._writer is None:
+            return
+        for k, v in metrics.items():
+            self._writer.scalar(k, float(v), step)
+
+    def on_train_end(self, state):
+        if self._writer is not None:
+            self._writer.flush()

@@ -1,0 +1,33 @@
+"""Step-metric accumulation (host side).
+
+Replaces the Keras metric/History plumbing (``tf_keras/src/callbacks.py:1189``)
+with a plain running-mean accumulator over the scalar dict each jitted step
+returns.  Metrics under pjit are global (already cross-replica reduced inside
+the step via the mean over the sharded batch), so host aggregation is a
+simple average across steps.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+class MetricAccumulator:
+    def __init__(self):
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    def update(self, metrics: Mapping[str, float]):
+        for k, v in metrics.items():
+            v = float(np.asarray(v))
+            self._sums[k] = self._sums.get(k, 0.0) + v
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def result(self) -> dict[str, float]:
+        return {k: self._sums[k] / self._counts[k] for k in self._sums}
+
+    def reset(self):
+        self._sums.clear()
+        self._counts.clear()

@@ -1,0 +1,112 @@
+"""Mixed-precision policy + dynamic loss scaling.
+
+Reference surface: Keras ``Policy`` (``tf_keras/src/mixed_precision/
+policy.py:32``) and ``LossScaleOptimizer`` (``loss_scale_optimizer.py:587``).
+On TPU the native story is simpler: bfloat16 has fp32's exponent range, so
+the standard policy is params/optimizer in float32, compute in bfloat16, and
+**no loss scaling needed**.  Dynamic loss scaling is still provided for
+float16 parity (and numerics experiments): scale the loss, unscale grads,
+skip the update and halve the scale on non-finite grads, double after
+``growth_interval`` good steps — the same contract as the reference's
+``DynamicLossScale``, expressed as pure functions over a small state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy: where params live, where compute happens."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+    # Loss scaling: None → disabled (the right default for bf16 on TPU).
+    initial_loss_scale: Optional[float] = None
+    growth_interval: int = 2000
+    scale_factor: float = 2.0
+
+    @classmethod
+    def from_name(cls, name: str) -> "Policy":
+        """Named policies matching the Keras policy strings."""
+        if name in ("float32", "fp32"):
+            return cls(compute_dtype=jnp.float32)
+        if name in ("bfloat16", "mixed_bfloat16", "bf16"):
+            return cls(compute_dtype=jnp.bfloat16)
+        if name in ("float16", "mixed_float16", "fp16"):
+            return cls(compute_dtype=jnp.float16, initial_loss_scale=2.0**15)
+        raise ValueError(f"Unknown precision policy {name!r}")
+
+    @property
+    def uses_loss_scaling(self) -> bool:
+        return self.initial_loss_scale is not None
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_output(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+class LossScaleState(struct.PyTreeNode):
+    """Dynamic loss-scale state (scale, consecutive-finite counter)."""
+
+    scale: jax.Array
+    good_steps: jax.Array
+
+    @classmethod
+    def create(cls, policy: Policy) -> Optional["LossScaleState"]:
+        if not policy.uses_loss_scaling:
+            return None
+        return cls(
+            scale=jnp.float32(policy.initial_loss_scale),
+            good_steps=jnp.int32(0),
+        )
+
+
+def scale_loss(loss: jax.Array, ls: Optional[LossScaleState]) -> jax.Array:
+    return loss if ls is None else loss * ls.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, ls: Optional[LossScaleState]):
+    if ls is None:
+        return grads
+    inv = (1.0 / ls.scale).astype(jnp.float32)
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
+
+
+def grads_finite(grads) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    return jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]).all()
+
+
+def update_loss_scale(
+    ls: Optional[LossScaleState], finite: jax.Array, policy: Policy
+) -> Optional[LossScaleState]:
+    """Halve on overflow; double after ``growth_interval`` clean steps."""
+    if ls is None:
+        return None
+    grow = ls.good_steps + 1 >= policy.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, ls.scale * policy.scale_factor, ls.scale),
+        ls.scale / policy.scale_factor,
+    )
+    new_scale = jnp.maximum(new_scale, 1.0)
+    new_good = jnp.where(finite & ~grow, ls.good_steps + 1, 0)
+    return LossScaleState(scale=new_scale, good_steps=new_good)

@@ -1,0 +1,53 @@
+"""Train state: the SPMD replacement for the reference's distributed values.
+
+The reference materializes training state as distributed variable wrappers —
+``MirroredVariable``/``SyncOnReadVariable`` (``distribute/values.py``),
+``TPUVariableMixin`` (``tpu_values.py``), packed vars
+(``packed_distributed_variable.py``) — created under ``strategy.scope()``.
+In SPMD-JAX, state is one pytree of *global* jax.Arrays whose NamedShardings
+say how they live on the mesh; there is nothing to wrap.  ``TrainState``
+bundles the pytree; sharding comes from ``parallel.sharding``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+from flax import struct
+
+from tensorflow_train_distributed_tpu.training.mixed_precision import (
+    LossScaleState,
+)
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter, params, mutable model collections, optimizer state.
+
+    ``model_state`` carries non-trainable collections (e.g. ResNet
+    ``batch_stats`` — the analog of the reference's sync-on-read BN
+    variables).  ``loss_scale`` is present only under float16 policy.
+    """
+
+    step: jax.Array
+    params: Any
+    model_state: Any
+    opt_state: optax.OptState
+    loss_scale: Optional[LossScaleState] = None
+
+    @classmethod
+    def create(cls, *, params, model_state=None, tx: optax.GradientTransformation,
+               loss_scale: Optional[LossScaleState] = None) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.int32(0),
+            params=params,
+            model_state={} if model_state is None else model_state,
+            opt_state=tx.init(params),
+            loss_scale=loss_scale,
+        )
+
+    def num_params(self) -> int:
+        return sum(x.size for x in jax.tree.leaves(self.params))

@@ -1,0 +1,364 @@
+"""The SPMD training loop: ``Model.fit`` rebuilt TPU-first.
+
+Reference call stack being replaced (SURVEY.md §3.1/§3.2):
+``Model.fit`` → ``make_train_function`` → ``strategy.run(step)`` →
+per-replica ``train_step`` → optimizer ``aggregate_gradients`` allreduce
+(``tf_keras/src/engine/training.py:1453,1338,1118``;
+``optimizers/utils.py:23``).  Here the whole stack is ONE jitted function
+over global arrays: the gradient allreduce is inserted by GSPMD because the
+loss is a mean over the batch axis (sharded over data/fsdp) while params are
+replicated (or fsdp-sharded, in which case it becomes reduce-scatter +
+all-gather automatically).  There are no per-replica values, no strategy.run
+dispatch, no gradient packing — XLA owns all of it.
+
+``steps_per_execution`` (reference: ``training.py`` fit arg) maps to an
+inner ``lax.scan`` over a stacked super-batch: k steps per dispatch,
+amortizing host→device latency exactly like the reference amortizes
+tf.function dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
+from tensorflow_train_distributed_tpu.parallel.sharding import (
+    DEFAULT_RULES, LogicalRules,
+)
+from tensorflow_train_distributed_tpu.runtime.mesh import batch_axes
+from tensorflow_train_distributed_tpu.training import mixed_precision as mp
+from tensorflow_train_distributed_tpu.training.callbacks import (
+    Callback, CallbackList,
+)
+from tensorflow_train_distributed_tpu.training.metrics import MetricAccumulator
+from tensorflow_train_distributed_tpu.training.mixed_precision import Policy
+from tensorflow_train_distributed_tpu.training.train_state import TrainState
+
+import flax.linen as nn
+
+logger = logging.getLogger(__name__)
+
+
+class Task(Protocol):
+    """What a model config provides to the trainer.
+
+    ``init_variables`` returns the flax variable collections
+    (``{"params": ..., "batch_stats": ...}``); ``loss_fn`` returns
+    ``(scalar_loss, (metrics_dict, new_model_state))``.  The loss must be a
+    mean over the *global* batch — that is the contract that makes GSPMD
+    insert the cross-replica gradient reduction (the reference's
+    ``all_reduce_sum_gradients``).
+    """
+
+    def init_variables(self, rng: jax.Array, batch) -> Any: ...
+
+    def loss_fn(self, params, model_state, batch, rng: jax.Array,
+                train: bool) -> tuple[jax.Array, tuple[dict, Any]]: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    seed: int = 0
+    steps_per_execution: int = 1
+    log_every: int = 10
+    checkpoint_every: Optional[int] = None
+    donate_state: bool = True
+
+
+class Trainer:
+    """Owns state creation, the jitted step, and the fit/evaluate loops."""
+
+    def __init__(
+        self,
+        task: Task,
+        optimizer: optax.GradientTransformation,
+        mesh,
+        *,
+        rules: LogicalRules = DEFAULT_RULES,
+        policy: Policy = Policy(),
+        config: TrainerConfig = TrainerConfig(),
+        callbacks: Sequence[Callback] = (),
+        checkpoint_manager=None,
+    ):
+        self.task = task
+        self.tx = optimizer
+        self.mesh = mesh
+        self.rules = rules
+        self.policy = policy
+        self.config = config
+        self.callbacks = CallbackList(callbacks, trainer=self)
+        self.checkpoint_manager = checkpoint_manager
+        self._train_step = None
+        self._eval_step = None
+        self.state_shardings = None
+
+    # -- state ---------------------------------------------------------------
+
+    def create_state(self, sample_batch) -> TrainState:
+        """Init params on-device directly into their target shardings.
+
+        The jit-with-out_shardings pattern means a 7B-param model never
+        materializes unsharded on one chip — the analog of the reference
+        creating variables under ``strategy.scope()`` (``distribute_lib.py:
+        1223``) but placement-correct from the first byte.
+        """
+        rng = jax.random.key(self.config.seed)
+        batch_shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            sample_batch,
+        )
+
+        def _create():
+            # Zeros with the batch's shapes/dtypes: tasks get real traced
+            # arrays (the natural `model.init(rng, batch["x"])` idiom works)
+            # without baking a real data batch into the init computation.
+            init_batch = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), batch_shapes
+            )
+            variables = self.task.init_variables(rng, init_batch)
+            variables = dict(variables)
+            params = variables.pop("params")
+            return TrainState.create(
+                params=params,
+                model_state=variables,
+                tx=self.tx,
+                loss_scale=mp.LossScaleState.create(self.policy),
+            )
+
+        with sharding_lib.with_logical_rules(self.mesh, self.rules):
+            abstract = jax.eval_shape(_create)
+            self.state_shardings = sharding_lib.make_state_shardings(
+                self.mesh, abstract, self.rules
+            )
+            state = jax.jit(_create, out_shardings=self.state_shardings)()
+        state = nn.unbox(state)
+        self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
+        logger.info("created state: %.2fM params", state.num_params() / 1e6)
+        return state
+
+    # -- step functions ------------------------------------------------------
+
+    def _make_loss_fn(self, model_state, batch, rng, train: bool):
+        def loss_fn(params):
+            p = self.policy.cast_to_compute(params)
+            b = self.policy.cast_to_compute(batch)
+            loss, (metrics, new_ms) = self.task.loss_fn(
+                p, model_state, b, rng, train
+            )
+            return loss.astype(jnp.float32), (metrics, new_ms)
+
+        return loss_fn
+
+    def _single_step(self, state: TrainState, batch):
+        rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
+        loss_fn = self._make_loss_fn(state.model_state, batch, rng, True)
+
+        def scaled(params):
+            loss, aux = loss_fn(params)
+            return mp.scale_loss(loss, state.loss_scale), (loss, aux)
+
+        grad_fn = jax.value_and_grad(scaled, has_aux=True)
+        (_, (loss, (metrics, new_ms))), grads = grad_fn(state.params)
+        grads = mp.unscale_grads(grads, state.loss_scale)
+
+        if state.loss_scale is not None:
+            finite = mp.grads_finite(grads)
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            # Skip the update entirely on overflow (LossScaleOptimizer
+            # contract: no param/opt-state change on non-finite grads).
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_params, state.params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state)
+            new_ls = mp.update_loss_scale(state.loss_scale, finite,
+                                          self.policy)
+            metrics = dict(metrics, loss_scale=new_ls.scale,
+                           grads_finite=finite.astype(jnp.float32))
+        else:
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_ls = None
+
+        metrics = dict(metrics, loss=loss)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_ms,
+            opt_state=new_opt,
+            loss_scale=new_ls,
+        )
+        return new_state, metrics
+
+    def _compiled_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        k = self.config.steps_per_execution
+        mesh, rules = self.mesh, self.rules
+
+        def step(state, batch):
+            with sharding_lib.with_logical_rules(mesh, rules):
+                if k == 1:
+                    return self._single_step(state, batch)
+                new_state, ms = jax.lax.scan(
+                    self._single_step, state, batch
+                )
+                return new_state, jax.tree.map(lambda m: m[-1], ms)
+
+        donate = (0,) if self.config.donate_state else ()
+        self._train_step = jax.jit(step, donate_argnums=donate)
+        return self._train_step
+
+    def _compiled_eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+
+        def step(state, batch):
+            with sharding_lib.with_logical_rules(self.mesh, self.rules):
+                rng = jax.random.fold_in(
+                    jax.random.key(self.config.seed + 1), state.step)
+                loss_fn = self._make_loss_fn(state.model_state, batch, rng,
+                                             False)
+                loss, (metrics, _) = loss_fn(state.params)
+                return dict(metrics, loss=loss)
+
+        self._eval_step = jax.jit(step)
+        return self._eval_step
+
+    # -- loops ---------------------------------------------------------------
+
+    def _stack_batches(self, it, k: int):
+        """Group k host batches into one super-batch for the scan path."""
+        while True:
+            group = []
+            for _ in range(k):
+                try:
+                    group.append(next(it))
+                except StopIteration:
+                    return
+            yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+    def fit(
+        self,
+        batches: Iterable[Mapping[str, np.ndarray]],
+        *,
+        steps: int,
+        state: Optional[TrainState] = None,
+        steps_per_epoch: Optional[int] = None,
+    ) -> TrainState:
+        """Run ``steps`` optimizer steps over ``batches`` (host iterator).
+
+        ``batches`` yields host-local numpy batches (e.g. ``HostDataLoader``);
+        sharding to the mesh happens via prefetch.  ``steps_per_epoch`` marks
+        epoch boundaries for ``on_epoch_end`` callbacks (loaders may be
+        infinite, so epochs are declared, not discovered).  Returns the final
+        state.
+        """
+        from tensorflow_train_distributed_tpu.data.pipeline import (
+            prefetch_to_device,
+        )
+
+        k = self.config.steps_per_execution
+        if steps % k:
+            raise ValueError(
+                f"steps={steps} must be a multiple of "
+                f"steps_per_execution={k} (each dispatch runs exactly k "
+                "optimizer steps)"
+            )
+        it = iter(batches)
+        if state is None:
+            first = next(it)
+            state = self.create_state(first)
+            it = _chain_first(first, it)
+        if k > 1:
+            it = self._stack_batches(it, k)
+
+        step_fn = self._compiled_train_step()
+        self.callbacks.train_begin(state)
+        start_step = int(state.step)
+        done = 0
+        epoch = 0
+        last_metrics: dict[str, float] = {}
+        pending: list[tuple[int, Any]] = []
+        stop = False
+
+        from jax.sharding import PartitionSpec as P
+
+        # Super-batches (k>1) carry the scan axis at dim 0; the batch dim —
+        # the one sharded over the mesh — is dim 1.
+        spec = None if k == 1 else P(None, batch_axes(self.mesh))
+        device_iter = prefetch_to_device(it, self.mesh, spec=spec)
+        try:
+            for dev_batch in device_iter:
+                state, metrics = step_fn(state, dev_batch)
+                done += k
+                cur = start_step + done
+                pending.append((cur, metrics))
+                if done >= steps:
+                    stop = True
+                if len(pending) * k >= self.config.log_every or stop:
+                    # One device fetch for the whole pending window.
+                    host = jax.device_get([m for _, m in pending])
+                    for (s, _), m in zip(pending, host):
+                        host_m = {kk: float(v) for kk, v in m.items()}
+                        stop |= self.callbacks.step_end(s, host_m)
+                        last_metrics = host_m
+                    pending.clear()
+                while steps_per_epoch and done >= (epoch + 1) * steps_per_epoch:
+                    epoch += 1
+                    stop |= self.callbacks.epoch_end(epoch, last_metrics)
+                if (self.checkpoint_manager is not None
+                        and self.config.checkpoint_every
+                        and cur % self.config.checkpoint_every < k):
+                    self.checkpoint_manager.save(cur, state)
+                if stop:
+                    break
+        finally:
+            device_iter.close()
+        if self.checkpoint_manager is not None:
+            self.checkpoint_manager.save(int(state.step), state, force=True)
+            self.checkpoint_manager.wait_until_finished()
+        self.callbacks.train_end(state)
+        return state
+
+    def evaluate(
+        self,
+        batches: Iterable[Mapping[str, np.ndarray]],
+        state: TrainState,
+        *,
+        steps: Optional[int] = None,
+    ) -> dict[str, float]:
+        from tensorflow_train_distributed_tpu.data.pipeline import (
+            prefetch_to_device,
+        )
+
+        step_fn = self._compiled_eval_step()
+        acc = MetricAccumulator()
+        n = 0
+        device_iter = prefetch_to_device(iter(batches), self.mesh)
+        try:
+            for dev_batch in device_iter:
+                metrics = step_fn(state, dev_batch)
+                acc.update({k: float(np.asarray(v))
+                            for k, v in metrics.items()})
+                n += 1
+                if steps is not None and n >= steps:
+                    break
+        finally:
+            device_iter.close()
+        return acc.result()
+
+
+def _chain_first(first, rest):
+    yield first
+    yield from rest
