@@ -1,0 +1,18 @@
+"""Model zoo: the five reference configs, rebuilt TPU-first in flax.
+
+Reference config list (BASELINE.json / SURVEY.md §2.1):
+
+0. MNIST LeNet       — MirroredStrategy smoke test       → ``models.lenet``
+1. ResNet-50/ImageNet — MultiWorkerMirroredStrategy/NCCL → ``models.resnet``
+2. BERT-base MLM      — ParameterServerStrategy          → ``models.bert``
+3. Transformer-big WMT — Horovod allreduce hook          → ``models.transformer``
+4. Llama-2-7B SFT     — DTensor 2-D mesh (stretch)       → ``models.llama``
+
+Every model: (a) annotates params/activations with logical axis names so one
+definition serves every mesh preset; (b) provides a ``Task`` (init + loss)
+for the Trainer; (c) ships preset configs including a tiny variant for CPU
+tests.
+"""
+
+from tensorflow_train_distributed_tpu.models import registry  # noqa: F401
+from tensorflow_train_distributed_tpu.models.registry import get_task  # noqa: F401

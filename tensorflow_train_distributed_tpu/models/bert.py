@@ -1,0 +1,132 @@
+"""BERT-base masked-LM pretraining — reference config[2].
+
+The reference runs this under ParameterServerStrategy (coordinator + workers
++ ps, SURVEY.md §3.3); its own north star retires that for synchronous SPMD
+("ParameterServerStrategy → DTensor SPMD"), which is exactly this module on
+a dp(×tp) mesh: embedding/attention/MLP weights carry logical axes instead
+of ShardedVariable round-robin placement, and the async closure queue
+becomes the ordinary jitted step.
+
+Architecture: post-LN encoder, learned positions, GELU FFN, MLM head with
+transform + tied embedding logits + bias (BERT-base: L12 H768 A12 I3072).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models import layers as L
+from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_positions: int = 512
+    dropout_rate: float = 0.1
+    dtype: object = jnp.float32
+
+
+BERT_PRESETS = {
+    "bert_base": BertConfig(),
+    "bert_large": BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                             intermediate_size=4096),
+    "bert_tiny": BertConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dropout_rate=0.0),
+}
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        attn = L.MultiHeadAttention(
+            num_heads=cfg.num_heads,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            dtype=cfg.dtype,
+            dropout_rate=cfg.dropout_rate,
+            name="attention",
+        )(x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="attn_ln")(x + attn)
+        mlp = L.MlpBlock(
+            hidden=cfg.intermediate_size, dtype=cfg.dtype,
+            dropout_rate=cfg.dropout_rate, name="mlp",
+        )(x, deterministic=deterministic)
+        return nn.LayerNorm(dtype=cfg.dtype, name="mlp_ln")(x + mlp)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig = BertConfig()
+
+    def setup(self):
+        cfg = self.config
+        self.embed = L.Embed(cfg.vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype, name="token_embed")
+        self.pos_embed = self.param(
+            "pos_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_positions, cfg.hidden_size),
+        )
+        self.encoder_layers = [
+            EncoderLayer(cfg, name=f"layer_{i}")
+            for i in range(cfg.num_layers)
+        ]
+        self.mlm_transform = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                      name="mlm_transform")
+        self.mlm_ln = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")
+        self.mlm_bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,),
+        )
+
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        seq_len = input_ids.shape[1]
+        x = self.embed(input_ids)
+        x = x + self.pos_embed[None, :seq_len].astype(cfg.dtype)
+        for layer in self.encoder_layers:
+            x = layer(x, deterministic=deterministic)
+        # MLM head: transform → tied-embedding logits + bias.
+        h = nn.gelu(self.mlm_transform(x))
+        h = self.mlm_ln(h)
+        logits = self.embed.attend(h) + self.mlm_bias.astype(cfg.dtype)
+        return nn.with_logical_constraint(
+            logits, ("batch", "length", "vocab"))
+
+
+class BertMlmTask:
+    """Masked-LM objective over ``SyntheticMLM``-shaped batches."""
+
+    def __init__(self, config: BertConfig = BertConfig()):
+        self.config = config
+        self.model = BertEncoder(config)
+
+    def init_variables(self, rng, batch):
+        return self.model.init(rng, batch["input_ids"])
+
+    def loss_fn(self, params, model_state, batch, rng, train):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            deterministic=not train,
+            rngs={"dropout": rng} if train else {},
+        ).astype(jnp.float32)
+        loss, acc = softmax_cross_entropy(
+            logits, batch["labels"], weights=batch["mask_weights"])
+        return loss, ({"mlm_accuracy": acc}, model_state)
+
+
+def make_task(config: BertConfig = BERT_PRESETS["bert_base"]) -> BertMlmTask:
+    return BertMlmTask(config)

@@ -1,0 +1,217 @@
+"""Shared transformer building blocks with logical-axis shardings.
+
+One set of layers serves BERT (config[2]), Transformer-big (config[3]) and
+Llama (config[4]).  Every weight and activation carries logical axis names
+(``parallel.sharding`` vocabulary), so the same module tensor-parallelizes
+under dp×tp, sequence-parallelizes under dp×sp, and fsdp-shards under fsdp —
+the DTensor-Layout role from the reference's stretch config, without
+per-strategy model code.
+
+Megatron-style TP falls out of the annotations: qkv/mlp-in kernels shard
+their *output* dim on ``tensor`` (("embed","heads"), ("embed","mlp")),
+out-proj/mlp-out shard their *input* dim (("heads","embed") is not used —
+("mlp","embed") etc.), so GSPMD inserts exactly the two allreduces per block
+Megatron prescribes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu.ops.attention import (
+    multihead_attention_kernel,
+)
+
+Dtype = Any
+
+
+def dense(features, logical_axes, *, use_bias=True, dtype=jnp.float32,
+          name=None, kernel_init=None):
+    return nn.DenseGeneral(
+        features, use_bias=use_bias, dtype=dtype, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            kernel_init or nn.initializers.lecun_normal(), logical_axes),
+    )
+
+
+class Embed(nn.Module):
+    """Token embedding, vocab-sharded, with optional logit tying."""
+
+    vocab_size: int
+    features: int
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.embedding = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=1.0), ("vocab", "embed")),
+            (self.vocab_size, self.features),
+        )
+
+    def __call__(self, ids):
+        x = jnp.take(self.embedding.astype(self.dtype), ids, axis=0)
+        return nn.with_logical_constraint(x, ("batch", "length", "embed"))
+
+    def attend(self, x):
+        """Tied output logits: x @ E^T (used by Llama/BERT heads)."""
+        return jnp.einsum("ble,ve->blv", x, self.embedding.astype(x.dtype))
+
+
+def sinusoidal_positions(seq_len: int, features: int) -> np.ndarray:
+    """Fixed sin/cos table (Transformer-big / reference Keras convention)."""
+    pos = np.arange(seq_len)[:, None]
+    div = np.exp(np.arange(0, features, 2) / features * -np.log(10000.0))
+    table = np.zeros((seq_len, features), np.float32)
+    table[:, 0::2] = np.sin(pos * div)
+    table[:, 1::2] = np.cos(pos * div)
+    return table
+
+
+def apply_rope(x, positions, *, base: float = 10000.0):
+    """RoPE applied to [B, S, H, D] at integer ``positions`` [B, S].
+
+    Applied separately to q and k so each uses its own positions (KV-cache
+    decode and cross-length attention need different q/k position vectors).
+    """
+    head_dim = x.shape[-1]
+    freqs = 1.0 / base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    """Llama-family norm; scale is replicated ("norm" logical axis)."""
+
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],),
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.epsilon)
+        return (y * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA/GQA over the shared attention kernel.
+
+    Weights: q/k/v ("embed", "heads", "kv"); out ("heads", "kv", "embed").
+    Activations constrained to ("batch", "length", "heads", "kv") so a seq
+    axis shards length and a tensor axis shards heads.
+    """
+
+    num_heads: int
+    head_dim: int
+    num_kv_heads: Optional[int] = None  # GQA; None → MHA
+    dtype: Dtype = jnp.float32
+    causal: bool = False
+    use_rope: bool = False
+    rope_base: float = 10000.0
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x_q, x_kv=None, *, mask=None, positions=None,
+                 deterministic: bool = True):
+        x_kv = x_q if x_kv is None else x_kv
+        kv_heads = self.num_kv_heads or self.num_heads
+
+        def proj(x, heads, name):
+            y = nn.DenseGeneral(
+                (heads, self.head_dim), axis=-1, use_bias=False,
+                dtype=self.dtype, name=name,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "heads", "kv")),
+            )(x)
+            return nn.with_logical_constraint(
+                y, ("batch", "length", "heads", "kv"))
+
+        q = proj(x_q, self.num_heads, "query")
+        k = proj(x_kv, kv_heads, "key")
+        v = proj(x_kv, kv_heads, "value")
+
+        if self.use_rope:
+            if positions is None:
+                positions = jnp.broadcast_to(
+                    jnp.arange(x_q.shape[1]), x_q.shape[:2])
+            # k gets positions derived from its own sequence; when q is a
+            # suffix (decode), its positions are offset to the tail.
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(x_kv.shape[1]), x_kv.shape[:2])
+            q = apply_rope(q, positions, base=self.rope_base)
+            k = apply_rope(k, kv_positions, base=self.rope_base)
+
+        if kv_heads != self.num_heads:
+            # GQA: repeat KV groups to full heads (XLA fuses the broadcast).
+            rep = self.num_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        # [B, S, H, D] → [B, H, S, D] for the kernel.
+        out = multihead_attention_kernel(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=self.causal,
+            mask=mask,
+        ).transpose(0, 2, 1, 3)
+        out = nn.with_logical_constraint(
+            out, ("batch", "length", "heads", "kv"))
+        if self.dropout_rate > 0 and not deterministic:
+            out = nn.Dropout(self.dropout_rate)(out,
+                                                deterministic=deterministic)
+        y = nn.DenseGeneral(
+            x_q.shape[-1], axis=(-2, -1), use_bias=False, dtype=self.dtype,
+            name="out",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
+        )(out)
+        return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+
+
+class MlpBlock(nn.Module):
+    """Transformer FFN; gated (SwiGLU) when ``gated`` — Llama convention."""
+
+    hidden: int
+    dtype: Dtype = jnp.float32
+    activation: Callable = nn.gelu
+    gated: bool = False
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        d = x.shape[-1]
+        if self.gated:
+            gate = dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                         dtype=self.dtype, name="wi_gate")(x)
+            up = dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                       dtype=self.dtype, name="wi_up")(x)
+            h = self.activation(gate) * up
+        else:
+            h = dense(self.hidden, ("embed", "mlp"), dtype=self.dtype,
+                      name="wi")(x)
+            h = self.activation(h)
+        h = nn.with_logical_constraint(h, ("batch", "length", "mlp"))
+        if self.dropout_rate > 0 and not deterministic:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        y = dense(d, ("mlp", "embed"), use_bias=not self.gated,
+                  dtype=self.dtype, name="wo")(h)
+        return nn.with_logical_constraint(y, ("batch", "length", "embed"))

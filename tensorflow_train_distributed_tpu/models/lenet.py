@@ -1,0 +1,44 @@
+"""LeNet-5 for MNIST — reference config[0] (MirroredStrategy smoke test).
+
+The reference runs this as its single-worker CPU/GPU sanity config; here it
+is the dp-mesh sanity config (and the CI convergence canary).  Classic
+LeNet-5 shape: conv5x5(6) → pool → conv5x5(16) → pool → 120 → 84 → 10.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+
+from tensorflow_train_distributed_tpu.models.vision_task import VisionTask
+
+
+@dataclasses.dataclass(frozen=True)
+class LeNetConfig:
+    num_classes: int = 10
+    hidden: tuple[int, int] = (120, 84)
+
+
+class LeNet(nn.Module):
+    config: LeNetConfig = LeNetConfig()
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        del train  # no BN/dropout in classic LeNet
+        x = nn.Conv(6, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for h in self.config.hidden:
+            x = nn.Dense(h)(x)
+            x = nn.relu(x)
+        x = nn.with_logical_constraint(x, ("batch", None))
+        return nn.Dense(self.config.num_classes)(x)
+
+
+def make_task(config: LeNetConfig = LeNetConfig()) -> VisionTask:
+    return VisionTask(LeNet(config))

@@ -1,0 +1,99 @@
+"""Model/task registry — name → Task factory + dataset pairing.
+
+The lookup table behind the CLI's ``--config`` flag (the reference
+launcher's per-model dispatch, SURVEY.md §2.1).  Tiny variants exist for
+every family so each model's full path runs on CPU test meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_REGISTRY: dict[str, dict[str, Any]] = {}
+
+
+def register(name: str, *, task_factory: Callable, dataset: str,
+             dataset_kwargs: dict | None = None, strategy: str = "dp",
+             global_batch_size: int = 32, learning_rate: float = 1e-3):
+    _REGISTRY[name] = dict(
+        task_factory=task_factory, dataset=dataset,
+        dataset_kwargs=dataset_kwargs or {}, strategy=strategy,
+        global_batch_size=global_batch_size, learning_rate=learning_rate,
+    )
+
+
+def get_task(name: str):
+    return get_entry(name)["task_factory"]()
+
+
+def get_entry(name: str) -> dict[str, Any]:
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"Unknown config {name!r}; available: {sorted(_REGISTRY)}")
+    return dict(_REGISTRY[name])
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _setup():
+    from tensorflow_train_distributed_tpu.models import (
+        bert, lenet, llama, resnet, transformer,
+    )
+
+    # Reference config[0]: MNIST LeNet (MirroredStrategy smoke test).
+    register("mnist", task_factory=lenet.make_task, dataset="mnist",
+             strategy="dp", global_batch_size=128, learning_rate=1e-3)
+    # Reference config[1]: ResNet-50 / ImageNet (MWMS + NCCL → dp over ICI).
+    register("resnet50_imagenet",
+             task_factory=lambda: resnet.make_task(
+                 resnet.RESNET_PRESETS["resnet50"]),
+             dataset="imagenet", strategy="dp", global_batch_size=1024,
+             learning_rate=0.4)
+    register("resnet_tiny",
+             task_factory=lambda: resnet.make_task(
+                 resnet.RESNET_PRESETS["resnet_tiny"],
+                 label_smoothing=0.0, weight_decay=0.0),
+             dataset="imagenet",
+             dataset_kwargs=dict(num_classes=10, image_size=32),
+             strategy="dp", global_batch_size=64, learning_rate=1e-3)
+    # Reference config[2]: BERT-base MLM (PS strategy → SPMD dp_tp).
+    register("bert_base_mlm",
+             task_factory=lambda: bert.make_task(
+                 bert.BERT_PRESETS["bert_base"]),
+             dataset="mlm", strategy="dp", global_batch_size=256,
+             learning_rate=1e-4)
+    register("bert_tiny_mlm",
+             task_factory=lambda: bert.make_task(
+                 bert.BERT_PRESETS["bert_tiny"]),
+             dataset="mlm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=64),
+             strategy="dp", global_batch_size=32, learning_rate=1e-3)
+    # Reference config[3]: Transformer-big WMT (Horovod hook → dp).
+    register("transformer_big_wmt",
+             task_factory=lambda: transformer.make_task(
+                 transformer.TRANSFORMER_PRESETS["transformer_big"]),
+             dataset="wmt", strategy="dp", global_batch_size=512,
+             learning_rate=1e-3)
+    register("transformer_tiny_wmt",
+             task_factory=lambda: transformer.make_task(
+                 transformer.TRANSFORMER_PRESETS["transformer_tiny"]),
+             dataset="wmt",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp", global_batch_size=32, learning_rate=1e-3)
+    # Reference config[4]: Llama-2-7B SFT (DTensor 2-D mesh → dp_tp).
+    register("llama2_7b_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["llama2_7b"]),
+             dataset="lm", strategy="dp_tp", global_batch_size=64,
+             learning_rate=2e-5)
+    register("llama_tiny_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["llama_tiny"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp_tp", global_batch_size=16, learning_rate=1e-3)
+
+
+_setup()

@@ -1,0 +1,125 @@
+"""ResNet-50 (v1.5) for ImageNet — reference config[1].
+
+The reference trains this under MultiWorkerMirroredStrategy with NCCL
+allreduce and ``Model.fit`` (SURVEY.md §3.1) — the headline benchmark config
+(BASELINE.md: ≥90% of MLPerf TPU-ref images/sec/chip).  TPU-first choices:
+
+- NHWC layout + bfloat16 compute: XLA's conv tiling onto the MXU wants NHWC
+  on TPU; params stay f32 (mixed-precision policy).
+- v1.5 variant (stride 2 on the 3x3, not the 1x1) — the MLPerf reference
+  architecture.
+- BatchNorm over the global batch (sync-BN semantics fall out of global
+  arrays; see ``vision_task``).
+- conv kernels carry ("conv_in", "conv_out") logical axes so the tensor
+  axis can shard output channels if a preset asks for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models.vision_task import VisionTask
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_filters: int = 64
+    num_classes: int = 1000
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+
+RESNET_PRESETS = {
+    "resnet18": ResNetConfig(stage_sizes=(2, 2, 2, 2)),
+    "resnet50": ResNetConfig(stage_sizes=(3, 4, 6, 3)),
+    "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3)),
+    "resnet_tiny": ResNetConfig(stage_sizes=(1, 1), num_filters=8,
+                                num_classes=10),
+}
+
+
+def _conv(features, kernel, strides=1, name=None):
+    return nn.Conv(
+        features, (kernel, kernel), strides=(strides, strides),
+        padding="SAME", use_bias=False,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            (None, None, "conv_in", "conv_out"),
+        ),
+        name=name,
+    )
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train,
+            momentum=self.config.bn_momentum, epsilon=self.config.bn_epsilon,
+            dtype=x.dtype,
+        )
+        residual = x
+        y = _conv(self.filters, 1)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = _conv(self.filters, 3, self.strides)(y)  # v1.5: stride on 3x3
+        y = norm()(y)
+        y = nn.relu(y)
+        y = _conv(self.filters * 4, 1)(y)
+        # Zero-init the last BN scale (standard ResNet trick: each block
+        # starts as identity, required to match reference loss curves).
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.filters * 4, 1, self.strides,
+                             name="proj_conv")(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig = ResNetConfig()
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.config
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=cfg.bn_momentum, epsilon=cfg.bn_epsilon,
+                       dtype=x.dtype)
+        x = _conv(cfg.num_filters, 7, 2, name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=cfg.num_filters * 2**i,
+                    strides=2 if j == 0 and i > 0 else 1,
+                    config=cfg,
+                )(x, train=train)
+        x = nn.with_logical_constraint(x, ("batch", None, None, "conv_out"))
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            cfg.num_classes,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")),
+            dtype=jnp.float32,
+        )(x)
+        return x
+
+
+def make_task(config: ResNetConfig = RESNET_PRESETS["resnet50"],
+              *, label_smoothing: float = 0.1,
+              weight_decay: float = 1e-4) -> VisionTask:
+    """MLPerf-style training task: label smoothing 0.1, weight decay 1e-4."""
+    return VisionTask(ResNet(config), label_smoothing=label_smoothing,
+                      weight_decay=weight_decay)

@@ -1,0 +1,155 @@
+"""Transformer-big encoder-decoder for WMT en-de — reference config[3].
+
+The reference trains this with a Horovod allreduce hook around a custom
+loop (SURVEY.md §3.2); here the allreduce is GSPMD's and the custom loop is
+the standard Trainer.  Architecture follows the classic "big" setting:
+6+6 layers, d_model 1024, 16 heads, FFN 4096, sinusoidal positions, pre-LN
+(the variant that trains stably without the reference's warmup fragility),
+label smoothing 0.1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models import layers as L
+from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 1024
+    num_heads: int = 16
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    ffn_size: int = 4096
+    max_positions: int = 1024
+    dropout_rate: float = 0.1
+    label_smoothing: float = 0.1
+    dtype: object = jnp.float32
+
+
+TRANSFORMER_PRESETS = {
+    "transformer_big": TransformerConfig(),
+    "transformer_base": TransformerConfig(d_model=512, num_heads=8,
+                                          ffn_size=2048),
+    "transformer_tiny": TransformerConfig(
+        vocab_size=256, d_model=32, num_heads=2, num_encoder_layers=2,
+        num_decoder_layers=2, ffn_size=64, max_positions=128,
+        dropout_rate=0.0),
+}
+
+
+class EncoderLayer(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        x = x + L.MultiHeadAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.d_model // cfg.num_heads,
+            dtype=cfg.dtype, dropout_rate=cfg.dropout_rate,
+            name="self_attention",
+        )(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        return x + L.MlpBlock(hidden=cfg.ffn_size, dtype=cfg.dtype,
+                              dropout_rate=cfg.dropout_rate, name="mlp",
+                              )(h, deterministic=deterministic)
+
+
+class DecoderLayer(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, y, enc, *, deterministic: bool = True):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype)(y)
+        y = y + L.MultiHeadAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.d_model // cfg.num_heads,
+            dtype=cfg.dtype, causal=True, dropout_rate=cfg.dropout_rate,
+            name="self_attention",
+        )(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype)(y)
+        y = y + L.MultiHeadAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.d_model // cfg.num_heads,
+            dtype=cfg.dtype, dropout_rate=cfg.dropout_rate,
+            name="cross_attention",
+        )(h, enc, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype)(y)
+        return y + L.MlpBlock(hidden=cfg.ffn_size, dtype=cfg.dtype,
+                              dropout_rate=cfg.dropout_rate, name="mlp",
+                              )(h, deterministic=deterministic)
+
+
+class Seq2SeqTransformer(nn.Module):
+    config: TransformerConfig = TransformerConfig()
+
+    def setup(self):
+        cfg = self.config
+        self.embed = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                             name="shared_embed")
+        self.pos_table = L.sinusoidal_positions(cfg.max_positions,
+                                                cfg.d_model)
+        self.enc_layers = [EncoderLayer(cfg, name=f"enc_{i}")
+                           for i in range(cfg.num_encoder_layers)]
+        self.dec_layers = [DecoderLayer(cfg, name=f"dec_{i}")
+                           for i in range(cfg.num_decoder_layers)]
+        self.enc_norm = nn.LayerNorm(dtype=cfg.dtype, name="enc_norm")
+        self.dec_norm = nn.LayerNorm(dtype=cfg.dtype, name="dec_norm")
+
+    def _pos(self, x):
+        scale = jnp.asarray(self.config.d_model, jnp.float32) ** 0.5
+        return x * scale.astype(x.dtype) + jnp.asarray(
+            self.pos_table[: x.shape[1]], x.dtype)[None]
+
+    def encode(self, inputs, *, deterministic: bool = True):
+        x = self._pos(self.embed(inputs))
+        for layer in self.enc_layers:
+            x = layer(x, deterministic=deterministic)
+        return self.enc_norm(x)
+
+    def decode(self, targets_in, enc, *, deterministic: bool = True):
+        y = self._pos(self.embed(targets_in))
+        for layer in self.dec_layers:
+            y = layer(y, enc, deterministic=deterministic)
+        y = self.dec_norm(y)
+        logits = self.embed.attend(y)  # tied softmax (big-model convention)
+        return nn.with_logical_constraint(
+            logits, ("batch", "length", "vocab"))
+
+    def __call__(self, inputs, targets_in, *, deterministic: bool = True):
+        enc = self.encode(inputs, deterministic=deterministic)
+        return self.decode(targets_in, enc, deterministic=deterministic)
+
+
+class Seq2SeqTask:
+    """WMT-style objective over ``SyntheticWMT`` batches."""
+
+    def __init__(self, config: TransformerConfig = TransformerConfig()):
+        self.config = config
+        self.model = Seq2SeqTransformer(config)
+
+    def init_variables(self, rng, batch):
+        return self.model.init(rng, batch["inputs"], batch["targets_in"])
+
+    def loss_fn(self, params, model_state, batch, rng, train):
+        logits = self.model.apply(
+            {"params": params}, batch["inputs"], batch["targets_in"],
+            deterministic=not train,
+            rngs={"dropout": rng} if train else {},
+        ).astype(jnp.float32)
+        loss, acc = softmax_cross_entropy(
+            logits, batch["targets_out"],
+            label_smoothing=self.config.label_smoothing)
+        return loss, ({"accuracy": acc}, model_state)
+
+
+def make_task(config: TransformerConfig = TRANSFORMER_PRESETS[
+        "transformer_big"]) -> Seq2SeqTask:
+    return Seq2SeqTask(config)

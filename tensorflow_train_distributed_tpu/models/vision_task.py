@@ -1,0 +1,52 @@
+"""Shared task wrapper for image-classification models (LeNet, ResNet).
+
+Replaces the reference harness's per-model ``train_step`` bodies: softmax
+cross-entropy (+ label smoothing / weight decay where the config says so),
+accuracy metric, and the mutable ``batch_stats`` plumbing for BatchNorm
+models.  Under global-array SPMD the BN statistics are computed over the
+*global* batch (XLA inserts the cross-replica reductions), i.e. sync-BN
+semantics — strictly stronger than the reference's default per-replica BN
+(``tf_keras`` BatchNormalization under MirroredStrategy).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+
+
+class VisionTask:
+    def __init__(self, model, *, label_smoothing: float = 0.0,
+                 weight_decay: float = 0.0):
+        self.model = model
+        self.label_smoothing = label_smoothing
+        self.weight_decay = weight_decay
+
+    def init_variables(self, rng, batch):
+        return self.model.init(rng, batch["image"], train=False)
+
+    def loss_fn(self, params, model_state, batch, rng, train):
+        variables = {"params": params, **model_state}
+        if train and model_state:
+            logits, updates = self.model.apply(
+                variables, batch["image"], train=True,
+                mutable=list(model_state.keys()),
+            )
+            new_model_state = updates
+        else:
+            logits = self.model.apply(variables, batch["image"], train=train)
+            new_model_state = model_state
+        loss, acc = softmax_cross_entropy(
+            logits, batch["label"], label_smoothing=self.label_smoothing)
+        if self.weight_decay > 0:
+            # L2 on kernels only (reference ResNet convention: no decay on
+            # BN scales/biases).
+            l2 = sum(
+                jnp.sum(jnp.square(p))
+                for path, p in jax.tree_util.tree_leaves_with_path(params)
+                if path[-1].key == "kernel"
+            )
+            loss = loss + self.weight_decay * l2
+        return loss, ({"accuracy": acc}, new_model_state)

@@ -1,0 +1,13 @@
+"""Hot ops: attention kernels and fused layers.
+
+The reference has no custom kernels — its hot ops are cuDNN/NCCL inside the
+TF runtime.  On TPU the equivalents are pallas kernels (flash/splash
+attention, grouped matmul) plus XLA fusion for everything else.  Every op
+here has a pure-jax reference implementation (used on CPU test meshes and as
+the numerics oracle) and a TPU fast path.
+"""
+
+from tensorflow_train_distributed_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+    multihead_attention_kernel,
+)

@@ -1,0 +1,98 @@
+"""Attention: pure-jax reference + pallas flash-attention TPU fast path.
+
+The reference framework has no attention kernel of its own (BERT/Transformer
+configs ride stock Keras layers → cuDNN).  TPU-first, attention is the one
+op worth a hand kernel: the pallas flash attention
+(``jax/experimental/pallas/ops/tpu/flash_attention.py``) streams KV blocks
+through VMEM without materializing the S×S score matrix, which is what makes
+long-context training feasible at all (SURVEY.md §5.7 — a capability the
+reference lacks).
+
+Dispatch contract: ``multihead_attention_kernel`` takes [B, H, S, D] q/k/v
+and routes to pallas on TPU when shapes are kernel-friendly, else to the
+reference einsum path (always used on CPU test meshes — it is also the
+numerics oracle the kernel is tested against).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention. q/k/v: [B, H, S, D] (q may have different S)."""
+    *_, q_len, head_dim = q.shape
+    kv_len = k.shape[-2]
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    # Large finite negative, not -inf: a fully-masked query row must produce
+    # ~zeros after softmax, not NaN (all--inf rows NaN out the whole batch).
+    mask_value = jnp.finfo(jnp.float32).min / 2
+    if causal:
+        # Bottom-right aligned causal mask (supports q_len != kv_len).
+        q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+        k_pos = jnp.arange(kv_len)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, mask_value)
+    if mask is not None:
+        logits = jnp.where(mask, logits, mask_value)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def _pallas_friendly(q, k, v) -> bool:
+    """Pallas flash kernel wants seq multiples of 128 and head_dim >= 128-
+    lane tiling; fall back cleanly otherwise."""
+    if jax.default_backend() != "tpu":
+        return False
+    q_len, kv_len = q.shape[-2], k.shape[-2]
+    # q_len == kv_len: the pallas kernel's causal mask is top-left aligned;
+    # our reference semantics are bottom-right — they only coincide for
+    # equal lengths, so unequal lengths take the reference path.
+    return (
+        q_len == kv_len
+        and q_len % 128 == 0
+        and q.shape[-1] in (64, 128, 256)
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )
+
+
+def multihead_attention_kernel(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    force_reference: bool = False,
+) -> jax.Array:
+    """Flash attention on TPU, reference path elsewhere.
+
+    Arbitrary ``mask`` forces the reference path (the pallas kernel supports
+    causal/segment structure, not dense boolean masks).
+    """
+    if force_reference or mask is not None or not _pallas_friendly(q, k, v):
+        return dot_product_attention(
+            q, k, v, causal=causal, mask=mask, softmax_scale=softmax_scale
+        )
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    scale = (softmax_scale if softmax_scale is not None
+             else q.shape[-1] ** -0.5)
+    return flash_attention(q, k, v, causal=causal, sm_scale=scale)

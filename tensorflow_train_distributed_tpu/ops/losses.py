@@ -1,0 +1,46 @@
+"""Shared classification losses (single source for every task).
+
+One implementation of (optionally label-smoothed, optionally weighted)
+softmax cross-entropy + accuracy, used by the vision, seq2seq, MLM and LM
+tasks — so fixes (padding masks, z-loss, ...) land everywhere at once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    label_smoothing: float = 0.0,
+    weights: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Mean CE loss and accuracy over ``labels``.
+
+    ``logits``: [..., num_classes] (f32 recommended); ``labels``: integer
+    [...]; ``weights``: optional per-example/token weights (e.g. MLM mask) —
+    the mean is over total weight, matching the reference's weighted-metric
+    semantics.
+    """
+    logits = logits.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32),
+            label_smoothing,
+        )
+        per_example = optax.softmax_cross_entropy(logits, onehot)
+    else:
+        per_example = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels)
+    correct = (logits.argmax(-1) == labels).astype(jnp.float32)
+    if weights is None:
+        return per_example.mean(), correct.mean()
+    w = weights.astype(jnp.float32)
+    denom = jnp.maximum(w.sum(), 1.0)
+    return (per_example * w).sum() / denom, (correct * w).sum() / denom

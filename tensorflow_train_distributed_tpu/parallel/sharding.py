@@ -27,10 +27,14 @@ Logical vocabulary used across our model zoo (models may add their own):
 
 from __future__ import annotations
 
+import logging
+import math
 from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax
+
+logger = logging.getLogger(__name__)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # rule table: logical axis → mesh axis (or tuple of mesh axes, or None)
@@ -120,10 +124,52 @@ def make_state_shardings(
     ``nn.Partitioned`` boxes still attached (``nn.get_partition_spec``
     extracts the logical PartitionSpecs).  Leaves without metadata are
     replicated — matching the reference's MirroredVariable default.
+
+    Dims whose size doesn't divide the assigned mesh axes fall back to
+    replicated for that dim (e.g. 2 GQA KV heads on a tensor=4 mesh): the
+    preset stays usable on any device count, trading sharding for
+    replication instead of erroring.
     """
     logical_specs = nn.get_partition_spec(abstract_state)
-    return nn.logical_to_mesh_sharding(
+    shardings = nn.logical_to_mesh_sharding(
         logical_specs, mesh, _rules_for_mesh(mesh, rules)
+    )
+
+    def _fit(leaf, sh):
+        shape = getattr(nn.meta.unbox(leaf), "shape", None)
+        if shape is None or not isinstance(sh, NamedSharding):
+            return sh
+        dims = []
+        changed = False
+        spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+        for size, assigned in zip(shape, spec):
+            if assigned is None:
+                dims.append(None)
+                continue
+            axes = (assigned,) if isinstance(assigned, str) else tuple(assigned)
+            # Keep the longest prefix of mesh axes that still divides the
+            # dim (partial sharding beats full replication for memory).
+            kept: list[str] = []
+            prod = 1
+            for a in axes:
+                if size % (prod * mesh.shape[a]) == 0:
+                    kept.append(a)
+                    prod *= mesh.shape[a]
+            if len(kept) != len(axes):
+                changed = True
+                logger.warning(
+                    "sharding downgrade: dim of size %d cannot shard over "
+                    "mesh axes %s (sizes %s); keeping %s",
+                    size, axes, [mesh.shape[a] for a in axes], kept or "none",
+                )
+            dims.append(kept[0] if len(kept) == 1 else (tuple(kept) or None))
+        return NamedSharding(mesh, P(*dims)) if changed else sh
+
+    # Walk per-leaf: shardings tree leaves are NamedShardings positioned at
+    # (possibly boxed) state leaves.
+    return jax.tree.map(
+        _fit, abstract_state, shardings,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
     )
 
 
