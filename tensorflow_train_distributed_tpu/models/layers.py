@@ -16,7 +16,6 @@ Megatron prescribes.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -150,10 +149,14 @@ class MultiHeadAttention(nn.Module):
 
         if self.use_rope:
             if positions is None:
+                # Default q positions follow the causal-mask alignment: for
+                # causal cross-length attention q is the *suffix* of the kv
+                # sequence (bottom-right alignment), so its positions start
+                # at kv_len - q_len; callers with other layouts (KV cache at
+                # arbitrary offsets) pass explicit ``positions``.
+                offset = x_kv.shape[1] - x_q.shape[1] if self.causal else 0
                 positions = jnp.broadcast_to(
-                    jnp.arange(x_q.shape[1]), x_q.shape[:2])
-            # k gets positions derived from its own sequence; when q is a
-            # suffix (decode), its positions are offset to the tail.
+                    jnp.arange(x_q.shape[1]) + offset, x_q.shape[:2])
             kv_positions = jnp.broadcast_to(
                 jnp.arange(x_kv.shape[1]), x_kv.shape[:2])
             q = apply_rope(q, positions, base=self.rope_base)

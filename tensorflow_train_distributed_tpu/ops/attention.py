@@ -16,12 +16,10 @@ numerics oracle the kernel is tested against).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def dot_product_attention(

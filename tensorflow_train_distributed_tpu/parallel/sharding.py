@@ -33,9 +33,9 @@ from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # rule table: logical axis → mesh axis (or tuple of mesh axes, or None)
 LogicalRules = Sequence[tuple[str, Any]]
@@ -152,9 +152,10 @@ def make_state_shardings(
             kept: list[str] = []
             prod = 1
             for a in axes:
-                if size % (prod * mesh.shape[a]) == 0:
-                    kept.append(a)
-                    prod *= mesh.shape[a]
+                if size % (prod * mesh.shape[a]) != 0:
+                    break  # prefix semantics: stop at first non-divider
+                kept.append(a)
+                prod *= mesh.shape[a]
             if len(kept) != len(axes):
                 changed = True
                 logger.warning(
