@@ -30,6 +30,16 @@ from tensorflow_train_distributed_tpu.ops.attention import (
 Dtype = Any
 
 
+def _seq_parallel_mesh(seq_parallel: Optional[str]):
+    """The ambient (abstract) mesh when SP is requested and usable."""
+    if seq_parallel is None:
+        return None
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or mesh.shape.get("seq", 1) <= 1:
+        return None
+    return mesh
+
+
 def dense(features, logical_axes, *, use_bias=True, dtype=jnp.float32,
           name=None, kernel_init=None):
     return nn.DenseGeneral(
@@ -126,6 +136,10 @@ class MultiHeadAttention(nn.Module):
     use_rope: bool = False
     rope_base: float = 10000.0
     dropout_rate: float = 0.0
+    # Sequence/context parallelism: "ring" | "ulysses" | None.  Takes
+    # effect when the ambient mesh (jax.set_mesh, as the Trainer binds)
+    # has a seq axis > 1; self-attention only.
+    seq_parallel: Optional[str] = None
 
     @nn.compact
     def __call__(self, x_q, x_kv=None, *, mask=None, positions=None,
@@ -134,12 +148,19 @@ class MultiHeadAttention(nn.Module):
         kv_heads = self.num_kv_heads or self.num_heads
 
         def proj(x, heads, name):
-            y = nn.DenseGeneral(
-                (heads, self.head_dim), axis=-1, use_bias=False,
-                dtype=self.dtype, name=name,
+            # Plain 2-D kernel (embed, heads*head_dim) + reshape: maps onto
+            # the MXU as one big matmul, and sidesteps flax's DenseGeneral
+            # boxed-kernel reshape which mis-applies logical constraints
+            # under an active mesh.  "heads" on the fused dim still gives
+            # Megatron TP (heads*head_dim stays divisible by the tensor
+            # axis whenever heads is).
+            y = nn.Dense(
+                heads * self.head_dim, use_bias=False, dtype=self.dtype,
+                name=name,
                 kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), ("embed", "heads", "kv")),
+                    nn.initializers.lecun_normal(), ("embed", "heads")),
             )(x)
+            y = y.reshape(*x.shape[:-1], heads, self.head_dim)
             return nn.with_logical_constraint(
                 y, ("batch", "length", "heads", "kv"))
 
@@ -162,30 +183,45 @@ class MultiHeadAttention(nn.Module):
             q = apply_rope(q, positions, base=self.rope_base)
             k = apply_rope(k, kv_positions, base=self.rope_base)
 
-        if kv_heads != self.num_heads:
-            # GQA: repeat KV groups to full heads (XLA fuses the broadcast).
-            rep = self.num_heads // kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
         # [B, S, H, D] → [B, H, S, D] for the kernel.
-        out = multihead_attention_kernel(
-            q.transpose(0, 2, 1, 3),
-            k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3),
-            causal=self.causal,
-            mask=mask,
-        ).transpose(0, 2, 1, 3)
+        qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        sp_mesh = _seq_parallel_mesh(self.seq_parallel)
+        if sp_mesh is None and kv_heads != self.num_heads:
+            # GQA: repeat KV groups to full heads (XLA fuses the broadcast).
+            # The SP path rotates/reshards the *unrepeated* KV and repeats
+            # inside the shard_map body, saving ICI traffic.
+            rep = self.num_heads // kv_heads
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
+        if sp_mesh is not None:
+            if mask is not None:
+                raise ValueError(
+                    "seq_parallel attention supports causal/full, not dense "
+                    "masks")
+            if x_kv is not x_q:
+                raise ValueError("seq_parallel supports self-attention only")
+            from tensorflow_train_distributed_tpu.parallel.ring_attention \
+                import shard_mapped_attention
+
+            out = shard_mapped_attention(
+                sp_mesh, qh, kh, vh, method=self.seq_parallel,
+                causal=self.causal,
+            ).transpose(0, 2, 1, 3)
+        else:
+            out = multihead_attention_kernel(
+                qh, kh, vh, causal=self.causal, mask=mask,
+            ).transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
             out, ("batch", "length", "heads", "kv"))
         if self.dropout_rate > 0 and not deterministic:
             out = nn.Dropout(self.dropout_rate)(out,
                                                 deterministic=deterministic)
-        y = nn.DenseGeneral(
-            x_q.shape[-1], axis=(-2, -1), use_bias=False, dtype=self.dtype,
-            name="out",
+        out = out.reshape(*out.shape[:-2],
+                          self.num_heads * self.head_dim)
+        y = nn.Dense(
+            x_q.shape[-1], use_bias=False, dtype=self.dtype, name="out",
             kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
+                nn.initializers.lecun_normal(), ("heads", "embed")),
         )(out)
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))
 

@@ -44,6 +44,8 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = True
+    # "ring" | "ulysses" | None — context parallelism over the seq mesh axis.
+    seq_parallel: object = None
 
 
 LLAMA_PRESETS = {
@@ -76,7 +78,8 @@ class DecoderBlock(nn.Module):
             head_dim=cfg.d_model // cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
-            rope_base=cfg.rope_base, name="attention",
+            rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
+            name="attention",
         )(h)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)

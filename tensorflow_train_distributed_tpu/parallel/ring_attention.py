@@ -1,0 +1,179 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Absent from the reference (SURVEY.md §5.7: sequence length bounded by
+per-replica memory; no ring/Ulysses anywhere in the TF tree) — here it is a
+first-class capability: shard the sequence axis over the ``seq`` mesh axis
+and attend across the full context without any device materializing the
+whole KV (ring) or the whole sequence of scores (both).
+
+Both functions are *per-shard* bodies (run inside ``shard_map`` over the
+``seq`` axis); ``shard_mapped_attention`` wraps them for global arrays with
+batch sharded over (data, fsdp) and heads over tensor — SP composes with DP
+and TP.
+
+- **Ring attention**: each device keeps its local Q block; KV blocks make
+  n-1 hops around the ICI ring (``collectives.ring_permute``) while a
+  flash-style online softmax (m, l, o) accumulates in f32.  GQA rotates the
+  *unrepeated* KV (traffic ∝ kv_heads, not heads).  One KV block resident
+  per device → O(S/n) memory.
+- **Ulysses**: all-to-all (``collectives.all_to_all``) reshards seq↔heads
+  so each device runs full-sequence attention for H/n heads locally (the
+  pallas flash kernel applies on TPU), then reshards back.  Requires
+  heads % n == 0; KV is resharded unrepeated when kv_heads % n == 0.
+
+Expressed with ``lax.scan`` (reverse-differentiable, so the same code path
+trains) and bottom-right causal alignment matching ``ops.attention``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_train_distributed_tpu.parallel.collectives import (
+    all_to_all,
+    ring_permute,
+)
+
+_NEG = float(jnp.finfo(jnp.float32).min) / 2
+
+
+def _repeat_kv(x: jax.Array, heads: int) -> jax.Array:
+    """Broadcast GQA KV heads up to ``heads`` full heads ([B, Hkv, S, D])."""
+    if x.shape[1] == heads:
+        return x
+    return jnp.repeat(x, heads // x.shape[1], axis=1)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard ring attention.  q: [B, H, Sq, D]; k/v: [B, Hkv, Sk, D]
+    (Hkv may divide H — GQA), all sharded on ``axis``."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    q32 = q.astype(jnp.float32) * scale
+
+    def attend_block(carry_olm, k_blk, v_blk, kv_idx):
+        o, m, l = carry_olm
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       _repeat_kv(k_blk, h).astype(jnp.float32))
+        if causal:
+            q_pos = idx * sq + jnp.arange(sq)[:, None]
+            k_pos = kv_idx * sk + jnp.arange(sk)[None, :]
+            block_mask = (q_pos >= k_pos)[None, None]
+        else:
+            block_mask = jnp.ones((1, 1, sq, sk), bool)
+        s = jnp.where(block_mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        # Mask again on p: a fully-masked block must contribute exactly 0
+        # (exp(s - m_new) would be 1 on its own masked rows).
+        p = jnp.where(block_mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(-1, keepdims=True)
+        o_new = o * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p,
+            _repeat_kv(v_blk, h).astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    # Local block first (no rotation), then n-1 rotate-and-attend hops —
+    # the discarded n-th rotation would be pure wasted ICI traffic.
+    olm = attend_block((o0, m0, l0), k, v, idx)
+
+    def body(carry, step):
+        olm, k_blk, v_blk = carry
+        k_nxt = ring_permute(k_blk, axis, shift=1)
+        v_nxt = ring_permute(v_blk, axis, shift=1)
+        kv_idx = (idx - step - 1) % n
+        olm = attend_block(olm, k_nxt, v_nxt, kv_idx)
+        return (olm, k_nxt, v_nxt), None
+
+    if n > 1:
+        (olm, _, _), _ = jax.lax.scan(body, (olm, k, v), jnp.arange(n - 1))
+    o, _, l = olm
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard Ulysses attention.  q: [B, H, S_local, D]; k/v may carry
+    fewer (GQA) heads.  Requires H % axis_size == 0.  Local attention uses
+    the shared kernel dispatch, so the pallas flash path applies on TPU."""
+    from tensorflow_train_distributed_tpu.ops.attention import (
+        multihead_attention_kernel,
+    )
+
+    n = jax.lax.axis_size(axis)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"Ulysses needs heads ({h}) divisible by seq-axis size "
+            f"({n}); use ring attention instead")
+
+    def seq_to_heads(x):  # [B, H, S/n, D] → [B, H/n, S, D]
+        if x.shape[1] % n:
+            # GQA heads not divisible by n: repeat up front (costs traffic,
+            # but keeps the a2a well-formed).
+            x = _repeat_kv(x, h)
+        return all_to_all(x, axis, split_dim=1, concat_dim=2)
+
+    def heads_to_seq(x):  # [B, H/n, S, D] → [B, H, S/n, D]
+        return all_to_all(x, axis, split_dim=2, concat_dim=1)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = multihead_attention_kernel(
+        qg, _repeat_kv(kg, qg.shape[1]), _repeat_kv(vg, qg.shape[1]),
+        causal=causal, softmax_scale=softmax_scale,
+    )
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def shard_mapped_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    method: str = "ring",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    axis: str = "seq",
+) -> jax.Array:
+    """Global-array entry point: q/k/v [B, H, S, D] with S sharded on
+    ``axis``, batch on (data, fsdp), heads on tensor — SP × DP × TP."""
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[method]
+    batch_dims = tuple(a for a in ("data", "fsdp")
+                       if mesh.shape.get(a, 1) > 1) or None
+    head_dim = "tensor" if mesh.shape.get("tensor", 1) > 1 else None
+    spec = P(batch_dims, head_dim, axis, None)
+    return shard_map(
+        partial(fn, axis=axis, causal=causal, softmax_scale=softmax_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

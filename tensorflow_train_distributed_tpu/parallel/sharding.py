@@ -136,7 +136,11 @@ def make_state_shardings(
     )
 
     def _fit(leaf, sh):
-        shape = getattr(nn.meta.unbox(leaf), "shape", None)
+        # Read the boxed value directly: .unbox() on LogicallyPartitioned
+        # applies a sharding constraint (a trace-time op, wrong on abstract
+        # leaves under an active mesh); we only need the shape.
+        val = leaf.value if isinstance(leaf, nn.meta.AxisMetadata) else leaf
+        shape = getattr(val, "shape", None)
         if shape is None or not isinstance(sh, NamedSharding):
             return sh
         dims = []

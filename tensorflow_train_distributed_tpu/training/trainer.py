@@ -133,7 +133,8 @@ class Trainer:
                 loss_scale=mp.LossScaleState.create(self.policy),
             )
 
-        with sharding_lib.with_logical_rules(self.mesh, self.rules):
+        with sharding_lib.with_logical_rules(self.mesh, self.rules), \
+                jax.set_mesh(self.mesh):
             abstract = jax.eval_shape(_create)
             self.state_shardings = sharding_lib.make_state_shardings(
                 self.mesh, abstract, self.rules
@@ -216,7 +217,16 @@ class Trainer:
                 return new_state, jax.tree.map(lambda m: m[-1], ms)
 
         donate = (0,) if self.config.donate_state else ()
-        self._train_step = jax.jit(step, donate_argnums=donate)
+        jitted = jax.jit(step, donate_argnums=donate)
+
+        def call(state, batch):
+            # set_mesh must wrap the call (it is illegal inside jit): it
+            # binds the abstract mesh at trace time so mesh-aware ops
+            # (seq-parallel attention) see it regardless of call site.
+            with jax.set_mesh(self.mesh):
+                return jitted(state, batch)
+
+        self._train_step = call
         return self._train_step
 
     def _compiled_eval_step(self):
@@ -232,7 +242,13 @@ class Trainer:
                 loss, (metrics, _) = loss_fn(state.params)
                 return dict(metrics, loss=loss)
 
-        self._eval_step = jax.jit(step)
+        jitted = jax.jit(step)
+
+        def call(state, batch):
+            with jax.set_mesh(self.mesh):
+                return jitted(state, batch)
+
+        self._eval_step = call
         return self._eval_step
 
     # -- loops ---------------------------------------------------------------
@@ -314,7 +330,8 @@ class Trainer:
                         stop |= self.callbacks.step_end(s, host_m)
                         last_metrics = host_m
                     pending.clear()
-                while steps_per_epoch and done >= (epoch + 1) * steps_per_epoch:
+                while (steps_per_epoch
+                       and done >= (epoch + 1) * steps_per_epoch):
                     epoch += 1
                     stop |= self.callbacks.epoch_end(epoch, last_metrics)
                 if (self.checkpoint_manager is not None
@@ -347,13 +364,14 @@ class Trainer:
         n = 0
         device_iter = prefetch_to_device(iter(batches), self.mesh)
         try:
-            for dev_batch in device_iter:
-                metrics = step_fn(state, dev_batch)
-                acc.update({k: float(np.asarray(v))
-                            for k, v in metrics.items()})
-                n += 1
-                if steps is not None and n >= steps:
-                    break
+            with jax.set_mesh(self.mesh):
+                for dev_batch in device_iter:
+                    metrics = step_fn(state, dev_batch)
+                    acc.update({k: float(np.asarray(v))
+                                for k, v in metrics.items()})
+                    n += 1
+                    if steps is not None and n >= steps:
+                        break
         finally:
             device_iter.close()
         return acc.result()
