@@ -1,0 +1,6 @@
+"""``python -m tensorflow_train_distributed_tpu`` → the launcher."""
+
+from tensorflow_train_distributed_tpu.launch import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,346 @@
+"""The ``train_distributed`` launcher: flags → cluster → mesh → fit.
+
+TPU-native rebuild of the reference's L6 entry point (SURVEY.md §2.1: a
+``train_distributed`` CLI that parses ``--strategy`` / model selection,
+builds ``TF_CONFIG``-aware cluster setup, and dispatches to a per-model
+train fn).  The strategy zoo collapses into mesh presets
+(``runtime.mesh.STRATEGY_PRESETS``), so the reference's launch contract
+keeps working: ``--strategy=mirrored|multi_worker_mirrored|horovod|tpu``
+all mean "data-parallel SPMD", ``--strategy=dtensor`` means the 2-D
+data×tensor mesh, and ``TF_CONFIG`` in the environment still places this
+process in the cluster (``runtime.distributed``).
+
+Usage::
+
+    train_distributed --config=resnet50_imagenet --steps=1000
+    train_distributed --config=llama2_7b_sft --strategy=dp_tp \
+        --mesh data=4,tensor=8 --precision=bfloat16 \
+        --checkpoint-dir=/ckpt --checkpoint-every=500
+    python -m tensorflow_train_distributed_tpu --config=mnist --steps=200
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from tensorflow_train_distributed_tpu.models import registry
+    from tensorflow_train_distributed_tpu.runtime.mesh import STRATEGY_PRESETS
+
+    p = argparse.ArgumentParser(
+        prog="train_distributed",
+        description="TPU-native distributed training launcher",
+    )
+    p.add_argument("--config", required=True,
+                   help=f"model config; one of {registry.available()}")
+    p.add_argument("--strategy", default=None,
+                   choices=sorted(STRATEGY_PRESETS) + ["ps", "parameter_server"],
+                   help="mesh preset (default: the config's preset); "
+                        "reference names (mirrored/multi_worker_mirrored/"
+                        "horovod/tpu/dtensor) are accepted")
+    p.add_argument("--mesh", default=None, metavar="AXIS=N,...",
+                   help="explicit mesh axis sizes overriding the preset, "
+                        "e.g. data=4,tensor=2 (one axis may be -1)")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--global-batch-size", type=int, default=None,
+                   help="global batch size (default: the config's)")
+    p.add_argument("--learning-rate", type=float, default=None)
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["sgd", "momentum", "adam", "adamw"])
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="decoupled weight decay (adamw only)")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps (0 = constant LR)")
+    p.add_argument("--precision", "--mixed-precision", dest="precision",
+                   default="bfloat16",
+                   help="dtype policy: float32 | bfloat16 | float16 "
+                        "(Keras policy names mixed_bfloat16/mixed_float16 "
+                        "also accepted)")
+    p.add_argument("--steps-per-execution", type=int, default=1,
+                   help="optimizer steps fused into one dispatch via an "
+                        "inner scan (reference Model.fit arg of the same "
+                        "name)")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval-steps", type=int, default=0,
+                   help="run evaluation for N batches after training")
+    # Checkpointing (reference: ModelCheckpoint + BackupAndRestore).
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--max-to-keep", type=int, default=3)
+    p.add_argument("--no-resume", action="store_true",
+                   help="start fresh even if --checkpoint-dir has a "
+                        "checkpoint")
+    p.add_argument("--no-preemption-handler", action="store_true",
+                   help="disable the SIGTERM-coordinated save-and-exit "
+                        "(on by default when --checkpoint-dir is set)")
+    # Observability.
+    p.add_argument("--tensorboard-dir", default=None)
+    p.add_argument("--jsonl-log", default=None,
+                   help="append per-step metrics as JSON lines to this file")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace into this directory "
+                        "(reference: TensorBoard callback profile_batch)")
+    p.add_argument("--profile-steps", default="10,20", metavar="START,STOP",
+                   help="step window for --profile-dir")
+    # Cluster placement (reference: TF_CONFIG / cluster resolvers; these
+    # flags take precedence, then TTD_*/TF_CONFIG/SLURM env, see
+    # runtime.distributed.resolve_cluster).
+    p.add_argument("--coordinator-address", default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a jax backend (cpu useful with "
+                        "--cpu-devices for local testing)")
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   help="with --platform=cpu: number of virtual devices")
+    p.add_argument("--list-configs", action="store_true",
+                   help="print available configs and exit")
+    return p
+
+
+def _parse_mesh_overrides(spec: str) -> dict[str, int]:
+    from tensorflow_train_distributed_tpu.runtime.mesh import AXES
+
+    sizes: dict[str, int] = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        axis, _, val = part.partition("=")
+        axis = axis.strip()
+        if axis not in AXES:
+            raise ValueError(f"Unknown mesh axis {axis!r}; axes: {AXES}")
+        sizes[axis] = int(val)
+    return sizes
+
+
+def _make_optimizer(args, entry) -> "optax.GradientTransformation":
+    import optax
+
+    lr = args.learning_rate
+    if lr is None:
+        lr = entry["learning_rate"]
+    if args.warmup_steps > 0:
+        lr = optax.linear_schedule(0.0, lr, args.warmup_steps)
+    if args.optimizer == "sgd":
+        return optax.sgd(lr)
+    if args.optimizer == "momentum":
+        return optax.sgd(lr, momentum=0.9, nesterov=True)
+    if args.optimizer == "adam":
+        return optax.adam(lr)
+    return optax.adamw(lr, weight_decay=args.weight_decay)
+
+
+@dataclasses.dataclass
+class RunResult:
+    """What a launch produced (returned by ``run`` for tests/embedding)."""
+
+    state: object
+    history: list
+    eval_metrics: Optional[dict]
+    mesh: object
+    preempted: bool = False
+
+
+def _parse_profile_steps(spec: str) -> tuple[int, int]:
+    try:
+        start, stop = (int(p) for p in spec.split(","))
+        return start, stop
+    except ValueError:
+        raise SystemExit(
+            f"--profile-steps expects START,STOP (two integers), got "
+            f"{spec!r}") from None
+
+
+def run(args: argparse.Namespace) -> RunResult:
+    """Build the full stack from parsed flags and train."""
+    import jax
+
+    # Backend override must land before any device API touches the backend
+    # (env vars are too late under launchers that pre-import jax).
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.cpu_devices:
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+    from tensorflow_train_distributed_tpu.data.pipeline import (
+        DataConfig, HostDataLoader,
+    )
+    from tensorflow_train_distributed_tpu.models import registry
+    from tensorflow_train_distributed_tpu.runtime.distributed import (
+        initialize_distributed, resolve_cluster,
+    )
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh, strategy_preset,
+    )
+    from tensorflow_train_distributed_tpu.training import (
+        History, JsonlLogger, Policy, ProgressLogger, TensorBoardScalars,
+        Trainer, TrainerConfig,
+    )
+    from tensorflow_train_distributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    # 1. Cluster: flags → env (TTD_* / TF_CONFIG / SLURM) → single-process.
+    cluster = resolve_cluster(
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    initialize_distributed(cluster)
+
+    # 2. Mesh from strategy preset (+ explicit axis overrides).
+    entry = registry.get_entry(args.config)
+    strategy = args.strategy or entry["strategy"]
+    n_dev = len(jax.devices())
+    cfg = strategy_preset(strategy, n_dev)
+    if args.mesh:
+        overrides = _parse_mesh_overrides(args.mesh)
+        sizes = cfg.axis_sizes()
+        sizes.update(overrides)
+        if -1 not in sizes.values() and "data" not in overrides:
+            sizes["data"] = -1  # let data absorb the remaining devices
+        cfg = MeshConfig(strategy=strategy, **sizes)
+    mesh = build_mesh(cfg)
+    logger.info("mesh: %s (strategy=%s, %d devices)",
+                dict(mesh.shape), strategy, n_dev)
+
+    # 3. Data: sharded host loader over this config's dataset.
+    global_batch = args.global_batch_size or entry["global_batch_size"]
+    source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
+    loader = HostDataLoader(
+        source,
+        DataConfig(global_batch_size=global_batch, seed=args.seed),
+        process_index=cluster.process_id if cluster.is_multiprocess else None,
+        process_count=cluster.num_processes if cluster.is_multiprocess else None,
+    )
+
+    # 4. Trainer: task + optimizer + policy + callbacks.
+    task = entry["task_factory"]()
+    policy = Policy.from_name(args.precision)
+    callbacks = [History(), ProgressLogger(examples_per_step=global_batch)]
+    if args.tensorboard_dir:
+        callbacks.append(TensorBoardScalars(args.tensorboard_dir))
+    if args.jsonl_log:
+        callbacks.append(JsonlLogger(args.jsonl_log))
+    if args.profile_dir:
+        from tensorflow_train_distributed_tpu.runtime.profiling import (
+            ProfileCallback,
+        )
+
+        start, stop = _parse_profile_steps(args.profile_steps)
+        callbacks.append(ProfileCallback(
+            args.profile_dir, start_step=start, stop_step=stop))
+    ckpt = None
+    watcher = None
+    if args.checkpoint_dir:
+        ckpt = CheckpointManager(
+            args.checkpoint_dir, max_to_keep=args.max_to_keep)
+        if not args.no_preemption_handler:
+            from tensorflow_train_distributed_tpu.runtime.preemption import (
+                PreemptionCheckpointCallback, PreemptionWatcher,
+            )
+
+            try:
+                watcher = PreemptionWatcher().install()
+            except RuntimeError:  # not on the main thread (embedded use)
+                watcher = None
+            if watcher is not None:
+                callbacks.append(PreemptionCheckpointCallback(watcher))
+    trainer = Trainer(
+        task,
+        _make_optimizer(args, entry),
+        mesh,
+        policy=policy,
+        config=TrainerConfig(
+            seed=args.seed,
+            steps_per_execution=args.steps_per_execution,
+            log_every=args.log_every,
+            checkpoint_every=args.checkpoint_every,
+        ),
+        callbacks=callbacks,
+        checkpoint_manager=ckpt,
+    )
+
+    try:
+        # 5. Resume (reference BackupAndRestore): restore latest if present.
+        state = None
+        if (ckpt is not None and not args.no_resume
+                and ckpt.latest_step() is not None):
+            sample = next(iter(loader))
+            state = trainer.create_state(sample)
+            state = ckpt.restore(state)
+            logger.info("resumed from step %d", int(state.step))
+
+        remaining = args.steps - (0 if state is None else int(state.step))
+        k = args.steps_per_execution
+        if remaining > 0 and remaining % k:
+            # Off-cycle resume (checkpoint step not a multiple of k) or
+            # steps not divisible by k: round up rather than crashloop.
+            rounded = -(-remaining // k) * k
+            logger.warning(
+                "remaining steps %d not a multiple of "
+                "steps_per_execution=%d; training %d steps",
+                remaining, k, rounded)
+            remaining = rounded
+        if remaining > 0:
+            state = trainer.fit(
+                loader, steps=remaining, state=state,
+                steps_per_epoch=loader.steps_per_epoch(),
+            )
+        else:
+            logger.info("checkpoint already at/past --steps; nothing to train")
+
+        preempted = watcher is not None and watcher.preempted
+        eval_metrics = None
+        if args.eval_steps > 0 and not preempted:
+            # Skip eval when preempted: the grace window is for the save,
+            # and the restarted job re-runs eval at its own end.
+            eval_metrics = trainer.evaluate(
+                loader, state, steps=args.eval_steps)
+            logger.info("eval: %s", eval_metrics)
+    finally:
+        if watcher is not None:
+            watcher.uninstall()
+        if ckpt is not None:
+            ckpt.close()
+    history = next(
+        (c.history for c in callbacks if isinstance(c, History)), [])
+    return RunResult(state=state, history=history,
+                     eval_metrics=eval_metrics, mesh=mesh,
+                     preempted=preempted)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_configs:
+        from tensorflow_train_distributed_tpu.models import registry
+
+        for name in registry.available():
+            e = registry.get_entry(name)
+            print(f"{name}: dataset={e['dataset']} strategy={e['strategy']} "
+                  f"batch={e['global_batch_size']} lr={e['learning_rate']}")
+        return 0
+    result = run(args)
+    if result.preempted:
+        # Non-zero so supervisors reschedule the job; 143 = SIGTERM'd by
+        # convention, which is what happened semantically.
+        logger.warning("exiting after preemption-coordinated checkpoint")
+        return 143
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,177 @@
+"""Preemption-coordinated checkpointing and failure detection.
+
+TPU-native rebuild of the reference's fault-tolerance stack (SURVEY.md
+§5.3): ``PreemptionCheckpointHandler``
+(``failure_handling/failure_handling.py:337`` — catch SIGTERM/maintenance
+events, save a checkpoint, coordinate a synchronized exit at the same step
+on every worker), the ``preemption_watcher.py:45`` watcher, and the MWMS
+peer health check (``collective_all_reduce_strategy.py:990``).
+
+Mechanics here:
+
+- ``PreemptionWatcher`` — installs a SIGTERM handler (the signal cloud
+  schedulers deliver before reclaiming capacity) that flips a flag; no work
+  happens in signal context.
+- ``sync_preemption_flag`` — the *coordination* step the reference does via
+  its gRPC coordination service: all processes agree whether anyone was
+  preempted, so every host saves at the same step and exits together
+  (divergent save steps would corrupt keep-N GC and deadlock collectives).
+  Cross-host agreement rides an all-gather through the live mesh; on one
+  process it's the local flag.
+- ``PreemptionCheckpointCallback`` — trainer callback: on the first synced
+  step after preemption, force-save, block until durable, stop training.
+  Resume then picks up from this exact step (``launch.run`` restores
+  latest), reproducing the reference's BackupAndRestore-on-SIGTERM flow.
+
+Liveness (the health-check analog): the XLA coordination service that
+``jax.distributed.initialize`` connects to already heartbeats every
+process and fails collectives on dead peers — the reference's
+``_check_health`` thread re-implemented that for NCCL; here it's inherited.
+``missed_heartbeat_timeout`` is surfaced in ``runtime.distributed``.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class PreemptionWatcher:
+    """Flags termination signals without doing work in signal context.
+
+    ``install()`` chains any pre-existing handler (so test harnesses and
+    outer supervisors keep working).  ``preempted`` may also be set
+    programmatically (maintenance-event pollers, tests).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def mark_preempted(self) -> None:
+        self._event.set()
+
+    def install(self) -> "PreemptionWatcher":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "PreemptionWatcher.install() must run on the main thread "
+                "(signal.signal requirement)")
+        for sig in self.signals:
+            self._prev[sig] = signal.getsignal(sig)
+            signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+        logger.warning("received signal %d: preemption flagged", signum)
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+
+def sync_preemption_flag(local_flag: bool) -> bool:
+    """True iff ANY process was preempted (all-host agreement).
+
+    The reference reaches this agreement through its coordination service
+    (``coordination_service.h``); here the flag is OR-reduced across
+    processes so every host takes the checkpoint branch at the same step.
+    Single-process: the local flag.
+    """
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([bool(local_flag)]))
+    return bool(np.any(flags))
+
+
+class PreemptionCheckpointCallback:
+    """Trainer callback: save-and-stop when any host is preempted.
+
+    Contract (mirrors ``PreemptionCheckpointHandler.run`` semantics): the
+    save happens at a step boundary every process reaches, is forced past
+    keep-N/interval policies, and is fully durable
+    (``wait_until_finished``) before training stops — the checkpoint a
+    restarted job resumes from.
+    """
+
+    def __init__(self, watcher: PreemptionWatcher,
+                 checkpoint_manager=None,
+                 *, exit_code: Optional[int] = None, sync_every: int = 10):
+        self.watcher = watcher
+        self._explicit_manager = checkpoint_manager
+        self.exit_code = exit_code
+        # Cross-host agreement is a blocking collective; running it every
+        # step would tax fast training loops. It runs only on steps where
+        # step % sync_every == 0 — a schedule derived from the step counter
+        # alone, so every process enters the collective at the same steps
+        # (a locally-gated entry would deadlock the all-gather).
+        self.sync_every = max(1, sync_every)
+        self.saved_step: Optional[int] = None
+        self.trainer = None
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    @property
+    def checkpoint_manager(self):
+        if self._explicit_manager is not None:
+            return self._explicit_manager
+        return getattr(self.trainer, "checkpoint_manager", None)
+
+    def on_train_begin(self, state):
+        pass
+
+    def on_step_end(self, step: int, metrics) -> Optional[bool]:
+        import jax as _jax
+
+        multi = _jax.process_count() > 1
+        if multi and step % self.sync_every:
+            return None  # off-cadence: no collective, no decision
+        flag = (sync_preemption_flag(self.watcher.preempted)
+                if multi else self.watcher.preempted)
+        if not flag:
+            return None
+        mgr = self.checkpoint_manager
+        state = getattr(self.trainer, "_live_state", None)
+        if mgr is not None and state is not None:
+            mgr.save(int(state.step), state, force=True)
+            mgr.wait_until_finished()
+            self.saved_step = int(state.step)
+            logger.warning(
+                "preemption: checkpoint saved at step %d; stopping",
+                self.saved_step)
+        else:
+            logger.warning("preemption: no checkpoint manager; stopping")
+        if self.exit_code is not None:
+            raise SystemExit(self.exit_code)
+        return True  # request early stop
+
+    def on_epoch_end(self, epoch, metrics):
+        return None
+
+    def on_train_end(self, state):
+        pass

@@ -82,25 +82,56 @@ class History(Callback):
             self.history.setdefault(k, []).append(float(v))
 
 
+class StepRateTracker:
+    """Wall-time per optimizer step, burst-aware.
+
+    ``Trainer.fit`` drains metrics in ``log_every`` windows, so callbacks
+    see bursts of ``on_step_end`` calls microseconds apart — the naive
+    consecutive-call delta is garbage (µs inside a burst, the whole window
+    attributed to one step at its edge).  A burst shares one drain
+    timestamp, which is when the window's last step finished; the honest
+    rate is therefore (drain_t − prev_drain_t) / (drain_step −
+    prev_drain_step), computed when a new burst begins.
+    """
+
+    BURST_GAP_S = 5e-4
+
+    def __init__(self):
+        self._prev = None   # (t, step) at the end of the last closed burst
+        self._cur = None    # (t, step) latest call in the current burst
+        self.last_ms_per_step: Optional[float] = None
+
+    def update(self, step: int) -> Optional[float]:
+        """Record a step report; returns a fresh ms/step when a window closes."""
+        now = time.perf_counter()
+        emitted = None
+        if self._cur is not None and now - self._cur[0] > self.BURST_GAP_S:
+            t1, s1 = self._cur
+            if self._prev is not None and s1 > self._prev[1]:
+                emitted = (t1 - self._prev[0]) / (s1 - self._prev[1]) * 1e3
+                self.last_ms_per_step = emitted
+            self._prev = (t1, s1)
+        self._cur = (now, step)
+        return emitted
+
+
 class ProgressLogger(Callback):
     """Stdout progress lines with step time + throughput (chief only)."""
 
     def __init__(self, examples_per_step: Optional[int] = None):
         self.examples_per_step = examples_per_step
-        self._last_time: Optional[float] = None
-        self._last_step: Optional[int] = None
+        self._tracker = StepRateTracker()
 
     def on_step_end(self, step, metrics):
         if jax.process_index() != 0:
             return
-        now = time.perf_counter()
+        self._tracker.update(step)
         line = f"step {step}"
-        if self._last_time is not None and step > self._last_step:
-            dt = (now - self._last_time) / (step - self._last_step)
-            line += f" | {dt * 1e3:.1f} ms/step"
+        ms = self._tracker.last_ms_per_step
+        if ms is not None:
+            line += f" | {ms:.1f} ms/step"
             if self.examples_per_step:
-                line += f" | {self.examples_per_step / dt:,.0f} ex/s"
-        self._last_time, self._last_step = now, step
+                line += f" | {self.examples_per_step / (ms / 1e3):,.0f} ex/s"
         for k, v in metrics.items():
             line += f" | {k}={float(v):.4f}"
         print(line, flush=True)

@@ -99,6 +99,7 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self.state_shardings = None
+        self._live_state = None
 
     # -- state ---------------------------------------------------------------
 
@@ -317,6 +318,10 @@ class Trainer:
         try:
             for dev_batch in device_iter:
                 state, metrics = step_fn(state, dev_batch)
+                # Callbacks that checkpoint (preemption handler) read the
+                # current state from here — fit's loop variable is otherwise
+                # invisible to them.
+                self._live_state = state
                 done += k
                 cur = start_step + done
                 pending.append((cur, metrics))

@@ -1,0 +1,161 @@
+"""Launcher tests: the reference's `train_distributed` CLI contract.
+
+Covers flag parsing, strategy/mesh resolution, end-to-end tiny runs, and
+checkpoint resume through the CLI path — all on the 8-device CPU mesh.
+"""
+
+import json
+import os
+
+import pytest
+
+from tensorflow_train_distributed_tpu import launch
+
+
+def _args(*argv):
+    return launch.build_parser().parse_args(argv)
+
+
+def test_list_configs(capsys):
+    assert launch.main(["--list-configs", "--config", "mnist"]) == 0
+    out = capsys.readouterr().out
+    assert "resnet50_imagenet" in out and "llama2_7b_sft" in out
+
+
+def test_reference_strategy_names_accepted():
+    for name in ["mirrored", "multi_worker_mirrored", "horovod", "tpu",
+                 "dtensor"]:
+        _args("--config", "mnist", "--strategy", name)
+
+
+def test_ps_strategy_rejected():
+    args = _args("--config", "bert_tiny_mlm", "--strategy", "ps")
+    with pytest.raises(ValueError, match="SPMD-only"):
+        launch.run(args)
+
+
+def test_mesh_override_parsing():
+    sizes = launch._parse_mesh_overrides("data=2,tensor=4")
+    assert sizes == {"data": 2, "tensor": 4}
+    with pytest.raises(ValueError, match="Unknown mesh axis"):
+        launch._parse_mesh_overrides("bogus=2")
+
+
+def test_end_to_end_mnist_loss_decreases():
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "30",
+        "--global-batch-size", "64", "--precision", "float32",
+        "--optimizer", "adam", "--learning-rate", "3e-3",
+        "--log-every", "5",
+    ))
+    losses = result.history["loss"]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_explicit_mesh_and_strategy_override():
+    result = launch.run(_args(
+        "--config", "llama_tiny_sft", "--steps", "2",
+        "--global-batch-size", "8", "--strategy", "dp_tp",
+        "--mesh", "data=2,tensor=4", "--precision", "float32",
+        "--log-every", "1",
+    ))
+    assert dict(result.mesh.shape)["tensor"] == 4
+    assert dict(result.mesh.shape)["data"] == 2
+
+
+def test_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--config", "mnist", "--global-batch-size", "32",
+              "--precision", "float32", "--checkpoint-dir", ckpt,
+              "--checkpoint-every", "5", "--log-every", "5"]
+    launch.run(_args(*common, "--steps", "10"))
+    assert os.path.isdir(ckpt)
+    # Second launch resumes from step 10 and trains only the remainder.
+    result = launch.run(_args(*common, "--steps", "15"))
+    assert int(result.state.step) == 15
+    # Third launch: target already reached — trains nothing.
+    result = launch.run(_args(*common, "--steps", "15"))
+    assert int(result.state.step) == 15
+
+
+def test_eval_and_jsonl(tmp_path):
+    log = tmp_path / "metrics.jsonl"
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "4", "--global-batch-size", "32",
+        "--precision", "float32", "--eval-steps", "2",
+        "--jsonl-log", str(log), "--log-every", "2",
+    ))
+    assert result.eval_metrics is not None and "loss" in result.eval_metrics
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert any("loss" in l for l in lines)
+
+
+def test_profile_steps_parse_error():
+    with pytest.raises(SystemExit, match="START,STOP"):
+        launch._parse_profile_steps("10")
+
+
+def test_remaining_steps_rounded_to_execution_multiple():
+    # steps=10 with k=4 → rounds up to 12 instead of crashing in fit.
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "10", "--global-batch-size", "32",
+        "--precision", "float32", "--steps-per-execution", "4",
+        "--log-every", "4",
+    ))
+    assert int(result.state.step) == 12
+
+
+def test_preempted_run_skips_eval_and_reports(tmp_path):
+    import os
+    import signal
+
+    from tensorflow_train_distributed_tpu.training.callbacks import Callback
+
+    class _SignalAt(Callback):
+        def on_step_end(self, step, metrics):
+            if step == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    # Inject the signal through a callback added behind the parsed args by
+    # monkey-patching the History list post-construction is messy; instead
+    # run the launcher path directly with a pre-marked watcher.
+    from tensorflow_train_distributed_tpu.runtime import preemption as pre
+
+    orig_install = pre.PreemptionWatcher.install
+
+    def install_and_arm(self):
+        orig_install(self)
+        signal_cb[0] = self
+        return self
+
+    signal_cb = [None]
+    pre.PreemptionWatcher.install = install_and_arm
+    try:
+        import threading
+
+        def _later_mark():
+            signal_cb[0].mark_preempted()
+
+        t = threading.Timer(0.5, _later_mark)
+        t.start()
+        result = launch.run(_args(
+            "--config", "mnist", "--steps", "500",
+            "--global-batch-size", "32", "--precision", "float32",
+            "--checkpoint-dir", str(tmp_path / "ck"), "--eval-steps", "2",
+            "--log-every", "1",
+        ))
+        t.cancel()
+    finally:
+        pre.PreemptionWatcher.install = orig_install
+    assert result.preempted
+    assert result.eval_metrics is None  # eval skipped under preemption
+    assert int(result.state.step) < 500  # stopped early
+
+
+def test_steps_per_execution_through_cli():
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "8", "--global-batch-size", "32",
+        "--precision", "float32", "--steps-per-execution", "4",
+        "--log-every", "4",
+    ))
+    assert int(result.state.step) == 8

@@ -1,0 +1,82 @@
+"""Profiling/observability tests: trace capture, step windows, memory stats."""
+
+import glob
+import os
+
+import pytest
+
+from tensorflow_train_distributed_tpu.runtime import profiling
+
+
+def test_trace_writes_xplane(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "trace")
+    with profiling.trace(logdir):
+        with profiling.annotate("unit-test-span"):
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    # XPlane capture lands under plugins/profile/<run>/ as .xplane.pb.
+    found = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert found, f"no xplane produced under {logdir}"
+
+
+def test_profile_callback_window(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(profiling, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(profiling, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    cb = profiling.ProfileCallback(str(tmp_path), start_step=3, stop_step=5)
+    for step in range(1, 8):
+        cb.on_step_end(step, {})
+    cb.on_train_end(None)
+    assert [c[0] for c in calls] == ["start", "stop"]
+
+
+def test_profile_callback_stops_at_train_end(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(profiling, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(profiling, "stop_trace",
+                        lambda: calls.append("stop"))
+    cb = profiling.ProfileCallback(str(tmp_path), start_step=1, stop_step=99)
+    cb.on_step_end(1, {})
+    cb.on_train_end(None)
+    assert calls == ["start", "stop"]
+
+
+def test_profile_callback_validates_window(tmp_path):
+    with pytest.raises(ValueError):
+        profiling.ProfileCallback(str(tmp_path), start_step=5, stop_step=3)
+
+
+def test_device_memory_stats_enumerates_devices():
+    import jax
+
+    stats = profiling.device_memory_stats()
+    assert len(stats) == len(jax.local_devices())
+    assert all("device" in s for s in stats)
+
+
+def test_speed_monitor_summary():
+    import time
+
+    mon = profiling.SpeedMonitor(examples_per_step=64)
+    # Simulate fit's drain pattern: bursts of step reports per log window.
+    for window in range(4):
+        for step in (2 * window + 1, 2 * window + 2):
+            mon.on_step_end(step, {})
+        time.sleep(0.01)
+    s = mon.summary()
+    # 2 steps per ~10ms window → ~5 ms/step, never the µs intra-burst gap.
+    assert 2.0 < s["median_step_ms"] < 50.0, s
+    assert "examples_per_sec" in s
+
+
+def test_speed_monitor_ignores_intra_burst_deltas():
+    mon = profiling.SpeedMonitor()
+    for step in range(1, 11):  # one burst, no wall time between steps
+        mon.on_step_end(step, {})
+    assert mon.summary() == {}  # no closed window yet → no bogus samples
