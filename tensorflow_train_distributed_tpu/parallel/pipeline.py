@@ -1,0 +1,163 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a mesh axis.
+
+NEW capability relative to the reference — it ships no pipeline layer
+(SURVEY.md §2.4: PP absent; nothing GPipe-like is reachable from the
+harness).  Included because a complete TPU framework needs all of
+dp/fsdp/tp/sp/pp to cover the model scales the flagship configs target.
+
+TPU-native design: the pipeline is ONE SPMD program under ``shard_map``.
+Every device holds one stage's parameters (stacked pytree sharded over the
+``pipeline`` mesh axis) and runs the same ``lax.scan`` over
+``M + S - 1`` ticks (M microbatches, S stages).  Per tick each device
+
+1. selects its input — microbatch ``t`` for stage 0, the activation
+   received from its predecessor otherwise;
+2. applies the stage function;
+3. passes its output to the successor with ``lax.ppermute`` (one ICI hop —
+   stages are laid out innermost on the torus by ``runtime.mesh``).
+
+The backward pass needs no scheduler: differentiating the scan replays the
+schedule in reverse, with ``ppermute``'s transpose carrying activation
+cotangents stage-to-stage — the 1F1B-style interleaving the reference
+would have had to hand-build in C++ falls out of autodiff.
+
+Constraint: every stage maps activations of one shape/dtype to the same
+shape/dtype (the standard homogeneous-transformer-block contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def num_pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
+    """Schedule length: M microbatches + (S-1) bubble ticks."""
+    return num_microbatches + num_stages - 1
+
+
+def pipeline_stages(
+    stage_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    microbatches: Any,
+    *,
+    axis: str = "pipeline",
+) -> Any:
+    """Run the microbatch pipeline *inside* an enclosing ``shard_map``.
+
+    Args:
+      stage_fn: ``(params_one_stage, activation) -> activation`` — one
+        stage's compute; activation shape/dtype preserved.
+      stage_params: this device's slice of the stacked stage parameters,
+        leading dim 1 (sharded over ``axis``).
+      microbatches: ``[M, mb, ...]`` pytree of microbatches (replicated or
+        data-sharded along ``mb`` — invisible here either way).
+      axis: pipeline mesh axis name bound by the enclosing shard_map.
+
+    Returns ``[M, mb, ...]`` outputs, valid on every device (the last
+    stage's results are broadcast via a masked psum so downstream loss
+    code need not care where they landed).
+    """
+    params = jax.tree.map(lambda x: x[0], stage_params)
+    stage = jax.lax.axis_index(axis)
+    num_stages = jax.lax.axis_size(axis)
+    leaves = jax.tree.leaves(microbatches)
+    num_micro = leaves[0].shape[0]
+    ticks = num_pipeline_ticks(num_micro, num_stages)
+
+    def tick(act, t):
+        # Stage 0 consumes microbatch t (clamped in the bubble tail where
+        # its compute is dead anyway); later stages consume what the
+        # predecessor sent last tick.
+        feed = jax.tree.map(
+            lambda m: jax.lax.dynamic_index_in_dim(
+                m, jnp.minimum(t, num_micro - 1), 0, keepdims=False),
+            microbatches,
+        )
+        inp = jax.tree.map(
+            lambda a, f: jnp.where(stage == 0, f, a), act, feed)
+        out = stage_fn(params, inp)
+        # Shift every stage's output one hop down the ring; stage 0
+        # receives the last stage's (already-harvested) output and
+        # overwrites it with the next microbatch.
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        passed = jax.tree.map(
+            lambda o: jax.lax.ppermute(o, axis, perm), out)
+        return passed, out
+
+    act0 = jax.tree.map(
+        lambda m: jnp.zeros(m.shape[1:], m.dtype), microbatches)
+    _, outs = jax.lax.scan(tick, act0, jnp.arange(ticks))
+    # Ticks S-1 .. T-1 on the LAST stage are microbatch outputs 0..M-1.
+    outs = jax.tree.map(lambda o: o[num_stages - 1:], outs)
+    return jax.tree.map(
+        lambda o: jax.lax.psum(
+            jnp.where(stage == num_stages - 1, o, jnp.zeros_like(o)), axis),
+        outs,
+    )
+
+
+def gpipe(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    batch: Any,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipeline",
+    batch_axes: Sequence[str] = (),
+) -> Any:
+    """Host-level entry: microbatch ``batch`` and run the full pipeline.
+
+    ``stacked_params`` leaves carry a leading ``num_stages`` dim (build
+    with ``init_stage_params``), sharded over ``axis``.  ``batch`` is
+    ``[B, ...]``; it is split into ``num_microbatches`` equal microbatches.
+    ``batch_axes`` optionally shards the microbatch dim over data-parallel
+    mesh axes, composing PP with DP in one program.  Differentiable.
+    """
+    num_stages = mesh.shape[axis]
+    leaves = jax.tree.leaves(batch)
+    bsz = leaves[0].shape[0]
+    if bsz % num_microbatches:
+        raise ValueError(
+            f"batch size {bsz} not divisible by "
+            f"num_microbatches={num_microbatches}")
+    micro = jax.tree.map(
+        lambda x: x.reshape(num_microbatches, bsz // num_microbatches,
+                            *x.shape[1:]),
+        batch,
+    )
+    mb_spec = P(None, tuple(batch_axes) or None)
+
+    def per_shard(params_local, micro_local):
+        return pipeline_stages(stage_fn, params_local, micro_local,
+                               axis=axis)
+
+    out = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(axis), mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )(stacked_params, micro)
+    return jax.tree.map(
+        lambda o: o.reshape(bsz, *o.shape[2:]), out)
+
+
+def init_stage_params(
+    init_fn: Callable[[jax.Array], Any],
+    rng: jax.Array,
+    num_stages: int,
+) -> Any:
+    """Stack per-stage params: ``init_fn(rng) -> params`` vmapped over S rngs.
+
+    The result's leading dim is the stage axis; place it on the mesh with
+    ``NamedSharding(mesh, P("pipeline"))`` (``sharding.shard_batch``-style
+    placement is up to the caller/trainer).
+    """
+    rngs = jax.random.split(rng, num_stages)
+    return jax.vmap(init_fn)(rngs)
