@@ -1,0 +1,276 @@
+"""Mixture-of-Experts decoder with expert parallelism.
+
+NEW capability relative to the reference — it has no MoE anywhere
+(SURVEY.md §2.4: EP absent; its nearest artifact is TPU embedding-table
+sharding, ``tpu_embedding_v3.py:498``).  Included so the framework covers
+the full dp/fsdp/tp/sp/ep/pp axis set.
+
+TPU-native design — the GShard/Switch dense-dispatch formulation rather
+than scatter/gather: tokens are routed per group g (one group per
+sequence, riding the batch sharding), and moved with two einsums,
+
+    expert_in[e,g,c,d] = Σ_s dispatch[g,s,e,c] · x[g,s,d]
+    y[g,s,d]           = Σ_{e,c} combine[g,s,e,c] · out[e,g,c,d]
+
+with per-group capacity c ≈ S·top_k·cf/E — cost linear in total tokens —
+so the whole layer is static-shaped MXU work.  Expert weights carry the
+``expert`` logical axis; under an ``expert``-sharded mesh GSPMD turns
+those einsums into the all-to-all dispatch/return pattern automatically —
+no hand-written collectives, and the same model runs unsharded on one
+chip.  Capacity (``capacity_factor``) bounds per-expert token count, the
+standard trick that keeps shapes static under jit (over-capacity tokens
+fall through the residual connection).
+
+Aux objectives follow Switch/GShard: load-balance loss (makes routing
+uniform so EP shards stay busy) and router z-loss (keeps logits small for
+bf16 stability); both are sown into an ``aux_loss`` collection that
+``MoeLmTask`` folds into the training loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models import layers as L
+from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32_000
+    d_model: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = 8
+    ffn_size: int = 14_336
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 1          # 1 = every layer MoE (Mixtral); 2 = alternate
+    max_positions: int = 4096
+    rope_base: float = 10_000.0
+    rms_epsilon: float = 1e-5
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+    dtype: object = jnp.bfloat16
+    remat: bool = True
+
+
+MOE_PRESETS = {
+    # Mixtral-8x7B-shaped flagship EP config.
+    "mixtral_8x7b": MoeConfig(),
+    "moe_1b": MoeConfig(d_model=1024, num_layers=8, num_heads=16,
+                        num_kv_heads=4, ffn_size=4096, num_experts=8),
+    "moe_tiny": MoeConfig(vocab_size=256, d_model=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2, ffn_size=128,
+                          num_experts=4, top_k=2, max_positions=128,
+                          dtype=jnp.float32, remat=False),
+}
+
+
+def _router_one_hot(probs: jax.Array, top_k: int, capacity: int):
+    """Top-k dispatch/combine tensors with per-expert capacity.
+
+    ``probs`` [T, E] float32.  Returns ``dispatch`` [T, E, C] one-hot and
+    ``combine`` [T, E, C] gate-weighted, plus the [T, E] routed mask for
+    the load-balance loss.  Tokens beyond an expert's capacity are dropped
+    (their combine weight is zero → they ride the residual path).
+    """
+    tokens, num_experts = probs.shape
+    remaining = probs
+    fill = jnp.zeros((num_experts,), jnp.int32)  # tokens already assigned
+    dispatch = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    routed = jnp.zeros((tokens, num_experts), probs.dtype)
+    gate_sum = jnp.zeros((tokens, 1), probs.dtype)
+    for _ in range(top_k):  # static, small
+        idx = jnp.argmax(remaining, axis=-1)                      # [T]
+        onehot = jax.nn.one_hot(idx, num_experts, dtype=probs.dtype)
+        gate = jnp.sum(remaining * onehot, axis=-1, keepdims=True)  # [T,1]
+        # Position of each token within its expert's buffer this round,
+        # offset by what previous rounds already filled.
+        pos = jnp.cumsum(onehot, axis=0) - onehot + fill[None, :]   # [T,E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = (pos_tok < capacity).astype(probs.dtype)             # [T]
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=probs.dtype)
+        hot = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + hot
+        combine = combine + hot * gate[:, :, None]
+        routed = routed + onehot * keep[:, None]
+        gate_sum = gate_sum + gate * keep[:, None]
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(
+            jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    # Normalize combine weights over the chosen experts (GShard top-2 rule).
+    combine = combine / jnp.maximum(gate_sum[:, :, None], 1e-9)
+    return dispatch, combine, routed
+
+
+class _ExpertFfn(nn.Module):
+    """One expert's SwiGLU FFN over its [groups, capacity, d_model] buffer.
+
+    Separate from ``layers.MlpBlock`` because expert buffers carry
+    (group, capacity, embed) dims — the shared block's (batch, length, ·)
+    activation constraints don't apply.  ``nn.vmap`` stacks this over the expert axis,
+    tagging params with the ``expert`` logical name.
+    """
+
+    hidden: int
+    dtype: object
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        gate = L.dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                       dtype=self.dtype, name="wi_gate")(x)
+        up = L.dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                     dtype=self.dtype, name="wi_up")(x)
+        h = nn.silu(gate) * up
+        return L.dense(d, ("mlp", "embed"), use_bias=False,
+                       dtype=self.dtype, name="wo")(h)
+
+
+class MoEMlpBlock(nn.Module):
+    """Routed expert FFN, a drop-in for ``layers.MlpBlock``."""
+
+    config: MoeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        # GShard grouping: each sequence is a routing group, so dispatch
+        # tensors are [G, S, E, C] with per-group capacity C ≈ S·k·cf/E —
+        # cost linear in total tokens (an ungrouped [T, E, C] formulation
+        # would be O(T²) and serialize the position cumsum across data
+        # shards).  Groups ride the batch sharding; routing is per-group
+        # independent, so no cross-shard bookkeeping exists at all.
+        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        groups, group_size, d_model = x.shape
+
+        # Router in float32: small matmul, numerically load-bearing.
+        logits = L.dense(cfg.num_experts, ("embed", "expert"),
+                         use_bias=False, dtype=jnp.float32,
+                         name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)          # [G, S, E]
+        capacity = max(
+            1, int(cfg.capacity_factor * cfg.top_k * group_size
+                   / cfg.num_experts))
+        dispatch, combine, routed = jax.vmap(
+            lambda p: _router_one_hot(p, cfg.top_k, capacity))(probs)
+
+        # Aux losses (Switch §4 / ST-MoE): sown, folded in by the task.
+        frac_routed = jnp.mean(routed, axis=(0, 1))      # [E] token fraction
+        frac_prob = jnp.mean(probs, axis=(0, 1))         # [E] router mass
+        lb = cfg.num_experts * jnp.sum(frac_routed * frac_prob) / cfg.top_k
+        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        self.sow("aux_loss", "load_balance", cfg.aux_loss_weight * lb)
+        self.sow("aux_loss", "router_z", cfg.z_loss_weight * z)
+
+        dispatch = dispatch.astype(cfg.dtype)
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, x)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", "batch", None, "embed"))
+        experts = nn.vmap(
+            _ExpertFfn,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(hidden=cfg.ffn_size, dtype=cfg.dtype, name="experts")
+        expert_out = experts(expert_in)                  # [E, G, C, D]
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("expert", "batch", None, "embed"))
+        y = jnp.einsum("gsec,egcd->gsd", combine.astype(cfg.dtype),
+                       expert_out)
+        return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+
+
+class MoeDecoderBlock(nn.Module):
+    config: MoeConfig
+    use_moe: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      name="attn_norm")(x)
+        x = x + L.MultiHeadAttention(
+            num_heads=cfg.num_heads,
+            head_dim=cfg.d_model // cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            dtype=cfg.dtype, causal=True, use_rope=True,
+            rope_base=cfg.rope_base, name="attention",
+        )(h)
+        h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      name="mlp_norm")(x)
+        if self.use_moe:
+            x = x + MoEMlpBlock(cfg, name="moe")(h)
+        else:
+            x = x + L.MlpBlock(hidden=cfg.ffn_size, dtype=cfg.dtype,
+                               activation=nn.silu, gated=True,
+                               name="mlp")(h)
+        return x
+
+
+class MoeLmModel(nn.Module):
+    """Decoder LM with MoE FFNs every ``moe_every``-th layer.
+
+    Layers are a Python loop (not depth-scan): MoE layers interleave with
+    dense ones, so blocks are not homogeneous when ``moe_every > 1``.
+    """
+
+    config: MoeConfig = MoeConfig()
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                    name="token_embed")(tokens)
+        for i in range(cfg.num_layers):
+            blk = MoeDecoderBlock
+            if cfg.remat:
+                blk = nn.remat(blk, prevent_cse=False)
+            x = blk(cfg, use_moe=(i % cfg.moe_every == 0),
+                    name=f"layer_{i}")(x)
+        x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      name="final_norm")(x)
+        logits = L.dense(cfg.vocab_size, ("embed", "vocab"), use_bias=False,
+                         dtype=cfg.dtype, name="lm_head")(x)
+        return nn.with_logical_constraint(
+            logits, ("batch", "length", "vocab"))
+
+
+class MoeLmTask:
+    """Causal LM objective + routed aux losses."""
+
+    def __init__(self, config: MoeConfig = MoeConfig()):
+        self.config = config
+        self.model = MoeLmModel(config)
+
+    def init_variables(self, rng, batch):
+        variables = dict(self.model.init(rng, batch["tokens"]))
+        variables.pop("aux_loss", None)  # ephemeral, not trainable state
+        return variables
+
+    def loss_fn(self, params, model_state, batch, rng, train):
+        del rng, train
+        logits, collections = self.model.apply(
+            {"params": params}, batch["tokens"], mutable=["aux_loss"])
+        logits = logits.astype(jnp.float32)
+        ce, acc = softmax_cross_entropy(logits, batch["targets"])
+        aux = sum(
+            jnp.sum(jnp.asarray(v))
+            for v in jax.tree.leaves(collections.get("aux_loss", {})))
+        loss = ce + aux
+        metrics = {"accuracy": acc, "ce_loss": ce,
+                   "aux_loss": jnp.asarray(aux)}
+        return loss, (metrics, model_state)
+
+
+def make_task(config: MoeConfig = MOE_PRESETS["mixtral_8x7b"]) -> MoeLmTask:
+    return MoeLmTask(config)

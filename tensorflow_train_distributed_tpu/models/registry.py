@@ -39,7 +39,7 @@ def available() -> list[str]:
 
 def _setup():
     from tensorflow_train_distributed_tpu.models import (
-        bert, lenet, llama, resnet, transformer,
+        bert, lenet, llama, moe, resnet, transformer,
     )
 
     # Reference config[0]: MNIST LeNet (MirroredStrategy smoke test).
@@ -88,6 +88,18 @@ def _setup():
                  llama.LLAMA_PRESETS["llama2_7b"]),
              dataset="lm", strategy="dp_tp", global_batch_size=64,
              learning_rate=2e-5)
+    # Beyond the reference (it has no MoE): expert-parallel decoder LM.
+    register("mixtral_8x7b",
+             task_factory=lambda: moe.make_task(
+                 moe.MOE_PRESETS["mixtral_8x7b"]),
+             dataset="lm", strategy="dp_ep", global_batch_size=64,
+             learning_rate=1e-4)
+    register("moe_tiny_lm",
+             task_factory=lambda: moe.make_task(
+                 moe.MOE_PRESETS["moe_tiny"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
     register("llama_tiny_sft",
              task_factory=lambda: llama.make_task(
                  llama.LLAMA_PRESETS["llama_tiny"]),
