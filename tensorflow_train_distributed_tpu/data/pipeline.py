@@ -52,6 +52,11 @@ class DataConfig:
     drop_remainder: bool = True
     num_epochs: Optional[int] = None  # None = repeat forever
     prefetch: int = 2
+    # Native (C++) batch assembly: threaded GIL-free gather via
+    # ``native.staging`` — same batches, same order, off the Python hot
+    # path. Requires the in-memory source to fit packed in host RAM.
+    use_native: bool = False
+    native_threads: int = 2
 
 
 class HostDataLoader:
@@ -104,23 +109,44 @@ class HostDataLoader:
         # permutation on every host keeps global batches consistent.
         return order[self.process_index :: self.process_count]
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+    def _epoch_orders(self) -> Iterator[np.ndarray]:
+        """Per-epoch index streams, truncated to whole batches.
+
+        Batch count must be identical on every process or multi-host SPMD
+        deadlocks at the epoch boundary (one process enters the collective
+        step while another's iterator is exhausted) — so it derives from
+        ``len(source)`` via ``steps_per_epoch``, never from this process's
+        shard length.  Single source of epoch/order/truncation logic for
+        both the Python and native batch paths.
+        """
         epoch = 0
         while self.config.num_epochs is None or epoch < self.config.num_epochs:
             order = self._epoch_order(epoch)
-            # Batch count must be identical on every process or multi-host
-            # SPMD deadlocks at the epoch boundary (one process enters the
-            # collective step while another's iterator is exhausted) — derive
-            # it from len(source), not from this process's shard length.
             n_batches = self.steps_per_epoch()
-            for b in range(n_batches):
+            yield order[: n_batches * self.host_batch_size]
+            epoch += 1
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        if self.config.use_native:
+            from tensorflow_train_distributed_tpu.native.staging import (
+                NativeBatchStager, native_batch_iterator,
+            )
+
+            if NativeBatchStager.available():
+                yield from native_batch_iterator(
+                    self.source, self._epoch_orders(), self.host_batch_size,
+                    num_threads=self.config.native_threads,
+                )
+                return
+            # No toolchain/library: fall through to the Python path.
+        for order in self._epoch_orders():
+            for b in range(len(order) // self.host_batch_size):
                 idx = order[b * self.host_batch_size : (b + 1) * self.host_batch_size]
                 records = [self.source[int(i)] for i in idx]
                 yield {
                     k: np.stack([r[k] for r in records])
                     for k in records[0]
                 }
-            epoch += 1
 
     def steps_per_epoch(self) -> int:
         per_host = len(self.source) // self.process_count

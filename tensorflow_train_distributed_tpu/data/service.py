@@ -1,0 +1,254 @@
+"""Out-of-process input workers — the tf.data service rebuilt host-side.
+
+Reference surface (SURVEY.md §2.2 "tf.data service", §3.5): a dispatcher
+plus out-of-process workers (``data/experimental/service/server_lib.py:
+131,349``) feeding trainers over gRPC via ``.distribute(...)``
+(``data_service_ops.py:578``) — moving input-pipeline CPU off the training
+process.  Here the same shape: ``DataServiceDispatcher`` spawns N worker
+processes, each producing one autoshard slice of the global batch
+(``HostDataLoader`` with ``process_index=w``); ``DataServiceClient``
+streams slices over local TCP and concatenates them into full global
+batches for the trainer.  Transport is a length-prefixed JSON-header +
+raw-buffer frame (no pickle on the wire).
+
+When to use: heavy host-side record work (decode/augment) that would
+otherwise steal cycles from the training process's dispatch thread.  The
+in-process ``HostDataLoader`` (optionally with the native C++ stager)
+remains the default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import multiprocessing as mp
+import socket
+import struct
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from tensorflow_train_distributed_tpu.data.pipeline import DataConfig
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_frame(sock: socket.socket, header: dict, payload: bytes = b""):
+    hdr = json.dumps(header).encode()
+    sock.sendall(_LEN.pack(len(hdr)) + hdr + _LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("input worker closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[dict, bytes]:
+    hdr_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    header = json.loads(_recv_exact(sock, hdr_len))
+    pay_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    return header, _recv_exact(sock, pay_len) if pay_len else b""
+
+
+def _encode_batch(batch: dict[str, np.ndarray]) -> tuple[dict, bytes]:
+    fields, chunks, offset = [], [], 0
+    for name in sorted(batch):
+        arr = np.ascontiguousarray(batch[name])
+        fields.append({"name": name, "dtype": arr.dtype.str,
+                       "shape": arr.shape, "offset": offset,
+                       "nbytes": arr.nbytes})
+        chunks.append(arr.tobytes())
+        offset += arr.nbytes
+    return {"kind": "batch", "fields": fields}, b"".join(chunks)
+
+
+def _decode_batch(header: dict, payload: bytes) -> dict[str, np.ndarray]:
+    out = {}
+    for f in header["fields"]:
+        raw = payload[f["offset"]:f["offset"] + f["nbytes"]]
+        out[f["name"]] = np.frombuffer(raw, dtype=np.dtype(f["dtype"])) \
+            .reshape(f["shape"]).copy()
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceSpec:
+    """Picklable description of a dataset (registry name + kwargs)."""
+
+    dataset: str
+    kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def build(self):
+        from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+
+        return get_dataset(self.dataset, **self.kwargs)
+
+
+def _worker_main(spec: SourceSpec, config: DataConfig, shard_index: int,
+                 shard_count: int, port_queue):
+    """Worker process: serve this shard's batches over a local socket."""
+    from tensorflow_train_distributed_tpu.data.pipeline import HostDataLoader
+
+    loader = HostDataLoader(spec.build(), config,
+                            process_index=shard_index,
+                            process_count=shard_count)
+    server = socket.socket()
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port_queue.put(server.getsockname()[1])
+    conn, _ = server.accept()
+    it = iter(loader)
+    try:
+        while True:
+            header, _ = _recv_frame(conn)
+            cmd = header.get("cmd")
+            if cmd == "NEXT":
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    _send_frame(conn, {"kind": "end"})
+                    continue
+                _send_frame(conn, *_encode_batch(batch))
+            elif cmd == "STOP":
+                _send_frame(conn, {"kind": "bye"})
+                return
+            else:
+                _send_frame(conn, {"kind": "error",
+                                   "message": f"unknown cmd {cmd!r}"})
+    except (ConnectionError, BrokenPipeError):
+        pass
+    finally:
+        conn.close()
+        server.close()
+
+
+class DataServiceDispatcher:
+    """Owns the worker fleet; hands out a connected client.
+
+    ``num_workers`` workers each produce ``global_batch/num_workers``
+    examples per step (the per-worker rebatch rule,
+    ``batch_sizes_for_worker``); the client reassembles full global
+    batches, so the trainer sees exactly the single-process loader
+    contract.
+    """
+
+    def __init__(self, spec: SourceSpec, config: DataConfig,
+                 num_workers: int = 2):
+        if config.global_batch_size % num_workers:
+            raise ValueError(
+                f"global_batch_size={config.global_batch_size} not "
+                f"divisible by num_workers={num_workers}")
+        self.spec = spec
+        self.config = config
+        self.num_workers = num_workers
+        self._procs: list[mp.process.BaseProcess] = []
+        self.ports: list[int] = []
+
+    def start(self) -> "DataServiceDispatcher":
+        import queue as queue_lib
+
+        ctx = mp.get_context("spawn")  # never fork a live XLA runtime
+        queues = [ctx.Queue() for _ in range(self.num_workers)]
+        for w in range(self.num_workers):
+            p = ctx.Process(
+                target=_worker_main,
+                args=(self.spec, self.config, w, self.num_workers,
+                      queues[w]),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+        self.ports = []
+        for w, (q, p) in enumerate(zip(queues, self._procs)):
+            # Poll liveness while waiting: a worker that crashes in
+            # source build/loader init would otherwise stall the full
+            # timeout and surface as a bare queue.Empty.
+            deadline = 60.0
+            while True:
+                try:
+                    self.ports.append(q.get(timeout=0.5))
+                    break
+                except queue_lib.Empty:
+                    deadline -= 0.5
+                    if not p.is_alive():
+                        rc = p.exitcode
+                        self.stop()
+                        raise RuntimeError(
+                            f"input worker {w} died during startup "
+                            f"(exit code {rc}) — bad SourceSpec/DataConfig?"
+                        ) from None
+                    if deadline <= 0:
+                        self.stop()
+                        raise TimeoutError(
+                            f"input worker {w} did not report a port")
+        return self
+
+    def client(self) -> "DataServiceClient":
+        return DataServiceClient(self.ports)
+
+    def stop(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=10)
+        self._procs.clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class DataServiceClient:
+    """Iterates global batches assembled from every worker's shard."""
+
+    def __init__(self, ports: list[int], host: str = "127.0.0.1"):
+        self._socks = []
+        for port in ports:
+            s = socket.create_connection((host, port), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        try:
+            while True:
+                shards = []
+                # Request all workers first, then read all replies — the
+                # workers assemble their slices concurrently.
+                for s in self._socks:
+                    _send_frame(s, {"cmd": "NEXT"})
+                ended = False
+                for s in self._socks:
+                    header, payload = _recv_frame(s)
+                    if header["kind"] == "end":
+                        ended = True
+                    elif header["kind"] == "batch":
+                        shards.append(_decode_batch(header, payload))
+                    else:
+                        raise RuntimeError(
+                            f"input worker error: {header}")
+                if ended:
+                    return
+                yield {
+                    k: np.concatenate([sh[k] for sh in shards])
+                    for k in shards[0]
+                }
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        for s in self._socks:
+            try:
+                _send_frame(s, {"cmd": "STOP"})
+                s.close()
+            except OSError:
+                pass
+        self._socks = []

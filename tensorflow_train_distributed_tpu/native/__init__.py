@@ -1,0 +1,117 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's runtime layer is C++ (SURVEY.md §2.3): tf.data dataset
+kernels, ring collectives, collective executor.  The TPU compute path
+needs none of that (XLA owns device collectives and scheduling), but the
+host-side runtime around it keeps two native components:
+
+- ``staging``   — threaded, GIL-free batch assembly with a buffer arena
+                  (the tf.data-kernel analog), `src/staging.cpp`.
+- ``ringcoll``  — TCP ring allreduce/broadcast for host/DCN-side data
+                  (the `RingAlg`/`RingReducer` analog), `src/ringcoll.cpp`.
+
+The shared library builds on demand with g++ (no pybind11 in this
+environment — plain C ABI + ctypes).  Environments without a toolchain
+get ``None`` from ``load_library`` and pure-Python fallbacks upstream.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libttd_native.so")
+_SOURCES = ("staging.cpp", "ringcoll.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
+        for s in _SOURCES
+    )
+
+
+def build(force: bool = False) -> str:
+    """Compile the native library (idempotent; mtime-cached)."""
+    with _lock:
+        if not force and not _needs_build():
+            return _LIB_PATH
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
+            *(os.path.join(_SRC_DIR, s) for s in _SOURCES),
+            "-o", _LIB_PATH,
+        ]
+        logger.info("building native library: %s", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return _LIB_PATH
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Build if needed and dlopen; returns None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        path = build()
+        lib = ctypes.CDLL(path)
+        _bind_signatures(lib)
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        logger.warning("native library unavailable (%s); using Python "
+                       "fallbacks", detail.strip()[:500])
+        _load_failed = True
+    return _lib
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    lib.ttd_stager_create.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int]
+    lib.ttd_stager_create.restype = ctypes.c_void_p
+    lib.ttd_stager_submit.argtypes = [ctypes.c_void_p, u64p]
+    lib.ttd_stager_submit.restype = ctypes.c_int
+    lib.ttd_stager_acquire.argtypes = [ctypes.c_void_p]
+    lib.ttd_stager_acquire.restype = u8p
+    lib.ttd_stager_release.argtypes = [ctypes.c_void_p, u8p]
+    lib.ttd_stager_release.restype = None
+    lib.ttd_stager_batch_bytes.argtypes = [ctypes.c_void_p]
+    lib.ttd_stager_batch_bytes.restype = ctypes.c_uint64
+    lib.ttd_stager_destroy.argtypes = [ctypes.c_void_p]
+    lib.ttd_stager_destroy.restype = None
+
+    lib.ttd_ring_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.ttd_ring_create.restype = ctypes.c_void_p
+    lib.ttd_ring_allreduce_f32.argtypes = [
+        ctypes.c_void_p, f32p, ctypes.c_uint64]
+    lib.ttd_ring_allreduce_f32.restype = ctypes.c_int
+    lib.ttd_ring_broadcast.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_uint64, ctypes.c_int]
+    lib.ttd_ring_broadcast.restype = ctypes.c_int
+    lib.ttd_ring_rank.argtypes = [ctypes.c_void_p]
+    lib.ttd_ring_rank.restype = ctypes.c_int
+    lib.ttd_ring_world.argtypes = [ctypes.c_void_p]
+    lib.ttd_ring_world.restype = ctypes.c_int
+    lib.ttd_ring_destroy.argtypes = [ctypes.c_void_p]
+    lib.ttd_ring_destroy.restype = None

@@ -1,0 +1,82 @@
+"""Python face of the native TCP ring collectives.
+
+Host-side analog of the reference's `RingReducer` (SURVEY.md §2.3): used
+for cross-process host data (metric fan-in, input-pipeline bookkeeping,
+toolchain tests) where pulling the device fabric in would be wrong.  The
+device path never touches this — XLA collectives over ICI/DCN own it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Sequence
+
+import numpy as np
+
+from tensorflow_train_distributed_tpu import native
+
+
+class HostRing:
+    """Blocking ring collectives among ``world`` processes over TCP."""
+
+    def __init__(self, rank: int, peers: Sequence[str], *,
+                 timeout_ms: int = 10_000):
+        """``peers``: rank-ordered ``host:port`` strings, one per process."""
+        lib = native.load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.ttd_ring_create(
+            rank, len(peers), ",".join(peers).encode(), timeout_ms)
+        if not self._handle:
+            raise RuntimeError(
+                f"ring setup failed (rank={rank}, peers={list(peers)})")
+
+    def _require_handle(self):
+        # ctypes would pass NULL straight into native code → segfault.
+        if not self._handle:
+            raise RuntimeError("HostRing is closed")
+        return self._handle
+
+    @property
+    def rank(self) -> int:
+        return self._lib.ttd_ring_rank(self._require_handle())
+
+    @property
+    def world(self) -> int:
+        return self._lib.ttd_ring_world(self._require_handle())
+
+    def allreduce(self, x: np.ndarray) -> np.ndarray:
+        """Sum-allreduce; returns a new float32 array of ``x``'s shape."""
+        self._require_handle()
+        out = np.ascontiguousarray(x, dtype=np.float32).copy()
+        rc = self._lib.ttd_ring_allreduce_f32(
+            self._handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.size)
+        if rc != 0:
+            raise RuntimeError("ring allreduce failed (peer died?)")
+        return out.reshape(np.shape(x))
+
+    def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
+        """Broadcast ``x`` (same shape/dtype everywhere) from ``root``."""
+        self._require_handle()
+        out = np.ascontiguousarray(x).copy()
+        rc = self._lib.ttd_ring_broadcast(
+            self._handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.nbytes, root)
+        if rc != 0:
+            raise RuntimeError("ring broadcast failed (peer died?)")
+        return out
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ttd_ring_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

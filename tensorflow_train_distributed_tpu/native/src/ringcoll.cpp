@@ -1,0 +1,296 @@
+// Host-side TCP ring collectives: chunked ring allreduce + ring broadcast.
+//
+// TPU-native answer to the reference's CPU/DCN collective path (SURVEY.md
+// §2.3: `RingAlg`/`RingReducer`, `core/common_runtime/ring_alg.h:32`,
+// `ring_reducer.h:32`): device-side collectives are XLA instructions over
+// ICI, but host-side coordination data (metrics fan-in, data-pipeline
+// bookkeeping, test backends without a device fabric) still wants a ring
+// over plain sockets. Classic two-phase algorithm: reduce-scatter then
+// all-gather, W-1 steps each, with send-to-next/recv-from-prev overlapped
+// via a sender thread per step. Bandwidth-optimal 2·(W-1)/W · N bytes on
+// the wire per rank.
+//
+// C ABI for ctypes. Blocking, single in-flight collective per ring — the
+// caller provides ordering (matches how the framework serializes host
+// collectives; XLA owns device-side ordering).
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Sockets are nonblocking (full-duplex Exchange needs it); the *All
+// helpers poll on EAGAIN so they present a blocking interface.
+bool SendAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        pollfd pf{fd, POLLOUT, 0};
+        ::poll(&pf, 1, -1);
+        continue;
+      }
+      return false;
+    }
+    if (k == 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        pollfd pf{fd, POLLIN, 0};
+        ::poll(&pf, 1, -1);
+        continue;
+      }
+      return false;
+    }
+    if (k == 0) return false;  // peer closed
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+class Ring {
+ public:
+  // peers: "host:port" per rank, comma-separated, rank-ordered.
+  // Topology: rank r accepts a connection from r-1 and connects to r+1.
+  static Ring* Create(int rank, int world, const std::string& peers,
+                      int timeout_ms);
+
+  ~Ring() {
+    if (send_fd_ >= 0) ::close(send_fd_);
+    if (recv_fd_ >= 0) ::close(recv_fd_);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  // In-place sum-allreduce of n floats. Returns 0 on success.
+  int AllreduceF32(float* data, uint64_t n) {
+    if (world_ == 1) return 0;
+    const uint64_t chunks = static_cast<uint64_t>(world_);
+    std::vector<uint64_t> ofs(chunks + 1);
+    for (uint64_t c = 0; c <= chunks; ++c) ofs[c] = n * c / chunks;
+    std::vector<float> inbox(ofs[1] - ofs[0] + n / chunks + 2);
+
+    // Phase 1 — reduce-scatter: after W-1 steps, chunk (r+1)%W on rank r
+    // holds the full sum.
+    for (int step = 0; step < world_ - 1; ++step) {
+      uint64_t sc = (rank_ - step + 2 * world_) % world_;        // send
+      uint64_t rc = (rank_ - step - 1 + 2 * world_) % world_;    // recv
+      if (!Exchange(data + ofs[sc], (ofs[sc + 1] - ofs[sc]) * 4,
+                    inbox.data(), (ofs[rc + 1] - ofs[rc]) * 4))
+        return -1;
+      float* dst = data + ofs[rc];
+      const uint64_t m = ofs[rc + 1] - ofs[rc];
+      for (uint64_t i = 0; i < m; ++i) dst[i] += inbox[i];
+    }
+    // Phase 2 — all-gather the reduced chunks around the ring.
+    for (int step = 0; step < world_ - 1; ++step) {
+      uint64_t sc = (rank_ + 1 - step + 2 * world_) % world_;
+      uint64_t rc = (rank_ - step + 2 * world_) % world_;
+      if (!Exchange(data + ofs[sc], (ofs[sc + 1] - ofs[sc]) * 4,
+                    data + ofs[rc], (ofs[rc + 1] - ofs[rc]) * 4))
+        return -1;
+    }
+    return 0;
+  }
+
+  // Ring broadcast from root: each non-root receives then forwards.
+  int Broadcast(uint8_t* data, uint64_t nbytes, int root) {
+    if (world_ == 1) return 0;
+    if (rank_ == root) {
+      return SendAll(send_fd_, data, nbytes) ? 0 : -1;
+    }
+    if (!RecvAll(recv_fd_, data, nbytes)) return -1;
+    // Forward unless the next rank is the root (ring complete).
+    if ((rank_ + 1) % world_ != root)
+      return SendAll(send_fd_, data, nbytes) ? 0 : -1;
+    return 0;
+  }
+
+  int rank() const { return rank_; }
+  int world() const { return world_; }
+
+ private:
+  Ring(int rank, int world) : rank_(rank), world_(world) {}
+
+  // Overlap send-to-next with recv-from-prev: one poll loop over both
+  // nonblocking sockets (no per-step thread churn — this runs 2(W-1)
+  // times per allreduce on per-step metric paths).
+  bool Exchange(const void* sbuf, size_t sn, void* rbuf, size_t rn) {
+    const char* sp = static_cast<const char*>(sbuf);
+    char* rp = static_cast<char*>(rbuf);
+    while (sn > 0 || rn > 0) {
+      pollfd fds[2];
+      int nf = 0, si = -1, ri = -1;
+      if (sn > 0) { fds[nf] = {send_fd_, POLLOUT, 0}; si = nf++; }
+      if (rn > 0) { fds[nf] = {recv_fd_, POLLIN, 0}; ri = nf++; }
+      if (::poll(fds, nf, -1) < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+        ssize_t k = ::send(send_fd_, sp, sn, MSG_NOSIGNAL);
+        if (k < 0 && errno != EINTR && errno != EAGAIN &&
+            errno != EWOULDBLOCK)
+          return false;
+        if (k > 0) { sp += k; sn -= static_cast<size_t>(k); }
+      }
+      if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+        ssize_t k = ::recv(recv_fd_, rp, rn, 0);
+        if (k == 0) return false;  // peer closed
+        if (k < 0 && errno != EINTR && errno != EAGAIN &&
+            errno != EWOULDBLOCK)
+          return false;
+        if (k > 0) { rp += k; rn -= static_cast<size_t>(k); }
+      }
+    }
+    return true;
+  }
+
+  int rank_, world_;
+  int listen_fd_ = -1, send_fd_ = -1, recv_fd_ = -1;
+
+  friend Ring* MakeRing(int, int, const std::string&, int);
+  friend class RingBuilder;
+
+ public:
+  int listen_fd_public() const { return listen_fd_; }
+  void set_fds(int listen_fd, int send_fd, int recv_fd) {
+    listen_fd_ = listen_fd;
+    send_fd_ = send_fd;
+    recv_fd_ = recv_fd;
+  }
+};
+
+std::vector<std::pair<std::string, int>> ParsePeers(const std::string& s) {
+  std::vector<std::pair<std::string, int>> out;
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    std::string item = s.substr(pos, comma - pos);
+    size_t colon = item.rfind(':');
+    if (colon == std::string::npos) return {};
+    out.emplace_back(item.substr(0, colon),
+                     std::atoi(item.c_str() + colon + 1));
+    pos = comma + 1;
+  }
+  return out;
+}
+
+Ring* MakeRing(int rank, int world, const std::string& peers,
+               int timeout_ms) {
+  auto addrs = ParsePeers(peers);
+  if (static_cast<int>(addrs.size()) != world || rank < 0 || rank >= world)
+    return nullptr;
+  if (world == 1) {
+    Ring* r = new Ring(rank, world);
+    return r;
+  }
+
+  // Listen on our advertised port for the predecessor.
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(addrs[rank].second));
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(lfd, 4) < 0) {
+    ::close(lfd);
+    return nullptr;
+  }
+
+  // Connect to successor (retry until its listener is up or timeout).
+  int next = (rank + 1) % world;
+  int sfd = -1;
+  for (int waited = 0; waited < timeout_ms; waited += 50) {
+    sfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in peer{};
+    peer.sin_family = AF_INET;
+    peer.sin_port = htons(static_cast<uint16_t>(addrs[next].second));
+    if (::inet_pton(AF_INET, addrs[next].first.c_str(), &peer.sin_addr) != 1) {
+      // Resolve "localhost" only; full DNS is the Python layer's job.
+      if (addrs[next].first == "localhost")
+        ::inet_pton(AF_INET, "127.0.0.1", &peer.sin_addr);
+      else {
+        ::close(sfd);
+        ::close(lfd);
+        return nullptr;
+      }
+    }
+    if (::connect(sfd, reinterpret_cast<sockaddr*>(&peer), sizeof(peer)) == 0)
+      break;
+    ::close(sfd);
+    sfd = -1;
+    ::usleep(50 * 1000);
+  }
+  if (sfd < 0) {
+    ::close(lfd);
+    return nullptr;
+  }
+  ::setsockopt(sfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  // Accept the predecessor.
+  int rfd = ::accept(lfd, nullptr, nullptr);
+  if (rfd < 0) {
+    ::close(sfd);
+    ::close(lfd);
+    return nullptr;
+  }
+  ::setsockopt(rfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  ::fcntl(sfd, F_SETFL, ::fcntl(sfd, F_GETFL) | O_NONBLOCK);
+  ::fcntl(rfd, F_SETFL, ::fcntl(rfd, F_GETFL) | O_NONBLOCK);
+
+  Ring* r = new Ring(rank, world);
+  r->set_fds(lfd, sfd, rfd);
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ttd_ring_create(int rank, int world, const char* peers,
+                      int timeout_ms) {
+  return MakeRing(rank, world, peers ? peers : "", timeout_ms);
+}
+
+int ttd_ring_allreduce_f32(void* r, float* data, uint64_t n) {
+  return static_cast<Ring*>(r)->AllreduceF32(data, n);
+}
+
+int ttd_ring_broadcast(void* r, uint8_t* data, uint64_t nbytes, int root) {
+  return static_cast<Ring*>(r)->Broadcast(data, nbytes, root);
+}
+
+int ttd_ring_rank(void* r) { return static_cast<Ring*>(r)->rank(); }
+int ttd_ring_world(void* r) { return static_cast<Ring*>(r)->world(); }
+
+void ttd_ring_destroy(void* r) { delete static_cast<Ring*>(r); }
+
+}  // extern "C"

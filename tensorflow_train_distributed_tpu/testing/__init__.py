@@ -1,0 +1,16 @@
+"""Test infrastructure: multi-process clusters without real hardware.
+
+Rebuilds the reference's distributed-test playbook (SURVEY.md §4):
+``MultiProcessRunner`` (``distribute/multi_process_runner.py:107``) →
+``MultiProcessRunner`` here; in-process fake clusters + ``MockOsEnv``
+(``multi_worker_test_base.py:123,579``) → per-child env dicts; logical-
+device splitting (``test_util.py:131``) → per-process virtual CPU devices.
+"""
+
+from tensorflow_train_distributed_tpu.testing.multiprocess import (  # noqa: F401
+    MultiProcessRunner,
+    ProcessResult,
+    UnexpectedExitError,
+    free_ports,
+    tf_config_env,
+)

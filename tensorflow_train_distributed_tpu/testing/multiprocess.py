@@ -1,0 +1,175 @@
+"""Multi-process localhost clusters for distributed tests.
+
+TPU-native rebuild of the reference's test rig (SURVEY.md §4): real
+subprocesses (not forks — a forked XLA runtime is undefined behavior) each
+running a named worker function on a virtual CPU backend, joined into one
+cluster via ``jax.distributed.initialize`` against a localhost coordinator
+— the same coordination service a real multi-host TPU pod uses, so
+collectives, process_allgather, and multi-host checkpointing execute their
+true code paths.  Mirrors ``MultiProcessRunner``'s contract: per-task env
+injection (``TF_CONFIG`` included, via ``tf_config_env``), captured
+stdout/stderr, timeout detection, and fault injection by killing workers
+(``SubprocessTimeoutError`` / ``UnexpectedSubprocessExitError`` analogs).
+
+Worker functions are addressed as ``"module:function"`` and must be
+importable in the child (test modules are put on ``PYTHONPATH``
+automatically).  The child bootstrap is ``testing._child``; results come
+back as a JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Optional, Sequence
+
+_RESULT_TAG = "TTD_RESULT:"
+
+
+class UnexpectedExitError(RuntimeError):
+    """A worker died (crash or injected kill) — reference
+    ``UnexpectedSubprocessExitError`` analog."""
+
+    def __init__(self, results):
+        self.results = results
+        detail = "\n".join(
+            f"--- rank {r.rank} rc={r.returncode} ---\n{r.stderr[-2000:]}"
+            for r in results if r.returncode != 0)
+        super().__init__(f"worker process(es) failed:\n{detail}")
+
+
+class TimeoutError_(RuntimeError):
+    """Cluster did not finish in time (``SubprocessTimeoutError`` analog)."""
+
+
+@dataclasses.dataclass
+class ProcessResult:
+    rank: int
+    returncode: Optional[int]
+    stdout: str
+    stderr: str
+    value: Any = None  # the worker fn's JSON-serializable return
+
+
+def free_ports(n: int) -> list[int]:
+    """Reserve n distinct free TCP ports (bind-then-release)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def tf_config_env(cluster: dict[str, Sequence[str]], task_type: str,
+                  task_index: int) -> dict[str, str]:
+    """A ``TF_CONFIG`` JSON env var for one task — the reference's cluster
+    spec format (``tfconfig_cluster_resolver.py:48``), built per-child so
+    the real process env is never mutated (``MockOsEnv`` analog)."""
+    return {"TF_CONFIG": json.dumps({
+        "cluster": {k: list(v) for k, v in cluster.items()},
+        "task": {"type": task_type, "index": task_index},
+    })}
+
+
+class MultiProcessRunner:
+    """Launch N workers; join them; deliver per-rank results.
+
+    Each worker runs ``target`` = ``"module:function"`` as
+    ``fn(rank, **payload)`` on a ``local_devices``-device CPU backend.
+    With ``init_distributed`` (default) the children form one JAX cluster
+    (global device count = N × local_devices).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        num_processes: int,
+        *,
+        payload: Optional[dict] = None,
+        env_per_rank: Optional[Sequence[dict[str, str]]] = None,
+        local_devices: int = 2,
+        init_distributed: bool = True,
+        timeout: float = 300.0,
+    ):
+        self.target = target
+        self.num_processes = num_processes
+        self.payload = payload or {}
+        self.env_per_rank = env_per_rank or [{} for _ in range(num_processes)]
+        self.local_devices = local_devices
+        self.init_distributed = init_distributed
+        self.timeout = timeout
+        self._procs: list[subprocess.Popen] = []
+        self._coordinator = f"127.0.0.1:{free_ports(1)[0]}"
+
+    def start(self) -> "MultiProcessRunner":
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        for rank in range(self.num_processes):
+            env = dict(os.environ)
+            env.update(self.env_per_rank[rank])
+            # Children must resolve the cluster from env exactly as a real
+            # launch would (runtime.distributed resolution order).
+            if self.init_distributed and "TF_CONFIG" not in env:
+                env.update(
+                    TTD_COORDINATOR=self._coordinator,
+                    TTD_NUM_PROCESSES=str(self.num_processes),
+                    TTD_PROCESS_ID=str(rank),
+                )
+            env["TTD_TEST_LOCAL_DEVICES"] = str(self.local_devices)
+            env["TTD_TEST_INIT_DISTRIBUTED"] = (
+                "1" if self.init_distributed else "0")
+            # Make the caller's test modules importable in the child.
+            extra_path = [repo_root] + [
+                p for p in sys.path if p.endswith("tests")]
+            env["PYTHONPATH"] = os.pathsep.join(
+                extra_path + [env.get("PYTHONPATH", "")])
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "tensorflow_train_distributed_tpu.testing._child",
+                 self.target, str(rank), json.dumps(self.payload)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, cwd=repo_root,
+            ))
+        return self
+
+    def terminate(self, rank: int, sig: int = signal.SIGKILL) -> None:
+        """Fault injection: kill one worker (reference process-kill tests)."""
+        self._procs[rank].send_signal(sig)
+
+    def join(self, *, expect_failure: bool = False) -> list[ProcessResult]:
+        deadline = time.monotonic() + self.timeout
+        results: list[ProcessResult] = []
+        for rank, p in enumerate(self._procs):
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in self._procs:
+                    if q.poll() is None:
+                        q.kill()
+                out, err = p.communicate()
+                results.append(ProcessResult(rank, None, out, err))
+                raise TimeoutError_(
+                    f"rank {rank} exceeded {self.timeout}s; stderr tail:\n"
+                    f"{err[-2000:]}")
+            value = None
+            for line in out.splitlines():
+                if line.startswith(_RESULT_TAG):
+                    value = json.loads(line[len(_RESULT_TAG):])
+            results.append(ProcessResult(rank, p.returncode, out, err, value))
+        if not expect_failure and any(r.returncode != 0 for r in results):
+            raise UnexpectedExitError(results)
+        return results
+
+    def run(self, *, expect_failure: bool = False) -> list[ProcessResult]:
+        return self.start().join(expect_failure=expect_failure)

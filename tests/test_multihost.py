@@ -1,0 +1,178 @@
+"""Multi-host tests: real localhost clusters via MultiProcessRunner.
+
+The reference runs its distributed machinery in forked processes with
+per-task TF_CONFIG (SURVEY.md §4.1–4.2); these tests do the same against
+the JAX coordination service — 2 processes × 2 virtual CPU devices form a
+4-device cluster, then collectives / input sharding / fault injection run
+their true multi-host code paths.
+
+Worker functions live at module top level (children import this module by
+name).  Keep worker payloads JSON-serializable.
+"""
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.testing import (
+    MultiProcessRunner, UnexpectedExitError, free_ports, tf_config_env,
+)
+
+pytestmark = pytest.mark.multihost
+
+
+# --- worker fns (run in children) ------------------------------------------
+
+
+def _cluster_info(rank):
+    import jax
+
+    return {
+        "rank": rank,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+
+
+def _global_psum(rank):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    # Each process contributes its local slice of a global [ndev] array.
+    local = np.full((len(jax.local_devices()),), float(rank + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local)
+    total = jax.jit(jnp.sum)(arr)
+    return {"sum": float(total), "devices": len(jax.devices())}
+
+
+def _sharded_loader(rank):
+    """Each host draws its autoshard slice; batches must align globally."""
+    import jax
+
+    from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+    from tensorflow_train_distributed_tpu.data.pipeline import (
+        DataConfig, HostDataLoader,
+    )
+
+    loader = HostDataLoader(
+        get_dataset("mnist", num_examples=128),
+        DataConfig(global_batch_size=16, seed=3, num_epochs=1),
+    )
+    batches = list(loader)
+    labels = [int(b["label"][0]) for b in batches]
+    return {
+        "process_index": jax.process_index(),
+        "num_batches": len(batches),
+        "host_batch": batches[0]["label"].shape[0],
+        "first_labels": labels,
+    }
+
+
+def _tf_config_identity(rank):
+    from tensorflow_train_distributed_tpu.runtime.distributed import (
+        resolve_cluster,
+    )
+
+    cfg = resolve_cluster()
+    return {"process_id": cfg.process_id, "num": cfg.num_processes,
+            "source": cfg.source, "coordinator": cfg.coordinator_address}
+
+
+def _hang_forever(rank):
+    if rank == 1:
+        import time
+
+        time.sleep(3600)
+    return {"rank": rank}
+
+
+def _host_ring_worker(rank, ports):
+    from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
+
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    ring = HostRing(rank, peers)
+    out = ring.allreduce(np.asarray([rank + 1.0], np.float32))
+    ring.close()
+    return {"sum": float(out[0])}
+
+
+# --- tests ------------------------------------------------------------------
+
+
+def test_cluster_forms():
+    results = MultiProcessRunner(
+        "test_multihost:_cluster_info", 2, local_devices=2).run()
+    for r in results:
+        assert r.value["process_count"] == 2
+        assert r.value["global_devices"] == 4
+        assert r.value["local_devices"] == 2
+        assert r.value["process_index"] == r.rank
+
+
+def test_global_collective_across_processes():
+    results = MultiProcessRunner(
+        "test_multihost:_global_psum", 2, local_devices=2).run()
+    # ranks contribute 2·1 + 2·2 = 6 over 4 devices.
+    for r in results:
+        assert r.value["devices"] == 4
+        assert r.value["sum"] == 6.0
+
+
+def test_input_autoshard_across_hosts():
+    results = MultiProcessRunner(
+        "test_multihost:_sharded_loader", 2, local_devices=2).run()
+    a, b = (r.value for r in results)
+    # Same step count everywhere (SPMD deadlock rule) and complementary
+    # halves of the global batch.
+    assert a["num_batches"] == b["num_batches"] == 8
+    assert a["host_batch"] == b["host_batch"] == 8
+    assert a["first_labels"] != b["first_labels"]  # disjoint shards
+
+
+def test_tf_config_cluster_resolution():
+    cluster = {"worker": [f"127.0.0.1:{p}" for p in free_ports(2)]}
+    envs = [tf_config_env(cluster, "worker", i) for i in range(2)]
+    results = MultiProcessRunner(
+        "test_multihost:_tf_config_identity", 2,
+        env_per_rank=envs, init_distributed=False).run()
+    for r in results:
+        assert r.value["source"] == "env:TF_CONFIG"
+        assert r.value["process_id"] == r.rank
+        assert r.value["num"] == 2
+        assert r.value["coordinator"] == cluster["worker"][0]
+
+
+def test_fault_injection_kill_worker():
+    runner = MultiProcessRunner(
+        "test_multihost:_hang_forever", 2, local_devices=1,
+        init_distributed=False, timeout=60).start()
+    import time
+
+    time.sleep(2)
+    runner.terminate(1)
+    with pytest.raises(UnexpectedExitError) as ei:
+        runner.join()
+    rcs = [r.returncode for r in ei.value.results]
+    assert rcs[1] != 0  # the killed worker is reported dead
+
+
+def test_host_ring_across_processes():
+    from tensorflow_train_distributed_tpu import native
+
+    if native.load_library() is None:
+        pytest.skip("native toolchain unavailable")
+    ports = free_ports(3)
+    results = MultiProcessRunner(
+        "test_multihost:_host_ring_worker", 3,
+        payload={"ports": ports}, init_distributed=False,
+        local_devices=1).run()
+    for r in results:
+        assert r.value["sum"] == 6.0
