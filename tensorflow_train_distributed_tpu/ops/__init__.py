@@ -11,3 +11,9 @@ from tensorflow_train_distributed_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
     multihead_attention_kernel,
 )
+from tensorflow_train_distributed_tpu.ops.embedding import (  # noqa: F401
+    EmbeddingCollection,
+    FeatureSpec,
+    TableSpec,
+    sharded_lookup,
+)

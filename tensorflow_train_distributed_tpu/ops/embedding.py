@@ -1,0 +1,191 @@
+"""Sharded embedding tables: the TPUEmbedding answer.
+
+Reference capability being matched: ``TPUEmbeddingV2``/``V3``
+(``tensorflow/python/tpu/tpu_embedding_v3.py:498``, ``tpu_embedding_v2.py:76``)
+— large embedding tables sharded across TPU devices, looked up by integer
+feature ids, with per-feature combiners (sum/mean/sqrtn) and table sharing
+between features.  The reference reaches SparseCore hardware; here tables
+live in HBM sharded over a mesh axis and lookups ride ICI collectives.
+
+Two lookup paths, same numerics:
+
+- **shard_map path** (TPU-native, used when the ambient mesh shards the
+  table axis): the table is mod-the-mesh row-sharded; every device clips the
+  global ids into its own row range, does a *local* ``take`` (rows it does
+  not own contribute zeros), and one ``psum`` over the table axis sums the
+  one non-zero contribution per id.  No device ever materializes the full
+  table or an all-gathered id-row matrix — traffic is O(batch × dim), the
+  activation size, independent of vocab.
+- **GSPMD path** (fallback, also the numerics oracle in tests): a plain
+  ``jnp.take`` with logical-axis constraints; XLA partitions the gather.
+
+Multi-valent features are [B, L] id matrices with negative padding; the
+combiner reduces L.  Gradients flow through both paths (``psum`` and
+``take`` are linear), giving the sparse-gradient-allreduce semantics of the
+reference's embedding optimizer without any custom backward pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    """One embedding table (reference: ``tpu_embedding_v2_utils.TableConfig``)."""
+
+    name: str
+    vocab_size: int
+    dim: int
+    initializer_stddev: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSpec:
+    """One input feature routed to a table (ref: ``FeatureConfig``).
+
+    Several features may name the same table — that is table sharing (e.g.
+    query-id and doc-id over one id space).  ``combiner`` reduces the valence
+    dim of [B, L] multi-valent inputs; scalar [B] inputs skip combining.
+    """
+
+    name: str
+    table: str
+    combiner: str = "mean"  # "sum" | "mean" | "sqrtn"
+
+
+def _combine(rows: jax.Array, valid: jax.Array, combiner: str) -> jax.Array:
+    """Reduce the valence dim. rows: [B, L, D]; valid: [B, L] bool."""
+    w = valid.astype(rows.dtype)
+    total = jnp.einsum("bld,bl->bd", rows, w)
+    if combiner == "sum":
+        return total
+    count = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1.0)
+    if combiner == "mean":
+        return total / count
+    if combiner == "sqrtn":
+        return total / jnp.sqrt(count)
+    raise ValueError(f"Unknown combiner {combiner!r}")
+
+
+def _local_take(local_table: jax.Array, ids: jax.Array, axis: str):
+    """Per-shard lookup body: rows this shard owns, zeros elsewhere.
+
+    ``local_table`` is this device's row block of the mod-sharded table;
+    global row r lives on shard r // rows_per_shard at local row
+    r % rows_per_shard.
+    """
+    rows_per_shard = local_table.shape[0]
+    shard = jax.lax.axis_index(axis)
+    local_ids = ids - shard * rows_per_shard
+    owned = (local_ids >= 0) & (local_ids < rows_per_shard)
+    rows = jnp.take(local_table, jnp.clip(local_ids, 0, rows_per_shard - 1),
+                    axis=0)
+    return jnp.where(owned[..., None], rows, 0)
+
+
+def sharded_lookup(
+    table: jax.Array,
+    ids: jax.Array,
+    *,
+    mesh=None,
+    table_axis: str = "tensor",
+) -> jax.Array:
+    """Embedding rows for ``ids`` from a row-sharded ``table``.
+
+    ``table``: [vocab, dim] sharded over ``table_axis`` (rows).  ``ids``: any
+    integer shape; out-of-range/negative ids return zero rows.  When ``mesh``
+    is None or doesn't shard ``table_axis``, falls back to masked
+    ``jnp.take`` (GSPMD partitions it).
+    """
+    valid = (ids >= 0) & (ids < table.shape[0])
+    safe = jnp.where(valid, ids, 0)
+    if mesh is None or mesh.shape.get(table_axis, 1) <= 1:
+        rows = jnp.take(table, safe, axis=0)
+        return jnp.where(valid[..., None], rows, 0)
+    if table.shape[0] % mesh.shape[table_axis]:
+        raise ValueError(
+            f"vocab {table.shape[0]} not divisible by mesh axis "
+            f"{table_axis}={mesh.shape[table_axis]}")
+
+    def body(local_table, ids_rep, valid_rep):
+        rows = _local_take(local_table, ids_rep, table_axis)
+        rows = jax.lax.psum(rows, table_axis)
+        return jnp.where(valid_rep[..., None], rows, 0)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(table_axis, None), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(table, safe, valid)
+
+
+def _ambient_mesh(table_axis: str):
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or mesh.shape.get(table_axis, 1) <= 1:
+        return None
+    return mesh
+
+
+class EmbeddingCollection(nn.Module):
+    """Feature→table embedding bank (reference: ``TPUEmbedding`` API shape).
+
+    ``__call__`` takes ``{feature_name: ids}`` ([B] scalar or [B, L]
+    multi-valent with negative padding) and returns ``{feature_name:
+    [B, dim]}``.  Tables are mod-row-sharded over ``table_axis`` when the
+    ambient mesh (bound by the Trainer via ``jax.set_mesh``) has it.
+    """
+
+    tables: Sequence[TableSpec]
+    features: Sequence[FeatureSpec]
+    table_axis: str = "tensor"
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        by_name = {t.name: t for t in self.tables}
+        if len(by_name) != len(self.tables):
+            raise ValueError("Duplicate table names")
+        for f in self.features:
+            if f.table not in by_name:
+                raise ValueError(
+                    f"Feature {f.name!r} routes to unknown table {f.table!r}")
+        params = {}
+        for t in self.tables:
+            params[t.name] = self.param(
+                t.name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=t.initializer_stddev),
+                    ("vocab", "embed")),
+                (t.vocab_size, t.dim),
+            )
+        self._params = params
+        self._specs = by_name
+
+    def __call__(self, feature_ids: Mapping[str, jax.Array],
+                 ) -> dict[str, jax.Array]:
+        mesh = _ambient_mesh(self.table_axis)
+        out = {}
+        for f in self.features:
+            if f.name not in feature_ids:
+                continue
+            ids = feature_ids[f.name]
+            table = self._params[f.table].astype(self.dtype)
+            scalar = ids.ndim == 1
+            ids2d = ids[:, None] if scalar else ids
+            rows = sharded_lookup(table, ids2d, mesh=mesh,
+                                  table_axis=self.table_axis)
+            if scalar:
+                out[f.name] = rows[:, 0, :]
+            else:
+                valid = (ids2d >= 0) & (ids2d < table.shape[0])
+                out[f.name] = _combine(rows, valid, f.combiner)
+        return out
