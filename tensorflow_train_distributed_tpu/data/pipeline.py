@@ -89,6 +89,7 @@ class HostDataLoader:
                 f"by process_count={self.process_count}"
             )
         self.host_batch_size = config.global_batch_size // self.process_count
+        self._native_packed = None  # pack_for_staging cache (use_native)
         if not config.drop_remainder:
             raise NotImplementedError(
                 "drop_remainder=False is not supported: SPMD step functions "
@@ -129,13 +130,19 @@ class HostDataLoader:
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         if self.config.use_native:
             from tensorflow_train_distributed_tpu.native.staging import (
-                NativeBatchStager, native_batch_iterator,
+                NativeBatchStager, native_batch_iterator, pack_for_staging,
             )
 
             if NativeBatchStager.available():
+                if self._native_packed is None:
+                    # Pack once per loader: re-created iterators (periodic
+                    # eval, preemption restart) reuse the flattened matrix
+                    # instead of re-copying the dataset every time.
+                    self._native_packed = pack_for_staging(self.source)
                 yield from native_batch_iterator(
                     self.source, self._epoch_orders(), self.host_batch_size,
                     num_threads=self.config.native_threads,
+                    packed=self._native_packed,
                 )
                 return
             # No toolchain/library: fall through to the Python path.

@@ -212,12 +212,25 @@ class DataServiceClient:
 
     def __init__(self, ports: list[int], host: str = "127.0.0.1"):
         self._socks = []
+        self._consumed = False
         for port in ports:
             s = socket.create_connection((host, port), timeout=60)
+            # The 60s budget is for connect only; batch production may
+            # legitimately take longer (heavy decode/augment), so reads
+            # block without a deadline.
+            s.settimeout(None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks.append(s)
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        # Single-use: the STOP/close in the finally block tears down the
+        # worker connections, so a second pass cannot be served.
+        if self._consumed:
+            raise RuntimeError(
+                "DataServiceClient is single-use (its sockets close when "
+                "iteration ends); call .client() on the service for a "
+                "fresh iterator")
+        self._consumed = True
         try:
             while True:
                 shards = []

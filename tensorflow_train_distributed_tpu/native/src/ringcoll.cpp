@@ -173,15 +173,6 @@ class Ring {
   int listen_fd_ = -1, send_fd_ = -1, recv_fd_ = -1;
 
   friend Ring* MakeRing(int, int, const std::string&, int);
-  friend class RingBuilder;
-
- public:
-  int listen_fd_public() const { return listen_fd_; }
-  void set_fds(int listen_fd, int send_fd, int recv_fd) {
-    listen_fd_ = listen_fd;
-    send_fd_ = send_fd;
-    recv_fd_ = recv_fd;
-  }
 };
 
 std::vector<std::pair<std::string, int>> ParsePeers(const std::string& s) {
@@ -228,7 +219,8 @@ Ring* MakeRing(int rank, int world, const std::string& peers,
   // Connect to successor (retry until its listener is up or timeout).
   int next = (rank + 1) % world;
   int sfd = -1;
-  for (int waited = 0; waited < timeout_ms; waited += 50) {
+  int waited = 0;
+  for (; waited < timeout_ms; waited += 50) {
     sfd = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in peer{};
     peer.sin_family = AF_INET;
@@ -255,7 +247,16 @@ Ring* MakeRing(int rank, int world, const std::string& peers,
   }
   ::setsockopt(sfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 
-  // Accept the predecessor.
+  // Accept the predecessor, spending whatever remains of the timeout
+  // budget — a predecessor that dies after our connect succeeded must
+  // surface as setup failure, not an indefinite accept() hang.
+  pollfd lpf{lfd, POLLIN, 0};
+  int remaining = timeout_ms - waited;
+  if (::poll(&lpf, 1, remaining > 0 ? remaining : 1) <= 0) {
+    ::close(sfd);
+    ::close(lfd);
+    return nullptr;
+  }
   int rfd = ::accept(lfd, nullptr, nullptr);
   if (rfd < 0) {
     ::close(sfd);
@@ -267,7 +268,9 @@ Ring* MakeRing(int rank, int world, const std::string& peers,
   ::fcntl(rfd, F_SETFL, ::fcntl(rfd, F_GETFL) | O_NONBLOCK);
 
   Ring* r = new Ring(rank, world);
-  r->set_fds(lfd, sfd, rfd);
+  r->listen_fd_ = lfd;
+  r->send_fd_ = sfd;
+  r->recv_fd_ = rfd;
   return r;
 }
 

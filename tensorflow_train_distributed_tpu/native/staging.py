@@ -123,6 +123,17 @@ class NativeBatchStager:
             pass
 
 
+def pack_for_staging(source) -> tuple[RecordLayout, np.ndarray]:
+    """One-time O(N) flatten of a source for the stager.
+
+    Callers that re-create iterators (periodic eval, preemption restart)
+    should pack once and pass the result to ``native_batch_iterator`` —
+    packing copies the whole dataset.
+    """
+    layout = RecordLayout(source[0])
+    return layout, layout.pack_source(source)
+
+
 def native_batch_iterator(
     source,
     order_epochs: Iterator[np.ndarray],
@@ -130,16 +141,17 @@ def native_batch_iterator(
     *,
     num_threads: int = 2,
     lookahead: int = 2,
+    packed: Optional[tuple[RecordLayout, np.ndarray]] = None,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Iterate structured batches drawn via the native stager.
 
     ``order_epochs`` yields per-epoch index arrays (already sharded/
     shuffled by the caller — ``HostDataLoader`` semantics).  Keeps
     ``lookahead`` submissions in flight so worker threads stay busy one
-    batch ahead of the consumer.
+    batch ahead of the consumer.  ``packed`` is a cached
+    ``pack_for_staging`` result; omitted, the source is packed here.
     """
-    layout = RecordLayout(source[0])
-    packed = layout.pack_source(source)
+    layout, packed = packed if packed is not None else pack_for_staging(source)
     stager = NativeBatchStager(packed, batch_size,
                                num_threads=num_threads,
                                pool_size=lookahead + 2)

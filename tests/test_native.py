@@ -5,7 +5,9 @@ on every native call, so the blocking socket exchange behaves exactly as
 it does across real processes (the multi-host rig covers that path).
 """
 
+import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -109,11 +111,14 @@ def test_native_loader_matches_python_loader():
 # --- ring collectives -------------------------------------------------------
 
 
-def _run_ring(world, fn, base_port):
+def _run_ring(world, fn):
     """Run fn(ring, rank) in `world` threads over a localhost ring."""
     from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
+    from tensorflow_train_distributed_tpu.testing.multiprocess import (
+        free_ports,
+    )
 
-    peers = [f"127.0.0.1:{base_port + r}" for r in range(world)]
+    peers = [f"127.0.0.1:{p}" for p in free_ports(world)]
     results = [None] * world
     errors = []
 
@@ -142,7 +147,7 @@ def test_ring_allreduce_matches_sum():
         x = np.arange(n, dtype=np.float32) * (rank + 1)
         return ring.allreduce(x)
 
-    results = _run_ring(world, fn, base_port=19300)
+    results = _run_ring(world, fn)
     want = np.arange(n, dtype=np.float32) * sum(range(1, world + 1))
     for r in results:
         np.testing.assert_allclose(r, want, rtol=1e-6)
@@ -151,7 +156,7 @@ def test_ring_allreduce_matches_sum():
 def test_ring_allreduce_small_vector():
     # n < world: some ranks own empty chunks.
     results = _run_ring(3, lambda ring, rank: ring.allreduce(
-        np.asarray([float(rank)], np.float32)), base_port=19310)
+        np.asarray([float(rank)], np.float32)))
     for r in results:
         np.testing.assert_allclose(r, [3.0])
 
@@ -163,14 +168,43 @@ def test_ring_broadcast():
         x = payload if rank == 1 else np.zeros_like(payload)
         return ring.broadcast(x, root=1)
 
-    for r in _run_ring(4, fn, base_port=19320):
+    for r in _run_ring(4, fn):
         np.testing.assert_array_equal(r, payload)
+
+
+def test_ring_setup_times_out_when_predecessor_missing():
+    """A dead predecessor must fail setup within the budget, not hang in
+    accept() forever (rank 0's connect to rank 1 succeeds; rank 2 never
+    starts, so rank 1 waits on accept and rank 0's ring can't close)."""
+    from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
+    from tensorflow_train_distributed_tpu.testing.multiprocess import (
+        free_ports,
+    )
+
+    ports = free_ports(3)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    # Fake rank-1 listener so rank 0's connect-to-successor SUCCEEDS and
+    # setup proceeds to the accept-from-predecessor wait.
+    fake = socket.socket()
+    fake.bind(("127.0.0.1", ports[1]))
+    fake.listen(1)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError):
+            HostRing(0, peers, timeout_ms=1500)  # rank 2 never connects
+        assert time.monotonic() - t0 < 10
+    finally:
+        fake.close()
 
 
 def test_ring_world_one_is_noop():
     from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
 
-    ring = HostRing(0, ["127.0.0.1:19330"])
+    from tensorflow_train_distributed_tpu.testing.multiprocess import (
+        free_ports,
+    )
+
+    ring = HostRing(0, [f"127.0.0.1:{free_ports(1)[0]}"])
     np.testing.assert_allclose(
         ring.allreduce(np.asarray([5.0], np.float32)), [5.0])
     ring.close()
