@@ -55,8 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["sgd", "momentum", "adam", "adamw"])
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled weight decay (adamw only)")
-    p.add_argument("--warmup-steps", type=int, default=0,
-                   help="linear LR warmup steps (0 = constant LR)")
+    p.add_argument("--warmup-steps", type=int, default=None,
+                   help="linear LR warmup steps (default: the config's "
+                        "warmup_ratio × --steps)")
+    p.add_argument("--lr-schedule", default=None,
+                   help="constant | warmup_cosine | warmup_linear | noam | "
+                        "resnet_steps (default: the config's convention)")
     p.add_argument("--precision", "--mixed-precision", dest="precision",
                    default="bfloat16",
                    help="dtype policy: float32 | bfloat16 | float16 "
@@ -120,21 +124,27 @@ def _parse_mesh_overrides(spec: str) -> dict[str, int]:
     return sizes
 
 
-def _make_optimizer(args, entry) -> "optax.GradientTransformation":
+def _make_optimizer(args, entry):
+    """(optimizer, lr_schedule) from flags + the config's LR convention."""
     import optax
 
-    lr = args.learning_rate
-    if lr is None:
-        lr = entry["learning_rate"]
-    if args.warmup_steps > 0:
-        lr = optax.linear_schedule(0.0, lr, args.warmup_steps)
+    from tensorflow_train_distributed_tpu.training import schedules
+
+    peak = args.learning_rate
+    if peak is None:
+        peak = entry["learning_rate"]
+    warmup = args.warmup_steps
+    if warmup is None:
+        warmup = int(entry.get("warmup_ratio", 0.0) * args.steps)
+    name = args.lr_schedule or entry.get("lr_schedule", "constant")
+    lr = schedules.by_name(name, peak, args.steps, warmup_steps=warmup)
     if args.optimizer == "sgd":
-        return optax.sgd(lr)
+        return optax.sgd(lr), lr
     if args.optimizer == "momentum":
-        return optax.sgd(lr, momentum=0.9, nesterov=True)
+        return optax.sgd(lr, momentum=0.9, nesterov=True), lr
     if args.optimizer == "adam":
-        return optax.adam(lr)
-    return optax.adamw(lr, weight_decay=args.weight_decay)
+        return optax.adam(lr), lr
+    return optax.adamw(lr, weight_decay=args.weight_decay), lr
 
 
 @dataclasses.dataclass
@@ -254,10 +264,12 @@ def run(args: argparse.Namespace) -> RunResult:
                 watcher = None
             if watcher is not None:
                 callbacks.append(PreemptionCheckpointCallback(watcher))
+    optimizer, lr_schedule = _make_optimizer(args, entry)
     trainer = Trainer(
         task,
-        _make_optimizer(args, entry),
+        optimizer,
         mesh,
+        lr_schedule=lr_schedule,
         policy=policy,
         config=TrainerConfig(
             seed=args.seed,

@@ -14,11 +14,13 @@ _REGISTRY: dict[str, dict[str, Any]] = {}
 
 def register(name: str, *, task_factory: Callable, dataset: str,
              dataset_kwargs: dict | None = None, strategy: str = "dp",
-             global_batch_size: int = 32, learning_rate: float = 1e-3):
+             global_batch_size: int = 32, learning_rate: float = 1e-3,
+             lr_schedule: str = "constant", warmup_ratio: float = 0.0):
     _REGISTRY[name] = dict(
         task_factory=task_factory, dataset=dataset,
         dataset_kwargs=dataset_kwargs or {}, strategy=strategy,
         global_batch_size=global_batch_size, learning_rate=learning_rate,
+        lr_schedule=lr_schedule, warmup_ratio=warmup_ratio,
     )
 
 
@@ -50,7 +52,8 @@ def _setup():
              task_factory=lambda: resnet.make_task(
                  resnet.RESNET_PRESETS["resnet50"]),
              dataset="imagenet", strategy="dp", global_batch_size=1024,
-             learning_rate=0.4)
+             learning_rate=0.4, lr_schedule="resnet_steps",
+             warmup_ratio=0.05)
     register("resnet_tiny",
              task_factory=lambda: resnet.make_task(
                  resnet.RESNET_PRESETS["resnet_tiny"],
@@ -63,7 +66,8 @@ def _setup():
              task_factory=lambda: bert.make_task(
                  bert.BERT_PRESETS["bert_base"]),
              dataset="mlm", strategy="dp", global_batch_size=256,
-             learning_rate=1e-4)
+             learning_rate=1e-4, lr_schedule="warmup_linear",
+             warmup_ratio=0.1)
     register("bert_tiny_mlm",
              task_factory=lambda: bert.make_task(
                  bert.BERT_PRESETS["bert_tiny"]),
@@ -75,7 +79,7 @@ def _setup():
              task_factory=lambda: transformer.make_task(
                  transformer.TRANSFORMER_PRESETS["transformer_big"]),
              dataset="wmt", strategy="dp", global_batch_size=512,
-             learning_rate=1e-3)
+             learning_rate=2.0, lr_schedule="noam", warmup_ratio=0.0)
     register("transformer_tiny_wmt",
              task_factory=lambda: transformer.make_task(
                  transformer.TRANSFORMER_PRESETS["transformer_tiny"]),
@@ -87,7 +91,8 @@ def _setup():
              task_factory=lambda: llama.make_task(
                  llama.LLAMA_PRESETS["llama2_7b"]),
              dataset="lm", strategy="dp_tp", global_batch_size=64,
-             learning_rate=2e-5)
+             learning_rate=2e-5, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03)
     # Beyond the reference (it has no MoE): expert-parallel decoder LM.
     register("mixtral_8x7b",
              task_factory=lambda: moe.make_task(

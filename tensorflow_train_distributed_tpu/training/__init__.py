@@ -27,3 +27,4 @@ from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     ProgressLogger,
     TensorBoardScalars,
 )
+from tensorflow_train_distributed_tpu.training import schedules  # noqa: F401

@@ -1,0 +1,116 @@
+"""Learning-rate schedules: the Keras ``LearningRateScheduler`` answer.
+
+The reference schedules LR two ways: a ``LearningRateScheduler`` callback
+mutating ``optimizer.lr`` per epoch (``tf_keras/src/callbacks.py:2250``) and
+per-model conventions in its configs.  In optax, a schedule is a pure
+``step -> lr`` function baked into the optimizer — XLA-compatible (the whole
+fit loop stays one jitted program, no host mutation of hyperparams), so the
+callback becomes a function and this module provides the conventions:
+
+- ``warmup_cosine``  — linear warmup → cosine decay (LLM/SFT convention,
+  reference config[4]).
+- ``warmup_linear``  — linear warmup → linear decay to 0 (BERT convention,
+  reference config[2]).
+- ``noam``           — the Transformer-big convention (Vaswani et al.):
+  d_model^-0.5 · min(step^-0.5, step · warmup^-1.5); reference config[3].
+- ``resnet_steps``   — linear warmup then 10× drops at fractional
+  milestones (the MLPerf/90-epoch ResNet recipe; reference config[1]).
+- ``constant``       — optionally warmed up (reference default).
+
+Every schedule is a plain ``optax.Schedule``; the trainer evaluates it at
+``state.step`` to log ``lr`` alongside loss (the reference logs lr via the
+callback/TensorBoard).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import optax
+
+
+def constant(peak_lr: float, *, warmup_steps: int = 0, **_) -> optax.Schedule:
+    if warmup_steps <= 0:
+        return optax.constant_schedule(peak_lr)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+         optax.constant_schedule(peak_lr)],
+        [warmup_steps],
+    )
+
+
+def warmup_cosine(peak_lr: float, total_steps: int, *,
+                  warmup_steps: int = 0, end_lr_ratio: float = 0.0,
+                  **_) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=peak_lr * end_lr_ratio,
+    )
+
+
+def warmup_linear(peak_lr: float, total_steps: int, *,
+                  warmup_steps: int = 0, **_) -> optax.Schedule:
+    warmup_steps = max(warmup_steps, 1)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+         optax.linear_schedule(
+             peak_lr, 0.0, max(total_steps - warmup_steps, 1))],
+        [warmup_steps],
+    )
+
+
+def noam(peak_lr: float, *, d_model: int = 1024, warmup_steps: int = 4000,
+         **_) -> optax.Schedule:
+    """Transformer-big LR: ``peak_lr`` acts as a multiplier (1.0 = paper)."""
+
+    warmup_steps = max(warmup_steps, 1)
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        s = (step + 1) * 1.0
+        return peak_lr * d_model**-0.5 * jnp.minimum(
+            s**-0.5, s * warmup_steps**-1.5)
+
+    return schedule
+
+
+def resnet_steps(peak_lr: float, total_steps: int, *,
+                 warmup_steps: int = 0,
+                 milestones: Sequence[float] = (0.33, 0.67, 0.89),
+                 decay: float = 0.1, **_) -> optax.Schedule:
+    """Warmup then stepwise 10× drops at fractions of the run (30/60/80-of-90
+    epochs scaled to any ``total_steps``)."""
+    boundaries = {
+        max(int(m * total_steps), warmup_steps + 1): decay
+        for m in milestones
+    }
+    stepped = optax.piecewise_constant_schedule(peak_lr, boundaries)
+    if warmup_steps <= 0:
+        return stepped
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+         lambda s: stepped(s + warmup_steps)],
+        [warmup_steps],
+    )
+
+
+SCHEDULES = {
+    "constant": constant,
+    "warmup_cosine": warmup_cosine,
+    "warmup_linear": warmup_linear,
+    "noam": noam,
+    "resnet_steps": resnet_steps,
+}
+
+
+def by_name(name: str, peak_lr: float, total_steps: int,
+            *, warmup_steps: int = 0,
+            **kwargs) -> optax.Schedule:
+    if name not in SCHEDULES:
+        raise ValueError(
+            f"Unknown schedule {name!r}; available: {sorted(SCHEDULES)}")
+    return SCHEDULES[name](
+        peak_lr, total_steps=total_steps, warmup_steps=warmup_steps, **kwargs)

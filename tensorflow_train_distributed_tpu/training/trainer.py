@@ -87,9 +87,14 @@ class Trainer:
         config: TrainerConfig = TrainerConfig(),
         callbacks: Sequence[Callback] = (),
         checkpoint_manager=None,
+        lr_schedule=None,
     ):
         self.task = task
         self.tx = optimizer
+        # Optional step->lr fn (training.schedules); purely observational —
+        # the optimizer already owns the schedule — so `lr` shows up in
+        # metrics/TensorBoard like the reference's LearningRateScheduler logs.
+        self.lr_schedule = lr_schedule
         self.mesh = mesh
         self.rules = rules
         self.policy = policy
@@ -193,6 +198,9 @@ class Trainer:
             new_ls = None
 
         metrics = dict(metrics, loss=loss)
+        if self.lr_schedule is not None:
+            metrics["lr"] = jnp.asarray(self.lr_schedule(state.step),
+                                        jnp.float32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

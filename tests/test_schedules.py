@@ -1,0 +1,91 @@
+"""LR schedule tests (Keras LearningRateScheduler parity)."""
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.training import schedules
+
+
+class TestShapes:
+    def test_constant_with_warmup(self):
+        s = schedules.constant(0.1, warmup_steps=10)
+        assert float(s(0)) == 0.0
+        assert float(s(10)) == pytest.approx(0.1)
+        assert float(s(1000)) == pytest.approx(0.1)
+
+    def test_warmup_cosine_decays_to_end(self):
+        s = schedules.warmup_cosine(1.0, 100, warmup_steps=10,
+                                    end_lr_ratio=0.1)
+        assert float(s(10)) == pytest.approx(1.0, abs=1e-6)
+        assert float(s(100)) == pytest.approx(0.1, abs=1e-6)
+        # monotone decay after warmup
+        vals = [float(s(t)) for t in range(10, 101, 10)]
+        assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+    def test_warmup_linear_hits_zero(self):
+        s = schedules.warmup_linear(2.0, 50, warmup_steps=5)
+        assert float(s(5)) == pytest.approx(2.0)
+        assert float(s(50)) == pytest.approx(0.0, abs=1e-7)
+
+    def test_noam_peaks_at_warmup(self):
+        s = schedules.noam(1.0, d_model=512, warmup_steps=400)
+        vals = np.array([float(s(t)) for t in range(0, 2000, 50)])
+        peak_idx = int(vals.argmax())
+        # Peak at the warmup boundary (step ≈ 400 → index 8).
+        assert abs(peak_idx - 8) <= 1
+        assert float(s(399)) == pytest.approx(
+            512**-0.5 * 400**-0.5, rel=1e-4)
+
+    def test_resnet_steps_drops_10x(self):
+        s = schedules.resnet_steps(0.4, 1000, warmup_steps=50)
+        assert float(s(50)) == pytest.approx(0.4)
+        assert float(s(400)) == pytest.approx(0.04)   # after 0.33 boundary
+        assert float(s(700)) == pytest.approx(0.004)  # after 0.67
+        assert float(s(950)) == pytest.approx(0.0004)
+
+    def test_by_name_unknown_raises(self):
+        with pytest.raises(ValueError, match="Unknown schedule"):
+            schedules.by_name("nope", 0.1, 100)
+
+
+class TestTrainerIntegration:
+    def test_lr_logged_in_metrics(self, mesh8):
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import lenet
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            shard_batch,
+        )
+        from tensorflow_train_distributed_tpu.training import (
+            Trainer, TrainerConfig,
+        )
+
+        sched = schedules.warmup_cosine(1e-3, 20, warmup_steps=5)
+        task = lenet.make_task()
+        trainer = Trainer(task, optax.adam(sched), mesh8,
+                          config=TrainerConfig(log_every=1),
+                          lr_schedule=sched)
+        rng = np.random.default_rng(0)
+        batch = {
+            "image": rng.standard_normal((8, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, 8).astype(np.int32),
+        }
+        state = trainer.create_state(batch)
+        step = trainer._compiled_train_step()
+        state, metrics = step(state, shard_batch(mesh8, batch))
+        assert float(metrics["lr"]) == pytest.approx(float(sched(0)))
+
+    def test_launcher_uses_config_schedule(self):
+        from tensorflow_train_distributed_tpu.launch import (
+            _make_optimizer, build_parser,
+        )
+
+        args = build_parser().parse_args(
+            ["--config=resnet50_imagenet", "--steps=1000"])
+        from tensorflow_train_distributed_tpu.models import registry
+
+        _, sched = _make_optimizer(args, registry.get_entry(args.config))
+        # resnet_steps with warmup_ratio 0.05 → warmup 50 steps.
+        assert float(sched(0)) == pytest.approx(0.0, abs=1e-6)
+        assert float(sched(50)) == pytest.approx(0.4)
+        assert float(sched(400)) == pytest.approx(0.04)
