@@ -11,6 +11,10 @@ from tensorflow_train_distributed_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
     multihead_attention_kernel,
 )
+from tensorflow_train_distributed_tpu.ops.pallas_kernels import (  # noqa: F401
+    fused_cross_entropy,
+    rms_norm,
+)
 from tensorflow_train_distributed_tpu.ops.embedding import (  # noqa: F401
     EmbeddingCollection,
     FeatureSpec,

@@ -14,6 +14,18 @@ import jax.numpy as jnp
 import optax
 
 
+def _fused_ce_usable() -> bool:
+    """Fused pallas CE on TPU — except under tensor parallelism, where
+    logits are vocab-sharded and the GSPMD jnp path keeps the logsumexp
+    sharded (one pallas_call would gather full logits per device)."""
+    if jax.default_backend() != "tpu":
+        return False
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty and mesh.shape.get("tensor", 1) > 1:
+        return False
+    return True
+
+
 def softmax_cross_entropy(
     logits: jax.Array,
     labels: jax.Array,
@@ -35,6 +47,15 @@ def softmax_cross_entropy(
             label_smoothing,
         )
         per_example = optax.softmax_cross_entropy(logits, onehot)
+    elif _fused_ce_usable():
+        # Pallas fused CE: streams vocab blocks through VMEM instead of
+        # materializing an f32 [tokens, vocab] log-softmax in HBM — the
+        # dominant activation at LM scale (ops.pallas_kernels docstring).
+        from tensorflow_train_distributed_tpu.ops.pallas_kernels import (
+            fused_cross_entropy,
+        )
+
+        per_example = fused_cross_entropy(logits, labels)
     else:
         per_example = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels)

@@ -1,0 +1,304 @@
+"""Hand-rolled pallas TPU kernels: fused RMSNorm and fused softmax-CE.
+
+The reference's hot ops live in cuDNN/cuBLAS; its framework code never hand-
+writes kernels.  TPU-first, the two ops worth owning beyond attention are:
+
+- **RMSNorm** (Llama-family norm, run 2×/layer): fusing square-mean,
+  rsqrt and the scale multiply into one VMEM pass removes two HBM round
+  trips of the [tokens, d_model] activation that unfused XLA sometimes
+  leaves behind around the f32 upcast.  Custom VJP keeps the backward to
+  one kernel + one einsum (dscale), saving the re-normalization recompute.
+- **Softmax cross-entropy** over large vocab (the LM loss): the jnp path
+  materializes an f32 [tokens, vocab] log-softmax (and its transpose flow
+  in backward) in HBM — at Llama scale (8k tokens × 32k vocab × 4B ≈ 1 GB)
+  that dwarfs the model's activations.  The fused kernel streams vocab
+  blocks through VMEM with an online (max, sumexp) accumulator — flash
+  attention's trick applied to the loss — and the backward recomputes
+  softmax blockwise from the saved logsumexp, so HBM cost is the logits
+  themselves and [tokens]-sized residuals.
+
+Both have pure-jax references (the CPU path and the numerics oracle) and
+run in interpreter mode in tests (``interpret=True``); kernel layout
+follows ``/opt/skills/guides/pallas_guide.md`` (f32 accumulation, 128-lane
+blocks, grid innermost over the reduction axis).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # big finite negative: avoids -inf − -inf = NaN in masking
+
+
+def _use_pallas(override: Optional[bool]) -> bool:
+    if override is not None:
+        return override
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm
+# ---------------------------------------------------------------------------
+
+
+def rms_norm_reference(x, scale, *, epsilon=1e-5):
+    """Pure-jax oracle (matches ``models.layers.RMSNorm`` numerics)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + epsilon)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rmsnorm_fwd_kernel(x_ref, s_ref, y_ref, r_ref, *, epsilon):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + epsilon)
+    y_ref[:] = (x * r * s_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    r_ref[:] = r
+
+
+def _rmsnorm_bwd_kernel(x_ref, s_ref, r_ref, g_ref, dx_ref):
+    # y = x·r·s with r = rsqrt(mean x² + eps):
+    #   dx = r·(g·s) − x · r³ · mean((g·s)·x)
+    x = x_ref[:].astype(jnp.float32)
+    gs = g_ref[:].astype(jnp.float32) * s_ref[:].astype(jnp.float32)
+    r = r_ref[:]
+    c = jnp.mean(gs * x, axis=-1, keepdims=True)
+    dx = r * gs - x * (r * r * r) * c
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _rmsnorm_rows(n_rows: int) -> int:
+    return min(256, max(8, n_rows))
+
+
+def _rmsnorm_fwd_call(x2, s2, *, epsilon, interpret):
+    n, d = x2.shape
+    bn = _rmsnorm_rows(n)
+    return pl.pallas_call(
+        functools.partial(_rmsnorm_fwd_kernel, epsilon=epsilon),
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x2.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, s2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm_pallas(x2, s2, epsilon, interpret):
+    y, _ = _rmsnorm_fwd_call(x2, s2, epsilon=epsilon, interpret=interpret)
+    return y
+
+
+def _rms_norm_pallas_fwd(x2, s2, epsilon, interpret):
+    y, r = _rmsnorm_fwd_call(x2, s2, epsilon=epsilon, interpret=interpret)
+    return y, (x2, s2, r)
+
+
+def _rms_norm_pallas_bwd(epsilon, interpret, res, g):
+    x2, s2, r = res
+    n, d = x2.shape
+    bn = _rmsnorm_rows(n)
+    dx = pl.pallas_call(
+        _rmsnorm_bwd_kernel,
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=interpret,
+    )(x2, s2, r, g)
+    # dscale_j = Σ_rows g_ij · x_ij · r_i — one dense reduction; XLA emits
+    # the optimal column-sum, no kernel needed.
+    ds = jnp.einsum(
+        "nd,nd->d",
+        g.astype(jnp.float32),
+        x2.astype(jnp.float32) * r,
+    ).astype(s2.dtype)
+    return dx, ds[None, :]
+
+
+_rms_norm_pallas.defvjp(_rms_norm_pallas_fwd, _rms_norm_pallas_bwd)
+
+
+def rms_norm(x, scale, *, epsilon: float = 1e-5,
+             use_pallas: Optional[bool] = None,
+             interpret: bool = False):
+    """Fused RMSNorm. ``x``: [..., D]; ``scale``: [D]."""
+    if not _use_pallas(use_pallas):
+        return rms_norm_reference(x, scale, epsilon=epsilon)
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d)
+    y = _rms_norm_pallas(x2, scale.reshape(1, d), epsilon, interpret)
+    return y.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax cross-entropy (integer labels)
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy_reference(logits, labels):
+    """Per-example CE via the standard log-softmax (the memory-hungry path)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return lse - ll
+
+
+def _ce_block_cols(v: int) -> int:
+    return min(2048, max(128, v))
+
+
+def _ce_fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref,
+                   m_ref, l_ref, ll_ref, *, vocab, block_v):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        ll_ref[:] = jnp.zeros_like(ll_ref)
+
+    block = logits_ref[:].astype(jnp.float32)
+    bn, bv = block.shape
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    block = jnp.where(cols < vocab, block, _NEG)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(block, axis=-1, keepdims=True))
+    l_ref[:] = (l_ref[:] * jnp.exp(m_prev - m_new)
+                + jnp.sum(jnp.exp(block - m_new), axis=-1, keepdims=True))
+    m_ref[:] = m_new
+    hit = cols == labels_ref[:]
+    ll_ref[:] += jnp.sum(jnp.where(hit, block, 0.0), axis=-1, keepdims=True)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        lse = m_ref[:] + jnp.log(l_ref[:])
+        lse_ref[:] = lse
+        loss_ref[:] = lse - ll_ref[:]
+
+
+def _ce_bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref,
+                   *, vocab, block_v):
+    j = pl.program_id(1)
+    block = logits_ref[:].astype(jnp.float32)
+    bn, bv = block.shape
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    p = jnp.exp(block - lse_ref[:])
+    hit = (cols == labels_ref[:]).astype(jnp.float32)
+    d = (p - hit) * g_ref[:]
+    dlogits_ref[:] = jnp.where(
+        cols < vocab, d, 0.0).astype(dlogits_ref.dtype)
+
+
+def _ce_specs(n, v, bn, bv):
+    return dict(
+        grid=(pl.cdiv(n, bn), pl.cdiv(v, bv)),
+        in_specs=[
+            pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+    )
+
+
+def _ce_rows(n: int) -> int:
+    return min(256, max(8, n))
+
+
+def _ce_fwd(logits, labels2, *, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, v = logits.shape
+    bn, bv = _ce_rows(n), _ce_block_cols(v)
+    sp = _ce_specs(n, v, bn, bv)
+    loss, lse = pl.pallas_call(
+        functools.partial(_ce_fwd_kernel, vocab=v, block_v=bv),
+        grid=sp["grid"],
+        in_specs=sp["in_specs"],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, 1), jnp.float32),
+            pltpu.VMEM((bn, 1), jnp.float32),
+            pltpu.VMEM((bn, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, labels2)
+    return loss, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _cross_entropy_pallas(logits, labels2, interpret):
+    loss, _ = _ce_fwd(logits, labels2, interpret=interpret)
+    return loss[:, 0]
+
+
+def _cross_entropy_pallas_fwd(logits, labels2, interpret):
+    loss, lse = _ce_fwd(logits, labels2, interpret=interpret)
+    return loss[:, 0], (logits, labels2, lse)
+
+
+def _cross_entropy_pallas_bwd(interpret, res, g):
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    logits, labels2, lse = res
+    n, v = logits.shape
+    bn, bv = _ce_rows(n), _ce_block_cols(v)
+    sp = _ce_specs(n, v, bn, bv)
+    dlogits = pl.pallas_call(
+        functools.partial(_ce_bwd_kernel, vocab=v, block_v=bv),
+        grid=sp["grid"],
+        in_specs=sp["in_specs"] + [
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n, v), logits.dtype),
+        interpret=interpret,
+    )(logits, labels2, lse, g[:, None].astype(jnp.float32))
+    return dlogits, None
+
+
+_cross_entropy_pallas.defvjp(_cross_entropy_pallas_fwd,
+                             _cross_entropy_pallas_bwd)
+
+
+def fused_cross_entropy(logits, labels, *,
+                        use_pallas: Optional[bool] = None,
+                        interpret: bool = False):
+    """Per-example softmax CE with integer labels, never materializing
+    softmax in HBM.  ``logits``: [..., V]; ``labels``: int [...]."""
+    if not _use_pallas(use_pallas):
+        return cross_entropy_reference(logits, labels)
+    v = logits.shape[-1]
+    flat = logits.reshape(-1, v)
+    lab = labels.reshape(-1, 1).astype(jnp.int32)
+    out = _cross_entropy_pallas(flat, lab, interpret)
+    return out.reshape(labels.shape)
