@@ -127,6 +127,40 @@ class HostDataLoader:
             yield order[: n_batches * self.host_batch_size]
             epoch += 1
 
+    def iter_from(self, global_step: int) -> Iterator[dict[str, np.ndarray]]:
+        """Iterator positioned after ``global_step`` optimizer steps.
+
+        The reference's mid-epoch resume (``BackupAndRestore``,
+        ``tf_keras/src/callbacks.py:1755``) checkpoints iterator state; here
+        the loader is deterministic by construction — a seeded per-epoch
+        permutation — so "iterator state" is just (epoch, offset) index
+        math, identical on every host, with nothing to serialize beyond the
+        step already in the train state.
+        """
+        spe = self.steps_per_epoch()
+        if spe == 0:
+            return iter(())
+        epoch, offset = divmod(global_step, spe)
+        if self.config.num_epochs is not None and epoch >= self.config.num_epochs:
+            return iter(())
+
+        def _resumed():
+            first = True
+            e = epoch
+            while self.config.num_epochs is None or e < self.config.num_epochs:
+                order = self._epoch_order(e)[: spe * self.host_batch_size]
+                start = offset * self.host_batch_size if first else 0
+                first = False
+                for b in range(start // self.host_batch_size, spe):
+                    idx = order[b * self.host_batch_size:
+                                (b + 1) * self.host_batch_size]
+                    records = [self.source[int(i)] for i in idx]
+                    yield {k: np.stack([r[k] for r in records])
+                           for k in records[0]}
+                e += 1
+
+        return _resumed()
+
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         if self.config.use_native:
             from tensorflow_train_distributed_tpu.native.staging import (

@@ -303,8 +303,13 @@ def run(args: argparse.Namespace) -> RunResult:
                 remaining, k, rounded)
             remaining = rounded
         if remaining > 0:
+            # Mid-epoch resume: position the data stream after the restored
+            # step so no examples repeat or skip (BackupAndRestore parity).
+            batches = (loader.iter_from(int(state.step))
+                       if state is not None and int(state.step) > 0
+                       else loader)
             state = trainer.fit(
-                loader, steps=remaining, state=state,
+                batches, steps=remaining, state=state,
                 steps_per_epoch=loader.steps_per_epoch(),
             )
         else:

@@ -122,3 +122,31 @@ class TestPrefetch:
         with pytest.raises(RuntimeError, match="source died"):
             for _ in it:
                 pass
+
+
+class TestMidEpochResume:
+    """iter_from: BackupAndRestore-style mid-run data positioning."""
+
+    def _loader(self, **kw):
+        cfg = DataConfig(global_batch_size=8, seed=3, **kw)
+        return HostDataLoader(SyntheticBlobs(num_examples=64), cfg)
+
+    def test_iter_from_zero_matches_fresh_stream(self):
+        a = [b["x"] for _, b in zip(range(10), iter(self._loader()))]
+        b = [b["x"] for _, b in zip(range(10), self._loader().iter_from(0))]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    @pytest.mark.parametrize("k", [3, 8, 13])  # mid-epoch, boundary, epoch 2
+    def test_iter_from_k_skips_exactly_k(self, k):
+        full = [b["x"] for _, b in zip(range(20), iter(self._loader()))]
+        resumed = [b["x"] for _, b in zip(range(20 - k),
+                                          self._loader().iter_from(k))]
+        assert len(resumed) == 20 - k
+        for x, y in zip(full[k:], resumed):
+            np.testing.assert_array_equal(x, y)
+
+    def test_iter_from_past_end_is_empty(self):
+        loader = self._loader(num_epochs=2)  # 8 steps/epoch → 16 steps total
+        assert list(loader.iter_from(16)) == []
+        assert len(list(loader.iter_from(15))) == 1
