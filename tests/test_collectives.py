@@ -95,3 +95,20 @@ class TestBusBandwidth:
         assert r["devices"] == 8
         assert r["bus_bandwidth_gbps"] > 0
         assert r["message_bytes"] >= 1e6
+
+
+class TestBenchAllreduceTool:
+    def test_device_json_line(self, capsys):
+        import json
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            import bench_allreduce
+        finally:
+            sys.path.pop(0)
+        rc = bench_allreduce.main(["--size-mb", "1", "--iters", "2"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["metric"] == "allreduce_bus_bandwidth_device"
+        assert out["value"] > 0
+        assert out["devices"] == 8

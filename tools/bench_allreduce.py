@@ -1,0 +1,137 @@
+"""Allreduce bus-bandwidth microbenchmark (a driver headline metric).
+
+Measures the two collective paths the framework owns:
+
+- **device**: XLA allreduce over the mesh's data axis (ICI on TPU) via
+  ``parallel.collectives.allreduce_bus_bandwidth`` — the TPU-native
+  equivalent of the reference's NCCL allreduce benchmark (NCCL busBW
+  convention: ``2(k-1)/k · bytes/time``), directly comparable to
+  ``nccl-tests`` numbers.
+- **host** (``--host``): the native C++ TCP ring (``native/ringcoll``) over
+  N localhost processes — the DCN/host-side fallback path.
+
+Prints one JSON line per measurement, driver-style.
+
+Usage::
+
+    python tools/bench_allreduce.py                  # device path, real mesh
+    python tools/bench_allreduce.py --size-mb 256
+    python tools/bench_allreduce.py --host --world 4
+    python tools/bench_allreduce.py --platform cpu --cpu-devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_device(size_mb: float, iters: int) -> dict:
+    import jax
+
+    from tensorflow_train_distributed_tpu.parallel import collectives
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    r = collectives.allreduce_bus_bandwidth(mesh, "data", size_mb=size_mb,
+                                            iters=iters)
+    return {
+        "metric": "allreduce_bus_bandwidth_device",
+        "value": round(r["bus_bandwidth_gbps"], 3),
+        "unit": "GB/s",
+        "devices": r["devices"],
+        "message_bytes": r["message_bytes"],
+        "backend": jax.default_backend(),
+    }
+
+
+def _host_worker(rank: int, world: int, peers: list[str], size_mb: float,
+                 iters: int, q) -> None:
+    import time
+
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
+
+    n = int(size_mb * 1e6 / 4)
+    ring = HostRing(rank, peers, timeout_ms=20_000)
+    x = np.ones(n, np.float32)
+    ring.allreduce(x)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ring.allreduce(x)
+    dt = (time.perf_counter() - t0) / iters
+    ring.close()
+    if rank == 0:
+        bus = 2 * (world - 1) / world * n * 4 / dt
+        q.put({"time_s": dt, "bus_gbps": bus / 1e9})
+
+
+def bench_host(world: int, size_mb: float, iters: int) -> dict:
+    import multiprocessing as mp
+
+    from tensorflow_train_distributed_tpu.testing.multiprocess import (
+        free_ports,
+    )
+
+    peers = [f"127.0.0.1:{p}" for p in free_ports(world)]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_host_worker,
+                    args=(r, world, peers, size_mb, iters, q))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    result = q.get(timeout=120)
+    for p in procs:
+        p.join(timeout=30)
+    return {
+        "metric": "allreduce_bus_bandwidth_host_ring",
+        "value": round(result["bus_gbps"], 3),
+        "unit": "GB/s",
+        "devices": world,
+        "message_bytes": int(size_mb * 1e6),
+        "backend": "tcp_ring",
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--host", action="store_true",
+                   help="benchmark the native TCP ring instead of the "
+                        "device mesh")
+    p.add_argument("--world", type=int, default=4,
+                   help="with --host: number of ring processes")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--cpu-devices", type=int, default=None)
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if args.cpu_devices:
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    if args.host:
+        out = bench_host(args.world, args.size_mb, args.iters)
+    else:
+        out = bench_device(args.size_mb, args.iters)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
