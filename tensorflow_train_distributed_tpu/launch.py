@@ -172,12 +172,12 @@ def run(args: argparse.Namespace) -> RunResult:
     """Build the full stack from parsed flags and train."""
     import jax
 
-    # Backend override must land before any device API touches the backend
-    # (env vars are too late under launchers that pre-import jax).
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-    if args.cpu_devices:
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.platform or args.cpu_devices:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform, args.cpu_devices)
 
     from tensorflow_train_distributed_tpu.data.datasets import get_dataset
     from tensorflow_train_distributed_tpu.data.pipeline import (

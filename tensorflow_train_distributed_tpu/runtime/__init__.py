@@ -13,5 +13,6 @@ from tensorflow_train_distributed_tpu.runtime.distributed import (  # noqa: F401
 from tensorflow_train_distributed_tpu.runtime.mesh import (  # noqa: F401
     MeshConfig,
     build_mesh,
+    force_platform,
     strategy_preset,
 )

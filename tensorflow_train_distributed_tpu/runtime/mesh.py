@@ -108,6 +108,27 @@ STRATEGY_PRESETS: dict[str, MeshConfig] = {
 }
 
 
+def force_platform(platform: Optional[str] = None,
+                   num_cpu_devices: Optional[int] = None) -> None:
+    """Re-target the JAX backend, even if one is already initialized.
+
+    Plain ``jax.config.update`` is silently ignored (``jax_platforms``) or
+    rejected (``jax_num_cpu_devices``) once a backend exists — which it
+    always does under launchers whose sitecustomize imports jax at
+    interpreter startup.  Resetting via ``clear_backends`` first makes the
+    override effective regardless of initialization order (the late-bound
+    analog of the reference's logical-device split in
+    ``tensorflow/python/distribute/test_util.py:131``).
+    """
+    from jax.extend import backend as jax_backend
+
+    jax_backend.clear_backends()
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if num_cpu_devices:
+        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+
+
 def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:
     """Look up a preset, shrinking fixed axes to fit small device counts.
 

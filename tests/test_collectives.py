@@ -100,12 +100,16 @@ class TestBusBandwidth:
 class TestBenchAllreduceTool:
     def test_device_json_line(self, capsys):
         import json
+        import os
         import sys
-        sys.path.insert(0, "tools")
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        sys.path.insert(0, tools_dir)
         try:
             import bench_allreduce
         finally:
-            sys.path.pop(0)
+            sys.path.remove(tools_dir)
         rc = bench_allreduce.main(["--size-mb", "1", "--iters", "2"])
         assert rc == 0
         out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])

@@ -75,6 +75,7 @@ def _host_worker(rank: int, world: int, peers: list[str], size_mb: float,
 
 def bench_host(world: int, size_mb: float, iters: int) -> dict:
     import multiprocessing as mp
+    import queue as queue_mod
 
     from tensorflow_train_distributed_tpu.testing.multiprocess import (
         free_ports,
@@ -90,9 +91,35 @@ def bench_host(world: int, size_mb: float, iters: int) -> dict:
     ]
     for p in procs:
         p.start()
-    result = q.get(timeout=120)
-    for p in procs:
-        p.join(timeout=30)
+    try:
+        import time
+
+        deadline = time.monotonic() + 120
+        result = None
+        while result is None:
+            try:
+                result = q.get(timeout=2)
+            except queue_mod.Empty:
+                failed = {p.name: p.exitcode for p in procs if p.exitcode}
+                if failed:
+                    raise RuntimeError(
+                        "ring workers exited nonzero before producing a "
+                        f"result (e.g. a port race on setup): {failed}"
+                    ) from None
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "host ring benchmark timed out after 120 s with no "
+                        "result and no worker failure") from None
+        for p in procs:
+            p.join(timeout=30)
+        failed = {p.name: p.exitcode for p in procs if p.exitcode}
+        if failed:
+            raise RuntimeError(f"ring workers exited nonzero: {failed}")
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=5)
     return {
         "metric": "allreduce_bus_bandwidth_host_ring",
         "value": round(result["bus_gbps"], 3),
@@ -116,14 +143,12 @@ def main(argv=None) -> int:
     p.add_argument("--cpu-devices", type=int, default=None)
     args = p.parse_args(argv)
 
-    if args.platform:
-        import jax
+    if args.platform or args.cpu_devices:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
 
-        jax.config.update("jax_platforms", args.platform)
-    if args.cpu_devices:
-        import jax
-
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        force_platform(args.platform, args.cpu_devices)
 
     if args.host:
         out = bench_host(args.world, args.size_mb, args.iters)
