@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optimizer steps fused into one dispatch via an "
                         "inner scan (reference Model.fit arg of the same "
                         "name)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per optimizer step (gradient "
+                        "accumulation; reference analog: Horovod "
+                        "backward_passes_per_step)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval-steps", type=int, default=0,
@@ -274,6 +278,7 @@ def run(args: argparse.Namespace) -> RunResult:
         config=TrainerConfig(
             seed=args.seed,
             steps_per_execution=args.steps_per_execution,
+            grad_accum=args.grad_accum,
             log_every=args.log_every,
             checkpoint_every=args.checkpoint_every,
         ),

@@ -125,7 +125,20 @@ class BertMlmTask:
         ).astype(jnp.float32)
         loss, acc = softmax_cross_entropy(
             logits, batch["labels"], weights=batch["mask_weights"])
-        return loss, ({"mlm_accuracy": acc}, model_state)
+        # loss_weight: Task contract — lets gradient accumulation combine
+        # microbatches as the true masked-token-weighted global mean.
+        # Clamped exactly like the loss denominator in softmax_cross_entropy
+        # so weighted recombination inverts the same normalization.
+        w_total = jnp.maximum(
+            batch["mask_weights"].astype(jnp.float32).sum(), 1.0)
+        return loss, ({"mlm_accuracy": acc, "loss_weight": w_total},
+                      model_state)
+
+    def predict_fn(self, params, model_state, batch):
+        """MLM logits (Trainer.predict contract)."""
+        del model_state
+        return self.model.apply({"params": params}, batch["input_ids"],
+                                deterministic=True)
 
 
 def make_task(config: BertConfig = BERT_PRESETS["bert_base"]) -> BertMlmTask:

@@ -161,6 +161,11 @@ class CausalLmTask:
         loss, acc = softmax_cross_entropy(logits, batch["targets"])
         return loss, ({"accuracy": acc}, model_state)
 
+    def predict_fn(self, params, model_state, batch):
+        """Next-token logits (Trainer.predict contract)."""
+        del model_state
+        return self.model.apply({"params": params}, batch["tokens"])
+
 
 def make_task(config: LlamaConfig = LLAMA_PRESETS["llama2_7b"]
               ) -> CausalLmTask:

@@ -50,3 +50,8 @@ class VisionTask:
             )
             loss = loss + self.weight_decay * l2
         return loss, ({"accuracy": acc}, new_model_state)
+
+    def predict_fn(self, params, model_state, batch):
+        """Inference logits (Trainer.predict contract)."""
+        return self.model.apply({"params": params, **model_state},
+                                batch["image"], train=False)

@@ -1,0 +1,73 @@
+"""Numerics debug mode: NaN trapping + de-optimized determinism.
+
+SURVEY.md §5.2: the reference has no harness-level race detection — TF core
+makes collective ordering deterministic via ordering tokens
+(``tensorflow/python/distribute/cross_device_utils.py:274``) and leans on
+build-time sanitizers.  XLA serializes collectives by construction, so the
+rebuild's observable debug surface is numerics: trap NaNs at the op that
+produced them (``jax_debug_nans``) and disable XLA's reordering/fusion
+optimizations (``jax_disable_most_optimizations``) so failures localize to
+source ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def debug_mode(*, nan_checks: bool = True,
+               disable_optimizations: bool = False):
+    """Context manager toggling JAX debug config, restoring it on exit.
+
+    ``nan_checks`` re-runs any jitted computation that produced a NaN
+    op-by-op and raises ``FloatingPointError`` at the culprit; expect a
+    large slowdown.  ``disable_optimizations`` additionally turns off most
+    XLA optimizations so op boundaries match source.
+    """
+    updates = {"jax_debug_nans": nan_checks}
+    if disable_optimizations:
+        updates["jax_disable_most_optimizations"] = True
+    # jax.config.values covers flags (jax_disable_most_optimizations) that
+    # have no attribute accessor.
+    saved = {k: jax.config.values[k] for k in updates}
+    try:
+        for k, v in updates.items():
+            jax.config.update(k, v)
+        yield
+    finally:
+        for k, v in saved.items():
+            jax.config.update(k, v)
+
+
+def assert_tree_finite(tree, name: str = "tree") -> None:
+    """Host-side finiteness check over a pytree (params, grads, metrics).
+
+    Raises ``FloatingPointError`` naming every offending leaf path — the
+    post-hoc complement to ``debug_mode``'s in-flight trap, cheap enough to
+    run at checkpoint boundaries.
+    """
+    bad = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if not np.isfinite(arr).all():
+            n_bad = int((~np.isfinite(arr)).sum())
+            bad.append(f"{jax.tree_util.keystr(path)}: {n_bad}/{arr.size} "
+                       "non-finite")
+    if bad:
+        raise FloatingPointError(
+            f"{name} has non-finite values:\n  " + "\n  ".join(bad))
+
+
+def is_finite_scalar(value) -> bool:
+    """True for finite floats/ints; False for NaN/inf (metric guard)."""
+    try:
+        return math.isfinite(float(value))
+    except (TypeError, ValueError):
+        return True

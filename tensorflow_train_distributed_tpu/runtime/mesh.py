@@ -122,6 +122,11 @@ def force_platform(platform: Optional[str] = None,
     """
     from jax.extend import backend as jax_backend
 
+    if num_cpu_devices and not platform:
+        # A device-count override only means anything on the CPU backend;
+        # without this the flag would silently no-op under a pinned
+        # non-CPU platform.
+        platform = "cpu"
     jax_backend.clear_backends()
     if platform:
         jax.config.update("jax_platforms", platform)

@@ -26,5 +26,6 @@ from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     JsonlLogger,
     ProgressLogger,
     TensorBoardScalars,
+    TerminateOnNaN,
 )
 from tensorflow_train_distributed_tpu.training import schedules  # noqa: F401

@@ -196,6 +196,29 @@ class EarlyStopping(Callback):
             return True
 
 
+class TerminateOnNaN(Callback):
+    """Stop training when a monitored metric goes non-finite (Keras
+    ``TerminateOnNaN`` analog, ``tf_keras/src/callbacks.py``)."""
+
+    def __init__(self, monitor: str = "loss"):
+        self.monitor = monitor
+
+    def on_step_end(self, step, metrics):
+        from tensorflow_train_distributed_tpu.runtime.debug import (
+            is_finite_scalar,
+        )
+
+        if self.monitor in metrics and not is_finite_scalar(
+                metrics[self.monitor]):
+            logger.error("TerminateOnNaN: step %d %s=%r — stopping", step,
+                         self.monitor, metrics[self.monitor])
+            # Veto further checkpoint writes: the state is poisoned and must
+            # not overwrite retained good saves.
+            if getattr(self, "trainer", None) is not None:
+                self.trainer.state_poisoned = True
+            return True
+
+
 class TensorBoardScalars(Callback):
     """Write scalars to TensorBoard event files via flax's writer.
 

@@ -56,6 +56,12 @@ class Task(Protocol):
     mean over the *global* batch — that is the contract that makes GSPMD
     insert the cross-replica gradient reduction (the reference's
     ``all_reduce_sum_gradients``).
+
+    Tasks whose loss is a *weighted* mean (e.g. MLM loss over masked tokens)
+    must report the total weight as ``metrics["loss_weight"]`` — gradient
+    accumulation uses it to combine microbatches as the true global weighted
+    mean instead of a uniform average.  Optional ``predict_fn(params,
+    model_state, batch)`` enables ``Trainer.predict``.
     """
 
     def init_variables(self, rng: jax.Array, batch) -> Any: ...
@@ -68,6 +74,12 @@ class Task(Protocol):
 class TrainerConfig:
     seed: int = 0
     steps_per_execution: int = 1
+    # Microbatch count for gradient accumulation: each optimizer step splits
+    # the batch into `grad_accum` microbatches and scans over them, so peak
+    # activation memory is one microbatch's worth (reference analog: Horovod
+    # `backward_passes_per_step`, [SPEC] config[3]).  Grads accumulate in
+    # fp32; BN statistics update sequentially per microbatch.
+    grad_accum: int = 1
     log_every: int = 10
     checkpoint_every: Optional[int] = None
     donate_state: bool = True
@@ -103,8 +115,12 @@ class Trainer:
         self.checkpoint_manager = checkpoint_manager
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
         self.state_shardings = None
         self._live_state = None
+        # Guard callbacks (TerminateOnNaN) set this to veto further
+        # checkpoint writes of a numerically-poisoned state.
+        self.state_poisoned = False
 
     # -- state ---------------------------------------------------------------
 
@@ -164,17 +180,74 @@ class Trainer:
 
         return loss_fn
 
-    def _single_step(self, state: TrainState, batch):
-        rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
-        loss_fn = self._make_loss_fn(state.model_state, batch, rng, True)
+    def _microbatch_grads(self, params, model_state, batch, rng, loss_scale):
+        """value_and_grad on one (micro)batch, unscaled; shared by both the
+        direct path and the grad-accumulation scan."""
+        loss_fn = self._make_loss_fn(model_state, batch, rng, True)
 
-        def scaled(params):
-            loss, aux = loss_fn(params)
-            return mp.scale_loss(loss, state.loss_scale), (loss, aux)
+        def scaled(p):
+            loss, aux = loss_fn(p)
+            return mp.scale_loss(loss, loss_scale), (loss, aux)
 
         grad_fn = jax.value_and_grad(scaled, has_aux=True)
-        (_, (loss, (metrics, new_ms))), grads = grad_fn(state.params)
-        grads = mp.unscale_grads(grads, state.loss_scale)
+        (_, (loss, (metrics, new_ms))), grads = grad_fn(params)
+        return mp.unscale_grads(grads, loss_scale), loss, metrics, new_ms
+
+    def _accumulated_grads(self, state: TrainState, batch, rng):
+        """Scan `grad_accum` microbatches, averaging grads in fp32."""
+        from jax.sharding import PartitionSpec as P
+
+        a = self.config.grad_accum
+        bsz = jax.tree.leaves(batch)[0].shape[0]
+        if bsz % a:
+            raise ValueError(
+                f"batch size {bsz} not divisible by grad_accum={a}")
+        # Microbatch axis in front; the global batch axis moves to dim 1, so
+        # re-pin its sharding there (one reshard per step, amortized by the
+        # microbatched compute it enables).
+        spec = P(None, batch_axes(self.mesh))
+        micro = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x.reshape((a, x.shape[0] // a) + x.shape[1:]), spec),
+            batch,
+        )
+
+        def body(carry, xs):
+            ms, acc = carry
+            mb, idx = xs
+            grads, loss, metrics, new_ms = self._microbatch_grads(
+                state.params, ms, mb, jax.random.fold_in(rng, idx),
+                state.loss_scale)
+            # Weighted-mean losses (Task contract): each microbatch's
+            # gradient is d(weighted mean)/dp, so the global gradient is the
+            # weight-weighted mean of microbatch gradients.
+            w = jnp.asarray(metrics.get("loss_weight", 1.0), jnp.float32)
+            acc = jax.tree.map(
+                lambda s, g: s + g.astype(jnp.float32) * w, acc, grads)
+            return (new_ms, acc), (loss, metrics, w)
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        (new_ms, grads), (losses, stacked, ws) = jax.lax.scan(
+            body, (state.model_state, zeros), (micro, jnp.arange(a)))
+        w_total = jnp.sum(ws)
+        grads = jax.tree.map(
+            lambda g, p: (g / w_total).astype(p.dtype), grads, state.params)
+        metrics = jax.tree.map(
+            lambda m: jnp.sum(m * ws, axis=0) / w_total, stacked)
+        if "loss_weight" in metrics:
+            metrics["loss_weight"] = w_total  # total, as one big batch would
+        return grads, jnp.sum(losses * ws) / w_total, metrics, new_ms
+
+    def _single_step(self, state: TrainState, batch):
+        rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
+        if self.config.grad_accum > 1:
+            grads, loss, metrics, new_ms = self._accumulated_grads(
+                state, batch, rng)
+        else:
+            grads, loss, metrics, new_ms = self._microbatch_grads(
+                state.params, state.model_state, batch, rng,
+                state.loss_scale)
 
         if state.loss_scale is not None:
             finite = mp.grads_finite(grads)
@@ -210,32 +283,39 @@ class Trainer:
         )
         return new_state, metrics
 
+    def _jit_step(self, fn, *, donate=()):
+        """jit ``fn(state, batch)`` with the trainer's mesh + logical rules.
+
+        set_mesh must wrap the *call* (it is illegal inside jit): it binds
+        the abstract mesh at trace time so mesh-aware ops (seq-parallel
+        attention) see it regardless of call site.
+        """
+
+        def step(state, batch):
+            with sharding_lib.with_logical_rules(self.mesh, self.rules):
+                return fn(state, batch)
+
+        jitted = jax.jit(step, donate_argnums=donate)
+
+        def call(state, batch):
+            with jax.set_mesh(self.mesh):
+                return jitted(state, batch)
+
+        return call
+
     def _compiled_train_step(self):
         if self._train_step is not None:
             return self._train_step
         k = self.config.steps_per_execution
-        mesh, rules = self.mesh, self.rules
 
         def step(state, batch):
-            with sharding_lib.with_logical_rules(mesh, rules):
-                if k == 1:
-                    return self._single_step(state, batch)
-                new_state, ms = jax.lax.scan(
-                    self._single_step, state, batch
-                )
-                return new_state, jax.tree.map(lambda m: m[-1], ms)
+            if k == 1:
+                return self._single_step(state, batch)
+            new_state, ms = jax.lax.scan(self._single_step, state, batch)
+            return new_state, jax.tree.map(lambda m: m[-1], ms)
 
         donate = (0,) if self.config.donate_state else ()
-        jitted = jax.jit(step, donate_argnums=donate)
-
-        def call(state, batch):
-            # set_mesh must wrap the call (it is illegal inside jit): it
-            # binds the abstract mesh at trace time so mesh-aware ops
-            # (seq-parallel attention) see it regardless of call site.
-            with jax.set_mesh(self.mesh):
-                return jitted(state, batch)
-
-        self._train_step = call
+        self._train_step = self._jit_step(step, donate=donate)
         return self._train_step
 
     def _compiled_eval_step(self):
@@ -243,22 +323,31 @@ class Trainer:
             return self._eval_step
 
         def step(state, batch):
-            with sharding_lib.with_logical_rules(self.mesh, self.rules):
-                rng = jax.random.fold_in(
-                    jax.random.key(self.config.seed + 1), state.step)
-                loss_fn = self._make_loss_fn(state.model_state, batch, rng,
-                                             False)
-                loss, (metrics, _) = loss_fn(state.params)
-                return dict(metrics, loss=loss)
+            rng = jax.random.fold_in(
+                jax.random.key(self.config.seed + 1), state.step)
+            loss_fn = self._make_loss_fn(state.model_state, batch, rng,
+                                         False)
+            loss, (metrics, _) = loss_fn(state.params)
+            return dict(metrics, loss=loss)
 
-        jitted = jax.jit(step)
-
-        def call(state, batch):
-            with jax.set_mesh(self.mesh):
-                return jitted(state, batch)
-
-        self._eval_step = call
+        self._eval_step = self._jit_step(step)
         return self._eval_step
+
+    def _compiled_predict_step(self):
+        if self._predict_step is not None:
+            return self._predict_step
+        if not hasattr(self.task, "predict_fn"):
+            raise NotImplementedError(
+                f"{type(self.task).__name__} has no predict_fn(params, "
+                "model_state, batch); implement it to use Trainer.predict")
+
+        def step(state, batch):
+            p = self.policy.cast_to_compute(state.params)
+            b = self.policy.cast_to_compute(batch)
+            return self.task.predict_fn(p, state.model_state, b)
+
+        self._predict_step = self._jit_step(step)
+        return self._predict_step
 
     # -- loops ---------------------------------------------------------------
 
@@ -335,7 +424,14 @@ class Trainer:
                 pending.append((cur, metrics))
                 if done >= steps:
                     stop = True
-                if len(pending) * k >= self.config.log_every or stop:
+                will_ckpt = (self.checkpoint_manager is not None
+                             and self.config.checkpoint_every
+                             and cur % self.config.checkpoint_every < k)
+                # Flush before a checkpoint too, so guard callbacks
+                # (TerminateOnNaN) see this window's metrics first and a
+                # poisoned state is never written over retained good saves.
+                if (len(pending) * k >= self.config.log_every or stop
+                        or will_ckpt):
                     # One device fetch for the whole pending window.
                     host = jax.device_get([m for _, m in pending])
                     for (s, _), m in zip(pending, host):
@@ -347,19 +443,36 @@ class Trainer:
                        and done >= (epoch + 1) * steps_per_epoch):
                     epoch += 1
                     stop |= self.callbacks.epoch_end(epoch, last_metrics)
-                if (self.checkpoint_manager is not None
-                        and self.config.checkpoint_every
-                        and cur % self.config.checkpoint_every < k):
+                if will_ckpt and not stop and not self.state_poisoned:
                     self.checkpoint_manager.save(cur, state)
                 if stop:
                     break
         finally:
             device_iter.close()
-        if self.checkpoint_manager is not None:
+        if self.checkpoint_manager is not None and not self.state_poisoned:
             self.checkpoint_manager.save(int(state.step), state, force=True)
             self.checkpoint_manager.wait_until_finished()
         self.callbacks.train_end(state)
         return state
+
+    def _forward_loop(self, batches, state, step_fn,
+                      steps: Optional[int]) -> list:
+        """Drive a jitted forward step over prefetched batches, collecting
+        host results (shared by evaluate/predict)."""
+        from tensorflow_train_distributed_tpu.data.pipeline import (
+            prefetch_to_device,
+        )
+
+        results = []
+        device_iter = prefetch_to_device(iter(batches), self.mesh)
+        try:
+            for dev_batch in device_iter:
+                results.append(jax.device_get(step_fn(state, dev_batch)))
+                if steps is not None and len(results) >= steps:
+                    break
+        finally:
+            device_iter.close()
+        return results
 
     def evaluate(
         self,
@@ -368,26 +481,28 @@ class Trainer:
         *,
         steps: Optional[int] = None,
     ) -> dict[str, float]:
-        from tensorflow_train_distributed_tpu.data.pipeline import (
-            prefetch_to_device,
-        )
-
-        step_fn = self._compiled_eval_step()
         acc = MetricAccumulator()
-        n = 0
-        device_iter = prefetch_to_device(iter(batches), self.mesh)
-        try:
-            with jax.set_mesh(self.mesh):
-                for dev_batch in device_iter:
-                    metrics = step_fn(state, dev_batch)
-                    acc.update({k: float(np.asarray(v))
-                                for k, v in metrics.items()})
-                    n += 1
-                    if steps is not None and n >= steps:
-                        break
-        finally:
-            device_iter.close()
+        for metrics in self._forward_loop(
+                batches, state, self._compiled_eval_step(), steps):
+            acc.update({k: float(np.asarray(v)) for k, v in metrics.items()})
         return acc.result()
+
+    def predict(
+        self,
+        batches: Iterable[Mapping[str, np.ndarray]],
+        state: TrainState,
+        *,
+        steps: Optional[int] = None,
+    ):
+        """``Model.predict`` analog (``tf_keras/src/engine/training.py``):
+        run the task's forward pass over ``batches`` and return host numpy
+        outputs concatenated along the batch axis (pytree-valued outputs
+        are concatenated leaf-wise)."""
+        outs = self._forward_loop(
+            batches, state, self._compiled_predict_step(), steps)
+        if not outs:
+            raise ValueError("predict got an empty batch iterator")
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *outs)
 
 
 def _chain_first(first, rest):

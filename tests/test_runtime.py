@@ -156,3 +156,47 @@ class TestMesh:
         arr = jax.device_put(x, sharding)
         assert len(arr.addressable_shards) == 8
         assert arr.addressable_shards[0].data.shape == (4, 1)
+
+
+class TestDebug:
+    def test_debug_mode_toggles_and_restores(self):
+        from tensorflow_train_distributed_tpu.runtime.debug import debug_mode
+
+        key = "jax_disable_most_optimizations"
+        before = jax.config.jax_debug_nans
+        with debug_mode(nan_checks=True, disable_optimizations=True):
+            assert jax.config.jax_debug_nans is True
+            assert jax.config.values[key] is True
+        assert jax.config.jax_debug_nans == before
+        assert jax.config.values[key] is not True
+
+    def test_debug_mode_traps_nan(self):
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.runtime.debug import debug_mode
+
+        with debug_mode(nan_checks=True):
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: jnp.log(x) * 0 + jnp.sqrt(x - 2))(
+                    jnp.float32(1.0)).block_until_ready()
+
+    def test_assert_tree_finite(self):
+        import numpy as np
+
+        from tensorflow_train_distributed_tpu.runtime.debug import (
+            assert_tree_finite,
+        )
+
+        ok = {"a": np.ones(3, np.float32), "n": np.arange(3)}
+        assert_tree_finite(ok, "ok")
+        bad = {"w": {"kernel": np.array([1.0, np.nan], np.float32)}}
+        with pytest.raises(FloatingPointError, match="kernel"):
+            assert_tree_finite(bad, "params")
+
+    def test_terminate_on_nan_callback(self):
+        from tensorflow_train_distributed_tpu.training import TerminateOnNaN
+
+        cb = TerminateOnNaN()
+        assert cb.on_step_end(1, {"loss": 1.0}) is None
+        assert cb.on_step_end(2, {"loss": float("nan")}) is True
+        assert cb.on_step_end(3, {"loss": float("inf")}) is True

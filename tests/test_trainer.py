@@ -57,6 +57,10 @@ class _BlobsTask:
         acc = (logits.argmax(-1) == batch["label"]).mean()
         return loss, ({"accuracy": acc}, model_state)
 
+    def predict_fn(self, params, model_state, batch):
+        del model_state
+        return self.model.apply({"params": params}, batch["x"])
+
 
 def _loader(batch=32, epochs=None, seed=0):
     return HostDataLoader(
@@ -151,6 +155,106 @@ class TestFit:
         metrics = trainer.evaluate(_loader(epochs=1), state, steps=4)
         assert metrics["accuracy"] > 0.8
         assert "loss" in metrics
+
+    def test_predict(self, mesh8):
+        trainer, state, _ = _fit(mesh8, steps=5)
+        out = trainer.predict(_loader(epochs=1), state, steps=3)
+        assert out.shape == (3 * 32, 4)
+        assert np.isfinite(out).all()
+
+    def test_predict_without_predict_fn_raises(self, mesh8):
+        class NoPredict:
+            init_variables = _BlobsTask.init_variables
+            loss_fn = _BlobsTask.loss_fn
+
+        task = NoPredict()
+        task.model = _MLP()
+        trainer = Trainer(task, optax.adam(1e-2), mesh8)
+        with pytest.raises(NotImplementedError, match="predict_fn"):
+            trainer._compiled_predict_step()
+
+
+class TestGradAccum:
+    def test_matches_unaccumulated_numerics(self, mesh8):
+        """grad_accum=4 over the same global batch must match plain steps
+        (the task is deterministic: no dropout/BN, rng unused)."""
+        losses = {}
+        for accum in (1, 4):
+            cfg = TrainerConfig(log_every=1, grad_accum=accum)
+            trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                              config=cfg, callbacks=[hist := History()])
+            trainer.fit(_loader(), steps=10)
+            losses[accum] = hist.history["loss"]
+        # First steps match to fp tolerance; later steps drift only by
+        # compounded reassociation through Adam, not by semantics.
+        np.testing.assert_allclose(losses[1][:2], losses[4][:2], rtol=1e-5)
+        np.testing.assert_allclose(losses[1], losses[4], rtol=1e-2)
+
+    def test_indivisible_batch_raises(self, mesh8):
+        cfg = TrainerConfig(grad_accum=5)
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8, config=cfg)
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.fit(_loader(batch=32), steps=1)
+
+    def test_composes_with_steps_per_execution(self, mesh8):
+        trainer, state, hist = _fit(mesh8, steps=12, steps_per_execution=3,
+                                    grad_accum=2)
+        assert int(state.step) == 12
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_weighted_loss_matches_unaccumulated(self, mesh8):
+        """A loss_weight-reporting task (MLM-style weighted mean) must
+        recombine microbatches as the global weighted mean — uniform
+        averaging would bias toward lightly-weighted microbatches."""
+
+        class WeightedTask(_BlobsTask):
+            def loss_fn(self, params, model_state, batch, rng, train):
+                logits = self.model.apply({"params": params}, batch["x"])
+                # Lopsided per-example weights (data-derived, so they follow
+                # examples into microbatches) so microbatches carry very
+                # different total weight.
+                w = (batch["label"] == 0).astype(jnp.float32) * 9.0 + 1.0
+                per = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), batch["label"])
+                w_total = jnp.maximum(w.sum(), 1.0)
+                loss = (per * w).sum() / w_total
+                return loss, ({"loss_weight": w_total}, model_state)
+
+        losses = {}
+        for accum in (1, 4):
+            cfg = TrainerConfig(log_every=1, grad_accum=accum)
+            trainer = Trainer(WeightedTask(), optax.adam(1e-2), mesh8,
+                              config=cfg, callbacks=[hist := History()])
+            trainer.fit(_loader(), steps=4)
+            losses[accum] = hist.history["loss"]
+        np.testing.assert_allclose(losses[1][:2], losses[4][:2], rtol=1e-5)
+        np.testing.assert_allclose(losses[1], losses[4], rtol=1e-2)
+
+
+class TestTerminateOnNaN:
+    def test_stops_and_vetoes_checkpoints(self, mesh8, tmp_path):
+        """Loss goes NaN → training stops at the next metrics flush and no
+        checkpoint (periodic or final) is written with poisoned state."""
+        from tensorflow_train_distributed_tpu.training import TerminateOnNaN
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        class PoisonTask(_BlobsTask):
+            def loss_fn(self, params, model_state, batch, rng, train):
+                loss, aux = super().loss_fn(params, model_state, batch, rng,
+                                            train)
+                return loss * jnp.nan, aux
+
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        cfg = TrainerConfig(log_every=1, checkpoint_every=2)
+        trainer = Trainer(PoisonTask(), optax.adam(1e-2), mesh8, config=cfg,
+                          callbacks=[TerminateOnNaN()],
+                          checkpoint_manager=ckpt)
+        state = trainer.fit(_loader(), steps=10)
+        assert int(state.step) <= 2
+        assert trainer.state_poisoned
+        assert ckpt.latest_step() is None
 
 
 class TestMixedPrecision:
