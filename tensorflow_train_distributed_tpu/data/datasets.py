@@ -68,9 +68,14 @@ class SyntheticImageNet:
     """224×224×3 images with class-dependent channel statistics (ResNet-50)."""
 
     def __init__(self, num_examples: int = 1_281_167, num_classes: int = 1000,
-                 image_size: int = 224, seed: int = 29):
+                 image_size: int = 224, seed: int = 29,
+                 space_to_depth: bool = False):
         self.n, self.num_classes, self.size, self.seed = (
             num_examples, num_classes, image_size, seed)
+        # Host-side 2x2 space-to-depth (models.resnet.space_to_depth): the
+        # MXU-friendly input layout for the s2d stem, applied before
+        # transfer so the device never sees the 3-channel tensor.
+        self.space_to_depth = space_to_depth
 
     def __len__(self):
         return self.n
@@ -85,6 +90,10 @@ class SyntheticImageNet:
         rep = -(-self.size // 8)  # ceil; crop handles non-multiple-of-8 sizes
         upsampled = np.repeat(np.repeat(basis, rep, axis=0), rep, axis=1)
         img += upsampled[: self.size, : self.size]
+        if self.space_to_depth:
+            s = self.size // 2
+            img = (img.reshape(s, 2, s, 2, 3).transpose(0, 2, 1, 3, 4)
+                   .reshape(s, s, 12))
         return {"image": img, "label": np.int32(label)}
 
 

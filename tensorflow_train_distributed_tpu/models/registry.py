@@ -54,6 +54,15 @@ def _setup():
              dataset="imagenet", strategy="dp", global_batch_size=1024,
              learning_rate=0.4, lr_schedule="resnet_steps",
              warmup_ratio=0.05)
+    # MXU-optimized variant: 2x2 space-to-depth stem (host-side transform
+    # in the dataset, stride-1 4x4 stem conv in the model).
+    register("resnet50_imagenet_s2d",
+             task_factory=lambda: resnet.make_task(
+                 resnet.RESNET_PRESETS["resnet50_s2d"]),
+             dataset="imagenet", dataset_kwargs=dict(space_to_depth=True),
+             strategy="dp", global_batch_size=1024,
+             learning_rate=0.4, lr_schedule="resnet_steps",
+             warmup_ratio=0.05)
     register("resnet_tiny",
              task_factory=lambda: resnet.make_task(
                  resnet.RESNET_PRESETS["resnet_tiny"],

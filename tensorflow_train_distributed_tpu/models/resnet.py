@@ -33,21 +33,63 @@ class ResNetConfig:
     num_classes: int = 1000
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
+    # MLPerf TPU trick: 2x2 space-to-depth on the input ([N,224,224,3] →
+    # [N,112,112,12]) turns the stride-2 7x7 stem conv into an equivalent
+    # stride-1 4x4 conv with 12 input channels — 4x better MXU lane
+    # utilization on the otherwise 3-channel-starved stem (~9% of step
+    # time).  Mathematically identical model family: see
+    # ``stem_kernel_to_s2d`` for the exact 7x7→4x4 kernel bijection.
+    space_to_depth: bool = False
 
 
 RESNET_PRESETS = {
     "resnet18": ResNetConfig(stage_sizes=(2, 2, 2, 2)),
     "resnet50": ResNetConfig(stage_sizes=(3, 4, 6, 3)),
+    "resnet50_s2d": ResNetConfig(stage_sizes=(3, 4, 6, 3),
+                                 space_to_depth=True),
     "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3)),
     "resnet_tiny": ResNetConfig(stage_sizes=(1, 1), num_filters=8,
                                 num_classes=10),
 }
 
 
-def _conv(features, kernel, strides=1, name=None):
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """[N,H,W,C] → [N,H/b,W/b,b·b·C], channel-minor order (du, dv, c).
+
+    Host pipelines should apply this before transfer (it is a pure data
+    rearrangement); the model also applies it on the fly when handed raw
+    3-channel input so both entry points work.
+    """
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"spatial dims {h}x{w} not divisible by {block}")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def stem_kernel_to_s2d(w: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """Map a [7,7,C,F] stem kernel to the equivalent [4,4,b·b·C,F] kernel.
+
+    With SAME padding (pad 3) and stride 2, output pixel i reads input rows
+    2i-3..2i+3; on space-to-depth input those are transformed rows i-2..i+1
+    — a 4-tap window.  Zero-padding the kernel to 8x8 (one leading zero
+    row/col) aligns tap k to (m=du-block offset): k+1 = 2m+du, so the
+    padded kernel reshapes exactly into the 4x4x(b·b·C) layout matching
+    ``space_to_depth``'s channel order.
+    """
+    kh, kw, c, f = w.shape
+    assert kh == 7 and kw == 7 and block == 2, "stem transform is 7x7/b=2"
+    padded = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    padded = padded.reshape(4, 2, 4, 2, c, f)        # (m, du, n, dv, c, f)
+    padded = padded.transpose(0, 2, 1, 3, 4, 5)      # (m, n, du, dv, c, f)
+    return padded.reshape(4, 4, block * block * c, f)
+
+
+def _conv(features, kernel, strides=1, name=None, padding="SAME"):
     return nn.Conv(
         features, (kernel, kernel), strides=(strides, strides),
-        padding="SAME", use_bias=False,
+        padding=padding, use_bias=False,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
             (None, None, "conv_in", "conv_out"),
@@ -95,7 +137,15 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=cfg.bn_momentum, epsilon=cfg.bn_epsilon,
                        dtype=x.dtype)
-        x = _conv(cfg.num_filters, 7, 2, name="stem_conv")(x)
+        if cfg.space_to_depth:
+            if x.shape[-1] == 3:  # raw input: transform on the fly
+                x = space_to_depth(x)
+            # Equivalent stride-1 4x4 stem on s2d input; padding (2,1)
+            # from the tap-window derivation in stem_kernel_to_s2d.
+            x = _conv(cfg.num_filters, 4, 1, name="stem_conv",
+                      padding=((2, 1), (2, 1)))(x)
+        else:
+            x = _conv(cfg.num_filters, 7, 2, name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -115,3 +115,19 @@ def _bind_signatures(lib: ctypes.CDLL) -> None:
     lib.ttd_ring_world.restype = ctypes.c_int
     lib.ttd_ring_destroy.argtypes = [ctypes.c_void_p]
     lib.ttd_ring_destroy.restype = None
+
+    lib.ttd_mesh_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.ttd_mesh_create.restype = ctypes.c_void_p
+    lib.ttd_mesh_allreduce_hd_f32.argtypes = [
+        ctypes.c_void_p, f32p, ctypes.c_uint64]
+    lib.ttd_mesh_allreduce_hd_f32.restype = ctypes.c_int
+    lib.ttd_mesh_allreduce_shuffle_f32.argtypes = [
+        ctypes.c_void_p, f32p, ctypes.c_uint64]
+    lib.ttd_mesh_allreduce_shuffle_f32.restype = ctypes.c_int
+    lib.ttd_mesh_rank.argtypes = [ctypes.c_void_p]
+    lib.ttd_mesh_rank.restype = ctypes.c_int
+    lib.ttd_mesh_world.argtypes = [ctypes.c_void_p]
+    lib.ttd_mesh_world.restype = ctypes.c_int
+    lib.ttd_mesh_destroy.argtypes = [ctypes.c_void_p]
+    lib.ttd_mesh_destroy.restype = None

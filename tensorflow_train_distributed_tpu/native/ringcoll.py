@@ -1,9 +1,14 @@
-"""Python face of the native TCP ring collectives.
+"""Python face of the native TCP host collectives.
 
-Host-side analog of the reference's `RingReducer` (SURVEY.md §2.3): used
-for cross-process host data (metric fan-in, input-pipeline bookkeeping,
-toolchain tests) where pulling the device fabric in would be wrong.  The
-device path never touches this — XLA collectives over ICI/DCN own it.
+Host-side analog of the reference's graph-level allreduce builders
+(SURVEY.md §2.2/§2.3): ``HostRing`` is the ring algorithm
+(``distribute/v1/all_reduce.py`` ``build_ring_all_reduce:250`` /
+``RingReducer``), ``HostMesh`` carries the remaining two —
+recursive halving-doubling (``build_recursive_hd_all_reduce:422``) and
+shuffle (``build_shuffle_all_reduce:554``).  Used for cross-process host
+data (metric fan-in, input-pipeline bookkeeping, toolchain tests) where
+pulling the device fabric in would be wrong.  The device path never
+touches this — XLA collectives over ICI/DCN own it.
 """
 
 from __future__ import annotations
@@ -16,8 +21,15 @@ import numpy as np
 from tensorflow_train_distributed_tpu import native
 
 
-class HostRing:
-    """Blocking ring collectives among ``world`` processes over TCP."""
+class _NativeGroup:
+    """Shared lifecycle for ctypes-backed process groups.
+
+    Subclasses set ``_PREFIX`` (the C symbol prefix); create/destroy/rank/
+    world symbols follow ``<prefix>_create`` etc.
+    """
+
+    _PREFIX = ""
+    _KIND = "group"
 
     def __init__(self, rank: int, peers: Sequence[str], *,
                  timeout_ms: int = 10_000):
@@ -26,37 +38,68 @@ class HostRing:
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
-        self._handle = lib.ttd_ring_create(
+        self._handle = getattr(lib, f"{self._PREFIX}_create")(
             rank, len(peers), ",".join(peers).encode(), timeout_ms)
         if not self._handle:
             raise RuntimeError(
-                f"ring setup failed (rank={rank}, peers={list(peers)})")
+                f"{self._KIND} setup failed (rank={rank}, "
+                f"peers={list(peers)})")
 
     def _require_handle(self):
         # ctypes would pass NULL straight into native code → segfault.
         if not self._handle:
-            raise RuntimeError("HostRing is closed")
+            raise RuntimeError(f"{type(self).__name__} is closed")
         return self._handle
 
     @property
     def rank(self) -> int:
-        return self._lib.ttd_ring_rank(self._require_handle())
+        return getattr(self._lib, f"{self._PREFIX}_rank")(
+            self._require_handle())
 
     @property
     def world(self) -> int:
-        return self._lib.ttd_ring_world(self._require_handle())
+        return getattr(self._lib, f"{self._PREFIX}_world")(
+            self._require_handle())
+
+    def _reduce_f32(self, fn, x: np.ndarray) -> np.ndarray:
+        """Marshal ``x`` to an owned contiguous f32 buffer, reduce in
+        place, reshape back."""
+        self._require_handle()
+        out = np.array(x, dtype=np.float32, order="C")  # always a copy
+        rc = fn(self._handle,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.size)
+        if rc == -2:
+            raise ValueError(
+                f"this algorithm requires a power-of-2 world, got "
+                f"{self.world}; use HostRing")
+        if rc != 0:
+            raise RuntimeError(f"{self._KIND} allreduce failed "
+                               "(peer died?)")
+        return out.reshape(np.shape(x))
+
+    def close(self) -> None:
+        if self._handle:
+            getattr(self._lib, f"{self._PREFIX}_destroy")(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class HostRing(_NativeGroup):
+    """Blocking ring collectives among ``world`` processes over TCP
+    (bandwidth-optimal: 2·(W-1)/W · N bytes on the wire per rank)."""
+
+    _PREFIX = "ttd_ring"
+    _KIND = "ring"
 
     def allreduce(self, x: np.ndarray) -> np.ndarray:
         """Sum-allreduce; returns a new float32 array of ``x``'s shape."""
-        self._require_handle()
-        out = np.ascontiguousarray(x, dtype=np.float32).copy()
-        rc = self._lib.ttd_ring_allreduce_f32(
-            self._handle,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            out.size)
-        if rc != 0:
-            raise RuntimeError("ring allreduce failed (peer died?)")
-        return out.reshape(np.shape(x))
+        return self._reduce_f32(self._lib.ttd_ring_allreduce_f32, x)
 
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
         """Broadcast ``x`` (same shape/dtype everywhere) from ``root``."""
@@ -70,13 +113,24 @@ class HostRing:
             raise RuntimeError("ring broadcast failed (peer died?)")
         return out
 
-    def close(self) -> None:
-        if self._handle:
-            self._lib.ttd_ring_destroy(self._handle)
-            self._handle = None
 
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+class HostMesh(_NativeGroup):
+    """Fully-connected host group: butterfly (recursive halving-doubling)
+    and shuffle allreduce.  HD is latency-optimal (2·log2 W exchanges) for
+    small messages; the ring stays bandwidth-optimal for large ones.
+    Power-of-2 world sizes only — callers fall back to ``HostRing``
+    otherwise.
+    """
+
+    _PREFIX = "ttd_mesh"
+    _KIND = "mesh"
+
+    def allreduce(self, x: np.ndarray, *,
+                  algorithm: str = "hd") -> np.ndarray:
+        """Sum-allreduce; ``algorithm`` is ``"hd"`` or ``"shuffle"``."""
+        fns = {"hd": self._lib.ttd_mesh_allreduce_hd_f32,
+               "shuffle": self._lib.ttd_mesh_allreduce_shuffle_f32}
+        if algorithm not in fns:
+            raise ValueError(f"algorithm must be hd|shuffle, "
+                             f"got {algorithm!r}")
+        return self._reduce_f32(fns[algorithm], x)

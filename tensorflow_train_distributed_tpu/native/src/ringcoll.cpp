@@ -25,6 +25,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <string>
 #include <vector>
 
@@ -175,6 +176,159 @@ class Ring {
   friend Ring* MakeRing(int, int, const std::string&, int);
 };
 
+// Full-duplex exchange over ONE socket (butterfly/mesh links are a single
+// bidirectional connection per partner, unlike the ring's two).
+bool ExchangeFd(int fd, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  while (sn > 0 || rn > 0) {
+    short ev = 0;
+    if (sn > 0) ev |= POLLOUT;
+    if (rn > 0) ev |= POLLIN;
+    pollfd pf{fd, ev, 0};
+    if (::poll(&pf, 1, -1) < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (sn > 0 && (pf.revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = ::send(fd, sp, sn, MSG_NOSIGNAL);
+      if (k < 0 && errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+        return false;
+      if (k > 0) { sp += k; sn -= static_cast<size_t>(k); }
+    }
+    if (rn > 0 && (pf.revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = ::recv(fd, rp, rn, 0);
+      if (k == 0) return false;
+      if (k < 0 && errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+        return false;
+      if (k > 0) { rp += k; rn -= static_cast<size_t>(k); }
+    }
+  }
+  return true;
+}
+
+// Fully-connected host group for the butterfly/shuffle algorithms the
+// reference ships as graph builders (`distribute/v1/all_reduce.py`:
+// `build_recursive_hd_all_reduce:422`, `build_shuffle_all_reduce:554`).
+// One bidirectional TCP connection per peer pair; rank i initiates to all
+// j > i (kernel backlog makes connect-before-accept safe), identifying
+// itself with a 4-byte rank handshake.
+class MeshGroup {
+ public:
+  static MeshGroup* Create(int rank, int world, const std::string& peers,
+                           int timeout_ms);
+
+  ~MeshGroup() {
+    for (int fd : fds_)
+      if (fd >= 0) ::close(fd);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  // Recursive halving-doubling allreduce (Rabenseifner): latency-optimal
+  // 2·log2(W) exchanges. Requires power-of-2 world (callers fall back to
+  // the ring otherwise, like the reference's upfront_shuffle pad).
+  int AllreduceHdF32(float* data, uint64_t n) {
+    if (world_ == 1) return 0;
+    if (world_ & (world_ - 1)) return -2;  // not a power of 2
+    uint64_t lo = 0, hi = n;
+    std::vector<uint64_t> los, his;  // segment stack for the gather phase
+    std::vector<float> inbox(n / 2 + 1);
+    // Reduce-scatter by recursive halving. rank and rank^mask share the
+    // same active segment (it is determined by already-processed bits),
+    // so both compute the same midpoint.
+    for (int mask = 1; mask < world_; mask <<= 1) {
+      los.push_back(lo);
+      his.push_back(hi);
+      const uint64_t mid = lo + (hi - lo) / 2;
+      const int partner_fd = fds_[rank_ ^ mask];
+      uint64_t keep_lo, keep_hi, send_lo, send_hi;
+      if (rank_ & mask) {  // keep upper half
+        keep_lo = mid; keep_hi = hi; send_lo = lo; send_hi = mid;
+      } else {             // keep lower half
+        keep_lo = lo; keep_hi = mid; send_lo = mid; send_hi = hi;
+      }
+      if (!ExchangeFd(partner_fd, data + send_lo, (send_hi - send_lo) * 4,
+                      inbox.data(), (keep_hi - keep_lo) * 4))
+        return -1;
+      float* dst = data + keep_lo;
+      const uint64_t m = keep_hi - keep_lo;
+      for (uint64_t i = 0; i < m; ++i) dst[i] += inbox[i];
+      lo = keep_lo;
+      hi = keep_hi;
+    }
+    // All-gather by recursive doubling (reverse the split stack).
+    for (int mask = world_ >> 1; mask >= 1; mask >>= 1) {
+      const uint64_t plo = los.back(), phi = his.back();
+      los.pop_back();
+      his.pop_back();
+      const uint64_t mid = plo + (phi - plo) / 2;
+      const int partner_fd = fds_[rank_ ^ mask];
+      // Which half we kept is decided by the rank bit (same rule as the
+      // halving phase) — comparing lo against plo is ambiguous when a
+      // split produced an empty segment (mid == plo).
+      uint64_t other_lo, other_hi;
+      if (rank_ & mask) {  // we kept upper; partner holds lower
+        other_lo = plo; other_hi = mid;
+      } else {
+        other_lo = mid; other_hi = phi;
+      }
+      if (!ExchangeFd(partner_fd, data + lo, (hi - lo) * 4,
+                      data + other_lo, (other_hi - other_lo) * 4))
+        return -1;
+      lo = plo;
+      hi = phi;
+    }
+    return 0;
+  }
+
+  // Shuffle allreduce: direct reduce-scatter (every rank sends chunk c to
+  // its owner) then direct all-gather — 2(W-1) single-hop messages, the
+  // reference's `build_shuffle_all_reduce` with gather shards == ranks.
+  // Rounds use XOR perfect matchings (partner = rank ^ s) so both ends of
+  // every exchange are in the same round — any other schedule can deadlock
+  // once messages exceed kernel socket buffers.  Power-of-2 world only.
+  int AllreduceShuffleF32(float* data, uint64_t n) {
+    if (world_ == 1) return 0;
+    if (world_ & (world_ - 1)) return -2;  // not a power of 2
+    const uint64_t W = static_cast<uint64_t>(world_);
+    std::vector<uint64_t> ofs(W + 1);
+    for (uint64_t c = 0; c <= W; ++c) ofs[c] = n * c / W;
+    const uint64_t own_lo = ofs[rank_], own_hi = ofs[rank_ + 1];
+    std::vector<float> inbox(own_hi - own_lo);
+    // Phase 1: pairwise-exchange chunks toward their owners, accumulate.
+    for (int s = 1; s < world_; ++s) {
+      const int p = rank_ ^ s;
+      if (!ExchangeFd(fds_[p], data + ofs[p], (ofs[p + 1] - ofs[p]) * 4,
+                      inbox.data(), (own_hi - own_lo) * 4))
+        return -1;
+      for (uint64_t i = 0; i < own_hi - own_lo; ++i)
+        data[own_lo + i] += inbox[i];
+    }
+    // Phase 2: exchange reduced chunks until everyone has all of them.
+    for (int s = 1; s < world_; ++s) {
+      const int p = rank_ ^ s;
+      if (!ExchangeFd(fds_[p], data + own_lo, (own_hi - own_lo) * 4,
+                      data + ofs[p], (ofs[p + 1] - ofs[p]) * 4))
+        return -1;
+    }
+    return 0;
+  }
+
+  int rank() const { return rank_; }
+  int world() const { return world_; }
+
+ private:
+  MeshGroup(int rank, int world) : rank_(rank), world_(world) {
+    fds_.assign(world, -1);
+  }
+
+  int rank_, world_;
+  int listen_fd_ = -1;
+  std::vector<int> fds_;  // per-peer connection; own slot stays -1
+
+  friend MeshGroup* MakeMesh(int, int, const std::string&, int);
+};
+
 std::vector<std::pair<std::string, int>> ParsePeers(const std::string& s) {
   std::vector<std::pair<std::string, int>> out;
   size_t pos = 0;
@@ -274,9 +428,114 @@ Ring* MakeRing(int rank, int world, const std::string& peers,
   return r;
 }
 
+MeshGroup* MakeMesh(int rank, int world, const std::string& peers,
+                    int timeout_ms) {
+  auto addrs = ParsePeers(peers);
+  if (static_cast<int>(addrs.size()) != world || rank < 0 || rank >= world)
+    return nullptr;
+  MeshGroup* g = new MeshGroup(rank, world);
+  if (world == 1) return g;
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) { delete g; return nullptr; }
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(addrs[rank].second));
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(lfd, world) < 0) {
+    ::close(lfd);
+    delete g;
+    return nullptr;
+  }
+  g->listen_fd_ = lfd;
+
+  // Outbound to every higher rank (connect succeeds once the peer's
+  // listener is bound, even before it calls accept — kernel backlog).
+  // Wall-clock deadline shared across all setup; every peer is guaranteed
+  // at least one connect attempt even if earlier peers ate the budget.
+  auto fail = [&]() { delete g; return static_cast<MeshGroup*>(nullptr); };
+  auto now_ms = []() {
+    timespec ts;
+    ::clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+  };
+  const int64_t deadline = now_ms() + timeout_ms;
+  for (int p = rank + 1; p < world; ++p) {
+    int sfd = -1;
+    for (bool first = true; first || now_ms() < deadline; first = false) {
+      sfd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in peer{};
+      peer.sin_family = AF_INET;
+      peer.sin_port = htons(static_cast<uint16_t>(addrs[p].second));
+      const std::string& host =
+          addrs[p].first == "localhost" ? "127.0.0.1" : addrs[p].first;
+      if (::inet_pton(AF_INET, host.c_str(), &peer.sin_addr) != 1) {
+        ::close(sfd);
+        return fail();
+      }
+      if (::connect(sfd, reinterpret_cast<sockaddr*>(&peer),
+                    sizeof(peer)) == 0)
+        break;
+      ::close(sfd);
+      sfd = -1;
+      ::usleep(50 * 1000);
+    }
+    if (sfd < 0) return fail();
+    ::setsockopt(sfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint32_t me = static_cast<uint32_t>(rank);
+    if (!SendAll(sfd, &me, 4)) { ::close(sfd); return fail(); }
+    g->fds_[p] = sfd;
+  }
+  // Inbound from every lower rank, identified by handshake.
+  for (int i = 0; i < rank; ++i) {
+    pollfd lpf{lfd, POLLIN, 0};
+    int64_t remaining = deadline - now_ms();
+    if (::poll(&lpf, 1, remaining > 0
+                            ? static_cast<int>(remaining)
+                            : 1) <= 0)
+      return fail();
+    int rfd = ::accept(lfd, nullptr, nullptr);
+    if (rfd < 0) return fail();
+    ::setsockopt(rfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint32_t who = 0;
+    if (!RecvAll(rfd, &who, 4) || who >= static_cast<uint32_t>(rank) ||
+        g->fds_[who] != -1) {
+      ::close(rfd);
+      return fail();
+    }
+    g->fds_[who] = rfd;
+  }
+  for (int p = 0; p < world; ++p) {
+    if (p == rank) continue;
+    ::fcntl(g->fds_[p], F_SETFL, ::fcntl(g->fds_[p], F_GETFL) | O_NONBLOCK);
+  }
+  return g;
+}
+
 }  // namespace
 
 extern "C" {
+
+void* ttd_mesh_create(int rank, int world, const char* peers,
+                      int timeout_ms) {
+  return MakeMesh(rank, world, peers ? peers : "", timeout_ms);
+}
+
+int ttd_mesh_allreduce_hd_f32(void* g, float* data, uint64_t n) {
+  return static_cast<MeshGroup*>(g)->AllreduceHdF32(data, n);
+}
+
+int ttd_mesh_allreduce_shuffle_f32(void* g, float* data, uint64_t n) {
+  return static_cast<MeshGroup*>(g)->AllreduceShuffleF32(data, n);
+}
+
+int ttd_mesh_rank(void* g) { return static_cast<MeshGroup*>(g)->rank(); }
+int ttd_mesh_world(void* g) { return static_cast<MeshGroup*>(g)->world(); }
+
+void ttd_mesh_destroy(void* g) { delete static_cast<MeshGroup*>(g); }
 
 void* ttd_ring_create(int rank, int world, const char* peers,
                       int timeout_ms) {

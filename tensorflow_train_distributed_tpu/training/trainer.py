@@ -449,8 +449,13 @@ class Trainer:
                     break
         finally:
             device_iter.close()
-        if self.checkpoint_manager is not None and not self.state_poisoned:
-            self.checkpoint_manager.save(int(state.step), state, force=True)
+        if self.checkpoint_manager is not None:
+            if not self.state_poisoned:
+                self.checkpoint_manager.save(int(state.step), state,
+                                             force=True)
+            # Always await in-flight async saves: an earlier GOOD periodic
+            # checkpoint may still be committing and must not be lost just
+            # because a later step went non-finite.
             self.checkpoint_manager.wait_until_finished()
         self.callbacks.train_end(state)
         return state

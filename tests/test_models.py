@@ -107,6 +107,53 @@ class TestTraining:
         assert any(np.abs(m).max() > 0 for m in bn_means)
         assert hist.history["loss"][-1] < hist.history["loss"][0]
 
+    def test_resnet_space_to_depth_equivalence(self):
+        """The s2d stem is the SAME function: transforming a trained 7x7
+        stem kernel with stem_kernel_to_s2d and feeding s2d input must
+        reproduce the baseline logits exactly (MLPerf s2d trick)."""
+        import dataclasses
+
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        cfg = dataclasses.replace(resnet.RESNET_PRESETS["resnet_tiny"],
+                                  space_to_depth=False)
+        cfg_s2d = dataclasses.replace(cfg, space_to_depth=True)
+        model, model_s2d = resnet.ResNet(cfg), resnet.ResNet(cfg_s2d)
+        x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3),
+                              jnp.float32)
+        variables = nn.unbox(model.init(jax.random.key(1), x, train=False))
+        params = variables["params"]
+        params_s2d = jax.tree.map(lambda p: p, params)
+        params_s2d["stem_conv"] = {
+            "kernel": resnet.stem_kernel_to_s2d(
+                params["stem_conv"]["kernel"])
+        }
+        ref = model.apply({"params": params, **{
+            k: v for k, v in variables.items() if k != "params"}}, x,
+            train=False)
+        out = model_s2d.apply({"params": params_s2d, **{
+            k: v for k, v in variables.items() if k != "params"}},
+            resnet.space_to_depth(x), train=False)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   atol=1e-5)
+
+    def test_resnet_s2d_dataset_layout_matches_model(self):
+        """Host-side dataset s2d must equal the model's on-the-fly s2d."""
+        from tensorflow_train_distributed_tpu.data.datasets import (
+            SyntheticImageNet,
+        )
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        raw = SyntheticImageNet(num_examples=4, image_size=32, seed=3)
+        s2d = SyntheticImageNet(num_examples=4, image_size=32, seed=3,
+                                space_to_depth=True)
+        img = raw[1]["image"][None]
+        np.testing.assert_array_equal(
+            np.asarray(resnet.space_to_depth(img))[0], s2d[1]["image"])
+
     def test_bert_tiny_mlm_trains(self, mesh8):
         state, hist = _train_config("bert_tiny_mlm", steps=12, mesh=mesh8)
         assert hist.history["loss"][-1] < hist.history["loss"][0]

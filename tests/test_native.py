@@ -208,3 +208,67 @@ def test_ring_world_one_is_noop():
     np.testing.assert_allclose(
         ring.allreduce(np.asarray([5.0], np.float32)), [5.0])
     ring.close()
+
+
+# --- mesh (halving-doubling / shuffle) --------------------------------------
+
+
+def _run_mesh(world, fn):
+    """Run fn(mesh, rank) in `world` threads over a localhost mesh group."""
+    from tensorflow_train_distributed_tpu.native.ringcoll import HostMesh
+    from tensorflow_train_distributed_tpu.testing.multiprocess import (
+        free_ports,
+    )
+
+    peers = [f"127.0.0.1:{p}" for p in free_ports(world)]
+    results = [None] * world
+    errors = []
+
+    def work(rank):
+        try:
+            mesh = HostMesh(rank, peers)
+            results[rank] = fn(mesh, rank)
+            mesh.close()
+        except Exception as e:
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+@pytest.mark.parametrize("algorithm", ["hd", "shuffle"])
+@pytest.mark.parametrize("world,n", [(4, 1000), (8, 64), (2, 7), (4, 3)])
+def test_mesh_allreduce_matches_sum(algorithm, world, n):
+    """HD and shuffle match the exact sum on uneven/tiny sizes too."""
+
+    def fn(mesh, rank):
+        x = np.arange(n, dtype=np.float32) * (rank + 1)
+        return mesh.allreduce(x, algorithm=algorithm)
+
+    results = _run_mesh(world, fn)
+    want = np.arange(n, dtype=np.float32) * sum(range(1, world + 1))
+    for r in results:
+        np.testing.assert_allclose(r, want, rtol=1e-6)
+
+
+def test_mesh_rejects_non_power_of_two():
+    def fn(mesh, rank):
+        with pytest.raises(ValueError, match="power-of-2"):
+            mesh.allreduce(np.ones(8, np.float32), algorithm="hd")
+        return True
+
+    assert all(_run_mesh(3, fn))
+
+
+def test_mesh_world_one_is_noop():
+    from tensorflow_train_distributed_tpu.native.ringcoll import HostMesh
+
+    mesh = HostMesh(0, ["127.0.0.1:1"])
+    out = mesh.allreduce(np.asarray([3.0], np.float32))
+    np.testing.assert_allclose(out, [3.0])
+    mesh.close()

@@ -52,28 +52,36 @@ def bench_device(size_mb: float, iters: int) -> dict:
 
 
 def _host_worker(rank: int, world: int, peers: list[str], size_mb: float,
-                 iters: int, q) -> None:
+                 iters: int, algo: str, q) -> None:
     import time
 
     import numpy as np
 
-    from tensorflow_train_distributed_tpu.native.ringcoll import HostRing
+    from tensorflow_train_distributed_tpu.native.ringcoll import (
+        HostMesh, HostRing,
+    )
 
     n = int(size_mb * 1e6 / 4)
-    ring = HostRing(rank, peers, timeout_ms=20_000)
+    if algo == "ring":
+        group = HostRing(rank, peers, timeout_ms=20_000)
+        reduce_fn = group.allreduce
+    else:
+        group = HostMesh(rank, peers, timeout_ms=20_000)
+        reduce_fn = lambda x: group.allreduce(x, algorithm=algo)  # noqa: E731
     x = np.ones(n, np.float32)
-    ring.allreduce(x)  # warmup
+    reduce_fn(x)  # warmup
     t0 = time.perf_counter()
     for _ in range(iters):
-        ring.allreduce(x)
+        reduce_fn(x)
     dt = (time.perf_counter() - t0) / iters
-    ring.close()
+    group.close()
     if rank == 0:
         bus = 2 * (world - 1) / world * n * 4 / dt
         q.put({"time_s": dt, "bus_gbps": bus / 1e9})
 
 
-def bench_host(world: int, size_mb: float, iters: int) -> dict:
+def bench_host(world: int, size_mb: float, iters: int,
+               algo: str = "ring") -> dict:
     import multiprocessing as mp
     import queue as queue_mod
 
@@ -86,7 +94,7 @@ def bench_host(world: int, size_mb: float, iters: int) -> dict:
     q = ctx.Queue()
     procs = [
         ctx.Process(target=_host_worker,
-                    args=(r, world, peers, size_mb, iters, q))
+                    args=(r, world, peers, size_mb, iters, algo, q))
         for r in range(world)
     ]
     for p in procs:
@@ -121,12 +129,12 @@ def bench_host(world: int, size_mb: float, iters: int) -> dict:
                 p.terminate()
             p.join(timeout=5)
     return {
-        "metric": "allreduce_bus_bandwidth_host_ring",
+        "metric": f"allreduce_bus_bandwidth_host_{algo}",
         "value": round(result["bus_gbps"], 3),
         "unit": "GB/s",
         "devices": world,
         "message_bytes": int(size_mb * 1e6),
-        "backend": "tcp_ring",
+        "backend": f"tcp_{algo}",
     }
 
 
@@ -139,6 +147,11 @@ def main(argv=None) -> int:
                         "device mesh")
     p.add_argument("--world", type=int, default=4,
                    help="with --host: number of ring processes")
+    p.add_argument("--algo", default="ring",
+                   choices=["ring", "hd", "shuffle"],
+                   help="with --host: allreduce algorithm (ring is "
+                        "bandwidth-optimal, hd latency-optimal, shuffle "
+                        "single-hop; hd/shuffle need power-of-2 world)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--cpu-devices", type=int, default=None)
     args = p.parse_args(argv)
@@ -151,7 +164,7 @@ def main(argv=None) -> int:
         force_platform(args.platform, args.cpu_devices)
 
     if args.host:
-        out = bench_host(args.world, args.size_mb, args.iters)
+        out = bench_host(args.world, args.size_mb, args.iters, args.algo)
     else:
         out = bench_device(args.size_mb, args.iters)
     print(json.dumps(out))
