@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval-steps", type=int, default=0,
                    help="run evaluation for N batches after training")
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="also evaluate every N training steps (Keras "
+                        "validation_freq analog); val_* metrics reach "
+                        "callbacks/TensorBoard")
     # Checkpointing (reference: ModelCheckpoint + BackupAndRestore).
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
@@ -313,9 +317,30 @@ def run(args: argparse.Namespace) -> RunResult:
             batches = (loader.iter_from(int(state.step))
                        if state is not None and int(state.step) > 0
                        else loader)
+            eval_kwargs = {}
+            if args.eval_every and args.eval_steps <= 0:
+                raise SystemExit(
+                    "--eval-every needs --eval-steps N (>0) to size each "
+                    "validation run")
+            if args.eval_every and args.eval_steps > 0:
+                # Fresh single-pass loader per eval (factory form).
+                eval_kwargs = dict(
+                    eval_batches=lambda: HostDataLoader(
+                        source,
+                        DataConfig(global_batch_size=global_batch,
+                                   seed=args.seed + 1, num_epochs=1),
+                        process_index=(cluster.process_id
+                                       if cluster.is_multiprocess else None),
+                        process_count=(cluster.num_processes
+                                       if cluster.is_multiprocess else None),
+                    ),
+                    eval_every=args.eval_every,
+                    eval_steps=args.eval_steps,
+                )
             state = trainer.fit(
                 batches, steps=remaining, state=state,
                 steps_per_epoch=loader.steps_per_epoch(),
+                **eval_kwargs,
             )
         else:
             logger.info("checkpoint already at/past --steps; nothing to train")

@@ -500,8 +500,30 @@ MeshGroup* MakeMesh(int rank, int world, const std::string& peers,
     int rfd = ::accept(lfd, nullptr, nullptr);
     if (rfd < 0) return fail();
     ::setsockopt(rfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Deadline-bounded handshake read: a stray connection (port scanner,
+    // stale peer from a crashed run) that never sends its rank must not
+    // hang setup past timeout_ms.
     uint32_t who = 0;
-    if (!RecvAll(rfd, &who, 4) || who >= static_cast<uint32_t>(rank) ||
+    char* hp = reinterpret_cast<char*>(&who);
+    size_t hn = 4;
+    bool hs_ok = true;
+    while (hn > 0) {
+      pollfd hpf{rfd, POLLIN, 0};
+      int64_t hrem = deadline - now_ms();
+      if (::poll(&hpf, 1, hrem > 0 ? static_cast<int>(hrem) : 1) <= 0) {
+        hs_ok = false;
+        break;
+      }
+      ssize_t k = ::recv(rfd, hp, hn, 0);
+      if (k <= 0) {
+        if (k < 0 && errno == EINTR) continue;
+        hs_ok = false;
+        break;
+      }
+      hp += k;
+      hn -= static_cast<size_t>(k);
+    }
+    if (!hs_ok || who >= static_cast<uint32_t>(rank) ||
         g->fds_[who] != -1) {
       ::close(rfd);
       return fail();

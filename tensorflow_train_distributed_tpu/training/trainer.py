@@ -369,6 +369,9 @@ class Trainer:
         steps: int,
         state: Optional[TrainState] = None,
         steps_per_epoch: Optional[int] = None,
+        eval_batches=None,
+        eval_every: Optional[int] = None,
+        eval_steps: Optional[int] = None,
     ) -> TrainState:
         """Run ``steps`` optimizer steps over ``batches`` (host iterator).
 
@@ -377,6 +380,13 @@ class Trainer:
         epoch boundaries for ``on_epoch_end`` callbacks (loaders may be
         infinite, so epochs are declared, not discovered).  Returns the final
         state.
+
+        ``eval_batches`` (Keras ``validation_data``): a re-iterable batch
+        source or zero-arg factory; every ``eval_every`` steps (default:
+        each epoch boundary, else end of training) ``evaluate`` runs for
+        ``eval_steps`` batches and the results reach callbacks as
+        ``val_``-prefixed metrics — ``EarlyStopping(monitor="val_loss")``
+        is the Keras idiom this reproduces.
         """
         from tensorflow_train_distributed_tpu.data.pipeline import (
             prefetch_to_device,
@@ -427,11 +437,17 @@ class Trainer:
                 will_ckpt = (self.checkpoint_manager is not None
                              and self.config.checkpoint_every
                              and cur % self.config.checkpoint_every < k)
-                # Flush before a checkpoint too, so guard callbacks
-                # (TerminateOnNaN) see this window's metrics first and a
-                # poisoned state is never written over retained good saves.
+                eval_due = eval_batches is not None and (
+                    (eval_every and cur % eval_every < k)
+                    or (not eval_every and steps_per_epoch
+                        and done % steps_per_epoch < k)
+                    or (not eval_every and not steps_per_epoch and stop))
+                # Flush before a checkpoint (guard callbacks must see this
+                # window first so a poisoned state is never written over
+                # retained good saves) and before eval (val_* events must
+                # follow the train metrics of the same step, in order).
                 if (len(pending) * k >= self.config.log_every or stop
-                        or will_ckpt):
+                        or will_ckpt or eval_due):
                     # One device fetch for the whole pending window.
                     host = jax.device_get([m for _, m in pending])
                     for (s, _), m in zip(pending, host):
@@ -439,6 +455,17 @@ class Trainer:
                         stop |= self.callbacks.step_end(s, host_m)
                         last_metrics = host_m
                     pending.clear()
+                if eval_due:
+                    src = (eval_batches() if callable(eval_batches)
+                           else eval_batches)
+                    val = {f"val_{kk}": v for kk, v in
+                           self.evaluate(src, state,
+                                         steps=eval_steps).items()}
+                    last_metrics = dict(last_metrics, **val)
+                    # Dedicated callback event carrying only val_* metrics:
+                    # EarlyStopping(monitor="val_loss") sees them;
+                    # train-metric monitors ignore the event.
+                    stop |= self.callbacks.step_end(cur, val)
                 while (steps_per_epoch
                        and done >= (epoch + 1) * steps_per_epoch):
                     epoch += 1

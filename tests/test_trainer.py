@@ -174,6 +174,38 @@ class TestFit:
             trainer._compiled_predict_step()
 
 
+class TestValidationDuringFit:
+    def test_eval_every_reports_val_metrics(self, mesh8):
+        from tensorflow_train_distributed_tpu.training import EarlyStopping
+
+        hist = History()
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=5),
+                          callbacks=[hist])
+        trainer.fit(_loader(), steps=20,
+                    eval_batches=lambda: _loader(epochs=1, seed=7),
+                    eval_every=10, eval_steps=2)
+        assert "val_loss" in hist.history
+        assert "val_accuracy" in hist.history
+        assert len(hist.history["val_loss"]) == 2  # steps 10 and 20
+
+    def test_epoch_boundary_eval_and_early_stopping(self, mesh8):
+        """Keras idiom: validation each epoch + EarlyStopping(val_loss)."""
+        from tensorflow_train_distributed_tpu.training import EarlyStopping
+
+        stopper = EarlyStopping(monitor="val_loss", patience=1,
+                                min_delta=10.0)  # absurd delta → stop fast
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=1),
+                          callbacks=[stopper, hist := History()])
+        state = trainer.fit(_loader(), steps=50, steps_per_epoch=5,
+                            eval_batches=lambda: _loader(epochs=1, seed=7),
+                            eval_steps=2)
+        # patience=1 with an unreachable min_delta stops at the 2nd eval.
+        assert int(state.step) == 10
+        assert len(hist.history["val_loss"]) == 2
+
+
 class TestGradAccum:
     def test_matches_unaccumulated_numerics(self, mesh8):
         """grad_accum=4 over the same global batch must match plain steps

@@ -164,6 +164,9 @@ def main(argv=None) -> int:
         force_platform(args.platform, args.cpu_devices)
 
     if args.host:
+        if args.algo != "ring" and args.world & (args.world - 1):
+            p.error(f"--algo {args.algo} requires a power-of-2 --world, "
+                    f"got {args.world} (use --algo ring)")
         out = bench_host(args.world, args.size_mb, args.iters, args.algo)
     else:
         out = bench_device(args.size_mb, args.iters)
