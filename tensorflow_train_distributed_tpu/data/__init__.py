@@ -11,6 +11,7 @@ prescribes.
 """
 
 from tensorflow_train_distributed_tpu.data.pipeline import (  # noqa: F401
+    ConcatSource,
     DataConfig,
     HostDataLoader,
     prefetch_to_device,

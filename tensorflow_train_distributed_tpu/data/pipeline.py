@@ -42,6 +42,35 @@ class RandomAccessSource(Protocol):
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]: ...
 
 
+class ConcatSource:
+    """Concatenation of per-file sources — the FILE-autoshard unit.
+
+    The reference's ``AutoShardPolicy.FILE`` (``data/ops/options.py:89``)
+    assigns whole input files to workers; here a "file" is any
+    ``RandomAccessSource`` and this class is the file list.  Use with
+    ``DataConfig(shard_policy="file")``.
+    """
+
+    def __init__(self, parts):
+        if not parts:
+            raise ValueError("ConcatSource needs at least one part")
+        self.parts = list(parts)
+        self._offsets = np.cumsum([0] + [len(p) for p in self.parts])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        if idx < 0 or idx >= len(self):
+            raise IndexError(idx)
+        f = int(np.searchsorted(self._offsets, idx, side="right")) - 1
+        return self.parts[f][int(idx - self._offsets[f])]
+
+    def part_indices(self, part: int) -> np.ndarray:
+        """Global record indices belonging to file ``part``."""
+        return np.arange(self._offsets[part], self._offsets[part + 1])
+
+
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
     """Pipeline configuration (global batch semantics, like the reference)."""
@@ -52,6 +81,12 @@ class DataConfig:
     drop_remainder: bool = True
     num_epochs: Optional[int] = None  # None = repeat forever
     prefetch: int = 2
+    # Autoshard policy (reference ``AutoShardPolicy``, options.py:89):
+    # "data" = index-stride over records (default); "file" = whole files
+    # per process (source must be a ``ConcatSource``).  FILE keeps each
+    # worker reading only its own files — the policy the reference uses
+    # when record-level sharding would defeat sequential file reads.
+    shard_policy: str = "data"
     # Native (C++) batch assembly: threaded GIL-free gather via
     # ``native.staging`` — same batches, same order, off the Python hot
     # path. Requires the in-memory source to fit packed in host RAM.
@@ -96,8 +131,42 @@ class HostDataLoader:
                 "need static shapes (XLA recompiles per shape). Pad the "
                 "source instead."
             )
+        if config.shard_policy not in ("data", "file"):
+            raise ValueError(
+                f"shard_policy must be data|file, got "
+                f"{config.shard_policy!r}")
+        if config.shard_policy == "file":
+            if not isinstance(source, ConcatSource):
+                raise ValueError(
+                    "shard_policy='file' needs a ConcatSource (the file "
+                    f"list); got {type(source).__name__}")
+            if len(source.parts) < self.process_count:
+                raise ValueError(
+                    f"FILE autoshard needs >= one file per process: "
+                    f"{len(source.parts)} files < {self.process_count} "
+                    "processes")
+            # File f belongs to process f % P (reference FILE policy).
+            # Every process computes every shard's size so steps_per_epoch
+            # agrees everywhere without communication.
+            self._file_shards = [
+                np.concatenate([source.part_indices(f)
+                                for f in range(q, len(source.parts),
+                                               self.process_count)])
+                for q in range(self.process_count)
+            ]
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
+        if self.config.shard_policy == "file":
+            # FILE autoshard: this process's records are its whole files;
+            # shuffle is within the shard (matching tf.data
+            # shard-then-shuffle under FILE policy).
+            own = self._file_shards[self.process_index]
+            if not self.config.shuffle:
+                return own
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.config.seed, epoch])
+            )
+            return own[rng.permutation(len(own))]
         n = len(self.source)
         if self.config.shuffle:
             rng = np.random.default_rng(
@@ -190,7 +259,12 @@ class HostDataLoader:
                 }
 
     def steps_per_epoch(self) -> int:
-        per_host = len(self.source) // self.process_count
+        if self.config.shard_policy == "file":
+            # Every process must run the same batch count (SPMD deadlock
+            # otherwise) — bound by the smallest file shard.
+            per_host = min(len(s) for s in self._file_shards)
+        else:
+            per_host = len(self.source) // self.process_count
         return per_host // self.host_batch_size
 
     def as_device_iterator(self, mesh: Mesh) -> Iterator[Any]:
