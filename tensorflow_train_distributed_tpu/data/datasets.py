@@ -192,6 +192,48 @@ class SyntheticWMT:
                 "targets_out": tgt_out}
 
 
+class SliceSource:
+    """Contiguous ``[start, stop)`` view of another source.
+
+    The building block for held-out train/validation splits (Keras
+    ``validation_split`` analog): both views share the underlying records
+    with no copying, and each is a full ``RandomAccessSource``.
+    """
+
+    def __init__(self, source, start: int, stop: int):
+        n = len(source)
+        if not (0 <= start <= stop <= n):
+            raise ValueError(
+                f"invalid slice [{start}, {stop}) of a {n}-record source")
+        self.source, self.start, self.stop = source, start, stop
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __getitem__(self, idx: int):
+        if idx < 0 or idx >= len(self):
+            raise IndexError(idx)
+        return self.source[self.start + idx]
+
+
+def train_val_split(source, val_fraction: float, *, min_val: int = 1):
+    """Split a source into (train, holdout-tail) views.
+
+    The tail — never the head — is held out so the training prefix is a
+    stable function of the source regardless of the fraction.
+    """
+    if not 0.0 < val_fraction < 1.0:
+        raise ValueError(f"val_fraction must be in (0, 1), got {val_fraction}")
+    n = len(source)
+    n_val = max(int(n * val_fraction), min_val)
+    if n_val >= n:
+        raise ValueError(
+            f"validation split of {n_val} leaves no training data "
+            f"(source has {n} records)")
+    cut = n - n_val
+    return SliceSource(source, 0, cut), SliceSource(source, cut, n)
+
+
 _REGISTRY = {
     "mnist": SyntheticMNIST,
     "blobs": SyntheticBlobs,

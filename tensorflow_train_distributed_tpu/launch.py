@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also evaluate every N training steps (Keras "
                         "validation_freq analog); val_* metrics reach "
                         "callbacks/TensorBoard")
+    p.add_argument("--eval-split", type=float, default=0.0,
+                   help="fraction of the dataset held out as a validation "
+                        "split for --eval-every/--eval-steps (Keras "
+                        "validation_split analog). 0 (default) evaluates "
+                        "on the training distribution itself — train-set "
+                        "monitoring only")
     # Checkpointing (reference: ModelCheckpoint + BackupAndRestore).
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
@@ -230,15 +236,50 @@ def run(args: argparse.Namespace) -> RunResult:
     logger.info("mesh: %s (strategy=%s, %d devices)",
                 dict(mesh.shape), strategy, n_dev)
 
-    # 3. Data: sharded host loader over this config's dataset.
+    # 3. Data: sharded host loader over this config's dataset.  With
+    # --eval-split, a held-out tail becomes the validation source (Keras
+    # validation_split semantics); otherwise eval runs on the training
+    # distribution (documented train-set monitoring).
     global_batch = args.global_batch_size or entry["global_batch_size"]
     source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
+    eval_source = source
+    if args.eval_split:
+        if args.eval_steps <= 0:
+            raise SystemExit(
+                "--eval-split without --eval-steps N (>0) would hold out "
+                "data that is never evaluated; add --eval-steps (and "
+                "optionally --eval-every)")
+        from tensorflow_train_distributed_tpu.data.datasets import (
+            train_val_split,
+        )
+
+        source, eval_source = train_val_split(
+            source, args.eval_split, min_val=global_batch)
     loader = HostDataLoader(
         source,
         DataConfig(global_batch_size=global_batch, seed=args.seed),
         process_index=cluster.process_id if cluster.is_multiprocess else None,
         process_count=cluster.num_processes if cluster.is_multiprocess else None,
     )
+
+    def make_eval_loader():
+        # Fresh single-pass loader per eval so every run sees the same
+        # records in the same (seeded) order.
+        eval_loader = HostDataLoader(
+            eval_source,
+            DataConfig(global_batch_size=global_batch, seed=args.seed + 1,
+                       num_epochs=1),
+            process_index=(cluster.process_id
+                           if cluster.is_multiprocess else None),
+            process_count=(cluster.num_processes
+                           if cluster.is_multiprocess else None),
+        )
+        if 0 < eval_loader.steps_per_epoch() < args.eval_steps:
+            logger.warning(
+                "--eval-steps=%d exceeds the evaluation source's %d "
+                "batches/epoch; each eval averages over the smaller count",
+                args.eval_steps, eval_loader.steps_per_epoch())
+        return eval_loader
 
     # 4. Trainer: task + optimizer + policy + callbacks.
     task = entry["task_factory"]()
@@ -323,17 +364,8 @@ def run(args: argparse.Namespace) -> RunResult:
                     "--eval-every needs --eval-steps N (>0) to size each "
                     "validation run")
             if args.eval_every and args.eval_steps > 0:
-                # Fresh single-pass loader per eval (factory form).
                 eval_kwargs = dict(
-                    eval_batches=lambda: HostDataLoader(
-                        source,
-                        DataConfig(global_batch_size=global_batch,
-                                   seed=args.seed + 1, num_epochs=1),
-                        process_index=(cluster.process_id
-                                       if cluster.is_multiprocess else None),
-                        process_count=(cluster.num_processes
-                                       if cluster.is_multiprocess else None),
-                    ),
+                    eval_batches=make_eval_loader,
                     eval_every=args.eval_every,
                     eval_steps=args.eval_steps,
                 )
@@ -351,7 +383,7 @@ def run(args: argparse.Namespace) -> RunResult:
             # Skip eval when preempted: the grace window is for the save,
             # and the restarted job re-runs eval at its own end.
             eval_metrics = trainer.evaluate(
-                loader, state, steps=args.eval_steps)
+                make_eval_loader(), state, steps=args.eval_steps)
             logger.info("eval: %s", eval_metrics)
     finally:
         if watcher is not None:

@@ -399,6 +399,10 @@ class Trainer:
                 f"steps_per_execution={k} (each dispatch runs exactly k "
                 "optimizer steps)"
             )
+        # A poisoned verdict belongs to the *previous* run's state: a Trainer
+        # reused after TerminateOnNaN (e.g. restarted from a good restored
+        # checkpoint) must checkpoint normally again.
+        self.state_poisoned = False
         it = iter(batches)
         if state is None:
             first = next(it)

@@ -49,6 +49,24 @@ class TestSources:
         assert r["targets_out"][-1] == SyntheticWMT.EOS
         assert len(r["inputs"]) == 8
 
+    def test_slice_source_views(self):
+        from tensorflow_train_distributed_tpu.data.datasets import (
+            SliceSource, train_val_split,
+        )
+
+        ds = SyntheticBlobs(num_examples=100)
+        train, val = train_val_split(ds, 0.1)
+        assert len(train) == 90 and len(val) == 10
+        # Views alias the base records with no overlap.
+        np.testing.assert_array_equal(train[0]["x"], ds[0]["x"])
+        np.testing.assert_array_equal(val[0]["x"], ds[90]["x"])
+        with pytest.raises(IndexError):
+            val[10]
+        with pytest.raises(ValueError, match="no training data"):
+            train_val_split(ds, 0.5, min_val=100)
+        with pytest.raises(ValueError, match="invalid slice"):
+            SliceSource(ds, 50, 20)
+
 
 class TestHostDataLoader:
     def _loader(self, **kw):

@@ -90,6 +90,18 @@ def test_eval_and_jsonl(tmp_path):
     assert any("loss" in l for l in lines)
 
 
+def test_eval_split_holds_out_validation_data():
+    # With --eval-split the val_* metrics come from a held-out tail, and
+    # the final eval also runs on it (not on the training loader).
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "6", "--global-batch-size", "32",
+        "--precision", "float32", "--eval-steps", "2", "--eval-every", "3",
+        "--eval-split", "0.1", "--log-every", "2",
+    ))
+    assert result.eval_metrics is not None and "loss" in result.eval_metrics
+    assert "val_loss" in result.history
+
+
 def test_profile_steps_parse_error():
     with pytest.raises(SystemExit, match="START,STOP"):
         launch._parse_profile_steps("10")

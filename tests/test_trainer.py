@@ -288,6 +288,23 @@ class TestTerminateOnNaN:
         assert trainer.state_poisoned
         assert ckpt.latest_step() is None
 
+    def test_poisoned_flag_resets_on_next_fit(self, mesh8, tmp_path):
+        """A Trainer reused after a NaN run (e.g. restarted from a good
+        checkpoint) must checkpoint normally again — the poison verdict
+        belongs to the previous run's state."""
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=1),
+                          checkpoint_manager=ckpt)
+        trainer.state_poisoned = True  # as TerminateOnNaN left it
+        trainer.fit(_loader(), steps=2)
+        assert not trainer.state_poisoned
+        assert ckpt.latest_step() == 2
+
 
 class TestMixedPrecision:
     def test_bf16_policy_trains(self, mesh8):

@@ -111,18 +111,18 @@ def bench_host(world: int, size_mb: float, iters: int,
                 failed = {p.name: p.exitcode for p in procs if p.exitcode}
                 if failed:
                     raise RuntimeError(
-                        "ring workers exited nonzero before producing a "
+                        f"{algo} workers exited nonzero before producing a "
                         f"result (e.g. a port race on setup): {failed}"
                     ) from None
                 if time.monotonic() > deadline:
                     raise RuntimeError(
-                        "host ring benchmark timed out after 120 s with no "
-                        "result and no worker failure") from None
+                        f"host {algo} benchmark timed out after 120 s with "
+                        "no result and no worker failure") from None
         for p in procs:
             p.join(timeout=30)
         failed = {p.name: p.exitcode for p in procs if p.exitcode}
         if failed:
-            raise RuntimeError(f"ring workers exited nonzero: {failed}")
+            raise RuntimeError(f"{algo} workers exited nonzero: {failed}")
     finally:
         for p in procs:
             if p.is_alive():
