@@ -216,21 +216,26 @@ class SliceSource:
         return self.source[self.start + idx]
 
 
-def train_val_split(source, val_fraction: float, *, min_val: int = 1):
+def train_val_split(source, val_fraction: float, *, min_val: int = 1,
+                    min_train: int = 1):
     """Split a source into (train, holdout-tail) views.
 
     The tail — never the head — is held out so the training prefix is a
-    stable function of the source regardless of the fraction.
+    stable function of the source regardless of the fraction.  ``min_val``
+    and ``min_train`` (typically both the global batch size) guarantee each
+    side can fill at least one batch — a split that can't is a config
+    error, not a silent empty loader.
     """
     if not 0.0 < val_fraction < 1.0:
         raise ValueError(f"val_fraction must be in (0, 1), got {val_fraction}")
     n = len(source)
     n_val = max(int(n * val_fraction), min_val)
-    if n_val >= n:
-        raise ValueError(
-            f"validation split of {n_val} leaves no training data "
-            f"(source has {n} records)")
     cut = n - n_val
+    if cut < min_train:
+        raise ValueError(
+            f"validation split of {n_val} leaves {max(cut, 0)} training "
+            f"records < required {min_train} (source has {n}); shrink "
+            "--eval-split or the batch size")
     return SliceSource(source, 0, cut), SliceSource(source, cut, n)
 
 

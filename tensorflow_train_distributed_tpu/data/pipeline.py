@@ -154,6 +154,14 @@ class HostDataLoader:
                                                self.process_count)])
                 for q in range(self.process_count)
             ]
+        if self.steps_per_epoch() == 0:
+            # A loader that can never fill one batch would iterate forever
+            # yielding nothing (num_epochs=None) — fail at construction.
+            raise ValueError(
+                f"source yields 0 batches/epoch: per-process records "
+                f"< host batch size {self.host_batch_size} "
+                f"({len(source)} records over {self.process_count} "
+                "processes); shrink the batch or grow the source")
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         if self.config.shard_policy == "file":

@@ -254,7 +254,8 @@ def run(args: argparse.Namespace) -> RunResult:
         )
 
         source, eval_source = train_val_split(
-            source, args.eval_split, min_val=global_batch)
+            source, args.eval_split, min_val=global_batch,
+            min_train=global_batch)
     loader = HostDataLoader(
         source,
         DataConfig(global_batch_size=global_batch, seed=args.seed),

@@ -46,6 +46,12 @@ class LlamaConfig:
     remat: bool = True
     # "ring" | "ulysses" | None — context parallelism over the seq mesh axis.
     seq_parallel: object = None
+    # GPipe microbatch count: when set AND the ambient mesh has a
+    # ``pipeline`` axis > 1, the depth scan is replaced by the
+    # ``parallel.pipeline`` schedule (each stage holds a contiguous layer
+    # group; same stacked params, same math, pipelined execution).  The
+    # schedule needs scan_layers (the stacked-parameter layout).
+    pipeline_microbatches: Optional[int] = None
 
 
 LLAMA_PRESETS = {
@@ -62,6 +68,13 @@ LLAMA_PRESETS = {
                                    num_heads=4, num_kv_heads=2, ffn_size=128,
                                    max_positions=128, dtype=jnp.float32,
                                    scan_layers=True, remat=True),
+    # Pipeline-parallel CI variant: 4 layers so a 2-stage mesh holds 2
+    # layers/stage, exercising the grouped gpipe schedule.
+    "llama_tiny_pp": LlamaConfig(vocab_size=256, d_model=64, num_layers=4,
+                                 num_heads=4, num_kv_heads=2, ffn_size=128,
+                                 max_positions=128, dtype=jnp.float32,
+                                 scan_layers=True, remat=True,
+                                 pipeline_microbatches=4),
 }
 
 
@@ -120,6 +133,46 @@ class _ScannedBlock(nn.Module):
         return x
 
 
+def _pipeline_mesh(cfg: LlamaConfig):
+    """The ambient mesh when the gpipe path is requested and usable."""
+    if not (cfg.pipeline_microbatches and cfg.scan_layers):
+        return None
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or mesh.shape.get("pipeline", 1) <= 1:
+        return None
+    return mesh
+
+
+def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh):
+    """Decoder stack as a GPipe schedule over the ``pipeline`` mesh axis.
+
+    ``block_params`` is the nn.scan-stacked DecoderBlock tree (leading dim
+    ``num_layers``, sharded over ``pipeline`` by the ``stage`` rule) — the
+    SAME parameters the depth scan uses, so dp and dp_pp runs of one
+    checkpoint are numerically identical.
+    """
+    from tensorflow_train_distributed_tpu.parallel.pipeline import (
+        gpipe_layers,
+    )
+
+    def layer_fn(p, h):
+        # Inside shard_map every mesh axis is manual: logical sharding
+        # constraints are meaningless there (and illegal to apply), so the
+        # block runs under empty rules — pure per-shard compute.
+        with nn.logical_axis_rules(()):
+            return DecoderBlock(cfg).apply({"params": p}, h)
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    data_axes = tuple(a for a in ("data", "fsdp")
+                      if mesh.shape.get(a, 1) > 1)
+    return gpipe_layers(
+        layer_fn, block_params, x, mesh=mesh,
+        num_microbatches=cfg.pipeline_microbatches,
+        batch_axes=data_axes,
+    )
+
+
 class LlamaModel(nn.Module):
     config: LlamaConfig = LlamaConfig()
 
@@ -128,7 +181,14 @@ class LlamaModel(nn.Module):
         cfg = self.config
         x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                     name="token_embed")(tokens)
-        if cfg.scan_layers:
+        pp_mesh = None if self.is_initializing() else _pipeline_mesh(cfg)
+        if pp_mesh is not None:
+            # Params were created by the scan path (init always takes it);
+            # read the stacked block tree and drive the pipeline schedule.
+            block_params = (
+                self.variables["params"]["layers"]["stack"]["block"])
+            x = _pipelined_blocks(cfg, block_params, x, pp_mesh)
+        elif cfg.scan_layers:
             x = _ScannedBlock(cfg, name="layers")(x)
         else:
             for i in range(cfg.num_layers):

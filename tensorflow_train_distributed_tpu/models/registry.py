@@ -120,6 +120,16 @@ def _setup():
              dataset="lm",
              dataset_kwargs=dict(vocab_size=256, seq_len=32),
              strategy="dp_tp", global_batch_size=16, learning_rate=1e-3)
+    # Pipeline parallelism end-to-end: --strategy=dp_pp drives the GPipe
+    # schedule (parallel.pipeline) for the scanned decoder stack; the same
+    # config under --strategy=dp runs the plain depth scan with identical
+    # numerics.
+    register("llama_tiny_pp",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["llama_tiny_pp"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp_pp", global_batch_size=16, learning_rate=1e-3)
 
 
 _setup()

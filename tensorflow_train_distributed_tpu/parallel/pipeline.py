@@ -46,6 +46,7 @@ def pipeline_stages(
     microbatches: Any,
     *,
     axis: str = "pipeline",
+    unstack_params: bool = True,
 ) -> Any:
     """Run the microbatch pipeline *inside* an enclosing ``shard_map``.
 
@@ -57,12 +58,17 @@ def pipeline_stages(
       microbatches: ``[M, mb, ...]`` pytree of microbatches (replicated or
         data-sharded along ``mb`` — invisible here either way).
       axis: pipeline mesh axis name bound by the enclosing shard_map.
+      unstack_params: strip the local leading stage dim (the 1-layer-per-
+        stage contract).  ``False`` passes the slice through intact — for
+        stages that hold a *group* of layers and scan over them
+        (``gpipe_layers``).
 
     Returns ``[M, mb, ...]`` outputs, valid on every device (the last
     stage's results are broadcast via a masked psum so downstream loss
     code need not care where they landed).
     """
-    params = jax.tree.map(lambda x: x[0], stage_params)
+    params = (jax.tree.map(lambda x: x[0], stage_params)
+              if unstack_params else stage_params)
     stage = jax.lax.axis_index(axis)
     num_stages = jax.lax.axis_size(axis)
     leaves = jax.tree.leaves(microbatches)
@@ -110,6 +116,7 @@ def gpipe(
     num_microbatches: int,
     axis: str = "pipeline",
     batch_axes: Sequence[str] = (),
+    unstack_params: bool = True,
 ) -> Any:
     """Host-level entry: microbatch ``batch`` and run the full pipeline.
 
@@ -135,7 +142,7 @@ def gpipe(
 
     def per_shard(params_local, micro_local):
         return pipeline_stages(stage_fn, params_local, micro_local,
-                               axis=axis)
+                               axis=axis, unstack_params=unstack_params)
 
     out = shard_map(
         per_shard,
@@ -146,6 +153,47 @@ def gpipe(
     )(stacked_params, micro)
     return jax.tree.map(
         lambda o: o.reshape(bsz, *o.shape[2:]), out)
+
+
+def gpipe_layers(
+    layer_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    batch: Any,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipeline",
+    batch_axes: Sequence[str] = (),
+) -> Any:
+    """GPipe where each stage holds a contiguous *group* of layers.
+
+    ``stacked_params`` leaves carry a leading ``num_layers`` dim (the
+    nn.scan layout — logical axis ``stage``, sharded over ``axis``);
+    ``num_layers`` must divide evenly into the axis size, giving each
+    stage ``num_layers / num_stages`` layers which it scans sequentially
+    per tick.  ``layer_fn(params_one_layer, act) -> act``.  This is the
+    entry the scanned-block model families (llama) use: the same stacked
+    parameter tree serves the plain depth-scan under dp and the pipeline
+    schedule under dp_pp, unchanged.
+    """
+    num_stages = mesh.shape[axis]
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % num_stages:
+        raise ValueError(
+            f"num_layers={num_layers} not divisible by the {axis} axis "
+            f"size {num_stages}")
+
+    def stage_fn(local_params, h):
+        # local_params: this stage's [L/S, ...] slice; apply in depth order.
+        def body(carry, one_layer):
+            return layer_fn(one_layer, carry), None
+
+        h, _ = jax.lax.scan(body, h, local_params)
+        return h
+
+    return gpipe(stage_fn, stacked_params, batch, mesh=mesh,
+                 num_microbatches=num_microbatches, axis=axis,
+                 batch_axes=batch_axes, unstack_params=False)
 
 
 def init_stage_params(

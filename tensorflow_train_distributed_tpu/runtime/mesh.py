@@ -104,6 +104,10 @@ STRATEGY_PRESETS: dict[str, MeshConfig] = {
     "dp_tp_sp": MeshConfig(data=-1, seq=2, tensor=4),
     "fsdp_tp": MeshConfig(data=1, fsdp=-1, tensor=4),
     "dp_ep": MeshConfig(data=-1, expert=4),
+    # Pipeline axis: scanned-block models with ``pipeline_microbatches``
+    # set (e.g. the llama family) run the GPipe schedule
+    # (``parallel.pipeline.gpipe_layers``) over it — layer groups per
+    # stage, microbatched ticks, ppermute hops.
     "dp_pp": MeshConfig(data=-1, pipeline=2),
 }
 

@@ -62,8 +62,10 @@ class TestSources:
         np.testing.assert_array_equal(val[0]["x"], ds[90]["x"])
         with pytest.raises(IndexError):
             val[10]
-        with pytest.raises(ValueError, match="no training data"):
+        with pytest.raises(ValueError, match="training records"):
             train_val_split(ds, 0.5, min_val=100)
+        with pytest.raises(ValueError, match="training records"):
+            train_val_split(ds, 0.2, min_train=90)
         with pytest.raises(ValueError, match="invalid slice"):
             SliceSource(ds, 50, 20)
 

