@@ -99,14 +99,16 @@ def _acquire_backend(retries: int, probe_timeout: float):
     return None, errors
 
 
-def _watchdog(seconds: float, record: dict):
+def _watchdog(seconds: float, record: dict, what: str = "backend init"):
     """Emit the failure record and hard-exit if not cancelled in time —
-    the last line of defense when in-process backend init wedges after a
-    healthy probe."""
+    the last line of defense when init/compile wedges after a healthy
+    probe.  ``record`` is read at fire time, so mutable fields (partial
+    per-config results) reflect progress made before the hang."""
     def _fire():
-        _emit(dict(record,
-                   error=f"in-process backend init exceeded {seconds:.0f}s",
-                   backend="none"))
+        out = dict(record)
+        out.setdefault("backend", "none")
+        out["error"] = f"in-process {what} exceeded {seconds:.0f}s"
+        _emit(out)
         os._exit(1)
 
     t = threading.Timer(seconds, _fire)
@@ -203,6 +205,8 @@ def main(argv=None) -> int:
                    help="seconds per subprocess backend probe")
     p.add_argument("--init-timeout", type=float, default=300.0,
                    help="watchdog on in-process backend init")
+    p.add_argument("--bench-timeout", type=float, default=1200.0,
+                   help="watchdog on the whole compile+measure phase")
     fb = p.add_mutually_exclusive_group()
     fb.add_argument("--allow-cpu-fallback", dest="cpu_fallback",
                     action="store_true", default=True)
@@ -240,10 +244,19 @@ def main(argv=None) -> int:
         platform = jax.devices()[0].platform
     except Exception as e:
         # Init can *raise* as well as hang (chip grabbed between probe and
-        # here); either way the record must still land.
-        _emit(dict(record, error=f"backend init failed: {e}",
-                   backend="none", probe_errors=errors))
-        return 1
+        # here).  With fallback enabled this is just another reason to
+        # bench on CPU; without it, the record must still land.
+        errors.append(f"in-process init: {e}")
+        if not args.cpu_fallback:
+            _emit(dict(record, error="; ".join(errors), backend="none"))
+            return 1
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        fallback = True
+        force_platform("cpu")
+        platform = jax.devices()[0].platform
     finally:
         wd.cancel()
 
@@ -266,12 +279,20 @@ def main(argv=None) -> int:
     profile_dir = args.profile_dir or None
     results = {}
     failures = {}
-    for name in [c for c in args.configs.split(",") if c]:
-        try:
-            results[name] = bench_config(
-                name, batch_per_chip, warmup, iters, profile_dir)
-        except Exception as e:
-            failures[name] = f"{type(e).__name__}: {e}"
+    # Compile or the first step can wedge just like init — keep a watchdog
+    # armed through the whole measure phase so a JSON record always lands.
+    wd = _watchdog(args.bench_timeout,
+                   dict(record, backend=platform, configs=results,
+                        failed_configs=failures), what="compile/measure")
+    try:
+        for name in [c for c in args.configs.split(",") if c]:
+            try:
+                results[name] = bench_config(
+                    name, batch_per_chip, warmup, iters, profile_dir)
+            except Exception as e:
+                failures[name] = f"{type(e).__name__}: {e}"
+    finally:
+        wd.cancel()
     if not results:
         _emit(dict(record, error=f"all configs failed: {failures}",
                    backend=platform, probe_errors=errors))

@@ -17,6 +17,7 @@ from tensorflow_train_distributed_tpu.data.pipeline import (  # noqa: F401
     prefetch_to_device,
 )
 from tensorflow_train_distributed_tpu.data.datasets import (  # noqa: F401
+    SliceSource,
     SyntheticBlobs,
     SyntheticImageNet,
     SyntheticLM,
@@ -24,4 +25,10 @@ from tensorflow_train_distributed_tpu.data.datasets import (  # noqa: F401
     SyntheticMNIST,
     SyntheticWMT,
     get_dataset,
+    train_val_split,
+)
+from tensorflow_train_distributed_tpu.data.filesource import (  # noqa: F401
+    MmapArraySource,
+    open_sharded,
+    write_shards,
 )

@@ -239,6 +239,13 @@ def train_val_split(source, val_fraction: float, *, min_val: int = 1,
     return SliceSource(source, 0, cut), SliceSource(source, cut, n)
 
 
+def _array_dir(root: str, transform=None):
+    """On-disk mmap corpus (``filesource.write_shards`` layout)."""
+    from tensorflow_train_distributed_tpu.data.filesource import open_sharded
+
+    return open_sharded(root, transform=transform)
+
+
 _REGISTRY = {
     "mnist": SyntheticMNIST,
     "blobs": SyntheticBlobs,
@@ -246,6 +253,7 @@ _REGISTRY = {
     "lm": SyntheticLM,
     "mlm": SyntheticMLM,
     "wmt": SyntheticWMT,
+    "array_dir": _array_dir,
 }
 
 

@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also evaluate every N training steps (Keras "
                         "validation_freq analog); val_* metrics reach "
                         "callbacks/TensorBoard")
+    p.add_argument("--data-dir", default=None,
+                   help="train from an on-disk mmap corpus "
+                        "(data.filesource.write_shards layout) instead of "
+                        "the config's synthetic dataset")
+    p.add_argument("--data-transform", default=None,
+                   help="named record transform for --data-dir (e.g. "
+                        "u8_image_to_f32)")
     p.add_argument("--eval-split", type=float, default=0.0,
                    help="fraction of the dataset held out as a validation "
                         "split for --eval-every/--eval-steps (Keras "
@@ -241,7 +248,11 @@ def run(args: argparse.Namespace) -> RunResult:
     # validation_split semantics); otherwise eval runs on the training
     # distribution (documented train-set monitoring).
     global_batch = args.global_batch_size or entry["global_batch_size"]
-    source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
+    if args.data_dir:
+        source = get_dataset("array_dir", root=args.data_dir,
+                             transform=args.data_transform)
+    else:
+        source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
     eval_source = source
     if args.eval_split:
         if args.eval_steps <= 0:

@@ -95,11 +95,15 @@ def _setup():
              dataset="wmt",
              dataset_kwargs=dict(vocab_size=256, seq_len=32),
              strategy="dp", global_batch_size=32, learning_rate=1e-3)
-    # Reference config[4]: Llama-2-7B SFT (DTensor 2-D mesh → dp_tp).
+    # Reference config[4]: Llama-2-7B SFT (DTensor 2-D mesh).  fsdp_tp,
+    # not dp_tp: pure dp×tp replicates the ~79 GiB params+adam state over
+    # the data axis (~19 GiB/device at tensor=4 — over v5e HBM), while
+    # fsdp shards it (AOT-validated in
+    # tests/test_models.py::TestLlama7bMemoryBudget).
     register("llama2_7b_sft",
              task_factory=lambda: llama.make_task(
                  llama.LLAMA_PRESETS["llama2_7b"]),
-             dataset="lm", strategy="dp_tp", global_batch_size=64,
+             dataset="lm", strategy="fsdp_tp", global_batch_size=64,
              learning_rate=2e-5, lr_schedule="warmup_cosine",
              warmup_ratio=0.03)
     # Beyond the reference (it has no MoE): expert-parallel decoder LM.

@@ -18,6 +18,7 @@ from tensorflow_train_distributed_tpu.training.train_state import (  # noqa: F40
 from tensorflow_train_distributed_tpu.training.trainer import (  # noqa: F401
     Trainer,
     TrainerConfig,
+    plan_state_memory,
 )
 from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     Callback,

@@ -544,3 +544,64 @@ class Trainer:
 def _chain_first(first, rest):
     yield first
     yield from rest
+
+
+def plan_state_memory(
+    task: Task,
+    sample_batch,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    rules: LogicalRules = DEFAULT_RULES,
+    policy: Policy = Policy(),
+) -> dict[str, float]:
+    """AOT memory plan: per-device bytes of params + optimizer state.
+
+    Pure shape arithmetic — ``jax.eval_shape`` over state creation plus the
+    same sharding resolution ``Trainer.create_state`` uses — so a 7B config
+    can be validated against an HBM budget with no chips and no memory
+    (``mesh`` may be a ``jax.sharding.AbstractMesh`` for device counts this
+    host doesn't have).  The reference answers "does it fit" only by OOM
+    trial on real hardware; this is the planning tool SURVEY §7 calls
+    make-or-break for the Llama config.
+
+    Returns ``{"total_bytes", "per_device_bytes", "replicated_bytes"}``
+    (replicated = leaves no mesh axis shards — the irreducible floor).
+    """
+    batch_shapes = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        sample_batch,
+    )
+
+    def _create():
+        init_batch = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), batch_shapes)
+        variables = dict(task.init_variables(
+            jax.random.key(0), init_batch))
+        params = variables.pop("params")
+        return TrainState.create(
+            params=params, model_state=variables, tx=tx,
+            loss_scale=mp.LossScaleState.create(policy))
+
+    abstract = jax.eval_shape(_create)
+    shardings = sharding_lib.make_state_shardings(mesh, abstract, rules)
+    is_boxed = lambda x: isinstance(x, nn.meta.AxisMetadata)  # noqa: E731
+    leaves = jax.tree.leaves(abstract, is_leaf=is_boxed)
+    shard_leaves = jax.tree.leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    total = per_device = replicated = 0.0
+    for leaf, sh in zip(leaves, shard_leaves):
+        val = leaf.value if is_boxed(leaf) else leaf
+        nbytes = val.dtype.itemsize * int(np.prod(val.shape, dtype=int))
+        factor = 1
+        for entry in getattr(sh, "spec", ()):
+            if entry is None:
+                continue
+            for axis in (entry,) if isinstance(entry, str) else entry:
+                factor *= mesh.shape[axis]
+        total += nbytes
+        per_device += nbytes / factor
+        if factor == 1:
+            replicated += nbytes
+    return {"total_bytes": total, "per_device_bytes": per_device,
+            "replicated_bytes": replicated}

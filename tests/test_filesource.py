@@ -1,0 +1,149 @@
+"""On-disk ingestion tests: mmap shards, FILE autoshard, real-file training.
+
+Round-1 gap closure: every convergence test previously ran on procedural
+sources; these exercise the full path from actual files on disk — mmap
+random access → (native) batch staging → sharded training — against the
+checked-in mini-corpora in tests/data/.
+"""
+
+import pathlib
+
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_train_distributed_tpu.data import (
+    DataConfig,
+    HostDataLoader,
+    MmapArraySource,
+    get_dataset,
+    open_sharded,
+    write_shards,
+)
+from tensorflow_train_distributed_tpu.data.datasets import SyntheticBlobs
+
+DATA = pathlib.Path(__file__).parent / "data"
+
+
+class TestMmapFormat:
+    def test_roundtrip(self, tmp_path):
+        src = SyntheticBlobs(num_examples=20)
+        write_shards(tmp_path / "c", src, num_shards=4)
+        opened = open_sharded(tmp_path / "c")
+        assert len(opened) == 20
+        assert len(opened.parts) == 4
+        for i in (0, 7, 19):
+            want = src[i]
+            got = opened[i]
+            np.testing.assert_array_equal(got["x"], want["x"])
+            assert got["label"] == want["label"]
+
+    def test_uneven_split_has_no_empty_shards(self, tmp_path):
+        # ceil-split would leave trailing shards empty (10 over 6).
+        write_shards(tmp_path / "c", SyntheticBlobs(num_examples=10),
+                     num_shards=6)
+        opened = open_sharded(tmp_path / "c")
+        assert len(opened) == 10
+        assert all(len(p) >= 1 for p in opened.parts)
+
+    def test_rewrite_removes_stale_shards(self, tmp_path):
+        write_shards(tmp_path / "c", SyntheticBlobs(num_examples=16),
+                     num_shards=8)
+        write_shards(tmp_path / "c", SyntheticBlobs(num_examples=8),
+                     num_shards=2)
+        opened = open_sharded(tmp_path / "c")
+        assert len(opened.parts) == 2 and len(opened) == 8
+
+    def test_unknown_transform_name(self):
+        with pytest.raises(ValueError, match="available"):
+            open_sharded(DATA / "mnist_mini", transform="nope")
+
+    def test_errors(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="no part-"):
+            open_sharded(tmp_path / "missing")
+        with pytest.raises(FileNotFoundError, match="manifest"):
+            (tmp_path / "d").mkdir()
+            MmapArraySource(tmp_path / "d")
+        with pytest.raises(ValueError, match="shards"):
+            write_shards(tmp_path / "e", SyntheticBlobs(num_examples=2),
+                         num_shards=4)
+
+    def test_transform_by_name(self):
+        src = open_sharded(DATA / "mnist_mini", transform="u8_image_to_f32")
+        rec = src[0]
+        assert rec["image"].dtype == np.float32
+        assert 0.0 <= rec["image"].min() and rec["image"].max() <= 1.0
+
+    def test_registry_entry(self):
+        src = get_dataset("array_dir", root=str(DATA / "mlm_mini"))
+        assert len(src) == 256
+        assert src[0]["input_ids"].shape == (64,)
+
+
+class TestFileAutoshardFromDisk:
+    def test_file_policy_disjoint_cover(self):
+        """FILE autoshard over the real corpus: whole shard-files per
+        process, together covering every record exactly once."""
+        src = open_sharded(DATA / "mnist_mini")
+        seen = []
+        for p in range(2):
+            loader = HostDataLoader(
+                src, DataConfig(global_batch_size=8, shuffle=False,
+                                num_epochs=1, shard_policy="file"),
+                process_index=p, process_count=2)
+            for batch in loader:
+                seen.extend(batch["label"].tolist())
+        # 256 records, both shards same size → all covered.
+        assert len(seen) == 256
+
+    def test_native_staging_from_files(self):
+        """use_native staging straight from the mmap'd corpus."""
+        from tensorflow_train_distributed_tpu.native.staging import (
+            NativeBatchStager,
+        )
+
+        src = open_sharded(DATA / "mnist_mini", transform="u8_image_to_f32")
+        cfg = DataConfig(global_batch_size=16, seed=3, num_epochs=1,
+                         use_native=True)
+        native_batches = list(HostDataLoader(src, cfg))
+        python_batches = list(HostDataLoader(
+            src, DataConfig(global_batch_size=16, seed=3, num_epochs=1)))
+        assert len(native_batches) == len(python_batches) == 16
+        if not NativeBatchStager.available():
+            pytest.skip("native library unavailable; python fallback checked")
+        for a, b in zip(native_batches, python_batches):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+
+class TestTrainFromFiles:
+    def test_mnist_trains_from_files(self, mesh8):
+        from tensorflow_train_distributed_tpu.models import lenet
+        from tensorflow_train_distributed_tpu.training import (
+            History, Trainer, TrainerConfig,
+        )
+
+        src = open_sharded(DATA / "mnist_mini", transform="u8_image_to_f32")
+        loader = HostDataLoader(src, DataConfig(global_batch_size=64, seed=0))
+        trainer = Trainer(lenet.make_task(), optax.adam(3e-3), mesh8,
+                          config=TrainerConfig(log_every=5),
+                          callbacks=[hist := History()])
+        trainer.fit(loader, steps=30)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_bert_mlm_trains_from_files(self, mesh8):
+        from tensorflow_train_distributed_tpu.models import bert
+        from tensorflow_train_distributed_tpu.training import (
+            History, Trainer, TrainerConfig,
+        )
+
+        src = open_sharded(DATA / "mlm_mini")
+        loader = HostDataLoader(src, DataConfig(global_batch_size=32, seed=0))
+        task = bert.make_task(bert.BERT_PRESETS["bert_tiny"])
+        trainer = Trainer(task, optax.adam(1e-3), mesh8,
+                          config=TrainerConfig(log_every=5),
+                          callbacks=[hist := History()])
+        trainer.fit(loader, steps=30)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0], losses

@@ -90,6 +90,20 @@ def test_eval_and_jsonl(tmp_path):
     assert any("loss" in l for l in lines)
 
 
+def test_data_dir_trains_from_files():
+    import pathlib
+
+    corpus = pathlib.Path(__file__).parent / "data" / "mnist_mini"
+    result = launch.run(_args(
+        "--config", "mnist", "--steps", "20", "--global-batch-size", "64",
+        "--precision", "float32", "--optimizer", "adam",
+        "--learning-rate", "3e-3", "--log-every", "5",
+        "--data-dir", str(corpus), "--data-transform", "u8_image_to_f32",
+    ))
+    losses = result.history["loss"]
+    assert losses[-1] < losses[0], losses
+
+
 def test_eval_split_holds_out_validation_data():
     # With --eval-split the val_* metrics come from a held-out tail, and
     # the final eval also runs on it (not on the training loader).

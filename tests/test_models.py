@@ -188,6 +188,61 @@ class TestTraining:
         assert hist.history["loss"][-1] < hist.history["loss"][0]
 
 
+class TestLlama7bMemoryBudget:
+    """SURVEY §7 calls the 7B memory layout make-or-break; validate it AOT
+    (eval_shape + sharding arithmetic, no chips) against the v5e 16-GiB
+    HBM budget."""
+
+    V5E_HBM = 16 * 2**30
+
+    def _plan(self, mesh):
+        import numpy as np
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.training import (
+            plan_state_memory,
+        )
+
+        task = llama.make_task(llama.LLAMA_PRESETS["llama2_7b"])
+        batch = {"tokens": np.zeros((8, 4096), np.int32),
+                 "targets": np.zeros((8, 4096), np.int32)}
+        return plan_state_memory(task, batch, optax.adamw(1e-5), mesh)
+
+    def test_fsdp_tp_fits_v5e8_and_v5e16(self):
+        from jax.sharding import AbstractMesh
+
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            AXES, MeshConfig, build_mesh,
+        )
+
+        plan8 = self._plan(build_mesh(MeshConfig(data=1, fsdp=2, tensor=4)))
+        # ~26 GB params+opt (7B × 12 bytes: f32 master + adam mu/nu),
+        # sharded 8-ways with a small replicated floor (norm scales).
+        assert plan8["total_bytes"] > 70 * 2**30
+        assert plan8["per_device_bytes"] < self.V5E_HBM
+        assert plan8["replicated_bytes"] < 2**30
+        # v5e-16 (fsdp=4 × tensor=4) — AbstractMesh: no 16 devices needed.
+        sizes = dict.fromkeys(AXES, 1)
+        sizes.update(fsdp=4, tensor=4)
+        mesh16 = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        plan16 = self._plan(mesh16)
+        assert plan16["per_device_bytes"] < self.V5E_HBM / 2
+        assert plan16["per_device_bytes"] < plan8["per_device_bytes"]
+
+    def test_dp_tp_exceeds_v5e_documenting_fsdp_default(self):
+        """Pure dp×tp replicates params+opt over data: 7B with adam needs
+        ~19 GiB/device at tensor=4 regardless of the data size — that is
+        WHY the llama2_7b_sft registry config defaults to fsdp_tp."""
+        from tensorflow_train_distributed_tpu.models import registry
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+
+        plan = self._plan(build_mesh(MeshConfig(data=2, tensor=4)))
+        assert plan["per_device_bytes"] > self.V5E_HBM
+        assert registry.get_entry("llama2_7b_sft")["strategy"] == "fsdp_tp"
+
+
 class TestRegistry:
     def test_all_reference_configs_present(self):
         names = registry.available()

@@ -1,0 +1,60 @@
+"""Regenerate the checked-in mini-corpora under tests/data/.
+
+The repo carries two small real on-disk corpora (mmap shard layout,
+``data.filesource``) so file-based ingestion — FILE autoshard, mmap
+random access, native staging — is exercised against actual files, not
+procedural sources:
+
+- ``tests/data/mnist_mini``: 256 MNIST-style records, images stored
+  uint8 (decode with the ``u8_image_to_f32`` transform), 8 shards.
+- ``tests/data/mlm_mini``: 256 BERT-MLM records (vocab 256, seq 64),
+  8 shards.
+
+Deterministic: re-running reproduces byte-identical corpora.
+"""
+
+import pathlib
+import sys
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tensorflow_train_distributed_tpu.data.datasets import (  # noqa: E402
+    SyntheticMLM, SyntheticMNIST,
+)
+from tensorflow_train_distributed_tpu.data.filesource import (  # noqa: E402
+    write_shards,
+)
+
+
+class _U8Mnist:
+    """MNIST records with images quantized to uint8 for storage."""
+
+    def __init__(self, n):
+        self.src = SyntheticMNIST(num_examples=n)
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, idx):
+        rec = self.src[idx]
+        return {"image": np.round(rec["image"] * 255).astype(np.uint8),
+                "label": rec["label"]}
+
+
+def main():
+    out = REPO / "tests" / "data"
+    write_shards(out / "mnist_mini", _U8Mnist(256), num_shards=8)
+    write_shards(out / "mlm_mini",
+                 SyntheticMLM(num_examples=256, seq_len=64, vocab_size=256),
+                 num_shards=8)
+    for name in ("mnist_mini", "mlm_mini"):
+        total = sum(f.stat().st_size
+                    for f in (out / name).rglob("*") if f.is_file())
+        print(f"{name}: {total / 1024:.0f} KiB")
+
+
+if __name__ == "__main__":
+    main()
