@@ -111,16 +111,30 @@ def broadcast_from_coordinator(tree):
 
 
 def host_all_reduce_mean(tree, mesh: Mesh):
-    """Mean of a metrics pytree across every device in the mesh.
+    """Fetch a metrics pytree, verifying every leaf is globally replicated.
 
-    Used by the trainer for cross-replica metric aggregation — the analog of
-    ``Strategy.reduce(MEAN, ...)`` (``distribute_lib.py:1675``).  Metrics
-    produced under pjit are already global (replicated) arrays, so the mean
-    is the identity and this reduces to a host fetch; kept as a named seam so
-    per-shard metric paths can change the reduction later.
+    The analog of ``Strategy.reduce(MEAN, ...)`` (``distribute_lib.py:
+    1675``).  Metrics produced under pjit are already global (replicated)
+    arrays — the cross-replica mean happened inside the step — so the host
+    side is a fetch.  This seam *verifies* that contract rather than
+    assuming it: a sharded leaf reaching here means some step skipped its
+    in-graph reduction, and silently fetching would hand back per-shard
+    garbage as if it were the global value.
     """
-    del mesh
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    del mesh  # the leaves' shardings carry their own mesh
+
+    def _fetch(path, x):
+        if isinstance(x, jax.Array) and not x.sharding.is_fully_replicated:
+            raise ValueError(
+                f"host_all_reduce_mean got non-replicated metric leaf "
+                f"'{jax.tree_util.keystr(path)}' with sharding spec "
+                f"{getattr(x.sharding, 'spec', x.sharding)}; reduce metrics "
+                "inside the jitted step (mean over the sharded batch / "
+                "psum over mesh axes) so every device holds the global "
+                "value")
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map_with_path(_fetch, tree)
 
 
 # --- microbenchmark ---------------------------------------------------------

@@ -18,7 +18,17 @@ Resolution order (first match wins):
    "task": {"type": "worker", "index": k}}`` maps to
    coordinator=worker[0], num_processes=len(workers), process_id=k.
 4. Slurm env (``SLURM_PROCID`` / ``SLURM_NTASKS`` / ``SLURM_STEP_NODELIST``).
-5. Single-process (no distributed init needed) — the default on one host.
+5. Kubernetes Indexed-Job env (``JOB_COMPLETION_INDEX`` +
+   ``TTD_K8S_REPLICAS``; reference ``KubernetesClusterResolver``).
+6. GCE metadata via ``TTD_GCE_METADATA`` (inline JSON or ``@file``;
+   reference ``GCEClusterResolver``).
+7. Single-process (no distributed init needed) — the default on one host.
+
+The K8s/GCE resolvers are deliberately *egress-free*: where the reference
+queries the cluster API server / the GCE metadata server at resolve time,
+here the same facts arrive through env vars a pod spec or startup script
+injects (downward API / one metadata fetch at boot) — resolution itself
+never needs the network, so it is testable and works in air-gapped runs.
 """
 
 from __future__ import annotations
@@ -150,6 +160,81 @@ def _from_slurm() -> Optional[DistributedConfig]:
     )
 
 
+def _from_kubernetes() -> Optional[DistributedConfig]:
+    """Kubernetes Indexed-Job resolution (reference
+    ``KubernetesClusterResolver``, ``kubernetes_cluster_resolver.py:42``).
+
+    The reference lists pods through the cluster API server; the TPU-native
+    spelling needs no API access: an Indexed Job already gives every pod
+    ``JOB_COMPLETION_INDEX`` (standard k8s env), the pod spec passes the
+    replica count as ``TTD_K8S_REPLICAS``, and the coordinator address is
+    either ``TTD_K8S_COORDINATOR`` or derived from the Indexed-Job +
+    headless-service DNS convention ``<job>-0.<subdomain>`` via
+    ``TTD_K8S_JOB_NAME`` / ``TTD_K8S_SUBDOMAIN``.
+    """
+    idx = os.environ.get("JOB_COMPLETION_INDEX")
+    nproc = os.environ.get("TTD_K8S_REPLICAS")
+    if idx is None or nproc is None:
+        return None
+    coord = os.environ.get("TTD_K8S_COORDINATOR")
+    if not coord:
+        job = os.environ.get("TTD_K8S_JOB_NAME")
+        subdomain = os.environ.get("TTD_K8S_SUBDOMAIN")
+        if not (job and subdomain):
+            raise ValueError(
+                "Kubernetes cluster env (JOB_COMPLETION_INDEX + "
+                "TTD_K8S_REPLICAS) needs a coordinator: set "
+                "TTD_K8S_COORDINATOR, or TTD_K8S_JOB_NAME + "
+                "TTD_K8S_SUBDOMAIN for the <job>-0.<subdomain> headless-"
+                "service convention")
+        coord = f"{job}-0.{subdomain}:{_DEFAULT_PORT}"
+    return DistributedConfig(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(idx),
+        source="env:kubernetes",
+    )
+
+
+def _from_gce_metadata() -> Optional[DistributedConfig]:
+    """GCE instance-group resolution (reference ``GCEClusterResolver``).
+
+    The reference asks the GCE metadata server for the instance group's
+    members per resolve; here a boot-time script does that fetch ONCE and
+    injects the result as ``TTD_GCE_METADATA`` — inline JSON or ``@/path``
+    to a JSON file — of the shape::
+
+        {"instances": ["host-a", "host-b", ...],   # group members, ordered
+         "self": "host-b",                         # this VM's name
+         "port": 8476}                             # optional
+
+    Resolution is pure env/file parsing: no egress, fully unit-testable.
+    """
+    raw = os.environ.get("TTD_GCE_METADATA")
+    if not raw:
+        return None
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        meta = json.loads(raw)
+        instances = list(meta["instances"])
+        self_name = meta["self"]
+        port = int(meta.get("port", _DEFAULT_PORT))
+        process_id = instances.index(self_name)
+    except (json.JSONDecodeError, KeyError, ValueError, TypeError,
+            OSError) as e:
+        raise ValueError(
+            f"Malformed TTD_GCE_METADATA (need a JSON object with an "
+            f"instances list containing self, or @path to one): {e}") from e
+    return DistributedConfig(
+        coordinator_address=f"{instances[0]}:{port}",
+        num_processes=len(instances),
+        process_id=process_id,
+        source="env:gce_metadata",
+    )
+
+
 def resolve_cluster(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -170,7 +255,8 @@ def resolve_cluster(
             process_id=pid,
             source="explicit",
         )
-    for probe in (_from_env_native, _from_tf_config, _from_slurm):
+    for probe in (_from_env_native, _from_tf_config, _from_slurm,
+                  _from_kubernetes, _from_gce_metadata):
         cfg = probe()
         if cfg is not None:
             return cfg

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tensorflow_train_distributed_tpu.parallel import collectives
 from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
 from tensorflow_train_distributed_tpu.parallel.sharding import (
     DEFAULT_RULES, LogicalRules,
@@ -452,8 +453,12 @@ class Trainer:
                 # follow the train metrics of the same step, in order).
                 if (len(pending) * k >= self.config.log_every or stop
                         or will_ckpt or eval_due):
-                    # One device fetch for the whole pending window.
-                    host = jax.device_get([m for _, m in pending])
+                    # One device fetch for the whole pending window, via
+                    # the guarded seam: a sharded metric leaf means a step
+                    # skipped its in-graph reduction and must fail loudly,
+                    # not flow per-shard garbage into callbacks.
+                    host = collectives.host_all_reduce_mean(
+                        [m for _, m in pending], self.mesh)
                     for (s, _), m in zip(pending, host):
                         host_m = {kk: float(v) for kk, v in m.items()}
                         stop |= self.callbacks.step_end(s, host_m)
@@ -491,10 +496,13 @@ class Trainer:
         self.callbacks.train_end(state)
         return state
 
-    def _forward_loop(self, batches, state, step_fn,
-                      steps: Optional[int]) -> list:
+    def _forward_loop(self, batches, state, step_fn, steps: Optional[int],
+                      fetch=jax.device_get) -> list:
         """Drive a jitted forward step over prefetched batches, collecting
-        host results (shared by evaluate/predict)."""
+        host results (shared by evaluate/predict).  ``fetch`` maps device
+        results to host values — evaluate passes the replication-guarded
+        metric fetch; predict keeps the plain device_get (its outputs are
+        data and may be legitimately sharded)."""
         from tensorflow_train_distributed_tpu.data.pipeline import (
             prefetch_to_device,
         )
@@ -503,7 +511,7 @@ class Trainer:
         device_iter = prefetch_to_device(iter(batches), self.mesh)
         try:
             for dev_batch in device_iter:
-                results.append(jax.device_get(step_fn(state, dev_batch)))
+                results.append(fetch(step_fn(state, dev_batch)))
                 if steps is not None and len(results) >= steps:
                     break
         finally:
@@ -519,7 +527,9 @@ class Trainer:
     ) -> dict[str, float]:
         acc = MetricAccumulator()
         for metrics in self._forward_loop(
-                batches, state, self._compiled_eval_step(), steps):
+                batches, state, self._compiled_eval_step(), steps,
+                fetch=lambda m: collectives.host_all_reduce_mean(
+                    m, self.mesh)):
             acc.update({k: float(np.asarray(v)) for k, v in metrics.items()})
         return acc.result()
 

@@ -87,6 +87,19 @@ class TestHostHelpers:
         assert isinstance(out["loss"], np.ndarray)
         np.testing.assert_allclose(out["loss"], 2.5)
 
+    def test_host_all_reduce_mean_rejects_sharded_leaf(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharded = jax.device_put(
+            jnp.arange(8.0), NamedSharding(mesh8, P("data")))
+        with pytest.raises(ValueError, match="non-replicated metric leaf"):
+            coll.host_all_reduce_mean({"per_shard": sharded}, mesh8)
+        # Replicated device arrays still fetch fine.
+        replicated = jax.device_put(
+            jnp.float32(1.0), NamedSharding(mesh8, P()))
+        out = coll.host_all_reduce_mean({"ok": replicated}, mesh8)
+        np.testing.assert_allclose(out["ok"], 1.0)
+
 
 class TestBusBandwidth:
     def test_allreduce_bench_runs(self, mesh8):

@@ -159,6 +159,50 @@ class TestTraining:
         assert hist.history["loss"][-1] < hist.history["loss"][0]
         assert "mlm_accuracy" in hist.history
 
+    def test_bert_mlm_val_metrics_drive_early_stopping(self, mesh8):
+        """BERT MLM eval parity: held-out val_loss + val_mlm_accuracy flow
+        through fit's eval loop and drive EarlyStopping — the [SPEC]
+        'samples/sec + loss match' metric pair, closed end-to-end."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader, get_dataset, train_val_split,
+        )
+        from tensorflow_train_distributed_tpu.models import bert
+        from tensorflow_train_distributed_tpu.training import (
+            EarlyStopping, History, Trainer, TrainerConfig,
+        )
+
+        src = get_dataset("mlm", num_examples=512, vocab_size=256,
+                          seq_len=64)
+        train_src, val_src = train_val_split(src, 0.25)
+        loader = HostDataLoader(
+            train_src, DataConfig(global_batch_size=32, seed=0))
+        # min_delta=0.5: only the initial steep descent counts as
+        # improvement, so the stop fires deterministically mid-run.
+        es = EarlyStopping(monitor="val_loss", patience=2, min_delta=0.5)
+        trainer = Trainer(
+            bert.make_task(bert.BERT_PRESETS["bert_tiny"]),
+            optax.adam(2e-3), mesh8,
+            config=TrainerConfig(log_every=5),
+            callbacks=[hist := History(), es])
+        state = trainer.fit(
+            loader, steps=300,
+            eval_batches=lambda: HostDataLoader(
+                val_src, DataConfig(global_batch_size=32, seed=1,
+                                    num_epochs=1)),
+            eval_every=10, eval_steps=4)
+        assert "val_loss" in hist.history
+        assert "val_mlm_accuracy" in hist.history
+        # Learned on the held-out split (the stop fires only after the
+        # steep descent, so the total drop exceeds min_delta).
+        assert (hist.history["val_loss"][-1]
+                < hist.history["val_loss"][0] - 0.5)
+        # EarlyStopping actually stopped the run on the val_loss plateau,
+        # and its best tracked the qualifying (>min_delta) improvements.
+        assert int(state.step) < 300
+        assert es.best < hist.history["val_loss"][0] - 0.5
+
     def test_transformer_tiny_wmt_trains(self, mesh8):
         state, hist = _train_config("transformer_tiny_wmt", steps=12,
                                     mesh=mesh8)

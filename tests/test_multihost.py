@@ -86,6 +86,35 @@ def _tf_config_identity(rank):
             "source": cfg.source, "coordinator": cfg.coordinator_address}
 
 
+def _metric_guard(rank):
+    """host_all_reduce_mean across a real 2-process cluster: replicated
+    metrics fetch; a sharded leaf is rejected, not silently mis-fetched."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflow_train_distributed_tpu.parallel.collectives import (
+        host_all_reduce_mean,
+    )
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    # Replicated metric (the pjit contract): global mean of a sharded array.
+    local = np.full((len(jax.local_devices()),), float(rank + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local)
+    metric = jax.jit(jnp.mean, out_shardings=NamedSharding(mesh, P()))(arr)
+    fetched = host_all_reduce_mean({"loss": metric}, mesh)
+    try:
+        host_all_reduce_mean({"bad": arr}, mesh)
+        raised = False
+    except ValueError:
+        raised = True
+    return {"loss": float(fetched["loss"]), "raised": raised}
+
+
 def _hang_forever(rank):
     if rank == 1:
         import time
@@ -148,6 +177,15 @@ def test_tf_config_cluster_resolution():
         assert r.value["process_id"] == r.rank
         assert r.value["num"] == 2
         assert r.value["coordinator"] == cluster["worker"][0]
+
+
+def test_metric_guard_across_processes():
+    results = MultiProcessRunner(
+        "test_multihost:_metric_guard", 2, local_devices=2).run()
+    for r in results:
+        # mean of [1,1,2,2] = 1.5 on every process; sharded leaf rejected.
+        assert r.value["loss"] == 1.5
+        assert r.value["raised"]
 
 
 def test_fault_injection_kill_worker():

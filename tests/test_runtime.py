@@ -93,6 +93,71 @@ class TestResolveCluster:
         assert _expand_first_slurm_node("solo") == "solo"
         assert _expand_first_slurm_node("a1,a2") == "a1"
 
+    def test_kubernetes_indexed_job(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("JOB_COMPLETION_INDEX", "2")
+        monkeypatch.setenv("TTD_K8S_REPLICAS", "4")
+        monkeypatch.setenv("TTD_K8S_JOB_NAME", "trainer")
+        monkeypatch.setenv("TTD_K8S_SUBDOMAIN", "trainer-svc")
+        cfg = resolve_cluster()
+        assert cfg.source == "env:kubernetes"
+        assert cfg.coordinator_address.startswith("trainer-0.trainer-svc:")
+        assert cfg.num_processes == 4 and cfg.process_id == 2
+
+    def test_kubernetes_explicit_coordinator(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("JOB_COMPLETION_INDEX", "0")
+        monkeypatch.setenv("TTD_K8S_REPLICAS", "2")
+        monkeypatch.setenv("TTD_K8S_COORDINATOR", "coord:7777")
+        cfg = resolve_cluster()
+        assert cfg.coordinator_address == "coord:7777"
+        assert cfg.is_coordinator
+
+    def test_kubernetes_missing_coordinator_actionable(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID",
+                    "TTD_K8S_COORDINATOR", "TTD_K8S_JOB_NAME"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("JOB_COMPLETION_INDEX", "1")
+        monkeypatch.setenv("TTD_K8S_REPLICAS", "2")
+        with pytest.raises(ValueError, match="TTD_K8S_COORDINATOR"):
+            resolve_cluster()
+
+    def test_gce_metadata_inline(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID",
+                    "JOB_COMPLETION_INDEX"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("TTD_GCE_METADATA", json.dumps({
+            "instances": ["vm-a", "vm-b", "vm-c"], "self": "vm-c",
+            "port": 9999,
+        }))
+        cfg = resolve_cluster()
+        assert cfg.source == "env:gce_metadata"
+        assert cfg.coordinator_address == "vm-a:9999"
+        assert cfg.num_processes == 3 and cfg.process_id == 2
+
+    def test_gce_metadata_file(self, monkeypatch, tmp_path):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID",
+                    "JOB_COMPLETION_INDEX"):
+            monkeypatch.delenv(var, raising=False)
+        meta = tmp_path / "gce.json"
+        meta.write_text(json.dumps(
+            {"instances": ["vm-a", "vm-b"], "self": "vm-a"}))
+        monkeypatch.setenv("TTD_GCE_METADATA", f"@{meta}")
+        cfg = resolve_cluster()
+        assert cfg.num_processes == 2 and cfg.process_id == 0
+        assert cfg.coordinator_address.startswith("vm-a:")
+
+    def test_gce_metadata_malformed(self, monkeypatch):
+        for var in ("TF_CONFIG", "TTD_COORDINATOR", "SLURM_PROCID",
+                    "JOB_COMPLETION_INDEX"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("TTD_GCE_METADATA", json.dumps(
+            {"instances": ["vm-a"], "self": "other-vm"}))
+        with pytest.raises(ValueError, match="Malformed TTD_GCE_METADATA"):
+            resolve_cluster()
+
 
 class TestMesh:
     def test_resolve_infers_one_axis(self):

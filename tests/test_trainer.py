@@ -263,6 +263,52 @@ class TestGradAccum:
         np.testing.assert_allclose(losses[1], losses[4], rtol=1e-2)
 
 
+class TestMetricAccumulator:
+    def test_plain_mean_without_weights(self):
+        from tensorflow_train_distributed_tpu.training.metrics import (
+            MetricAccumulator,
+        )
+
+        acc = MetricAccumulator()
+        acc.update({"loss": 1.0})
+        acc.update({"loss": 3.0})
+        assert acc.result() == {"loss": 2.0}
+
+    def test_weighted_mean_with_loss_weight(self):
+        """Batches reporting loss_weight (MLM contract) aggregate as the
+        true weighted mean; loss_weight reports the total evaluated."""
+        from tensorflow_train_distributed_tpu.training.metrics import (
+            MetricAccumulator,
+        )
+
+        acc = MetricAccumulator()
+        acc.update({"loss": 1.0, "mlm_accuracy": 0.0, "loss_weight": 1.0})
+        acc.update({"loss": 2.0, "mlm_accuracy": 1.0, "loss_weight": 3.0})
+        r = acc.result()
+        assert r["loss"] == pytest.approx((1.0 + 2.0 * 3) / 4)
+        assert r["mlm_accuracy"] == pytest.approx(0.75)
+        assert r["loss_weight"] == 4.0
+        acc.reset()
+        assert acc.result() == {}
+
+    def test_zero_weight_batches_excluded(self):
+        """A zero-weight batch (no masked tokens) must not poison the
+        aggregate (NaN·0) or the denominator (0 weight total)."""
+        from tensorflow_train_distributed_tpu.training.metrics import (
+            MetricAccumulator,
+        )
+
+        acc = MetricAccumulator()
+        acc.update({"loss": float("nan"), "loss_weight": 0.0})
+        acc.update({"loss": 2.0, "loss_weight": 2.0})
+        r = acc.result()
+        assert r["loss"] == 2.0 and r["loss_weight"] == 2.0
+        # All-zero-weight eval: defined (empty) result, not a crash.
+        acc.reset()
+        acc.update({"loss": float("nan"), "loss_weight": 0.0})
+        assert acc.result() == {"loss_weight": 0.0}
+
+
 class TestTerminateOnNaN:
     def test_stops_and_vetoes_checkpoints(self, mesh8, tmp_path):
         """Loss goes NaN → training stops at the next metrics flush and no
