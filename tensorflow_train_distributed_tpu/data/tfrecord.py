@@ -1,0 +1,401 @@
+"""TFRecord + tf.train.Example interop — read the reference's corpora.
+
+The reference's input pipelines read TFRecord files of ``tf.train.Example``
+protos (the tf.data convention its builders assume, SURVEY.md §2.1/§3.5).
+A reference user migrating here brings that data; this module reads and
+writes it with **zero TensorFlow/protobuf dependency** — the framing
+(length + masked crc32c) and the three-message Example schema are small
+enough to implement directly:
+
+- ``TFRecordWriter`` / ``read_records``: the on-wire framing
+  (`uint64 length | crc(length) | payload | crc(payload)`, crc32c masked
+  with the TF rotation constant).
+- ``encode_example`` / ``decode_example``: hand-rolled proto codec for
+  ``Example { Features { map<string, Feature> } }`` with
+  BytesList/FloatList/Int64List (packed and unpacked accepted).
+- ``TFRecordSource``: a ``RandomAccessSource`` over one or more ``.tfrecord``
+  files — builds an offset index in one sequential pass (TFRecord itself is
+  stream-oriented; the index restores the random access the SPMD input
+  pipeline needs), then serves ``{field: np.ndarray}`` records through a
+  ``FixedLenFeature``-style spec.
+
+Sequential-proto decode is NOT the hot path (that is the mmap format in
+``data.filesource``); ``convert_to_shards`` does the one-time migration.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+# --- crc32c (Castagnoli), table-driven, with TF's masking -------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --- varint / proto primitives ----------------------------------------------
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _tag(field: int, wire: int) -> int:
+    return (field << 3) | wire
+
+
+def _write_len_delimited(out: bytearray, field: int, payload: bytes) -> None:
+    _write_varint(out, _tag(field, 2))
+    _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _skip_field(buf: bytes, pos: int, wire: int) -> int:
+    if wire == 0:
+        _, pos = _read_varint(buf, pos)
+        return pos
+    if wire == 1:
+        return pos + 8
+    if wire == 2:
+        n, pos = _read_varint(buf, pos)
+        return pos + n
+    if wire == 5:
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire}")
+
+
+# --- tf.train.Example codec -------------------------------------------------
+
+
+def encode_example(features: dict[str, np.ndarray]) -> bytes:
+    """Encode ``{name: array}`` as a serialized ``tf.train.Example``.
+
+    dtype mapping (the tf.train convention): floating → FloatList (f32),
+    integer/bool → Int64List, bytes/str objects → BytesList.
+    """
+    feats = bytearray()
+    for name in sorted(features):
+        arr = features[name]
+        body = bytearray()
+        if isinstance(arr, (bytes, str)):
+            values = [arr.encode() if isinstance(arr, str) else arr]
+            inner = bytearray()
+            for v in values:
+                _write_len_delimited(inner, 1, v)
+            _write_len_delimited(body, 1, bytes(inner))  # bytes_list
+        else:
+            arr = np.asarray(arr)
+            if np.issubdtype(arr.dtype, np.floating):
+                packed = np.ascontiguousarray(
+                    arr.reshape(-1), np.float32).tobytes()
+                inner = bytearray()
+                _write_len_delimited(inner, 1, packed)  # packed floats
+                _write_len_delimited(body, 2, bytes(inner))  # float_list
+            elif (np.issubdtype(arr.dtype, np.integer)
+                  or arr.dtype == np.bool_):
+                inner = bytearray()
+                packed = bytearray()
+                for v in arr.reshape(-1).astype(np.int64).tolist():
+                    _write_varint(packed, v & 0xFFFFFFFFFFFFFFFF)
+                _write_len_delimited(inner, 1, bytes(packed))
+                _write_len_delimited(body, 3, bytes(inner))  # int64_list
+            else:
+                raise TypeError(
+                    f"field {name!r}: unsupported dtype {arr.dtype}")
+        # map entry: key = field 1 (string), value = field 2 (Feature)
+        entry = bytearray()
+        _write_len_delimited(entry, 1, name.encode())
+        _write_len_delimited(entry, 2, bytes(body))
+        _write_len_delimited(feats, 1, bytes(entry))
+    example = bytearray()
+    _write_len_delimited(example, 1, bytes(feats))  # Example.features
+    return bytes(example)
+
+
+def _decode_float_list(buf: bytes) -> list[float]:
+    out: list[float] = []
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 2:  # packed
+            n, pos = _read_varint(buf, pos)
+            out.extend(struct.unpack(f"<{n // 4}f", buf[pos:pos + n]))
+            pos += n
+        elif field == 1 and wire == 5:  # unpacked
+            out.append(struct.unpack("<f", buf[pos:pos + 4])[0])
+            pos += 4
+        else:
+            pos = _skip_field(buf, pos, wire)
+    return out
+
+
+def _decode_int64_list(buf: bytes) -> list[int]:
+    out: list[int] = []
+    pos = 0
+
+    def _signed(v: int) -> int:
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 2:  # packed
+            n, pos = _read_varint(buf, pos)
+            end = pos + n
+            while pos < end:
+                v, pos = _read_varint(buf, pos)
+                out.append(_signed(v))
+        elif field == 1 and wire == 0:  # unpacked
+            v, pos = _read_varint(buf, pos)
+            out.append(_signed(v))
+        else:
+            pos = _skip_field(buf, pos, wire)
+    return out
+
+
+def _decode_bytes_list(buf: bytes) -> list[bytes]:
+    out: list[bytes] = []
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 2:
+            n, pos = _read_varint(buf, pos)
+            out.append(buf[pos:pos + n])
+            pos += n
+        else:
+            pos = _skip_field(buf, pos, wire)
+    return out
+
+
+def _decode_feature(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2 and field in (1, 2, 3):
+            n, pos = _read_varint(buf, pos)
+            payload = buf[pos:pos + n]
+            pos += n
+            if field == 1:
+                return _decode_bytes_list(payload)
+            if field == 2:
+                return np.asarray(_decode_float_list(payload), np.float32)
+            return np.asarray(_decode_int64_list(payload), np.int64)
+        pos = _skip_field(buf, pos, wire)
+    return np.asarray([], np.float32)  # empty Feature
+
+
+def decode_example(data: bytes) -> dict[str, object]:
+    """Serialized ``tf.train.Example`` → ``{name: ndarray | [bytes]}``
+    (flat values; apply shapes via ``TFRecordSource``'s feature spec)."""
+    out: dict[str, object] = {}
+    pos = 0
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 2:  # Example.features
+            n, pos = _read_varint(data, pos)
+            feats = data[pos:pos + n]
+            pos += n
+            fpos = 0
+            while fpos < len(feats):
+                ftag, fpos = _read_varint(feats, fpos)
+                ffield, fwire = ftag >> 3, ftag & 7
+                if ffield == 1 and fwire == 2:  # map entry
+                    en, fpos = _read_varint(feats, fpos)
+                    entry = feats[fpos:fpos + en]
+                    fpos += en
+                    key, value = None, None
+                    epos = 0
+                    while epos < len(entry):
+                        etag, epos = _read_varint(entry, epos)
+                        efield, ewire = etag >> 3, etag & 7
+                        if ewire == 2:
+                            vn, epos = _read_varint(entry, epos)
+                            payload = entry[epos:epos + vn]
+                            epos += vn
+                            if efield == 1:
+                                key = payload.decode()
+                            elif efield == 2:
+                                value = _decode_feature(payload)
+                        else:
+                            epos = _skip_field(entry, epos, ewire)
+                    if key is not None:
+                        out[key] = value
+                else:
+                    fpos = _skip_field(feats, fpos, fwire)
+        else:
+            pos = _skip_field(data, pos, wire)
+    return out
+
+
+# --- record-level IO --------------------------------------------------------
+
+
+class TFRecordWriter:
+    """Write raw records in TFRecord framing (context-manager friendly)."""
+
+    def __init__(self, path: Union[str, Path]):
+        self._f = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", _masked_crc(record)))
+
+    def write_example(self, features: dict[str, np.ndarray]) -> None:
+        self.write(encode_example(features))
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: Union[str, Path], *, verify_crc: bool = True):
+    """Yield raw record payloads from one TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) != 8:
+                raise ValueError(f"{path}: truncated length header")
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and _masked_crc(header) != len_crc:
+                raise ValueError(f"{path}: corrupt length crc")
+            payload = f.read(length)
+            if len(payload) != length:
+                raise ValueError(f"{path}: truncated record")
+            (crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and _masked_crc(payload) != crc:
+                raise ValueError(f"{path}: corrupt record crc")
+            yield payload
+
+
+def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
+    """One sequential pass → [(payload_offset, payload_length)]."""
+    index = []
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            header = f.read(8)
+            if not header:
+                return index
+            if len(header) != 8:
+                raise ValueError(f"{path}: truncated length header")
+            (length,) = struct.unpack("<Q", header)
+            index.append((pos + 12, length))
+            pos += 12 + length + 4
+            f.seek(pos)
+
+
+class TFRecordSource:
+    """Random access over TFRecord file(s) of ``tf.train.Example`` protos.
+
+    ``features``: FixedLenFeature-style spec ``{name: (shape, dtype)}`` —
+    flat Example values are reshaped/cast per field.  ``None`` returns the
+    raw decoded dict (flat arrays / byte lists).  Multiple paths act as
+    one concatenated dataset whose file boundaries are the FILE-autoshard
+    units (wrap in ``pipeline.ConcatSource`` semantics via ``as_parts``).
+    """
+
+    def __init__(self, paths: Union[str, Path, Sequence[Union[str, Path]]],
+                 features: Optional[dict[str, tuple]] = None):
+        if isinstance(paths, (str, Path)):
+            paths = [paths]
+        self.paths = [Path(p) for p in paths]
+        if not self.paths:
+            raise ValueError("TFRecordSource needs at least one path")
+        self.features = features
+        self._index: list[tuple[int, int, int]] = []  # (file, offset, len)
+        for fi, p in enumerate(self.paths):
+            for off, length in _index_file(p):
+                self._index.append((fi, off, length))
+        self._handles: dict[int, object] = {}
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        if idx < 0 or idx >= len(self._index):
+            raise IndexError(idx)
+        fi, off, length = self._index[idx]
+        f = self._handles.get(fi)
+        if f is None:
+            f = self._handles[fi] = open(self.paths[fi], "rb")
+        f.seek(off)
+        rec = decode_example(f.read(length))
+        if self.features is None:
+            return rec
+        out = {}
+        for name, (shape, dtype) in self.features.items():
+            if name not in rec:
+                raise KeyError(
+                    f"record {idx} missing feature {name!r}; has "
+                    f"{sorted(rec)}")
+            out[name] = np.asarray(rec[name]).reshape(shape).astype(dtype)
+        return out
+
+    def as_parts(self, features: Optional[dict[str, tuple]] = None):
+        """Per-file sources for FILE autoshard (``ConcatSource(parts)``)."""
+        return [TFRecordSource(p, features or self.features)
+                for p in self.paths]
+
+
+def convert_to_shards(tfrecord_paths, out_root, features,
+                      num_shards: int):
+    """One-time migration: TFRecord corpus → the mmap hot-path format."""
+    from tensorflow_train_distributed_tpu.data.filesource import write_shards
+
+    src = TFRecordSource(tfrecord_paths, features)
+    return write_shards(out_root, src, num_shards)
