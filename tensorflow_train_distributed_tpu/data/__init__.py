@@ -32,3 +32,8 @@ from tensorflow_train_distributed_tpu.data.filesource import (  # noqa: F401
     open_sharded,
     write_shards,
 )
+from tensorflow_train_distributed_tpu.data.tfrecord import (  # noqa: F401
+    TFRecordSource,
+    TFRecordWriter,
+    open_tfrecord_dir,
+)

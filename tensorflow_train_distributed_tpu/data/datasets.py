@@ -246,6 +246,15 @@ def _array_dir(root: str, transform=None):
     return open_sharded(root, transform=transform)
 
 
+def _tfrecord_dir(root: str, transform=None):
+    """Directory of ``*.tfrecord`` files + ``features.json`` sidecar."""
+    from tensorflow_train_distributed_tpu.data.tfrecord import (
+        open_tfrecord_dir,
+    )
+
+    return open_tfrecord_dir(root, transform=transform)
+
+
 _REGISTRY = {
     "mnist": SyntheticMNIST,
     "blobs": SyntheticBlobs,
@@ -254,6 +263,7 @@ _REGISTRY = {
     "mlm": SyntheticMLM,
     "wmt": SyntheticWMT,
     "array_dir": _array_dir,
+    "tfrecord_dir": _tfrecord_dir,
 }
 
 

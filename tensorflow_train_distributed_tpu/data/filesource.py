@@ -42,6 +42,19 @@ TRANSFORMS: dict[str, Callable[[dict], dict]] = {
 }
 
 
+def resolve_transform(
+    transform: Union[Callable[[dict], dict], str, None],
+) -> Optional[Callable[[dict], dict]]:
+    """Resolve a ``TRANSFORMS`` name (or pass a callable/None through)."""
+    if isinstance(transform, str):
+        if transform not in TRANSFORMS:
+            raise ValueError(
+                f"Unknown transform {transform!r}; available: "
+                f"{sorted(TRANSFORMS)}")
+        return TRANSFORMS[transform]
+    return transform
+
+
 class MmapArraySource:
     """One shard dir of ``.npy`` columns, memory-mapped; random access.
 
@@ -68,13 +81,7 @@ class MmapArraySource:
                     f"manifest says {n}")
             self.columns[name] = arr
         self._n = n
-        if isinstance(transform, str):
-            if transform not in TRANSFORMS:
-                raise ValueError(
-                    f"Unknown transform {transform!r}; available: "
-                    f"{sorted(TRANSFORMS)}")
-            transform = TRANSFORMS[transform]
-        self.transform = transform
+        self.transform = resolve_transform(transform)
 
     def __len__(self) -> int:
         return self._n

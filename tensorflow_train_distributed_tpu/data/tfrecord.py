@@ -25,6 +25,7 @@ Sequential-proto decode is NOT the hot path (that is the mmap format in
 
 from __future__ import annotations
 
+import json
 import struct
 from pathlib import Path
 from typing import Optional, Sequence, Union
@@ -323,8 +324,14 @@ def read_records(path: Union[str, Path], *, verify_crc: bool = True):
 
 
 def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
-    """One sequential pass → [(payload_offset, payload_length)]."""
+    """One sequential pass → [(payload_offset, payload_length)].
+
+    Bounds-checks every record against the file size so a file truncated
+    mid-record (crashed writer) fails loudly at open time, not as an
+    opaque decode error mid-training.
+    """
     index = []
+    size = Path(path).stat().st_size
     with open(path, "rb") as f:
         pos = 0
         while True:
@@ -334,8 +341,13 @@ def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
             if len(header) != 8:
                 raise ValueError(f"{path}: truncated length header")
             (length,) = struct.unpack("<Q", header)
+            end = pos + 12 + length + 4
+            if end > size:
+                raise ValueError(
+                    f"{path}: truncated record at offset {pos} "
+                    f"(needs {end} bytes, file has {size})")
             index.append((pos + 12, length))
-            pos += 12 + length + 4
+            pos = end
             f.seek(pos)
 
 
@@ -361,18 +373,29 @@ class TFRecordSource:
         for fi, p in enumerate(self.paths):
             for off, length in _index_file(p):
                 self._index.append((fi, off, length))
-        self._handles: dict[int, object] = {}
+        # LRU-bounded handle cache: big corpora (1000s of shard files)
+        # must not exhaust the process fd limit.
+        self._handles: "dict[int, object]" = {}
+        self._max_handles = 64
 
     def __len__(self) -> int:
         return len(self._index)
+
+    def _handle(self, fi: int):
+        f = self._handles.pop(fi, None)
+        if f is None:
+            if len(self._handles) >= self._max_handles:
+                lru = next(iter(self._handles))  # least recently used
+                self._handles.pop(lru).close()
+            f = open(self.paths[fi], "rb")
+        self._handles[fi] = f  # re-insert → most recently used
+        return f
 
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
         if idx < 0 or idx >= len(self._index):
             raise IndexError(idx)
         fi, off, length = self._index[idx]
-        f = self._handles.get(fi)
-        if f is None:
-            f = self._handles[fi] = open(self.paths[fi], "rb")
+        f = self._handle(fi)
         f.seek(off)
         rec = decode_example(f.read(length))
         if self.features is None:
@@ -390,6 +413,84 @@ class TFRecordSource:
         """Per-file sources for FILE autoshard (``ConcatSource(parts)``)."""
         return [TFRecordSource(p, features or self.features)
                 for p in self.paths]
+
+
+FEATURES_SIDECAR = "features.json"
+
+_DTYPES = {"float32": np.float32, "float64": np.float64,
+           "int32": np.int32, "int64": np.int64, "uint8": np.uint8,
+           "bool": np.bool_}
+
+
+def write_features_sidecar(root: Union[str, Path],
+                           features: dict[str, tuple]) -> Path:
+    """Persist a feature spec as ``features.json`` next to the tfrecords,
+    so directory-level opens (CLI ``--data-dir``) need no Python spec."""
+    root = Path(root)
+    spec = {name: {"shape": list(shape), "dtype": np.dtype(dtype).name}
+            for name, (shape, dtype) in features.items()}
+    out = root / FEATURES_SIDECAR
+    out.write_text(json.dumps({"features": spec}))
+    return out
+
+
+def read_features_sidecar(root: Union[str, Path]) -> dict[str, tuple]:
+    spec = json.loads((Path(root) / FEATURES_SIDECAR).read_text())
+    out = {}
+    for name, f in spec["features"].items():
+        dtype = f["dtype"]
+        if dtype not in _DTYPES:
+            raise ValueError(
+                f"{FEATURES_SIDECAR}: feature {name!r} has unsupported "
+                f"dtype {dtype!r}; supported: {sorted(_DTYPES)}")
+        out[name] = (tuple(f["shape"]), _DTYPES[dtype])
+    return out
+
+
+def open_tfrecord_dir(root: Union[str, Path],
+                      features: Optional[dict[str, tuple]] = None,
+                      transform=None):
+    """Open a directory of ``*.tfrecord`` files as a ``ConcatSource``.
+
+    Each file is one FILE-autoshard part (``DataConfig(shard_policy=
+    "file")`` hands whole files to processes — the reference's FILE policy
+    unit, SURVEY.md §3.5).  The feature spec comes from ``features`` or a
+    ``features.json`` sidecar; ``transform`` is a callable or a
+    ``filesource.TRANSFORMS`` name applied per record.
+    """
+    from tensorflow_train_distributed_tpu.data.filesource import (
+        resolve_transform,
+    )
+    from tensorflow_train_distributed_tpu.data.pipeline import ConcatSource
+
+    root = Path(root)
+    paths = sorted(root.glob("*.tfrecord"))
+    if not paths:
+        raise FileNotFoundError(f"no *.tfrecord files under {root}")
+    if features is None:
+        if not (root / FEATURES_SIDECAR).is_file():
+            raise FileNotFoundError(
+                f"{root} has no {FEATURES_SIDECAR}; pass features= or "
+                "write one with write_features_sidecar()")
+        features = read_features_sidecar(root)
+    transform = resolve_transform(transform)
+    parts = [TFRecordSource(p, features) for p in paths]
+    if transform is not None:
+        parts = [_TransformedSource(p, transform) for p in parts]
+    return ConcatSource(parts)
+
+
+class _TransformedSource:
+    """Apply a record transform over any ``RandomAccessSource``."""
+
+    def __init__(self, source, transform):
+        self.source, self.transform = source, transform
+
+    def __len__(self) -> int:
+        return len(self.source)
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        return self.transform(self.source[idx])
 
 
 def convert_to_shards(tfrecord_paths, out_root, features,

@@ -249,7 +249,14 @@ def run(args: argparse.Namespace) -> RunResult:
     # distribution (documented train-set monitoring).
     global_batch = args.global_batch_size or entry["global_batch_size"]
     if args.data_dir:
-        source = get_dataset("array_dir", root=args.data_dir,
+        # Autodetect format: a dir of *.tfrecord files (the reference's
+        # tf.data corpus convention) vs the native mmap part-*/ layout.
+        import pathlib
+
+        kind = ("tfrecord_dir"
+                if any(pathlib.Path(args.data_dir).glob("*.tfrecord"))
+                else "array_dir")
+        source = get_dataset(kind, root=args.data_dir,
                              transform=args.data_transform)
     else:
         source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])

@@ -60,6 +60,11 @@ LLAMA_PRESETS = {
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
                             ffn_size=5504),
+    # ~125M-param GPT-2-small-class decoder: the flagship fwd path at a
+    # size that compiles fast everywhere (same code path as llama2_7b;
+    # also the __graft_entry__ flagship and the LM benchmark default).
+    "llama_125m": LlamaConfig(d_model=768, num_layers=12, num_heads=12,
+                              ffn_size=2048, max_positions=2048),
     "llama_tiny": LlamaConfig(vocab_size=256, d_model=64, num_layers=2,
                               num_heads=4, num_kv_heads=2, ffn_size=128,
                               max_positions=128, dtype=jnp.float32,

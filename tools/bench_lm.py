@@ -1,0 +1,152 @@
+"""Decoder (Llama-family) training throughput: tokens/sec/chip + MFU.
+
+Secondary benchmark (the driver's headline is bench.py / ResNet-50): the
+flagship causal-LM path — RoPE/RMSNorm/SwiGLU, scan+remat, pallas flash
+attention on TPU — measured end-to-end through the jitted Trainer step.
+
+MFU uses the standard decoder FLOP estimate (PaLM-appendix style):
+  flops/token ≈ 6·N_params + 12·L·d_model·seq·0.5   (causal attention)
+fwd+bwd included in the 6·N factor; remat recompute is NOT counted (MFU is
+model FLOPs, not hardware FLOPs — remat makes true utilization higher).
+
+Prints one JSON line per benched config.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# bf16 peak TFLOP/s by TPU generation (device_kind substrings); MFU is
+# omitted for kinds not listed rather than reported against a wrong peak.
+PEAK_TFLOPS_BY_KIND = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6": 918.0,
+}
+
+
+def peak_tflops(device) -> float | None:
+    if device.platform != "tpu":
+        return None
+    kind = device.device_kind.lower()
+    for sub, peak in PEAK_TFLOPS_BY_KIND.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def param_count(tree):
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
+             remat=None):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_train_distributed_tpu.models import llama
+    from tensorflow_train_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+    from tensorflow_train_distributed_tpu.training import (
+        Policy, Trainer, TrainerConfig,
+    )
+
+    import dataclasses
+
+    cfg = llama.LLAMA_PRESETS[preset]
+    if remat is not None:
+        # remat trades recompute for memory; when the model fits without
+        # it (small presets, single chip) turning it off is pure speed.
+        cfg = dataclasses.replace(cfg, remat=remat)
+    if seq > cfg.max_positions:
+        raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
+    mesh = build_mesh(MeshConfig(data=-1))
+    n_chips = mesh.devices.size
+    task = llama.CausalLmTask(cfg)
+    trainer = Trainer(
+        task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1), mesh,
+        policy=Policy.from_name("mixed_bfloat16"),
+        config=TrainerConfig(log_every=1_000_000),
+    )
+    rng = np.random.default_rng(0)
+    global_batch = batch * n_chips
+    data = {
+        "tokens": rng.integers(0, cfg.vocab_size,
+                               (global_batch, seq)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size,
+                                (global_batch, seq)).astype(np.int32),
+    }
+    state = trainer.create_state(data)
+    n_params = param_count(state.params)
+    step = trainer._compiled_train_step()
+    dev_batch = shard_batch(mesh, data)
+    for _ in range(warmup):
+        state, m = step(state, dev_batch)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, dev_batch)
+    jax.block_until_ready(m)
+    dt = (time.perf_counter() - t0) / iters
+    tok_per_sec_chip = global_batch * seq / dt / n_chips
+    dev0 = mesh.devices.flat[0]
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.d_model * \
+        seq * 0.5
+    rec = {
+        "metric": f"{preset}_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "step_time_ms": round(dt * 1e3, 2),
+        "batch_per_chip": batch,
+        "seq_len": seq,
+        "n_chips": n_chips,
+        "n_params": n_params,
+        "backend": dev0.platform,
+    }
+    peak = peak_tflops(dev0)
+    if peak is not None:
+        mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
+        rec["mfu_pct"] = round(100 * mfu, 2)
+        rec["device_kind"] = dev0.device_kind
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="llama_125m")
+    p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--iters", type=int, default=10)
+    rm = p.add_mutually_exclusive_group()
+    rm.add_argument("--remat", dest="remat", action="store_true",
+                    default=None, help="force activation remat on")
+    rm.add_argument("--no-remat", dest="remat", action="store_false",
+                    help="disable remat (faster when memory allows)")
+    args = p.parse_args(argv)
+    try:
+        rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
+                       args.warmup, args.iters, remat=args.remat)
+    except Exception as e:  # machine-readable failure, bench.py lesson
+        print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
+                          "_per_chip", "value": 0.0,
+                          "unit": "tokens/sec/chip",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
