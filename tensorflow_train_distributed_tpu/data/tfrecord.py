@@ -370,8 +370,11 @@ class TFRecordSource:
             raise ValueError("TFRecordSource needs at least one path")
         self.features = features
         self._index: list[tuple[int, int, int]] = []  # (file, offset, len)
+        self._file_counts: list[int] = []
         for fi, p in enumerate(self.paths):
-            for off, length in _index_file(p):
+            entries = _index_file(p)
+            self._file_counts.append(len(entries))
+            for off, length in entries:
                 self._index.append((fi, off, length))
         # LRU-bounded handle cache: big corpora (1000s of shard files)
         # must not exhaust the process fd limit.
@@ -409,10 +412,33 @@ class TFRecordSource:
             out[name] = np.asarray(rec[name]).reshape(shape).astype(dtype)
         return out
 
-    def as_parts(self, features: Optional[dict[str, tuple]] = None):
-        """Per-file sources for FILE autoshard (``ConcatSource(parts)``)."""
-        return [TFRecordSource(p, features or self.features)
-                for p in self.paths]
+    def as_parts(self):
+        """Per-file views for FILE autoshard (``ConcatSource(parts)``).
+
+        Views, not new sources: all parts share this source's index and
+        LRU-bounded handle cache, so a 5000-file corpus still holds at
+        most ``_max_handles`` fds process-wide.
+        """
+        parts, start = [], 0
+        for count in self._file_counts:
+            parts.append(_SourceSlice(self, start, count))
+            start += count
+        return parts
+
+
+class _SourceSlice:
+    """Contiguous view into a ``RandomAccessSource`` (one file's records)."""
+
+    def __init__(self, source, start: int, count: int):
+        self.source, self.start, self.count = source, start, count
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        if idx < 0 or idx >= self.count:
+            raise IndexError(idx)
+        return self.source[self.start + idx]
 
 
 FEATURES_SIDECAR = "features.json"
@@ -474,7 +500,11 @@ def open_tfrecord_dir(root: Union[str, Path],
                 "write one with write_features_sidecar()")
         features = read_features_sidecar(root)
     transform = resolve_transform(transform)
-    parts = [TFRecordSource(p, features) for p in paths]
+    # ONE source over all files (shared index + LRU handle cache), exposed
+    # as per-file views so FILE autoshard still hands whole files out —
+    # per-file sources would each cache fds and defeat the LRU bound.
+    source = TFRecordSource(paths, features)
+    parts = source.as_parts()
     if transform is not None:
         parts = [_TransformedSource(p, transform) for p in parts]
     return ConcatSource(parts)

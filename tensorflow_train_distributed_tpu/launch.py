@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-transform", default=None,
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
+    p.add_argument("--init-from-hf", default=None, metavar="DIR",
+                   help="initialize a Llama-family config's params from a "
+                        "local HuggingFace checkpoint dir (the config's "
+                        "model dims must match the checkpoint)")
     p.add_argument("--eval-split", type=float, default=0.0,
                    help="fraction of the dataset held out as a validation "
                         "split for --eval-every/--eval-steps (Keras "
@@ -359,6 +363,30 @@ def run(args: argparse.Namespace) -> RunResult:
             state = trainer.create_state(sample)
             state = ckpt.restore(state)
             logger.info("resumed from step %d", int(state.step))
+        elif args.init_from_hf:
+            # SFT entry point: start from a local HF Llama checkpoint
+            # (models.import_hf) instead of random init; a later resume
+            # from --checkpoint-dir takes precedence over re-importing.
+            from tensorflow_train_distributed_tpu.models.import_hf import (
+                import_llama,
+            )
+            from tensorflow_train_distributed_tpu.models.llama import (
+                LlamaConfig,
+            )
+
+            task_cfg = getattr(task, "config", None)
+            if not isinstance(task_cfg, LlamaConfig):
+                raise SystemExit(
+                    f"--init-from-hf needs a Llama-family --config; "
+                    f"{args.config!r} is not one")
+            # The task's config decides the param-tree layout (scan vs
+            # per-layer) and validates dims against the checkpoint.
+            hf_cfg, hf_params = import_llama(args.init_from_hf,
+                                             config=task_cfg)
+            state = trainer.create_state(next(iter(loader)),
+                                         params=hf_params)
+            logger.info("initialized from HF checkpoint %s (%d layers)",
+                        args.init_from_hf, hf_cfg.num_layers)
 
         remaining = args.steps - (0 if state is None else int(state.step))
         k = args.steps_per_execution

@@ -1,0 +1,148 @@
+"""Import HuggingFace Llama-family checkpoints into the native model.
+
+Migration path for the reference's SFT config (SURVEY.md §2.1 config[4]:
+"Llama-2-7B SFT"): users arrive with HF ``LlamaForCausalLM`` weights; this
+maps them onto ``models.llama.LlamaModel``'s parameter tree so fine-tuning
+continues here with TP/SP/FSDP shardings instead of the reference's DTensor
+mesh.
+
+Conventions that make the mapping exact (verified by the forward-parity
+test against the torch implementation, tests/test_import_hf.py):
+
+- torch ``nn.Linear`` stores ``[out, in]``; flax kernels are ``[in, out]``
+  → every projection transposes.
+- RoPE: both use the split-half ("rotate_half") pairing with
+  ``inv_freq = base^(-2i/d)`` — q/k copy over with no permutation.
+- RMSNorm epsilon/scale and the SwiGLU gate/up/down order match 1:1.
+
+Only the Llama family is importable: our BERT encoder deliberately omits
+token-type embeddings and q/k/v biases (TPU-first simplifications), so an
+HF BERT checkpoint cannot be represented exactly — rejected with an error
+rather than imported approximately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from tensorflow_train_distributed_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
+    if getattr(hf_config, "model_type", "llama") not in ("llama", "mistral"):
+        raise ValueError(
+            f"import_hf supports Llama-family checkpoints, got model_type="
+            f"{hf_config.model_type!r} (BERT-style models are not exactly "
+            "representable here — see module docstring)")
+    kv = getattr(hf_config, "num_key_value_heads",
+                 hf_config.num_attention_heads)
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=None if kv == hf_config.num_attention_heads else kv,
+        ffn_size=hf_config.intermediate_size,
+        max_positions=hf_config.max_position_embeddings,
+        rope_base=getattr(hf_config, "rope_theta", 10_000.0),
+        rms_epsilon=hf_config.rms_norm_eps,
+    )
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy (params live in f32)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _layer_tree(sd, i: int) -> dict:
+    """One decoder layer's flax param tree from an HF state dict."""
+    p = f"model.layers.{i}."
+    return {
+        "attn_norm": {"scale": _np(sd[p + "input_layernorm.weight"])},
+        "attention": {
+            "query": {"kernel": _np(sd[p + "self_attn.q_proj.weight"]).T},
+            "key": {"kernel": _np(sd[p + "self_attn.k_proj.weight"]).T},
+            "value": {"kernel": _np(sd[p + "self_attn.v_proj.weight"]).T},
+            "out": {"kernel": _np(sd[p + "self_attn.o_proj.weight"]).T},
+        },
+        "mlp_norm": {"scale": _np(sd[p + "post_attention_layernorm.weight"])},
+        "mlp": {
+            "wi_gate": {"kernel": _np(sd[p + "mlp.gate_proj.weight"]).T},
+            "wi_up": {"kernel": _np(sd[p + "mlp.up_proj.weight"]).T},
+            "wo": {"kernel": _np(sd[p + "mlp.down_proj.weight"]).T},
+        },
+    }
+
+
+def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
+    """HF ``LlamaForCausalLM`` state dict → native flax ``params`` tree.
+
+    Honors ``config.scan_layers`` (stacks per-layer trees along a leading
+    axis, the nn.scan layout) vs per-layer ``layer_{i}`` modules.
+    """
+    sd = state_dict
+    embed = _np(sd["model.embed_tokens.weight"])
+    if embed.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"checkpoint embed is {embed.shape}, config expects "
+            f"{(config.vocab_size, config.d_model)}")
+    # Exact layer-count match: a deeper checkpoint must not be silently
+    # truncated (training would proceed on a corrupted model), a shallower
+    # one fails here instead of with an opaque KeyError mid-mapping.
+    def _has_layer(i):
+        return f"model.layers.{i}.input_layernorm.weight" in sd
+
+    if _has_layer(config.num_layers) or not _has_layer(
+            config.num_layers - 1):
+        n = 0
+        while _has_layer(n):
+            n += 1
+        raise ValueError(
+            f"checkpoint has {n} decoder layers, config expects "
+            f"{config.num_layers}")
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T
+    else:  # tied-embedding checkpoints omit the head
+        lm_head = embed.T.copy()
+    params = {
+        "token_embed": {"embedding": embed},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+        "lm_head": {"kernel": lm_head},
+    }
+    layers = [_layer_tree(sd, i) for i in range(config.num_layers)]
+    if config.scan_layers:
+        import jax
+
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *layers)
+        params["layers"] = {"stack": {"block": stacked}}
+    else:
+        for i, tree in enumerate(layers):
+            params[f"layer_{i}"] = tree
+    return params
+
+
+def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
+                 **config_overrides):
+    """(native_config, params) from an HF model instance or local path.
+
+    ``config_overrides`` tweak the derived config (e.g. ``scan_layers=
+    False``, ``seq_parallel="ring"``) — anything not changing parameter
+    shapes is safe.
+    """
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    if config is None:
+        config = config_from_hf(model_or_path.config)
+    if config_overrides:
+        config = dataclasses.replace(config, **config_overrides)
+    params = import_llama_state_dict(model_or_path.state_dict(), config)
+    return config, params

@@ -125,13 +125,18 @@ class Trainer:
 
     # -- state ---------------------------------------------------------------
 
-    def create_state(self, sample_batch) -> TrainState:
+    def create_state(self, sample_batch, params=None) -> TrainState:
         """Init params on-device directly into their target shardings.
 
         The jit-with-out_shardings pattern means a 7B-param model never
         materializes unsharded on one chip — the analog of the reference
         creating variables under ``strategy.scope()`` (``distribute_lib.py:
         1223``) but placement-correct from the first byte.
+
+        ``params``: optional pre-trained parameter tree (e.g. from
+        ``models.import_hf``) replacing the random init; leaves are cast to
+        the init dtypes and placed into the same target shardings, so
+        fine-tuning from a checkpoint shards identically to from-scratch.
         """
         rng = jax.random.key(self.config.seed)
         batch_shapes = jax.tree.map(
@@ -165,6 +170,16 @@ class Trainer:
             state = jax.jit(_create, out_shardings=self.state_shardings)()
         state = nn.unbox(state)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
+        if params is not None:
+            # Cast on HOST, then device_put straight into the target
+            # sharding: a jnp cast would materialize each full leaf on one
+            # device first — a 7B scan-stacked FFN kernel is ~5.8 GB/leaf,
+            # which must never exist unsharded on a 16 GB chip.
+            loaded = jax.tree.map(
+                lambda init, p: jax.device_put(
+                    np.asarray(p).astype(init.dtype), init.sharding),
+                state.params, params)
+            state = state.replace(params=loaded)
         logger.info("created state: %.2fM params", state.num_params() / 1e6)
         return state
 

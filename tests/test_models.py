@@ -70,6 +70,61 @@ class TestArchitectures:
                               jnp.zeros((1, 8), jnp.int32))
         assert n_loop == n_scan
 
+    def test_llama_remat_policy_matches_full(self):
+        """'dots' remat saves more, recomputes less — same math: loss AND
+        gradients must match full remat exactly."""
+        import dataclasses
+
+        import jax
+        import numpy as np
+
+        from tensorflow_train_distributed_tpu.models.llama import (
+            CausalLmTask,
+        )
+
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": rng.integers(0, 256, (2, 32)).astype(np.int32),
+            "targets": rng.integers(0, 256, (2, 32)).astype(np.int32),
+        }
+
+        def loss_and_grad(policy):
+            cfg = dataclasses.replace(LLAMA_PRESETS["llama_tiny_scan"],
+                                      remat_policy=policy)
+            task = CausalLmTask(cfg)
+            variables = task.init_variables(jax.random.key(0), batch)
+
+            def loss(params):
+                value, _ = task.loss_fn(params, {}, batch,
+                                        jax.random.key(1), True)
+                return value
+
+            return jax.value_and_grad(loss)(variables["params"])
+
+        (l_full, g_full) = loss_and_grad("full")
+        (l_dots, g_dots) = loss_and_grad("dots")
+        np.testing.assert_allclose(float(l_full), float(l_dots), rtol=1e-6)
+        # Gradients: recompute-vs-saved changes f32 reassociation, so
+        # element-wise rounding differs; bound the relative tree error.
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=5e-3, atol=1e-5),
+            g_full, g_dots)
+
+    def test_llama_remat_policy_unknown_rejected(self):
+        import dataclasses
+
+        import pytest as _pytest
+
+        from tensorflow_train_distributed_tpu.models.llama import (
+            _checkpoint_policy,
+        )
+
+        cfg = dataclasses.replace(LLAMA_PRESETS["llama_tiny_scan"],
+                                  remat_policy="nope")
+        with _pytest.raises(ValueError, match="remat_policy"):
+            _checkpoint_policy(cfg)
+
 
 def _train_config(name, steps=12, mesh=None, **overrides):
     entry = registry.get_entry(name)

@@ -118,14 +118,18 @@ class TestCodec:
         assert src[0]["input_ids"].shape == (16,)
 
     def test_known_masked_crc(self, tmp_path):
-        # The length-header crc for an 11-byte record, cross-checked once
-        # against TF's writer ("hello world" record): any framing drift
-        # breaks real-TF interop even when our writer/reader agree.
+        # Byte-exact framing pinned against tf.io.TFRecordWriter's output
+        # for the b"hello world" record (captured once from TF 2.21): a
+        # shared writer/reader bug in _crc32c/_masked_crc (polynomial,
+        # mask constant, rotation) cannot pass this even when our own
+        # roundtrip still agrees with itself.
         p = tmp_path / "x.tfrecord"
         with TFRecordWriter(p) as w:
             w.write(b"hello world")
         raw = p.read_bytes()
         assert raw[:8] == (11).to_bytes(8, "little")
+        assert raw[8:12] == bytes.fromhex("8615f504")   # masked crc(header)
+        assert raw[-4:] == bytes.fromhex("007ed86d")    # masked crc(payload)
         assert len(raw) == 8 + 4 + 11 + 4
 
 
@@ -155,6 +159,30 @@ class TestSource:
         src = open_tfrecord_dir(tmp_path)
         assert len(src) == 12 and len(src.parts) == 3
         assert src[5]["input_ids"].dtype == np.int64
+
+    def test_dir_open_shares_one_handle_cache(self, tmp_path):
+        # Per-file parts must be views over ONE source (shared fd LRU) —
+        # per-file sources would hold one cached fd each and blow the
+        # process limit on 1000s-of-files corpora.
+        _write_mlm_files(tmp_path, files=4, records_per_file=2)
+        write_features_sidecar(tmp_path, FEATURES)
+        src = open_tfrecord_dir(tmp_path)
+        backing = {id(p.source) for p in src.parts}
+        assert len(backing) == 1
+        for i in range(len(src)):
+            src[i]
+        parent = src.parts[0].source
+        assert len(parent._handles) <= parent._max_handles
+
+    def test_as_parts_cover_all_records(self, tmp_path):
+        paths = _write_mlm_files(tmp_path, files=3, records_per_file=5)
+        src = TFRecordSource(paths, FEATURES)
+        parts = src.as_parts()
+        assert [len(p) for p in parts] == [5, 5, 5]
+        np.testing.assert_array_equal(parts[2][4]["input_ids"],
+                                      src[14]["input_ids"])
+        with pytest.raises(IndexError):
+            parts[0][5]
 
     def test_registry_entry(self, tmp_path):
         from tensorflow_train_distributed_tpu.data import get_dataset

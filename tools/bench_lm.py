@@ -48,7 +48,7 @@ def param_count(tree):
 
 
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
-             remat=None):
+             remat=None, remat_policy=None):
     import jax
     import numpy as np
     import optax
@@ -69,6 +69,8 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         # remat trades recompute for memory; when the model fits without
         # it (small presets, single chip) turning it off is pure speed.
         cfg = dataclasses.replace(cfg, remat=remat)
+    if remat_policy is not None:
+        cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
     mesh = build_mesh(MeshConfig(data=-1))
@@ -134,10 +136,14 @@ def main(argv=None) -> int:
                     default=None, help="force activation remat on")
     rm.add_argument("--no-remat", dest="remat", action="store_false",
                     help="disable remat (faster when memory allows)")
+    p.add_argument("--remat-policy", default=None,
+                   choices=("full", "dots"),
+                   help="what remat saves (see LlamaConfig.remat_policy)")
     args = p.parse_args(argv)
     try:
         rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
-                       args.warmup, args.iters, remat=args.remat)
+                       args.warmup, args.iters, remat=args.remat,
+                       remat_policy=args.remat_policy)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
                           "_per_chip", "value": 0.0,
