@@ -25,6 +25,7 @@ Public surface is re-exported here for convenience::
 from tensorflow_train_distributed_tpu.runtime.mesh import (  # noqa: F401
     MeshConfig,
     build_mesh,
+    hybrid_shapes,
     strategy_preset,
     STRATEGY_PRESETS,
 )

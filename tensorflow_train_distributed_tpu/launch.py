@@ -47,14 +47,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None, metavar="AXIS=N,...",
                    help="explicit mesh axis sizes overriding the preset, "
                         "e.g. data=4,tensor=2 (one axis may be -1)")
+    p.add_argument("--dcn", default=None, metavar="AXIS=N,...",
+                   help="multi-slice placement: how many slices divide each "
+                        "axis over DCN, e.g. data=4 (default: all slices on "
+                        "the outermost data-like axis)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--global-batch-size", type=int, default=None,
                    help="global batch size (default: the config's)")
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--optimizer", default="adamw",
-                   choices=["sgd", "momentum", "adam", "adamw"])
+                   choices=["sgd", "momentum", "adam", "adamw", "lamb",
+                            "adafactor"])
     p.add_argument("--weight-decay", type=float, default=0.0,
-                   help="decoupled weight decay (adamw only)")
+                   help="decoupled weight decay (adamw/lamb)")
     p.add_argument("--warmup-steps", type=int, default=None,
                    help="linear LR warmup steps (default: the config's "
                         "warmup_ratio × --steps)")
@@ -169,6 +174,16 @@ def _make_optimizer(args, entry):
         return optax.sgd(lr, momentum=0.9, nesterov=True), lr
     if args.optimizer == "adam":
         return optax.adam(lr), lr
+    if args.optimizer == "lamb":
+        # BERT large-batch convention (the reference's PS-pretrain config
+        # scaled with LAMB); layerwise trust ratios make the global batch
+        # scalable far past Adam's stability range.
+        return optax.lamb(lr, weight_decay=args.weight_decay), lr
+    if args.optimizer == "adafactor":
+        # Memory-frugal second-moment factorization — the optimizer of
+        # choice when optimizer state must not double 7B-param HBM use.
+        return optax.adafactor(
+            lr, weight_decay_rate=args.weight_decay or None), lr
     return optax.adamw(lr, weight_decay=args.weight_decay), lr
 
 
@@ -243,7 +258,8 @@ def run(args: argparse.Namespace) -> RunResult:
         if -1 not in sizes.values() and "data" not in overrides:
             sizes["data"] = -1  # let data absorb the remaining devices
         cfg = MeshConfig(strategy=strategy, **sizes)
-    mesh = build_mesh(cfg)
+    dcn_axes = _parse_mesh_overrides(args.dcn) if args.dcn else None
+    mesh = build_mesh(cfg, dcn_axes=dcn_axes)
     logger.info("mesh: %s (strategy=%s, %d devices)",
                 dict(mesh.shape), strategy, n_dev)
 

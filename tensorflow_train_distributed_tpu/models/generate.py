@@ -1,0 +1,99 @@
+"""Autoregressive generation with a KV cache for the decoder family.
+
+The reference is a training harness — its SFT config (SURVEY.md §2.1
+config[4]) produces a model users then sample from elsewhere; here the
+framework closes that loop natively.  TPU-first shape discipline: one
+jitted function, static prompt/output lengths, ``lax.scan`` over decode
+steps (no per-token dispatch), cache buffers donated between steps by XLA.
+
+Two phases inside one jit:
+- prefill: the whole prompt in a single call (``decode=True`` attention
+  appends all prompt positions to the cache at once, causal via the index
+  mask);
+- step: ``lax.scan`` over single-token calls, greedy or temperature
+  sampling.  Only the greedy-vs-sampling *branch* is static; the
+  temperature value is traced, so a temperature sweep reuses one compile.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModel,
+)
+
+
+def generate(config: LlamaConfig, params, prompt: jax.Array,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` [B, S].
+
+    ``temperature`` 0 → greedy argmax; > 0 → categorical sampling with
+    ``rng`` (required).  Returns [B, S + max_new_tokens] token ids.
+    Prompt + new tokens must fit ``config.max_positions`` (the cache size).
+    """
+    b, prompt_len = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got "
+                         f"{max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
+    if prompt_len + max_new_tokens > config.max_positions:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"max_positions={config.max_positions} (the KV cache size)")
+    if temperature < 0:
+        raise ValueError(
+            f"temperature must be >= 0, got {temperature} (negative "
+            "values invert the distribution)")
+    greedy = temperature == 0.0
+    if not greedy and rng is None:
+        raise ValueError("temperature sampling needs rng=")
+    if rng is None:
+        rng = jax.random.key(0)  # unused under greedy; keeps shapes static
+    return _generate(config, max_new_tokens, greedy, params, prompt,
+                     jnp.float32(temperature), rng)
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens", "greedy"))
+def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
+              params, prompt, temperature, rng):
+    # Cache sized to the request, not max_positions: a 30-token generation
+    # from a 4k-context config must not allocate (or attend over) 4k
+    # cache rows per layer.
+    model = LlamaModel(config, decode=True,
+                       cache_len=prompt.shape[1] + max_new_tokens)
+
+    def pick(logits, step_rng):
+        logits = logits.astype(jnp.float32)
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            step_rng, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    # Prefill: whole prompt at once; next token comes from the last logit.
+    logits, variables = model.apply(
+        {"params": params}, prompt, mutable=["cache"])
+    rngs = jax.random.split(rng, max_new_tokens)
+    first = pick(logits[:, -1], rngs[0])
+
+    def step(carry, step_rng):
+        cache, tok = carry
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        nxt = pick(logits[:, -1], step_rng)
+        return (updated["cache"], nxt), tok
+
+    # first is token 1 of n; n-1 scan steps sample the rest.  toks collects
+    # each step's *input* token, so toks = tokens 1..n-1 and `last` is n.
+    (_, last), toks = jax.lax.scan(
+        step, (variables["cache"], first), rngs[1:])
+    out = jnp.moveaxis(toks, 0, 1)
+    return jnp.concatenate([prompt, out, last[:, None]], axis=1)

@@ -38,6 +38,22 @@ def config_from_hf(hf_config) -> LlamaConfig:
             f"import_hf supports Llama-family checkpoints, got model_type="
             f"{hf_config.model_type!r} (BERT-style models are not exactly "
             "representable here — see module docstring)")
+    # Exact-or-rejected: attention-affecting options the native model does
+    # not implement must fail loudly, not import into silently-different
+    # logits.
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError(
+            "checkpoint uses rope_scaling (Llama-3-style scaled RoPE), "
+            "which the native model does not implement — importing would "
+            "silently change logits at every position")
+    if getattr(hf_config, "sliding_window", None):
+        raise ValueError(
+            "checkpoint uses sliding-window attention; the native model "
+            "attends globally — not exactly representable")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError(
+            "checkpoint has q/k/v/o projection biases; the native "
+            "attention is bias-free — not exactly representable")
     kv = getattr(hf_config, "num_key_value_heads",
                  hf_config.num_attention_heads)
     return LlamaConfig(
@@ -106,6 +122,12 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
         raise ValueError(
             f"checkpoint has {n} decoder layers, config expects "
             f"{config.num_layers}")
+    biases = [k for k in sd if k.endswith("proj.bias")]
+    if biases:
+        raise ValueError(
+            f"checkpoint has projection biases ({biases[0]}, ...); the "
+            "native attention/MLP are bias-free — not exactly "
+            "representable")
     if "lm_head.weight" in sd:
         lm_head = _np(sd["lm_head.weight"]).T
     else:  # tied-embedding checkpoints omit the head

@@ -143,33 +143,55 @@ class MultiHeadAttention(nn.Module):
     # effect when the ambient mesh (jax.set_mesh, as the Trainer binds)
     # has a seq axis > 1; self-attention only.
     seq_parallel: Optional[str] = None
+    # Autoregressive decode: keep a KV cache of ``cache_len`` positions in
+    # the mutable "cache" collection; each call appends this call's k/v at
+    # the running index and attends over the filled prefix.  Works for
+    # prefill (q_len = prompt length) and stepping (q_len = 1) alike.
+    decode: bool = False
+    cache_len: int = 0
+
+    def _proj(self, x, heads, name):
+        # Plain 2-D kernel (embed, heads*head_dim) + reshape: maps onto
+        # the MXU as one big matmul, and sidesteps flax's DenseGeneral
+        # boxed-kernel reshape which mis-applies logical constraints
+        # under an active mesh.  "heads" on the fused dim still gives
+        # Megatron TP (heads*head_dim stays divisible by the tensor
+        # axis whenever heads is).  Shared by the training and decode
+        # paths — the submodule name/init/partitioning contract between
+        # them lives here and only here.
+        y = nn.Dense(
+            heads * self.head_dim, use_bias=False, dtype=self.dtype,
+            name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads")),
+        )(x)
+        y = y.reshape(*x.shape[:-1], heads, self.head_dim)
+        return nn.with_logical_constraint(
+            y, ("batch", "length", "heads", "kv"))
+
+    def _out_proj(self, x, features):
+        return nn.Dense(
+            features, use_bias=False, dtype=self.dtype, name="out",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "embed")),
+        )(x)
 
     @nn.compact
     def __call__(self, x_q, x_kv=None, *, mask=None, positions=None,
                  deterministic: bool = True):
+        if self.decode:
+            if x_kv is not None or mask is not None:
+                raise ValueError(
+                    "decode=True is causal self-attention over the KV "
+                    "cache; cross-attention inputs (x_kv) and dense masks "
+                    "are not supported in decode mode")
+            return self._decode_step(x_q)
         x_kv = x_q if x_kv is None else x_kv
         kv_heads = self.num_kv_heads or self.num_heads
 
-        def proj(x, heads, name):
-            # Plain 2-D kernel (embed, heads*head_dim) + reshape: maps onto
-            # the MXU as one big matmul, and sidesteps flax's DenseGeneral
-            # boxed-kernel reshape which mis-applies logical constraints
-            # under an active mesh.  "heads" on the fused dim still gives
-            # Megatron TP (heads*head_dim stays divisible by the tensor
-            # axis whenever heads is).
-            y = nn.Dense(
-                heads * self.head_dim, use_bias=False, dtype=self.dtype,
-                name=name,
-                kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), ("embed", "heads")),
-            )(x)
-            y = y.reshape(*x.shape[:-1], heads, self.head_dim)
-            return nn.with_logical_constraint(
-                y, ("batch", "length", "heads", "kv"))
-
-        q = proj(x_q, self.num_heads, "query")
-        k = proj(x_kv, kv_heads, "key")
-        v = proj(x_kv, kv_heads, "value")
+        q = self._proj(x_q, self.num_heads, "query")
+        k = self._proj(x_kv, kv_heads, "key")
+        v = self._proj(x_kv, kv_heads, "value")
 
         if self.use_rope:
             if positions is None:
@@ -221,11 +243,76 @@ class MultiHeadAttention(nn.Module):
                                                 deterministic=deterministic)
         out = out.reshape(*out.shape[:-2],
                           self.num_heads * self.head_dim)
-        y = nn.Dense(
-            x_q.shape[-1], use_bias=False, dtype=self.dtype, name="out",
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "embed")),
-        )(out)
+        y = self._out_proj(out, x_q.shape[-1])
+        return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+
+    def _decode_step(self, x):
+        """Append x's tokens to the KV cache, attend over the prefix.
+
+        Submodule names match the training path exactly, so params trained
+        (or imported) without decode load unchanged; only the "cache"
+        collection is new.  Causal structure comes from the index mask, not
+        the kernel — decode q_len is tiny, the einsum path is the right
+        tool.
+        """
+        if self.cache_len <= 0:
+            raise ValueError("decode=True needs cache_len > 0")
+        kv_heads = self.num_kv_heads or self.num_heads
+        b, q_len, _ = x.shape
+
+        q = self._proj(x, self.num_heads, "query")
+        k = self._proj(x, kv_heads, "key")
+        v = self._proj(x, kv_heads, "value")
+
+        cache_k = self.variable(
+            "cache", "key_cache", jnp.zeros,
+            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+        cache_v = self.variable(
+            "cache", "value_cache", jnp.zeros,
+            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32))
+        cur = index.value
+
+        positions = cur + jnp.arange(q_len)
+        if self.use_rope:
+            pos_b = jnp.broadcast_to(positions, (b, q_len))
+            q = apply_rope(q, pos_b, base=self.rope_base)
+            k = apply_rope(k, pos_b, base=self.rope_base)
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cache_k.value.dtype), (0, cur, 0, 0))
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cache_v.value.dtype), (0, cur, 0, 0))
+        index.value = cur + q_len
+
+        # Same logical sharding as the training path: under a tensor/fsdp
+        # mesh the cache reads and attention activations shard over heads
+        # rather than replicating (B, cache_len, H, D) per device.
+        kh = nn.with_logical_constraint(
+            cache_k.value, ("batch", "length", "heads", "kv"))
+        vh = nn.with_logical_constraint(
+            cache_v.value, ("batch", "length", "heads", "kv"))
+        if kv_heads != self.num_heads:
+            rep = self.num_heads // kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        # [B, S, H, D] → [B, H, S, D]; valid kv = filled AND causal ≤ q pos.
+        qh = q.transpose(0, 2, 1, 3)
+        kh = kh.transpose(0, 2, 1, 3)
+        vh = vh.transpose(0, 2, 1, 3)
+        kv_pos = jnp.arange(self.cache_len)
+        mask = kv_pos[None, :] <= positions[:, None]       # [q, cache]
+        mask = mask[None, None]                            # [1, 1, q, cache]
+        from tensorflow_train_distributed_tpu.ops.attention import (
+            dot_product_attention,
+        )
+
+        out = dot_product_attention(qh, kh, vh, mask=mask)
+        out = out.transpose(0, 2, 1, 3)
+        out = nn.with_logical_constraint(
+            out, ("batch", "length", "heads", "kv"))
+        out = out.reshape(b, q_len, self.num_heads * self.head_dim)
+        y = self._out_proj(out, x.shape[-1])
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))
 
 

@@ -106,6 +106,8 @@ def _checkpoint_policy(cfg: LlamaConfig):
 
 class DecoderBlock(nn.Module):
     config: LlamaConfig
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -118,6 +120,8 @@ class DecoderBlock(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
+            decode=self.decode,
+            cache_len=self.cache_len or cfg.max_positions,
             name="attention",
         )(h)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
@@ -131,10 +135,14 @@ class _BlockStep(nn.Module):
     """scan-compatible adapter: (carry, None) → (carry, None)."""
 
     config: LlamaConfig
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, carry, _):
-        return DecoderBlock(self.config, name="block")(carry), None
+        return DecoderBlock(self.config, decode=self.decode,
+                            cache_len=self.cache_len,
+                            name="block")(carry), None
 
 
 class _ScannedBlock(nn.Module):
@@ -142,16 +150,24 @@ class _ScannedBlock(nn.Module):
     time is O(1) in depth and the pipeline axis can shard layers."""
 
     config: LlamaConfig
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
-        step = _BlockStep
-        if self.config.remat:
+        from functools import partial as _partial
+
+        step = (_partial(_BlockStep, decode=True,
+                         cache_len=self.cache_len) if self.decode
+                else _BlockStep)
+        # No remat in decode mode: there is no backward pass to save memory
+        # for, and the KV-cache writes must not replay under a checkpoint.
+        if self.config.remat and not self.decode:
             step = nn.remat(step, prevent_cse=False,
                             policy=_checkpoint_policy(self.config))
         scanned = nn.scan(
             step,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             length=self.config.num_layers,
             metadata_params={nn.PARTITION_NAME: "stage"},
@@ -202,7 +218,15 @@ def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh):
 
 
 class LlamaModel(nn.Module):
+    # ``decode=True``: autoregressive KV-cache mode (models.generate) —
+    # same params, plus a mutable "cache" collection sized max_positions.
     config: LlamaConfig = LlamaConfig()
+    decode: bool = False
+    # Decode-mode KV cache size; 0 → config.max_positions.  generate()
+    # passes the statically-known prompt_len + max_new_tokens so short
+    # generations from a long-context config don't allocate (and attend
+    # over) the full max_positions cache.
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, tokens):
@@ -210,6 +234,10 @@ class LlamaModel(nn.Module):
         x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                     name="token_embed")(tokens)
         pp_mesh = None if self.is_initializing() else _pipeline_mesh(cfg)
+        if pp_mesh is not None and self.decode:
+            raise ValueError(
+                "decode mode does not run under a pipeline mesh; generate "
+                "outside the pipeline strategy")
         if pp_mesh is not None:
             # Params were created by the scan path (init always takes it);
             # read the stacked block tree and drive the pipeline schedule.
@@ -217,14 +245,16 @@ class LlamaModel(nn.Module):
                 self.variables["params"]["layers"]["stack"]["block"])
             x = _pipelined_blocks(cfg, block_params, x, pp_mesh)
         elif cfg.scan_layers:
-            x = _ScannedBlock(cfg, name="layers")(x)
+            x = _ScannedBlock(cfg, decode=self.decode,
+                              cache_len=self.cache_len, name="layers")(x)
         else:
             for i in range(cfg.num_layers):
                 blk = DecoderBlock
-                if cfg.remat:
+                if cfg.remat and not self.decode:
                     blk = nn.remat(blk, prevent_cse=False,
                                    policy=_checkpoint_policy(cfg))
-                x = blk(cfg, name=f"layer_{i}")(x)
+                x = blk(cfg, decode=self.decode,
+                        cache_len=self.cache_len, name=f"layer_{i}")(x)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)
         logits = L.dense(cfg.vocab_size, ("embed", "vocab"), use_bias=False,

@@ -146,6 +146,15 @@ def make_state_shardings(
         dims = []
         changed = False
         spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+        if len(spec) > len(shape):
+            # Optimizer states can carry LOWER-rank leaves than the param
+            # whose metadata they inherit (adafactor's factored row/col
+            # stats).  Which param dim a reduced leaf corresponds to is
+            # not recoverable from shapes (v_row drops the last dim,
+            # v_col the second-to-last), so guessing inherits the WRONG
+            # dim's mesh axes and forces a reshard every optimizer step.
+            # These leaves are O(m+n) vs the param's O(m·n): replicate.
+            return NamedSharding(mesh, P())
         for size, assigned in zip(shape, spec):
             if assigned is None:
                 dims.append(None)

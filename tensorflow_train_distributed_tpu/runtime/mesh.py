@@ -175,11 +175,56 @@ def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:
     return MeshConfig(strategy=name, **sizes)
 
 
+def hybrid_shapes(sizes: dict[str, int],
+                  dcn_axes: Optional[dict[str, int]],
+                  num_slices: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split per-axis totals into (ici_shape, dcn_shape) for a multi-slice
+    mesh (``mesh_utils.create_hybrid_device_mesh`` contract: per-dim totals
+    = ici × dcn, product of dcn dims = number of slices).
+
+    ``dcn_axes`` names how slices divide each logical axis (e.g.
+    ``{"data": 4}`` = 4 slices data-parallel over DCN).  ``None`` infers the
+    default placement: all slices on the outermost axis whose size they
+    divide — DCN traffic belongs on gradient allreduce (data/fsdp), never on
+    tensor/seq collectives (AXES order encodes that preference).
+    """
+    if dcn_axes is None:
+        # Only data-like axes may be inferred: tensor/seq collectives on
+        # DCN would silently destroy step time, so a mesh whose data-like
+        # axes can't absorb the slices must be configured explicitly.
+        for a in ("pipeline", "data", "fsdp", "expert"):
+            if sizes[a] >= num_slices and sizes[a] % num_slices == 0:
+                dcn_axes = {a: num_slices}
+                break
+        else:
+            raise ValueError(
+                f"cannot place {num_slices} slices on any data-like axis "
+                f"of {sizes} (tensor/seq are never inferred — their "
+                "collectives belong on ICI); pass dcn_axes explicitly")
+    if math.prod(dcn_axes.values()) != num_slices:
+        raise ValueError(
+            f"dcn_axes {dcn_axes} product must equal the slice count "
+            f"{num_slices}")
+    for a, d in dcn_axes.items():
+        if a not in sizes:
+            raise ValueError(f"unknown dcn axis {a!r}")
+        if d < 1:
+            raise ValueError(f"dcn factor for {a!r} must be >= 1, got {d}")
+        if sizes[a] % d:
+            raise ValueError(
+                f"axis {a!r} of size {sizes[a]} not divisible by its DCN "
+                f"factor {d}")
+    ici = tuple(sizes[a] // dcn_axes.get(a, 1) for a in AXES)
+    dcn = tuple(dcn_axes.get(a, 1) for a in AXES)
+    return ici, dcn
+
+
 def build_mesh(
     config: Optional[MeshConfig] = None,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
     allow_split_physical_axes: bool = False,
+    dcn_axes: Optional[dict[str, int]] = None,
 ) -> Mesh:
     """Build a named ``Mesh`` over the device grid.
 
@@ -189,6 +234,13 @@ def build_mesh(
     ``DeviceAssignment.build`` (``tpu/device_assignment.py:343``) computing
     replica→core mappings.  On CPU/test backends it falls back to a plain
     reshape.
+
+    Multi-slice (several ICI islands joined by DCN — the topology the
+    reference reaches with MultiWorkerMirroredStrategy over NCCL+gRPC):
+    detected via device ``slice_index``; the hybrid mesh keeps each slice's
+    devices ICI-contiguous and places the ``dcn_axes`` factors (default:
+    outermost data-like axis) across slices, so XLA routes exactly those
+    collectives over DCN.
     """
     if config is None:
         config = MeshConfig(data=-1)
@@ -204,11 +256,26 @@ def build_mesh(
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(
-            shape, devices=devices,
-            allow_split_physical_axes=allow_split_physical_axes,
-        )
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) > 1 or dcn_axes:
+            ici_shape, dcn_shape = hybrid_shapes(
+                sizes, dcn_axes, max(len(slice_ids), 1))
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
     else:
+        if dcn_axes:
+            # No slice structure on CPU/test backends — placement is moot,
+            # but the factorization is still validated so multi-slice CLI
+            # invocations (--dcn) dry-run correctly on the test mesh.
+            hybrid_shapes(sizes, dcn_axes,
+                          math.prod(dcn_axes.values()))
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXES)
 

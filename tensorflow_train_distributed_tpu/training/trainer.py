@@ -174,12 +174,30 @@ class Trainer:
             # Cast on HOST, then device_put straight into the target
             # sharding: a jnp cast would materialize each full leaf on one
             # device first — a 7B scan-stacked FFN kernel is ~5.8 GB/leaf,
-            # which must never exist unsharded on a 16 GB chip.
-            loaded = jax.tree.map(
-                lambda init, p: jax.device_put(
-                    np.asarray(p).astype(init.dtype), init.sharding),
-                state.params, params)
-            state = state.replace(params=loaded)
+            # which must never exist unsharded on a 16 GB chip.  The random
+            # init is dropped (and its buffers freed) BEFORE the imported
+            # copy lands, so peak HBM is params + opt state — not 2×params.
+            flat_init, treedef = jax.tree_util.tree_flatten(state.params)
+            specs = [(x.dtype, x.sharding, x.shape) for x in flat_init]
+            del flat_init
+            flat_p, treedef_p = jax.tree_util.tree_flatten(params)
+            if treedef_p != treedef:
+                raise ValueError(
+                    f"imported param tree structure does not match the "
+                    f"model's:\n  imported: {treedef_p}\n  model: "
+                    f"{treedef}")
+            state = state.replace(params=None)  # free the random init
+            loaded = []
+            for p, (dtype, sharding, shape) in zip(flat_p, specs):
+                host = np.asarray(p)
+                if host.shape != shape:
+                    raise ValueError(
+                        f"imported param shape {host.shape} != model "
+                        f"shape {shape}")
+                loaded.append(
+                    jax.device_put(host.astype(dtype), sharding))
+            state = state.replace(
+                params=jax.tree_util.tree_unflatten(treedef, loaded))
         logger.info("created state: %.2fM params", state.num_params() / 1e6)
         return state
 

@@ -52,6 +52,19 @@ def test_end_to_end_mnist_loss_decreases():
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+def test_lamb_and_adafactor_train():
+    # BERT large-batch (LAMB) and memory-frugal (adafactor) optimizer
+    # paths through the CLI: loss must decrease on the tiny MLM config.
+    for opt in ("lamb", "adafactor"):
+        result = launch.run(_args(
+            "--config", "bert_tiny_mlm", "--steps", "20",
+            "--optimizer", opt, "--learning-rate", "2e-3",
+            "--log-every", "5",
+        ))
+        losses = result.history["loss"]
+        assert losses[-1] < losses[0], (opt, losses)
+
+
 def test_explicit_mesh_and_strategy_override():
     result = launch.run(_args(
         "--config", "llama_tiny_sft", "--steps", "2",

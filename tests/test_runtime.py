@@ -179,6 +179,50 @@ class TestMesh:
         assert mesh_2d.shape["data"] == 2 and mesh_2d.shape["tensor"] == 4
         assert mesh_2d.devices.size == 8
 
+    def test_hybrid_shapes_default_placement(self):
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            hybrid_shapes,
+        )
+
+        # 4 slices over a data=16×tensor=4 mesh: slices divide `data`
+        # (the outermost axis that fits), tensor stays all-ICI.
+        sizes = MeshConfig(data=16, tensor=4).resolve(64)
+        ici, dcn = hybrid_shapes(sizes, None, 4)
+        assert dcn == (1, 4, 1, 1, 1, 1)           # AXES order
+        assert ici == (1, 4, 1, 1, 1, 4)
+        assert math.prod(ici) * math.prod(dcn) == 64
+
+    def test_hybrid_shapes_explicit_and_errors(self):
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            hybrid_shapes,
+        )
+
+        sizes = MeshConfig(data=8, fsdp=4).resolve(32)
+        ici, dcn = hybrid_shapes(sizes, {"data": 2, "fsdp": 2}, 4)
+        assert dcn[1] == 2 and dcn[2] == 2
+        with pytest.raises(ValueError, match="product"):
+            hybrid_shapes(sizes, {"data": 2}, 4)
+        with pytest.raises(ValueError, match="not divisible"):
+            hybrid_shapes(sizes, {"fsdp": 3}, 3)
+        with pytest.raises(ValueError, match="cannot place"):
+            hybrid_shapes(MeshConfig(data=3).resolve(3), None, 2)
+        with pytest.raises(ValueError, match=">= 1"):
+            hybrid_shapes(sizes, {"data": -1}, -1)
+
+    def test_hybrid_shapes_never_infers_tensor(self):
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            hybrid_shapes,
+        )
+
+        # All-tensor mesh: slices must NOT silently land on the tensor
+        # axis (TP collectives over DCN) — explicit config required.
+        sizes = MeshConfig(data=1, tensor=8).resolve(8)
+        with pytest.raises(ValueError, match="tensor/seq are never"):
+            hybrid_shapes(sizes, None, 2)
+        # ...but an explicit request is honored.
+        ici, dcn = hybrid_shapes(sizes, {"tensor": 2}, 2)
+        assert dcn[-1] == 2 and ici[-1] == 4
+
     def test_presets_reference_names(self):
         for name in ("mirrored", "multi_worker_mirrored", "horovod", "tpu"):
             cfg = strategy_preset(name, 8)
