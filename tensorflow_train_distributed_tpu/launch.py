@@ -383,22 +383,50 @@ def run(args: argparse.Namespace) -> RunResult:
             # SFT entry point: start from a local HF Llama checkpoint
             # (models.import_hf) instead of random init; a later resume
             # from --checkpoint-dir takes precedence over re-importing.
-            from tensorflow_train_distributed_tpu.models.import_hf import (
-                import_llama,
+            from tensorflow_train_distributed_tpu.models import import_hf
+            from tensorflow_train_distributed_tpu.models.bert import (
+                BertConfig,
             )
             from tensorflow_train_distributed_tpu.models.llama import (
                 LlamaConfig,
             )
 
             task_cfg = getattr(task, "config", None)
-            if not isinstance(task_cfg, LlamaConfig):
+            if isinstance(task_cfg, LlamaConfig):
+                # The task's config decides the param-tree layout (scan
+                # vs per-layer) and validates dims vs the checkpoint.
+                hf_cfg, hf_params = import_hf.import_llama(
+                    args.init_from_hf, config=task_cfg)
+            elif isinstance(task_cfg, BertConfig):
+                # BERT import derives its own HF-compat config (bias/
+                # token-type/embed-LN knobs); rebuild the task around it
+                # so the model matches the imported tree — but the
+                # checkpoint must still cover the data pipeline's token
+                # space and sequence length (a smaller embedding table
+                # would CLAMP out-of-range ids in XLA's gather and train
+                # on garbage with a finite loss).
+                from tensorflow_train_distributed_tpu.models.bert import (
+                    BertMlmTask,
+                )
+
+                hf_cfg, hf_params = import_hf.import_bert(args.init_from_hf)
+                sample_seq = next(iter(loader))["input_ids"].shape[1]
+                if hf_cfg.vocab_size < task_cfg.vocab_size:
+                    raise SystemExit(
+                        f"HF checkpoint vocab ({hf_cfg.vocab_size}) is "
+                        f"smaller than the config's ({task_cfg.vocab_size})"
+                        " — token ids would silently clamp")
+                if hf_cfg.max_positions < sample_seq:
+                    raise SystemExit(
+                        f"HF checkpoint max_positions "
+                        f"({hf_cfg.max_positions}) < the pipeline's "
+                        f"sequence length ({sample_seq})")
+                task = BertMlmTask(hf_cfg)
+                trainer.task = task
+            else:
                 raise SystemExit(
-                    f"--init-from-hf needs a Llama-family --config; "
-                    f"{args.config!r} is not one")
-            # The task's config decides the param-tree layout (scan vs
-            # per-layer) and validates dims against the checkpoint.
-            hf_cfg, hf_params = import_llama(args.init_from_hf,
-                                             config=task_cfg)
+                    f"--init-from-hf supports Llama- and BERT-family "
+                    f"--config; {args.config!r} is neither")
             state = trainer.create_state(next(iter(loader)),
                                          params=hf_params)
             logger.info("initialized from HF checkpoint %s (%d layers)",

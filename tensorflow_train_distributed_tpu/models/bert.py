@@ -33,6 +33,22 @@ class BertConfig:
     max_positions: int = 512
     dropout_rate: float = 0.1
     dtype: object = jnp.float32
+    # HF-exact compatibility knobs (all default to the lean TPU-first
+    # encoder; ``import_hf.import_bert`` requires them on so an HF
+    # ``BertForMaskedLM`` state dict is representable bit-exactly):
+    # q/k/v/out projection biases, token-type (segment) embeddings, and
+    # the post-sum embedding LayerNorm.
+    attention_bias: bool = False
+    type_vocab_size: int = 0
+    embed_layer_norm: bool = False
+    layer_norm_eps: float = 1e-6  # flax default; HF checkpoints use 1e-12
+    exact_gelu: bool = False      # erf GELU (HF) vs tanh approximation
+
+
+def _gelu(cfg: "BertConfig"):
+    if cfg.exact_gelu:
+        return lambda x: nn.gelu(x, approximate=False)
+    return nn.gelu
 
 
 BERT_PRESETS = {
@@ -56,14 +72,18 @@ class EncoderLayer(nn.Module):
             head_dim=cfg.hidden_size // cfg.num_heads,
             dtype=cfg.dtype,
             dropout_rate=cfg.dropout_rate,
+            use_bias=cfg.attention_bias,
             name="attention",
         )(x, deterministic=deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="attn_ln")(x + attn)
+        x = nn.LayerNorm(dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                         name="attn_ln")(x + attn)
         mlp = L.MlpBlock(
             hidden=cfg.intermediate_size, dtype=cfg.dtype,
             dropout_rate=cfg.dropout_rate, name="mlp",
+            activation=_gelu(cfg),
         )(x, deterministic=deterministic)
-        return nn.LayerNorm(dtype=cfg.dtype, name="mlp_ln")(x + mlp)
+        return nn.LayerNorm(dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                            name="mlp_ln")(x + mlp)
 
 
 class BertEncoder(nn.Module):
@@ -79,28 +99,49 @@ class BertEncoder(nn.Module):
                 nn.initializers.normal(0.02), (None, "embed")),
             (cfg.max_positions, cfg.hidden_size),
         )
+        if cfg.type_vocab_size:
+            self.type_embed = self.param(
+                "type_embedding",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.type_vocab_size, cfg.hidden_size),
+            )
+        if cfg.embed_layer_norm:
+            self.embed_ln = nn.LayerNorm(
+                dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                name="embed_ln")
         self.encoder_layers = [
             EncoderLayer(cfg, name=f"layer_{i}")
             for i in range(cfg.num_layers)
         ]
         self.mlm_transform = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                                       name="mlm_transform")
-        self.mlm_ln = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")
+        self.mlm_ln = nn.LayerNorm(dtype=cfg.dtype,
+                                   epsilon=cfg.layer_norm_eps,
+                                   name="mlm_ln")
         self.mlm_bias = self.param(
             "mlm_bias",
             nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
             (cfg.vocab_size,),
         )
 
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, token_type_ids=None,
+                 deterministic: bool = True):
         cfg = self.config
         seq_len = input_ids.shape[1]
         x = self.embed(input_ids)
         x = x + self.pos_embed[None, :seq_len].astype(cfg.dtype)
+        if cfg.type_vocab_size:
+            if token_type_ids is None:  # single-segment default
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + jnp.take(self.type_embed.astype(cfg.dtype),
+                             token_type_ids, axis=0)
+        if cfg.embed_layer_norm:
+            x = self.embed_ln(x)
         for layer in self.encoder_layers:
             x = layer(x, deterministic=deterministic)
         # MLM head: transform → tied-embedding logits + bias.
-        h = nn.gelu(self.mlm_transform(x))
+        h = _gelu(cfg)(self.mlm_transform(x))
         h = self.mlm_ln(h)
         logits = self.embed.attend(h) + self.mlm_bias.astype(cfg.dtype)
         return nn.with_logical_constraint(
@@ -120,6 +161,7 @@ class BertMlmTask:
     def loss_fn(self, params, model_state, batch, rng, train):
         logits = self.model.apply(
             {"params": params}, batch["input_ids"],
+            token_type_ids=batch.get("token_type_ids"),
             deterministic=not train,
             rngs={"dropout": rng} if train else {},
         ).astype(jnp.float32)
@@ -138,6 +180,7 @@ class BertMlmTask:
         """MLM logits (Trainer.predict contract)."""
         del model_state
         return self.model.apply({"params": params}, batch["input_ids"],
+                                token_type_ids=batch.get("token_type_ids"),
                                 deterministic=True)
 
 

@@ -150,6 +150,130 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
     return params
 
 
+def config_from_hf_bert(hf_config) -> "BertConfig":
+    """Derive a native ``BertConfig`` (HF-compat knobs on) from an HF
+    ``BertConfig``."""
+    from tensorflow_train_distributed_tpu.models.bert import BertConfig
+
+    if getattr(hf_config, "model_type", "bert") != "bert":
+        raise ValueError(
+            f"import_bert expects model_type 'bert', got "
+            f"{hf_config.model_type!r}")
+    if getattr(hf_config, "position_embedding_type", "absolute") != \
+            "absolute":
+        raise ValueError(
+            "only absolute learned position embeddings are representable")
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_positions=hf_config.max_position_embeddings,
+        dropout_rate=hf_config.hidden_dropout_prob,
+        attention_bias=True,
+        type_vocab_size=hf_config.type_vocab_size,
+        embed_layer_norm=True,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        exact_gelu=(act == "gelu"),  # HF "gelu" = erf; *_new/_tanh ≈ tanh
+    )
+
+
+def _ln(sd, prefix):
+    return {"scale": _np(sd[prefix + ".weight"]),
+            "bias": _np(sd[prefix + ".bias"])}
+
+
+def _dense(sd, prefix):
+    return {"kernel": _np(sd[prefix + ".weight"]).T,
+            "bias": _np(sd[prefix + ".bias"])}
+
+
+def import_bert_state_dict(state_dict, config) -> dict:
+    """HF ``BertForMaskedLM`` state dict → native flax ``params`` tree.
+
+    Requires a config from ``config_from_hf_bert`` (HF-compat knobs on);
+    the MLM head decoder must be tied to the word embeddings (the HF
+    default) — its logits come from ``Embed.attend`` here.
+    """
+    sd = state_dict
+    if not (config.attention_bias and config.embed_layer_norm
+            and config.type_vocab_size):
+        raise ValueError(
+            "import_bert needs the HF-compat config knobs on "
+            "(attention_bias, embed_layer_norm, type_vocab_size) — build "
+            "the config with config_from_hf_bert()")
+    emb = "bert.embeddings."
+    params = {
+        "token_embed": {
+            "embedding": _np(sd[emb + "word_embeddings.weight"])},
+        "pos_embedding": _np(sd[emb + "position_embeddings.weight"]),
+        "type_embedding": _np(sd[emb + "token_type_embeddings.weight"]),
+        "embed_ln": _ln(sd, emb + "LayerNorm"),
+        "mlm_transform": _dense(sd, "cls.predictions.transform.dense"),
+        "mlm_ln": _ln(sd, "cls.predictions.transform.LayerNorm"),
+        "mlm_bias": _np(sd["cls.predictions.bias"]),
+    }
+    if params["token_embed"]["embedding"].shape != (
+            config.vocab_size, config.hidden_size):
+        raise ValueError(
+            f"checkpoint embed "
+            f"{params['token_embed']['embedding'].shape} != config "
+            f"{(config.vocab_size, config.hidden_size)}")
+    dec = sd.get("cls.predictions.decoder.weight")
+    if dec is not None and not np.array_equal(
+            _np(dec), params["token_embed"]["embedding"]):
+        raise ValueError(
+            "checkpoint's MLM decoder is not tied to the word embeddings; "
+            "the native head computes logits from the tied embedding")
+    for i in range(config.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        if p + "attention.self.query.weight" not in sd:
+            raise ValueError(
+                f"checkpoint has {i} encoder layers, config expects "
+                f"{config.num_layers}")
+        params[f"layer_{i}"] = {
+            "attention": {
+                "query": _dense(sd, p + "attention.self.query"),
+                "key": _dense(sd, p + "attention.self.key"),
+                "value": _dense(sd, p + "attention.self.value"),
+                "out": _dense(sd, p + "attention.output.dense"),
+            },
+            "attn_ln": _ln(sd, p + "attention.output.LayerNorm"),
+            "mlp": {
+                "wi": _dense(sd, p + "intermediate.dense"),
+                "wo": _dense(sd, p + "output.dense"),
+            },
+            "mlp_ln": _ln(sd, p + "output.LayerNorm"),
+        }
+    if f"bert.encoder.layer.{config.num_layers}.attention.self.query." \
+            "weight" in sd:
+        n = config.num_layers
+        while f"bert.encoder.layer.{n}.attention.self.query.weight" in sd:
+            n += 1
+        raise ValueError(
+            f"checkpoint has {n} encoder layers, config expects "
+            f"{config.num_layers}")
+    return params
+
+
+def import_bert(model_or_path, config=None, **config_overrides):
+    """(native_config, params) from an HF BertForMaskedLM or local path."""
+    if isinstance(model_or_path, str):
+        from transformers import BertForMaskedLM
+
+        model_or_path = BertForMaskedLM.from_pretrained(model_or_path)
+    if config is None:
+        config = config_from_hf_bert(model_or_path.config)
+    if config_overrides:
+        config = dataclasses.replace(config, **config_overrides)
+    params = import_bert_state_dict(model_or_path.state_dict(), config)
+    return config, params
+
+
 def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
                  **config_overrides):
     """(native_config, params) from an HF model instance or local path.

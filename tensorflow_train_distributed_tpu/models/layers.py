@@ -149,6 +149,8 @@ class MultiHeadAttention(nn.Module):
     # prefill (q_len = prompt length) and stepping (q_len = 1) alike.
     decode: bool = False
     cache_len: int = 0
+    # Projection biases (BERT-style encoders; Llama-family stays False).
+    use_bias: bool = False
 
     def _proj(self, x, heads, name):
         # Plain 2-D kernel (embed, heads*head_dim) + reshape: maps onto
@@ -160,7 +162,7 @@ class MultiHeadAttention(nn.Module):
         # paths — the submodule name/init/partitioning contract between
         # them lives here and only here.
         y = nn.Dense(
-            heads * self.head_dim, use_bias=False, dtype=self.dtype,
+            heads * self.head_dim, use_bias=self.use_bias, dtype=self.dtype,
             name=name,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "heads")),
@@ -171,7 +173,7 @@ class MultiHeadAttention(nn.Module):
 
     def _out_proj(self, x, features):
         return nn.Dense(
-            features, use_bias=False, dtype=self.dtype, name="out",
+            features, use_bias=self.use_bias, dtype=self.dtype, name="out",
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("heads", "embed")),
         )(x)

@@ -92,6 +92,20 @@ def test_errors_and_edge_counts():
     assert np.asarray(generate(cfg, params, prompt, 1)).shape == (1, 5)
 
 
+def test_decode_cache_sized_to_request():
+    """generate() must allocate the KV cache at prompt+new, not the
+    config's max_positions — a 20-token generation from a long-context
+    config would otherwise pay max_positions cache HBM and attention."""
+    cfg = LLAMA_PRESETS["llama_tiny"]  # max_positions = 128
+    model = LlamaModel(cfg, decode=True, cache_len=16)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32)))
+    caches = [v for path, v in
+              jax.tree_util.tree_flatten_with_path(shapes["cache"])[0]
+              if "key_cache" in str(path) or "value_cache" in str(path)]
+    assert caches and all(c.shape[1] == 16 for c in caches), caches
+
+
 def test_temperature_is_traced_not_compiled_in():
     """A temperature sweep must reuse one compiled program."""
     from tensorflow_train_distributed_tpu.models.generate import _generate

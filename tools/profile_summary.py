@@ -26,8 +26,6 @@ import os
 import re
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
 
 def find_xplane(path: str) -> str:
     if path.endswith(".pb"):
