@@ -95,9 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
     p.add_argument("--init-from-hf", default=None, metavar="DIR",
-                   help="initialize a Llama-family config's params from a "
-                        "local HuggingFace checkpoint dir (the config's "
-                        "model dims must match the checkpoint)")
+                   help="initialize a Llama- or BERT-family config's "
+                        "params from a local HuggingFace checkpoint dir "
+                        "(dims validated against the config/pipeline)")
     p.add_argument("--eval-split", type=float, default=0.0,
                    help="fraction of the dataset held out as a validation "
                         "split for --eval-every/--eval-steps (Keras "
@@ -123,6 +123,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference: TensorBoard callback profile_batch)")
     p.add_argument("--profile-steps", default="10,20", metavar="START,STOP",
                    help="step window for --profile-dir")
+    p.add_argument("--profiler-port", type=int, default=None,
+                   help="start an on-demand profiler server on this port "
+                        "(reference: tf.profiler.experimental.server.start; "
+                        "capture from TensorBoard's Capture Profile dialog)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="warn + dump thread stacks if no step completes in "
+                        "this many seconds (reference: coordinator "
+                        "watchdog); 0 disables")
     # Cluster placement (reference: TF_CONFIG / cluster resolvers; these
     # flags take precedence, then TTD_*/TF_CONFIG/SLURM env, see
     # runtime.distributed.resolve_cluster).
@@ -336,6 +344,16 @@ def run(args: argparse.Namespace) -> RunResult:
         start, stop = _parse_profile_steps(args.profile_steps)
         callbacks.append(ProfileCallback(
             args.profile_dir, start_step=start, stop_step=stop))
+    if args.profiler_port:
+        from tensorflow_train_distributed_tpu.runtime.profiling import (
+            start_profiler_server,
+        )
+
+        start_profiler_server(args.profiler_port)
+    if args.stall_timeout > 0:
+        from tensorflow_train_distributed_tpu.training import StallWatchdog
+
+        callbacks.append(StallWatchdog(args.stall_timeout))
     ckpt = None
     watcher = None
     if args.checkpoint_dir:
@@ -392,6 +410,7 @@ def run(args: argparse.Namespace) -> RunResult:
             )
 
             task_cfg = getattr(task, "config", None)
+            sample = None
             if isinstance(task_cfg, LlamaConfig):
                 # The task's config decides the param-tree layout (scan
                 # vs per-layer) and validates dims vs the checkpoint.
@@ -410,25 +429,26 @@ def run(args: argparse.Namespace) -> RunResult:
                 )
 
                 hf_cfg, hf_params = import_hf.import_bert(args.init_from_hf)
-                sample_seq = next(iter(loader))["input_ids"].shape[1]
+                sample = next(iter(loader))
                 if hf_cfg.vocab_size < task_cfg.vocab_size:
                     raise SystemExit(
                         f"HF checkpoint vocab ({hf_cfg.vocab_size}) is "
                         f"smaller than the config's ({task_cfg.vocab_size})"
                         " — token ids would silently clamp")
-                if hf_cfg.max_positions < sample_seq:
+                if hf_cfg.max_positions < sample["input_ids"].shape[1]:
                     raise SystemExit(
                         f"HF checkpoint max_positions "
                         f"({hf_cfg.max_positions}) < the pipeline's "
-                        f"sequence length ({sample_seq})")
+                        f"sequence length ({sample['input_ids'].shape[1]})")
                 task = BertMlmTask(hf_cfg)
                 trainer.task = task
             else:
                 raise SystemExit(
                     f"--init-from-hf supports Llama- and BERT-family "
                     f"--config; {args.config!r} is neither")
-            state = trainer.create_state(next(iter(loader)),
-                                         params=hf_params)
+            if sample is None:
+                sample = next(iter(loader))
+            state = trainer.create_state(sample, params=hf_params)
             logger.info("initialized from HF checkpoint %s (%d layers)",
                         args.init_from_hf, hf_cfg.num_layers)
 

@@ -43,6 +43,9 @@ class BertConfig:
     embed_layer_norm: bool = False
     layer_norm_eps: float = 1e-6  # flax default; HF checkpoints use 1e-12
     exact_gelu: bool = False      # erf GELU (HF) vs tanh approximation
+    # HF configures attention-probability dropout separately from hidden
+    # dropout; None keeps the single-rate convention.
+    attention_dropout_rate: object = None
 
 
 def _gelu(cfg: "BertConfig"):
@@ -67,11 +70,14 @@ class EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         cfg = self.config
+        attn_dropout = (cfg.dropout_rate
+                        if cfg.attention_dropout_rate is None
+                        else cfg.attention_dropout_rate)
         attn = L.MultiHeadAttention(
             num_heads=cfg.num_heads,
             head_dim=cfg.hidden_size // cfg.num_heads,
             dtype=cfg.dtype,
-            dropout_rate=cfg.dropout_rate,
+            dropout_rate=attn_dropout,
             use_bias=cfg.attention_bias,
             name="attention",
         )(x, deterministic=deterministic)

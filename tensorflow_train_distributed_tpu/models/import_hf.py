@@ -174,6 +174,7 @@ def config_from_hf_bert(hf_config) -> "BertConfig":
         intermediate_size=hf_config.intermediate_size,
         max_positions=hf_config.max_position_embeddings,
         dropout_rate=hf_config.hidden_dropout_prob,
+        attention_dropout_rate=hf_config.attention_probs_dropout_prob,
         attention_bias=True,
         type_vocab_size=hf_config.type_vocab_size,
         embed_layer_norm=True,

@@ -59,6 +59,21 @@ def trace(logdir: str) -> Iterator[None]:
         stop_trace()
 
 
+def start_profiler_server(port: int):
+    """On-demand remote capture: the analog of the reference's
+    ``tf.profiler.experimental.server.start`` (``profiler_v2.py:169``) —
+    TensorBoard's "Capture profile" dialog (or
+    ``jax.profiler.trace_remote``) can then pull a trace from a live
+    training job without any pre-planned --profile-dir window.
+
+    jax keeps the running server in a module-level global until
+    ``jax.profiler.stop_server()``; the returned handle is informational.
+    """
+    server = jax.profiler.start_server(port)
+    logger.info("profiler server listening on port %d", port)
+    return server
+
+
 def annotate(name: str, **kwargs):
     """Named host-side span (TraceMe); nests under an active trace."""
     return jax.profiler.TraceAnnotation(name, **kwargs)

@@ -26,6 +26,7 @@ from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     History,
     JsonlLogger,
     ProgressLogger,
+    StallWatchdog,
     TensorBoardScalars,
     TerminateOnNaN,
 )

@@ -250,3 +250,72 @@ class TensorBoardScalars(Callback):
     def on_train_end(self, state):
         if self._writer is not None:
             self._writer.flush()
+
+
+class StallWatchdog(Callback):
+    """Dump stacks and warn when no step completes for ``timeout_s``.
+
+    The reference's ClusterCoordinator ships a hang watchdog
+    (``coordinator/watchdog.py``: a daemon thread that periodically dumps
+    all thread stacks when progress stalls); SPMD training hangs the same
+    way in practice — a wedged collective, a dead host in the process
+    group, an input pipeline deadlock.  This is the trainer-side analog:
+    armed from ``on_train_begin``, petted by every completed step, barking
+    (log + ``faulthandler`` stack dump to stderr) every ``timeout_s`` of
+    silence.  Observability only — it never kills the run.
+    """
+
+    def __init__(self, timeout_s: float = 300.0):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self._stop = None
+        self._last_beat = None
+        self.stall_count = 0  # exposed for tests/metrics
+
+    def _dump_stacks(self):
+        # faulthandler needs a real fd; pytest capture / notebooks swap
+        # sys.stderr for fd-less streams — fall back to the pure-Python
+        # dump, and never let a dump failure kill the watchdog thread.
+        import faulthandler
+        import traceback
+
+        try:
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:
+            try:
+                for tid, frame in sys._current_frames().items():
+                    print(f"--- thread {tid} ---", file=sys.stderr)
+                    traceback.print_stack(frame, file=sys.stderr)
+            except Exception:
+                pass
+
+    def _loop(self):
+        while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
+            if time.monotonic() - self._last_beat > self.timeout_s:
+                self.stall_count += 1
+                logger.warning(
+                    "StallWatchdog: no training step completed in %.0f s "
+                    "(stall #%d) — dumping thread stacks to stderr",
+                    self.timeout_s, self.stall_count)
+                self._dump_stacks()
+                self._last_beat = time.monotonic()  # re-arm, don't spam
+
+    def on_train_begin(self, state):
+        import threading
+
+        # monotonic: a wall-clock NTP step must neither fake a stall nor
+        # mask a real one.
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="stall-watchdog", daemon=True)
+        self._thread.start()
+
+    def on_step_end(self, step, metrics):
+        self._last_beat = time.monotonic()
+
+    def on_train_end(self, state):
+        if self._stop is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)

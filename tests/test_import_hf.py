@@ -178,6 +178,11 @@ class TestBertImport:
         assert cfg.attention_bias and cfg.embed_layer_norm
         assert cfg.type_vocab_size == 2 and cfg.exact_gelu
         assert cfg.layer_norm_eps == 1e-12
+        # HF's two dropout knobs map separately — a checkpoint trained
+        # with differing rates must not silently get hidden-rate attention
+        # dropout.
+        assert cfg.attention_dropout_rate == \
+            hf_bert.config.attention_probs_dropout_prob
 
     def test_forward_parity(self, hf_bert):
         from tensorflow_train_distributed_tpu.models.bert import BertEncoder

@@ -80,3 +80,65 @@ def test_speed_monitor_ignores_intra_burst_deltas():
     for step in range(1, 11):  # one burst, no wall time between steps
         mon.on_step_end(step, {})
     assert mon.summary() == {}  # no closed window yet → no bogus samples
+
+
+class TestStallWatchdog:
+    def test_fires_on_stall_and_quiet_when_stepping(self, capsys):
+        import time
+
+        from tensorflow_train_distributed_tpu.training import StallWatchdog
+
+        wd = StallWatchdog(timeout_s=0.3)
+        wd.on_train_begin(None)
+        try:
+            # Stepping regularly: never fires.
+            for i in range(4):
+                time.sleep(0.1)
+                wd.on_step_end(i, {})
+            assert wd.stall_count == 0
+            # Silence past the timeout: fires (and re-arms, no spam).
+            time.sleep(0.6)
+            assert wd.stall_count >= 1
+        finally:
+            wd.on_train_end(None)
+        assert not wd._thread.is_alive()
+
+    def test_rejects_bad_timeout(self):
+        import pytest as _pytest
+
+        from tensorflow_train_distributed_tpu.training import StallWatchdog
+
+        with _pytest.raises(ValueError, match="timeout_s"):
+            StallWatchdog(timeout_s=0)
+
+    def test_cli_flag_installs_watchdog(self):
+        from tensorflow_train_distributed_tpu import launch
+
+        result = launch.run(launch.build_parser().parse_args([
+            "--config", "mnist", "--steps", "2", "--platform", "cpu",
+            "--stall-timeout", "600",
+        ]))
+        import numpy as np
+
+        assert np.isfinite(result.history["loss"][-1])
+
+
+def test_profiler_server_starts_and_stops():
+    import socket
+
+    import jax
+
+    from tensorflow_train_distributed_tpu.runtime.profiling import (
+        start_profiler_server,
+    )
+
+    # A fixed port collides across concurrent CI runs; grab a free one.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    start_profiler_server(port=port)
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5):
+            pass  # something is listening
+    finally:
+        jax.profiler.stop_server()
