@@ -282,9 +282,12 @@ def main(argv=None) -> int:
         batch_per_chip = min(batch_per_chip, 8)
         warmup, iters = min(warmup, 1), min(iters, 2)
 
-    # Traces are TPU evidence (committed under profiles/bench); a CPU
-    # fallback run must not bury the real captures under CPU traces.
-    profile_dir = (args.profile_dir or None) if platform == "tpu" else None
+    # The DEFAULT trace dir holds committed TPU evidence; a CPU fallback
+    # must not bury it under CPU traces.  An explicitly chosen dir is
+    # honored on any backend.
+    profile_dir = args.profile_dir or None
+    if platform != "tpu" and args.profile_dir == "profiles/bench":
+        profile_dir = None
     results = {}
     failures = {}
     # Compile or the first step can wedge just like init — keep a watchdog
@@ -319,6 +322,8 @@ def main(argv=None) -> int:
     )
     if "mfu_pct" in best:
         record["mfu_pct"] = best["mfu_pct"]
+    record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
     if fallback:
         record["fallback"] = True
         if errors:

@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval-steps", type=int, default=0,
                    help="run evaluation for N batches after training")
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore from --checkpoint-dir and evaluate "
+                        "--eval-steps batches without training "
+                        "(Model.evaluate standalone)")
     p.add_argument("--eval-every", type=int, default=None,
                    help="also evaluate every N training steps (Keras "
                         "validation_freq analog); val_* metrics reach "
@@ -219,6 +223,11 @@ def _parse_profile_steps(spec: str) -> tuple[int, int]:
 def run(args: argparse.Namespace) -> RunResult:
     """Build the full stack from parsed flags and train."""
     import jax
+
+    # Flag-vs-flag errors are decidable before the expensive setup
+    # (checkpoint restore, HF import, mesh build) — fail now.
+    if args.eval_only and args.eval_steps <= 0:
+        raise SystemExit("--eval-only needs --eval-steps N (>0)")
 
     if args.platform or args.cpu_devices:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -452,6 +461,22 @@ def run(args: argparse.Namespace) -> RunResult:
             logger.info("initialized from HF checkpoint %s (%d layers)",
                         args.init_from_hf, hf_cfg.num_layers)
 
+        if args.eval_only:
+            if state is None:
+                raise SystemExit(
+                    "--eval-only needs a restorable checkpoint "
+                    "(--checkpoint-dir with a saved state) or "
+                    "--init-from-hf")
+            eval_metrics = trainer.evaluate(
+                make_eval_loader(), state, steps=args.eval_steps)
+            logger.info("eval-only: %s", eval_metrics)
+            history = next(
+                (c.history for c in callbacks if isinstance(c, History)),
+                {})
+            return RunResult(state=state, history=history,
+                             eval_metrics=eval_metrics, mesh=mesh,
+                             preempted=False)
+
         remaining = args.steps - (0 if state is None else int(state.step))
         k = args.steps_per_execution
         if remaining > 0 and remaining % k:
@@ -502,7 +527,7 @@ def run(args: argparse.Namespace) -> RunResult:
         if ckpt is not None:
             ckpt.close()
     history = next(
-        (c.history for c in callbacks if isinstance(c, History)), [])
+        (c.history for c in callbacks if isinstance(c, History)), {})
     return RunResult(state=state, history=history,
                      eval_metrics=eval_metrics, mesh=mesh,
                      preempted=preempted)

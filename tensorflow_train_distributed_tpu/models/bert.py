@@ -14,6 +14,7 @@ transform + tied embedding logits + bias (BERT-base: L12 H768 A12 I3072).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import flax.linen as nn
 import jax
@@ -45,7 +46,7 @@ class BertConfig:
     exact_gelu: bool = False      # erf GELU (HF) vs tanh approximation
     # HF configures attention-probability dropout separately from hidden
     # dropout; None keeps the single-rate convention.
-    attention_dropout_rate: object = None
+    attention_dropout_rate: Optional[float] = None
 
 
 def _gelu(cfg: "BertConfig"):

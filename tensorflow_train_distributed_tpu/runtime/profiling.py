@@ -69,7 +69,14 @@ def start_profiler_server(port: int):
     jax keeps the running server in a module-level global until
     ``jax.profiler.stop_server()``; the returned handle is informational.
     """
-    server = jax.profiler.start_server(port)
+    try:
+        server = jax.profiler.start_server(port)
+    except ValueError as e:
+        # jax allows one server per process; a second launch.run in the
+        # same process keeps the existing one rather than crashing.
+        logger.warning("profiler server not started (%s); keeping the "
+                       "existing one", e)
+        return None
     logger.info("profiler server listening on port %d", port)
     return server
 

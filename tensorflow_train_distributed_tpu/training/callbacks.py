@@ -37,6 +37,12 @@ class Callback:
     def on_epoch_end(self, epoch: int, metrics: Mapping[str, float]) -> Optional[bool]:
         pass
 
+    def on_eval_begin(self):
+        """Mid-training evaluation window opens (no step heartbeats)."""
+
+    def on_eval_end(self):
+        pass
+
     def on_train_end(self, state):
         pass
 
@@ -63,6 +69,14 @@ class CallbackList:
         for c in self.callbacks:
             stop |= bool(c.on_epoch_end(epoch, metrics))
         return stop
+
+    def eval_begin(self):
+        for c in self.callbacks:
+            c.on_eval_begin()
+
+    def eval_end(self):
+        for c in self.callbacks:
+            c.on_eval_end()
 
     def train_end(self, state):
         for c in self.callbacks:
@@ -271,6 +285,7 @@ class StallWatchdog(Callback):
         self.timeout_s = timeout_s
         self._stop = None
         self._last_beat = None
+        self._paused = False
         self.stall_count = 0  # exposed for tests/metrics
 
     def _dump_stacks(self):
@@ -292,6 +307,8 @@ class StallWatchdog(Callback):
 
     def _loop(self):
         while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
+            if self._paused:
+                continue
             if time.monotonic() - self._last_beat > self.timeout_s:
                 self.stall_count += 1
                 logger.warning(
@@ -315,7 +332,17 @@ class StallWatchdog(Callback):
     def on_step_end(self, step, metrics):
         self._last_beat = time.monotonic()
 
+    def on_eval_begin(self):
+        # Evaluation produces no step heartbeats; a long eval window is
+        # not a stall.
+        self._paused = True
+
+    def on_eval_end(self):
+        self._last_beat = time.monotonic()
+        self._paused = False
+
     def on_train_end(self, state):
         if self._stop is not None:
             self._stop.set()
             self._thread.join(timeout=5)
+            self._stop = None

@@ -448,11 +448,6 @@ class Trainer:
         step_fn = self._compiled_train_step()
         self.callbacks.train_begin(state)
         start_step = int(state.step)
-        done = 0
-        epoch = 0
-        last_metrics: dict[str, float] = {}
-        pending: list[tuple[int, Any]] = []
-        stop = False
 
         from jax.sharding import PartitionSpec as P
 
@@ -460,6 +455,28 @@ class Trainer:
         # the one sharded over the mesh — is dim 1.
         spec = None if k == 1 else P(None, batch_axes(self.mesh))
         device_iter = prefetch_to_device(it, self.mesh, spec=spec)
+        try:
+            self._fit_loop(device_iter, step_fn, state_box := [state],
+                           steps, k, start_step, steps_per_epoch,
+                           eval_batches, eval_every, eval_steps)
+            state = state_box[0]
+        finally:
+            # train_end must run even when a step raises (OOM, NaN guard,
+            # shape error): cleanup callbacks (StallWatchdog's thread,
+            # TensorBoard flush) otherwise leak into the rest of the
+            # process.
+            self.callbacks.train_end(state_box[0])
+        return state_box[0]
+
+    def _fit_loop(self, device_iter, step_fn, state_box, steps, k,
+                  start_step, steps_per_epoch, eval_batches, eval_every,
+                  eval_steps):
+        state = state_box[0]
+        done = 0
+        epoch = 0
+        last_metrics: dict[str, float] = {}
+        pending: list[tuple[int, Any]] = []
+        stop = False
         try:
             for dev_batch in device_iter:
                 state, metrics = step_fn(state, dev_batch)
@@ -500,9 +517,13 @@ class Trainer:
                 if eval_due:
                     src = (eval_batches() if callable(eval_batches)
                            else eval_batches)
-                    val = {f"val_{kk}": v for kk, v in
-                           self.evaluate(src, state,
-                                         steps=eval_steps).items()}
+                    self.callbacks.eval_begin()
+                    try:
+                        val = {f"val_{kk}": v for kk, v in
+                               self.evaluate(src, state,
+                                             steps=eval_steps).items()}
+                    finally:
+                        self.callbacks.eval_end()
                     last_metrics = dict(last_metrics, **val)
                     # Dedicated callback event carrying only val_* metrics:
                     # EarlyStopping(monitor="val_loss") sees them;
@@ -514,9 +535,11 @@ class Trainer:
                     stop |= self.callbacks.epoch_end(epoch, last_metrics)
                 if will_ckpt and not stop and not self.state_poisoned:
                     self.checkpoint_manager.save(cur, state)
+                state_box[0] = state
                 if stop:
                     break
         finally:
+            state_box[0] = state
             device_iter.close()
         if self.checkpoint_manager is not None:
             if not self.state_poisoned:
@@ -526,8 +549,6 @@ class Trainer:
             # checkpoint may still be committing and must not be lost just
             # because a later step went non-finite.
             self.checkpoint_manager.wait_until_finished()
-        self.callbacks.train_end(state)
-        return state
 
     def _forward_loop(self, batches, state, step_fn, steps: Optional[int],
                       fetch=jax.device_get) -> list:

@@ -1,0 +1,93 @@
+"""SavedModel export: jax predict_fn → tf.saved_model, reload, parity.
+
+The proof is the round trip: export, load with plain TensorFlow (no jax in
+the serving process conceptually), run the serving signature, and match
+the native jax forward bit-for-near-bit.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow_train_distributed_tpu.export_tf import (  # noqa: E402
+    export_savedmodel,
+)
+
+
+@pytest.fixture(scope="module")
+def lenet_setup():
+    import jax
+    import optax
+
+    from tensorflow_train_distributed_tpu.models import lenet
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+    from tensorflow_train_distributed_tpu.training import Trainer
+
+    task = lenet.make_task()
+    mesh = build_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    trainer = Trainer(task, optax.sgd(1e-2), mesh)
+    rng = np.random.default_rng(0)
+    batch = {"image": rng.standard_normal((4, 28, 28, 1)).astype(np.float32),
+             "label": rng.integers(0, 10, 4).astype(np.int32)}
+    state = trainer.create_state(batch)
+    return task, state, batch
+
+
+def test_export_load_parity(lenet_setup, tmp_path):
+    task, state, batch = lenet_setup
+    out = str(tmp_path / "saved")
+    export_savedmodel(task, state.params, state.model_state, batch, out)
+
+    loaded = tf.saved_model.load(out)
+    served = loaded.signatures["serving_default"](
+        image=tf.constant(batch["image"]),
+        label=tf.constant(batch["label"]))
+    got = list(served.values())[0].numpy()
+    want = np.asarray(task.predict_fn(state.params, state.model_state,
+                                      batch))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_polymorphic_serves_any_batch(lenet_setup, tmp_path):
+    task, state, batch = lenet_setup
+    out = str(tmp_path / "saved_poly")
+    export_savedmodel(task, state.params, state.model_state, batch, out)
+    loaded = tf.saved_model.load(out)
+    sig = loaded.signatures["serving_default"]
+    for b in (1, 4, 7):
+        served = sig(image=tf.zeros((b, 28, 28, 1)),
+                     label=tf.zeros((b,), tf.int32))
+        assert list(served.values())[0].shape[0] == b
+
+
+def test_exported_params_are_variables(lenet_setup, tmp_path):
+    task, state, batch = lenet_setup
+    out = str(tmp_path / "saved_vars")
+    export_savedmodel(task, state.params, state.model_state, batch, out)
+    loaded = tf.saved_model.load(out)
+    # Real restorable weights, not graph constants.
+    n_vars = len(loaded.model_params) if hasattr(
+        loaded, "model_params") else len(loaded.variables)
+    assert n_vars > 0
+
+
+def test_task_without_predict_fn_rejected(tmp_path):
+    class NoPredict:
+        pass
+
+    with pytest.raises(ValueError, match="predict_fn"):
+        export_savedmodel(NoPredict(), {}, {}, {}, str(tmp_path / "x"))
+
+
+def test_registry_wrapper_fresh_init(tmp_path):
+    from tensorflow_train_distributed_tpu.export_tf import (
+        export_from_registry,
+    )
+
+    out = str(tmp_path / "mnist_saved")
+    export_from_registry("mnist", None, out, platform="")
+    loaded = tf.saved_model.load(out)
+    assert "serving_default" in loaded.signatures

@@ -142,3 +142,45 @@ def test_profiler_server_starts_and_stops():
             pass  # something is listening
     finally:
         jax.profiler.stop_server()
+
+
+def test_watchdog_stops_when_fit_raises(mesh8):
+    """fit must run train_end (stopping the watchdog thread) even when a
+    step raises — otherwise the daemon dumps stacks forever after."""
+    import optax
+
+    from tensorflow_train_distributed_tpu.training import (
+        StallWatchdog, Trainer, TrainerConfig,
+    )
+    from tests.test_trainer import _BlobsTask, _loader
+
+    wd = StallWatchdog(timeout_s=60)
+    trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                      config=TrainerConfig(log_every=1), callbacks=[wd])
+
+    def exploding():
+        yield next(iter(_loader()))
+        raise RuntimeError("input pipeline died")
+
+    with pytest.raises(RuntimeError, match="input pipeline died"):
+        trainer.fit(exploding(), steps=10)
+    assert wd._stop is None or wd._stop.is_set()
+    assert not wd._thread.is_alive()
+
+
+def test_watchdog_paused_during_eval():
+    import time
+
+    from tensorflow_train_distributed_tpu.training import StallWatchdog
+
+    wd = StallWatchdog(timeout_s=0.2)
+    wd.on_train_begin(None)
+    try:
+        wd.on_eval_begin()
+        time.sleep(0.7)          # long eval window: must NOT count
+        assert wd.stall_count == 0
+        wd.on_eval_end()
+        time.sleep(0.1)
+        assert wd.stall_count == 0
+    finally:
+        wd.on_train_end(None)

@@ -1,0 +1,35 @@
+"""Export a trained config as a TF SavedModel (serving interop).
+
+Usage:
+  python tools/export_savedmodel.py --config mnist \
+      --checkpoint-dir /ckpt --out /tmp/mnist_saved
+  (omit --checkpoint-dir to export a fresh init — signature smoke test)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--config", required=True)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--out", required=True)
+    p.add_argument("--platform", default="cpu",
+                   help="jax platform for the export trace ('' = default)")
+    args = p.parse_args(argv)
+    from tensorflow_train_distributed_tpu.export_tf import (
+        export_from_registry,
+    )
+
+    export_from_registry(args.config, args.checkpoint_dir, args.out,
+                         platform=args.platform)
+    print(f"SavedModel written to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
