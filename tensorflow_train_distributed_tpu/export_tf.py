@@ -102,18 +102,20 @@ def export_from_registry(config_name: str, checkpoint_dir, out_dir: str,
 
     sample = next(iter(HostDataLoader(
         source, DataConfig(global_batch_size=entry["global_batch_size"]))))
-    state = trainer.create_state(sample)
+    params = None
     if checkpoint_dir is not None:
         from tensorflow_train_distributed_tpu.training.checkpoint import (
             CheckpointManager,
         )
 
+        # Params-only restore: the export must not depend on matching the
+        # run's optimizer state (adamw vs sgd vs lamb all export alike).
         mgr = CheckpointManager(str(checkpoint_dir), async_save=False)
-        restored = mgr.restore(state)
-        if restored is None:
+        params = mgr.restore_params()
+        mgr.close()
+        if params is None:
             raise FileNotFoundError(
                 f"no checkpoint under {checkpoint_dir}")
-        state = restored
-        mgr.close()
+    state = trainer.create_state(sample, params=params)
     export_savedmodel(task, state.params, state.model_state, sample,
                       out_dir)

@@ -204,7 +204,7 @@ class RunResult:
     """What a launch produced (returned by ``run`` for tests/embedding)."""
 
     state: object
-    history: list
+    history: dict
     eval_metrics: Optional[dict]
     mesh: object
     preempted: bool = False

@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tensorflow_train_distributed_tpu.models.llama import (
     LlamaConfig,
@@ -31,12 +32,18 @@ from tensorflow_train_distributed_tpu.models.llama import (
 
 def generate(config: LlamaConfig, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
+             rng: Optional[jax.Array] = None,
+             cast_params: bool = True) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, S].
 
     ``temperature`` 0 → greedy argmax; > 0 → categorical sampling with
     ``rng`` (required).  Returns [B, S + max_new_tokens] token ids.
     Prompt + new tokens must fit ``config.max_positions`` (the cache size).
+
+    ``cast_params``: cast floating params to ``config.dtype`` before
+    inference — a trained state carries f32 masters (26 GB at 7B), which
+    inference neither needs nor fits on one chip; the compute path runs in
+    ``config.dtype`` either way.  No-op for f32 configs.
     """
     b, prompt_len = prompt.shape
     if max_new_tokens < 0:
@@ -57,6 +64,15 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
         raise ValueError("temperature sampling needs rng=")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
+    if cast_params:
+        # Read .dtype directly — jnp.asarray would round-trip every leaf
+        # through the device just to inspect it (26 GB of H2D at 7B).
+        params = jax.tree.map(
+            lambda x: x.astype(config.dtype)
+            if jnp.issubdtype(np.asarray(x).dtype
+                              if not hasattr(x, "dtype") else x.dtype,
+                              jnp.floating) else x,
+            params)
     return _generate(config, max_new_tokens, greedy, params, prompt,
                      jnp.float32(temperature), rng)
 

@@ -345,4 +345,7 @@ class StallWatchdog(Callback):
         if self._stop is not None:
             self._stop.set()
             self._thread.join(timeout=5)
-            self._stop = None
+            if not self._thread.is_alive():
+                # Only forget the event once the thread is confirmed gone —
+                # a loop blocked in a stack dump still reads self._stop.
+                self._stop = None

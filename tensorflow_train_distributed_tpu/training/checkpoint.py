@@ -81,6 +81,26 @@ class CheckpointManager:
         logger.info("restored checkpoint step %d", step)
         return restored
 
+    def restore_params(self, step: Optional[int] = None):
+        """Raw ``params`` subtree as host arrays, no state template.
+
+        For consumers that need only the weights (SavedModel export,
+        analysis tools): restoring through ``restore`` requires rebuilding
+        the exact optimizer/loss-scale state the run trained with, which a
+        tool cannot know.  Returns None when no checkpoint exists.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        restored = self._mgr.restore(step)
+        tree = restored if isinstance(restored, dict) else restored.__dict__
+        if "params" not in tree:
+            raise KeyError(
+                f"checkpoint step {step} has no 'params' subtree; keys: "
+                f"{sorted(tree)}")
+        logger.info("restored params subtree from step %d", step)
+        return tree["params"]
+
     def wait_until_finished(self):
         self._mgr.wait_until_finished()
 

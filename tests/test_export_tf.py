@@ -82,6 +82,26 @@ def test_task_without_predict_fn_rejected(tmp_path):
         export_savedmodel(NoPredict(), {}, {}, {}, str(tmp_path / "x"))
 
 
+def test_registry_wrapper_exports_adamw_checkpoint(tmp_path):
+    """Params-only restore: exporting must not depend on matching the
+    run's optimizer (the launcher default is adamw, the export trainer
+    uses sgd — a full-state restore would die on tree mismatch)."""
+    from tensorflow_train_distributed_tpu import launch
+    from tensorflow_train_distributed_tpu.export_tf import (
+        export_from_registry,
+    )
+
+    ckpt = str(tmp_path / "ck")
+    launch.run(launch.build_parser().parse_args([
+        "--config", "mnist", "--steps", "5", "--global-batch-size", "64",
+        "--optimizer", "adamw", "--checkpoint-dir", ckpt,
+        "--checkpoint-every", "5", "--log-every", "5"]))
+    out = str(tmp_path / "saved")
+    export_from_registry("mnist", ckpt, out, platform="")
+    loaded = tf.saved_model.load(out)
+    assert "serving_default" in loaded.signatures
+
+
 def test_registry_wrapper_fresh_init(tmp_path):
     from tensorflow_train_distributed_tpu.export_tf import (
         export_from_registry,
