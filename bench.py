@@ -141,6 +141,7 @@ def bench_config(preset_name: str, batch_per_chip: int, warmup: int,
 
     mesh = build_mesh(MeshConfig(data=-1))
     n_chips = mesh.devices.size
+    platform_hint = mesh.devices.flat[0].platform
     batch_size = batch_per_chip * n_chips
     preset = resnet.RESNET_PRESETS[preset_name]
     task = resnet.make_task(preset)
@@ -168,11 +169,22 @@ def bench_config(preset_name: str, batch_per_chip: int, warmup: int,
     for _ in range(warmup):
         state, m = step(state, dev_batch)
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, dev_batch)
-    jax.block_until_ready(m)
-    dt = (time.perf_counter() - t0) / iters
+    # Plausibility guard: a timed window faster than the compute roofline
+    # (all FLOPs at 100% peak) is a measurement artifact, not throughput —
+    # observed once on a flaky chip tunnel (73k img/s ≈ 460% MFU).
+    # Re-time once on the SAME compiled step (recompiling could blow the
+    # bench watchdog); a persistent artifact is reported but flagged so it
+    # can never become the headline.
+    roofline_dt = (batch_size * GFLOP_PER_IMAGE
+                   / (PEAK_TFLOPS.get(platform_hint, 1e9) * 1e3 * n_chips))
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, dev_batch)
+        jax.block_until_ready(m)
+        dt = (time.perf_counter() - t0) / iters
+        if dt >= roofline_dt:
+            break
     if profile_dir is not None:
         # Short profiled window, separate from the timed one: traces are
         # evidence for PROFILE.md, not part of the measurement.
@@ -184,13 +196,15 @@ def bench_config(preset_name: str, batch_per_chip: int, warmup: int,
         except Exception as e:  # profiling must never kill the bench
             print(f"# profiler trace failed: {e}", file=sys.stderr)
     img_per_sec_per_chip = batch_size / dt / n_chips
-    platform = mesh.devices.flat[0].platform
+    platform = platform_hint
     result = {
         "images_per_sec_per_chip": round(img_per_sec_per_chip, 1),
         "step_time_ms": round(dt * 1e3, 2),
         "batch_per_chip": batch_per_chip,
         "n_chips": n_chips,
     }
+    if dt < roofline_dt:
+        result["implausible"] = True
     if platform in PEAK_TFLOPS:
         mfu = (img_per_sec_per_chip * GFLOP_PER_IMAGE
                / (PEAK_TFLOPS[platform] * 1e3))
@@ -221,6 +235,11 @@ def main(argv=None) -> int:
                     help="emit a failure record instead of benching on CPU")
     p.add_argument("--profile-dir", default="profiles/bench",
                    help="jax.profiler trace output ('' disables)")
+    p.add_argument("--no-persist", dest="persist", action="store_false",
+                   default=True,
+                   help="don't overwrite the last-known-TPU record (for "
+                        "sweeps/experiments; the default headline run "
+                        "persists)")
     args = p.parse_args(argv)
 
     record = _base_record()
@@ -309,8 +328,15 @@ def main(argv=None) -> int:
                    backend=platform, probe_errors=errors))
         return 1
 
-    best_name = max(results, key=lambda n:
-                    results[n]["images_per_sec_per_chip"])
+    plausible = {n: r for n, r in results.items()
+                 if not r.get("implausible")}
+    if not plausible:
+        _emit(dict(record, backend=platform, configs=results,
+                   error="all measurements exceeded the hardware roofline "
+                         "(timing artifact; see bench_config guard)"))
+        return 1
+    best_name = max(plausible, key=lambda n:
+                    plausible[n]["images_per_sec_per_chip"])
     best = results[best_name]
     record.update(
         value=best["images_per_sec_per_chip"],
@@ -337,7 +363,7 @@ def main(argv=None) -> int:
         record["failed_configs"] = failures
     if profile_dir:
         record["profile_dir"] = profile_dir
-    if platform == "tpu":
+    if platform == "tpu" and args.persist:
         try:
             os.makedirs(os.path.dirname(LAST_TPU_RESULT), exist_ok=True)
             with open(LAST_TPU_RESULT, "w") as f:

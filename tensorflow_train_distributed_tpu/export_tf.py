@@ -102,20 +102,25 @@ def export_from_registry(config_name: str, checkpoint_dir, out_dir: str,
 
     sample = next(iter(HostDataLoader(
         source, DataConfig(global_batch_size=entry["global_batch_size"]))))
-    params = None
+    params = model_state = None
     if checkpoint_dir is not None:
         from tensorflow_train_distributed_tpu.training.checkpoint import (
             CheckpointManager,
         )
 
-        # Params-only restore: the export must not depend on matching the
-        # run's optimizer state (adamw vs sgd vs lamb all export alike).
+        # Inference-state restore: params + model_state (BN running
+        # statistics), but NOT the optimizer state — the export must not
+        # depend on matching the run's optimizer (adamw vs sgd vs lamb
+        # all export alike).
         mgr = CheckpointManager(str(checkpoint_dir), async_save=False)
-        params = mgr.restore_params()
+        restored = mgr.restore_inference_state()
         mgr.close()
-        if params is None:
+        if restored is None:
             raise FileNotFoundError(
                 f"no checkpoint under {checkpoint_dir}")
+        params, model_state = restored
     state = trainer.create_state(sample, params=params)
-    export_savedmodel(task, state.params, state.model_state, sample,
-                      out_dir)
+    # Fresh-init model_state is only correct when the checkpoint carried
+    # none (no mutable collections in the model).
+    export_savedmodel(task, state.params, model_state or state.model_state,
+                      sample, out_dir)

@@ -22,7 +22,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from tensorflow_train_distributed_tpu.models.llama import (
     LlamaConfig,
@@ -67,11 +66,12 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
     if cast_params:
         # Read .dtype directly — jnp.asarray would round-trip every leaf
         # through the device just to inspect it (26 GB of H2D at 7B).
+        # Non-array leaves (a Python float smuggled into a hand-built
+        # tree) have no .astype — leave them to _generate's tracing.
         params = jax.tree.map(
             lambda x: x.astype(config.dtype)
-            if jnp.issubdtype(np.asarray(x).dtype
-                              if not hasattr(x, "dtype") else x.dtype,
-                              jnp.floating) else x,
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
             params)
     return _generate(config, max_new_tokens, greedy, params, prompt,
                      jnp.float32(temperature), rng)

@@ -81,25 +81,78 @@ class CheckpointManager:
         logger.info("restored checkpoint step %d", step)
         return restored
 
+    def _restore_subtrees(self, step: int, names: tuple,
+                          required: tuple):
+        """Partial restore of top-level ``TrainState`` subtrees.
+
+        Deserializes ONLY the named subtrees — a full ``restore(step)``
+        would materialize the optimizer moments too (~3× params of host
+        RAM under adamw, enough to OOM an export host at 7B scale) just to
+        throw them away.  Subtrees in ``names`` but not in ``required``
+        are optional: absent or empty in the checkpoint → ``{}``.
+        """
+        import os
+
+        item_dir = os.path.join(str(self._mgr.directory), str(step),
+                                "default")
+        # Metadata straight from the item dir: the manager's
+        # ``item_metadata`` comes back None on a freshly opened manager
+        # (handler registry only populates after a save/restore call).
+        meta = ocp.StandardCheckpointer().metadata(item_dir).item_metadata
+        item = {}
+        for name in names:
+            try:
+                sub_meta = meta[name]
+            except KeyError:
+                if name in required:
+                    raise KeyError(
+                        f"checkpoint step {step} has no {name!r} subtree; "
+                        f"keys: {sorted(meta.keys())}") from None
+                continue  # optional subtree (e.g. empty model_state)
+            item[name] = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), sub_meta)
+        restored = ocp.PyTreeCheckpointer().restore(
+            item_dir,
+            args=ocp.args.PyTreeRestore(
+                item=item,
+                restore_args=jax.tree.map(lambda _: ocp.RestoreArgs(),
+                                          item),
+                transforms={},
+            ),
+        )
+        logger.info("restored %s subtrees from step %d",
+                    "/".join(sorted(item)), step)
+        return {name: restored.get(name, {}) for name in names}
+
     def restore_params(self, step: Optional[int] = None):
         """Raw ``params`` subtree as host arrays, no state template.
 
-        For consumers that need only the weights (SavedModel export,
-        analysis tools): restoring through ``restore`` requires rebuilding
-        the exact optimizer/loss-scale state the run trained with, which a
-        tool cannot know.  Returns None when no checkpoint exists.
+        For consumers that need only the weights (analysis tools):
+        restoring through ``restore`` requires rebuilding the exact
+        optimizer/loss-scale state the run trained with, which a tool
+        cannot know.  Returns None when no checkpoint exists.
         """
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             return None
-        restored = self._mgr.restore(step)
-        tree = restored if isinstance(restored, dict) else restored.__dict__
-        if "params" not in tree:
-            raise KeyError(
-                f"checkpoint step {step} has no 'params' subtree; keys: "
-                f"{sorted(tree)}")
-        logger.info("restored params subtree from step %d", step)
-        return tree["params"]
+        return self._restore_subtrees(
+            step, ("params",), required=("params",))["params"]
+
+    def restore_inference_state(self, step: Optional[int] = None):
+        """``(params, model_state)`` for inference/export consumers.
+
+        ``model_state`` carries the trained non-trainable collections
+        (BatchNorm running statistics) — exporting with fresh-init stats
+        would serve garbage for BN models.  It restores as ``{}`` when the
+        model has no mutable collections (the subtree is empty, so orbax
+        never wrote it).  Returns None when no checkpoint exists.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        tree = self._restore_subtrees(
+            step, ("params", "model_state"), required=("params",))
+        return tree["params"], tree["model_state"]
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

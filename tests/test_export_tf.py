@@ -111,3 +111,66 @@ def test_registry_wrapper_fresh_init(tmp_path):
     export_from_registry("mnist", None, out, platform="")
     loaded = tf.saved_model.load(out)
     assert "serving_default" in loaded.signatures
+
+
+def test_registry_export_carries_trained_bn_stats(tmp_path):
+    """Regression: export restores model_state (BatchNorm running stats)
+    from the checkpoint, not fresh-init mean=0/var=1 — a BN model exported
+    with fresh stats serves garbage."""
+    import jax
+
+    from tensorflow_train_distributed_tpu import launch
+    from tensorflow_train_distributed_tpu.export_tf import (
+        export_from_registry,
+    )
+    from tensorflow_train_distributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    ckpt = str(tmp_path / "ck")
+    launch.run(launch.build_parser().parse_args([
+        "--config", "resnet_tiny", "--steps", "5",
+        "--global-batch-size", "16", "--optimizer", "adamw",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "5",
+        "--log-every", "5"]))
+
+    mgr = CheckpointManager(ckpt, async_save=False)
+    restored = mgr.restore_inference_state()
+    mgr.close()
+    assert restored is not None
+    params, model_state = restored
+    stats = model_state["batch_stats"]
+    # Five training steps move every BN mean off its zero init.
+    means = [np.asarray(x) for path, x in
+             jax.tree_util.tree_flatten_with_path(stats)[0]
+             if "mean" in jax.tree_util.keystr(path)]
+    assert means and any(np.abs(m).max() > 0 for m in means)
+
+    from tensorflow_train_distributed_tpu.models import registry
+
+    task = registry.get_entry("resnet_tiny")["task_factory"]()
+    out = str(tmp_path / "saved")
+    export_from_registry("resnet_tiny", ckpt, out, platform="")
+    loaded = tf.saved_model.load(out)
+
+    # Functional probe (stats ride the jax2tf graph as constants, not
+    # variables): serving output must match jax predict under the TRAINED
+    # stats — and differ from fresh-init stats, which is what a
+    # params-only restore would have produced.
+    rng = np.random.default_rng(3)
+    image = rng.standard_normal((4, 32, 32, 3)).astype(np.float32)
+    label = np.zeros(4, np.int32)  # in the signature; unused by predict
+    served = loaded.signatures["serving_default"](
+        image=tf.constant(image),
+        label=tf.constant(label))["output"].numpy()
+    jax_trained = np.asarray(task.predict_fn(
+        params, model_state, {"image": image, "label": label}))
+    fresh_stats = jax.tree.map(np.zeros_like, stats)
+    fresh_stats = jax.tree_util.tree_map_with_path(
+        lambda p, x: np.ones_like(x) if "var" in jax.tree_util.keystr(p)
+        else x, fresh_stats)
+    jax_fresh = np.asarray(task.predict_fn(
+        params, {"batch_stats": fresh_stats},
+        {"image": image, "label": label}))
+    np.testing.assert_allclose(served, jax_trained, rtol=1e-4, atol=1e-4)
+    assert not np.allclose(served, jax_fresh, rtol=1e-4, atol=1e-4)
