@@ -16,7 +16,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -47,8 +46,103 @@ def param_count(tree):
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
+# Usable HBM per chip after the runtime's reserve, by device_kind
+# substring (v5e observed directly in OOM reports: 15.75 GiB of 16).
+HBM_BUDGET_GIB_BY_KIND = {
+    "v5 lite": 15.75,
+    "v5e": 15.75,
+    "v4": 31.25,
+    "v5p": 94.75,
+    "v6": 31.25,
+}
+
+
+def hbm_budget_bytes(device) -> float | None:
+    """Per-chip HBM budget, or None when the guard doesn't apply (non-TPU
+    backend, or a TPU generation the table doesn't know)."""
+    if device.platform != "tpu":
+        return None
+    kind = device.device_kind.lower()
+    for sub, gib in HBM_BUDGET_GIB_BY_KIND.items():
+        if sub in kind:
+            return gib * 2**30
+    return None
+
+
+def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
+                     batch: int, seq: int, remat: bool, *,
+                     causal: bool, force: bool, device,
+                     score_heads: int = 1) -> None:
+    """Pre-flight HBM estimate — refuse configs that would OOM on-chip.
+
+    An HBM-OOM *compile request* has twice killed this environment's
+    single-chip tunnel for the rest of the session (see PROFILE.md), so a
+    bench must not gamble.  Skipped entirely off-TPU (CPU smoke runs risk
+    nothing).  The activation model is empirical, calibrated against
+    observed XLA allocations on v5e (llama_125m seq2048: b8 fits, b16
+    no-remat needs 26.4G):
+
+      state  = params × 14 B   (bf16 compute copy + f32 master + 2×f32
+               adam moments + grads in flight)
+      remat  : ~6 residual passes of [B,S,d] per layer (layer inputs +
+               flash l/m/out saved across the scan)
+      no-remat: adds ~24 [B,S,d] passes per layer and ~6 score-sized temps
+               per layer stack.  ``score_heads=1`` models the flash path
+               (no materialized [S,S] per head); pass ``num_heads`` for
+               models on the reference einsum attention (BERT), which
+               saves per-head [B,H,S,S] logits/probs for backward.
+
+    Raises SystemExit with a machine-readable JSON line unless ``force``.
+    """
+    budget = hbm_budget_bytes(device)
+    if budget is None:
+        return
+    state = n_params * 14
+    act = n_layers * batch * seq * d_model * 2 * 6
+    if not remat:
+        act += n_layers * batch * seq * d_model * 2 * 24
+        act += (6 * score_heads * n_layers * batch * seq * seq * 2
+                // (2 if causal else 1))
+    need = state + act
+    # The estimate intentionally errs a little high (b16 no-remat: est 28
+    # vs 26.4 GiB observed), so compare against the full budget: known-good
+    # llama_125m b8 no-remat (est 14.9) passes, the two tunnel-killers
+    # (b16 no-remat est 28, llama_1b no-remat state alone > 17) refuse.
+    if need <= budget or force:
+        return
+    import json as _json
+
+    print(_json.dumps({
+        "error": "pre-flight HBM estimate exceeds budget; an OOM compile "
+                 "can kill the chip tunnel — rerun with --force-hbm to "
+                 "gamble anyway",
+        "estimated_gib": round(need / 2**30, 2),
+        "budget_gib": round(budget / 2**30, 2),
+        "device_kind": device.device_kind,
+        "state_gib": round(state / 2**30, 2),
+        "activations_gib": round(act / 2**30, 2),
+    }), flush=True)
+    raise SystemExit(2)
+
+
+def timed_step_seconds(step, state, dev_batch, warmup: int,
+                       iters: int) -> float:
+    """Shared measure loop: warmup, then a timed window; mean step s."""
+    import jax
+    import time as _time
+
+    for _ in range(warmup):
+        state, m = step(state, dev_batch)
+    jax.block_until_ready(state)
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, dev_batch)
+    jax.block_until_ready(m)
+    return (_time.perf_counter() - t0) / iters
+
+
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
-             remat=None, remat_policy=None):
+             remat=None, remat_policy=None, force_hbm: bool = False):
     import jax
     import numpy as np
     import optax
@@ -73,9 +167,19 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
+    task = llama.CausalLmTask(cfg)
+    import jax.numpy as jnp
+
     mesh = build_mesh(MeshConfig(data=-1))
     n_chips = mesh.devices.size
-    task = llama.CausalLmTask(cfg)
+    abstract = jax.eval_shape(lambda: task.init_variables(
+        jax.random.key(0),
+        {"tokens": jnp.zeros((1, seq), jnp.int32),
+         "targets": jnp.zeros((1, seq), jnp.int32)}))
+    check_hbm_budget(
+        param_count(abstract["params"]), cfg.num_layers, cfg.d_model,
+        batch, seq, cfg.remat, causal=True, force=force_hbm,
+        device=mesh.devices.flat[0])
     trainer = Trainer(
         task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1), mesh,
         policy=Policy.from_name("mixed_bfloat16"),
@@ -93,14 +197,7 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
     n_params = param_count(state.params)
     step = trainer._compiled_train_step()
     dev_batch = shard_batch(mesh, data)
-    for _ in range(warmup):
-        state, m = step(state, dev_batch)
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, dev_batch)
-    jax.block_until_ready(m)
-    dt = (time.perf_counter() - t0) / iters
+    dt = timed_step_seconds(step, state, dev_batch, warmup, iters)
     tok_per_sec_chip = global_batch * seq / dt / n_chips
     dev0 = mesh.devices.flat[0]
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.d_model * \
@@ -139,11 +236,24 @@ def main(argv=None) -> int:
     p.add_argument("--remat-policy", default=None,
                    choices=("full", "dots"),
                    help="what remat saves (see LlamaConfig.remat_policy)")
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (e.g. 'cpu' for a smoke run "
+                        "that must not touch the TPU tunnel)")
+    p.add_argument("--force-hbm", action="store_true",
+                   help="skip the pre-flight HBM estimate (an OOM compile "
+                        "can kill the chip tunnel)")
     args = p.parse_args(argv)
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
     try:
         rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
                        args.warmup, args.iters, remat=args.remat,
-                       remat_policy=args.remat_policy)
+                       remat_policy=args.remat_policy,
+                       force_hbm=args.force_hbm)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
                           "_per_chip", "value": 0.0,
