@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "adafactor"])
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled weight decay (adamw/lamb)")
+    p.add_argument("--grad-clip-norm", type=float, default=None,
+                   help="clip gradients to this global norm before the "
+                        "optimizer update (default: the config's "
+                        "convention, e.g. 1.0 for BERT/Llama; 0 disables)")
     p.add_argument("--warmup-steps", type=int, default=None,
                    help="linear LR warmup steps (default: the config's "
                         "warmup_ratio × --steps)")
@@ -181,22 +185,32 @@ def _make_optimizer(args, entry):
     name = args.lr_schedule or entry.get("lr_schedule", "constant")
     lr = schedules.by_name(name, peak, args.steps, warmup_steps=warmup)
     if args.optimizer == "sgd":
-        return optax.sgd(lr), lr
-    if args.optimizer == "momentum":
-        return optax.sgd(lr, momentum=0.9, nesterov=True), lr
-    if args.optimizer == "adam":
-        return optax.adam(lr), lr
-    if args.optimizer == "lamb":
+        tx = optax.sgd(lr)
+    elif args.optimizer == "momentum":
+        tx = optax.sgd(lr, momentum=0.9, nesterov=True)
+    elif args.optimizer == "adam":
+        tx = optax.adam(lr)
+    elif args.optimizer == "lamb":
         # BERT large-batch convention (the reference's PS-pretrain config
         # scaled with LAMB); layerwise trust ratios make the global batch
         # scalable far past Adam's stability range.
-        return optax.lamb(lr, weight_decay=args.weight_decay), lr
-    if args.optimizer == "adafactor":
+        tx = optax.lamb(lr, weight_decay=args.weight_decay)
+    elif args.optimizer == "adafactor":
         # Memory-frugal second-moment factorization — the optimizer of
         # choice when optimizer state must not double 7B-param HBM use.
-        return optax.adafactor(
-            lr, weight_decay_rate=args.weight_decay or None), lr
-    return optax.adamw(lr, weight_decay=args.weight_decay), lr
+        tx = optax.adafactor(
+            lr, weight_decay_rate=args.weight_decay or None)
+    else:
+        tx = optax.adamw(lr, weight_decay=args.weight_decay)
+    clip = args.grad_clip_norm
+    if clip is None:
+        clip = entry.get("grad_clip_norm")
+    if clip:  # 0/None = disabled
+        # Applied to the already-unscaled, globally-averaged grads (the
+        # Trainer unscales before tx), so the clip norm means the same
+        # thing at any loss-scale or batch size.
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx, lr
 
 
 @dataclasses.dataclass

@@ -15,12 +15,14 @@ _REGISTRY: dict[str, dict[str, Any]] = {}
 def register(name: str, *, task_factory: Callable, dataset: str,
              dataset_kwargs: dict | None = None, strategy: str = "dp",
              global_batch_size: int = 32, learning_rate: float = 1e-3,
-             lr_schedule: str = "constant", warmup_ratio: float = 0.0):
+             lr_schedule: str = "constant", warmup_ratio: float = 0.0,
+             grad_clip_norm: float | None = None):
     _REGISTRY[name] = dict(
         task_factory=task_factory, dataset=dataset,
         dataset_kwargs=dataset_kwargs or {}, strategy=strategy,
         global_batch_size=global_batch_size, learning_rate=learning_rate,
         lr_schedule=lr_schedule, warmup_ratio=warmup_ratio,
+        grad_clip_norm=grad_clip_norm,
     )
 
 
@@ -76,7 +78,10 @@ def _setup():
                  bert.BERT_PRESETS["bert_base"]),
              dataset="mlm", strategy="dp", global_batch_size=256,
              learning_rate=1e-4, lr_schedule="warmup_linear",
-             warmup_ratio=0.1)
+             warmup_ratio=0.1,
+             # BERT pretrain convention (Devlin et al. / NVIDIA refs):
+             # global-norm clip 1.0.
+             grad_clip_norm=1.0)
     register("bert_tiny_mlm",
              task_factory=lambda: bert.make_task(
                  bert.BERT_PRESETS["bert_tiny"]),
@@ -105,7 +110,9 @@ def _setup():
                  llama.LLAMA_PRESETS["llama2_7b"]),
              dataset="lm", strategy="fsdp_tp", global_batch_size=64,
              learning_rate=2e-5, lr_schedule="warmup_cosine",
-             warmup_ratio=0.03)
+             warmup_ratio=0.03,
+             # Llama-2 training convention: global-norm clip 1.0.
+             grad_clip_norm=1.0)
     # Beyond the reference (it has no MoE): expert-parallel decoder LM.
     register("mixtral_8x7b",
              task_factory=lambda: moe.make_task(

@@ -1,0 +1,104 @@
+"""Bench tooling: the HBM pre-flight guard and the shared timing path.
+
+The guard exists because an HBM-OOM compile request can kill the
+single-chip TPU tunnel for the whole session (PROFILE.md) — these tests
+pin its calibration to the three measured v5e data points and its
+skip-off-TPU contract, with fake device objects (no backend needed).
+"""
+
+import dataclasses
+import importlib.util
+import os
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(scope="module")
+def bench_lm_mod():
+    spec = importlib.util.spec_from_file_location(
+        "bench_lm_under_test", os.path.join(_TOOLS, "bench_lm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@dataclasses.dataclass
+class FakeDevice:
+    platform: str = "tpu"
+    device_kind: str = "TPU v5 lite"
+
+
+LLAMA_125M = dict(n_params=134_105_856, n_layers=12, d_model=768, seq=2048)
+
+
+class TestHbmGuard:
+    def test_measured_v5e_points(self, bench_lm_mod):
+        """Calibration: b8 no-remat ran on the chip, b16 no-remat OOMed
+        at 26.4 GiB (both measured 2026-07-30), remat always fits."""
+        check = bench_lm_mod.check_hbm_budget
+        dev = FakeDevice()
+        check(batch=8, remat=False, causal=True, force=False, device=dev,
+              **LLAMA_125M)  # fits → returns
+        check(batch=8, remat=True, causal=True, force=False, device=dev,
+              **LLAMA_125M)
+        with pytest.raises(SystemExit):
+            check(batch=16, remat=False, causal=True, force=False,
+                  device=dev, **LLAMA_125M)
+
+    def test_skipped_off_tpu_and_on_unknown_kind(self, bench_lm_mod):
+        for dev in (FakeDevice(platform="cpu", device_kind="cpu"),
+                    FakeDevice(device_kind="TPU v99 mystery")):
+            bench_lm_mod.check_hbm_budget(
+                batch=4096, remat=False, causal=True, force=False,
+                device=dev, **LLAMA_125M)  # must not raise
+
+    def test_force_overrides(self, bench_lm_mod):
+        bench_lm_mod.check_hbm_budget(
+            batch=4096, remat=False, causal=True, force=True,
+            device=FakeDevice(), **LLAMA_125M)
+
+    def test_generation_budgets(self, bench_lm_mod):
+        """llama_1b no-remat (state ~17 GiB) refuses on v5e, fits v5p."""
+        kw = dict(n_params=1_300_000_000, n_layers=16, d_model=2048,
+                  batch=4, seq=2048, remat=False, causal=True, force=False)
+        with pytest.raises(SystemExit):
+            bench_lm_mod.check_hbm_budget(
+                device=FakeDevice(device_kind="TPU v5 lite"), **kw)
+        bench_lm_mod.check_hbm_budget(
+            device=FakeDevice(device_kind="TPU v5p"), **kw)
+
+    def test_per_head_scores_matter(self, bench_lm_mod):
+        """BERT-style einsum attention (score_heads=num_heads) refuses a
+        config the flash-path model would wave through."""
+        kw = dict(n_params=110_000_000, n_layers=12, d_model=768,
+                  batch=32, seq=512, remat=False, causal=False,
+                  force=False, device=FakeDevice())
+        bench_lm_mod.check_hbm_budget(score_heads=1, **kw)
+        with pytest.raises(SystemExit):
+            bench_lm_mod.check_hbm_budget(score_heads=12, **kw)
+
+    def test_refusal_record_is_json(self, bench_lm_mod, capsys):
+        import json
+
+        with pytest.raises(SystemExit):
+            bench_lm_mod.check_hbm_budget(
+                batch=4096, remat=False, causal=True, force=False,
+                device=FakeDevice(), **LLAMA_125M)
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "error" in rec and rec["estimated_gib"] > rec["budget_gib"]
+
+
+def test_bench_bert_smoke_on_cpu_mesh(bench_lm_mod):
+    """End-to-end tiny BERT bench on the test mesh (conftest forces CPU):
+    the record schema the docstring promises actually lands."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_bert_under_test", os.path.join(_TOOLS, "bench_bert.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.bench_bert("bert_tiny", batch=2, seq=32, warmup=1, iters=2)
+    assert rec["unit"] == "samples/sec/chip"
+    assert rec["value"] > 0 and rec["backend"] == "cpu"
+    assert rec["n_params"] > 0

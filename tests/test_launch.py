@@ -228,3 +228,69 @@ def test_eval_only_without_checkpoint_rejected(tmp_path):
     with _pytest.raises(SystemExit, match="eval-steps"):
         launch.run(_args(
             "--config", "mnist", "--steps", "5", "--eval-only"))
+
+
+class TestGradClipping:
+    def test_make_optimizer_clips_to_global_norm(self):
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import registry
+
+        # sgd lr=1.0 so the update IS the (negated) clipped gradient —
+        # adam would normalize magnitudes and mask a missing clip.
+        args = _args("--config", "bert_tiny_mlm", "--grad-clip-norm", "1.0",
+                     "--steps", "10", "--optimizer", "sgd",
+                     "--learning-rate", "1.0", "--lr-schedule", "constant",
+                     "--warmup-steps", "0")
+        tx, _ = launch._make_optimizer(args, registry.get_entry(args.config))
+        params = {"w": jnp.zeros(4)}
+        grads = {"w": jnp.full(4, 100.0)}  # norm 200 >> clip 1.0
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        assert float(optax.global_norm(updates)) == pytest.approx(1.0,
+                                                                  rel=1e-5)
+        assert float(updates["w"][0]) < 0  # descent direction preserved
+
+    def test_flag_omitted_uses_config_convention(self):
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import registry
+
+        # No --grad-clip-norm: bert_base_mlm's convention (1.0) applies.
+        args = _args("--config", "bert_base_mlm", "--steps", "10",
+                     "--optimizer", "sgd", "--learning-rate", "1.0",
+                     "--lr-schedule", "constant", "--warmup-steps", "0")
+        tx, _ = launch._make_optimizer(
+            args, registry.get_entry("bert_base_mlm"))
+        grads = {"w": jnp.full(4, 100.0)}
+        state = tx.init({"w": jnp.zeros(4)})
+        updates, _ = tx.update(grads, state, {"w": jnp.zeros(4)})
+        assert float(optax.global_norm(updates)) == pytest.approx(1.0,
+                                                                  rel=1e-5)
+
+    def test_config_convention_applies_and_zero_disables(self):
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models import registry
+
+        entry = registry.get_entry("bert_base_mlm")
+        assert entry["grad_clip_norm"] == 1.0
+        # --grad-clip-norm 0 overrides the config convention off.
+        args = _args("--config", "bert_base_mlm", "--grad-clip-norm", "0",
+                     "--steps", "10", "--optimizer", "sgd",
+                     "--learning-rate", "1.0", "--lr-schedule", "constant",
+                     "--warmup-steps", "0")
+        tx, _ = launch._make_optimizer(args, entry)
+        grads = {"w": jnp.full(4, 100.0)}
+        state = tx.init({"w": jnp.zeros(4)})
+        updates, _ = tx.update(grads, state, {"w": jnp.zeros(4)})
+        # sgd lr=1.0, no clip: update = -grads exactly.
+        assert float(jnp.abs(updates["w"]).max()) == 100.0
+
+    def test_e2e_run_with_clipping(self, tmp_path):
+        res = launch.run(_args(
+            "--config", "mnist", "--steps", "5", "--global-batch-size", "32",
+            "--grad-clip-norm", "0.5", "--log-every", "5"))
+        assert len(res.history["loss"]) >= 1

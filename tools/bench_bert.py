@@ -18,7 +18,9 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
+sys.path.insert(0, _HERE)                   # tools/ (bench_lm helpers)
 
 from bench_lm import (  # noqa: E402
     check_hbm_budget,

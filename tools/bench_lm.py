@@ -176,9 +176,13 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         jax.random.key(0),
         {"tokens": jnp.zeros((1, seq), jnp.int32),
          "targets": jnp.zeros((1, seq), jnp.int32)}))
+    # remat_policy="dots" saves every matmul output — including the SwiGLU
+    # hiddens that dominate the no-remat footprint — so for budgeting it
+    # is the no-remat estimate, not the full-remat one.
+    effective_remat = cfg.remat and cfg.remat_policy != "dots"
     check_hbm_budget(
         param_count(abstract["params"]), cfg.num_layers, cfg.d_model,
-        batch, seq, cfg.remat, causal=True, force=force_hbm,
+        batch, seq, effective_remat, causal=True, force=force_hbm,
         device=mesh.devices.flat[0])
     trainer = Trainer(
         task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1), mesh,
