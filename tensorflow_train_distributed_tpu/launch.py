@@ -84,6 +84,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "accumulation; reference analog: Horovod "
                         "backward_passes_per_step)")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--log-grad-norm", action="store_true",
+                   help="add a grad_norm metric (pre-clip global norm of "
+                        "the averaged grads) to step logs")
+    p.add_argument("--bleu-eval", type=int, default=0, metavar="N",
+                   help="after training, beam-decode N eval batches and "
+                        "report corpus BLEU (seq2seq/wmt configs only)")
+    p.add_argument("--beam-size", type=int, default=4,
+                   help="beam width for --bleu-eval (1 = greedy); WMT "
+                        "convention is 4")
+    p.add_argument("--bos-id", type=int, default=1)
+    p.add_argument("--eos-id", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval-steps", type=int, default=0,
                    help="run evaluation for N batches after training")
@@ -205,12 +216,44 @@ def _make_optimizer(args, entry):
     clip = args.grad_clip_norm
     if clip is None:
         clip = entry.get("grad_clip_norm")
+    if clip is not None and clip < 0:
+        raise ValueError(
+            f"--grad-clip-norm must be >= 0 (0 disables), got {clip}; a "
+            "negative max norm would flip every update's sign")
     if clip:  # 0/None = disabled
         # Applied to the already-unscaled, globally-averaged grads (the
         # Trainer unscales before tx), so the clip norm means the same
         # thing at any loss-scale or batch size.
         tx = optax.chain(optax.clip_by_global_norm(clip), tx)
     return tx, lr
+
+
+def _bleu_eval(args, task, state, loader) -> float:
+    """Beam-decode eval batches and score corpus BLEU — the reference's
+    Transformer-big target metric ([SPEC] config[3]), evaluated the WMT
+    way (beam search + length penalty) rather than teacher-forced."""
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.models import transformer as tr
+    from tensorflow_train_distributed_tpu.ops.metrics import (
+        corpus_bleu, strip_after_eos,
+    )
+
+    if not isinstance(task, tr.Seq2SeqTask):
+        raise ValueError(
+            "--bleu-eval needs a seq2seq config (wmt family); "
+            f"{type(task).__name__} does not decode")
+    hyps, refs = [], []
+    for _, batch in zip(range(args.bleu_eval), loader):
+        out = np.asarray(tr.beam_translate(
+            task.config, state.params, batch["inputs"],
+            max_len=batch["targets_out"].shape[1],
+            beam_size=args.beam_size, bos_id=args.bos_id,
+            eos_id=args.eos_id))
+        hyps += [strip_after_eos(list(r), args.eos_id) for r in out]
+        refs += [strip_after_eos(list(r), args.eos_id)
+                 for r in np.asarray(batch["targets_out"])]
+    return corpus_bleu(hyps, refs)
 
 
 @dataclasses.dataclass
@@ -353,6 +396,14 @@ def run(args: argparse.Namespace) -> RunResult:
 
     # 4. Trainer: task + optimizer + policy + callbacks.
     task = entry["task_factory"]()
+    if args.bleu_eval > 0:
+        # Fail at launch, not after a multi-hour run completes.
+        from tensorflow_train_distributed_tpu.models import transformer as tr
+
+        if not isinstance(task, tr.Seq2SeqTask):
+            raise ValueError(
+                "--bleu-eval needs a seq2seq config (wmt family); "
+                f"{type(task).__name__} does not decode")
     policy = Policy.from_name(args.precision)
     callbacks = [History(), ProgressLogger(examples_per_step=global_batch)]
     if args.tensorboard_dir:
@@ -406,6 +457,7 @@ def run(args: argparse.Namespace) -> RunResult:
             grad_accum=args.grad_accum,
             log_every=args.log_every,
             checkpoint_every=args.checkpoint_every,
+            log_grad_norm=args.log_grad_norm,
         ),
         callbacks=callbacks,
         checkpoint_manager=ckpt,
@@ -484,6 +536,11 @@ def run(args: argparse.Namespace) -> RunResult:
             eval_metrics = trainer.evaluate(
                 make_eval_loader(), state, steps=args.eval_steps)
             logger.info("eval-only: %s", eval_metrics)
+            if args.bleu_eval > 0:
+                bleu = _bleu_eval(args, task, state, make_eval_loader())
+                eval_metrics = dict(eval_metrics or {}, bleu=bleu)
+                logger.info("BLEU (beam %d, %d batches): %.2f",
+                            args.beam_size, args.bleu_eval, bleu)
             history = next(
                 (c.history for c in callbacks if isinstance(c, History)),
                 {})
@@ -535,6 +592,11 @@ def run(args: argparse.Namespace) -> RunResult:
             eval_metrics = trainer.evaluate(
                 make_eval_loader(), state, steps=args.eval_steps)
             logger.info("eval: %s", eval_metrics)
+        if args.bleu_eval > 0 and not preempted:
+            bleu = _bleu_eval(args, task, state, make_eval_loader())
+            eval_metrics = dict(eval_metrics or {}, bleu=bleu)
+            logger.info("BLEU (beam %d, %d batches): %.2f",
+                        args.beam_size, args.bleu_eval, bleu)
     finally:
         if watcher is not None:
             watcher.uninstall()

@@ -192,3 +192,78 @@ def greedy_translate(config: TransformerConfig, params, inputs,
 
     ys, _ = jax.lax.fori_loop(0, max_len, body, (ys, finished0))
     return ys[:, 1:]
+
+
+@partial(jax.jit, static_argnames=("config", "max_len", "beam_size",
+                                   "bos_id", "eos_id", "pad_id"))
+def beam_translate(config: TransformerConfig, params, inputs,
+                   *, max_len: int, beam_size: int = 4,
+                   bos_id: int, eos_id: int, pad_id: int = 0,
+                   length_alpha: float = 0.6):
+    """Beam-search seq2seq decoding: [B, S] sources → [B, max_len] targets.
+
+    The WMT convention the reference's Transformer-big config evaluates
+    under (beam 4, GNMT length penalty ((5+l)/6)^alpha, alpha 0.6); greedy
+    is the beam_size=1 special case.  TPU-first mechanics match
+    ``greedy_translate``: one jit, static shapes, ``lax.fori_loop`` over
+    positions, encoder run once — beams ride the batch dimension
+    ([B, K] flattened to B·K) so the decoder sees one big static batch.
+
+    Single-buffer variant: a finished beam (emitted EOS) can only extend
+    with ``pad_id`` at zero added cost, freezing its raw score; the final
+    winner per row is argmax of cumulative log-prob / GNMT length penalty.
+    (The dual live/finished buffer of GNMT/T5X differs only when a short
+    finished hypothesis should *lose* its slot to a longer live one
+    mid-search — beams here are never reclaimed once finished.)
+
+    Returns [B, max_len] int32; positions after EOS are ``pad_id``.
+    """
+    model = Seq2SeqTransformer(config)
+    b = inputs.shape[0]
+    k = beam_size
+    enc = model.apply({"params": params}, inputs, method="encode")
+    # [B, S, D] → [B·K, S, D], beams contiguous per row.
+    enc = jnp.repeat(enc, k, axis=0)
+
+    ys = jnp.full((b, k, max_len + 1), pad_id, jnp.int32)
+    ys = ys.at[:, :, 0].set(bos_id)
+    # Beam 0 starts at 0; the rest at -inf so step 0 doesn't pick K copies
+    # of the same token from identical prefixes.
+    neg_inf = jnp.asarray(-1e9, jnp.float32)
+    scores = jnp.tile(jnp.array([0.0] + [float(-1e9)] * (k - 1),
+                                jnp.float32), (b, 1))
+    finished = jnp.zeros((b, k), bool)
+    lengths = jnp.zeros((b, k), jnp.int32)  # tokens generated (incl. EOS)
+
+    vocab = config.vocab_size
+
+    def body(i, carry):
+        ys, scores, finished, lengths = carry
+        logits = model.apply(
+            {"params": params}, ys.reshape(b * k, -1)[:, :-1], enc,
+            method="decode")
+        logp = jax.nn.log_softmax(
+            logits[:, i].astype(jnp.float32)).reshape(b, k, vocab)
+        # Finished beams: only pad continues, at zero added cost.
+        pad_only = jnp.full((vocab,), -1e9, jnp.float32).at[pad_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], pad_only[None, None], logp)
+        cand = scores[:, :, None] + logp                  # [B, K, V]
+        top, idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        beam_idx, tok = idx // vocab, (idx % vocab).astype(jnp.int32)
+        take = lambda t: jnp.take_along_axis(  # noqa: E731
+            t, beam_idx.reshape(beam_idx.shape + (1,) * (t.ndim - 2)),
+            axis=1)
+        ys = take(ys).at[:, :, i + 1].set(tok)
+        was_done = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        lengths = jnp.where(was_done, lengths, lengths + 1)
+        return ys, top, was_done | (tok == eos_id), lengths
+
+    ys, scores, finished, lengths = jax.lax.fori_loop(
+        0, max_len, body, (ys, scores, finished, lengths))
+    # GNMT length penalty on the final cumulative scores.
+    lp = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_alpha
+    best = jnp.argmax(jnp.where(scores <= neg_inf / 2, neg_inf,
+                                scores / lp), axis=1)
+    out = jnp.take_along_axis(ys, best[:, None, None], axis=1)[:, 0]
+    return out[:, 1:]

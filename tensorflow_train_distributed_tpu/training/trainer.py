@@ -84,6 +84,11 @@ class TrainerConfig:
     log_every: int = 10
     checkpoint_every: Optional[int] = None
     donate_state: bool = True
+    # Adds a ``grad_norm`` metric (global norm of the unscaled, averaged
+    # grads, measured BEFORE any optimizer-chain clipping — the signal
+    # used to choose a --grad-clip-norm).  Off by default: it is an extra
+    # all-params reduction per step.
+    log_grad_norm: bool = False
 
 
 class Trainer:
@@ -305,6 +310,8 @@ class Trainer:
             new_ls = None
 
         metrics = dict(metrics, loss=loss)
+        if self.config.log_grad_norm:
+            metrics["grad_norm"] = optax.global_norm(grads)
         if self.lr_schedule is not None:
             metrics["lr"] = jnp.asarray(self.lr_schedule(state.step),
                                         jnp.float32)

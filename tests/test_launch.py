@@ -294,3 +294,71 @@ class TestGradClipping:
             "--config", "mnist", "--steps", "5", "--global-batch-size", "32",
             "--grad-clip-norm", "0.5", "--log-every", "5"))
         assert len(res.history["loss"]) >= 1
+
+    def test_log_grad_norm_metric(self):
+        """--log-grad-norm surfaces the pre-clip global norm: with clip 1.0
+        active, logged grad_norm can exceed 1 while updates stay clipped."""
+        res = launch.run(_args(
+            "--config", "mnist", "--steps", "5", "--global-batch-size", "32",
+            "--grad-clip-norm", "1.0", "--log-grad-norm",
+            "--log-every", "1"))
+        norms = res.history["grad_norm"]
+        assert len(norms) == 5 and all(n > 0 for n in norms)
+
+
+def test_bleu_eval_through_cli():
+    """--bleu-eval on the tiny WMT config: beam decode + corpus BLEU land
+    in eval_metrics (value near 0 for an untrained model; key + range is
+    the contract, quality is test_copy_task_reaches_high_bleu's job)."""
+    result = launch.run(_args(
+        "--config", "transformer_tiny_wmt", "--steps", "2",
+        "--global-batch-size", "16", "--precision", "float32",
+        "--eval-steps", "1", "--bleu-eval", "1", "--beam-size", "2",
+        "--log-every", "1"))
+    assert "bleu" in result.eval_metrics
+    assert 0.0 <= result.eval_metrics["bleu"] <= 100.0
+
+
+def test_bleu_eval_rejects_non_seq2seq():
+    with pytest.raises(ValueError, match="seq2seq"):
+        launch.run(_args(
+            "--config", "mnist", "--steps", "1",
+            "--global-batch-size", "16", "--bleu-eval", "1",
+            "--log-every", "1"))
+
+
+def test_negative_grad_clip_rejected():
+    from tensorflow_train_distributed_tpu.models import registry
+
+    args = _args("--config", "mnist", "--grad-clip-norm", "-1",
+                 "--steps", "5")
+    with pytest.raises(ValueError, match="grad-clip-norm"):
+        launch._make_optimizer(args, registry.get_entry("mnist"))
+
+
+def test_bleu_eval_rejected_before_training(tmp_path):
+    """Config mismatch fails at launch, not after the run."""
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="seq2seq"):
+        launch.run(_args(
+            "--config", "mnist", "--steps", "100000",
+            "--global-batch-size", "16", "--bleu-eval", "1",
+            "--log-every", "1"))
+    assert time.monotonic() - t0 < 60  # long before 100k steps
+
+
+def test_eval_only_reports_bleu(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    launch.run(_args(
+        "--config", "transformer_tiny_wmt", "--steps", "2",
+        "--global-batch-size", "16", "--precision", "float32",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+        "--log-every", "1"))
+    result = launch.run(_args(
+        "--config", "transformer_tiny_wmt", "--global-batch-size", "16",
+        "--precision", "float32", "--checkpoint-dir", ckpt, "--eval-only",
+        "--eval-steps", "1", "--bleu-eval", "1", "--beam-size", "2",
+        "--log-every", "1"))
+    assert "bleu" in result.eval_metrics

@@ -155,3 +155,69 @@ def test_copy_task_reaches_high_bleu():
     refs = [list(map(int, r)) for r in src]
     bleu = corpus_bleu(hyps, refs)
     assert bleu > 90.0, (bleu, hyps[:2], refs[:2])
+
+
+class TestBeamTranslate:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        from tensorflow_train_distributed_tpu.models.transformer import (
+            beam_translate,
+        )
+
+        cfg = TRANSFORMER_PRESETS["transformer_tiny"]
+        rng = np.random.default_rng(1)
+        src = rng.integers(3, cfg.vocab_size, (3, 6)).astype(np.int32)
+        params = Seq2SeqTransformer(cfg).init(
+            jax.random.key(1), src, src)["params"]
+        return cfg, params, src, beam_translate
+
+    @staticmethod
+    def _seq_logprob(cfg, params, src, out, bos, eos, pad):
+        """Model log-prob of a decoded row (up to and including EOS)."""
+        model = Seq2SeqTransformer(cfg)
+        enc = model.apply({"params": params}, jnp.asarray(src),
+                          method="encode")
+        tgt_in = np.concatenate(
+            [np.full((out.shape[0], 1), bos, np.int32), out[:, :-1]], 1)
+        logp = jax.nn.log_softmax(model.apply(
+            {"params": params}, jnp.asarray(tgt_in), enc,
+            method="decode").astype(jnp.float32))
+        total = np.zeros(out.shape[0])
+        for r in range(out.shape[0]):
+            for i, tok in enumerate(out[r]):
+                total[r] += float(logp[r, i, tok])
+                if tok == eos:
+                    break
+        return total
+
+    def test_beam1_equals_greedy(self, tiny):
+        cfg, params, src, beam_translate = tiny
+        g = np.asarray(greedy_translate(
+            cfg, params, jnp.asarray(src), max_len=6, bos_id=1, eos_id=2))
+        b = np.asarray(beam_translate(
+            cfg, params, jnp.asarray(src), max_len=6, beam_size=1,
+            bos_id=1, eos_id=2))
+        np.testing.assert_array_equal(g, b)
+
+    def test_beam_never_below_greedy_likelihood(self, tiny):
+        """The point of beam search: its hypothesis's model log-prob is ≥
+        greedy's on every row (equal when greedy is optimal)."""
+        cfg, params, src, beam_translate = tiny
+        kw = dict(max_len=6, bos_id=1, eos_id=2)
+        g = np.asarray(greedy_translate(cfg, params, jnp.asarray(src), **kw))
+        b = np.asarray(beam_translate(cfg, params, jnp.asarray(src),
+                                      beam_size=4, length_alpha=0.0, **kw))
+        lp_g = self._seq_logprob(cfg, params, src, g, 1, 2, 0)
+        lp_b = self._seq_logprob(cfg, params, src, b, 1, 2, 0)
+        assert (lp_b >= lp_g - 1e-4).all(), (lp_b, lp_g)
+
+    def test_eos_freezes_row_and_pads(self, tiny):
+        cfg, params, src, beam_translate = tiny
+        out = np.asarray(beam_translate(
+            cfg, params, jnp.asarray(src), max_len=8, beam_size=3,
+            bos_id=1, eos_id=2))
+        assert out.shape == (3, 8)
+        for row in out:
+            hit = np.where(row == 2)[0]
+            if hit.size:
+                assert (row[hit[0] + 1:] == 0).all()
