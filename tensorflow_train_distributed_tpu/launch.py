@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-grad-norm", action="store_true",
                    help="add a grad_norm metric (pre-clip global norm of "
                         "the averaged grads) to step logs")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard optimizer moments over the data "
+                        "axis (N× less optimizer memory on an N-way dp "
+                        "mesh; numerically identical)")
     p.add_argument("--bleu-eval", type=int, default=0, metavar="N",
                    help="after training, beam-decode N eval batches and "
                         "report corpus BLEU (seq2seq/wmt configs only)")
@@ -458,6 +462,7 @@ def run(args: argparse.Namespace) -> RunResult:
             log_every=args.log_every,
             checkpoint_every=args.checkpoint_every,
             log_grad_norm=args.log_grad_norm,
+            zero1=args.zero1,
         ),
         callbacks=callbacks,
         checkpoint_manager=ckpt,

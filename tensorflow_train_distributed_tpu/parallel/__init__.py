@@ -19,6 +19,7 @@ from tensorflow_train_distributed_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,
     logical_sharding,
     make_state_shardings,
+    zero1_opt_shardings,
     shard_batch,
     shard_batch_spec,
     with_logical_rules,

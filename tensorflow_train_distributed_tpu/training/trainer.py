@@ -89,6 +89,11 @@ class TrainerConfig:
     # used to choose a --grad-clip-norm).  Off by default: it is an extra
     # all-params reduction per step.
     log_grad_norm: bool = False
+    # ZeRO-1: shard optimizer moments over the ``data`` mesh axis
+    # (parallel.sharding.zero1_opt_shardings).  N× less moment memory on
+    # an N-way dp mesh for one extra all-gather per step; numerically
+    # identical (parity-tested).
+    zero1: bool = False
 
 
 class Trainer:
@@ -172,6 +177,11 @@ class Trainer:
             self.state_shardings = sharding_lib.make_state_shardings(
                 self.mesh, abstract, self.rules
             )
+            if self.config.zero1:
+                self.state_shardings = self.state_shardings.replace(
+                    opt_state=sharding_lib.zero1_opt_shardings(
+                        self.mesh, abstract.opt_state,
+                        self.state_shardings.opt_state))
             state = jax.jit(_create, out_shardings=self.state_shardings)()
         state = nn.unbox(state)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
@@ -625,6 +635,7 @@ def plan_state_memory(
     *,
     rules: LogicalRules = DEFAULT_RULES,
     policy: Policy = Policy(),
+    zero1: bool = False,
 ) -> dict[str, float]:
     """AOT memory plan: per-device bytes of params + optimizer state.
 
@@ -656,6 +667,10 @@ def plan_state_memory(
 
     abstract = jax.eval_shape(_create)
     shardings = sharding_lib.make_state_shardings(mesh, abstract, rules)
+    if zero1:
+        shardings = shardings.replace(
+            opt_state=sharding_lib.zero1_opt_shardings(
+                mesh, abstract.opt_state, shardings.opt_state))
     is_boxed = lambda x: isinstance(x, nn.meta.AxisMetadata)  # noqa: E731
     leaves = jax.tree.leaves(abstract, is_leaf=is_boxed)
     shard_leaves = jax.tree.leaves(
