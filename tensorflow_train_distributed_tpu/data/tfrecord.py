@@ -25,12 +25,27 @@ Sequential-proto decode is NOT the hot path (that is the mmap format in
 
 from __future__ import annotations
 
+import gzip
+import io
 import json
 import struct
 from pathlib import Path
 from typing import Optional, Sequence, Union
 
 import numpy as np
+
+# id1+id2+deflate method: 3 bytes, not 2 — a plain TFRecord whose first
+# record is exactly 0x8B1F bytes long starts with 1f 8b too, but its third
+# byte is a length byte, not 0x08.
+_GZIP_MAGIC = b"\x1f\x8b\x08"
+
+
+def _is_gzip(path: Union[str, Path]) -> bool:
+    """Sniff the gzip magic — TF writes ``.gz`` TFRecords as one gzip
+    stream over the whole file (TFRecordOptions GZIP), and extension
+    conventions vary, so content beats suffix."""
+    with open(path, "rb") as f:
+        return f.read(3) == _GZIP_MAGIC
 
 # --- crc32c (Castagnoli), table-driven, with TF's masking -------------------
 
@@ -276,10 +291,18 @@ def decode_example(data: bytes) -> dict[str, object]:
 
 
 class TFRecordWriter:
-    """Write raw records in TFRecord framing (context-manager friendly)."""
+    """Write raw records in TFRecord framing (context-manager friendly).
 
-    def __init__(self, path: Union[str, Path]):
-        self._f = open(path, "wb")
+    A ``.gz`` path (or ``compress=True``) streams through gzip — the
+    TFRecordOptions GZIP wire format, readable by tf.data with
+    ``compression_type="GZIP"`` and by ``TFRecordSource`` here.
+    """
+
+    def __init__(self, path: Union[str, Path],
+                 compress: Optional[bool] = None):
+        if compress is None:
+            compress = str(path).endswith(".gz")
+        self._f = gzip.open(path, "wb") if compress else open(path, "wb")
 
     def write(self, record: bytes) -> None:
         header = struct.pack("<Q", len(record))
@@ -302,8 +325,9 @@ class TFRecordWriter:
 
 
 def read_records(path: Union[str, Path], *, verify_crc: bool = True):
-    """Yield raw record payloads from one TFRecord file."""
-    with open(path, "rb") as f:
+    """Yield raw record payloads from one TFRecord file (gzip-aware)."""
+    opener = gzip.open if _is_gzip(path) else open
+    with opener(path, "rb") as f:
         while True:
             header = f.read(8)
             if not header:
@@ -323,32 +347,36 @@ def read_records(path: Union[str, Path], *, verify_crc: bool = True):
             yield payload
 
 
-def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
+def _index_stream(f, size: int, name: str) -> list[tuple[int, int]]:
     """One sequential pass → [(payload_offset, payload_length)].
 
-    Bounds-checks every record against the file size so a file truncated
-    mid-record (crashed writer) fails loudly at open time, not as an
-    opaque decode error mid-training.
+    Bounds-checks every record against the stream size so a file
+    truncated mid-record (crashed writer) fails loudly at open time, not
+    as an opaque decode error mid-training.
     """
     index = []
+    pos = 0
+    while True:
+        header = f.read(8)
+        if not header:
+            return index
+        if len(header) != 8:
+            raise ValueError(f"{name}: truncated length header")
+        (length,) = struct.unpack("<Q", header)
+        end = pos + 12 + length + 4
+        if end > size:
+            raise ValueError(
+                f"{name}: truncated record at offset {pos} "
+                f"(needs {end} bytes, stream has {size})")
+        index.append((pos + 12, length))
+        pos = end
+        f.seek(pos)
+
+
+def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
     size = Path(path).stat().st_size
     with open(path, "rb") as f:
-        pos = 0
-        while True:
-            header = f.read(8)
-            if not header:
-                return index
-            if len(header) != 8:
-                raise ValueError(f"{path}: truncated length header")
-            (length,) = struct.unpack("<Q", header)
-            end = pos + 12 + length + 4
-            if end > size:
-                raise ValueError(
-                    f"{path}: truncated record at offset {pos} "
-                    f"(needs {end} bytes, file has {size})")
-            index.append((pos + 12, length))
-            pos = end
-            f.seek(pos)
+        return _index_stream(f, size, str(path))
 
 
 class TFRecordSource:
@@ -371,8 +399,22 @@ class TFRecordSource:
         self.features = features
         self._index: list[tuple[int, int, int]] = []  # (file, offset, len)
         self._file_counts: list[int] = []
+        # Gzip TFRecords are one stream (no per-record seek): serve random
+        # access from a decompressed in-memory copy, LRU-bounded like the
+        # fd cache below — a 100-shard gzip corpus must not pin the whole
+        # decompressed corpus in RAM.  Re-decompression on miss is the
+        # cold-path price; the mmap format is the hot path for anything
+        # throughput-critical (module docstring).
+        self._gz_files: set[int] = set()
+        self._gz_cache: dict[int, bytes] = {}
+        self._max_gz_cached = 4
         for fi, p in enumerate(self.paths):
-            entries = _index_file(p)
+            if _is_gzip(p):
+                self._gz_files.add(fi)
+                data = self._gz_bytes(fi)
+                entries = _index_stream(io.BytesIO(data), len(data), str(p))
+            else:
+                entries = _index_file(p)
             self._file_counts.append(len(entries))
             for off, length in entries:
                 self._index.append((fi, off, length))
@@ -384,7 +426,19 @@ class TFRecordSource:
     def __len__(self) -> int:
         return len(self._index)
 
+    def _gz_bytes(self, fi: int) -> bytes:
+        data = self._gz_cache.pop(fi, None)
+        if data is None:
+            if len(self._gz_cache) >= self._max_gz_cached:
+                self._gz_cache.pop(next(iter(self._gz_cache)))  # LRU out
+            with gzip.open(self.paths[fi], "rb") as f:
+                data = f.read()
+        self._gz_cache[fi] = data  # re-insert → most recently used
+        return data
+
     def _handle(self, fi: int):
+        if fi in self._gz_files:  # in-memory; no fd to manage
+            return io.BytesIO(self._gz_bytes(fi))
         f = self._handles.pop(fi, None)
         if f is None:
             if len(self._handles) >= self._max_handles:

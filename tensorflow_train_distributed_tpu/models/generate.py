@@ -31,12 +31,17 @@ from tensorflow_train_distributed_tpu.models.llama import (
 
 def generate(config: LlamaConfig, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
              cast_params: bool = True) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, S].
 
     ``temperature`` 0 → greedy argmax; > 0 → categorical sampling with
-    ``rng`` (required).  Returns [B, S + max_new_tokens] token ids.
+    ``rng`` (required).  ``top_k`` keeps only the k highest logits;
+    ``top_p`` keeps the smallest nucleus of tokens whose probability mass
+    reaches p (Holtzman et al.) — both filters apply after the
+    temperature scale, compose (k first, then p — the HF convention), and
+    require ``temperature > 0``.  Returns [B, S + max_new_tokens] ids.
     Prompt + new tokens must fit ``config.max_positions`` (the cache size).
 
     ``cast_params``: cast floating params to ``config.dtype`` before
@@ -61,6 +66,14 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
     greedy = temperature == 0.0
     if not greedy and rng is None:
         raise ValueError("temperature sampling needs rng=")
+    if greedy and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p filter a sampling distribution; set "
+            "temperature > 0 (greedy argmax is unaffected by them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
     if cast_params:
@@ -73,13 +86,18 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
             else x,
             params)
-    return _generate(config, max_new_tokens, greedy, params, prompt,
-                     jnp.float32(temperature), rng)
+    # top_k is static (it sets the lax.top_k shape); top_p is a TRACED
+    # scalar so a sampling sweep over p reuses one compiled graph.
+    return _generate(config, max_new_tokens, greedy, top_k,
+                     top_p is not None, params, prompt,
+                     jnp.float32(temperature),
+                     jnp.float32(1.0 if top_p is None else top_p), rng)
 
 
-@partial(jax.jit, static_argnames=("config", "max_new_tokens", "greedy"))
+@partial(jax.jit, static_argnames=("config", "max_new_tokens", "greedy",
+                                   "top_k", "use_top_p"))
 def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
-              params, prompt, temperature, rng):
+              top_k, use_top_p, params, prompt, temperature, top_p, rng):
     # Cache sized to the request, not max_positions: a 30-token generation
     # from a 4k-context config must not allocate (or attend over) 4k
     # cache rows per layer.
@@ -90,8 +108,22 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
         logits = logits.astype(jnp.float32)
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits = logits / temperature
+        if top_k is not None and top_k < logits.shape[-1]:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if use_top_p:
+            # Nucleus: keep the smallest prefix (by descending prob)
+            # whose mass reaches p; the first token always survives.
+            sorted_desc = -jnp.sort(-logits, axis=-1)
+            cum = jnp.cumsum(jax.nn.softmax(sorted_desc), axis=-1)
+            keep = cum - jax.nn.softmax(sorted_desc) <= top_p
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
         return jax.random.categorical(
-            step_rng, logits / temperature, axis=-1).astype(prompt.dtype)
+            step_rng, logits, axis=-1).astype(prompt.dtype)
 
     # Prefill: whole prompt at once; next token comes from the last logit.
     logits, variables = model.apply(

@@ -200,3 +200,80 @@ def test_generate_from_imported_hf_weights():
             torch.asarray(prompt), max_new_tokens=5, do_sample=False,
             pad_token_id=0).numpy()
     np.testing.assert_array_equal(ours, theirs)
+
+
+class TestTopKTopP:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = LLAMA_PRESETS["llama_tiny"]
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+        params = LlamaModel(cfg).init(jax.random.key(5), prompt)["params"]
+        # Next-token logits from the train-path forward: the support
+        # oracle for filter assertions.
+        logits = np.asarray(LlamaModel(cfg).apply(
+            {"params": params}, jnp.asarray(prompt))[:, -1], np.float32)
+        return cfg, params, prompt, logits
+
+    def test_top_k_1_is_greedy(self, setup):
+        cfg, params, prompt, _ = setup
+        g = np.asarray(generate(cfg, params, jnp.asarray(prompt), 3))
+        k1 = np.asarray(generate(
+            cfg, params, jnp.asarray(prompt), 3, temperature=0.7,
+            top_k=1, rng=jax.random.key(0)))
+        np.testing.assert_array_equal(g, k1)
+
+    def test_tiny_top_p_is_greedy(self, setup):
+        cfg, params, prompt, _ = setup
+        g = np.asarray(generate(cfg, params, jnp.asarray(prompt), 3))
+        p0 = np.asarray(generate(
+            cfg, params, jnp.asarray(prompt), 3, temperature=1.3,
+            top_p=1e-6, rng=jax.random.key(1)))
+        np.testing.assert_array_equal(g, p0)
+
+    def test_top_k_restricts_support(self, setup):
+        cfg, params, prompt, logits = setup
+        k = 3
+        allowed = [set(np.argsort(row)[-k:]) for row in logits]
+        for seed in range(8):
+            out = np.asarray(generate(
+                cfg, params, jnp.asarray(prompt), 1, temperature=2.0,
+                top_k=k, rng=jax.random.key(seed)))
+            for b in range(prompt.shape[0]):
+                assert out[b, -1] in allowed[b]
+
+    def test_top_p_restricts_support(self, setup):
+        cfg, params, prompt, logits = setup
+        p = 0.5
+        temp = 1.5
+        allowed = []
+        for row in logits:
+            scaled = row / temp
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            order = np.argsort(-probs)
+            cum = np.cumsum(probs[order])
+            nucleus = {order[0]}
+            for j in range(1, len(order)):
+                if cum[j - 1] <= p:
+                    nucleus.add(order[j])
+                else:
+                    break
+            allowed.append(nucleus)
+        for seed in range(8):
+            out = np.asarray(generate(
+                cfg, params, jnp.asarray(prompt), 1, temperature=temp,
+                top_p=p, rng=jax.random.key(seed)))
+            for b in range(prompt.shape[0]):
+                assert out[b, -1] in allowed[b], (out[b, -1], allowed[b])
+
+    def test_validation(self, setup):
+        cfg, params, prompt, _ = setup
+        with pytest.raises(ValueError, match="temperature > 0"):
+            generate(cfg, params, jnp.asarray(prompt), 2, top_k=5)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(cfg, params, jnp.asarray(prompt), 2, temperature=1.0,
+                     top_k=0, rng=jax.random.key(0))
+        with pytest.raises(ValueError, match="top_p"):
+            generate(cfg, params, jnp.asarray(prompt), 2, temperature=1.0,
+                     top_p=1.5, rng=jax.random.key(0))

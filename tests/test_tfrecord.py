@@ -292,3 +292,56 @@ class TestTensorFlowInterop:
         np.testing.assert_array_equal(parsed["ids"].numpy(), [3, -1, 4])
         np.testing.assert_allclose(parsed["w"].numpy(), [0.5, 1.5])
         assert parsed["tag"].numpy() == b"blob"
+
+    def test_gzip_interop_both_directions(self, tf, tmp_path):
+        """TF GZIP TFRecords read here; our .gz files read by tf.data."""
+        # TF writes GZIP → we random-access it.
+        p_tf = str(tmp_path / "tf.tfrecord.gz")
+        opts = tf.io.TFRecordOptions(compression_type="GZIP")
+        rng = np.random.default_rng(7)
+        want = [rng.integers(0, 50, 4) for _ in range(5)]
+        with tf.io.TFRecordWriter(p_tf, opts) as w:
+            for ids in want:
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "ids": tf.train.Feature(int64_list=tf.train.Int64List(
+                        value=ids.tolist()))}))
+                w.write(ex.SerializeToString())
+        src = TFRecordSource(p_tf, {"ids": ((4,), np.int64)})
+        assert len(src) == 5
+        np.testing.assert_array_equal(src[3]["ids"], want[3])  # random access
+        np.testing.assert_array_equal(src[0]["ids"], want[0])
+
+        # We write .gz → tf.data reads it with compression_type GZIP.
+        p_ours = str(tmp_path / "ours.tfrecord.gz")
+        with TFRecordWriter(p_ours) as w:
+            w.write_example({"ids": np.asarray([1, 2, 3], np.int64)})
+        ds = tf.data.TFRecordDataset(p_ours, compression_type="GZIP")
+        parsed = tf.io.parse_single_example(next(iter(ds)).numpy(), {
+            "ids": tf.io.FixedLenFeature([3], tf.int64)})
+        np.testing.assert_array_equal(parsed["ids"].numpy(), [1, 2, 3])
+
+
+def test_gzip_read_records_and_plain_magic_sniff(tmp_path):
+    """Pure-python gzip round trip — no TF needed, so it must not live in
+    the importorskip'd interop class."""
+    from tensorflow_train_distributed_tpu.data.tfrecord import read_records
+
+    # Extensionless gzip file: content sniffing, not suffix, decides.
+    p = str(tmp_path / "sniffed")
+    with TFRecordWriter(p, compress=True) as w:
+        w.write(b"payload-a")
+        w.write(b"payload-b")
+    assert list(read_records(p)) == [b"payload-a", b"payload-b"]
+    src = TFRecordSource(p)
+    assert len(src) == 2
+
+
+def test_plain_record_starting_with_partial_gzip_magic(tmp_path):
+    """A record of exactly 0x8B1F bytes makes the file start 1f 8b — the
+    3-byte magic check must still classify it as plain TFRecord."""
+    p = str(tmp_path / "collide.tfrecord")
+    payload = b"x" * 0x8B1F
+    with TFRecordWriter(p) as w:
+        w.write(payload)
+    assert list(read_records(p)) == [payload]
+    assert len(TFRecordSource(p)) == 1
