@@ -530,7 +530,7 @@ def read_features_sidecar(root: Union[str, Path]) -> dict[str, tuple]:
 def open_tfrecord_dir(root: Union[str, Path],
                       features: Optional[dict[str, tuple]] = None,
                       transform=None):
-    """Open a directory of ``*.tfrecord`` files as a ``ConcatSource``.
+    """Open a directory of ``*.tfrecord``(.gz) files as a ``ConcatSource``.
 
     Each file is one FILE-autoshard part (``DataConfig(shard_policy=
     "file")`` hands whole files to processes — the reference's FILE policy
@@ -544,9 +544,10 @@ def open_tfrecord_dir(root: Union[str, Path],
     from tensorflow_train_distributed_tpu.data.pipeline import ConcatSource
 
     root = Path(root)
-    paths = sorted(root.glob("*.tfrecord"))
+    paths = sorted([*root.glob("*.tfrecord"), *root.glob("*.tfrecord.gz")])
     if not paths:
-        raise FileNotFoundError(f"no *.tfrecord files under {root}")
+        raise FileNotFoundError(
+            f"no *.tfrecord / *.tfrecord.gz files under {root}")
     if features is None:
         if not (root / FEATURES_SIDECAR).is_file():
             raise FileNotFoundError(

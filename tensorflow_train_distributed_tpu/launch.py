@@ -351,8 +351,10 @@ def run(args: argparse.Namespace) -> RunResult:
         # tf.data corpus convention) vs the native mmap part-*/ layout.
         import pathlib
 
+        data_root = pathlib.Path(args.data_dir)
         kind = ("tfrecord_dir"
-                if any(pathlib.Path(args.data_dir).glob("*.tfrecord"))
+                if any(data_root.glob("*.tfrecord"))
+                or any(data_root.glob("*.tfrecord.gz"))
                 else "array_dir")
         source = get_dataset(kind, root=args.data_dir,
                              transform=args.data_transform)

@@ -47,6 +47,9 @@ class BertConfig:
     # HF configures attention-probability dropout separately from hidden
     # dropout; None keeps the single-rate convention.
     attention_dropout_rate: Optional[float] = None
+    # Activation checkpointing per encoder layer (nn.remat): trades
+    # recompute for activation memory at large batch/seq.
+    remat: bool = False
 
 
 def _gelu(cfg: "BertConfig"):
@@ -69,7 +72,7 @@ class EncoderLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         attn_dropout = (cfg.dropout_rate
                         if cfg.attention_dropout_rate is None
@@ -117,8 +120,12 @@ class BertEncoder(nn.Module):
             self.embed_ln = nn.LayerNorm(
                 dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
                 name="embed_ln")
+        # nn.remat preserves param names — HF-imported and previously
+        # trained checkpoints load unchanged either way.
+        layer_cls = (nn.remat(EncoderLayer, static_argnums=(2,))
+                     if cfg.remat else EncoderLayer)
         self.encoder_layers = [
-            EncoderLayer(cfg, name=f"layer_{i}")
+            layer_cls(cfg, name=f"layer_{i}")
             for i in range(cfg.num_layers)
         ]
         self.mlm_transform = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
@@ -146,7 +153,7 @@ class BertEncoder(nn.Module):
         if cfg.embed_layer_norm:
             x = self.embed_ln(x)
         for layer in self.encoder_layers:
-            x = layer(x, deterministic=deterministic)
+            x = layer(x, deterministic)  # positional: remat static argnum
         # MLM head: transform → tied-embedding logits + bias.
         h = _gelu(cfg)(self.mlm_transform(x))
         h = self.mlm_ln(h)

@@ -33,6 +33,10 @@ class TransformerConfig:
     dropout_rate: float = 0.1
     label_smoothing: float = 0.1
     dtype: object = jnp.float32
+    # Activation checkpointing per layer (jax.checkpoint via nn.remat):
+    # trades recompute for activation memory — the big-batch enabler for
+    # transformer_big on small-HBM chips.
+    remat: bool = False
 
 
 TRANSFORMER_PRESETS = {
@@ -50,7 +54,7 @@ class EncoderLayer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype)(x)
         x = x + L.MultiHeadAttention(
@@ -68,7 +72,7 @@ class DecoderLayer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, y, enc, *, deterministic: bool = True):
+    def __call__(self, y, enc, deterministic: bool = True):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype)(y)
         y = y + L.MultiHeadAttention(
@@ -97,9 +101,16 @@ class Seq2SeqTransformer(nn.Module):
                              name="shared_embed")
         self.pos_table = L.sinusoidal_positions(cfg.max_positions,
                                                 cfg.d_model)
-        self.enc_layers = [EncoderLayer(cfg, name=f"enc_{i}")
+        # nn.remat is a transparent lift: param names/structure (and so
+        # checkpoints) are identical with and without it.  deterministic
+        # is a static argnum — a python bool must not be traced.
+        enc_cls, dec_cls = EncoderLayer, DecoderLayer
+        if cfg.remat:
+            enc_cls = nn.remat(EncoderLayer, static_argnums=(2,))
+            dec_cls = nn.remat(DecoderLayer, static_argnums=(3,))
+        self.enc_layers = [enc_cls(cfg, name=f"enc_{i}")
                            for i in range(cfg.num_encoder_layers)]
-        self.dec_layers = [DecoderLayer(cfg, name=f"dec_{i}")
+        self.dec_layers = [dec_cls(cfg, name=f"dec_{i}")
                            for i in range(cfg.num_decoder_layers)]
         self.enc_norm = nn.LayerNorm(dtype=cfg.dtype, name="enc_norm")
         self.dec_norm = nn.LayerNorm(dtype=cfg.dtype, name="dec_norm")
@@ -112,13 +123,13 @@ class Seq2SeqTransformer(nn.Module):
     def encode(self, inputs, *, deterministic: bool = True):
         x = self._pos(self.embed(inputs))
         for layer in self.enc_layers:
-            x = layer(x, deterministic=deterministic)
+            x = layer(x, deterministic)  # positional: remat static argnum
         return self.enc_norm(x)
 
     def decode(self, targets_in, enc, *, deterministic: bool = True):
         y = self._pos(self.embed(targets_in))
         for layer in self.dec_layers:
-            y = layer(y, enc, deterministic=deterministic)
+            y = layer(y, enc, deterministic)
         y = self.dec_norm(y)
         logits = self.embed.attend(y)  # tied softmax (big-model convention)
         return nn.with_logical_constraint(

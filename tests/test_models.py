@@ -353,3 +353,60 @@ class TestRegistry:
     def test_unknown_config_raises(self):
         with pytest.raises(ValueError, match="Unknown config"):
             registry.get_entry("alexnet")
+
+
+class TestEncoderRemat:
+    """remat=True is a pure memory/speed trade: params, forward, and
+    grads must be bit-identical (nn.remat is a transparent lift, so
+    trained/HF checkpoints load unchanged)."""
+
+    def test_bert_remat_parity(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import bert
+
+        cfg0 = bert.BERT_PRESETS["bert_tiny"]
+        cfg1 = dataclasses.replace(cfg0, remat=True)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg0.vocab_size, (2, 16)).astype(np.int32)
+        p0 = bert.BertEncoder(cfg0).init(jax.random.key(0), ids)["params"]
+        p1 = bert.BertEncoder(cfg1).init(jax.random.key(0), ids)["params"]
+        assert (jax.tree_util.tree_structure(p0)
+                == jax.tree_util.tree_structure(p1))
+        o0 = bert.BertEncoder(cfg0).apply({"params": p0}, ids)
+        o1 = bert.BertEncoder(cfg1).apply({"params": p0}, ids)
+        np.testing.assert_allclose(np.asarray(o0), np.asarray(o1),
+                                   atol=1e-6)
+        g = lambda cfg: jax.grad(  # noqa: E731
+            lambda p: bert.BertEncoder(cfg).apply(
+                {"params": p}, ids).sum())(p0)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            g(cfg0), g(cfg1))
+
+    def test_transformer_remat_parity(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import transformer
+
+        cfg0 = transformer.TRANSFORMER_PRESETS["transformer_tiny"]
+        cfg1 = dataclasses.replace(cfg0, remat=True)
+        rng = np.random.default_rng(1)
+        src = rng.integers(0, cfg0.vocab_size, (2, 8)).astype(np.int32)
+        M = transformer.Seq2SeqTransformer
+        p0 = M(cfg0).init(jax.random.key(1), src, src)["params"]
+        p1 = M(cfg1).init(jax.random.key(1), src, src)["params"]
+        assert (jax.tree_util.tree_structure(p0)
+                == jax.tree_util.tree_structure(p1))
+        o0 = M(cfg0).apply({"params": p0}, src, src)
+        o1 = M(cfg1).apply({"params": p0}, src, src)
+        np.testing.assert_allclose(np.asarray(o0), np.asarray(o1),
+                                   atol=1e-5)
+        g = lambda cfg: jax.grad(  # noqa: E731
+            lambda p: M(cfg).apply({"params": p}, src, src)
+            .astype(jnp.float32).sum())(p0)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4),
+            g(cfg0), g(cfg1))

@@ -345,3 +345,20 @@ def test_plain_record_starting_with_partial_gzip_magic(tmp_path):
         w.write(payload)
     assert list(read_records(p)) == [payload]
     assert len(TFRecordSource(p)) == 1
+
+
+def test_gzip_dir_open_and_autodetect(tmp_path):
+    """A directory of only .tfrecord.gz shards opens (FILE autoshard) and
+    the CLI --data-dir format autodetect classifies it as tfrecord."""
+    from tensorflow_train_distributed_tpu.data.tfrecord import (
+        open_tfrecord_dir, write_features_sidecar,
+    )
+
+    for i in range(2):
+        with TFRecordWriter(str(tmp_path / f"shard-{i}.tfrecord.gz")) as w:
+            for j in range(3):
+                w.write_example({"v": np.asarray([i * 3 + j], np.int64)})
+    write_features_sidecar(tmp_path, {"v": ((1,), "int64")})
+    src = open_tfrecord_dir(tmp_path)
+    assert len(src) == 6
+    np.testing.assert_array_equal(src[4]["v"], [4])

@@ -31,7 +31,7 @@ from bench_lm import (  # noqa: E402
 
 
 def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
-               force_hbm: bool = False):
+               force_hbm: bool = False, remat: bool = False):
     import jax
     import numpy as np
     import optax
@@ -45,7 +45,11 @@ def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
         Policy, Trainer, TrainerConfig,
     )
 
+    import dataclasses
+
     cfg = bert.BERT_PRESETS[preset]
+    if remat:
+        cfg = dataclasses.replace(cfg, remat=True)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
     task = bert.make_task(cfg)
@@ -56,12 +60,12 @@ def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
     abstract = jax.eval_shape(lambda: task.init_variables(
         jax.random.key(0),
         {"input_ids": jnp.zeros((1, seq), jnp.int32)}))
-    # No remat path; bidirectional attention; BERT runs the reference
-    # einsum attention, which saves per-head [B,H,S,S] for backward —
+    # Bidirectional attention; BERT runs the reference einsum attention,
+    # which saves per-head [B,H,S,S] for backward when remat is off —
     # score_heads makes the estimate account for that.
     check_hbm_budget(
         param_count(abstract["params"]), cfg.num_layers, cfg.hidden_size,
-        batch, seq, remat=False, causal=False, force=force_hbm,
+        batch, seq, remat=cfg.remat, causal=False, force=force_hbm,
         device=mesh.devices.flat[0], score_heads=cfg.num_heads)
     trainer = Trainer(
         task, optax.adamw(1e-4, weight_decay=0.01), mesh,
@@ -123,6 +127,9 @@ def main(argv=None) -> int:
     p.add_argument("--force-hbm", action="store_true",
                    help="skip the pre-flight HBM estimate (an OOM compile "
                         "can kill the chip tunnel)")
+    p.add_argument("--remat", action="store_true",
+                   help="per-layer activation checkpointing (bigger "
+                        "batch/seq at recompute cost)")
     args = p.parse_args(argv)
     if args.platform:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -132,7 +139,8 @@ def main(argv=None) -> int:
         force_platform(args.platform)
     try:
         rec = bench_bert(args.preset, args.batch_per_chip, args.seq,
-                         args.warmup, args.iters, force_hbm=args.force_hbm)
+                         args.warmup, args.iters, force_hbm=args.force_hbm,
+                         remat=args.remat)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({
             "metric": f"{args.preset}_mlm_samples_per_sec_per_chip",
