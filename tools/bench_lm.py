@@ -99,10 +99,17 @@ def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
         return
     state = n_params * 14
     act = n_layers * batch * seq * d_model * 2 * 6
+    score_term = (6 * score_heads * batch * seq * seq * 2
+                  // (2 if causal else 1))
     if not remat:
         act += n_layers * batch * seq * d_model * 2 * 24
-        act += (6 * score_heads * n_layers * batch * seq * seq * 2
-                // (2 if causal else 1))
+        act += n_layers * score_term
+    elif score_heads > 1:
+        # Per-layer remat still rematerializes ONE layer's einsum-attention
+        # score buffers during its backward — a transient, but it peaks
+        # alongside the saved boundaries, so large-seq configs can OOM the
+        # compile even though nothing seq²-sized is *saved*.
+        act += score_term
     need = state + act
     # The estimate intentionally errs a little high (b16 no-remat: est 28
     # vs 26.4 GiB observed), so compare against the full budget: known-good
