@@ -1,0 +1,129 @@
+"""Sample from a trained (or HF-imported) decoder checkpoint via the CLI.
+
+The inference face of the Llama family: load weights from an orbax
+checkpoint dir (params-only partial restore — no optimizer state
+materialized) or a local HuggingFace checkpoint, then run the KV-cache
+``generate`` path (greedy / temperature / top-k / top-p).
+
+No tokenizer ships in this environment, so prompts are token ids:
+``--prompt 1,15043,29892`` (comma-separated), repeatable for a batch.
+
+Examples:
+  python tools/sample.py --config llama_tiny_sft --checkpoint-dir /ck \\
+      --prompt 1,2,3 --max-new 32
+  python tools/sample.py --config llama2_7b_sft --init-from-hf /hf \\
+      --prompt 1,15043 --max-new 64 --temperature 0.8 --top-p 0.95
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--config", required=True,
+                   help="registry config name (a llama-family preset)")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--checkpoint-dir",
+                     help="orbax checkpoint dir (params-only restore)")
+    src.add_argument("--init-from-hf",
+                     help="local HuggingFace LlamaForCausalLM checkpoint")
+    p.add_argument("--prompt", action="append", required=True,
+                   metavar="IDS", help="comma-separated token ids; repeat "
+                   "for a batch. Rows must be the SAME length (static "
+                   "shapes, and the decode path has no pad masking — run "
+                   "unequal prompts as separate batches)")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (e.g. 'cpu')")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+
+    import jax
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.models import registry
+    from tensorflow_train_distributed_tpu.models.generate import generate
+    from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
+
+    task = registry.get_entry(args.config)["task_factory"]()
+    if not isinstance(task, CausalLmTask):
+        raise SystemExit(
+            f"--config {args.config} is not a decoder LM; sampling needs "
+            "a llama-family config")
+    cfg = task.config
+
+    rows = []
+    for spec in args.prompt:
+        try:
+            rows.append([int(t) for t in spec.split(",") if t])
+        except ValueError:
+            raise SystemExit(f"--prompt must be comma-separated ints, got "
+                             f"{spec!r}")
+    if not rows or any(not r for r in rows):
+        raise SystemExit("--prompt rows must be non-empty")
+    if len({len(r) for r in rows}) != 1:
+        raise SystemExit(
+            "all --prompt rows must have equal length (static shapes, and "
+            "the decode path has no pad masking — padding would condition "
+            "on pad tokens as real context; run unequal prompts as "
+            "separate invocations)")
+    if args.temperature == 0 and (args.top_k is not None
+                                  or args.top_p is not None):
+        raise SystemExit(
+            "--top-k/--top-p filter a sampling distribution; add "
+            "--temperature > 0 (they have no effect on greedy argmax)")
+    bad = [t for r in rows for t in r if not 0 <= t < cfg.vocab_size]
+    if bad:
+        raise SystemExit(f"prompt ids outside vocab [0, {cfg.vocab_size}): "
+                         f"{sorted(set(bad))[:8]}")
+    prompt = np.asarray(rows, np.int32)
+
+    if args.init_from_hf:
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_llama,
+        )
+
+        cfg, params = import_llama(args.init_from_hf, cfg)
+    else:
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        mgr = CheckpointManager(args.checkpoint_dir, async_save=False)
+        params = mgr.restore_params()
+        mgr.close()
+        if params is None:
+            raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+
+    rng = (jax.random.key(args.seed)
+           if args.temperature > 0 else None)
+    out = np.asarray(generate(
+        cfg, params, prompt, args.max_new,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        rng=rng))
+    for row_in, row_out in zip(rows, out):
+        print(json.dumps({
+            "prompt": row_in,
+            "completion": [int(t) for t in row_out[len(row_in):]],
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
