@@ -92,6 +92,12 @@ def main(argv=None) -> int:
     if bad:
         raise SystemExit(f"prompt ids outside vocab [0, {cfg.vocab_size}): "
                          f"{sorted(set(bad))[:8]}")
+    if args.max_new < 1:
+        raise SystemExit(f"--max-new must be >= 1, got {args.max_new}")
+    if len(rows[0]) + args.max_new > cfg.max_positions:
+        raise SystemExit(
+            f"prompt {len(rows[0])} + --max-new {args.max_new} exceeds the "
+            f"config's max_positions={cfg.max_positions} (the KV cache)")
     prompt = np.asarray(rows, np.int32)
 
     if args.init_from_hf:
@@ -105,6 +111,10 @@ def main(argv=None) -> int:
             CheckpointManager,
         )
 
+        if not os.path.isdir(args.checkpoint_dir):
+            # Check BEFORE constructing the manager: orbax would create
+            # the (typo'd) directory as a side effect of opening it.
+            raise SystemExit(f"no checkpoint dir at {args.checkpoint_dir}")
         mgr = CheckpointManager(args.checkpoint_dir, async_save=False)
         params = mgr.restore_params()
         mgr.close()
