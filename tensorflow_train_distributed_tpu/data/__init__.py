@@ -12,6 +12,7 @@ prescribes.
 
 from tensorflow_train_distributed_tpu.data.pipeline import (  # noqa: F401
     ConcatSource,
+    MixtureSource,
     DataConfig,
     HostDataLoader,
     prefetch_to_device,

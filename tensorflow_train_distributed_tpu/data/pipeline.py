@@ -71,6 +71,78 @@ class ConcatSource:
         return np.arange(self._offsets[part], self._offsets[part + 1])
 
 
+class MixtureSource:
+    """Weighted mixture of sources — the LLM-pretrain data-mixture unit.
+
+    Record ``i`` deterministically comes from one component (chosen by a
+    seeded weighted draw) at that component's next sequential position,
+    wrapping when a smaller corpus is exhausted (components repeat at
+    their weight's rate — the standard mixture semantics; beyond the
+    reference, which has no multi-corpus story).  The schedule is drawn
+    once from ``seed`` at open (a longer ``num_examples`` with the same
+    seed extends the schedule without rescrambling its prefix), making
+    the source random-access like any other: DATA autoshard, shuffling,
+    and deterministic mid-epoch resume compose unchanged.  (FILE
+    autoshard wants a ``ConcatSource`` of per-file parts — mix *inside*
+    each part, or shard the mixture with the DATA policy.)
+
+    ``num_examples`` defaults to the total across components (each seen
+    ~once at equal weights); set it explicitly for weighted runs where
+    "one epoch" is a token budget, not a corpus pass.
+    """
+
+    def __init__(self, sources, weights=None, *, seed: int = 0,
+                 num_examples: int | None = None):
+        if not sources:
+            raise ValueError("MixtureSource needs at least one source")
+        self.sources = list(sources)
+        k = len(self.sources)
+        empty = [i for i, s in enumerate(self.sources) if len(s) == 0]
+        if empty:
+            raise ValueError(
+                f"mixture components {empty} are empty (every component "
+                "must have at least one record)")
+        if weights is None:
+            weights = [1.0] * k
+        if len(weights) != k:
+            raise ValueError(
+                f"{k} sources but {len(weights)} weights")
+        w = np.asarray(weights, np.float64)
+        if (w <= 0).any():
+            raise ValueError(f"weights must be > 0, got {weights}")
+        self.weights = w / w.sum()
+        n = sum(len(s) for s in self.sources) if num_examples is None \
+            else num_examples
+        if n <= 0:
+            raise ValueError(f"num_examples must be > 0, got {n}")
+        # Seeded by `seed` alone: rng.choice draws sequentially, so a
+        # longer num_examples with the same seed keeps the prefix stable
+        # (extending a token budget must not rescramble history).
+        rng = np.random.default_rng(np.random.SeedSequence([seed]))
+        # Materialized schedule: component id per record + running
+        # within-component position.  int8+int32 per record (~5 B/record)
+        # — 100M-record mixtures cost ~500 MB of host index, same order
+        # as the offset indexes the file sources already keep.
+        if k > 127:
+            raise ValueError(f"at most 127 mixture components, got {k}")
+        self._assignment = rng.choice(
+            k, size=n, p=self.weights).astype(np.int8)
+        self._within = np.zeros(n, np.int32)
+        for c in range(k):
+            mask = self._assignment == c
+            self._within[mask] = np.arange(mask.sum(), dtype=np.int32)
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        if idx < 0 or idx >= self._n:
+            raise IndexError(idx)
+        src = self.sources[int(self._assignment[idx])]
+        return src[int(self._within[idx]) % len(src)]
+
+
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
     """Pipeline configuration (global batch semantics, like the reference)."""
