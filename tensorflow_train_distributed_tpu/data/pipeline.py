@@ -127,10 +127,15 @@ class MixtureSource:
             raise ValueError(f"at most 127 mixture components, got {k}")
         self._assignment = rng.choice(
             k, size=n, p=self.weights).astype(np.int8)
-        self._within = np.zeros(n, np.int32)
-        for c in range(k):
-            mask = self._assignment == c
-            self._within[mask] = np.arange(mask.sum(), dtype=np.int32)
+        # Within-component cumcount in one stable-argsort pass (a
+        # per-component mask loop would be O(k·n) — hundreds of array
+        # sweeps at the 100M-record/127-component scale budgeted above).
+        order = np.argsort(self._assignment, kind="stable")
+        counts = np.bincount(self._assignment, minlength=k)
+        starts = np.repeat(np.concatenate(
+            [[0], np.cumsum(counts)[:-1]]), counts)
+        self._within = np.empty(n, np.int32)
+        self._within[order] = (np.arange(n) - starts).astype(np.int32)
         self._n = n
 
     def __len__(self) -> int:

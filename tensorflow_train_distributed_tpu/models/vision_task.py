@@ -40,6 +40,13 @@ class VisionTask:
             new_model_state = model_state
         loss, acc = softmax_cross_entropy(
             logits, batch["label"], label_smoothing=self.label_smoothing)
+        metrics = {"accuracy": acc}
+        if logits.shape[-1] > 5:
+            # Top-5 — the ImageNet convention's second headline number
+            # (only meaningful with more than 5 classes).
+            top5 = jax.lax.top_k(logits.astype(jnp.float32), 5)[1]
+            metrics["top5_accuracy"] = (
+                top5 == batch["label"][:, None]).any(-1).mean()
         if self.weight_decay > 0:
             # L2 on kernels only (reference ResNet convention: no decay on
             # BN scales/biases).
@@ -49,7 +56,7 @@ class VisionTask:
                 if path[-1].key == "kernel"
             )
             loss = loss + self.weight_decay * l2
-        return loss, ({"accuracy": acc}, new_model_state)
+        return loss, (metrics, new_model_state)
 
     def predict_fn(self, params, model_state, batch):
         """Inference logits (Trainer.predict contract)."""

@@ -410,3 +410,29 @@ class TestEncoderRemat:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-4),
             g(cfg0), g(cfg1))
+
+
+def test_vision_top5_metric(mesh8):
+    """ImageNet convention: top-5 accuracy reported alongside top-1 (and
+    top-5 >= top-1 by construction); LeNet/MNIST (10 classes) gets it,
+    and it flows through fit's metric pipeline."""
+    import optax
+
+    from tensorflow_train_distributed_tpu.data import (
+        DataConfig, HostDataLoader,
+    )
+    from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+    from tensorflow_train_distributed_tpu.models import lenet
+    from tensorflow_train_distributed_tpu.training import (
+        History, Trainer, TrainerConfig,
+    )
+
+    loader = HostDataLoader(get_dataset("mnist", num_examples=128),
+                            DataConfig(global_batch_size=32))
+    trainer = Trainer(lenet.make_task(), optax.adam(1e-3), mesh8,
+                      config=TrainerConfig(log_every=1),
+                      callbacks=[hist := History()])
+    trainer.fit(iter(loader), steps=3)
+    assert "top5_accuracy" in hist.history
+    assert all(t5 >= t1 - 1e-6 for t1, t5 in
+               zip(hist.history["accuracy"], hist.history["top5_accuracy"]))
