@@ -180,14 +180,18 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x_q, x_kv=None, *, mask=None, positions=None,
-                 deterministic: bool = True):
+                 segment_ids=None, deterministic: bool = True):
         if self.decode:
-            if x_kv is not None or mask is not None:
+            if x_kv is not None or mask is not None or segment_ids is not None:
                 raise ValueError(
                     "decode=True is causal self-attention over the KV "
-                    "cache; cross-attention inputs (x_kv) and dense masks "
-                    "are not supported in decode mode")
+                    "cache; cross-attention inputs (x_kv), dense masks and "
+                    "segment ids are not supported in decode mode")
             return self._decode_step(x_q)
+        if segment_ids is not None and x_kv is not None:
+            raise ValueError(
+                "segment_ids (sequence packing) applies to self-attention "
+                "only")
         x_kv = x_q if x_kv is None else x_kv
         kv_heads = self.num_kv_heads or self.num_heads
 
@@ -205,8 +209,11 @@ class MultiHeadAttention(nn.Module):
                 offset = x_kv.shape[1] - x_q.shape[1] if self.causal else 0
                 positions = jnp.broadcast_to(
                     jnp.arange(x_q.shape[1]) + offset, x_q.shape[:2])
-            kv_positions = jnp.broadcast_to(
-                jnp.arange(x_kv.shape[1]), x_kv.shape[:2])
+            # Self-attention with caller positions (packed segments):
+            # keys live at the SAME positions as their queries.
+            kv_positions = (positions if x_kv is x_q
+                            else jnp.broadcast_to(
+                                jnp.arange(x_kv.shape[1]), x_kv.shape[:2]))
             q = apply_rope(q, positions, base=self.rope_base)
             k = apply_rope(k, kv_positions, base=self.rope_base)
 
@@ -221,10 +228,10 @@ class MultiHeadAttention(nn.Module):
             kh = jnp.repeat(kh, rep, axis=1)
             vh = jnp.repeat(vh, rep, axis=1)
         if sp_mesh is not None:
-            if mask is not None:
+            if mask is not None or segment_ids is not None:
                 raise ValueError(
                     "seq_parallel attention supports causal/full, not dense "
-                    "masks")
+                    "masks or packed segments")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
@@ -237,6 +244,7 @@ class MultiHeadAttention(nn.Module):
         else:
             out = multihead_attention_kernel(
                 qh, kh, vh, causal=self.causal, mask=mask,
+                segment_ids=segment_ids,
             ).transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
             out, ("batch", "length", "heads", "kv"))

@@ -110,7 +110,7 @@ class DecoderBlock(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions=None):
         cfg = self.config
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="attn_norm")(x)
@@ -123,7 +123,7 @@ class DecoderBlock(nn.Module):
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
             name="attention",
-        )(h)
+        )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
         x = x + L.MlpBlock(hidden=cfg.ffn_size, dtype=cfg.dtype,
@@ -131,18 +131,38 @@ class DecoderBlock(nn.Module):
         return x
 
 
+def segment_relative_positions(segment_ids: jax.Array) -> jax.Array:
+    """[B, S] segment ids → [B, S] positions restarting at each segment.
+
+    Positions are what RoPE sees: in a packed row each document must be
+    encoded at 0..len-1, not at its offset in the row.  Padding (its own
+    segment id) restarts too — harmless, those positions are loss-masked.
+    """
+    s = segment_ids.shape[-1]
+    idx = jnp.arange(s)
+    restart = jnp.concatenate(
+        [jnp.ones_like(segment_ids[..., :1], bool),
+         segment_ids[..., 1:] != segment_ids[..., :-1]], axis=-1)
+    last_restart = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(restart, idx, 0), axis=-1)
+    return idx - last_restart
+
+
 class _BlockStep(nn.Module):
-    """scan-compatible adapter: (carry, None) → (carry, None)."""
+    """scan-compatible adapter: (carry, aux) → (carry, None); ``aux`` is
+    the nn.broadcast (segment_ids, positions) pair shared by all layers."""
 
     config: LlamaConfig
     decode: bool = False
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, carry, _):
+    def __call__(self, carry, aux):
+        segment_ids, positions = aux if aux is not None else (None, None)
         return DecoderBlock(self.config, decode=self.decode,
                             cache_len=self.cache_len,
-                            name="block")(carry), None
+                            name="block")(carry, segment_ids,
+                                          positions), None
 
 
 class _ScannedBlock(nn.Module):
@@ -154,7 +174,7 @@ class _ScannedBlock(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions=None):
         from functools import partial as _partial
 
         step = (_partial(_BlockStep, decode=True,
@@ -169,10 +189,12 @@ class _ScannedBlock(nn.Module):
             step,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
+            in_axes=nn.broadcast,  # (segment_ids, positions): all layers
             length=self.config.num_layers,
             metadata_params={nn.PARTITION_NAME: "stage"},
         )
-        x, _ = scanned(self.config, name="stack")(x, None)
+        x, _ = scanned(self.config, name="stack")(
+            x, (segment_ids, positions))
         return x
 
 
@@ -229,8 +251,15 @@ class LlamaModel(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, *, segment_ids=None, positions=None):
         cfg = self.config
+        if segment_ids is not None and self.decode:
+            raise ValueError("decode mode does not take packed segments")
+        if segment_ids is not None and positions is None:
+            # Packed rows: RoPE positions restart at each segment
+            # boundary (each document sees itself at positions 0..len-1,
+            # exactly as if it were alone in the row).
+            positions = segment_relative_positions(segment_ids)
         x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                     name="token_embed")(tokens)
         pp_mesh = None if self.is_initializing() else _pipeline_mesh(cfg)
@@ -239,6 +268,11 @@ class LlamaModel(nn.Module):
                 "decode mode does not run under a pipeline mesh; generate "
                 "outside the pipeline strategy")
         if pp_mesh is not None:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segments under the gpipe pipeline schedule are "
+                    "not supported yet; train packed data under "
+                    "dp/tp/fsdp meshes")
             # Params were created by the scan path (init always takes it);
             # read the stacked block tree and drive the pipeline schedule.
             block_params = (
@@ -246,7 +280,8 @@ class LlamaModel(nn.Module):
             x = _pipelined_blocks(cfg, block_params, x, pp_mesh)
         elif cfg.scan_layers:
             x = _ScannedBlock(cfg, decode=self.decode,
-                              cache_len=self.cache_len, name="layers")(x)
+                              cache_len=self.cache_len, name="layers")(
+                x, segment_ids, positions)
         else:
             for i in range(cfg.num_layers):
                 blk = DecoderBlock
@@ -254,7 +289,8 @@ class LlamaModel(nn.Module):
                     blk = nn.remat(blk, prevent_cse=False,
                                    policy=_checkpoint_policy(cfg))
                 x = blk(cfg, decode=self.decode,
-                        cache_len=self.cache_len, name=f"layer_{i}")(x)
+                        cache_len=self.cache_len, name=f"layer_{i}")(
+                    x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)
         logits = L.dense(cfg.vocab_size, ("embed", "vocab"), use_bias=False,
@@ -276,14 +312,24 @@ class CausalLmTask:
     def loss_fn(self, params, model_state, batch, rng, train):
         del rng, train  # no dropout in llama pretraining/SFT
         logits = self.model.apply(
-            {"params": params}, batch["tokens"]).astype(jnp.float32)
-        loss, acc = softmax_cross_entropy(logits, batch["targets"])
-        return loss, ({"accuracy": acc}, model_state)
+            {"params": params}, batch["tokens"],
+            segment_ids=batch.get("segment_ids")).astype(jnp.float32)
+        weights = batch.get("loss_weights")
+        loss, acc = softmax_cross_entropy(logits, batch["targets"],
+                                          weights=weights)
+        metrics = {"accuracy": acc}
+        if weights is not None:
+            # Grad-accum recombination contract (Task docstring): weighted
+            # losses report their total weight.
+            metrics["loss_weight"] = jnp.maximum(
+                weights.astype(jnp.float32).sum(), 1.0)
+        return loss, (metrics, model_state)
 
     def predict_fn(self, params, model_state, batch):
         """Next-token logits (Trainer.predict contract)."""
         del model_state
-        return self.model.apply({"params": params}, batch["tokens"])
+        return self.model.apply({"params": params}, batch["tokens"],
+                                segment_ids=batch.get("segment_ids"))
 
 
 def make_task(config: LlamaConfig = LLAMA_PRESETS["llama2_7b"]

@@ -75,15 +75,34 @@ def multihead_attention_kernel(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     force_reference: bool = False,
 ) -> jax.Array:
     """Flash attention on TPU, reference path elsewhere.
 
-    Arbitrary ``mask`` forces the reference path (the pallas kernel supports
-    causal/segment structure, not dense boolean masks).
+    ``segment_ids`` [B, S]: restrict attention to same-segment pairs (the
+    sequence-packing mask) — structured, so the pallas kernel handles it
+    natively (``SegmentIds``); an arbitrary dense ``mask`` forces the
+    reference path instead.
     """
-    if force_reference or mask is not None or not _pallas_friendly(q, k, v):
+    use_reference = (force_reference or mask is not None
+                     or not _pallas_friendly(q, k, v))
+    if segment_ids is not None and not use_reference:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            SegmentIds, flash_attention,
+        )
+
+        scale = (softmax_scale if softmax_scale is not None
+                 else q.shape[-1] ** -0.5)
+        return flash_attention(
+            q, k, v, segment_ids=SegmentIds(q=segment_ids, kv=segment_ids),
+            causal=causal, sm_scale=scale)
+    if segment_ids is not None:
+        seg = (segment_ids[:, None, :, None]
+               == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if use_reference:  # mask (incl. segment-derived) implies use_reference
         return dot_product_attention(
             q, k, v, causal=causal, mask=mask, softmax_scale=softmax_scale
         )
