@@ -110,6 +110,20 @@ class PackedLmSource:
         if not self._records:
             raise ValueError("no packable documents (all < 2 tokens?)")
 
+    @classmethod
+    def from_source(cls, source, seq_len: int, *, key: str = "tokens",
+                    pad_id: int = 0) -> "PackedLmSource":
+        """Pack variable-length docs out of any ``RandomAccessSource``.
+
+        The natural producer is ``TFRecordSource(paths, features=None)``:
+        without a fixed spec it returns each Example's raw flat arrays,
+        which is exactly what a varlen tokenized corpus is — so real
+        TFRecord document corpora feed packed LM training directly.
+        """
+        docs = [np.asarray(source[i][key]).ravel()
+                for i in range(len(source))]
+        return cls(docs, seq_len, pad_id=pad_id)
+
     def __len__(self) -> int:
         return len(self._records)
 

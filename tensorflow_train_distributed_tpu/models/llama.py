@@ -268,11 +268,11 @@ class LlamaModel(nn.Module):
                 "decode mode does not run under a pipeline mesh; generate "
                 "outside the pipeline strategy")
         if pp_mesh is not None:
-            if segment_ids is not None:
+            if segment_ids is not None or positions is not None:
                 raise NotImplementedError(
-                    "packed segments under the gpipe pipeline schedule are "
-                    "not supported yet; train packed data under "
-                    "dp/tp/fsdp meshes")
+                    "packed segments / custom positions under the gpipe "
+                    "pipeline schedule are not supported yet; train packed "
+                    "data under dp/tp/fsdp meshes")
             # Params were created by the scan path (init always takes it);
             # read the stacked block tree and drive the pipeline schedule.
             block_params = (

@@ -259,6 +259,11 @@ class MoeLmTask:
 
     def loss_fn(self, params, model_state, batch, rng, train):
         del rng, train
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed segments are not supported by the MoE decoder yet "
+                "(its attention has no segment masking); unpacked batches "
+                "only — or use the llama family for packed corpora")
         logits, collections = self.model.apply(
             {"params": params}, batch["tokens"], mutable=["aux_loss"])
         logits = logits.astype(jnp.float32)

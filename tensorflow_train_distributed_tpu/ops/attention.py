@@ -86,30 +86,22 @@ def multihead_attention_kernel(
     natively (``SegmentIds``); an arbitrary dense ``mask`` forces the
     reference path instead.
     """
-    use_reference = (force_reference or mask is not None
-                     or not _pallas_friendly(q, k, v))
-    if segment_ids is not None and not use_reference:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            SegmentIds, flash_attention,
-        )
-
-        scale = (softmax_scale if softmax_scale is not None
-                 else q.shape[-1] ** -0.5)
-        return flash_attention(
-            q, k, v, segment_ids=SegmentIds(q=segment_ids, kv=segment_ids),
-            causal=causal, sm_scale=scale)
-    if segment_ids is not None:
-        seg = (segment_ids[:, None, :, None]
-               == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
-        mask = seg if mask is None else jnp.logical_and(mask, seg)
-    if use_reference:  # mask (incl. segment-derived) implies use_reference
+    if force_reference or mask is not None or not _pallas_friendly(q, k, v):
+        if segment_ids is not None:
+            seg = (segment_ids[:, None, :, None]
+                   == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
+            mask = seg if mask is None else jnp.logical_and(mask, seg)
         return dot_product_attention(
             q, k, v, causal=causal, mask=mask, softmax_scale=softmax_scale
         )
     from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention,
+        SegmentIds, flash_attention,
     )
 
     scale = (softmax_scale if softmax_scale is not None
              else q.shape[-1] ** -0.5)
-    return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    return flash_attention(
+        q, k, v,
+        segment_ids=(None if segment_ids is None
+                     else SegmentIds(q=segment_ids, kv=segment_ids)),
+        causal=causal, sm_scale=scale)

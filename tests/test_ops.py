@@ -53,6 +53,39 @@ class TestAttention:
                                    rtol=1e-6)
 
 
+    def test_segment_mask_matches_reference(self):
+        """Packed-segment attention: derived dense mask on the reference
+        path, and (via pallas interpret mode) the SegmentIds fast path the
+        TPU takes — both must agree with first principles."""
+        rng = np.random.default_rng(3)
+        B, H, S, D = 1, 2, 256, 64
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, S, D)),
+                               jnp.float32) for _ in range(3))
+        seg = jnp.asarray(np.repeat([1, 2, 3, 0], S // 4)[None], jnp.int32)
+        out_kernel = multihead_attention_kernel(
+            q, k, v, causal=True, segment_ids=seg)  # reference on CPU
+        mask = seg[:, None, :, None] == seg[:, None, None, :]
+        want = dot_product_attention(q, k, v, causal=True, mask=mask)
+        np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(want),
+                                   atol=1e-6)
+        # The TPU fast path: pallas flash kernel with SegmentIds, run in
+        # interpret mode so CPU CI covers its *semantics* (pad segment 0,
+        # causal alignment, scale) against the same oracle.
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                SegmentIds, flash_attention,
+            )
+        except ImportError:
+            pytest.skip("pallas tpu ops unavailable")
+        with pltpu.force_tpu_interpret_mode():
+            out_flash = flash_attention(
+                q, k, v, segment_ids=SegmentIds(q=seg, kv=seg),
+                causal=True, sm_scale=D**-0.5)
+        np.testing.assert_allclose(np.asarray(out_flash), np.asarray(want),
+                                   atol=2e-6)
+
+
 class TestRope:
     def test_relative_phase(self):
         # RoPE property: <rot(q,p1), rot(k,p2)> depends only on p1-p2.
