@@ -120,8 +120,15 @@ class PackedLmSource:
         which is exactly what a varlen tokenized corpus is — so real
         TFRecord document corpora feed packed LM training directly.
         """
-        docs = [np.asarray(source[i][key]).ravel()
-                for i in range(len(source))]
+        docs = []
+        for i in range(len(source)):
+            rec = source[i]
+            if key not in rec:
+                raise KeyError(
+                    f"record {i} has no feature {key!r} (has "
+                    f"{sorted(rec)}); pass key=/--pack-key naming the "
+                    "token feature")
+            docs.append(np.asarray(rec[key]).ravel())
         return cls(docs, seq_len, pad_id=pad_id)
 
     def __len__(self) -> int:

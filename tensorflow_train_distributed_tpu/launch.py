@@ -114,6 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train from an on-disk mmap corpus "
                         "(data.filesource.write_shards layout) instead of "
                         "the config's synthetic dataset")
+    p.add_argument("--pack-seq", type=int, default=0, metavar="LEN",
+                   help="treat --data-dir TFRecords as VARIABLE-length "
+                        "tokenized documents (no feature spec needed) and "
+                        "pack them into LEN-token rows with segment-masked "
+                        "attention (decoder LM configs only)")
+    p.add_argument("--pack-key", default="tokens",
+                   help="feature name holding the document tokens under "
+                        "--pack-seq")
     p.add_argument("--data-transform", default=None,
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
@@ -346,18 +354,65 @@ def run(args: argparse.Namespace) -> RunResult:
     # validation_split semantics); otherwise eval runs on the training
     # distribution (documented train-set monitoring).
     global_batch = args.global_batch_size or entry["global_batch_size"]
+    if args.pack_seq and not args.data_dir:
+        raise SystemExit("--pack-seq needs --data-dir (a varlen TFRecord "
+                         "corpus to pack)")
     if args.data_dir:
         # Autodetect format: a dir of *.tfrecord files (the reference's
         # tf.data corpus convention) vs the native mmap part-*/ layout.
         import pathlib
 
         data_root = pathlib.Path(args.data_dir)
-        kind = ("tfrecord_dir"
-                if any(data_root.glob("*.tfrecord"))
-                or any(data_root.glob("*.tfrecord.gz"))
-                else "array_dir")
-        source = get_dataset(kind, root=args.data_dir,
-                             transform=args.data_transform)
+        if args.pack_seq:
+            # Varlen documents → packed LM rows (decoder configs).
+            from tensorflow_train_distributed_tpu.data.packing import (
+                PackedLmSource,
+            )
+            from tensorflow_train_distributed_tpu.data.tfrecord import (
+                TFRecordSource,
+            )
+
+            if args.data_transform:
+                raise SystemExit(
+                    "--data-transform does not apply under --pack-seq "
+                    "(packing consumes raw token documents); drop one of "
+                    "the two flags")
+            paths = sorted([*data_root.glob("*.tfrecord"),
+                            *data_root.glob("*.tfrecord.gz")])
+            if not paths:
+                raise SystemExit(
+                    f"--pack-seq needs *.tfrecord(.gz) files under "
+                    f"{data_root}")
+            source = PackedLmSource.from_source(
+                TFRecordSource(paths), args.pack_seq, key=args.pack_key)
+            # Fail at launch: only decoder LM tasks consume packed
+            # batches, and clamped out-of-vocab ids would train on
+            # garbage with a finite loss (the --init-from-hf hazard).
+            from tensorflow_train_distributed_tpu.models.llama import (
+                CausalLmTask,
+            )
+
+            probe_task = entry["task_factory"]()
+            if not isinstance(probe_task, CausalLmTask):
+                raise SystemExit(
+                    f"--pack-seq needs a decoder LM config (llama "
+                    f"family); {type(probe_task).__name__} does not "
+                    "consume packed batches")
+            max_id = max(int(source[i]["tokens"].max())
+                         for i in range(len(source)))
+            if max_id >= probe_task.config.vocab_size:
+                raise SystemExit(
+                    f"packed corpus has token id {max_id} but the "
+                    f"config's vocab is {probe_task.config.vocab_size}; "
+                    "re-tokenize or pick a matching config "
+                    "(out-of-range ids would clamp and train on garbage)")
+        else:
+            kind = ("tfrecord_dir"
+                    if any(data_root.glob("*.tfrecord"))
+                    or any(data_root.glob("*.tfrecord.gz"))
+                    else "array_dir")
+            source = get_dataset(kind, root=args.data_dir,
+                                 transform=args.data_transform)
     else:
         source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
     eval_source = source

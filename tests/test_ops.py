@@ -78,6 +78,8 @@ class TestAttention:
             )
         except ImportError:
             pytest.skip("pallas tpu ops unavailable")
+        if not hasattr(pltpu, "force_tpu_interpret_mode"):
+            pytest.skip("force_tpu_interpret_mode unavailable")
         with pltpu.force_tpu_interpret_mode():
             out_flash = flash_attention(
                 q, k, v, segment_ids=SegmentIds(q=seg, kv=seg),

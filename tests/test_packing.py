@@ -140,3 +140,56 @@ def test_pack_from_tfrecord_varlen_corpus(tmp_path):
     r0 = packed[0]
     assert set(r0) == {"tokens", "targets", "segment_ids", "loss_weights"}
     assert r0["tokens"].shape == (16,)
+
+
+def test_cli_pack_seq_trains_from_varlen_tfrecord(tmp_path):
+    """--data-dir + --pack-seq: a directory of variable-length tokenized
+    TFRecord docs trains a decoder LM packed, through the real CLI."""
+    from tensorflow_train_distributed_tpu import launch
+    from tensorflow_train_distributed_tpu.data.tfrecord import (
+        TFRecordWriter,
+    )
+
+    rng = np.random.default_rng(4)
+    with TFRecordWriter(str(tmp_path / "docs.tfrecord")) as w:
+        for n in rng.integers(3, 30, 128):
+            w.write_example({"tokens": rng.integers(2, 256, n)})
+    result = launch.run(launch.build_parser().parse_args([
+        "--config", "llama_tiny_sft", "--steps", "4",
+        "--global-batch-size", "8", "--data-dir", str(tmp_path),
+        "--pack-seq", "32", "--log-every", "1"]))
+    assert np.isfinite(result.history["loss"]).all()
+    assert "loss_weight" in result.history  # packed weighting active
+
+
+def test_cli_pack_seq_guards(tmp_path):
+    from tensorflow_train_distributed_tpu import launch
+    from tensorflow_train_distributed_tpu.data.tfrecord import (
+        TFRecordWriter,
+    )
+
+    with TFRecordWriter(str(tmp_path / "docs.tfrecord")) as w:
+        w.write_example({"tokens": np.arange(2, 12)})
+    args = ["--data-dir", str(tmp_path), "--pack-seq", "16",
+            "--steps", "1", "--global-batch-size", "8", "--log-every", "1"]
+    with pytest.raises(SystemExit, match="decoder LM"):
+        launch.run(launch.build_parser().parse_args(
+            ["--config", "bert_tiny_mlm", *args]))
+    with pytest.raises(SystemExit, match="data-transform"):
+        launch.run(launch.build_parser().parse_args(
+            ["--config", "llama_tiny_sft", "--data-transform",
+             "u8_image_to_f32", *args]))
+    with pytest.raises(SystemExit, match="needs --data-dir"):
+        launch.run(launch.build_parser().parse_args(
+            ["--config", "llama_tiny_sft", "--pack-seq", "16",
+             "--steps", "1"]))
+    # Vocab overflow: llama_tiny_sft vocab is 256; write id 999.
+    big = tmp_path / "big"
+    big.mkdir()
+    with TFRecordWriter(str(big / "docs.tfrecord")) as w:
+        w.write_example({"tokens": np.asarray([1, 999, 3, 4])})
+    with pytest.raises(SystemExit, match="vocab"):
+        launch.run(launch.build_parser().parse_args(
+            ["--config", "llama_tiny_sft", "--data-dir", str(big),
+             "--pack-seq", "16", "--steps", "1",
+             "--global-batch-size", "8", "--log-every", "1"]))
