@@ -316,9 +316,12 @@ def main(argv=None) -> int:
     failures = {}
     # Compile or the first step can wedge just like init — keep a watchdog
     # armed through the whole measure phase so a JSON record always lands.
+    skip_note = ({"skipped_configs": skipped_configs}
+                 if skipped_configs else {})
     wd = _watchdog(args.bench_timeout,
                    dict(record, backend=platform, configs=results,
-                        failed_configs=failures), what="compile/measure")
+                        failed_configs=failures, **skip_note),
+                   what="compile/measure")
     try:
         for name in configs:
             try:
@@ -330,7 +333,7 @@ def main(argv=None) -> int:
         wd.cancel()
     if not results:
         _emit(dict(record, error=f"all configs failed: {failures}",
-                   backend=platform, probe_errors=errors))
+                   backend=platform, probe_errors=errors, **skip_note))
         return 1
 
     plausible = {n: r for n, r in results.items()
@@ -338,7 +341,8 @@ def main(argv=None) -> int:
     if not plausible:
         _emit(dict(record, backend=platform, configs=results,
                    error="all measurements exceeded the hardware roofline "
-                         "(timing artifact; see bench_config guard)"))
+                         "(timing artifact; see bench_config guard)",
+                   **skip_note))
         return 1
     best_name = max(plausible, key=lambda n:
                     plausible[n]["images_per_sec_per_chip"])

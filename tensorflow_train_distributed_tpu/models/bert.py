@@ -165,6 +165,8 @@ class BertEncoder(nn.Module):
 class BertMlmTask:
     """Masked-LM objective over ``SyntheticMLM``-shaped batches."""
 
+    report_perplexity = True  # evaluate() adds exp(mean masked loss)
+
     def __init__(self, config: BertConfig = BertConfig()):
         self.config = config
         self.model = BertEncoder(config)

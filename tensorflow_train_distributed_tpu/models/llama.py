@@ -302,6 +302,8 @@ class LlamaModel(nn.Module):
 class CausalLmTask:
     """Next-token objective over ``SyntheticLM`` batches (SFT-shaped)."""
 
+    report_perplexity = True  # evaluate() adds exp(mean loss)
+
     def __init__(self, config: LlamaConfig = LlamaConfig()):
         self.config = config
         self.model = LlamaModel(config)

@@ -602,7 +602,12 @@ class Trainer:
                 fetch=lambda m: collectives.host_all_reduce_mean(
                     m, self.mesh)):
             acc.update({k: float(np.asarray(v)) for k, v in metrics.items()})
-        return acc.result()
+        out = acc.result()
+        if getattr(self.task, "report_perplexity", False) and "loss" in out:
+            # exp of the aggregated mean loss (NOT the mean of per-batch
+            # exps — Jensen would bias it high); LM/MLM convention.
+            out["perplexity"] = float(np.exp(min(out["loss"], 30.0)))
+        return out
 
     def predict(
         self,

@@ -429,3 +429,36 @@ class TestZero1:
             np.asarray(mu), np.asarray(state.opt_state[0].mu["Dense_0"]
                                        ["kernel"]), rtol=1e-6)
         mgr.close()
+
+
+def test_lm_eval_reports_perplexity(mesh8):
+    """LM/MLM convention: evaluate() adds exp(aggregated mean loss) —
+    computed after aggregation, not averaged per-batch (Jensen)."""
+    import optax
+
+    from tensorflow_train_distributed_tpu.data import (
+        DataConfig, HostDataLoader,
+    )
+    from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+    from tensorflow_train_distributed_tpu.models import llama
+
+    cfg = llama.LLAMA_PRESETS["llama_tiny"]
+    loader = HostDataLoader(
+        get_dataset("lm", num_examples=64, vocab_size=cfg.vocab_size,
+                    seq_len=16),
+        DataConfig(global_batch_size=16, num_epochs=1))
+    trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3), mesh8,
+                      config=TrainerConfig(log_every=100))
+    state = trainer.create_state(next(iter(loader)))
+    out = trainer.evaluate(iter(loader), state, steps=2)
+    assert out["perplexity"] == pytest.approx(np.exp(out["loss"]), rel=1e-6)
+    # Vision tasks don't report it.
+    v_loader = HostDataLoader(get_dataset("mnist", num_examples=64),
+                              DataConfig(global_batch_size=16, num_epochs=1))
+    from tensorflow_train_distributed_tpu.models import lenet
+
+    v_tr = Trainer(lenet.make_task(), optax.adam(1e-3), mesh8,
+                   config=TrainerConfig(log_every=100))
+    v_state = v_tr.create_state(next(iter(v_loader)))
+    assert "perplexity" not in v_tr.evaluate(iter(v_loader), v_state,
+                                             steps=2)
