@@ -61,6 +61,16 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
         row_toks.clear(), row_segs.clear(), row_tgts.clear(), row_wts.clear()
         used = 0
 
+    def _take(remaining: int, space: int) -> int:
+        """Tokens to place now.  Never leaves a 1-token continuation: its
+        lone position would carry loss weight 0 (dead packed capacity), so
+        the split point moves back one and the continuation keeps a
+        labeled next-token pair."""
+        take = min(remaining, space)
+        if remaining - take == 1:
+            take -= 1
+        return take
+
     for doc in docs:
         doc = np.asarray(doc, np.int32).ravel()
         if doc.size < 2:
@@ -70,11 +80,14 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
         while start < doc.size:
             if used == seq_len:
                 flush()
-            take = min(doc.size - start, seq_len - used)
+            take = _take(doc.size - start, seq_len - used)
             if take < 2 and doc.size - start >= 2:
-                # Don't strand a 1-token sliver at a row end.
+                # Don't strand a <2-token piece at a row end (a sliver, or
+                # the split-back above) — start a fresh row instead.  In a
+                # fresh row take ≥ 2 for seq_len ≥ 3; the seq_len == 2
+                # degenerate edge can still yield a labeled 1-token piece.
                 flush()
-                take = min(doc.size - start, seq_len)
+                take = _take(doc.size - start, seq_len)
             piece = doc[start:start + take]
             seg += 1
             row_toks.append(piece)
@@ -109,6 +122,10 @@ class PackedLmSource:
         self._records = pack_documents(docs, seq_len, pad_id=pad_id)
         if not self._records:
             raise ValueError("no packable documents (all < 2 tokens?)")
+        # O(1) vocab-range validation for launchers: the max id over the
+        # packed corpus, tracked here so callers never re-scan it.
+        self.max_token_id = max(
+            int(r["tokens"].max()) for r in self._records)
 
     @classmethod
     def from_source(cls, source, seq_len: int, *, key: str = "tokens",

@@ -390,7 +390,8 @@ class TFRecordSource:
     """
 
     def __init__(self, paths: Union[str, Path, Sequence[Union[str, Path]]],
-                 features: Optional[dict[str, tuple]] = None):
+                 features: Optional[dict[str, tuple]] = None,
+                 max_gz_cached: int = 4):
         if isinstance(paths, (str, Path)):
             paths = [paths]
         self.paths = [Path(p) for p in paths]
@@ -407,7 +408,9 @@ class TFRecordSource:
         # throughput-critical (module docstring).
         self._gz_files: set[int] = set()
         self._gz_cache: dict[int, bytes] = {}
-        self._max_gz_cached = 4
+        self._max_gz_cached = max(1, int(max_gz_cached))
+        self._gz_decompressed: set[int] = set()  # shards decompressed once
+        self._warned_gz_thrash = False
         for fi, p in enumerate(self.paths):
             if _is_gzip(p):
                 self._gz_files.add(fi)
@@ -418,6 +421,9 @@ class TFRecordSource:
             self._file_counts.append(len(entries))
             for off, length in entries:
                 self._index.append((fi, off, length))
+        # Indexing above decompressed every gzip shard once — that's
+        # construction cost, not read-pattern thrash.  Reads start fresh.
+        self._gz_decompressed.clear()
         # LRU-bounded handle cache: big corpora (1000s of shard files)
         # must not exhaust the process fd limit.
         self._handles: "dict[int, object]" = {}
@@ -429,6 +435,24 @@ class TFRecordSource:
     def _gz_bytes(self, fi: int) -> bytes:
         data = self._gz_cache.pop(fi, None)
         if data is None:
+            if fi in self._gz_decompressed and not self._warned_gz_thrash:
+                # Evicted-then-refetched: the access pattern (e.g. global
+                # shuffle over many gzip shards) is thrashing the cache —
+                # each miss re-decompresses a whole shard.  Warn once; a
+                # strictly sequential pass never hits this.
+                self._warned_gz_thrash = True
+                import warnings
+
+                warnings.warn(
+                    f"re-decompressing gzip shard "
+                    f"{self.paths[fi].name}: {len(self._gz_files)} gzip "
+                    f"shards exceed the {self._max_gz_cached}-shard "
+                    f"decompressed cache (max_gz_cached) under a "
+                    f"non-sequential access pattern — raise max_gz_cached "
+                    f"or convert to the uncompressed/mmap format for "
+                    f"shuffled throughput-critical reads",
+                    stacklevel=3)
+            self._gz_decompressed.add(fi)
             if len(self._gz_cache) >= self._max_gz_cached:
                 self._gz_cache.pop(next(iter(self._gz_cache)))  # LRU out
             with gzip.open(self.paths[fi], "rb") as f:

@@ -398,8 +398,7 @@ def run(args: argparse.Namespace) -> RunResult:
                     f"--pack-seq needs a decoder LM config (llama "
                     f"family); {type(probe_task).__name__} does not "
                     "consume packed batches")
-            max_id = max(int(source[i]["tokens"].max())
-                         for i in range(len(source)))
+            max_id = source.max_token_id  # tracked at pack time, O(1) here
             if max_id >= probe_task.config.vocab_size:
                 raise SystemExit(
                     f"packed corpus has token id {max_id} but the "

@@ -182,11 +182,13 @@ class MultiHeadAttention(nn.Module):
     def __call__(self, x_q, x_kv=None, *, mask=None, positions=None,
                  segment_ids=None, deterministic: bool = True):
         if self.decode:
-            if x_kv is not None or mask is not None or segment_ids is not None:
+            if (x_kv is not None or mask is not None
+                    or segment_ids is not None or positions is not None):
                 raise ValueError(
                     "decode=True is causal self-attention over the KV "
-                    "cache; cross-attention inputs (x_kv), dense masks and "
-                    "segment ids are not supported in decode mode")
+                    "cache; cross-attention inputs (x_kv), dense masks, "
+                    "segment ids and explicit positions are not supported "
+                    "in decode mode (the cache index supplies positions)")
             return self._decode_step(x_q)
         if segment_ids is not None and x_kv is not None:
             raise ValueError(
