@@ -6,9 +6,16 @@ machine-readable record (round-1 lesson: rc=1 with no JSON is zero
 evidence).
 
 Hardening:
+- A host-wide flock (runtime/chip_lock.py) serializes every framework
+  process that touches the single-chip tunnel — concurrent use corrupts
+  timings (observed 460% "MFU") and can wedge the backend.
 - The TPU backend is probed in a SUBPROCESS with a timeout (observed
-  failure mode is a hang inside backend init, not an exception), with
-  bounded retries + backoff.
+  failure mode is a hang inside backend init, not an exception), inside a
+  patient time-budgeted acquire loop (``--acquire-timeout``, default
+  10 min) with exponential backoff — the chip is known to be held
+  transiently.  Probe errors distinguish "chip held by framework pid N"
+  (lock diagnosis) from "tunnel unresponsive" (dead tunnel / non-framework
+  holder).
 - Even after a successful probe, the in-process init runs under a watchdog
   that emits the failure record and exits if init wedges.
 - ``--allow-cpu-fallback`` (default on) benches on the host CPU when the
@@ -16,11 +23,15 @@ Hardening:
   the number is never mistaken for a TPU result. ``--no-cpu-fallback``
   restores hard-fail-with-record.
 
-Benched configs: both ``resnet50`` and ``resnet50_s2d`` (the MXU-friendly
-space-to-depth stem, models/resnet.py) — the headline is the faster one,
-with per-config results and derived MFU% in the record.  A jax.profiler
-trace is captured per config into ``--profile-dir`` (default
-``profiles/bench``).
+Benched families (``--families``): ``resnet`` (both ``resnet50`` and
+``resnet50_s2d``, the MXU-friendly space-to-depth stem — the headline is
+the faster one), plus on TPU ``lm`` (llama_125m decoder, tools/bench_lm)
+and ``bert`` (bert_base MLM, tools/bench_bert) so the persisted record
+carries every driver-designated metric, not just ResNet.  The lm/bert
+families run as subprocesses: allocator isolation (a fresh HBM heap per
+family — in-process leftovers could push a fitting config over the
+budget) while inheriting the chip lock.  A jax.profiler trace is captured
+per ResNet config into ``--profile-dir`` (default ``profiles/bench``).
 
 Baseline: the reference publishes no numbers (BASELINE.json "published":
 {}), so ``vs_baseline`` is computed against TARGET_IMG_PER_SEC_PER_CHIP —
@@ -81,7 +92,12 @@ def _probe_backend(timeout_s: float):
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return f"backend probe timed out after {timeout_s:.0f}s"
+        # We hold the framework chip lock here, so a hang is NOT another
+        # framework process — it is the tunnel itself (dead, or held by
+        # something outside this repo's tooling).
+        return (f"tunnel unresponsive: probe hung {timeout_s:.0f}s with "
+                f"the framework chip lock held (tunnel dead, or chip held "
+                f"by a non-framework process)")
     if out.returncode != 0:
         tail = (out.stderr or out.stdout).strip().splitlines()
         return "backend probe failed: " + (tail[-1] if tail else
@@ -92,17 +108,25 @@ def _probe_backend(timeout_s: float):
         return f"backend probe printed no JSON: {out.stdout[-200:]!r}"
 
 
-def _acquire_backend(retries: int, probe_timeout: float):
-    """(info_dict | None, [attempt error strings])."""
+def _acquire_backend(acquire_timeout: float, probe_timeout: float):
+    """Patient acquire: probe with exponential backoff until the time
+    budget runs out.  (info_dict | None, [attempt error strings])."""
     errors = []
-    for attempt in range(retries):
+    t0 = time.monotonic()
+    backoff = 15.0
+    attempt = 0
+    while True:
+        attempt += 1
         info = _probe_backend(probe_timeout)
+        elapsed = time.monotonic() - t0
         if isinstance(info, dict):
             return info, errors
-        errors.append(f"attempt {attempt + 1}: {info}")
-        if attempt + 1 < retries:
-            time.sleep(5 * (attempt + 1))  # 5s, 10s, ... backoff
-    return None, errors
+        errors.append(f"attempt {attempt} (t+{elapsed:.0f}s): {info}")
+        remaining = acquire_timeout - (time.monotonic() - t0)
+        if remaining <= probe_timeout * 0.5:
+            return None, errors  # not enough budget for a useful retry
+        time.sleep(min(backoff, max(remaining - probe_timeout, 1.0)))
+        backoff = min(backoff * 2, 120.0)
 
 
 def _watchdog(seconds: float, record: dict, what: str = "backend init"):
@@ -212,21 +236,78 @@ def bench_config(preset_name: str, batch_per_chip: int, warmup: int,
     return result
 
 
+# Non-ResNet model families folded into the persisted emit (VERDICT r2:
+# the record must carry ≥2 model families).  Subprocesses: fresh HBM heap
+# per family; the chip lock is inherited via TTD_CHIP_LOCK_HELD.
+_HERE = os.path.dirname(os.path.abspath(__file__))
+FAMILY_CMDS = {
+    "lm": ([sys.executable, os.path.join(_HERE, "tools", "bench_lm.py"),
+            "--preset", "llama_125m", "--batch-per-chip", "8",
+            "--seq", "2048", "--no-remat", "--warmup", "3",
+            "--iters", "10"], "llama_125m"),
+    "bert": ([sys.executable, os.path.join(_HERE, "tools", "bench_bert.py"),
+              "--preset", "bert_base", "--batch-per-chip", "32",
+              "--seq", "128", "--warmup", "3", "--iters", "20"],
+             "bert_base"),
+}
+
+
+def _run_family(cmd, timeout_s: float):
+    """(record | None, error | None) from a family bench subprocess."""
+    from tensorflow_train_distributed_tpu.runtime import chip_lock as _cl
+
+    # Pass the held lock fd through: if THIS process is killed mid-family
+    # (driver timeout), the child's inherited open file description keeps
+    # the flock held until the child exits — no concurrent acquirer can
+    # race the orphan on the chip.
+    fd = _cl.held_fd()
+    kw = {"pass_fds": (fd,)} if fd is not None else {}
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s, **kw)
+    except subprocess.TimeoutExpired:
+        return None, f"family bench timed out after {timeout_s:.0f}s"
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        tail = (out.stderr or out.stdout).strip().splitlines()
+        return None, ("family bench printed no JSON: "
+                      + (tail[-1][-200:] if tail else
+                         f"rc={out.returncode}"))
+    try:
+        rec = json.loads(lines[-1])
+    except ValueError:
+        return None, f"unparseable family JSON: {lines[-1][:200]!r}"
+    if out.returncode != 0 or rec.get("error"):
+        return None, rec.get("error", f"rc={out.returncode}")
+    return rec, None
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--configs", default="resnet50,resnet50_s2d",
                    help="comma-separated RESNET_PRESETS names to bench")
+    p.add_argument("--families", default="resnet,lm,bert",
+                   help="model families in the emit: resnet (in-process "
+                        "headline) plus lm/bert subprocess benches (TPU "
+                        "only)")
     p.add_argument("--batch-per-chip", type=int, default=256)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
-    p.add_argument("--retries", type=int, default=2,
-                   help="backend probe attempts before fallback/failure")
+    p.add_argument("--acquire-timeout", type=float, default=600.0,
+                   help="total time budget for acquiring a live TPU "
+                        "backend (probe + backoff loop)")
     p.add_argument("--probe-timeout", type=float, default=120.0,
                    help="seconds per subprocess backend probe")
+    p.add_argument("--lock-timeout", type=float, default=900.0,
+                   help="how long to wait for the host-wide chip lock "
+                        "when another framework process holds the chip")
     p.add_argument("--init-timeout", type=float, default=300.0,
                    help="watchdog on in-process backend init")
     p.add_argument("--bench-timeout", type=float, default=1200.0,
-                   help="watchdog on the whole compile+measure phase")
+                   help="watchdog on the ResNet compile+measure phase")
+    p.add_argument("--family-timeout", type=float, default=900.0,
+                   help="timeout per lm/bert family subprocess")
     fb = p.add_mutually_exclusive_group()
     fb.add_argument("--allow-cpu-fallback", dest="cpu_fallback",
                     action="store_true", default=True)
@@ -243,55 +324,87 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     record = _base_record()
-    info, errors = _acquire_backend(args.retries, args.probe_timeout)
-    fallback = False
-    if info is None:
-        if not args.cpu_fallback:
-            _emit(dict(record, error="; ".join(errors), backend="none"))
-            return 1
-        fallback = True
+    try:
+        return _run(args, record)
+    except SystemExit:
+        raise
+    except Exception as e:
+        # The one-JSON-line-on-any-outcome contract holds even for
+        # failures nothing below anticipated (round-1 lesson).
+        _emit(dict(record, error=f"{type(e).__name__}: {e}",
+                   backend="none"))
+        return 1
 
+
+def _run(args, record) -> int:
+    from tensorflow_train_distributed_tpu.runtime.chip_lock import chip_lock
+
+    errors: list[str] = []
+    try:
+        with chip_lock(
+                timeout=args.lock_timeout,
+                on_wait=lambda pid, w: print(
+                    f"# waiting for chip lock"
+                    + (f" (held by framework pid {pid})" if pid else "")
+                    + f", {w:.0f}s", file=sys.stderr)):
+            info, perrors = _acquire_backend(args.acquire_timeout,
+                                             args.probe_timeout)
+            errors += perrors
+            if info is not None:
+                rc = _bench_phase(args, record, errors, want_tpu=True)
+                if rc is not None:
+                    return rc
+                # else: in-process TPU init failed after a healthy probe —
+                # fall through to the CPU path OUTSIDE the lock (this
+                # process has no further use for the chip).
+    except TimeoutError as e:
+        # Another framework process owns the chip for longer than our
+        # budget — a definitive "chip held" diagnosis, distinct from a
+        # dead tunnel.
+        errors.append(f"chip held: {e}")
+    except OSError as e:
+        errors.append(f"chip lock error: {type(e).__name__}: {e}")
+
+    if not args.cpu_fallback:
+        _emit(dict(record, error="; ".join(errors), backend="none"))
+        return 1
+    # Re-target CPU *before* any further in-process backend use.
+    # force_platform clears any backend a launcher's sitecustomize already
+    # pinned — a bare jax.config.update would be silently ignored in
+    # exactly the wedged-TPU case that got us here.
+    from tensorflow_train_distributed_tpu.runtime.mesh import force_platform
+
+    force_platform("cpu")
+    rc = _bench_phase(args, record, errors, want_tpu=False)
+    return 1 if rc is None else rc
+
+
+def _bench_phase(args, record, errors, want_tpu: bool):
+    """Init the backend and measure.  Returns an exit code, or None when
+    a TPU init failed and the caller should fall back on CPU."""
     import jax
-
-    if fallback:
-        # Probe exhausted retries: re-target CPU *before* any in-process
-        # backend init.  force_platform clears any backend a launcher's
-        # sitecustomize already pinned — a bare jax.config.update would be
-        # silently ignored in exactly the wedged-TPU case that got us here.
-        from tensorflow_train_distributed_tpu.runtime.mesh import (
-            force_platform,
-        )
-
-        force_platform("cpu")
 
     wd = _watchdog(args.init_timeout, record)
     try:
         platform = jax.devices()[0].platform
     except Exception as e:
         # Init can *raise* as well as hang (chip grabbed between probe and
-        # here).  With fallback enabled this is just another reason to
-        # bench on CPU; without it, the record must still land.
+        # here).
         errors.append(f"in-process init: {e}")
-        if not args.cpu_fallback:
-            _emit(dict(record, error="; ".join(errors), backend="none"))
-            return 1
-        from tensorflow_train_distributed_tpu.runtime.mesh import (
-            force_platform,
-        )
-
-        fallback = True
-        force_platform("cpu")
-        platform = jax.devices()[0].platform
+        if want_tpu and args.cpu_fallback:
+            return None  # caller benches on CPU, outside the chip lock
+        _emit(dict(record, error="; ".join(errors), backend="none"))
+        return 1
     finally:
         wd.cancel()
 
-    if platform != "tpu" and not fallback and not args.cpu_fallback:
+    if want_tpu and platform != "tpu" and not args.cpu_fallback:
         _emit(dict(record, error=f"expected tpu backend, got {platform}",
                    backend=platform))
         return 1
     # Any non-TPU number is a fallback result by definition — flag it even
     # when the probe "succeeded" because the host simply has no TPU.
-    fallback = fallback or platform != "tpu"
+    fallback = platform != "tpu"
 
     # CPU can't push MLPerf-sized batches through ResNet-50 in useful time;
     # shrink the workload (one config, tiny batch) and say so in the
@@ -300,11 +413,14 @@ def main(argv=None) -> int:
     batch_per_chip = args.batch_per_chip
     warmup, iters = args.warmup, args.iters
     configs = [c for c in args.configs.split(",") if c]
+    families = [f for f in args.families.split(",") if f]
     skipped_configs = []
     if platform != "tpu":
         batch_per_chip = min(batch_per_chip, 8)
         warmup, iters = min(warmup, 1), min(iters, 2)
         configs, skipped_configs = configs[:1], configs[1:]
+        skipped_configs += [f for f in families if f != "resnet"]
+        families = [f for f in families if f == "resnet"]
 
     # The DEFAULT trace dir holds committed TPU evidence; a CPU fallback
     # must not bury it under CPU traces.  An explicitly chosen dir is
@@ -323,40 +439,71 @@ def main(argv=None) -> int:
                         failed_configs=failures, **skip_note),
                    what="compile/measure")
     try:
-        for name in configs:
-            try:
-                results[name] = bench_config(
-                    name, batch_per_chip, warmup, iters, profile_dir)
-            except Exception as e:
-                failures[name] = f"{type(e).__name__}: {e}"
+        if "resnet" in families:
+            for name in configs:
+                try:
+                    results[name] = bench_config(
+                        name, batch_per_chip, warmup, iters, profile_dir)
+                except Exception as e:
+                    failures[name] = f"{type(e).__name__}: {e}"
     finally:
         wd.cancel()
-    if not results:
+    # Non-ResNet families: bounded subprocesses, lock inherited.  They
+    # enrich the record but never sink the headline — a family failure is
+    # recorded, not fatal.
+    family_results = {}
+    for fam in families:
+        if fam == "resnet":
+            continue
+        if fam not in FAMILY_CMDS:
+            failures[fam] = f"unknown family {fam!r}"
+            continue
+        cmd, key = FAMILY_CMDS[fam]
+        rec_f, err = _run_family(cmd, args.family_timeout)
+        if err:
+            failures[fam] = err
+        else:
+            family_results[key] = rec_f
+    if not results and not family_results:
         _emit(dict(record, error=f"all configs failed: {failures}",
                    backend=platform, probe_errors=errors, **skip_note))
         return 1
 
-    plausible = {n: r for n, r in results.items()
-                 if not r.get("implausible")}
-    if not plausible:
-        _emit(dict(record, backend=platform, configs=results,
-                   error="all measurements exceeded the hardware roofline "
-                         "(timing artifact; see bench_config guard)",
-                   **skip_note))
-        return 1
-    best_name = max(plausible, key=lambda n:
-                    plausible[n]["images_per_sec_per_chip"])
-    best = results[best_name]
-    record.update(
-        value=best["images_per_sec_per_chip"],
-        vs_baseline=round(best["images_per_sec_per_chip"]
-                          / TARGET_IMG_PER_SEC_PER_CHIP, 3),
-        backend=platform,
-        config=best_name,
-        configs=results,
-    )
-    if "mfu_pct" in best:
-        record["mfu_pct"] = best["mfu_pct"]
+    if results:
+        plausible = {n: r for n, r in results.items()
+                     if not r.get("implausible")}
+        if not plausible:
+            _emit(dict(record, backend=platform,
+                       configs={**results, **family_results},
+                       error="all measurements exceeded the hardware "
+                             "roofline (timing artifact; see bench_config "
+                             "guard)", **skip_note))
+            return 1
+        best_name = max(plausible, key=lambda n:
+                        plausible[n]["images_per_sec_per_chip"])
+        best = results[best_name]
+        record.update(
+            value=best["images_per_sec_per_chip"],
+            vs_baseline=round(best["images_per_sec_per_chip"]
+                              / TARGET_IMG_PER_SEC_PER_CHIP, 3),
+            backend=platform,
+            config=best_name,
+            configs={**results, **family_results},
+        )
+        if "mfu_pct" in best:
+            record["mfu_pct"] = best["mfu_pct"]
+    else:
+        # Families-only run (--families lm / bert): the first successful
+        # family carries the headline; there is no ResNet target to
+        # compare against, so vs_baseline stays 0.0 by convention.
+        first = next(iter(family_results.values()))
+        record.update(
+            metric=first.get("metric", record["metric"]),
+            value=first.get("value", 0.0),
+            unit=first.get("unit", record["unit"]),
+            backend=platform,
+            configs=family_results,
+        )
     record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime())
     if fallback:

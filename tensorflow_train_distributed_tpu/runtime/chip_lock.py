@@ -1,0 +1,117 @@
+"""Host-wide TPU chip lock: framework processes never overlap on a chip.
+
+Two framework processes touching the single-chip tunnel concurrently
+corrupt measurements (observed: a 460% "MFU" timing artifact and a 4×
+step-time slowdown under contention — PROFILE.md) and can wedge the
+backend.  Every tool that initializes the TPU backend takes this lock
+first; CPU-forced runs skip it.
+
+Design (SURVEY §5.8 places serialization host-side, not in XLA):
+- ``flock`` on a well-known path — kernel-released on process death, so
+  a crashed bench can never deadlock the next one.
+- Children spawned by a lock holder inherit the right to run via
+  ``TTD_CHIP_LOCK_HELD=1`` in the environment (bench.py runs per-family
+  benches as subprocesses for allocator isolation).  Python's subprocess
+  closes inherited fds by default, so a spawner that wants the kernel
+  lock to survive its own death while a child still drives the chip must
+  explicitly pass ``held_fd()`` via ``pass_fds`` — the shared open file
+  description then keeps the flock held until the child exits too.
+- The holder's pid is written to the file so a waiting process can say
+  WHO holds the chip — the "chip held" vs "tunnel dead" diagnosis.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import time
+
+LOCK_PATH = os.environ.get("TTD_CHIP_LOCK_PATH", "/tmp/ttd_tpu.lock")
+ENV_FLAG = "TTD_CHIP_LOCK_HELD"
+
+_held_fd: int | None = None
+
+
+def held_fd() -> int | None:
+    """Fd of the lock THIS process holds (for subprocess ``pass_fds``)."""
+    return _held_fd
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError as e:
+        return e.errno == errno.EPERM
+    return True
+
+
+def lock_holder() -> int | None:
+    """Pid of the live process holding the chip lock, else None."""
+    import fcntl
+
+    try:
+        with open(LOCK_PATH, "r+") as f:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                content = f.read().strip()
+                if content.isdigit() and _pid_alive(int(content)):
+                    return int(content)
+                return None  # held, holder unknown/unreadable
+            fcntl.flock(f, fcntl.LOCK_UN)
+            return None
+    except OSError:
+        return None
+
+
+@contextlib.contextmanager
+def chip_lock(timeout: float = 900.0, poll: float = 5.0,
+              on_wait=None):
+    """Acquire the host-wide chip lock (or inherit it from a parent).
+
+    ``on_wait(holder_pid, waited_s)`` is called once per poll while
+    blocked, for progress reporting.  Raises ``TimeoutError`` with the
+    holder's pid when the budget runs out — the caller decides whether
+    that means "try later" or "steal" (it never means steal here).
+    """
+    if os.environ.get(ENV_FLAG) == "1":
+        yield "inherited"
+        return
+    import fcntl
+
+    f = open(LOCK_PATH, "a+")
+    t0 = time.monotonic()
+    try:
+        while True:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                waited = time.monotonic() - t0
+                holder = lock_holder()
+                if waited >= timeout:
+                    raise TimeoutError(
+                        f"chip lock {LOCK_PATH} still held"
+                        + (f" by pid {holder}" if holder else "")
+                        + f" after {waited:.0f}s")
+                if on_wait is not None:
+                    on_wait(holder, waited)
+                time.sleep(poll)
+        f.seek(0)
+        f.truncate()
+        f.write(str(os.getpid()))
+        f.flush()
+        os.environ[ENV_FLAG] = "1"
+        global _held_fd
+        _held_fd = f.fileno()
+        try:
+            yield "acquired"
+        finally:
+            _held_fd = None
+            os.environ.pop(ENV_FLAG, None)
+            f.seek(0)
+            f.truncate()
+            fcntl.flock(f, fcntl.LOCK_UN)
+    finally:
+        f.close()

@@ -137,10 +137,23 @@ def main(argv=None) -> int:
         )
 
         force_platform(args.platform)
+    import contextlib
+
+    if args.platform and args.platform != "tpu":
+        cm = contextlib.nullcontext()
+    else:
+        # May touch the single-chip tunnel: serialize with every other
+        # framework TPU process (concurrent use corrupts timings).
+        from tensorflow_train_distributed_tpu.runtime.chip_lock import (
+            chip_lock,
+        )
+
+        cm = chip_lock()
     try:
-        rec = bench_bert(args.preset, args.batch_per_chip, args.seq,
-                         args.warmup, args.iters, force_hbm=args.force_hbm,
-                         remat=args.remat)
+        with cm:
+            rec = bench_bert(args.preset, args.batch_per_chip, args.seq,
+                             args.warmup, args.iters,
+                             force_hbm=args.force_hbm, remat=args.remat)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({
             "metric": f"{args.preset}_mlm_samples_per_sec_per_chip",

@@ -260,11 +260,24 @@ def main(argv=None) -> int:
         )
 
         force_platform(args.platform)
+    import contextlib
+
+    if args.platform and args.platform != "tpu":
+        cm = contextlib.nullcontext()
+    else:
+        # May touch the single-chip tunnel: serialize with every other
+        # framework TPU process (concurrent use corrupts timings).
+        from tensorflow_train_distributed_tpu.runtime.chip_lock import (
+            chip_lock,
+        )
+
+        cm = chip_lock()
     try:
-        rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
-                       args.warmup, args.iters, remat=args.remat,
-                       remat_policy=args.remat_policy,
-                       force_hbm=args.force_hbm)
+        with cm:
+            rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
+                           args.warmup, args.iters, remat=args.remat,
+                           remat_policy=args.remat_policy,
+                           force_hbm=args.force_hbm)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
                           "_per_chip", "value": 0.0,

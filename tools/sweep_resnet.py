@@ -33,7 +33,8 @@ def run_point(batch: int, flags: str, iters: int, config: str):
         env["LIBTPU_INIT_ARGS"] = flags
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
            "--configs", config, "--batch-per-chip", str(batch),
-           "--iters", str(iters), "--retries", "1",
+           "--iters", str(iters), "--acquire-timeout", "120",
+           "--families", "resnet",
            "--no-cpu-fallback", "--no-persist", "--profile-dir", ""]
     out = subprocess.run(cmd, capture_output=True, text=True, env=env,
                          timeout=900, cwd=REPO)
