@@ -155,6 +155,13 @@ class DataConfig:
     global_batch_size: int = 32
     shuffle: bool = True
     seed: int = 0
+    # True (training default): truncate each epoch to whole batches —
+    # static SPMD shapes, no partial batch.  False (evaluation): PAD the
+    # final batch to full size instead of dropping it; every batch gains a
+    # ``sample_weight`` key ([B] f32, 1.0 real / 0.0 pad) that the Task
+    # loss_fns fold into their weighting, so a finite split's metrics
+    # cover every example exactly while shapes stay static (SURVEY §7
+    # hard-part 2, the reference input layer's last-batch semantics).
     drop_remainder: bool = True
     num_epochs: Optional[int] = None  # None = repeat forever
     prefetch: int = 2
@@ -202,12 +209,6 @@ class HostDataLoader:
             )
         self.host_batch_size = config.global_batch_size // self.process_count
         self._native_packed = None  # pack_for_staging cache (use_native)
-        if not config.drop_remainder:
-            raise NotImplementedError(
-                "drop_remainder=False is not supported: SPMD step functions "
-                "need static shapes (XLA recompiles per shape). Pad the "
-                "source instead."
-            )
         if config.shard_policy not in ("data", "file"):
             raise ValueError(
                 f"shard_policy must be data|file, got "
@@ -265,21 +266,46 @@ class HostDataLoader:
         return order[self.process_index :: self.process_count]
 
     def _epoch_orders(self) -> Iterator[np.ndarray]:
-        """Per-epoch index streams, truncated to whole batches.
+        """Per-epoch index streams, sized to exactly whole batches —
+        truncated (drop_remainder=True) or PADDED with repeats of the
+        final index (False; the repeats are masked to weight 0 via
+        ``sample_weight`` downstream, so they are NOT distinct records).
 
         Batch count must be identical on every process or multi-host SPMD
         deadlocks at the epoch boundary (one process enters the collective
         step while another's iterator is exhausted) — so it derives from
-        ``len(source)`` via ``steps_per_epoch``, never from this process's
-        shard length.  Single source of epoch/order/truncation logic for
-        both the Python and native batch paths.
+        globally-known sizes via ``steps_per_epoch``, never from this
+        process's shard length.  Single source of epoch/order/sizing logic
+        for both the Python and native batch paths.
         """
         epoch = 0
         while self.config.num_epochs is None or epoch < self.config.num_epochs:
-            order = self._epoch_order(epoch)
-            n_batches = self.steps_per_epoch()
-            yield order[: n_batches * self.host_batch_size]
+            yield self._padded_order(epoch)
             epoch += 1
+
+    def _padded_order(self, epoch: int) -> np.ndarray:
+        """Epoch index stream sized to exactly steps_per_epoch batches:
+        truncated (drop_remainder) or padded by repeating the final index
+        (pad rows get sample_weight 0 downstream — repeating real records
+        keeps every model's input distribution valid, unlike zeros)."""
+        order = np.asarray(self._epoch_order(epoch))
+        want = self.steps_per_epoch() * self.host_batch_size
+        if self.config.drop_remainder or len(order) == want:
+            return order[:want]
+        filler = order[-1:] if len(order) else np.zeros(1, np.int64)
+        return np.concatenate(
+            [order, np.repeat(filler, want - len(order))])
+
+    def _with_sample_weight(self, batch: dict, in_epoch_batch: int) -> dict:
+        """Attach the pad-row mask (drop_remainder=False contract)."""
+        if "sample_weight" in batch:
+            raise ValueError(
+                "source records already have a 'sample_weight' key; the "
+                "drop_remainder=False pad mask would clobber it")
+        b0 = in_epoch_batch * self.host_batch_size
+        w = ((np.arange(self.host_batch_size) + b0)
+             < self._shard_len()).astype(np.float32)
+        return dict(batch, sample_weight=w)
 
     def iter_from(self, global_step: int) -> Iterator[dict[str, np.ndarray]]:
         """Iterator positioned after ``global_step`` optimizer steps.
@@ -302,15 +328,18 @@ class HostDataLoader:
             first = True
             e = epoch
             while self.config.num_epochs is None or e < self.config.num_epochs:
-                order = self._epoch_order(e)[: spe * self.host_batch_size]
+                order = self._padded_order(e)
                 start = offset * self.host_batch_size if first else 0
                 first = False
                 for b in range(start // self.host_batch_size, spe):
                     idx = order[b * self.host_batch_size:
                                 (b + 1) * self.host_batch_size]
                     records = [self.source[int(i)] for i in idx]
-                    yield {k: np.stack([r[k] for r in records])
-                           for k in records[0]}
+                    batch = {k: np.stack([r[k] for r in records])
+                             for k in records[0]}
+                    if not self.config.drop_remainder:
+                        batch = self._with_sample_weight(batch, b)
+                    yield batch
                 e += 1
 
         return _resumed()
@@ -327,30 +356,57 @@ class HostDataLoader:
                     # eval, preemption restart) reuse the flattened matrix
                     # instead of re-copying the dataset every time.
                     self._native_packed = pack_for_staging(self.source)
-                yield from native_batch_iterator(
+                it = native_batch_iterator(
                     self.source, self._epoch_orders(), self.host_batch_size,
                     num_threads=self.config.native_threads,
                     packed=self._native_packed,
                 )
+                if self.config.drop_remainder:
+                    yield from it
+                else:
+                    spe = self.steps_per_epoch()
+                    for i, batch in enumerate(it):
+                        yield self._with_sample_weight(batch, i % spe)
                 return
             # No toolchain/library: fall through to the Python path.
         for order in self._epoch_orders():
             for b in range(len(order) // self.host_batch_size):
                 idx = order[b * self.host_batch_size : (b + 1) * self.host_batch_size]
                 records = [self.source[int(i)] for i in idx]
-                yield {
+                batch = {
                     k: np.stack([r[k] for r in records])
                     for k in records[0]
                 }
+                if not self.config.drop_remainder:
+                    batch = self._with_sample_weight(batch, b)
+                yield batch
+
+    def _shard_len(self) -> int:
+        """This process's record count for one epoch (pre-padding)."""
+        if self.config.shard_policy == "file":
+            return len(self._file_shards[self.process_index])
+        n, p = len(self.source), self.process_index
+        return (n - p + self.process_count - 1) // self.process_count
 
     def steps_per_epoch(self) -> int:
+        """Identical on every process (SPMD deadlock otherwise): derived
+        from globally-known sizes, never this process's shard length.
+        drop_remainder=True floors to whole batches over the SMALLEST
+        shard; False ceils over the LARGEST (shorter shards pad)."""
         if self.config.shard_policy == "file":
-            # Every process must run the same batch count (SPMD deadlock
-            # otherwise) — bound by the smallest file shard.
-            per_host = min(len(s) for s in self._file_shards)
+            sizes = [len(s) for s in self._file_shards]
+            per_host = (min(sizes) if self.config.drop_remainder
+                        else max(sizes))
         else:
-            per_host = len(self.source) // self.process_count
-        return per_host // self.host_batch_size
+            n = len(self.source)
+            per_host = (n // self.process_count
+                        if self.config.drop_remainder
+                        else (n + self.process_count - 1)
+                        // self.process_count)
+        if self.config.drop_remainder:
+            return per_host // self.host_batch_size
+        return ((per_host + self.host_batch_size - 1)
+                // self.host_batch_size)
 
     def as_device_iterator(self, mesh: Mesh) -> Iterator[Any]:
         """Prefetched device iterator using ``config.prefetch`` buffers."""

@@ -262,9 +262,16 @@ def _bleu_eval(args, task, state, loader) -> float:
             max_len=batch["targets_out"].shape[1],
             beam_size=args.beam_size, bos_id=args.bos_id,
             eos_id=args.eos_id))
-        hyps += [strip_after_eos(list(r), args.eos_id) for r in out]
+        # Padded eval rows (sample_weight 0) are duplicates of a real
+        # record — scoring them would double-count sentences.
+        keep = (np.asarray(batch["sample_weight"]) > 0
+                if "sample_weight" in batch
+                else np.ones(len(out), bool))
+        hyps += [strip_after_eos(list(r), args.eos_id)
+                 for r, k in zip(out, keep) if k]
         refs += [strip_after_eos(list(r), args.eos_id)
-                 for r in np.asarray(batch["targets_out"])]
+                 for r, k in zip(np.asarray(batch["targets_out"]), keep)
+                 if k]
     return corpus_bleu(hyps, refs)
 
 
@@ -437,11 +444,14 @@ def run(args: argparse.Namespace) -> RunResult:
 
     def make_eval_loader():
         # Fresh single-pass loader per eval so every run sees the same
-        # records in the same (seeded) order.
+        # records in the same (seeded) order.  drop_remainder=False: the
+        # final partial batch is padded and weight-masked so a finite
+        # split's metrics cover every example exactly (Task sample_weight
+        # contract); training keeps whole batches.
         eval_loader = HostDataLoader(
             eval_source,
             DataConfig(global_batch_size=global_batch, seed=args.seed + 1,
-                       num_epochs=1),
+                       num_epochs=1, drop_remainder=False),
             process_index=(cluster.process_id
                            if cluster.is_multiprocess else None),
             process_count=(cluster.num_processes

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_train_distributed_tpu.models import layers as L
-from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+from tensorflow_train_distributed_tpu.ops.losses import (
+    fold_sample_weight, softmax_cross_entropy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,14 +183,16 @@ class BertMlmTask:
             deterministic=not train,
             rngs={"dropout": rng} if train else {},
         ).astype(jnp.float32)
+        weights = fold_sample_weight(batch, batch["labels"].shape,
+                                     batch["mask_weights"])
         loss, acc = softmax_cross_entropy(
-            logits, batch["labels"], weights=batch["mask_weights"])
+            logits, batch["labels"], weights=weights)
         # loss_weight: Task contract — lets gradient accumulation combine
         # microbatches as the true masked-token-weighted global mean.
-        # Clamped exactly like the loss denominator in softmax_cross_entropy
-        # so weighted recombination inverts the same normalization.
-        w_total = jnp.maximum(
-            batch["mask_weights"].astype(jnp.float32).sum(), 1.0)
+        # Unclamped per fold_sample_weight's contract (the loss
+        # denominator stays clamped inside softmax_cross_entropy;
+        # recombination multiplies a garbage-0 loss by weight 0).
+        w_total = weights.sum()
         return loss, ({"mlm_accuracy": acc, "loss_weight": w_total},
                       model_state)
 

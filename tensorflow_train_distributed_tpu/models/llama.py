@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_train_distributed_tpu.models import layers as L
-from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+from tensorflow_train_distributed_tpu.ops.losses import (
+    fold_sample_weight, softmax_cross_entropy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -316,15 +318,16 @@ class CausalLmTask:
         logits = self.model.apply(
             {"params": params}, batch["tokens"],
             segment_ids=batch.get("segment_ids")).astype(jnp.float32)
-        weights = batch.get("loss_weights")
+        weights = fold_sample_weight(batch, batch["targets"].shape,
+                                     batch.get("loss_weights"))
         loss, acc = softmax_cross_entropy(logits, batch["targets"],
                                           weights=weights)
         metrics = {"accuracy": acc}
         if weights is not None:
             # Grad-accum recombination contract (Task docstring): weighted
-            # losses report their total weight.
-            metrics["loss_weight"] = jnp.maximum(
-                weights.astype(jnp.float32).sum(), 1.0)
+            # losses report their total weight, unclamped per
+            # fold_sample_weight's contract.
+            metrics["loss_weight"] = weights.sum()
         return loss, (metrics, model_state)
 
     def predict_fn(self, params, model_state, batch):

@@ -37,7 +37,9 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_train_distributed_tpu.models import layers as L
-from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+from tensorflow_train_distributed_tpu.ops.losses import (
+    fold_sample_weight, softmax_cross_entropy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,13 +269,20 @@ class MoeLmTask:
         logits, collections = self.model.apply(
             {"params": params}, batch["tokens"], mutable=["aux_loss"])
         logits = logits.astype(jnp.float32)
-        ce, acc = softmax_cross_entropy(logits, batch["targets"])
+        weights = fold_sample_weight(batch, batch["targets"].shape)
+        ce, acc = softmax_cross_entropy(logits, batch["targets"],
+                                        weights=weights)
         aux = sum(
             jnp.sum(jnp.asarray(v))
             for v in jax.tree.leaves(collections.get("aux_loss", {})))
         loss = ce + aux
         metrics = {"accuracy": acc, "ce_loss": ce,
                    "aux_loss": jnp.asarray(aux)}
+        if weights is not None:
+            # Pad rows still flow through the router, so the load-balance
+            # aux term sees them — harmless for eval (loss is reported,
+            # not optimized); training keeps full drop_remainder batches.
+            metrics["loss_weight"] = weights.sum()
         return loss, (metrics, model_state)
 
 

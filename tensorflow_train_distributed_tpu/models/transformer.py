@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_train_distributed_tpu.models import layers as L
-from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+from tensorflow_train_distributed_tpu.ops.losses import (
+    fold_sample_weight, softmax_cross_entropy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,10 +158,16 @@ class Seq2SeqTask:
             deterministic=not train,
             rngs={"dropout": rng} if train else {},
         ).astype(jnp.float32)
+        weights = fold_sample_weight(batch, batch["targets_out"].shape)
         loss, acc = softmax_cross_entropy(
             logits, batch["targets_out"],
-            label_smoothing=self.config.label_smoothing)
-        return loss, ({"accuracy": acc}, model_state)
+            label_smoothing=self.config.label_smoothing, weights=weights)
+        metrics = {"accuracy": acc}
+        if weights is not None:
+            # Task contract: report total weight (unclamped) so padded
+            # batches combine as the true weighted mean across steps.
+            metrics["loss_weight"] = weights.sum()
+        return loss, (metrics, model_state)
 
 
 def make_task(config: TransformerConfig = TRANSFORMER_PRESETS[

@@ -14,7 +14,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tensorflow_train_distributed_tpu.ops.losses import softmax_cross_entropy
+from tensorflow_train_distributed_tpu.ops.losses import (
+    fold_sample_weight, softmax_cross_entropy,
+)
 
 
 class VisionTask:
@@ -38,15 +40,29 @@ class VisionTask:
         else:
             logits = self.model.apply(variables, batch["image"], train=train)
             new_model_state = model_state
+        # Per-example weights (the padded-final-batch eval contract,
+        # data.pipeline drop_remainder=False): pad rows carry weight 0 so
+        # a finite split's metrics are exact.
+        weights = fold_sample_weight(batch, batch["label"].shape)
         loss, acc = softmax_cross_entropy(
-            logits, batch["label"], label_smoothing=self.label_smoothing)
+            logits, batch["label"], label_smoothing=self.label_smoothing,
+            weights=weights)
         metrics = {"accuracy": acc}
         if logits.shape[-1] > 5:
             # Top-5 — the ImageNet convention's second headline number
             # (only meaningful with more than 5 classes).
             top5 = jax.lax.top_k(logits.astype(jnp.float32), 5)[1]
-            metrics["top5_accuracy"] = (
-                top5 == batch["label"][:, None]).any(-1).mean()
+            hit5 = (top5 == batch["label"][:, None]).any(-1)
+            if weights is None:
+                metrics["top5_accuracy"] = hit5.mean()
+            else:
+                metrics["top5_accuracy"] = (
+                    (hit5 * weights).sum()
+                    / jnp.maximum(weights.sum(), 1.0))
+        if weights is not None:
+            # Task contract: weighted losses report total weight so batch
+            # metrics combine as the true weighted mean.
+            metrics["loss_weight"] = weights.sum()
         if self.weight_decay > 0:
             # L2 on kernels only (reference ResNet convention: no decay on
             # BN scales/biases).

@@ -14,6 +14,31 @@ import jax.numpy as jnp
 import optax
 
 
+def fold_sample_weight(batch, targets_shape,
+                       weights: Optional[jax.Array] = None
+                       ) -> Optional[jax.Array]:
+    """Fold the optional ``sample_weight`` batch key into ``weights``.
+
+    ``sample_weight`` ([B] f32, 1.0 real / 0.0 pad) is the padded-eval
+    contract (``data.pipeline`` ``drop_remainder=False``): pad rows must
+    contribute nothing to any loss or metric.  One implementation shared
+    by every task loss_fn so the composition rule can't drift between
+    families.  Returns per-position weights shaped/broadcastable to
+    ``targets_shape`` (``weights`` with pad rows zeroed, or the pad mask
+    alone), or None when neither weighting applies.  Tasks report
+    ``weights.sum()`` UNCLAMPED as ``metrics["loss_weight"]`` so an
+    all-pad batch (weight 0) is skipped by the metric accumulator.
+    """
+    sw = batch.get("sample_weight")
+    if sw is None:
+        return None if weights is None else weights.astype(jnp.float32)
+    base = (jnp.ones(targets_shape, jnp.float32) if weights is None
+            else weights.astype(jnp.float32))
+    sw = sw.astype(jnp.float32).reshape(
+        sw.shape + (1,) * (len(targets_shape) - sw.ndim))
+    return base * sw
+
+
 def _fused_ce_usable() -> bool:
     """Fused pallas CE on TPU — except under tensor parallelism, where
     logits are vocab-sharded and the GSPMD jnp path keeps the logsumexp

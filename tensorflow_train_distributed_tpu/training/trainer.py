@@ -279,7 +279,10 @@ class Trainer:
             lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
         (new_ms, grads), (losses, stacked, ws) = jax.lax.scan(
             body, (state.model_state, zeros), (micro, jnp.arange(a)))
-        w_total = jnp.sum(ws)
+        # Tasks report UNclamped weights (an all-pad batch is weight 0);
+        # guard the division — zero-weight microbatches contribute 0·loss,
+        # so the epsilon never changes a batch that has any real weight.
+        w_total = jnp.maximum(jnp.sum(ws), 1e-6)
         grads = jax.tree.map(
             lambda g, p: (g / w_total).astype(p.dtype), grads, state.params)
         metrics = jax.tree.map(

@@ -112,11 +112,89 @@ class TestHostDataLoader:
                            DataConfig(global_batch_size=3),
                            process_index=0, process_count=2)
 
-    def test_dynamic_shapes_rejected(self):
-        with pytest.raises(NotImplementedError, match="static shapes"):
-            HostDataLoader(SyntheticBlobs(num_examples=8),
-                           DataConfig(global_batch_size=4,
-                                      drop_remainder=False))
+    def test_dynamic_shapes_never_emitted(self):
+        # drop_remainder=False must keep shapes static: the final batch is
+        # padded, never shrunk (SPMD recompiles per shape otherwise).
+        loader = HostDataLoader(
+            SyntheticBlobs(num_examples=10),
+            DataConfig(global_batch_size=4, shuffle=False, num_epochs=1,
+                       drop_remainder=False))
+        shapes = {b["x"].shape[0] for b in loader}
+        assert shapes == {4}
+
+
+class TestPadRemainder:
+    """drop_remainder=False: pad-and-mask final batch (SURVEY §7 HP2)."""
+
+    def _loader(self, n=10, gbs=4, **kw):
+        return HostDataLoader(
+            SyntheticBlobs(num_examples=n),
+            DataConfig(global_batch_size=gbs, shuffle=False, num_epochs=1,
+                       drop_remainder=False), **kw)
+
+    def test_covers_every_example_exactly_once(self):
+        loader = self._loader()
+        batches = list(loader)
+        assert len(batches) == 3 == loader.steps_per_epoch()
+        w = np.concatenate([b["sample_weight"] for b in batches])
+        labels = np.concatenate([b["label"] for b in batches])
+        assert w.sum() == 10  # every real example weighted once
+        np.testing.assert_array_equal(w, [1] * 10 + [0, 0])
+        # Pad rows repeat the last real record (valid data, weight 0).
+        src = SyntheticBlobs(num_examples=10)
+        np.testing.assert_array_equal(
+            labels[:10], [src[i]["label"] for i in range(10)])
+        assert labels[10] == labels[9] == labels[11]
+
+    def test_exact_multiple_yields_all_ones(self):
+        loader = self._loader(n=8, gbs=4)
+        batches = list(loader)
+        assert len(batches) == 2
+        for b in batches:
+            assert (b["sample_weight"] == 1.0).all()
+
+    def test_multiprocess_consistent_batch_counts(self):
+        # n=9 over 2 processes: shards of 5 and 4; both must run the SAME
+        # number of batches (SPMD deadlock otherwise), short shards pad.
+        loaders = [
+            HostDataLoader(
+                SyntheticBlobs(num_examples=9),
+                DataConfig(global_batch_size=4, shuffle=False,
+                           num_epochs=1, drop_remainder=False),
+                process_index=p, process_count=2)
+            for p in range(2)
+        ]
+        per_proc = [list(ld) for ld in loaders]
+        assert len(per_proc[0]) == len(per_proc[1]) == \
+            loaders[0].steps_per_epoch() == loaders[1].steps_per_epoch()
+        total_w = sum(float(b["sample_weight"].sum())
+                      for bs in per_proc for b in bs)
+        assert total_w == 9  # global coverage exact
+
+    def test_iter_from_matches_fresh_stream(self):
+        loader = self._loader()
+        fresh = list(loader)[1:]
+        resumed = list(loader.iter_from(1))
+        assert len(fresh) == len(resumed)
+        for a, b in zip(fresh, resumed):
+            np.testing.assert_array_equal(a["sample_weight"],
+                                          b["sample_weight"])
+            np.testing.assert_array_equal(a["x"], b["x"])
+
+    def test_weight_key_collision_rejected(self):
+        class _Src:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.zeros(2, np.float32),
+                        "sample_weight": np.float32(1)}
+
+        loader = HostDataLoader(
+            _Src(), DataConfig(global_batch_size=4, shuffle=False,
+                               num_epochs=1, drop_remainder=False))
+        with pytest.raises(ValueError, match="sample_weight"):
+            next(iter(loader))
 
 
 class TestPrefetch:
