@@ -398,11 +398,14 @@ def run(args: argparse.Namespace) -> RunResult:
             from tensorflow_train_distributed_tpu.models.llama import (
                 CausalLmTask,
             )
+            from tensorflow_train_distributed_tpu.models.moe import (
+                MoeLmTask,
+            )
 
             probe_task = entry["task_factory"]()
-            if not isinstance(probe_task, CausalLmTask):
+            if not isinstance(probe_task, (CausalLmTask, MoeLmTask)):
                 raise SystemExit(
-                    f"--pack-seq needs a decoder LM config (llama "
+                    f"--pack-seq needs a decoder LM config (llama or moe "
                     f"family); {type(probe_task).__name__} does not "
                     "consume packed batches")
             max_id = source.max_token_id  # tracked at pack time, O(1) here

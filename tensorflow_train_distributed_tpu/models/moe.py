@@ -197,7 +197,7 @@ class MoeDecoderBlock(nn.Module):
     use_moe: bool = True
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions=None):
         cfg = self.config
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="attn_norm")(x)
@@ -207,7 +207,7 @@ class MoeDecoderBlock(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, name="attention",
-        )(h)
+        )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
         if self.use_moe:
@@ -229,8 +229,20 @@ class MoeLmModel(nn.Module):
     config: MoeConfig = MoeConfig()
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, *, segment_ids=None, positions=None):
         cfg = self.config
+        if segment_ids is not None and positions is None:
+            # Packed rows (llama-path contract): segment-masked attention
+            # + RoPE positions restarting at each document boundary, so a
+            # packed document computes exactly as if alone in the row.
+            # Routing needs no masking — it is per-token, and within a
+            # group earlier tokens' dispatch slots are unaffected by later
+            # ones (the capacity cumsum is causal in token order).
+            from tensorflow_train_distributed_tpu.models.llama import (
+                segment_relative_positions,
+            )
+
+            positions = segment_relative_positions(segment_ids)
         x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                     name="token_embed")(tokens)
         for i in range(cfg.num_layers):
@@ -238,7 +250,7 @@ class MoeLmModel(nn.Module):
             if cfg.remat:
                 blk = nn.remat(blk, prevent_cse=False)
             x = blk(cfg, use_moe=(i % cfg.moe_every == 0),
-                    name=f"layer_{i}")(x)
+                    name=f"layer_{i}")(x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)
         logits = L.dense(cfg.vocab_size, ("embed", "vocab"), use_bias=False,
@@ -260,28 +272,27 @@ class MoeLmTask:
         return variables
 
     def loss_fn(self, params, model_state, batch, rng, train):
-        del rng, train
-        if "segment_ids" in batch:
-            raise NotImplementedError(
-                "packed segments are not supported by the MoE decoder yet "
-                "(its attention has no segment masking); unpacked batches "
-                "only — or use the llama family for packed corpora")
+        del rng
         logits, collections = self.model.apply(
-            {"params": params}, batch["tokens"], mutable=["aux_loss"])
+            {"params": params}, batch["tokens"],
+            segment_ids=batch.get("segment_ids"), mutable=["aux_loss"])
         logits = logits.astype(jnp.float32)
-        weights = fold_sample_weight(batch, batch["targets"].shape)
+        weights = fold_sample_weight(batch, batch["targets"].shape,
+                                     batch.get("loss_weights"))
         ce, acc = softmax_cross_entropy(logits, batch["targets"],
                                         weights=weights)
         aux = sum(
             jnp.sum(jnp.asarray(v))
             for v in jax.tree.leaves(collections.get("aux_loss", {})))
-        loss = ce + aux
+        # Aux terms are training regularizers computed over every routed
+        # token — including eval pad rows, which fold_sample_weight cannot
+        # mask (they bypass the CE weights).  Excluding them from the eval
+        # loss keeps the padded-eval exactness contract: eval 'loss' is
+        # the pad-exact CE, aux stays visible as a diagnostic metric.
+        loss = ce + aux if train else ce
         metrics = {"accuracy": acc, "ce_loss": ce,
                    "aux_loss": jnp.asarray(aux)}
         if weights is not None:
-            # Pad rows still flow through the router, so the load-balance
-            # aux term sees them — harmless for eval (loss is reported,
-            # not optimized); training keeps full drop_remainder batches.
             metrics["loss_weight"] = weights.sum()
         return loss, (metrics, model_state)
 

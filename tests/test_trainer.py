@@ -487,6 +487,7 @@ class TestEvalPartialBatch:
         task = llama.CausalLmTask(cfg)
         mesh = self._mesh1()
         trainer = Trainer(task, optax.adam(1e-3), mesh,
+                          policy=Policy.from_name("float32"),
                           config=TrainerConfig(log_every=100))
         state = trainer.create_state(next(iter(loader)))
         out = trainer.evaluate(iter(loader), state)
@@ -513,6 +514,7 @@ class TestEvalPartialBatch:
                             num_epochs=1, drop_remainder=False))
         task = lenet.make_task()
         trainer = Trainer(task, optax.adam(1e-3), self._mesh1(),
+                          policy=Policy.from_name("float32"),
                           config=TrainerConfig(log_every=100))
         state = trainer.create_state(next(iter(loader)))
         out = trainer.evaluate(iter(loader), state)
@@ -547,6 +549,7 @@ class TestEvalPartialBatch:
                             num_epochs=1, drop_remainder=False))
         task = llama.CausalLmTask(cfg)
         trainer = Trainer(task, optax.adam(1e-3), self._mesh1(),
+                          policy=Policy.from_name("float32"),
                           config=TrainerConfig(log_every=100))
         state = trainer.create_state(next(iter(loader)))
         out = trainer.evaluate(iter(loader), state)
@@ -557,3 +560,35 @@ class TestEvalPartialBatch:
         assert out["loss"] == pytest.approx(float(loss), rel=2e-5)
         assert out["loss_weight"] == pytest.approx(
             float(metrics["loss_weight"]), rel=1e-6)
+
+    def test_moe_eval_exact_over_indivisible_split(self):
+        """MoE eval loss is the pad-exact CE (aux regularizers excluded:
+        they see pad rows and would make 'loss' depend on batch size)."""
+        import dataclasses
+
+        import optax
+
+        from tensorflow_train_distributed_tpu.data.datasets import get_dataset
+        from tensorflow_train_distributed_tpu.models import moe
+
+        cfg = dataclasses.replace(moe.MOE_PRESETS["moe_tiny"],
+                                  capacity_factor=4.0)
+        n, gbs = 10, 4
+        src = get_dataset("lm", num_examples=n, vocab_size=cfg.vocab_size,
+                          seq_len=16)
+        loader = HostDataLoader(
+            src, DataConfig(global_batch_size=gbs, shuffle=False,
+                            num_epochs=1, drop_remainder=False))
+        task = moe.MoeLmTask(cfg)
+        trainer = Trainer(task, optax.adam(1e-3), self._mesh1(),
+                          policy=Policy.from_name("float32"),
+                          config=TrainerConfig(log_every=100))
+        state = trainer.create_state(next(iter(loader)))
+        out = trainer.evaluate(iter(loader), state)
+        full = {k: np.stack([src[i][k] for i in range(n)]) for k in src[0]}
+        loss, (metrics, _) = task.loss_fn(
+            state.params, state.model_state, full,
+            jax.random.key(0), train=False)
+        assert out["loss"] == pytest.approx(float(loss), rel=2e-5)
+        assert out["accuracy"] == pytest.approx(
+            float(metrics["accuracy"]), rel=2e-5)
