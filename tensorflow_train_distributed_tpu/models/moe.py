@@ -233,11 +233,15 @@ class MoeLmModel(nn.Module):
         cfg = self.config
         if segment_ids is not None and positions is None:
             # Packed rows (llama-path contract): segment-masked attention
-            # + RoPE positions restarting at each document boundary, so a
-            # packed document computes exactly as if alone in the row.
+            # + RoPE positions restarting at each document boundary.
             # Routing needs no masking — it is per-token, and within a
             # group earlier tokens' dispatch slots are unaffected by later
-            # ones (the capacity cumsum is causal in token order).
+            # ones (the capacity cumsum is causal in token order).  The
+            # packed == lone-document equivalence is exact only while no
+            # capacity drops occur: under a binding capacity_factor,
+            # earlier documents consume a shared per-row budget, so later
+            # documents may see drops (residual fallthrough) they would
+            # not see alone.
             from tensorflow_train_distributed_tpu.models.llama import (
                 segment_relative_positions,
             )

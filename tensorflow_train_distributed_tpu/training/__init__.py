@@ -20,6 +20,11 @@ from tensorflow_train_distributed_tpu.training.trainer import (  # noqa: F401
     TrainerConfig,
     plan_state_memory,
 )
+from tensorflow_train_distributed_tpu.training.memory import (  # noqa: F401
+    decoder_activation_bytes,
+    hbm_budget_bytes,
+    plan_train_memory,
+)
 from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     Callback,
     EarlyStopping,

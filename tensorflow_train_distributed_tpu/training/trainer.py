@@ -135,18 +135,13 @@ class Trainer:
 
     # -- state ---------------------------------------------------------------
 
-    def create_state(self, sample_batch, params=None) -> TrainState:
-        """Init params on-device directly into their target shardings.
+    def _abstract_state_and_shardings(self, sample_batch):
+        """(create_fn, abstract_state, state_shardings) for this trainer.
 
-        The jit-with-out_shardings pattern means a 7B-param model never
-        materializes unsharded on one chip — the analog of the reference
-        creating variables under ``strategy.scope()`` (``distribute_lib.py:
-        1223``) but placement-correct from the first byte.
-
-        ``params``: optional pre-trained parameter tree (e.g. from
-        ``models.import_hf``) replacing the random init; leaves are cast to
-        the init dtypes and placed into the same target shardings, so
-        fine-tuning from a checkpoint shards identically to from-scratch.
+        Single source of the state-creation closure and its sharding
+        resolution, shared by ``create_state`` (which executes it) and
+        ``lower_train_step`` (which only traces it) — the AOT proof must
+        lower exactly the program the trainer runs.
         """
         rng = jax.random.key(self.config.seed)
         batch_shapes = jax.tree.map(
@@ -174,14 +169,34 @@ class Trainer:
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
                 jax.set_mesh(self.mesh):
             abstract = jax.eval_shape(_create)
-            self.state_shardings = sharding_lib.make_state_shardings(
+            shardings = sharding_lib.make_state_shardings(
                 self.mesh, abstract, self.rules
             )
             if self.config.zero1:
-                self.state_shardings = self.state_shardings.replace(
+                shardings = shardings.replace(
                     opt_state=sharding_lib.zero1_opt_shardings(
                         self.mesh, abstract.opt_state,
-                        self.state_shardings.opt_state))
+                        shardings.opt_state))
+        return _create, abstract, shardings
+
+    def create_state(self, sample_batch, params=None) -> TrainState:
+        """Init params on-device directly into their target shardings.
+
+        The jit-with-out_shardings pattern means a 7B-param model never
+        materializes unsharded on one chip — the analog of the reference
+        creating variables under ``strategy.scope()`` (``distribute_lib.py:
+        1223``) but placement-correct from the first byte.
+
+        ``params``: optional pre-trained parameter tree (e.g. from
+        ``models.import_hf``) replacing the random init; leaves are cast to
+        the init dtypes and placed into the same target shardings, so
+        fine-tuning from a checkpoint shards identically to from-scratch.
+        """
+        _create, abstract, shardings = self._abstract_state_and_shardings(
+            sample_batch)
+        with sharding_lib.with_logical_rules(self.mesh, self.rules), \
+                jax.set_mesh(self.mesh):
+            self.state_shardings = shardings
             state = jax.jit(_create, out_shardings=self.state_shardings)()
         state = nn.unbox(state)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
@@ -215,6 +230,63 @@ class Trainer:
                 params=jax.tree_util.tree_unflatten(treedef, loaded))
         logger.info("created state: %.2fM params", state.num_params() / 1e6)
         return state
+
+    def lower_train_step(self, sample_batch):
+        """AOT-lower the jitted train step on ABSTRACT state — the
+        compile-level proof that a config partitions over this trainer's
+        mesh, with nothing materialized (a 7B f32 train state is ~84 GB;
+        tracing is shape arithmetic).  Returns the ``jax.stages.Lowered``;
+        ``.compile()`` then runs the full XLA SPMD pipeline, so collective
+        structure and per-device buffer sizes can be asserted without one
+        real chip (SURVEY §7 hard-part 3).  ``mesh`` may use devices this
+        host doesn't have (virtual CPU mesh) — the lowering never executes.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            shard_batch_spec,
+        )
+
+        k = self.config.steps_per_execution
+
+        def step(state, batch):
+            with sharding_lib.with_logical_rules(self.mesh, self.rules):
+                if k == 1:
+                    return self._single_step(state, batch)
+                new_state, ms = jax.lax.scan(self._single_step, state,
+                                             batch)
+                return new_state, jax.tree.map(lambda m: m[-1], ms)
+
+        _, abstract, shardings = self._abstract_state_and_shardings(
+            sample_batch)
+        with sharding_lib.with_logical_rules(self.mesh, self.rules), \
+                jax.set_mesh(self.mesh):
+            # Strip metadata boxes WITHOUT nn.unbox: unbox() applies
+            # sharding constraints, which is illegal on abstract values.
+            is_boxed = (lambda x:  # noqa: E731
+                        isinstance(x, nn.meta.AxisMetadata))
+            plain = jax.tree.map(lambda x: x.value if is_boxed(x) else x,
+                                 abstract, is_leaf=is_boxed)
+            state_in = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                plain, shardings)
+            # Same batch layout as the live path: ``sample_batch`` is a
+            # regular batch (the create_state contract); with
+            # steps_per_execution > 1 fit stacks k of them with the scan
+            # axis at dim 0 and shards dim 1 (the prefetch spec) — mirror
+            # both the stacking and the spec here.
+            spec = (shard_batch_spec(self.mesh) if k == 1
+                    else P(None, batch_axes(self.mesh)))
+            batch_sharding = jax.sharding.NamedSharding(self.mesh, spec)
+            batch_in = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (np.shape(x) if k == 1 else (k,) + np.shape(x)),
+                    np.asarray(x).dtype, sharding=batch_sharding),
+                sample_batch)
+            donate = (0,) if self.config.donate_state else ()
+            return jax.jit(step, donate_argnums=donate).lower(
+                state_in, batch_in)
 
     # -- step functions ------------------------------------------------------
 

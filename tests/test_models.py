@@ -342,6 +342,123 @@ class TestLlama7bMemoryBudget:
         assert registry.get_entry("llama2_7b_sft")["strategy"] == "fsdp_tp"
 
 
+class TestActivationMemoryModel:
+    """training.memory: the calibrated activation estimate — pinned to the
+    three OOM points measured on the real v5e chip (PROFILE.md)."""
+
+    V5E_BUDGET = 15.75 * 2**30
+
+    def _estimate(self, preset, batch, seq, remat):
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.training.memory import (
+            STATE_BYTES_PER_PARAM, decoder_activation_bytes,
+        )
+
+        cfg = llama.LLAMA_PRESETS[preset]
+        model = llama.LlamaModel(cfg)
+        import numpy as np
+
+        abstract = jax.eval_shape(
+            lambda: model.init(jax.random.key(0),
+                               np.zeros((1, seq), np.int32)))
+        n_params = sum(
+            x.size for x in jax.tree_util.tree_leaves(abstract["params"]))
+        state = n_params * STATE_BYTES_PER_PARAM
+        act = decoder_activation_bytes(
+            cfg.num_layers, cfg.d_model, batch, seq, remat=remat)
+        return state + act
+
+    def test_measured_point_125m_b8_noremat_fits(self):
+        # Measured: runs at 31.8k tok/s on the chip.
+        est = self._estimate("llama_125m", 8, 2048, remat=False)
+        assert est <= self.V5E_BUDGET
+
+    def test_measured_point_125m_b16_noremat_refused(self):
+        # Measured: OOM, 26.4 GiB requested.  The estimate must refuse
+        # the budget (that's the guard's job) and stay in the measured
+        # point's calibration band — not so low it green-lights a tunnel
+        # killer.
+        est = self._estimate("llama_125m", 16, 2048, remat=False)
+        assert est > self.V5E_BUDGET
+        assert est > 0.7 * 26.4 * 2**30
+
+    def test_measured_point_1b_noremat_state_refused(self):
+        # Measured: llama_1b state alone exceeds the chip.
+        est = self._estimate("llama_1b", 16, 2048, remat=False)
+        assert est > 17 * 2**30
+
+    def test_plan_train_memory_7b_v5e16(self):
+        """The combined planner: 7B fsdp4xtp4 fits v5e-16 at small batch
+        with remat, and refuses the large-batch config."""
+        import numpy as np
+        import optax
+
+        from jax.sharding import AbstractMesh
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.runtime.mesh import AXES
+        from tensorflow_train_distributed_tpu.training import (
+            plan_train_memory,
+        )
+
+        sizes = dict.fromkeys(AXES, 1)
+        sizes.update(fsdp=4, tensor=4)
+        mesh16 = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        task = llama.make_task(llama.LLAMA_PRESETS["llama2_7b"])
+
+        def plan(batch):
+            b = {"tokens": np.zeros((batch, 4096), np.int32),
+                 "targets": np.zeros((batch, 4096), np.int32)}
+            return plan_train_memory(task, b, optax.adamw(1e-5), mesh16,
+                                     device_kind="TPU v5e")
+
+        small = plan(4)
+        assert small["fits"], small
+        assert small["activation_bytes_per_device"] > 0
+        big = plan(64)
+        assert not big["fits"], big
+        assert (big["step_bytes_per_device"]
+                > small["step_bytes_per_device"])
+
+
+class TestLlama7bAotCompile:
+    """Compile-level 7B proof (VERDICT r2 item 5): the REAL llama2_7b
+    train step AOT-lowers and runs the full XLA SPMD partitioning
+    pipeline over an fsdp x tp mesh with nothing materialized — the
+    collective structure is asserted from the compiled HLO."""
+
+    def test_7b_partitions_on_8dev_fsdp_tp(self, mesh8):
+        import numpy as np
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+        from tensorflow_train_distributed_tpu.training import (
+            Policy, Trainer, TrainerConfig,
+        )
+
+        mesh = build_mesh(MeshConfig(fsdp=2, tensor=4))
+        task = llama.CausalLmTask(llama.LLAMA_PRESETS["llama2_7b"])
+        trainer = Trainer(
+            task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+            mesh, policy=Policy.from_name("mixed_bfloat16"),
+            config=TrainerConfig(log_every=1_000_000))
+        batch = {"tokens": np.zeros((8, 4096), np.int32),
+                 "targets": np.zeros((8, 4096), np.int32)}
+        compiled = trainer.lower_train_step(batch).compile()
+        txt = compiled.as_text()
+        # fsdp: params all-gather before use; grads reduced across fsdp.
+        # tp: activation all-reduce (Megatron row/col pattern).
+        assert txt.count("all-gather") > 0
+        assert txt.count("all-reduce") > 0
+        # State never materializes unsharded: per-device argument bytes
+        # are ~1/8 of the ~84 GB f32+moments state.
+        mem = compiled.memory_analysis()
+        assert mem.argument_size_in_bytes < 15 * 2**30
+
+
 class TestRegistry:
     def test_all_reference_configs_present(self):
         names = registry.available()
@@ -436,3 +553,62 @@ def test_vision_top5_metric(mesh8):
     assert "top5_accuracy" in hist.history
     assert all(t5 >= t1 - 1e-6 for t1, t5 in
                zip(hist.history["accuracy"], hist.history["top5_accuracy"]))
+
+
+def test_7b_partitions_on_16dev_v5e16_subprocess():
+    """The exact v5e-16 topology (fsdp=4 x tp=4): needs 16 virtual
+    devices, which the session-scoped 8-device conftest can't provide —
+    fork a fresh interpreter (the multihost-test pattern)."""
+    import subprocess
+    import sys
+
+    src = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 16)
+import numpy as np, optax
+from tensorflow_train_distributed_tpu.models import llama
+from tensorflow_train_distributed_tpu.runtime.mesh import MeshConfig, build_mesh
+from tensorflow_train_distributed_tpu.training import Policy, Trainer, TrainerConfig
+
+mesh = build_mesh(MeshConfig(fsdp=4, tensor=4))
+task = llama.CausalLmTask(llama.LLAMA_PRESETS["llama2_7b"])
+tr = Trainer(task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+             mesh, policy=Policy.from_name("mixed_bfloat16"),
+             config=TrainerConfig(log_every=1_000_000))
+batch = {"tokens": np.zeros((16, 4096), np.int32),
+         "targets": np.zeros((16, 4096), np.int32)}
+compiled = tr.lower_train_step(batch).compile()
+txt = compiled.as_text()
+assert txt.count("all-gather") > 0 and txt.count("all-reduce") > 0
+mem = compiled.memory_analysis()
+# ~84 GB state over 16 devices: strictly sharded arguments.
+assert mem.argument_size_in_bytes < 8 * 2**30, mem.argument_size_in_bytes
+print("OK", txt.count("all-gather"), txt.count("all-reduce"),
+      mem.argument_size_in_bytes)
+"""
+    out = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                         text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
+
+
+def test_plan_train_memory_refuses_moe():
+    """The activation model has no MoE dispatch/expert-buffer terms; a
+    silent underestimate would green-light tunnel-killing compiles."""
+    import optax
+
+    from jax.sharding import AbstractMesh
+
+    from tensorflow_train_distributed_tpu.models import moe
+    from tensorflow_train_distributed_tpu.runtime.mesh import AXES
+    from tensorflow_train_distributed_tpu.training import plan_train_memory
+
+    sizes = dict.fromkeys(AXES, 1)
+    sizes.update(expert=4)
+    mesh = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+    b = {"tokens": np.zeros((4, 128), np.int32),
+         "targets": np.zeros((4, 128), np.int32)}
+    with pytest.raises(ValueError, match="MoE"):
+        plan_train_memory(moe.make_task(moe.MOE_PRESETS["moe_tiny"]), b,
+                          optax.adamw(1e-5), mesh)

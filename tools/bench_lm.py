@@ -19,25 +19,24 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# bf16 peak TFLOP/s by TPU generation (device_kind substrings); MFU is
-# omitted for kinds not listed rather than reported against a wrong peak.
-PEAK_TFLOPS_BY_KIND = {
-    "v5 lite": 197.0,
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v4": 275.0,
-    "v6": 918.0,
-}
+# Device tables + the calibrated activation model live in the package
+# (training.memory) — one source for bench tools and the planner.
+from tensorflow_train_distributed_tpu.training.memory import (  # noqa: E402
+    STATE_BYTES_PER_PARAM,
+    decoder_activation_bytes,
+)
+from tensorflow_train_distributed_tpu.training.memory import (  # noqa: E402
+    hbm_budget_bytes as _hbm_budget_for_kind,
+)
+from tensorflow_train_distributed_tpu.training.memory import (  # noqa: E402
+    peak_tflops as _peak_for_kind,
+)
 
 
 def peak_tflops(device) -> float | None:
     if device.platform != "tpu":
         return None
-    kind = device.device_kind.lower()
-    for sub, peak in PEAK_TFLOPS_BY_KIND.items():
-        if sub in kind:
-            return peak
-    return None
+    return _peak_for_kind(device.device_kind)
 
 
 def param_count(tree):
@@ -46,27 +45,12 @@ def param_count(tree):
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
-# Usable HBM per chip after the runtime's reserve, by device_kind
-# substring (v5e observed directly in OOM reports: 15.75 GiB of 16).
-HBM_BUDGET_GIB_BY_KIND = {
-    "v5 lite": 15.75,
-    "v5e": 15.75,
-    "v4": 31.25,
-    "v5p": 94.75,
-    "v6": 31.25,
-}
-
-
 def hbm_budget_bytes(device) -> float | None:
     """Per-chip HBM budget, or None when the guard doesn't apply (non-TPU
     backend, or a TPU generation the table doesn't know)."""
     if device.platform != "tpu":
         return None
-    kind = device.device_kind.lower()
-    for sub, gib in HBM_BUDGET_GIB_BY_KIND.items():
-        if sub in kind:
-            return gib * 2**30
-    return None
+    return _hbm_budget_for_kind(device.device_kind)
 
 
 def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
@@ -78,38 +62,20 @@ def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
     An HBM-OOM *compile request* has twice killed this environment's
     single-chip tunnel for the rest of the session (see PROFILE.md), so a
     bench must not gamble.  Skipped entirely off-TPU (CPU smoke runs risk
-    nothing).  The activation model is empirical, calibrated against
-    observed XLA allocations on v5e (llama_125m seq2048: b8 fits, b16
-    no-remat needs 26.4G):
-
-      state  = params × 14 B   (bf16 compute copy + f32 master + 2×f32
-               adam moments + grads in flight)
-      remat  : ~6 residual passes of [B,S,d] per layer (layer inputs +
-               flash l/m/out saved across the scan)
-      no-remat: adds ~24 [B,S,d] passes per layer and ~6 score-sized temps
-               per layer stack.  ``score_heads=1`` models the flash path
-               (no materialized [S,S] per head); pass ``num_heads`` for
-               models on the reference einsum attention (BERT), which
-               saves per-head [B,H,S,S] logits/probs for backward.
+    nothing).  The activation model (``training.memory``) is empirical,
+    calibrated against observed XLA allocations on v5e; state is
+    ``params × 14 B`` (bf16 compute copy + f32 master + 2×f32 adam
+    moments + grads in flight).
 
     Raises SystemExit with a machine-readable JSON line unless ``force``.
     """
     budget = hbm_budget_bytes(device)
     if budget is None:
         return
-    state = n_params * 14
-    act = n_layers * batch * seq * d_model * 2 * 6
-    score_term = (6 * score_heads * batch * seq * seq * 2
-                  // (2 if causal else 1))
-    if not remat:
-        act += n_layers * batch * seq * d_model * 2 * 24
-        act += n_layers * score_term
-    elif score_heads > 1:
-        # Per-layer remat still rematerializes ONE layer's einsum-attention
-        # score buffers during its backward — a transient, but it peaks
-        # alongside the saved boundaries, so large-seq configs can OOM the
-        # compile even though nothing seq²-sized is *saved*.
-        act += score_term
+    state = n_params * STATE_BYTES_PER_PARAM
+    act = decoder_activation_bytes(n_layers, d_model, batch, seq,
+                                   remat=remat, causal=causal,
+                                   score_heads=score_heads)
     need = state + act
     # The estimate intentionally errs a little high (b16 no-remat: est 28
     # vs 26.4 GiB observed), so compare against the full budget: known-good
