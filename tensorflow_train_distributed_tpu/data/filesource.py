@@ -48,6 +48,13 @@ def resolve_transform(
     """Resolve a ``TRANSFORMS`` name (or pass a callable/None through)."""
     if isinstance(transform, str):
         if transform not in TRANSFORMS:
+            # Image decode/augment names register on demand — lazy, so
+            # loading a text corpus never imports PIL, and any
+            # imagenet_(train|eval)_{SIZE} resolves without a fixed list.
+            from tensorflow_train_distributed_tpu.data import image
+
+            image.ensure_registered(transform)
+        if transform not in TRANSFORMS:
             raise ValueError(
                 f"Unknown transform {transform!r}; available: "
                 f"{sorted(TRANSFORMS)}")

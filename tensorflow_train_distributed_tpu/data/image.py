@@ -1,0 +1,167 @@
+"""Host-side image decode + augmentation (the reference's tf.image stage).
+
+The reference's ImageNet config is fed by tf.data pipelines that decode
+JPEG on the host CPU and apply random-resized-crop + horizontal flip for
+training, resize-short-side + center-crop for evaluation (SURVEY §2.1
+"tf.data input pipelines", §3.5) — the standard ImageNet recipe.  This
+module is that stage for the rebuild: pure per-record numpy/PIL
+functions registered under ``filesource.TRANSFORMS`` string names, so
+they run wherever records are read — in-process loaders, the native
+stager's producer, or the out-of-process data-service workers (the name
+travels in the picklable ``SourceSpec``; the CPU cost lands on the
+workers, exactly where the reference puts it).
+
+Determinism: the augmentation rng is seeded from the crc32 of the
+encoded bytes, so a given record augments identically on every worker,
+epoch and restart — reproducible by construction (a stronger property
+than tf.data's stateful rng; the tradeoff is one fixed crop per record
+per training run rather than a fresh crop per epoch).
+
+Record schema: the reference's ImageNet TFRecords carry
+``image/encoded`` (JPEG bytes) and ``image/class/label``; bare
+``jpeg``/``image`` + ``label`` names are accepted too, so hand-rolled
+corpora need no renaming.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zlib
+from functools import partial
+
+import numpy as np
+
+# ImageNet channel statistics (the torchvision/MLPerf convention).
+MEAN_RGB = np.asarray([0.485, 0.456, 0.406], np.float32)
+STDDEV_RGB = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+# NOT "image": elsewhere in the package that key is a DECODED pixel
+# array (u8_image_to_f32's convention) — treating it as encoded bytes
+# would fail deep inside PIL instead of with a schema error here.
+_ENCODED_KEYS = ("image/encoded", "jpeg")
+_LABEL_KEYS = ("image/class/label", "label")
+
+
+def _encoded_bytes(rec: dict) -> bytes:
+    for k in _ENCODED_KEYS:
+        v = rec.get(k)
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):  # raw TFRecord bytes_list
+            v = v[0]
+        if isinstance(v, np.ndarray):
+            v = v.tobytes()
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v)
+    raise KeyError(
+        f"record has no encoded image under any of {_ENCODED_KEYS} "
+        f"(keys: {sorted(rec)})")
+
+
+def _label(rec: dict) -> np.int32:
+    for k in _LABEL_KEYS:
+        v = rec.get(k)
+        if v is not None:
+            return np.int32(np.asarray(v).ravel()[0])
+    raise KeyError(
+        f"record has no label under any of {_LABEL_KEYS} "
+        f"(keys: {sorted(rec)})")
+
+
+def decode_image(data: bytes) -> np.ndarray:
+    """Encoded image bytes (JPEG/PNG/...) → uint8 [H, W, 3] RGB."""
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as im:
+        return np.asarray(im.convert("RGB"), np.uint8)
+
+
+def _normalize(img_u8: np.ndarray) -> np.ndarray:
+    return ((img_u8.astype(np.float32) / 255.0) - MEAN_RGB) / STDDEV_RGB
+
+
+def random_resized_crop(img: np.ndarray, size: int,
+                        rng: np.random.Generator,
+                        *, area_range=(0.08, 1.0),
+                        ratio_range=(3 / 4, 4 / 3),
+                        attempts: int = 10) -> np.ndarray:
+    """Inception-style crop: sample area+aspect, fall back to center."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(attempts):
+        target = area * rng.uniform(*area_range)
+        log_ratio = np.log(ratio_range)
+        ratio = np.exp(rng.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target * ratio)))
+        ch = int(round(np.sqrt(target / ratio)))
+        if 0 < cw <= w and 0 < ch <= h:
+            top = int(rng.integers(0, h - ch + 1))
+            left = int(rng.integers(0, w - cw + 1))
+            crop = img[top:top + ch, left:left + cw]
+            return np.asarray(
+                Image.fromarray(crop).resize((size, size),
+                                             Image.BILINEAR), np.uint8)
+    return center_crop(img, size)
+
+
+def center_crop(img: np.ndarray, size: int,
+                *, crop_padding: int = 32) -> np.ndarray:
+    """Resize-short-side then central crop (the eval convention)."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    scale = (size + crop_padding) / min(h, w)
+    nh, nw = max(size, int(round(h * scale))), max(size,
+                                                   int(round(w * scale)))
+    resized = np.asarray(
+        Image.fromarray(img).resize((nw, nh), Image.BILINEAR), np.uint8)
+    top = (nh - size) // 2
+    left = (nw - size) // 2
+    return resized[top:top + size, left:left + size]
+
+
+def imagenet_train_record(rec: dict, *, size: int = 224) -> dict:
+    """JPEG record → augmented training record (decode/crop/flip/norm)."""
+    data = _encoded_bytes(rec)
+    rng = np.random.default_rng(zlib.crc32(data))
+    img = random_resized_crop(decode_image(data), size, rng)
+    if rng.random() < 0.5:
+        img = img[:, ::-1]
+    return {"image": np.ascontiguousarray(_normalize(img)),
+            "label": _label(rec)}
+
+
+def imagenet_eval_record(rec: dict, *, size: int = 224) -> dict:
+    """JPEG record → deterministic eval record (decode/center-crop/norm)."""
+    img = center_crop(decode_image(_encoded_bytes(rec)), size)
+    return {"image": _normalize(img), "label": _label(rec)}
+
+
+_NAME_RE = re.compile(r"imagenet_(train|eval)_(\d+)$")
+
+
+def ensure_registered(name: str) -> None:
+    """Register ``imagenet_(train|eval)_{SIZE}`` for ANY size on demand —
+    the size is encoded in the name, so no fixed list gates resolutions."""
+    m = _NAME_RE.fullmatch(name)
+    if m is None:
+        return
+    from tensorflow_train_distributed_tpu.data.filesource import TRANSFORMS
+
+    fn = (imagenet_train_record if m.group(1) == "train"
+          else imagenet_eval_record)
+    TRANSFORMS.setdefault(name, partial(fn, size=int(m.group(2))))
+
+
+def register_transforms() -> None:
+    """Pre-install the common names into ``filesource.TRANSFORMS`` (other
+    sizes resolve on demand via ``ensure_registered``)."""
+    for size in (224, 32):
+        ensure_registered(f"imagenet_train_{size}")
+        ensure_registered(f"imagenet_eval_{size}")
+
+
+register_transforms()

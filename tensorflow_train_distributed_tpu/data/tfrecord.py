@@ -527,19 +527,32 @@ _DTYPES = {"float32": np.float32, "float64": np.float64,
 
 
 def write_features_sidecar(root: Union[str, Path],
-                           features: dict[str, tuple]) -> Path:
+                           features: Optional[dict[str, tuple]]) -> Path:
     """Persist a feature spec as ``features.json`` next to the tfrecords,
-    so directory-level opens (CLI ``--data-dir``) need no Python spec."""
+    so directory-level opens (CLI ``--data-dir``) need no Python spec.
+
+    ``features=None`` writes the RAW marker: records decode as the
+    Example's raw flat arrays/byte lists with no fixed-shape spec — the
+    variable-shape case (JPEG corpora, varlen token docs), where a
+    per-record ``transform`` produces the fixed-shape training record.
+    """
     root = Path(root)
+    out = root / FEATURES_SIDECAR
+    if features is None:
+        out.write_text(json.dumps({"raw": True}))
+        return out
     spec = {name: {"shape": list(shape), "dtype": np.dtype(dtype).name}
             for name, (shape, dtype) in features.items()}
-    out = root / FEATURES_SIDECAR
     out.write_text(json.dumps({"features": spec}))
     return out
 
 
-def read_features_sidecar(root: Union[str, Path]) -> dict[str, tuple]:
+def read_features_sidecar(root: Union[str, Path]
+                          ) -> Optional[dict[str, tuple]]:
+    """Feature spec from ``features.json``; None for the RAW marker."""
     spec = json.loads((Path(root) / FEATURES_SIDECAR).read_text())
+    if spec.get("raw"):
+        return None
     out = {}
     for name, f in spec["features"].items():
         dtype = f["dtype"]
@@ -579,6 +592,15 @@ def open_tfrecord_dir(root: Union[str, Path],
                 "write one with write_features_sidecar()")
         features = read_features_sidecar(root)
     transform = resolve_transform(transform)
+    if features is None and transform is None:
+        # RAW records are variable-shape (byte lists, varlen arrays) —
+        # batching would np.stack them into garbage or crash downstream.
+        # Fail at open with the actionable fix instead.
+        raise ValueError(
+            f"{root} is a RAW corpus (features.json marks no fixed "
+            "schema) — a per-record transform must produce the "
+            "fixed-shape training record; pass --data-transform (e.g. "
+            "imagenet_train_224) or open with transform=")
     # ONE source over all files (shared index + LRU handle cache), exposed
     # as per-file views so FILE autoshard still hands whole files out —
     # per-file sources would each cache fds and defeat the LRU bound.

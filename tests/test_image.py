@@ -1,0 +1,174 @@
+"""Image decode + augmentation: the real-data ImageNet ingestion path.
+
+JPEG-bearing TFRecords → host-side decode/random-crop/flip (the
+reference's tf.data image stage, SURVEY §2.1/§3.5) → ResNet fit — in
+process, through the data-service workers, and through the real CLI.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.data import image as I
+from tensorflow_train_distributed_tpu.data.tfrecord import (
+    TFRecordWriter,
+    encode_example,
+    open_tfrecord_dir,
+    write_features_sidecar,
+)
+
+
+def _jpeg_bytes(rng, h, w):
+    from PIL import Image
+
+    arr = rng.integers(0, 255, (h, w, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG")
+    return buf.getvalue(), arr
+
+
+def _write_corpus(root, n=16, shards=2, seed=0):
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    per = n // shards
+    for s in range(shards):
+        with TFRecordWriter(os.path.join(root, f"imgs-{s}.tfrecord")) as w:
+            for i in range(per):
+                data, _ = _jpeg_bytes(rng, int(rng.integers(40, 90)),
+                                      int(rng.integers(40, 90)))
+                w.write(encode_example({
+                    "image/encoded": data,
+                    "image/class/label": np.int64((s * per + i) % 10),
+                }))
+    write_features_sidecar(root, None)  # RAW marker: varlen bytes
+    return root
+
+
+class TestDecodeAugment:
+    def test_decode_roundtrip_shape(self):
+        rng = np.random.default_rng(0)
+        data, arr = _jpeg_bytes(rng, 48, 64)
+        img = I.decode_image(data)
+        assert img.shape == (48, 64, 3) and img.dtype == np.uint8
+
+    def test_train_record_shape_norm_and_determinism(self):
+        rng = np.random.default_rng(1)
+        data, _ = _jpeg_bytes(rng, 80, 60)
+        rec = {"image/encoded": data, "image/class/label": np.int64(3)}
+        a = I.imagenet_train_record(rec, size=32)
+        b = I.imagenet_train_record(rec, size=32)
+        assert a["image"].shape == (32, 32, 3)
+        assert a["image"].dtype == np.float32
+        assert a["label"] == 3
+        # Normalized: values centered (not 0..255).
+        assert abs(float(a["image"].mean())) < 3.0
+        np.testing.assert_array_equal(a["image"], b["image"])
+
+    def test_different_records_get_different_crops(self):
+        rng = np.random.default_rng(2)
+        d1, _ = _jpeg_bytes(rng, 70, 70)
+        d2, _ = _jpeg_bytes(rng, 70, 70)
+        a = I.imagenet_train_record({"jpeg": d1, "label": 0}, size=32)
+        b = I.imagenet_train_record({"jpeg": d2, "label": 0}, size=32)
+        assert not np.array_equal(a["image"], b["image"])
+
+    def test_eval_center_crop_geometry(self):
+        # A tall image: center crop takes the middle band.
+        img = np.zeros((100, 50, 3), np.uint8)
+        img[40:60] = 255  # bright middle band
+        out = I.center_crop(img, 32)
+        assert out.shape == (32, 32, 3)
+        assert out.mean() > img.mean()  # crop centered on the band
+
+    def test_bare_key_names_accepted(self):
+        rng = np.random.default_rng(3)
+        data, _ = _jpeg_bytes(rng, 50, 50)
+        rec = I.imagenet_eval_record({"jpeg": data, "label": 7}, size=32)
+        assert rec["label"] == 7
+
+    def test_missing_keys_fail_loudly(self):
+        with pytest.raises(KeyError, match="encoded image"):
+            I.imagenet_train_record({"label": 1})
+        rng = np.random.default_rng(4)
+        data, _ = _jpeg_bytes(rng, 50, 50)
+        with pytest.raises(KeyError, match="label"):
+            I.imagenet_train_record({"jpeg": data})
+
+
+class TestJpegTfrecordPath:
+    def test_raw_sidecar_roundtrip(self, tmp_path):
+        from tensorflow_train_distributed_tpu.data.tfrecord import (
+            read_features_sidecar,
+        )
+
+        write_features_sidecar(tmp_path, None)
+        assert read_features_sidecar(tmp_path) is None
+
+    def test_open_dir_with_named_transform(self, tmp_path):
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        assert len(src) == 16
+        rec = src[5]
+        assert rec["image"].shape == (32, 32, 3)
+        # Transform names resolve lazily (data.image import side effect).
+        from tensorflow_train_distributed_tpu.data.filesource import (
+            resolve_transform,
+        )
+
+        assert resolve_transform("imagenet_eval_224") is not None
+
+    def test_data_service_workers_decode_and_augment(self, tmp_path):
+        """The out-of-process workers run the decode+augment CPU work —
+        where the reference's tf.data service puts it."""
+        from tensorflow_train_distributed_tpu.data import DataConfig
+        from tensorflow_train_distributed_tpu.data.service import (
+            DataServiceDispatcher, SourceSpec,
+        )
+
+        root = _write_corpus(str(tmp_path))
+        spec = SourceSpec("tfrecord_dir",
+                          {"root": root, "transform": "imagenet_train_32"})
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=1)
+        with DataServiceDispatcher(spec, cfg, num_workers=2) as disp:
+            batches = list(disp.client())
+        assert batches
+        for b in batches:
+            assert b["image"].shape == (8, 32, 32, 3)
+            assert b["image"].dtype == np.float32
+
+    def test_cli_trains_resnet_from_encoded_jpegs(self, tmp_path):
+        """--data-dir of encoded images trains ResNet through the real
+        CLI (VERDICT r2 item 6 'done' criterion)."""
+        from tensorflow_train_distributed_tpu import launch
+
+        root = _write_corpus(str(tmp_path))
+        result = launch.run(launch.build_parser().parse_args([
+            "--config", "resnet_tiny", "--steps", "2",
+            "--global-batch-size", "8", "--data-dir", root,
+            "--data-transform", "imagenet_train_32", "--log-every", "1"]))
+        assert np.isfinite(result.history["loss"]).all()
+
+    def test_raw_corpus_without_transform_rejected(self, tmp_path):
+        root = _write_corpus(str(tmp_path))
+        with pytest.raises(ValueError, match="data-transform"):
+            open_tfrecord_dir(root)
+
+    def test_any_size_resolves_on_demand(self):
+        from tensorflow_train_distributed_tpu.data.filesource import (
+            resolve_transform,
+        )
+
+        fn = resolve_transform("imagenet_train_64")
+        rng = np.random.default_rng(6)
+        data, _ = _jpeg_bytes(rng, 80, 80)
+        rec = fn({"jpeg": data, "label": 1})
+        assert rec["image"].shape == (64, 64, 3)
+
+    def test_decoded_pixel_array_key_not_misread_as_bytes(self):
+        # "image" holds DECODED pixels elsewhere in the package — the
+        # transform must raise a schema error, not fail inside PIL.
+        with pytest.raises(KeyError, match="encoded image"):
+            I.imagenet_train_record(
+                {"image": np.zeros((8, 8, 3), np.uint8), "label": 0})
