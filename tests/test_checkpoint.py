@@ -1,5 +1,9 @@
 """Checkpoint/resume tests: keep-N, restore-into-shardings, mid-run resume."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import numpy as np
 import optax

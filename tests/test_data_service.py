@@ -16,7 +16,7 @@ from tensorflow_train_distributed_tpu.data.service import (
     DataServiceDispatcher, SourceSpec,
 )
 
-pytestmark = pytest.mark.multihost
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
 
 
 def _config(**kw):

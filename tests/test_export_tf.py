@@ -5,6 +5,10 @@ the serving process conceptually), run the serving signature, and match
 the native jax forward bit-for-near-bit.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import numpy as np
 import pytest
 

@@ -6,6 +6,10 @@ single-token steps) must produce identical token sequences — that is the
 proof the cache write/read, RoPE positions, and index masking are right.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import dataclasses
 
 import jax

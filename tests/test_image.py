@@ -5,6 +5,10 @@ reference's tf.data image stage, SURVEY §2.1/§3.5) → ResNet fit — in
 process, through the data-service workers, and through the real CLI.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import io
 import os
 

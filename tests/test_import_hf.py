@@ -6,6 +6,10 @@ local config; parity of the two forwards is the proof the weight mapping
 shape-compatible.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import numpy as np
 import pytest
 

@@ -4,6 +4,10 @@ Covers flag parsing, strategy/mesh resolution, end-to-end tiny runs, and
 checkpoint resume through the CLI path — all on the 8-device CPU mesh.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import json
 import os
 

@@ -6,6 +6,10 @@ squeezed, drops show up as combine mass < 1 (those tokens ride the
 residual).  Expert-sharded and unsharded execution must agree numerically.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ from tensorflow_train_distributed_tpu.testing import (
     MultiProcessRunner, UnexpectedExitError, free_ports, tf_config_env,
 )
 
-pytestmark = pytest.mark.multihost
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
 
 
 # --- worker fns (run in children) ------------------------------------------

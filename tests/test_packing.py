@@ -6,6 +6,10 @@ proof the attention isolation and position arithmetic are exact, not
 approximate.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

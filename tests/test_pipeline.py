@@ -5,6 +5,10 @@ execution schedule, not a math change, so outputs and gradients must match
 exactly (fp32 on CPU).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

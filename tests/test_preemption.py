@@ -6,6 +6,10 @@ injected into the training process mid-fit and the save/stop/resume
 contract is asserted end-to-end.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import os
 import signal
 

@@ -1,6 +1,10 @@
 """Sequence-parallelism tests: ring + Ulysses vs the full-attention oracle,
 and end-to-end llama training over a seq-sharded mesh."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

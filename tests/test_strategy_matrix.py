@@ -8,6 +8,10 @@ test proves the cross-product compiles AND the first steps are finite —
 catching preset/rules/model interactions no single-config test sees.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import numpy as np
 import pytest
 

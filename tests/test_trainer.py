@@ -4,6 +4,10 @@ The numerics-parity test (sharded == single-device) is the rebuild of the
 reference's keras_correctness_test_base pattern (SURVEY.md §4.6).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -7,6 +7,10 @@ hand-computed values; (3) a tiny transformer trained on a copy task must
 reach near-perfect BLEU — translation quality end to end.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np
