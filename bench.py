@@ -285,8 +285,11 @@ def _run_family(cmd, timeout_s: float):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--configs", default="resnet50,resnet50_s2d",
-                   help="comma-separated RESNET_PRESETS names to bench")
+    p.add_argument("--configs",
+                   default="resnet50,resnet50_s2d,resnet50_s2d_bnsub",
+                   help="comma-separated RESNET_PRESETS names to bench "
+                        "(bnsub = strided-BN-statistics variant, the "
+                        "PROFILE.md BN-traffic attack)")
     p.add_argument("--families", default="resnet,lm,bert",
                    help="model families in the emit: resnet (in-process "
                         "headline) plus lm/bert subprocess benches (TPU "

@@ -19,8 +19,9 @@ per training run rather than a fresh crop per epoch).
 
 Record schema: the reference's ImageNet TFRecords carry
 ``image/encoded`` (JPEG bytes) and ``image/class/label``; bare
-``jpeg``/``image`` + ``label`` names are accepted too, so hand-rolled
-corpora need no renaming.
+``jpeg`` + ``label`` names are accepted too, so hand-rolled corpora
+need no renaming.  (``image`` is NOT an accepted bytes key — elsewhere
+in the package it denotes a decoded pixel array.)
 """
 
 from __future__ import annotations

@@ -336,21 +336,46 @@ class MlpBlock(nn.Module):
     activation: Callable = nn.gelu
     gated: bool = False
     dropout_rate: float = 0.0
+    # Mark every [B,S,ffn] intermediate non-saveable for the "no_ffn"
+    # remat policy: "mlp_hidden" checkpoint_name tags on the dense
+    # outputs/products (identity unless a policy names them), plus an
+    # inner nothing-saveable checkpoint around the activation so its
+    # elementwise internals (e.g. silu's sigmoid) can't be saved either.
+    # The inner checkpoint only wraps when this flag is on — a plain
+    # no-remat model must not pay activation recompute.
+    remat_hiddens: bool = False
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
+        import jax
+
+        from jax.ad_checkpoint import checkpoint_name
+
         d = x.shape[-1]
         if self.gated:
-            gate = dense(self.hidden, ("embed", "mlp"), use_bias=False,
-                         dtype=self.dtype, name="wi_gate")(x)
-            up = dense(self.hidden, ("embed", "mlp"), use_bias=False,
-                       dtype=self.dtype, name="wi_up")(x)
-            h = self.activation(gate) * up
+            gate = checkpoint_name(
+                dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                      dtype=self.dtype, name="wi_gate")(x), "mlp_hidden")
+            up = checkpoint_name(
+                dense(self.hidden, ("embed", "mlp"), use_bias=False,
+                      dtype=self.dtype, name="wi_up")(x), "mlp_hidden")
+            act = (lambda g, u: self.activation(g) * u)
+            if self.remat_hiddens:
+                act = jax.checkpoint(
+                    act, policy=jax.checkpoint_policies.nothing_saveable)
+            h = checkpoint_name(act(gate, up), "mlp_hidden")
         else:
-            h = dense(self.hidden, ("embed", "mlp"), dtype=self.dtype,
-                      name="wi")(x)
-            h = self.activation(h)
-        h = nn.with_logical_constraint(h, ("batch", "length", "mlp"))
+            h = checkpoint_name(
+                dense(self.hidden, ("embed", "mlp"), dtype=self.dtype,
+                      name="wi")(x), "mlp_hidden")
+            act = self.activation
+            if self.remat_hiddens:
+                act = jax.checkpoint(
+                    act, policy=jax.checkpoint_policies.nothing_saveable)
+            h = checkpoint_name(act(h), "mlp_hidden")
+        h = checkpoint_name(
+            nn.with_logical_constraint(h, ("batch", "length", "mlp")),
+            "mlp_hidden")
         if self.dropout_rate > 0 and not deterministic:
             h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
         y = dense(d, ("mlp", "embed"), use_bias=not self.gated,

@@ -54,7 +54,11 @@ class LlamaConfig:
     #            — the standard LLM policy: most of full-remat's memory win
     #            at a fraction of the recompute, so higher MFU when HBM
     #            allows; attention internals still stream via the flash
-    #            kernel, which saves only q/k/v + LSE regardless).
+    #            kernel, which saves only q/k/v + LSE regardless);
+    #   "no_ffn" — save everything EXCEPT the [B,S,ffn] SwiGLU hiddens
+    #            (the dominant no-remat buffers): backward re-runs only
+    #            the two FFN input matmuls + activation — near-no-remat
+    #            speed at a fraction of its memory.
     remat_policy: str = "full"
     # "ring" | "ulysses" | None — context parallelism over the seq mesh axis.
     seq_parallel: object = None
@@ -101,9 +105,17 @@ def _checkpoint_policy(cfg: LlamaConfig):
         return None  # save nothing beyond layer boundaries
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if cfg.remat_policy == "no_ffn":
+        # Save every intermediate EXCEPT the [B,S,ffn] SwiGLU hiddens —
+        # the buffers that dominate the no-remat footprint (PROFILE.md).
+        # Backward re-runs only the two FFN input matmuls + activation
+        # (~no-remat speed, a fraction of its memory; the flash kernel's
+        # saved residuals stay saved, unlike "full"/"dots" re-runs).
+        return jax.checkpoint_policies.save_anything_except_these_names(
+            "mlp_hidden")
     raise ValueError(
-        f"Unknown remat_policy {cfg.remat_policy!r}; expected 'full' or "
-        "'dots'")
+        f"Unknown remat_policy {cfg.remat_policy!r}; expected 'full', "
+        "'dots' or 'no_ffn'")
 
 
 class DecoderBlock(nn.Module):
@@ -128,8 +140,11 @@ class DecoderBlock(nn.Module):
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
-        x = x + L.MlpBlock(hidden=cfg.ffn_size, dtype=cfg.dtype,
-                           activation=nn.silu, gated=True, name="mlp")(h)
+        x = x + L.MlpBlock(
+            hidden=cfg.ffn_size, dtype=cfg.dtype, activation=nn.silu,
+            gated=True,
+            remat_hiddens=(cfg.remat and cfg.remat_policy == "no_ffn"),
+            name="mlp")(h)
         return x
 
 

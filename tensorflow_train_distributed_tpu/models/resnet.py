@@ -33,6 +33,15 @@ class ResNetConfig:
     num_classes: int = 1000
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
+    # BN statistics over a spatially strided subset (1 = exact).  The
+    # measured v5e step-time ceiling is BatchNorm HBM traffic, not conv
+    # FLOPs (PROFILE.md: ~half the step in BN statistics/backward
+    # reductions); stride 2 reads 1/4 of each activation for the mean/var
+    # passes while normalizing the full tensor — at batch 256 the
+    # estimate still pools >800k samples/channel in the first stage.
+    # Running-stat/param names are unchanged, so checkpoints interchange
+    # with the exact-BN variants.
+    bn_stats_stride: int = 1
     # MLPerf TPU trick: 2x2 space-to-depth on the input ([N,224,224,3] →
     # [N,112,112,12]) turns the stride-2 7x7 stem conv into an equivalent
     # stride-1 4x4 conv with 12 input channels — 4x better MXU lane
@@ -47,10 +56,75 @@ RESNET_PRESETS = {
     "resnet50": ResNetConfig(stage_sizes=(3, 4, 6, 3)),
     "resnet50_s2d": ResNetConfig(stage_sizes=(3, 4, 6, 3),
                                  space_to_depth=True),
+    # s2d + subsampled BN statistics: the BN-traffic attack variant
+    # (bench.py --configs can pit it against the exact-stats baselines).
+    "resnet50_s2d_bnsub": ResNetConfig(stage_sizes=(3, 4, 6, 3),
+                                       space_to_depth=True,
+                                       bn_stats_stride=2),
     "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3)),
     "resnet_tiny": ResNetConfig(stage_sizes=(1, 1), num_filters=8,
                                 num_classes=10),
 }
+
+
+class SubsampledStatsBN(nn.Module):
+    """BatchNorm whose TRAIN statistics come from a spatially strided
+    subset of the activation (``x[:, ::s, ::s]``).
+
+    The normalize-apply is algebraically refolded to one fused
+    multiply-add (``x·w + b`` with w/b precomputed per channel in f32),
+    and the mean/var reduction — the HBM-bound part of BN on TPU — reads
+    only 1/s² of the tensor.  The batch dim is untouched, so dp/fsdp
+    sharding and the global-batch sync-BN semantics (GSPMD reduces the
+    sharded jnp.mean) are identical to ``nn.BatchNorm``.  Parameter and
+    running-stat names match ``nn.BatchNorm`` ("scale"/"bias",
+    "mean"/"var"), so checkpoints interchange between variants.
+
+    ``stats_stride=1`` degenerates to exact one-pass (E[x²]−E[x]²) BN;
+    the resnet builder still uses ``nn.BatchNorm`` there (flax's is the
+    reference implementation this one is parity-tested against).
+    """
+
+    use_running_average: bool
+    momentum: float
+    epsilon: float
+    dtype: object
+    stats_stride: int = 2
+    scale_init: object = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        import jax
+
+        feat = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,),
+                          jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32),
+                                (feat,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32), (feat,))
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            s = self.stats_stride
+            sub = x[:, ::s, ::s, :] if (x.ndim == 4 and s > 1) else x
+            sub = sub.astype(jnp.float32)
+            axes = tuple(range(sub.ndim - 1))
+            mean = jnp.mean(sub, axes)
+            # One-pass variance; clamped — subsampling can't make it
+            # negative, but f32 cancellation can.
+            var = jnp.maximum(
+                jnp.mean(jnp.square(sub), axes) - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        w = (scale * inv).astype(self.dtype)
+        b = (bias - mean * scale * inv).astype(self.dtype)
+        return x.astype(self.dtype) * w + b
 
 
 def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
@@ -98,6 +172,32 @@ def _conv(features, kernel, strides=1, name=None, padding="SAME"):
     )
 
 
+def _norm_factory(cfg: ResNetConfig, train: bool, dtype):
+    """The config's BN: flax's exact BatchNorm, or the strided-stats
+    variant (same variable names — checkpoints interchange).
+
+    Unnamed uses take flax's auto names for ``nn.BatchNorm``
+    ("BatchNorm_0", ...) whichever implementation is active, so the tree
+    structure is byte-compatible across ``bn_stats_stride`` settings.
+    """
+    if cfg.bn_stats_stride <= 1:
+        return partial(
+            nn.BatchNorm, use_running_average=not train,
+            momentum=cfg.bn_momentum, epsilon=cfg.bn_epsilon, dtype=dtype)
+    import itertools
+
+    counter = itertools.count()
+    base = partial(
+        SubsampledStatsBN, use_running_average=not train,
+        momentum=cfg.bn_momentum, epsilon=cfg.bn_epsilon,
+        dtype=dtype, stats_stride=cfg.bn_stats_stride)
+
+    def make(name: str = None, **kw):
+        return base(name=name or f"BatchNorm_{next(counter)}", **kw)
+
+    return make
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -105,11 +205,7 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool):
-        norm = partial(
-            nn.BatchNorm, use_running_average=not train,
-            momentum=self.config.bn_momentum, epsilon=self.config.bn_epsilon,
-            dtype=x.dtype,
-        )
+        norm = _norm_factory(self.config, train, x.dtype)
         residual = x
         y = _conv(self.filters, 1)(x)
         y = norm()(y)
@@ -134,9 +230,7 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         cfg = self.config
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=cfg.bn_momentum, epsilon=cfg.bn_epsilon,
-                       dtype=x.dtype)
+        norm = _norm_factory(cfg, train, x.dtype)
         if cfg.space_to_depth:
             if x.shape[-1] == 3:  # raw input: transform on the fly
                 x = space_to_depth(x)

@@ -68,7 +68,9 @@ def peak_tflops(device_kind: str) -> Optional[float]:
 
 def decoder_activation_bytes(num_layers: int, d_model: int, batch: int,
                              seq: int, *, remat: bool, causal: bool = True,
-                             score_heads: int = 1) -> int:
+                             score_heads: int = 1,
+                             ffn_size: Optional[int] = None,
+                             save_ffn_hiddens: bool = True) -> int:
     """Empirical activation working set of one train step, in bytes.
 
     ``batch``/``seq`` are PER-DEVICE extents (divide global dims by the
@@ -87,7 +89,14 @@ def decoder_activation_bytes(num_layers: int, d_model: int, batch: int,
     score_term = (6 * score_heads * batch * seq * seq * 2
                   // (2 if causal else 1))
     if not remat:
-        act += num_layers * batch * seq * d_model * 2 * 24
+        passes = 24
+        if not save_ffn_hiddens:
+            # remat_policy="no_ffn": the ~3 [B,S,ffn] hidden tensors are
+            # re-computed, not saved — subtract their d_model-equivalent
+            # passes (3·ffn/d; the SwiGLU default ffn≈2.67d gives 8).
+            ffn = ffn_size if ffn_size else int(8 * d_model / 3)
+            passes -= min(passes - 4, int(round(3 * ffn / d_model)))
+        act += num_layers * batch * seq * d_model * 2 * passes
         act += num_layers * score_term
     elif score_heads > 1:
         # Per-layer remat still rematerializes ONE layer's einsum-attention
@@ -99,7 +108,7 @@ def decoder_activation_bytes(num_layers: int, d_model: int, batch: int,
 
 
 def _model_dims(task):
-    """(num_layers, width, remat, causal, score_heads) from a task config.
+    """Activation-model inputs (a dict of dims/flags) from a task config.
 
     Decoder families (llama/moe) run the flash kernel (score_heads=1,
     causal); BERT runs the reference einsum attention (per-head scores,
@@ -127,9 +136,20 @@ def _model_dims(task):
             f"{type(cfg).__name__} lacks num_layers/d_model dims for the "
             "activation model")
     remat = bool(getattr(cfg, "remat", False))
+    # Policy-aware budgeting (mirrors bench_lm): "dots" saves the SwiGLU
+    # hiddens so it budgets as no-remat; "no_ffn" is no-remat MINUS the
+    # hiddens it re-computes.
+    remat_policy = getattr(cfg, "remat_policy", "full")
+    effective_remat = remat and remat_policy not in ("dots", "no_ffn")
+    save_ffn = not (remat and remat_policy == "no_ffn")
+    ffn = (getattr(cfg, "ffn_size", None)
+           or getattr(cfg, "intermediate_size", None))
     bidirectional = hasattr(cfg, "intermediate_size")  # BERT-shaped
     score_heads = cfg.num_heads if bidirectional else 1
-    return num_layers, width, remat, not bidirectional, score_heads
+    return {"num_layers": num_layers, "width": width,
+            "remat": effective_remat, "causal": not bidirectional,
+            "score_heads": score_heads, "ffn_size": ffn,
+            "save_ffn_hiddens": save_ffn}
 
 
 def plan_train_memory(task, sample_batch, tx, mesh, *,
@@ -158,7 +178,7 @@ def plan_train_memory(task, sample_batch, tx, mesh, *,
     policy = Policy() if policy is None else policy
     plan = plan_state_memory(task, sample_batch, tx, mesh, rules=rules,
                              policy=policy, zero1=zero1)
-    num_layers, width, remat, causal, score_heads = _model_dims(task)
+    dims = _model_dims(task)
     tokens = next(v for k, v in sorted(sample_batch.items())
                   if np.ndim(v) >= 2)
     global_batch, seq = np.shape(tokens)[:2]
@@ -169,8 +189,10 @@ def plan_train_memory(task, sample_batch, tx, mesh, *,
     per_dev_batch = max(1, global_batch // batch_shards)
     per_dev_seq = max(1, seq // seq_shards)
     act = decoder_activation_bytes(
-        num_layers, width, per_dev_batch, per_dev_seq, remat=remat,
-        causal=causal, score_heads=score_heads)
+        dims["num_layers"], dims["width"], per_dev_batch, per_dev_seq,
+        remat=dims["remat"], causal=dims["causal"],
+        score_heads=dims["score_heads"], ffn_size=dims["ffn_size"],
+        save_ffn_hiddens=dims["save_ffn_hiddens"])
     plan["activation_bytes_per_device"] = float(act)
     plan["step_bytes_per_device"] = plan["per_device_bytes"] + act
     if device_kind is not None:

@@ -4,6 +4,10 @@ Tiny variants exercise the full code path (attention, BN, scan, remat);
 param-count checks pin the full-size architectures without compiling them.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -70,9 +74,10 @@ class TestArchitectures:
                               jnp.zeros((1, 8), jnp.int32))
         assert n_loop == n_scan
 
-    def test_llama_remat_policy_matches_full(self):
-        """'dots' remat saves more, recomputes less — same math: loss AND
-        gradients must match full remat exactly."""
+    @pytest.mark.parametrize("policy", ["dots", "no_ffn"])
+    def test_llama_remat_policy_matches_full(self, policy):
+        """'dots'/'no_ffn' remat save more, recompute less — same math:
+        loss AND gradients must match full remat exactly."""
         import dataclasses
 
         import jax
@@ -88,9 +93,9 @@ class TestArchitectures:
             "targets": rng.integers(0, 256, (2, 32)).astype(np.int32),
         }
 
-        def loss_and_grad(policy):
+        def loss_and_grad(pol):
             cfg = dataclasses.replace(LLAMA_PRESETS["llama_tiny_scan"],
-                                      remat_policy=policy)
+                                      remat_policy=pol)
             task = CausalLmTask(cfg)
             variables = task.init_variables(jax.random.key(0), batch)
 
@@ -102,14 +107,14 @@ class TestArchitectures:
             return jax.value_and_grad(loss)(variables["params"])
 
         (l_full, g_full) = loss_and_grad("full")
-        (l_dots, g_dots) = loss_and_grad("dots")
-        np.testing.assert_allclose(float(l_full), float(l_dots), rtol=1e-6)
+        (l_p, g_p) = loss_and_grad(policy)
+        np.testing.assert_allclose(float(l_full), float(l_p), rtol=1e-6)
         # Gradients: recompute-vs-saved changes f32 reassociation, so
         # element-wise rounding differs; bound the relative tree error.
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_allclose(
                 a, b, rtol=5e-3, atol=1e-5),
-            g_full, g_dots)
+            g_full, g_p)
 
     def test_llama_remat_policy_unknown_rejected(self):
         import dataclasses
@@ -382,6 +387,18 @@ class TestActivationMemoryModel:
         assert est > self.V5E_BUDGET
         assert est > 0.7 * 26.4 * 2**30
 
+    def test_no_ffn_policy_sits_between_remat_and_no_remat(self):
+        from tensorflow_train_distributed_tpu.training.memory import (
+            decoder_activation_bytes,
+        )
+
+        kw = dict(num_layers=12, d_model=768, batch=16, seq=2048)
+        no_remat = decoder_activation_bytes(remat=False, **kw)
+        no_ffn = decoder_activation_bytes(remat=False, ffn_size=2048,
+                                          save_ffn_hiddens=False, **kw)
+        remat = decoder_activation_bytes(remat=True, **kw)
+        assert remat < no_ffn < no_remat
+
     def test_measured_point_1b_noremat_state_refused(self):
         # Measured: llama_1b state alone exceeds the chip.
         est = self._estimate("llama_1b", 16, 2048, remat=False)
@@ -612,3 +629,121 @@ def test_plan_train_memory_refuses_moe():
     with pytest.raises(ValueError, match="MoE"):
         plan_train_memory(moe.make_task(moe.MOE_PRESETS["moe_tiny"]), b,
                           optax.adamw(1e-5), mesh)
+
+
+class TestSubsampledStatsBN:
+    """The BN-traffic attack (PROFILE.md: BN statistics dominate the
+    ResNet step): strided-stats BN must be exact at stride 1, use the
+    subsampled statistics at stride 2, and interchange checkpoints with
+    the exact-BN presets."""
+
+    def _io(self, seed=0, shape=(4, 8, 8, 6)):
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal(shape, dtype=np.float32) * 2.0 + 0.5
+
+    def test_stride1_matches_flax_batchnorm(self):
+        import flax.linen as nn
+
+        from tensorflow_train_distributed_tpu.models.resnet import (
+            SubsampledStatsBN,
+        )
+
+        x = jnp.asarray(self._io())
+        ours = SubsampledStatsBN(use_running_average=False, momentum=0.9,
+                                 epsilon=1e-5, dtype=jnp.float32,
+                                 stats_stride=1)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5, dtype=jnp.float32)
+        v_ours = ours.init(jax.random.key(0), x)
+        v_ref = ref.init(jax.random.key(0), x)
+        y_ours, m_ours = ours.apply(v_ours, x, mutable=["batch_stats"])
+        y_ref, m_ref = ref.apply(v_ref, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_ours), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5),
+            m_ours["batch_stats"], m_ref["batch_stats"])
+
+    def test_stride2_uses_subsampled_statistics(self):
+        from tensorflow_train_distributed_tpu.models.resnet import (
+            SubsampledStatsBN,
+        )
+
+        x = jnp.asarray(self._io(1))
+        bn = SubsampledStatsBN(use_running_average=False, momentum=0.0,
+                               epsilon=0.0, dtype=jnp.float32,
+                               stats_stride=2)
+        v = bn.init(jax.random.key(0), x)
+        y, mut = bn.apply(v, x, mutable=["batch_stats"])
+        sub = np.asarray(x)[:, ::2, ::2, :].astype(np.float64)
+        mean = sub.mean((0, 1, 2))
+        var = (sub ** 2).mean((0, 1, 2)) - mean ** 2
+        # momentum=0 → running stats ARE this batch's stats.
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["mean"]), mean, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["var"]), var, rtol=1e-3)
+        # Normalize-apply uses those stats over the FULL tensor.
+        want = (np.asarray(x) - mean) / np.sqrt(var)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_eval_uses_running_stats(self):
+        from tensorflow_train_distributed_tpu.models.resnet import (
+            SubsampledStatsBN,
+        )
+
+        x = jnp.asarray(self._io(2))
+        bn = SubsampledStatsBN(use_running_average=True, momentum=0.9,
+                               epsilon=1e-5, dtype=jnp.float32)
+        v = bn.init(jax.random.key(0), x)
+        y = bn.apply(v, x)  # fresh stats: mean 0, var 1 → near-identity
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bnsub_preset_checkpoint_interchanges(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        cfg = dataclasses.replace(resnet.RESNET_PRESETS["resnet_tiny"])
+        cfg_sub = dataclasses.replace(cfg, bn_stats_stride=2)
+        x = jnp.zeros((1, 16, 16, 3))
+        v = resnet.ResNet(cfg).init(jax.random.key(0), x, train=False)
+        v_sub = resnet.ResNet(cfg_sub).init(jax.random.key(0), x,
+                                            train=False)
+        assert (jax.tree_util.tree_structure(v)
+                == jax.tree_util.tree_structure(v_sub))
+        # Exact-BN variables evaluate through the subsampled model.
+        y = resnet.ResNet(cfg_sub).apply(v, x, train=False)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_bnsub_resnet_trains(self, mesh8):
+        import dataclasses
+
+        import optax
+
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader, get_dataset,
+        )
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        cfg = dataclasses.replace(resnet.RESNET_PRESETS["resnet_tiny"],
+                                  bn_stats_stride=2)
+        loader = HostDataLoader(
+            get_dataset("imagenet", num_examples=64, num_classes=10,
+                        image_size=32),
+            DataConfig(global_batch_size=16))
+        trainer = Trainer(resnet.make_task(cfg, label_smoothing=0.0,
+                                           weight_decay=0.0),
+                          optax.adam(1e-3), mesh8,
+                          config=TrainerConfig(log_every=4),
+                          callbacks=[hist := History()])
+        state = trainer.fit(iter(loader), steps=8)
+        assert np.isfinite(hist.history["loss"]).all()
+        means = [np.asarray(x) for path, x in
+                 jax.tree_util.tree_leaves_with_path(
+                     state.model_state["batch_stats"])
+                 if path[-1].key == "mean"]
+        assert any(np.abs(m).max() > 0 for m in means)

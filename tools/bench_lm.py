@@ -56,7 +56,9 @@ def hbm_budget_bytes(device) -> float | None:
 def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
                      batch: int, seq: int, remat: bool, *,
                      causal: bool, force: bool, device,
-                     score_heads: int = 1) -> None:
+                     score_heads: int = 1,
+                     ffn_size: int | None = None,
+                     save_ffn_hiddens: bool = True) -> None:
     """Pre-flight HBM estimate — refuse configs that would OOM on-chip.
 
     An HBM-OOM *compile request* has twice killed this environment's
@@ -75,7 +77,9 @@ def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
     state = n_params * STATE_BYTES_PER_PARAM
     act = decoder_activation_bytes(n_layers, d_model, batch, seq,
                                    remat=remat, causal=causal,
-                                   score_heads=score_heads)
+                                   score_heads=score_heads,
+                                   ffn_size=ffn_size,
+                                   save_ffn_hiddens=save_ffn_hiddens)
     need = state + act
     # The estimate intentionally errs a little high (b16 no-remat: est 28
     # vs 26.4 GiB observed), so compare against the full budget: known-good
@@ -151,12 +155,15 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
          "targets": jnp.zeros((1, seq), jnp.int32)}))
     # remat_policy="dots" saves every matmul output — including the SwiGLU
     # hiddens that dominate the no-remat footprint — so for budgeting it
-    # is the no-remat estimate, not the full-remat one.
-    effective_remat = cfg.remat and cfg.remat_policy != "dots"
+    # is the no-remat estimate, not the full-remat one.  "no_ffn" is the
+    # no-remat estimate MINUS those hiddens (that's its whole point).
+    effective_remat = cfg.remat and cfg.remat_policy not in ("dots",
+                                                             "no_ffn")
     check_hbm_budget(
         param_count(abstract["params"]), cfg.num_layers, cfg.d_model,
         batch, seq, effective_remat, causal=True, force=force_hbm,
-        device=mesh.devices.flat[0])
+        device=mesh.devices.flat[0], ffn_size=cfg.ffn_size,
+        save_ffn_hiddens=not (cfg.remat and cfg.remat_policy == "no_ffn"))
     trainer = Trainer(
         task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1), mesh,
         policy=Policy.from_name("mixed_bfloat16"),
@@ -211,7 +218,7 @@ def main(argv=None) -> int:
     rm.add_argument("--no-remat", dest="remat", action="store_false",
                     help="disable remat (faster when memory allows)")
     p.add_argument("--remat-policy", default=None,
-                   choices=("full", "dots"),
+                   choices=("full", "dots", "no_ffn"),
                    help="what remat saves (see LlamaConfig.remat_policy)")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu' for a smoke run "
