@@ -347,8 +347,6 @@ class MlpBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
-        import jax
-
         from jax.ad_checkpoint import checkpoint_name
 
         d = x.shape[-1]

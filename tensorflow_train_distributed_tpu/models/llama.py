@@ -225,35 +225,46 @@ def _pipeline_mesh(cfg: LlamaConfig):
     return mesh
 
 
-def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh):
+def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh,
+                      segment_ids=None, positions=None):
     """Decoder stack as a GPipe schedule over the ``pipeline`` mesh axis.
 
     ``block_params`` is the nn.scan-stacked DecoderBlock tree (leading dim
     ``num_layers``, sharded over ``pipeline`` by the ``stage`` rule) — the
     SAME parameters the depth scan uses, so dp and dp_pp runs of one
     checkpoint are numerically identical.
+
+    Packed rows: segment ids / positions ride the pipeline carry WITH the
+    activation — at tick t, stage s is processing microbatch t−s, so
+    side inputs cannot be indexed by tick at later stages; shipping them
+    through the same ppermute hop keeps each microbatch's metadata
+    aligned with its activation (int [mb,S] hops are <1% of the [mb,S,D]
+    activation bytes at real widths).
     """
     from tensorflow_train_distributed_tpu.parallel.pipeline import (
         gpipe_layers,
     )
 
-    def layer_fn(p, h):
+    def layer_fn(p, carry):
+        h, seg, pos = carry
         # Inside shard_map every mesh axis is manual: logical sharding
         # constraints are meaningless there (and illegal to apply), so the
         # block runs under empty rules — pure per-shard compute.
         with nn.logical_axis_rules(()):
-            return DecoderBlock(cfg).apply({"params": p}, h)
+            h = DecoderBlock(cfg).apply({"params": p}, h, seg, pos)
+        return (h, seg, pos)
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False,
                                   policy=_checkpoint_policy(cfg))
     data_axes = tuple(a for a in ("data", "fsdp")
                       if mesh.shape.get(a, 1) > 1)
-    return gpipe_layers(
-        layer_fn, block_params, x, mesh=mesh,
+    out, _, _ = gpipe_layers(
+        layer_fn, block_params, (x, segment_ids, positions), mesh=mesh,
         num_microbatches=cfg.pipeline_microbatches,
         batch_axes=data_axes,
     )
+    return out
 
 
 class LlamaModel(nn.Module):
@@ -285,16 +296,13 @@ class LlamaModel(nn.Module):
                 "decode mode does not run under a pipeline mesh; generate "
                 "outside the pipeline strategy")
         if pp_mesh is not None:
-            if segment_ids is not None or positions is not None:
-                raise NotImplementedError(
-                    "packed segments / custom positions under the gpipe "
-                    "pipeline schedule are not supported yet; train packed "
-                    "data under dp/tp/fsdp meshes")
             # Params were created by the scan path (init always takes it);
             # read the stacked block tree and drive the pipeline schedule.
+            # Packed segment ids / positions ride the pipeline carry.
             block_params = (
                 self.variables["params"]["layers"]["stack"]["block"])
-            x = _pipelined_blocks(cfg, block_params, x, pp_mesh)
+            x = _pipelined_blocks(cfg, block_params, x, pp_mesh,
+                                  segment_ids, positions)
         elif cfg.scan_layers:
             x = _ScannedBlock(cfg, decode=self.decode,
                               cache_len=self.cache_len, name="layers")(

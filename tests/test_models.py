@@ -6,8 +6,6 @@ param-count checks pin the full-size architectures without compiling them.
 
 import pytest
 
-pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -149,6 +147,7 @@ def _train_config(name, steps=12, mesh=None, **overrides):
     return state, hist
 
 
+@pytest.mark.slow  # full fit loops per config family
 class TestTraining:
     def test_mnist_lenet_converges(self, mesh8):
         state, hist = _train_config("mnist", steps=30, mesh=mesh8,
@@ -572,6 +571,7 @@ def test_vision_top5_metric(mesh8):
                zip(hist.history["accuracy"], hist.history["top5_accuracy"]))
 
 
+@pytest.mark.slow  # forks a 16-device interpreter
 def test_7b_partitions_on_16dev_v5e16_subprocess():
     """The exact v5e-16 topology (fsdp=4 x tp=4): needs 16 virtual
     devices, which the session-scoped 8-device conftest can't provide —
@@ -719,6 +719,7 @@ class TestSubsampledStatsBN:
         y = resnet.ResNet(cfg_sub).apply(v, x, train=False)
         assert np.isfinite(np.asarray(y)).all()
 
+    @pytest.mark.slow
     def test_bnsub_resnet_trains(self, mesh8):
         import dataclasses
 
