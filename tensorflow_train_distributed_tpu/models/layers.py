@@ -230,10 +230,10 @@ class MultiHeadAttention(nn.Module):
             kh = jnp.repeat(kh, rep, axis=1)
             vh = jnp.repeat(vh, rep, axis=1)
         if sp_mesh is not None:
-            if mask is not None or segment_ids is not None:
+            if mask is not None:
                 raise ValueError(
-                    "seq_parallel attention supports causal/full, not dense "
-                    "masks or packed segments")
+                    "seq_parallel attention supports causal/full (+ packed "
+                    "segment_ids), not dense masks")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
@@ -241,7 +241,7 @@ class MultiHeadAttention(nn.Module):
 
             out = shard_mapped_attention(
                 sp_mesh, qh, kh, vh, method=self.seq_parallel,
-                causal=self.causal,
+                causal=self.causal, segment_ids=segment_ids,
             ).transpose(0, 2, 1, 3)
         else:
             out = multihead_attention_kernel(

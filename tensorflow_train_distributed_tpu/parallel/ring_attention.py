@@ -58,9 +58,16 @@ def ring_attention(
     axis: str = "seq",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-shard ring attention.  q: [B, H, Sq, D]; k/v: [B, Hkv, Sk, D]
-    (Hkv may divide H — GQA), all sharded on ``axis``."""
+    (Hkv may divide H — GQA), all sharded on ``axis``.
+
+    ``segment_ids`` [B, Sq] (this shard's slice, same seq sharding as q)
+    restricts attention to same-segment pairs — packed long-context rows:
+    the KV shard's segment ids rotate around the ring WITH the k/v blocks
+    so every hop masks against the correct metadata.
+    """
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, h, sq, d = q.shape
@@ -68,7 +75,7 @@ def ring_attention(
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     q32 = q.astype(jnp.float32) * scale
 
-    def attend_block(carry_olm, k_blk, v_blk, kv_idx):
+    def attend_block(carry_olm, k_blk, v_blk, kv_idx, kv_seg):
         o, m, l = carry_olm
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
                        _repeat_kv(k_blk, h).astype(jnp.float32))
@@ -78,6 +85,10 @@ def ring_attention(
             block_mask = (q_pos >= k_pos)[None, None]
         else:
             block_mask = jnp.ones((1, 1, sq, sk), bool)
+        if kv_seg is not None:
+            # [B,1,Sq,Sk] segment mask; & broadcasts the positional mask.
+            block_mask = block_mask & (
+                segment_ids[:, :, None] == kv_seg[:, None, :])[:, None]
         s = jnp.where(block_mask, s, _NEG)
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
         # Mask again on p: a fully-masked block must contribute exactly 0
@@ -94,19 +105,24 @@ def ring_attention(
     m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
     # Local block first (no rotation), then n-1 rotate-and-attend hops —
-    # the discarded n-th rotation would be pure wasted ICI traffic.
-    olm = attend_block((o0, m0, l0), k, v, idx)
+    # the discarded n-th rotation would be pure wasted ICI traffic.  The
+    # KV shard's segment ids ride the carry ONLY when packing is active:
+    # the unpacked path must not pay an extra ppermute per hop.
+    olm = attend_block((o0, m0, l0), k, v, idx, segment_ids)
 
     def body(carry, step):
-        olm, k_blk, v_blk = carry
+        olm, k_blk, v_blk, seg_blk = carry
         k_nxt = ring_permute(k_blk, axis, shift=1)
         v_nxt = ring_permute(v_blk, axis, shift=1)
+        seg_nxt = (None if seg_blk is None
+                   else ring_permute(seg_blk, axis, shift=1))
         kv_idx = (idx - step - 1) % n
-        olm = attend_block(olm, k_nxt, v_nxt, kv_idx)
-        return (olm, k_nxt, v_nxt), None
+        olm = attend_block(olm, k_nxt, v_nxt, kv_idx, seg_nxt)
+        return (olm, k_nxt, v_nxt, seg_nxt), None
 
     if n > 1:
-        (olm, _, _), _ = jax.lax.scan(body, (olm, k, v), jnp.arange(n - 1))
+        (olm, _, _, _), _ = jax.lax.scan(
+            body, (olm, k, v, segment_ids), jnp.arange(n - 1))
     o, _, l = olm
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
@@ -119,10 +135,17 @@ def ulysses_attention(
     axis: str = "seq",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-shard Ulysses attention.  q: [B, H, S_local, D]; k/v may carry
     fewer (GQA) heads.  Requires H % axis_size == 0.  Local attention uses
-    the shared kernel dispatch, so the pallas flash path applies on TPU."""
+    the shared kernel dispatch, so the pallas flash path applies on TPU.
+
+    ``segment_ids`` [B, S_local]: after the a2a each shard attends over
+    the FULL sequence, so the ids are all-gathered along ``axis`` (int
+    [B,S] — negligible next to the a2a'd activations) and handed to the
+    kernel's native segment masking (pallas ``SegmentIds`` on TPU).
+    """
     from tensorflow_train_distributed_tpu.ops.attention import (
         multihead_attention_kernel,
     )
@@ -145,9 +168,12 @@ def ulysses_attention(
         return all_to_all(x, axis, split_dim=2, concat_dim=1)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    full_seg = (None if segment_ids is None else jax.lax.all_gather(
+        segment_ids, axis, axis=1, tiled=True))
     out = multihead_attention_kernel(
         qg, _repeat_kv(kg, qg.shape[1]), _repeat_kv(vg, qg.shape[1]),
         causal=causal, softmax_scale=softmax_scale,
+        segment_ids=full_seg,
     )
     return heads_to_seq(out.astype(q.dtype))
 
@@ -162,18 +188,30 @@ def shard_mapped_attention(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     axis: str = "seq",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Global-array entry point: q/k/v [B, H, S, D] with S sharded on
-    ``axis``, batch on (data, fsdp), heads on tensor — SP × DP × TP."""
+    ``axis``, batch on (data, fsdp), heads on tensor — SP × DP × TP.
+    ``segment_ids`` [B, S] (packed rows) shards with the sequence."""
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[method]
     batch_dims = tuple(a for a in ("data", "fsdp")
                        if mesh.shape.get(a, 1) > 1) or None
     head_dim = "tensor" if mesh.shape.get("tensor", 1) > 1 else None
     spec = P(batch_dims, head_dim, axis, None)
+    args = [q, k, v]
+    in_specs = [spec, spec, spec]
+    if segment_ids is not None:
+        args.append(segment_ids)
+        in_specs.append(P(batch_dims, axis))
+
+    def per_shard(q_, k_, v_, seg_=None):
+        return fn(q_, k_, v_, axis=axis, causal=causal,
+                  softmax_scale=softmax_scale, segment_ids=seg_)
+
     return shard_map(
-        partial(fn, axis=axis, causal=causal, softmax_scale=softmax_scale),
+        per_shard,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(*args)
