@@ -70,6 +70,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-schedule", default=None,
                    help="constant | warmup_cosine | warmup_linear | noam | "
                         "resnet_steps (default: the config's convention)")
+    p.add_argument("--reduce-lr-factor", type=float, default=None,
+                   help="enable ReduceLROnPlateau: multiply the LR by "
+                        "this factor (0<f<1) when the monitored metric "
+                        "plateaus (monitors val_loss when --eval-steps "
+                        "is set, else loss); requires a constant LR "
+                        "schedule")
+    p.add_argument("--reduce-lr-patience", type=int, default=10,
+                   help="plateau events before each reduction")
+    p.add_argument("--reduce-lr-min", type=float, default=0.0,
+                   help="LR floor for ReduceLROnPlateau")
+    p.add_argument("--reduce-lr-cooldown", type=int, default=0,
+                   help="events to skip after a reduction")
     p.add_argument("--precision", "--mixed-precision", dest="precision",
                    default="bfloat16",
                    help="dtype policy: float32 | bfloat16 | float16 "
@@ -207,24 +219,43 @@ def _make_optimizer(args, entry):
         warmup = int(entry.get("warmup_ratio", 0.0) * args.steps)
     name = args.lr_schedule or entry.get("lr_schedule", "constant")
     lr = schedules.by_name(name, peak, args.steps, warmup_steps=warmup)
+    wrap = False
+    if getattr(args, "reduce_lr_factor", None) is not None:
+        # ReduceLROnPlateau needs the LR to live in optimizer STATE, not
+        # baked into a schedule closure: inject_hyperparams puts it
+        # there, and the callback rewrites it functionally between steps.
+        if name != "constant" or warmup:
+            raise SystemExit(
+                "--reduce-lr-factor needs a constant LR (no schedule/"
+                f"warmup): got schedule={name!r}, warmup={warmup} — a "
+                "schedule and metric-driven reduction would fight over "
+                "the same knob")
+        wrap, lr = True, peak
+
+    def build(fn, **kw):
+        if wrap:
+            # kwargs only: inject_hyperparams injects keyword args.
+            return optax.inject_hyperparams(fn)(learning_rate=lr, **kw)
+        return fn(lr, **kw)
+
     if args.optimizer == "sgd":
-        tx = optax.sgd(lr)
+        tx = build(optax.sgd)
     elif args.optimizer == "momentum":
-        tx = optax.sgd(lr, momentum=0.9, nesterov=True)
+        tx = build(optax.sgd, momentum=0.9, nesterov=True)
     elif args.optimizer == "adam":
-        tx = optax.adam(lr)
+        tx = build(optax.adam)
     elif args.optimizer == "lamb":
         # BERT large-batch convention (the reference's PS-pretrain config
         # scaled with LAMB); layerwise trust ratios make the global batch
         # scalable far past Adam's stability range.
-        tx = optax.lamb(lr, weight_decay=args.weight_decay)
+        tx = build(optax.lamb, weight_decay=args.weight_decay)
     elif args.optimizer == "adafactor":
         # Memory-frugal second-moment factorization — the optimizer of
         # choice when optimizer state must not double 7B-param HBM use.
-        tx = optax.adafactor(
-            lr, weight_decay_rate=args.weight_decay or None)
+        tx = build(optax.adafactor,
+                   weight_decay_rate=args.weight_decay or None)
     else:
-        tx = optax.adamw(lr, weight_decay=args.weight_decay)
+        tx = build(optax.adamw, weight_decay=args.weight_decay)
     clip = args.grad_clip_norm
     if clip is None:
         clip = entry.get("grad_clip_norm")
@@ -237,7 +268,9 @@ def _make_optimizer(args, entry):
         # Trainer unscales before tx), so the clip norm means the same
         # thing at any loss-scale or batch size.
         tx = optax.chain(optax.clip_by_global_norm(clip), tx)
-    return tx, lr
+    # Under ReduceLROnPlateau the LR is optimizer STATE, not a schedule —
+    # there is no step->lr function for the observational metric.
+    return tx, (None if wrap else lr)
 
 
 def _bleu_eval(args, task, state, loader) -> float:
@@ -304,6 +337,24 @@ def run(args: argparse.Namespace) -> RunResult:
     # (checkpoint restore, HF import, mesh build) — fail now.
     if args.eval_only and args.eval_steps <= 0:
         raise SystemExit("--eval-only needs --eval-steps N (>0)")
+    if args.reduce_lr_factor is not None:
+        if not 0.0 < args.reduce_lr_factor < 1.0:
+            raise SystemExit(
+                f"--reduce-lr-factor must be in (0, 1), got "
+                f"{args.reduce_lr_factor}")
+        from tensorflow_train_distributed_tpu.models import registry as _reg
+
+        _entry = _reg.get_entry(args.config)
+        _name = args.lr_schedule or _entry.get("lr_schedule", "constant")
+        _warm = args.warmup_steps
+        if _warm is None:
+            _warm = int(_entry.get("warmup_ratio", 0.0) * args.steps)
+        if _name != "constant" or _warm:
+            raise SystemExit(
+                "--reduce-lr-factor needs a constant LR (no schedule/"
+                f"warmup): got schedule={_name!r}, warmup={_warm} — a "
+                "schedule and metric-driven reduction would fight over "
+                "the same knob")
 
     if args.platform or args.cpu_devices:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -479,6 +530,22 @@ def run(args: argparse.Namespace) -> RunResult:
                 f"{type(task).__name__} does not decode")
     policy = Policy.from_name(args.precision)
     callbacks = [History(), ProgressLogger(examples_per_step=global_batch)]
+    if args.reduce_lr_factor is not None:
+        from tensorflow_train_distributed_tpu.training import (
+            ReduceLROnPlateau,
+        )
+
+        # val_loss only reaches step events when PERIODIC eval runs
+        # during fit (--eval-every); --eval-steps alone evaluates after
+        # training, when reductions can no longer act.
+        monitor = ("val_loss"
+                   if args.eval_every and args.eval_steps > 0 else "loss")
+        callbacks.append(ReduceLROnPlateau(
+            monitor=monitor,
+            factor=args.reduce_lr_factor,
+            patience=args.reduce_lr_patience,
+            min_lr=args.reduce_lr_min,
+            cooldown=args.reduce_lr_cooldown))
     if args.tensorboard_dir:
         callbacks.append(TensorBoardScalars(args.tensorboard_dir))
     if args.jsonl_log:

@@ -31,6 +31,7 @@ from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     History,
     JsonlLogger,
     ProgressLogger,
+    ReduceLROnPlateau,
     StallWatchdog,
     TensorBoardScalars,
     TerminateOnNaN,

@@ -43,6 +43,17 @@ class Callback:
     def on_eval_end(self):
         pass
 
+    def transform_state(self, state):
+        """Return a replacement TrainState, or None to leave it alone.
+
+        Called between jitted steps after metric/eval dispatch — the ONE
+        sanctioned seam for callbacks that must mutate training state
+        (dynamic LR, hyperparameter schedules keyed on metrics).  The
+        replacement must preserve tree structure, shapes and shardings;
+        the next step runs on it unchanged (no recompile: same avals).
+        """
+        return None
+
     def on_train_end(self, state):
         pass
 
@@ -77,6 +88,16 @@ class CallbackList:
     def eval_end(self):
         for c in self.callbacks:
             c.on_eval_end()
+
+    def apply_state_transforms(self, state):
+        # getattr: callbacks are duck-typed (PreemptionCheckpointCallback
+        # and user callbacks need not subclass Callback).
+        for c in self.callbacks:
+            fn = getattr(c, "transform_state", None)
+            out = fn(state) if fn is not None else None
+            if out is not None:
+                state = out
+        return state
 
     def train_end(self, state):
         for c in self.callbacks:
@@ -208,6 +229,134 @@ class EarlyStopping(Callback):
             logger.info("EarlyStopping: %s plateaued at %s", self.monitor,
                         self.best)
             return True
+
+
+def set_injected_hyperparam(opt_state, name: str, value):
+    """Functionally set an ``optax.inject_hyperparams`` hyperparameter.
+
+    Walks the (possibly chained/nested) optimizer state for
+    ``InjectHyperparamsState``-shaped nodes whose ``hyperparams`` dict
+    carries ``name`` and rewrites the entry, preserving dtype and
+    sharding (replicated scalar).  Returns ``(new_opt_state, n_set)`` —
+    callers decide whether ``n_set == 0`` is an error.
+    """
+    import jax.numpy as jnp
+
+    n_set = 0
+
+    def rec(node):
+        nonlocal n_set
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict) and name in hp:
+            n_set += 1
+            old = hp[name]
+            new = jnp.asarray(value, dtype=old.dtype)
+            if isinstance(old, jax.Array) and hasattr(old, "sharding"):
+                new = jax.device_put(new, old.sharding)
+            return node._replace(hyperparams={**hp, name: new})
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*(rec(getattr(node, f))
+                                for f in node._fields))
+        if isinstance(node, (tuple, list)):
+            return type(node)(rec(x) for x in node)
+        return node
+
+    return rec(opt_state), n_set
+
+
+def get_injected_hyperparam(opt_state, name: str):
+    """First ``inject_hyperparams`` entry named ``name``, or None."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if isinstance(hp, dict) and name in hp:
+        return hp[name]
+    if isinstance(opt_state, tuple):
+        fields = (getattr(opt_state, f) for f in opt_state._fields) \
+            if hasattr(opt_state, "_fields") else iter(opt_state)
+        for sub in fields:
+            found = get_injected_hyperparam(sub, name)
+            if found is not None:
+                return found
+    return None
+
+
+class ReduceLROnPlateau(Callback):
+    """Drop the learning rate when ``monitor`` stops improving (Keras
+    ``ReduceLROnPlateau`` analog, ``tf_keras/src/callbacks.py:2915``).
+
+    Needs the optimizer built with ``optax.inject_hyperparams`` so the
+    LR lives in optimizer STATE (the CLI's ``--reduce-lr-factor`` does
+    this); the reduction is then a functional state rewrite through the
+    ``transform_state`` seam — no recompile, checkpoint/resume carries
+    the reduced LR automatically because it IS state.
+    """
+
+    def __init__(self, monitor: str = "val_loss", factor: float = 0.1,
+                 patience: int = 10, min_delta: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0,
+                 mode: str = "min"):
+        if not 0.0 < factor < 1.0:
+            raise ValueError(f"factor must be in (0, 1), got {factor}")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.min_delta, self.cooldown = min_delta, cooldown
+        self.min_lr, self.mode = min_lr, mode
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.cooldown_left = 0
+        # COUNT, not flag: step events flush in log_every windows, so
+        # several patience expirations can precede one transform_state —
+        # each must apply its factor.
+        self._reductions_pending = 0
+
+    def on_train_begin(self, state):
+        if get_injected_hyperparam(state.opt_state,
+                                   "learning_rate") is None:
+            raise ValueError(
+                "ReduceLROnPlateau needs the optimizer wrapped with "
+                "optax.inject_hyperparams(...)(learning_rate=...) so the "
+                "LR lives in optimizer state (CLI: --reduce-lr-factor "
+                "builds it that way); none found in opt_state")
+
+    def on_step_end(self, step, metrics):
+        if self.monitor not in metrics:
+            return
+        cur = float(metrics[self.monitor])
+        better = (
+            self.best is None
+            or (self.mode == "min" and cur < self.best - self.min_delta)
+            or (self.mode == "max" and cur > self.best + self.min_delta)
+        )
+        if self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            self.wait = 0
+        if better:
+            self.best, self.wait = cur, 0
+            return
+        if self.cooldown_left > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            self._reductions_pending += 1
+            self.wait = 0
+            self.cooldown_left = self.cooldown
+
+    def transform_state(self, state):
+        if not self._reductions_pending:
+            return None
+        pending, self._reductions_pending = self._reductions_pending, 0
+        old = get_injected_hyperparam(state.opt_state, "learning_rate")
+        new_lr = max(float(old) * self.factor**pending, self.min_lr)
+        if new_lr >= float(old):
+            return None  # already at the floor
+        new_opt, n_set = set_injected_hyperparam(state.opt_state,
+                                                 "learning_rate", new_lr)
+        if n_set == 0:  # guarded at train_begin; belt and braces
+            return None
+        logger.info("ReduceLROnPlateau: %s plateaued (best %.5g) — lr "
+                    "%.3g → %.3g", self.monitor, self.best, float(old),
+                    new_lr)
+        return state.replace(opt_state=new_opt)
 
 
 class TerminateOnNaN(Callback):

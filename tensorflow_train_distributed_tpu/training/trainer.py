@@ -400,6 +400,17 @@ class Trainer:
         if self.lr_schedule is not None:
             metrics["lr"] = jnp.asarray(self.lr_schedule(state.step),
                                         jnp.float32)
+        else:
+            # Dynamic LR (inject_hyperparams + ReduceLROnPlateau): the LR
+            # lives in optimizer state — surface it so TensorBoard/JSONL
+            # keep an lr series exactly when it starts moving.
+            from tensorflow_train_distributed_tpu.training.callbacks import (
+                get_injected_hyperparam,
+            )
+
+            inj = get_injected_hyperparam(state.opt_state, "learning_rate")
+            if inj is not None:
+                metrics["lr"] = jnp.asarray(inj, jnp.float32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -625,6 +636,10 @@ class Trainer:
                        and done >= (epoch + 1) * steps_per_epoch):
                     epoch += 1
                     stop |= self.callbacks.epoch_end(epoch, last_metrics)
+                # The sanctioned state-mutation seam (dynamic LR et al.):
+                # runs between jitted steps, after this window's metrics
+                # and val_* events reached the callbacks.
+                state = self.callbacks.apply_state_transforms(state)
                 if will_ckpt and not stop and not self.state_poisoned:
                     self.checkpoint_manager.save(cur, state)
                 state_box[0] = state

@@ -596,3 +596,116 @@ class TestEvalPartialBatch:
         assert out["loss"] == pytest.approx(float(loss), rel=2e-5)
         assert out["accuracy"] == pytest.approx(
             float(metrics["accuracy"]), rel=2e-5)
+
+
+class TestReduceLROnPlateau:
+    """Metric-driven LR reduction through the transform_state seam."""
+
+    def _trainer(self, mesh, **cb_kw):
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            ReduceLROnPlateau, get_injected_hyperparam,
+        )
+
+        tx = optax.inject_hyperparams(optax.adam)(learning_rate=1e-2)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               min_delta=10.0, **cb_kw)  # huge delta:
+        # nothing ever counts as improvement → reductions fire on
+        # schedule, deterministically.
+        trainer = Trainer(_BlobsTask(), tx, mesh,
+                          config=TrainerConfig(log_every=1),
+                          callbacks=[cb])
+        return trainer, cb, get_injected_hyperparam
+
+    def test_lr_reduces_in_state_and_training_continues(self, mesh8):
+        trainer, cb, get_hp = self._trainer(mesh8)
+        state = trainer.fit(_loader(), steps=7)
+        lr = float(get_hp(state.opt_state, "learning_rate"))
+        # patience=2, log_every=1, 7 steps → 3 reductions: 1e-2 * 0.5^3.
+        assert lr == pytest.approx(1e-2 * 0.5**3, rel=1e-5)
+
+    def test_min_lr_floor(self, mesh8):
+        trainer, cb, get_hp = self._trainer(mesh8, min_lr=4e-3)
+        state = trainer.fit(_loader(), steps=7)
+        lr = float(get_hp(state.opt_state, "learning_rate"))
+        assert lr == pytest.approx(4e-3, rel=1e-6)
+
+    def test_cooldown_spaces_reductions(self, mesh8):
+        trainer, cb, get_hp = self._trainer(mesh8, cooldown=3)
+        state = trainer.fit(_loader(), steps=7)
+        lr = float(get_hp(state.opt_state, "learning_rate"))
+        # patience 2 → reduce at step 2; cooldown 3 absorbs steps 3-5,
+        # wait rebuilds at 6,7 → exactly 2 reductions in 7 steps.
+        assert lr == pytest.approx(1e-2 * 0.5**2, rel=1e-5)
+
+    def test_requires_injected_hyperparams(self, mesh8):
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            ReduceLROnPlateau,
+        )
+
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=1),
+                          callbacks=[ReduceLROnPlateau(monitor="loss")])
+        with pytest.raises(ValueError, match="inject_hyperparams"):
+            trainer.fit(_loader(), steps=2)
+
+    def test_cli_reduce_lr_flag(self, tmp_path):
+        from tensorflow_train_distributed_tpu import launch
+
+        result = launch.run(launch.build_parser().parse_args([
+            "--config", "mnist", "--steps", "6", "--log-every", "1",
+            "--reduce-lr-factor", "0.5", "--reduce-lr-patience", "2",
+            "--global-batch-size", "16"]))
+        assert np.isfinite(result.history["loss"]).all()
+
+    def test_cli_rejects_schedule_conflict(self):
+        from tensorflow_train_distributed_tpu import launch
+
+        with pytest.raises(SystemExit, match="constant"):
+            launch.run(launch.build_parser().parse_args([
+                "--config", "mnist", "--steps", "4",
+                "--reduce-lr-factor", "0.5",
+                "--lr-schedule", "warmup_cosine"]))
+
+    def test_multiple_reductions_per_flush_window(self, mesh8):
+        """patience expirations inside one log_every window each apply
+        their factor (pending is a count, not a flag)."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            ReduceLROnPlateau, get_injected_hyperparam,
+        )
+
+        tx = optax.inject_hyperparams(optax.adam)(learning_rate=1e-2)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               min_delta=10.0)
+        trainer = Trainer(_BlobsTask(), tx, mesh8,
+                          config=TrainerConfig(log_every=3),
+                          callbacks=[cb])
+        state = trainer.fit(_loader(), steps=6)
+        lr = float(get_injected_hyperparam(state.opt_state,
+                                           "learning_rate"))
+        # Event 1 establishes the baseline; events 2-6 each expire
+        # patience=1 → five reductions across two flush windows.
+        assert lr == pytest.approx(1e-2 * 0.5**5, rel=1e-5)
+
+    def test_dynamic_lr_visible_in_metrics(self, mesh8):
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            ReduceLROnPlateau,
+        )
+
+        tx = optax.inject_hyperparams(optax.adam)(learning_rate=1e-2)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               min_delta=10.0)
+        trainer = Trainer(_BlobsTask(), tx, mesh8,
+                          config=TrainerConfig(log_every=1),
+                          callbacks=[cb, hist := History()])
+        trainer.fit(_loader(), steps=5)
+        lrs = hist.history["lr"]
+        assert lrs[0] == pytest.approx(1e-2, rel=1e-5)
+        assert lrs[-1] < lrs[0]  # reductions visible in the series
