@@ -73,9 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reduce-lr-factor", type=float, default=None,
                    help="enable ReduceLROnPlateau: multiply the LR by "
                         "this factor (0<f<1) when the monitored metric "
-                        "plateaus (monitors val_loss when --eval-steps "
-                        "is set, else loss); requires a constant LR "
-                        "schedule")
+                        "plateaus (monitors val_loss when periodic eval "
+                        "runs — --eval-every with --eval-steps — else "
+                        "loss); requires a constant LR schedule")
     p.add_argument("--reduce-lr-patience", type=int, default=10,
                    help="plateau events before each reduction")
     p.add_argument("--reduce-lr-min", type=float, default=0.0,
@@ -149,6 +149,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "monitoring only")
     # Checkpointing (reference: ModelCheckpoint + BackupAndRestore).
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--save-best", action="store_true",
+                   help="also keep the best-metric checkpoint under "
+                        "<checkpoint-dir>/best (Keras ModelCheckpoint "
+                        "save_best_only analog; monitors val_loss when "
+                        "periodic eval runs, else loss)")
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--max-to-keep", type=int, default=3)
     p.add_argument("--no-resume", action="store_true",
@@ -205,6 +210,27 @@ def _parse_mesh_overrides(spec: str) -> dict[str, int]:
     return sizes
 
 
+def _resolve_schedule(args, entry):
+    """(schedule_name, warmup_steps) from flags + config conventions —
+    the ONE place this defaulting lives (validation and the optimizer
+    builder must agree)."""
+    name = args.lr_schedule or entry.get("lr_schedule", "constant")
+    warmup = args.warmup_steps
+    if warmup is None:
+        warmup = int(entry.get("warmup_ratio", 0.0) * args.steps)
+    return name, warmup
+
+
+def _validate_constant_lr(args, entry):
+    name, warmup = _resolve_schedule(args, entry)
+    if name != "constant" or warmup:
+        raise SystemExit(
+            "--reduce-lr-factor needs a constant LR (no schedule/"
+            f"warmup): got schedule={name!r}, warmup={warmup} — a "
+            "schedule and metric-driven reduction would fight over "
+            "the same knob")
+
+
 def _make_optimizer(args, entry):
     """(optimizer, lr_schedule) from flags + the config's LR convention."""
     import optax
@@ -214,22 +240,14 @@ def _make_optimizer(args, entry):
     peak = args.learning_rate
     if peak is None:
         peak = entry["learning_rate"]
-    warmup = args.warmup_steps
-    if warmup is None:
-        warmup = int(entry.get("warmup_ratio", 0.0) * args.steps)
-    name = args.lr_schedule or entry.get("lr_schedule", "constant")
+    name, warmup = _resolve_schedule(args, entry)
     lr = schedules.by_name(name, peak, args.steps, warmup_steps=warmup)
     wrap = False
     if getattr(args, "reduce_lr_factor", None) is not None:
         # ReduceLROnPlateau needs the LR to live in optimizer STATE, not
         # baked into a schedule closure: inject_hyperparams puts it
         # there, and the callback rewrites it functionally between steps.
-        if name != "constant" or warmup:
-            raise SystemExit(
-                "--reduce-lr-factor needs a constant LR (no schedule/"
-                f"warmup): got schedule={name!r}, warmup={warmup} — a "
-                "schedule and metric-driven reduction would fight over "
-                "the same knob")
+        _validate_constant_lr(args, entry)  # run() checks early; re-check
         wrap, lr = True, peak
 
     def build(fn, **kw):
@@ -337,6 +355,8 @@ def run(args: argparse.Namespace) -> RunResult:
     # (checkpoint restore, HF import, mesh build) — fail now.
     if args.eval_only and args.eval_steps <= 0:
         raise SystemExit("--eval-only needs --eval-steps N (>0)")
+    if args.save_best and not args.checkpoint_dir:
+        raise SystemExit("--save-best needs --checkpoint-dir")
     if args.reduce_lr_factor is not None:
         if not 0.0 < args.reduce_lr_factor < 1.0:
             raise SystemExit(
@@ -344,17 +364,7 @@ def run(args: argparse.Namespace) -> RunResult:
                 f"{args.reduce_lr_factor}")
         from tensorflow_train_distributed_tpu.models import registry as _reg
 
-        _entry = _reg.get_entry(args.config)
-        _name = args.lr_schedule or _entry.get("lr_schedule", "constant")
-        _warm = args.warmup_steps
-        if _warm is None:
-            _warm = int(_entry.get("warmup_ratio", 0.0) * args.steps)
-        if _name != "constant" or _warm:
-            raise SystemExit(
-                "--reduce-lr-factor needs a constant LR (no schedule/"
-                f"warmup): got schedule={_name!r}, warmup={_warm} — a "
-                "schedule and metric-driven reduction would fight over "
-                "the same knob")
+        _validate_constant_lr(args, _reg.get_entry(args.config))
 
     if args.platform or args.cpu_devices:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -530,16 +540,17 @@ def run(args: argparse.Namespace) -> RunResult:
                 f"{type(task).__name__} does not decode")
     policy = Policy.from_name(args.precision)
     callbacks = [History(), ProgressLogger(examples_per_step=global_batch)]
+    # val_loss only reaches step events when PERIODIC eval runs during
+    # fit (--eval-every); --eval-steps alone evaluates after training.
+    # Shared by ReduceLROnPlateau and BestCheckpoint — the pair must
+    # watch the same signal to behave coherently.
+    monitor = ("val_loss"
+               if args.eval_every and args.eval_steps > 0 else "loss")
     if args.reduce_lr_factor is not None:
         from tensorflow_train_distributed_tpu.training import (
             ReduceLROnPlateau,
         )
 
-        # val_loss only reaches step events when PERIODIC eval runs
-        # during fit (--eval-every); --eval-steps alone evaluates after
-        # training, when reductions can no longer act.
-        monitor = ("val_loss"
-                   if args.eval_every and args.eval_steps > 0 else "loss")
         callbacks.append(ReduceLROnPlateau(
             monitor=monitor,
             factor=args.reduce_lr_factor,
@@ -573,6 +584,16 @@ def run(args: argparse.Namespace) -> RunResult:
     if args.checkpoint_dir:
         ckpt = CheckpointManager(
             args.checkpoint_dir, max_to_keep=args.max_to_keep)
+        if args.save_best:
+            import os as _os
+
+            from tensorflow_train_distributed_tpu.training.callbacks import (
+                BestCheckpoint,
+            )
+
+            callbacks.append(BestCheckpoint(
+                _os.path.join(args.checkpoint_dir, "best"),
+                monitor=monitor))
         if not args.no_preemption_handler:
             from tensorflow_train_distributed_tpu.runtime.preemption import (
                 PreemptionCheckpointCallback, PreemptionWatcher,

@@ -26,6 +26,7 @@ from tensorflow_train_distributed_tpu.training.memory import (  # noqa: F401
     plan_train_memory,
 )
 from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
+    BestCheckpoint,
     Callback,
     EarlyStopping,
     History,

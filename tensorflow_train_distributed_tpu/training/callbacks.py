@@ -359,6 +359,72 @@ class ReduceLROnPlateau(Callback):
         return state.replace(opt_state=new_opt)
 
 
+class BestCheckpoint(Callback):
+    """Keep the best-``monitor`` checkpoint (Keras ``ModelCheckpoint``
+    ``save_best_only=True`` analog, ``tf_keras/src/callbacks.py:1233``).
+
+    Saves into its OWN directory (default ``<dir>/best``), separate from
+    the trainer's periodic keep-N manager: rolling saves must never evict
+    the best state, and the best save must never count against keep-N.
+
+    Save timing: step metrics flush in ``log_every`` windows AFTER the
+    window's last step executed — earlier states no longer exist (the
+    step donates them).  So only the window's LAST metric event is a save
+    candidate (its step IS the live state's step), saved through the
+    ``transform_state`` seam where the current state is authoritative.
+    "Best" therefore means best among flush boundaries; run with
+    ``log_every=1`` (or monitor ``val_*`` events, which always carry the
+    evaluated state) for per-step granularity.
+    """
+
+    def __init__(self, directory: str, monitor: str = "val_loss",
+                 mode: str = "min", min_delta: float = 0.0):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        self.monitor, self.mode, self.min_delta = monitor, mode, min_delta
+        self.best: Optional[float] = None
+        self.best_step: Optional[int] = None
+        self._candidate: Optional[float] = None
+        self._mgr = CheckpointManager(directory, max_to_keep=1)
+
+    def on_step_end(self, step, metrics):
+        if self.monitor in metrics:
+            # Last writer wins: within one flush window only the final
+            # event's metric belongs to a state that still exists.
+            self._candidate = float(metrics[self.monitor])
+
+    def transform_state(self, state):
+        if self._candidate is None:
+            return None
+        cur, self._candidate = self._candidate, None
+        better = (
+            self.best is None
+            or (self.mode == "min" and cur < self.best - self.min_delta)
+            or (self.mode == "max" and cur > self.best + self.min_delta)
+        )
+        if not better:
+            return None
+        if getattr(getattr(self, "trainer", None), "state_poisoned",
+                   False):
+            return None  # never immortalize a non-finite state
+        step = int(state.step)
+        self.best, self.best_step = cur, step
+        self._mgr.save(step, state, force=True)
+        logger.info("BestCheckpoint: %s=%.5g at step %d", self.monitor,
+                    cur, step)
+        return None  # observation only; the state itself is unchanged
+
+    def on_train_end(self, state):
+        self._mgr.wait_until_finished()
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+
 class TerminateOnNaN(Callback):
     """Stop training when a monitored metric goes non-finite (Keras
     ``TerminateOnNaN`` analog, ``tf_keras/src/callbacks.py``)."""

@@ -709,12 +709,33 @@ class Trainer:
         """``Model.predict`` analog (``tf_keras/src/engine/training.py``):
         run the task's forward pass over ``batches`` and return host numpy
         outputs concatenated along the batch axis (pytree-valued outputs
-        are concatenated leaf-wise)."""
+        are concatenated leaf-wise).  Padded-eval batches
+        (``drop_remainder=False`` loaders) are handled: pad rows
+        (``sample_weight`` 0) are dropped from the output, so predicting
+        a finite split returns exactly one row per real example."""
+        masks: list = []
+
+        def spy(it):
+            for b in it:
+                masks.append(np.asarray(b["sample_weight"]) > 0
+                             if "sample_weight" in b else None)
+                yield b
+
         outs = self._forward_loop(
-            batches, state, self._compiled_predict_step(), steps)
+            spy(iter(batches)), state, self._compiled_predict_step(),
+            steps)
         if not outs:
             raise ValueError("predict got an empty batch iterator")
-        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *outs)
+        out = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *outs)
+        # Prefetch may have pulled (and spied) more batches than were
+        # consumed — align masks with the results actually produced.
+        used = masks[:len(outs)]
+        if not any(m is not None for m in used):
+            return out
+        counts = [np.shape(jax.tree.leaves(o)[0])[0] for o in outs]
+        keep = np.concatenate([m if m is not None else np.ones(c, bool)
+                               for m, c in zip(used, counts)])
+        return jax.tree.map(lambda x: x[keep], out)
 
 
 def _chain_first(first, rest):

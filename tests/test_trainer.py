@@ -709,3 +709,92 @@ class TestReduceLROnPlateau:
         lrs = hist.history["lr"]
         assert lrs[0] == pytest.approx(1e-2, rel=1e-5)
         assert lrs[-1] < lrs[0]  # reductions visible in the series
+
+
+class TestBestCheckpointAndPaddedPredict:
+    def test_best_checkpoint_tracks_best_not_last(self, mesh8, tmp_path):
+        """BestCheckpoint keeps the best-metric step even when later steps
+        are worse (separate dir: rolling keep-N never evicts it)."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            BestCheckpoint,
+        )
+
+        cb = BestCheckpoint(str(tmp_path / "best"), monitor="loss")
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=1),
+                          callbacks=[cb])
+        trainer.fit(_loader(), steps=10)
+        cb.wait_until_finished()
+        assert cb.best_step is not None
+        assert cb._mgr.latest_step() == cb.best_step
+
+    def test_best_save_labels_the_live_state(self, mesh8, tmp_path):
+        """With log_every windows, only the window's LAST event (whose
+        step IS the live state's step) is a save candidate — a mid-window
+        best must never label a later state."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            BestCheckpoint,
+        )
+
+        cb = BestCheckpoint(str(tmp_path / "best"), monitor="loss")
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                          config=TrainerConfig(log_every=3),
+                          callbacks=[cb])
+        state = trainer.fit(_loader(), steps=9)
+        cb.wait_until_finished()
+        # Saves happen only at flush boundaries; every saved label must be
+        # a step whose state was current at save time (multiples of 3).
+        assert cb.best_step % 3 == 0
+        assert cb._mgr.latest_step() == cb.best_step
+        restored = cb._mgr.restore(state)
+        assert int(restored.step) == cb.best_step
+
+    def test_cli_save_best(self, tmp_path):
+        from tensorflow_train_distributed_tpu import launch
+
+        launch.run(launch.build_parser().parse_args([
+            "--config", "mnist", "--steps", "6", "--log-every", "1",
+            "--global-batch-size", "16",
+            "--checkpoint-dir", str(tmp_path), "--checkpoint-every", "3",
+            "--save-best"]))
+        import os
+
+        assert os.path.isdir(tmp_path / "best")
+
+    def test_cli_save_best_needs_dir(self):
+        from tensorflow_train_distributed_tpu import launch
+
+        with pytest.raises(SystemExit, match="checkpoint-dir"):
+            launch.run(launch.build_parser().parse_args([
+                "--config", "mnist", "--steps", "2", "--save-best"]))
+
+    def test_predict_drops_padded_rows(self):
+        """Predicting a finite split through a padded loader returns
+        exactly one row per real example."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.data.datasets import (
+            SyntheticBlobs,
+        )
+
+        n, gbs = 10, 4
+        src = SyntheticBlobs(num_examples=n)
+        loader = HostDataLoader(
+            src, DataConfig(global_batch_size=gbs, shuffle=False,
+                            num_epochs=1, drop_remainder=False))
+        mesh = build_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+        trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh,
+                          policy=Policy.from_name("float32"),
+                          config=TrainerConfig(log_every=100))
+        state = trainer.create_state(next(iter(loader)))
+        out = trainer.predict(iter(loader), state)
+        assert out.shape[0] == n
+        # Rows match an unpadded forward over the full split.
+        full = {k: np.stack([src[i][k] for i in range(n)]) for k in src[0]}
+        ref = _BlobsTask().predict_fn(state.params, {}, full)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
