@@ -486,6 +486,15 @@ def run(args: argparse.Namespace) -> RunResult:
     else:
         source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
     eval_source = source
+    if (args.eval_steps > 0 or args.bleu_eval > 0) and not args.eval_split:
+        # Keras validation_data semantics imply HELD-OUT data; without
+        # --eval-split the val_* numbers measure the training
+        # distribution — fine for smoke runs, misleading for model
+        # selection. Say so loudly rather than silently (VERDICT r2).
+        logger.warning(
+            "evaluation will run on the TRAINING distribution (no "
+            "--eval-split): val_* metrics are not held-out generalization "
+            "numbers; pass --eval-split F (e.g. 0.1) to hold out a split")
     if args.eval_split:
         if args.eval_steps <= 0:
             raise SystemExit(

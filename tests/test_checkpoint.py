@@ -18,6 +18,10 @@ from tensorflow_train_distributed_tpu.training.checkpoint import (
 
 from tests.test_trainer import _BlobsTask, _loader
 
+import pathlib
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
 
 class TestCheckpointManager:
     def test_save_restore_roundtrip(self, mesh8, tmp_path):
@@ -149,3 +153,34 @@ class TestCheckpointManager:
             rtol=1e-5,
         )
         mgr.close()
+
+
+def test_elastic_resume_across_device_counts(tmp_path):
+    """ELASTIC resize: a run checkpointed on 8 devices resumes on 4, then
+    on 2 — through the real CLI with auto-resume.  Global arrays + orbax
+    make device count a free variable across save/restore (the reference
+    pins variable placement to the saving strategy's topology)."""
+    import subprocess
+    import sys
+
+    ck = tmp_path / "ck"
+
+    def run(n_dev, steps):
+        cmd = [sys.executable, "-m", "tensorflow_train_distributed_tpu",
+               "--config", "mnist", "--steps", str(steps),
+               "--platform", "cpu", "--cpu-devices", str(n_dev),
+               "--strategy", "dp", "--global-batch-size", "16",
+               "--log-every", "1", "--checkpoint-dir", str(ck),
+               "--checkpoint-every", "4"]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600, cwd=REPO_ROOT)
+        assert out.returncode == 0, out.stderr[-1500:]
+        return out.stderr + out.stdout
+
+    run(8, 4)                     # train to step 4 on 8 devices
+    log = run(4, 8)               # resume on FOUR devices
+    assert "restored checkpoint step 4" in log
+    assert "step 8" in log
+    log2 = run(2, 12)             # shrink again to TWO
+    assert "restored checkpoint step 8" in log2
+    assert "step 12" in log2
