@@ -134,6 +134,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pack-key", default="tokens",
                    help="feature name holding the document tokens under "
                         "--pack-seq")
+    p.add_argument("--data-workers", type=int, default=0, metavar="N",
+                   help="serve training batches from N out-of-process "
+                        "workers (the tf.data-service analog): record "
+                        "read + decode/augment CPU work runs in the "
+                        "workers, off the trainer's Python thread "
+                        "(single-host; synthetic and --data-dir sources)")
     p.add_argument("--data-transform", default=None,
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
@@ -357,6 +363,27 @@ def run(args: argparse.Namespace) -> RunResult:
         raise SystemExit("--eval-only needs --eval-steps N (>0)")
     if args.save_best and not args.checkpoint_dir:
         raise SystemExit("--save-best needs --checkpoint-dir")
+    if args.data_workers > 0 and args.pack_seq:
+        raise SystemExit(
+            "--data-workers does not compose with --pack-seq yet "
+            "(packing runs in-process); drop one of the flags")
+    if args.data_workers > 0 and args.eval_split:
+        raise SystemExit(
+            "--data-workers does not compose with --eval-split: the "
+            "worker fleet streams the FULL dataset, so training would "
+            "consume the held-out examples (contaminated validation); "
+            "drop one of the flags")
+    if args.data_workers > 0:
+        from tensorflow_train_distributed_tpu.models import registry as _r
+
+        _gb = args.global_batch_size
+        if _gb is None:
+            _gb = _r.get_entry(args.config)["global_batch_size"]
+        if _gb % args.data_workers:
+            raise SystemExit(
+                f"global batch {_gb} not divisible by "
+                f"--data-workers={args.data_workers} (each worker serves "
+                "an equal slice of every batch)")
     if args.reduce_lr_factor is not None:
         if not 0.0 < args.reduce_lr_factor < 1.0:
             raise SystemExit(
@@ -477,14 +504,33 @@ def run(args: argparse.Namespace) -> RunResult:
                     "re-tokenize or pick a matching config "
                     "(out-of-range ids would clamp and train on garbage)")
         else:
-            kind = ("tfrecord_dir"
-                    if any(data_root.glob("*.tfrecord"))
-                    or any(data_root.glob("*.tfrecord.gz"))
-                    else "array_dir")
-            source = get_dataset(kind, root=args.data_dir,
+            dir_kind = ("tfrecord_dir"
+                        if any(data_root.glob("*.tfrecord"))
+                        or any(data_root.glob("*.tfrecord.gz"))
+                        else "array_dir")
+            source = get_dataset(dir_kind, root=args.data_dir,
                                  transform=args.data_transform)
     else:
+        dir_kind = None
         source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
+    service_spec = None
+    if args.data_workers > 0:
+        # pack-seq already rejected at arg validation; multiprocess is
+        # only known after cluster resolution, so it lands here.
+        from tensorflow_train_distributed_tpu.data.service import SourceSpec
+
+        if cluster.is_multiprocess:
+            raise SystemExit(
+                "--data-workers is single-host (per-host worker fleets "
+                "over a multiprocess cluster are not wired); drop the "
+                "flag or run single-process")
+        if args.data_dir:
+            service_spec = SourceSpec(
+                dir_kind, {"root": args.data_dir,
+                           "transform": args.data_transform})
+        else:
+            service_spec = SourceSpec(entry["dataset"],
+                                      dict(entry["dataset_kwargs"]))
     eval_source = source
     if (args.eval_steps > 0 or args.bleu_eval > 0) and not args.eval_split:
         # Keras validation_data semantics imply HELD-OUT data; without
@@ -634,6 +680,7 @@ def run(args: argparse.Namespace) -> RunResult:
         checkpoint_manager=ckpt,
     )
 
+    service = None
     try:
         # 5. Resume (reference BackupAndRestore): restore latest if present.
         state = None
@@ -736,6 +783,25 @@ def run(args: argparse.Namespace) -> RunResult:
             batches = (loader.iter_from(int(state.step))
                        if state is not None and int(state.step) > 0
                        else loader)
+            if service_spec is not None:
+                from tensorflow_train_distributed_tpu.data.service import (
+                    DataServiceDispatcher,
+                )
+
+                if state is not None and int(state.step) > 0:
+                    logger.warning(
+                        "--data-workers resume: the worker stream "
+                        "restarts from epoch 0 (deterministic mid-epoch "
+                        "positioning is an in-process loader feature); "
+                        "examples may repeat relative to a single "
+                        "uninterrupted run")
+                dispatcher = DataServiceDispatcher(
+                    service_spec,
+                    DataConfig(global_batch_size=global_batch,
+                               seed=args.seed),
+                    num_workers=args.data_workers).start()
+                service = dispatcher
+                batches = iter(dispatcher.client())
             eval_kwargs = {}
             if args.eval_every and args.eval_steps <= 0:
                 raise SystemExit(
@@ -769,6 +835,8 @@ def run(args: argparse.Namespace) -> RunResult:
             logger.info("BLEU (beam %d, %d batches): %.2f",
                         args.beam_size, args.bleu_eval, bleu)
     finally:
+        if service is not None:
+            service.stop()
         if watcher is not None:
             watcher.uninstall()
         if ckpt is not None:

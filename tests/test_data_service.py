@@ -116,3 +116,24 @@ def test_service_serves_tfrecord_corpus(tmp_path):
     assert len(batches) == 4  # 64 records / 16 batch
     uids = np.sort(np.concatenate([b["uid"].ravel() for b in batches]))
     np.testing.assert_array_equal(uids, np.arange(64))
+
+
+def test_cli_data_workers_serve_training(tmp_path):
+    """--data-workers N: the real CLI trains from out-of-process input
+    workers (the tf.data-service analog, config-driven)."""
+    from tensorflow_train_distributed_tpu import launch
+
+    result = launch.run(launch.build_parser().parse_args([
+        "--config", "mnist", "--steps", "3", "--log-every", "1",
+        "--global-batch-size", "16", "--data-workers", "2"]))
+    assert np.isfinite(result.history["loss"]).all()
+
+
+def test_cli_data_workers_guards():
+    from tensorflow_train_distributed_tpu import launch
+
+    with pytest.raises(SystemExit, match="pack-seq"):
+        launch.run(launch.build_parser().parse_args([
+            "--config", "llama_tiny_sft", "--steps", "1",
+            "--data-dir", "/nonexistent", "--pack-seq", "16",
+            "--data-workers", "2"]))
