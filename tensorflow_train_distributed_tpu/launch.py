@@ -452,7 +452,25 @@ def run(args: argparse.Namespace) -> RunResult:
     if args.pack_seq and not args.data_dir:
         raise SystemExit("--pack-seq needs --data-dir (a varlen TFRecord "
                          "corpus to pack)")
-    if args.data_dir:
+    # Pure service mode: the workers own ALL record I/O — building the
+    # in-process source too would re-materialize/re-index the corpus in
+    # the trainer for nothing.  Any in-process consumer (eval, BLEU, HF
+    # sample, checkpoint-resume sample) keeps the source.
+    service_only = (args.data_workers > 0 and args.eval_steps <= 0
+                    and args.bleu_eval <= 0 and args.init_from_hf is None
+                    and args.checkpoint_dir is None)
+    if service_only:
+        source = None
+        dir_kind = None
+        if args.data_dir:
+            import pathlib
+
+            _root = pathlib.Path(args.data_dir)
+            dir_kind = ("tfrecord_dir"
+                        if any(_root.glob("*.tfrecord"))
+                        or any(_root.glob("*.tfrecord.gz"))
+                        else "array_dir")
+    elif args.data_dir:
         # Autodetect format: a dir of *.tfrecord files (the reference's
         # tf.data corpus convention) vs the native mmap part-*/ layout.
         import pathlib
@@ -554,7 +572,7 @@ def run(args: argparse.Namespace) -> RunResult:
         source, eval_source = train_val_split(
             source, args.eval_split, min_val=global_batch,
             min_train=global_batch)
-    loader = HostDataLoader(
+    loader = None if source is None else HostDataLoader(
         source,
         DataConfig(global_batch_size=global_batch, seed=args.seed),
         process_index=cluster.process_id if cluster.is_multiprocess else None,
@@ -781,8 +799,9 @@ def run(args: argparse.Namespace) -> RunResult:
             # Mid-epoch resume: position the data stream after the restored
             # step so no examples repeat or skip (BackupAndRestore parity).
             batches = (loader.iter_from(int(state.step))
-                       if state is not None and int(state.step) > 0
-                       else loader)
+                       if loader is not None and state is not None
+                       and int(state.step) > 0
+                       else loader)  # None only in service mode (below)
             if service_spec is not None:
                 from tensorflow_train_distributed_tpu.data.service import (
                     DataServiceDispatcher,
@@ -815,7 +834,8 @@ def run(args: argparse.Namespace) -> RunResult:
                 )
             state = trainer.fit(
                 batches, steps=remaining, state=state,
-                steps_per_epoch=loader.steps_per_epoch(),
+                steps_per_epoch=(None if loader is None
+                                 else loader.steps_per_epoch()),
                 **eval_kwargs,
             )
         else:

@@ -43,6 +43,16 @@ PEAK_TFLOPS_BY_KIND = {
     "v6": 918.0,
 }
 
+# HBM bandwidth GB/s by generation (public spec sheets) — the roofline
+# for bandwidth-bound regimes (BN statistics, autoregressive decode).
+HBM_GBPS_BY_KIND = {
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v4": 1228.0,
+    "v6": 1640.0,
+}
+
 # Bytes of optimizer+param state per parameter under the mixed-bf16 adam
 # recipe: bf16 compute copy + f32 master + 2×f32 moments + grads in
 # flight.
@@ -63,6 +73,14 @@ def peak_tflops(device_kind: str) -> Optional[float]:
     for sub, peak in PEAK_TFLOPS_BY_KIND.items():
         if sub in kind:
             return peak
+    return None
+
+
+def hbm_bandwidth_bytes_per_sec(device_kind: str) -> Optional[float]:
+    kind = device_kind.lower()
+    for sub, gbps in HBM_GBPS_BY_KIND.items():
+        if sub in kind:
+            return gbps * 1e9
     return None
 
 

@@ -102,3 +102,23 @@ def test_bench_bert_smoke_on_cpu_mesh(bench_lm_mod):
     assert rec["unit"] == "samples/sec/chip"
     assert rec["value"] > 0 and rec["backend"] == "cpu"
     assert rec["n_params"] > 0
+
+
+def test_bench_generate_cpu_smoke():
+    """Decode-throughput tool: full prefill+scan path on CPU, one JSON
+    record with the required fields."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "bench_generate.py"),
+         "--preset", "llama_tiny", "--batch", "2", "--prompt-len", "16",
+         "--max-new", "16", "--iters", "2", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert rec["unit"] == "tokens/sec/chip"
+    assert rec["backend"] == "cpu"
+    assert rec["max_new_tokens"] == 16

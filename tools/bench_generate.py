@@ -1,0 +1,170 @@
+"""Decoder DECODE throughput: generated tokens/sec/chip (serving side).
+
+Beyond the reference (a training harness with no serving loop): measures
+the KV-cache autoregressive path — one jitted prefill + ``lax.scan``
+decode — end-to-end through ``models.generate``.  The decode regime is
+memory-bandwidth-bound (each step reads all params + the cache for one
+token), so the companion number is model-bandwidth utilization (MBU):
+bytes-touched/step ≈ param_bytes + cache_bytes vs the chip's HBM
+bandwidth — the serving analog of training MFU.
+
+Prints one JSON line (bench_lm.py conventions; chip lock held on TPU).
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflow_train_distributed_tpu.training.memory import (  # noqa: E402
+    hbm_bandwidth_bytes_per_sec,
+    hbm_budget_bytes,
+)
+
+
+def bench_generate(preset: str, batch: int, prompt_len: int,
+                   max_new: int, warmup: int, iters: int,
+                   temperature: float = 0.0,
+                   force_hbm: bool = False):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.models import generate, llama
+
+    cfg = llama.LLAMA_PRESETS[preset]
+    total_len = prompt_len + max_new
+    if total_len > cfg.max_positions:
+        raise SystemExit(
+            f"prompt+new = {total_len} > max_positions "
+            f"{cfg.max_positions}")
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(
+        1, cfg.vocab_size, (batch, prompt_len)).astype(np.int32))
+    model = llama.LlamaModel(cfg)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), prompt[:, :8]))
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(abstract["params"]))
+    # Decode working set in the config's COMPUTE dtype (generate casts
+    # params to cfg.dtype; tiny presets are f32, big ones bf16): cast
+    # params + the KV cache (2 tensors × L × B × total_len × kv_heads ×
+    # head_dim).
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    cache_bytes = (2 * cfg.num_layers * batch * total_len
+                   * kv_heads * (cfg.d_model // cfg.num_heads) * itemsize)
+    need = n_params * (itemsize + 4) + cache_bytes  # cast copy + f32 init
+    budget = (hbm_budget_bytes(dev.device_kind)
+              if dev.platform == "tpu" else None)
+    if budget is not None and need > budget and not force_hbm:
+        print(json.dumps({
+            "error": "decode working set exceeds HBM budget; an OOM "
+                     "compile can kill the chip tunnel — rerun with "
+                     "--force-hbm to gamble",
+            "estimated_gib": round(need / 2**30, 2),
+            "budget_gib": round(budget / 2**30, 2)}), flush=True)
+        raise SystemExit(2)
+    params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+
+    def run(n):
+        return generate.generate(cfg, params, prompt, n,
+                                 temperature=temperature,
+                                 rng=jax.random.key(1))
+
+    def timed(n):
+        jax.block_until_ready(run(n))  # compile
+        for _ in range(warmup):
+            jax.block_until_ready(run(n))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = run(n)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    # Two timed variants separate prefill from the decode loop: the
+    # max_new=1 call is prefill + one step, so the per-step decode time
+    # is the difference divided by the extra steps — MBU then measures
+    # the DECODE loop, not a prefill-diluted blend.
+    dt_full = timed(max_new)
+    dt_one = timed(1)
+    step_s = max(dt_full - dt_one, 1e-9) / (max_new - 1)
+    decode_tok_per_sec = batch / step_s
+    rec = {
+        "metric": f"{preset}_decode_tokens_per_sec_per_chip",
+        "value": round(decode_tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "time_per_call_ms": round(dt_full * 1e3, 2),
+        "prefill_ms": round(dt_one * 1e3, 2),
+        "ms_per_token_step": round(step_s * 1e3, 3),
+        "call_tokens_per_sec": round(batch * max_new / dt_full, 1),
+        "n_params": n_params,
+        "backend": dev.platform,
+    }
+    bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
+          if dev.platform == "tpu" else None)
+    if bw is not None:
+        # Each decode step streams the cast params + the filled cache
+        # once, whatever the batch (that's why batching decode is nearly
+        # free until compute-bound).
+        bytes_per_step = n_params * itemsize + cache_bytes
+        rec["mbu_pct"] = round(100 * bytes_per_step / step_s / bw, 2)
+        rec["device_kind"] = dev.device_kind
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="llama_125m")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform ('cpu' for smoke runs)")
+    p.add_argument("--force-hbm", action="store_true")
+    args = p.parse_args(argv)
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+    if args.platform and args.platform != "tpu":
+        cm = contextlib.nullcontext()
+    else:
+        from tensorflow_train_distributed_tpu.runtime.chip_lock import (
+            chip_lock,
+        )
+
+        cm = chip_lock()
+    try:
+        with cm:
+            rec = bench_generate(args.preset, args.batch, args.prompt_len,
+                                 args.max_new, args.warmup, args.iters,
+                                 temperature=args.temperature,
+                                 force_hbm=args.force_hbm)
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"{args.preset}_decode_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec/chip",
+            "error": f"{type(e).__name__}: {e}"}), flush=True)
+        return 1
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
