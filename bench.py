@@ -249,6 +249,13 @@ FAMILY_CMDS = {
               "--preset", "bert_base", "--batch-per-chip", "32",
               "--seq", "128", "--warmup", "3", "--iters", "20"],
              "bert_base"),
+    # Opt-in (not in the default list — the driver window is budgeted for
+    # the three training families): KV-cache decode throughput + MBU.
+    "gen": ([sys.executable, os.path.join(_HERE, "tools",
+                                          "bench_generate.py"),
+             "--preset", "llama_125m", "--batch", "8",
+             "--prompt-len", "128", "--max-new", "256"],
+            "llama_125m_decode"),
 }
 
 
