@@ -27,7 +27,8 @@ Benched families (``--families``): ``resnet`` (both ``resnet50`` and
 ``resnet50_s2d``, the MXU-friendly space-to-depth stem — the headline is
 the faster one), plus on TPU ``lm`` (llama_125m decoder, tools/bench_lm)
 and ``bert`` (bert_base MLM, tools/bench_bert) so the persisted record
-carries every driver-designated metric, not just ResNet.  The lm/bert
+carries every driver-designated metric, not just ResNet; ``gen``
+(opt-in, tools/bench_generate) adds KV-cache decode throughput + MBU.  The lm/bert
 families run as subprocesses: allocator isolation (a fresh HBM heap per
 family — in-process leftovers could push a fitting config over the
 budget) while inheriting the chip lock.  A jax.profiler trace is captured
@@ -300,7 +301,8 @@ def main(argv=None) -> int:
     p.add_argument("--families", default="resnet,lm,bert",
                    help="model families in the emit: resnet (in-process "
                         "headline) plus lm/bert subprocess benches (TPU "
-                        "only)")
+                        "only); 'gen' (opt-in) adds KV-cache decode "
+                        "throughput + MBU")
     p.add_argument("--batch-per-chip", type=int, default=256)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
@@ -317,7 +319,7 @@ def main(argv=None) -> int:
     p.add_argument("--bench-timeout", type=float, default=1200.0,
                    help="watchdog on the ResNet compile+measure phase")
     p.add_argument("--family-timeout", type=float, default=900.0,
-                   help="timeout per lm/bert family subprocess")
+                   help="timeout per non-resnet family subprocess")
     fb = p.add_mutually_exclusive_group()
     fb.add_argument("--allow-cpu-fallback", dest="cpu_fallback",
                     action="store_true", default=True)
