@@ -750,3 +750,61 @@ class TestSubsampledStatsBN:
                      state.model_state["batch_stats"])
                  if path[-1].key == "mean"]
         assert any(np.abs(m).max() > 0 for m in means)
+
+
+class TestLlama13bScale:
+    """llama2_13b: partitions through the full SPMD pipeline, and the
+    planner gives honest fit answers (v5e-16 at seq 4096 does NOT fit —
+    shrink seq or grow the slice; that refusal is the feature)."""
+
+    def _plan(self, seq, axes):
+        from jax.sharding import AbstractMesh
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.runtime.mesh import AXES
+        from tensorflow_train_distributed_tpu.training import (
+            plan_train_memory,
+        )
+
+        sizes = dict.fromkeys(AXES, 1)
+        sizes.update(axes)
+        mesh = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        task = llama.make_task(llama.LLAMA_PRESETS["llama2_13b"])
+        b = {"tokens": np.zeros((4, seq), np.int32),
+             "targets": np.zeros((4, seq), np.int32)}
+        return plan_train_memory(task, b, optax.adamw(1e-5), mesh,
+                                 device_kind="TPU v5e")
+
+    def test_planner_refuses_v5e16_seq4096(self):
+        plan = self._plan(4096, dict(fsdp=4, tensor=4))
+        assert not plan["fits"]
+
+    def test_planner_fits_v5e16_seq2048(self):
+        plan = self._plan(2048, dict(fsdp=4, tensor=4))
+        assert plan["fits"], plan
+
+    def test_planner_fits_v5e32_seq4096(self):
+        plan = self._plan(4096, dict(fsdp=8, tensor=4))
+        assert plan["fits"], plan
+
+    @pytest.mark.slow  # full 13B SPMD compile
+    def test_13b_partitions_on_8dev_fsdp_tp(self):
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+        from tensorflow_train_distributed_tpu.training import (
+            Policy, Trainer, TrainerConfig,
+        )
+
+        mesh = build_mesh(MeshConfig(fsdp=2, tensor=4))
+        task = llama.CausalLmTask(llama.LLAMA_PRESETS["llama2_13b"])
+        trainer = Trainer(
+            task, optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+            mesh, policy=Policy.from_name("mixed_bfloat16"),
+            config=TrainerConfig(log_every=1_000_000))
+        batch = {"tokens": np.zeros((8, 4096), np.int32),
+                 "targets": np.zeros((8, 4096), np.int32)}
+        compiled = trainer.lower_train_step(batch).compile()
+        txt = compiled.as_text()
+        assert txt.count("all-gather") > 0 and txt.count("all-reduce") > 0
