@@ -22,6 +22,18 @@ def _qkv(shape=(2, 2, 16, 8), kv_len=None, seed=0):
     return q, k, v
 
 
+def _require_pallas_interpret():
+    """Import the pallas TPU flash kernel + interpret mode, or skip."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    except ImportError:
+        pytest.skip("pallas tpu ops unavailable")
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip("force_tpu_interpret_mode unavailable")
+    return pltpu, fa
+
+
 class TestAttention:
     def test_causal_masks_future(self):
         q, k, v = _qkv()
@@ -71,18 +83,10 @@ class TestAttention:
         # The TPU fast path: pallas flash kernel with SegmentIds, run in
         # interpret mode so CPU CI covers its *semantics* (pad segment 0,
         # causal alignment, scale) against the same oracle.
-        try:
-            from jax.experimental.pallas import tpu as pltpu
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                SegmentIds, flash_attention,
-            )
-        except ImportError:
-            pytest.skip("pallas tpu ops unavailable")
-        if not hasattr(pltpu, "force_tpu_interpret_mode"):
-            pytest.skip("force_tpu_interpret_mode unavailable")
+        pltpu, fa = _require_pallas_interpret()
         with pltpu.force_tpu_interpret_mode():
-            out_flash = flash_attention(
-                q, k, v, segment_ids=SegmentIds(q=seg, kv=seg),
+            out_flash = fa.flash_attention(
+                q, k, v, segment_ids=fa.SegmentIds(q=seg, kv=seg),
                 causal=True, sm_scale=D**-0.5)
         np.testing.assert_allclose(np.asarray(out_flash), np.asarray(want),
                                    atol=2e-6)
@@ -132,3 +136,61 @@ class TestLosses:
         loss_s, _ = softmax_cross_entropy(logits, labels,
                                           label_smoothing=0.1)
         assert float(loss_s) > float(loss0)
+
+
+def test_flash_backward_stays_in_pallas():
+    """VERDICT r2 #3: the flash kernel's custom VJP IS the training-path
+    backward — the grad jaxpr contains the pallas bwd kernels and NO
+    materialized [S, S] score tensor anywhere (the buffer whose absence
+    makes long-context training fit)."""
+    pltpu, fa = _require_pallas_interpret()
+
+    rng = np.random.default_rng(0)
+    B, H, S, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, S, D)),
+                           jnp.float32) for _ in range(3))
+
+    def loss(q_, k_, v_):
+        return fa.flash_attention(q_, k_, v_, causal=True,
+                                  sm_scale=D**-0.5).sum()
+
+    with pltpu.force_tpu_interpret_mode():
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    text = str(jaxpr)
+    # fwd + dq + dkv kernels: >= 2 pallas calls proves the BACKWARD runs
+    # in pallas, not just the forward (3 observed on jax 0.9).
+    assert text.count("pallas_call") >= 2, text.count("pallas_call")
+
+    def all_avals(jx):
+        # recurse through call/scan/custom_vjp sub-jaxprs generically
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    yield aval.shape
+            for val in eqn.params.values():
+                sub = getattr(val, "jaxpr", None)
+                if sub is not None:
+                    yield from all_avals(sub)
+                if isinstance(val, (list, tuple)):
+                    for v_ in val:
+                        s_ = getattr(v_, "jaxpr", None)
+                        if s_ is not None:
+                            yield from all_avals(s_)
+
+    def count_score_tensors(jx):
+        return sum(1 for s in all_avals(jx)
+                   if len(s) >= 2 and s[-1] == S and s[-2] == S)
+
+    # Kernel-internal BLOCK tiles are fine; a full [B, H, S, S] (or any
+    # S×S trailing pair) would be the materialized scores.
+    assert count_score_tensors(jaxpr.jaxpr) == 0
+
+    # Negative control: the reference einsum path MUST trip the detector,
+    # or the assertion above is vacuous.
+    ref_jaxpr = jax.make_jaxpr(jax.grad(
+        lambda q_, k_, v_: dot_product_attention(
+            q_, k_, v_, causal=True).sum(),
+        argnums=(0, 1, 2)))(q, k, v)
+    assert count_score_tensors(ref_jaxpr.jaxpr) > 0
