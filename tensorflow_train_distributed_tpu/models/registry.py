@@ -113,6 +113,16 @@ def _setup():
              warmup_ratio=0.03,
              # Llama-2 training convention: global-norm clip 1.0.
              grad_clip_norm=1.0)
+    # The single-chip benchmark flagship (bench_lm / __graft_entry__):
+    # GPT-2-small-class decoder, trainable through the CLI on one chip.
+    register("llama_125m_lm",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["llama_125m"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=32_000, seq_len=2048),
+             strategy="dp", global_batch_size=8,
+             learning_rate=3e-4, lr_schedule="warmup_cosine",
+             warmup_ratio=0.01, grad_clip_norm=1.0)
     # Beyond the reference (it has no MoE): expert-parallel decoder LM.
     register("mixtral_8x7b",
              task_factory=lambda: moe.make_task(

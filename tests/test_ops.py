@@ -163,8 +163,13 @@ def test_flash_backward_stays_in_pallas():
     assert text.count("pallas_call") >= 2, text.count("pallas_call")
 
     def all_avals(jx):
-        # recurse through call/scan/custom_vjp sub-jaxprs generically
+        # recurse through call/scan/custom_vjp sub-jaxprs generically —
+        # but NOT into pallas_call kernels: their in-VMEM block tiles are
+        # S×S here (block = min(512, S)) by design, and excluding them
+        # must not depend on how jax happens to store the kernel jaxpr.
         for eqn in jx.eqns:
+            if "pallas" in str(eqn.primitive):
+                continue
             for var in list(eqn.invars) + list(eqn.outvars):
                 aval = getattr(var, "aval", None)
                 if aval is not None and hasattr(aval, "shape"):
