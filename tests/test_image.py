@@ -176,3 +176,28 @@ class TestJpegTfrecordPath:
         with pytest.raises(KeyError, match="encoded image"):
             I.imagenet_train_record(
                 {"image": np.zeros((8, 8, 3), np.uint8), "label": 0})
+
+    def test_native_stager_serves_decoded_batches(self, tmp_path):
+        """use_native=True over a transformed JPEG corpus: the GIL-free
+        stager serves byte-identical batches to the Python path (decode
+        happens once, at pack time — a warm-start mode)."""
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+        from tensorflow_train_distributed_tpu.native.staging import (
+            NativeBatchStager,
+        )
+
+        if not NativeBatchStager.available():
+            pytest.skip("native stager not built in this environment")
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=1)
+        py_batches = list(HostDataLoader(src, cfg))
+        nat_batches = list(HostDataLoader(
+            src, DataConfig(global_batch_size=8, shuffle=False,
+                            num_epochs=1, use_native=True)))
+        assert len(py_batches) == len(nat_batches) == 2
+        for a, b in zip(py_batches, nat_batches):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
