@@ -122,3 +122,21 @@ def test_bench_generate_cpu_smoke():
     assert rec["unit"] == "tokens/sec/chip"
     assert rec["backend"] == "cpu"
     assert rec["max_new_tokens"] == 16
+
+
+def test_bench_input_cpu_smoke():
+    """Input-pipeline bench: all modes produce positive rates."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "bench_input.py"),
+         "--records", "64", "--image-hw", "64", "--size", "32",
+         "--batch", "16", "--workers", "2"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert set(rec["modes"]) == {"inprocess", "workers2",
+                                 "mmap_predecoded"}
+    assert all(v > 0 for v in rec["modes"].values())
