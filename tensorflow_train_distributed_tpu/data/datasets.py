@@ -211,9 +211,23 @@ class SliceSource:
         return self.stop - self.start
 
     def __getitem__(self, idx: int):
+        return self.get_record(idx, 0)
+
+    def get_record(self, idx: int, epoch: int = 0):
+        """Indexed fetch with the epoch threaded through the view —
+        ``--eval-split`` wrapping must not freeze per-epoch augmentation
+        (``pipeline.fetch_record`` semantics)."""
         if idx < 0 or idx >= len(self):
             raise IndexError(idx)
-        return self.source[self.start + idx]
+        from tensorflow_train_distributed_tpu.data.pipeline import (
+            fetch_record,
+        )
+
+        return fetch_record(self.source, self.start + idx, epoch)
+
+    @property
+    def epoch_aware(self) -> bool:
+        return getattr(self.source, "epoch_aware", False)
 
 
 def train_val_split(source, val_fraction: float, *, min_val: int = 1,

@@ -23,13 +23,17 @@ once.
 
 from __future__ import annotations
 
+import inspect
 import json
 from pathlib import Path
 from typing import Callable, Optional, Union
 
 import numpy as np
 
-from tensorflow_train_distributed_tpu.data.pipeline import ConcatSource
+from tensorflow_train_distributed_tpu.data.pipeline import (
+    ConcatSource,
+    fetch_record,  # noqa: F401  (re-export: the record-fetch protocol)
+)
 
 MANIFEST = "manifest.json"
 
@@ -62,7 +66,48 @@ def resolve_transform(
     return transform
 
 
-class MmapArraySource:
+def transform_is_epoch_aware(fn) -> bool:
+    """Does ``fn`` accept an ``epoch`` keyword (fresh-per-epoch
+    augmentation, e.g. ``image.imagenet_train_record``)?  Sources call
+    epoch-aware transforms as ``fn(rec, epoch=e)`` with the epoch the
+    loader passes to ``get_record``; everything else keeps the 1-arg
+    call."""
+    if fn is None:
+        return False
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    p = sig.parameters.get("epoch")
+    return p is not None and p.kind in (
+        inspect.Parameter.KEYWORD_ONLY,
+        inspect.Parameter.POSITIONAL_OR_KEYWORD)
+
+
+class TransformedRecordMixin:
+    """Leaf-source helper: raw record + optional (epoch-aware) transform.
+
+    Subclasses implement ``_raw(idx)`` and call ``_init_transform`` once;
+    the mixin provides the ``get_record``/``__getitem__`` pair with the
+    epoch threaded into transforms that accept it."""
+
+    def _init_transform(self, transform) -> None:
+        self.transform = resolve_transform(transform)
+        self.epoch_aware = transform_is_epoch_aware(self.transform)
+
+    def get_record(self, idx: int, epoch: int = 0) -> dict:
+        rec = self._raw(idx)
+        if self.transform is None:
+            return rec
+        if self.epoch_aware:
+            return self.transform(rec, epoch=epoch)
+        return self.transform(rec)
+
+    def __getitem__(self, idx: int) -> dict:
+        return self.get_record(idx, 0)
+
+
+class MmapArraySource(TransformedRecordMixin):
     """One shard dir of ``.npy`` columns, memory-mapped; random access.
 
     ``transform`` (callable or ``TRANSFORMS`` name) maps the raw stored
@@ -88,16 +133,15 @@ class MmapArraySource:
                     f"manifest says {n}")
             self.columns[name] = arr
         self._n = n
-        self.transform = resolve_transform(transform)
+        self._init_transform(transform)
 
     def __len__(self) -> int:
         return self._n
 
-    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+    def _raw(self, idx: int) -> dict[str, np.ndarray]:
         if idx < 0 or idx >= self._n:
             raise IndexError(idx)
-        rec = {k: np.asarray(v[idx]) for k, v in self.columns.items()}
-        return self.transform(rec) if self.transform else rec
+        return {k: np.asarray(v[idx]) for k, v in self.columns.items()}
 
 
 def write_shards(root: Union[str, Path], source, num_shards: int) -> Path:

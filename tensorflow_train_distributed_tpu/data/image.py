@@ -11,11 +11,15 @@ stager's producer, or the out-of-process data-service workers (the name
 travels in the picklable ``SourceSpec``; the CPU cost lands on the
 workers, exactly where the reference puts it).
 
-Determinism: the augmentation rng is seeded from the crc32 of the
-encoded bytes, so a given record augments identically on every worker,
-epoch and restart — reproducible by construction (a stronger property
-than tf.data's stateful rng; the tradeoff is one fixed crop per record
-per training run rather than a fresh crop per epoch).
+Determinism: the augmentation rng is seeded from ``(crc32(encoded
+bytes), epoch)``, so a given record augments identically on every
+worker and restart within an epoch but draws a FRESH crop/flip each
+epoch — the reference's per-epoch tf.data augmentation diversity with
+reproducibility by construction (tf.data's stateful rng has neither
+property without careful seeding).  The epoch arrives with each fetch
+via ``filesource.fetch_record`` (``HostDataLoader`` threads it through
+the ``filesource``/``tfrecord`` sources; epoch-unaware callers get the
+epoch-0 crop).
 
 Record schema: the reference's ImageNet TFRecords carry
 ``image/encoded`` (JPEG bytes) and ``image/class/label``; bare
@@ -124,10 +128,18 @@ def center_crop(img: np.ndarray, size: int,
     return resized[top:top + size, left:left + size]
 
 
-def imagenet_train_record(rec: dict, *, size: int = 224) -> dict:
-    """JPEG record → augmented training record (decode/crop/flip/norm)."""
+def imagenet_train_record(rec: dict, *, size: int = 224,
+                          epoch: int = 0) -> dict:
+    """JPEG record → augmented training record (decode/crop/flip/norm).
+
+    ``epoch`` folds into the rng seed so every epoch draws a fresh
+    crop/flip (reference tf.data semantics) while staying deterministic
+    across workers and restarts; sources pass it per fetch
+    (``filesource.fetch_record`` / ``transform_is_epoch_aware``).
+    """
     data = _encoded_bytes(rec)
-    rng = np.random.default_rng(zlib.crc32(data))
+    rng = np.random.default_rng(
+        np.random.SeedSequence([zlib.crc32(data), int(epoch)]))
     img = random_resized_crop(decode_image(data), size, rng)
     if rng.random() < 0.5:
         img = img[:, ::-1]

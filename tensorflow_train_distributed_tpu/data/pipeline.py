@@ -42,6 +42,21 @@ class RandomAccessSource(Protocol):
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]: ...
 
 
+def fetch_record(source, idx: int, epoch: int = 0) -> dict:
+    """Fetch ``source[idx]`` with the epoch threaded to epoch-aware
+    transforms (fresh-per-epoch augmentation, reference tf.data
+    semantics).  The epoch travels WITH the call — no mutable source
+    state — so interleaved iterators over one source (periodic eval,
+    ``iter_from`` probes, prefetch threads) can never corrupt each
+    other's augmentation epoch.  Sources without the ``get_record`` hook
+    fall back to plain indexing (their transforms, if any, are
+    epoch-independent)."""
+    g = getattr(source, "get_record", None)
+    if g is not None:
+        return g(idx, epoch)
+    return source[idx]
+
+
 class ConcatSource:
     """Concatenation of per-file sources — the FILE-autoshard unit.
 
@@ -61,10 +76,19 @@ class ConcatSource:
         return int(self._offsets[-1])
 
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        return self.get_record(idx, 0)
+
+    def get_record(self, idx: int, epoch: int = 0) -> dict[str, np.ndarray]:
+        """Indexed fetch with the epoch threaded to epoch-aware parts
+        (``fetch_record`` semantics)."""
         if idx < 0 or idx >= len(self):
             raise IndexError(idx)
         f = int(np.searchsorted(self._offsets, idx, side="right")) - 1
-        return self.parts[f][int(idx - self._offsets[f])]
+        return fetch_record(self.parts[f], int(idx - self._offsets[f]), epoch)
+
+    @property
+    def epoch_aware(self) -> bool:
+        return any(getattr(p, "epoch_aware", False) for p in self.parts)
 
     def part_indices(self, part: int) -> np.ndarray:
         """Global record indices belonging to file ``part``."""
@@ -142,10 +166,19 @@ class MixtureSource:
         return self._n
 
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        return self.get_record(idx, 0)
+
+    def get_record(self, idx: int, epoch: int = 0) -> dict[str, np.ndarray]:
+        """Indexed fetch with the epoch threaded to epoch-aware
+        components (``fetch_record`` semantics)."""
         if idx < 0 or idx >= self._n:
             raise IndexError(idx)
         src = self.sources[int(self._assignment[idx])]
-        return src[int(self._within[idx]) % len(src)]
+        return fetch_record(src, int(self._within[idx]) % len(src), epoch)
+
+    @property
+    def epoch_aware(self) -> bool:
+        return any(getattr(s, "epoch_aware", False) for s in self.sources)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -283,6 +316,22 @@ class HostDataLoader:
             yield self._padded_order(epoch)
             epoch += 1
 
+    def _augmentation_frozen(self) -> bool:
+        """True when the native stager serves ``__iter__`` over an
+        epoch-aware source: the stager packs transformed records once, so
+        augmentation is frozen at epoch 0 — and ``iter_from`` (always the
+        Python path) must ALSO fetch epoch 0, or a preemption restart
+        would diverge from the uninterrupted stream."""
+        if not self.config.use_native:
+            return False
+        if not getattr(self.source, "epoch_aware", False):
+            return False
+        from tensorflow_train_distributed_tpu.native.staging import (
+            NativeBatchStager,
+        )
+
+        return NativeBatchStager.available()
+
     def _padded_order(self, epoch: int) -> np.ndarray:
         """Epoch index stream sized to exactly steps_per_epoch batches:
         truncated (drop_remainder) or padded by repeating the final index
@@ -324,6 +373,8 @@ class HostDataLoader:
         if self.config.num_epochs is not None and epoch >= self.config.num_epochs:
             return iter(())
 
+        frozen = self._augmentation_frozen()
+
         def _resumed():
             first = True
             e = epoch
@@ -334,7 +385,9 @@ class HostDataLoader:
                 for b in range(start // self.host_batch_size, spe):
                     idx = order[b * self.host_batch_size:
                                 (b + 1) * self.host_batch_size]
-                    records = [self.source[int(i)] for i in idx]
+                    records = [fetch_record(self.source, int(i),
+                                            0 if frozen else e)
+                               for i in idx]
                     batch = {k: np.stack([r[k] for r in records])
                              for k in records[0]}
                     if not self.config.drop_remainder:
@@ -351,6 +404,15 @@ class HostDataLoader:
             )
 
             if NativeBatchStager.available():
+                if getattr(self.source, "epoch_aware", False):
+                    import warnings
+
+                    warnings.warn(
+                        "use_native packs transformed records ONCE, so a "
+                        "per-epoch augmentation transform is frozen at its "
+                        "epoch-0 crops; use the in-process or data-service "
+                        "path for fresh-per-epoch augmentation",
+                        stacklevel=2)
                 if self._native_packed is None:
                     # Pack once per loader: re-created iterators (periodic
                     # eval, preemption restart) reuse the flattened matrix
@@ -369,10 +431,11 @@ class HostDataLoader:
                         yield self._with_sample_weight(batch, i % spe)
                 return
             # No toolchain/library: fall through to the Python path.
-        for order in self._epoch_orders():
+        for epoch, order in enumerate(self._epoch_orders()):
             for b in range(len(order) // self.host_batch_size):
                 idx = order[b * self.host_batch_size : (b + 1) * self.host_batch_size]
-                records = [self.source[int(i)] for i in idx]
+                records = [fetch_record(self.source, int(i), epoch)
+                           for i in idx]
                 batch = {
                     k: np.stack([r[k] for r in records])
                     for k in records[0]

@@ -34,6 +34,10 @@ from typing import Optional, Sequence, Union
 
 import numpy as np
 
+from tensorflow_train_distributed_tpu.data.filesource import (
+    TransformedRecordMixin,
+)
+
 # id1+id2+deflate method: 3 bytes, not 2 — a plain TFRecord whose first
 # record is exactly 0x8B1F bytes long starts with 1f 8b too, but its third
 # byte is a length byte, not 0x08.
@@ -611,17 +615,18 @@ def open_tfrecord_dir(root: Union[str, Path],
     return ConcatSource(parts)
 
 
-class _TransformedSource:
+class _TransformedSource(TransformedRecordMixin):
     """Apply a record transform over any ``RandomAccessSource``."""
 
     def __init__(self, source, transform):
-        self.source, self.transform = source, transform
+        self.source = source
+        self._init_transform(transform)
 
     def __len__(self) -> int:
         return len(self.source)
 
-    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
-        return self.transform(self.source[idx])
+    def _raw(self, idx: int) -> dict[str, np.ndarray]:
+        return self.source[idx]
 
 
 def convert_to_shards(tfrecord_paths, out_root, features,

@@ -101,6 +101,142 @@ class TestDecodeAugment:
             I.imagenet_train_record({"jpeg": data})
 
 
+class TestPerEpochAugmentation:
+    """Fresh crop/flip per epoch (reference tf.data semantics), still
+    deterministic across workers and restarts (VERDICT r3 item 4)."""
+
+    def test_same_record_fresh_crop_per_epoch(self):
+        rng = np.random.default_rng(11)
+        data, _ = _jpeg_bytes(rng, 80, 60)
+        rec = {"jpeg": data, "label": 1}
+        e0 = I.imagenet_train_record(rec, size=32, epoch=0)
+        e1 = I.imagenet_train_record(rec, size=32, epoch=1)
+        e1b = I.imagenet_train_record(rec, size=32, epoch=1)
+        assert not np.array_equal(e0["image"], e1["image"])
+        np.testing.assert_array_equal(e1["image"], e1b["image"])
+
+    def test_loader_threads_epoch_into_transform(self, tmp_path):
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+
+        root = _write_corpus(str(tmp_path))
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=2)
+
+        def batches():
+            src = open_tfrecord_dir(root, transform="imagenet_train_32")
+            assert src.epoch_aware
+            return list(HostDataLoader(src, cfg))
+
+        a = batches()
+        assert len(a) == 4  # 2 epochs x 2 steps
+        # Same records, different epoch: fresh crops.
+        assert not np.array_equal(a[0]["image"], a[2]["image"])
+        np.testing.assert_array_equal(a[0]["label"], a[2]["label"])
+        # A second loader reproduces the stream exactly (worker/restart
+        # determinism).
+        for x, y in zip(a, batches()):
+            np.testing.assert_array_equal(x["image"], y["image"])
+
+    def test_mid_epoch_resume_reproduces_epoch_crops(self, tmp_path):
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=2)
+        loader = HostDataLoader(src, cfg)
+        full = list(loader)
+        resumed = list(loader.iter_from(3))  # last batch of epoch 1
+        assert len(resumed) == 1
+        np.testing.assert_array_equal(full[3]["image"], resumed[0]["image"])
+
+    def test_interleaved_iterators_do_not_corrupt_epochs(self, tmp_path):
+        """The epoch travels with each fetch, not as source state — a
+        second iterator opened mid-stream (periodic eval / resume probe)
+        must not shift the first iterator's augmentation epoch."""
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=2)
+        loader = HostDataLoader(src, cfg)
+        sequential = list(loader)  # the reference stream
+
+        it = iter(loader)
+        got = [next(it)]           # epoch 0, batch 0
+        # Interleave: a fresh epoch-0 iterator AND an epoch-1 probe.
+        next(iter(loader))
+        list(loader.iter_from(3))
+        got += list(it)            # rest of the original stream
+        assert len(got) == len(sequential)
+        for x, y in zip(got, sequential):
+            np.testing.assert_array_equal(x["image"], y["image"])
+
+    def test_eval_split_view_keeps_fresh_epochs(self, tmp_path):
+        """SliceSource (--eval-split wrapping) must forward the epoch —
+        a frozen view would silently undo per-epoch augmentation."""
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+        from tensorflow_train_distributed_tpu.data.datasets import (
+            train_val_split,
+        )
+
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        train, _val = train_val_split(src, 0.25)
+        assert train.epoch_aware
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=2)
+        b = list(HostDataLoader(train, cfg))
+        assert not np.array_equal(b[0]["image"], b[1]["image"])
+
+    def test_native_stager_warns_frozen_augmentation(self, tmp_path):
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+        from tensorflow_train_distributed_tpu.native.staging import (
+            NativeBatchStager,
+        )
+
+        if not NativeBatchStager.available():
+            pytest.skip("native stager not built in this environment")
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=1,
+                         use_native=True)
+        with pytest.warns(UserWarning, match="frozen"):
+            next(iter(HostDataLoader(src, cfg)))
+
+    def test_native_resume_matches_frozen_stream(self, tmp_path):
+        """use_native freezes augmentation at epoch 0; a preemption
+        resume (iter_from, always the Python path) must serve the SAME
+        frozen crops or the restarted run diverges."""
+        from tensorflow_train_distributed_tpu.data import (
+            DataConfig, HostDataLoader,
+        )
+        from tensorflow_train_distributed_tpu.native.staging import (
+            NativeBatchStager,
+        )
+
+        if not NativeBatchStager.available():
+            pytest.skip("native stager not built in this environment")
+        root = _write_corpus(str(tmp_path))
+        src = open_tfrecord_dir(root, transform="imagenet_train_32")
+        cfg = DataConfig(global_batch_size=8, shuffle=False, num_epochs=2,
+                         use_native=True)
+        loader = HostDataLoader(src, cfg)
+        with pytest.warns(UserWarning, match="frozen"):
+            stream = list(loader)  # 4 batches, all epoch-0 crops
+        resumed = list(loader.iter_from(2))  # restart at epoch 1
+        assert len(resumed) == 2
+        for x, y in zip(stream[2:], resumed):
+            np.testing.assert_array_equal(x["image"], y["image"])
+
+
 class TestJpegTfrecordPath:
     def test_raw_sidecar_roundtrip(self, tmp_path):
         from tensorflow_train_distributed_tpu.data.tfrecord import (
