@@ -74,9 +74,21 @@ def _label(rec: dict) -> np.int32:
         f"(keys: {sorted(rec)})")
 
 
+def _pil_image():
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            "Pillow is required for JPEG decode / ImageNet augmentation "
+            "(the imagenet_* transforms); install the optional extra: "
+            "pip install 'tensorflow_train_distributed_tpu[image]'"
+        ) from e
+    return Image
+
+
 def decode_image(data: bytes) -> np.ndarray:
     """Encoded image bytes (JPEG/PNG/...) → uint8 [H, W, 3] RGB."""
-    from PIL import Image
+    Image = _pil_image()
 
     with Image.open(io.BytesIO(data)) as im:
         return np.asarray(im.convert("RGB"), np.uint8)
@@ -92,7 +104,7 @@ def random_resized_crop(img: np.ndarray, size: int,
                         ratio_range=(3 / 4, 4 / 3),
                         attempts: int = 10) -> np.ndarray:
     """Inception-style crop: sample area+aspect, fall back to center."""
-    from PIL import Image
+    Image = _pil_image()
 
     h, w = img.shape[:2]
     area = h * w
@@ -115,7 +127,7 @@ def random_resized_crop(img: np.ndarray, size: int,
 def center_crop(img: np.ndarray, size: int,
                 *, crop_padding: int = 32) -> np.ndarray:
     """Resize-short-side then central crop (the eval convention)."""
-    from PIL import Image
+    Image = _pil_image()
 
     h, w = img.shape[:2]
     scale = (size + crop_padding) / min(h, w)

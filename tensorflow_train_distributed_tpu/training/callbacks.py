@@ -259,6 +259,12 @@ def set_injected_hyperparam(opt_state, name: str, value):
                                 for f in node._fields))
         if isinstance(node, (tuple, list)):
             return type(node)(rec(x) for x in node)
+        if isinstance(node, dict):
+            # dict-valued state nodes (optax.multi_transform inner_states,
+            # masked wrappers) — without this branch an inject_hyperparams
+            # nested under them is unreachable.  type(node) preserves
+            # dict subclasses (OrderedDict params → same treedef).
+            return type(node)((k, rec(v)) for k, v in node.items())
         return node
 
     return rec(opt_state), n_set
@@ -273,6 +279,13 @@ def get_injected_hyperparam(opt_state, name: str):
         fields = (getattr(opt_state, f) for f in opt_state._fields) \
             if hasattr(opt_state, "_fields") else iter(opt_state)
         for sub in fields:
+            found = get_injected_hyperparam(sub, name)
+            if found is not None:
+                return found
+    if isinstance(opt_state, dict):
+        # Mirror the setter: descend through dict-valued state nodes
+        # (multi_transform inner_states, masked wrappers).
+        for sub in opt_state.values():
             found = get_injected_hyperparam(sub, name)
             if found is not None:
                 return found

@@ -124,6 +124,20 @@ def test_bench_generate_cpu_smoke():
     assert rec["max_new_tokens"] == 16
 
 
+def test_bench_generate_rejects_max_new_one():
+    """--max-new 1 cannot measure a decode rate (it IS the prefill call);
+    argparse rejects it cleanly instead of a ZeroDivisionError."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "bench_generate.py"),
+         "--preset", "llama_tiny", "--max-new", "1", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2  # argparse usage error
+    assert "--max-new must be >= 2" in out.stderr
+
+
 def test_bench_input_cpu_smoke():
     """Input-pipeline bench: all modes produce positive rates."""
     import json

@@ -670,6 +670,34 @@ class TestReduceLROnPlateau:
                 "--reduce-lr-factor", "0.5",
                 "--lr-schedule", "warmup_cosine"]))
 
+    def test_walkers_reach_dict_valued_state_nodes(self):
+        """inject_hyperparams nested under optax.multi_transform (whose
+        state holds a DICT of inner states) is found and rewritten —
+        library users composing optimizers, not the CLI chain."""
+        import optax
+
+        from tensorflow_train_distributed_tpu.training.callbacks import (
+            get_injected_hyperparam, set_injected_hyperparam,
+        )
+
+        tx = optax.multi_transform(
+            {"a": optax.inject_hyperparams(optax.adam)(learning_rate=1e-2),
+             "b": optax.sgd(1e-3)},
+            {"x": "a", "y": "b"})
+        params = {"x": np.zeros(3, np.float32), "y": np.zeros(2, np.float32)}
+        state = tx.init(params)
+        assert float(get_injected_hyperparam(
+            state, "learning_rate")) == pytest.approx(1e-2)
+        new_state, n_set = set_injected_hyperparam(
+            state, "learning_rate", 5e-3)
+        assert n_set == 1
+        assert float(get_injected_hyperparam(
+            new_state, "learning_rate")) == pytest.approx(5e-3)
+        # The rewritten state still drives an update (structure intact).
+        grads = {"x": np.ones(3, np.float32), "y": np.ones(2, np.float32)}
+        updates, _ = tx.update(grads, new_state, params)
+        assert np.isfinite(updates["x"]).all()
+
     def test_multiple_reductions_per_flush_window(self, mesh8):
         """patience expirations inside one log_every window each apply
         their factor (pending is a count, not a flag)."""

@@ -123,12 +123,22 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     return rec
 
 
+def _at_least_two(s: str) -> int:
+    v = int(s)
+    if v < 2:
+        raise argparse.ArgumentTypeError(
+            f"--max-new must be >= 2 (decode rate is measured against a "
+            f"max_new=1 prefill call), got {v}")
+    return v
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--preset", default="llama_125m")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
-    p.add_argument("--max-new", type=int, default=128)
+    # >= 2: the decode-step rate comes from (full - one-step) / (n - 1).
+    p.add_argument("--max-new", type=_at_least_two, default=128)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--temperature", type=float, default=0.0)
