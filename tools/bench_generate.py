@@ -37,6 +37,11 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
 
     from tensorflow_train_distributed_tpu.models import generate, llama
 
+    if max_new < 2:
+        # The decode-step rate is (full - one-step) / (max_new - 1); a
+        # single new token IS the prefill call. Guarded here too so
+        # library callers get the clean error, not ZeroDivisionError.
+        raise ValueError(f"max_new must be >= 2, got {max_new}")
     cfg = llama.LLAMA_PRESETS[preset]
     total_len = prompt_len + max_new
     if total_len > cfg.max_positions:
