@@ -173,6 +173,19 @@ class MoEMlpBlock(nn.Module):
         self.sow("aux_loss", "load_balance", cfg.aux_loss_weight * lb)
         self.sow("aux_loss", "router_z", cfg.z_loss_weight * z)
 
+        # Routing health (sown separately — diagnostics, NOT loss terms):
+        # a binding capacity_factor drops tokens silently (they ride the
+        # residual), which also breaks packed==lone-document parity (see
+        # MoeLmModel packing note).  dropped_frac = fraction of desired
+        # top_k assignments that hit a full expert; expert_load = each
+        # expert's share of kept tokens (uniform = 1/E).
+        desired = jnp.asarray(groups * group_size * cfg.top_k, jnp.float32)
+        self.sow("router_stats", "dropped_frac",
+                 1.0 - jnp.sum(routed) / desired)
+        self.sow("router_stats", "expert_load",
+                 jnp.sum(routed, axis=(0, 1)) / jnp.maximum(
+                     jnp.sum(routed), 1.0))
+
         dispatch = dispatch.astype(cfg.dtype)
         expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, x)
         expert_in = nn.with_logical_constraint(
@@ -263,6 +276,36 @@ class MoeLmModel(nn.Module):
             logits, ("batch", "length", "vocab"))
 
 
+def _sown_values(collection, name: str) -> list:
+    """All leaves sown under ``name`` anywhere in a (nested) flax
+    collection — one entry per MoE layer.  Path-based so dict and
+    FrozenDict collections (flax_return_frozendict mode) both work."""
+    return [leaf for path, leaf
+            in jax.tree_util.tree_leaves_with_path(collection)
+            if any(getattr(p, "key", None) == name for p in path)]
+
+
+def _routing_metrics(stats: dict) -> dict:
+    """Scalar routing-health metrics averaged over MoE layers.
+
+    ``dropped_frac`` > 0 means the capacity_factor is binding — tokens
+    are silently falling through the residual AND packed rows are no
+    longer exactly equivalent to lone documents; ``expert_load_max/min``
+    bound the per-expert share of kept tokens (uniform = 1/E), exposing
+    hot/cold experts that an aggregate load-balance loss value hides.
+    """
+    dropped = _sown_values(stats, "dropped_frac")
+    load = _sown_values(stats, "expert_load")
+    if not dropped:
+        return {}
+    mean_load = jnp.mean(jnp.stack(load), axis=0)  # [E] over layers
+    return {
+        "dropped_frac": jnp.mean(jnp.stack(dropped)),
+        "expert_load_max": jnp.max(mean_load),
+        "expert_load_min": jnp.min(mean_load),
+    }
+
+
 class MoeLmTask:
     """Causal LM objective + routed aux losses."""
 
@@ -272,14 +315,17 @@ class MoeLmTask:
 
     def init_variables(self, rng, batch):
         variables = dict(self.model.init(rng, batch["tokens"]))
-        variables.pop("aux_loss", None)  # ephemeral, not trainable state
+        # Ephemeral sown collections, not trainable state.
+        variables.pop("aux_loss", None)
+        variables.pop("router_stats", None)
         return variables
 
     def loss_fn(self, params, model_state, batch, rng, train):
         del rng
         logits, collections = self.model.apply(
             {"params": params}, batch["tokens"],
-            segment_ids=batch.get("segment_ids"), mutable=["aux_loss"])
+            segment_ids=batch.get("segment_ids"),
+            mutable=["aux_loss", "router_stats"])
         logits = logits.astype(jnp.float32)
         weights = fold_sample_weight(batch, batch["targets"].shape,
                                      batch.get("loss_weights"))
@@ -296,6 +342,7 @@ class MoeLmTask:
         loss = ce + aux if train else ce
         metrics = {"accuracy": acc, "ce_loss": ce,
                    "aux_loss": jnp.asarray(aux)}
+        metrics.update(_routing_metrics(collections.get("router_stats", {})))
         if weights is not None:
             metrics["loss_weight"] = weights.sum()
         return loss, (metrics, model_state)
