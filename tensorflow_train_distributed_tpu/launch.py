@@ -143,6 +143,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-transform", default=None,
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
+    p.add_argument("--dataset-kwarg", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="override a config's synthetic-dataset kwarg "
+                        "(repeatable; VALUE parsed as JSON, falling back "
+                        "to string) — e.g. --dataset-kwarg image_size=64 "
+                        "--dataset-kwarg num_examples=2048. Incompatible "
+                        "with --data-dir")
     p.add_argument("--init-from-hf", default=None, metavar="DIR",
                    help="initialize a Llama- or BERT-family config's "
                         "params from a local HuggingFace checkpoint dir "
@@ -353,6 +360,25 @@ def _parse_profile_steps(spec: str) -> tuple[int, int]:
             f"{spec!r}") from None
 
 
+def _dataset_kwargs(entry: dict, args: argparse.Namespace) -> dict:
+    """Registry dataset kwargs with ``--dataset-kwarg KEY=VALUE``
+    overrides (VALUE parsed as JSON so ints/floats/bools arrive typed;
+    non-JSON stays a string)."""
+    import json
+
+    kw = dict(entry["dataset_kwargs"])
+    for item in args.dataset_kwarg:
+        key, sep, raw = item.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--dataset-kwarg wants KEY=VALUE, got {item!r}")
+        try:
+            kw[key] = json.loads(raw)
+        except ValueError:
+            kw[key] = raw
+    return kw
+
+
 def run(args: argparse.Namespace) -> RunResult:
     """Build the full stack from parsed flags and train."""
     import jax
@@ -452,6 +478,9 @@ def run(args: argparse.Namespace) -> RunResult:
     if args.pack_seq and not args.data_dir:
         raise SystemExit("--pack-seq needs --data-dir (a varlen TFRecord "
                          "corpus to pack)")
+    if args.dataset_kwarg and args.data_dir:
+        raise SystemExit("--dataset-kwarg overrides the config's SYNTHETIC "
+                         "dataset; it has no effect with --data-dir")
     # Pure service mode: the workers own ALL record I/O — building the
     # in-process source too would re-materialize/re-index the corpus in
     # the trainer for nothing.  Any in-process consumer (eval, BLEU, HF
@@ -530,7 +559,7 @@ def run(args: argparse.Namespace) -> RunResult:
                                  transform=args.data_transform)
     else:
         dir_kind = None
-        source = get_dataset(entry["dataset"], **entry["dataset_kwargs"])
+        source = get_dataset(entry["dataset"], **_dataset_kwargs(entry, args))
     service_spec = None
     if args.data_workers > 0:
         # pack-seq already rejected at arg validation; multiprocess is
@@ -548,7 +577,7 @@ def run(args: argparse.Namespace) -> RunResult:
                            "transform": args.data_transform})
         else:
             service_spec = SourceSpec(entry["dataset"],
-                                      dict(entry["dataset_kwargs"]))
+                                      _dataset_kwargs(entry, args))
     eval_source = source
     if (args.eval_steps > 0 or args.bleu_eval > 0) and not args.eval_split:
         # Keras validation_data semantics imply HELD-OUT data; without

@@ -65,6 +65,16 @@ def _setup():
              strategy="dp", global_batch_size=1024,
              learning_rate=0.4, lr_schedule="resnet_steps",
              warmup_ratio=0.05)
+    # s2d + 2-strided BN statistics (the BN-HBM-traffic attack variant,
+    # PROFILE.md): CLI-trainable so its convergence can be certified
+    # against resnet50_imagenet_s2d before it claims the headline.
+    register("resnet50_imagenet_s2d_bnsub",
+             task_factory=lambda: resnet.make_task(
+                 resnet.RESNET_PRESETS["resnet50_s2d_bnsub"]),
+             dataset="imagenet", dataset_kwargs=dict(space_to_depth=True),
+             strategy="dp", global_batch_size=1024,
+             learning_rate=0.4, lr_schedule="resnet_steps",
+             warmup_ratio=0.05)
     register("resnet_tiny",
              task_factory=lambda: resnet.make_task(
                  resnet.RESNET_PRESETS["resnet_tiny"],

@@ -26,6 +26,7 @@ blocks, grid innermost over the reduction axis).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -38,6 +39,13 @@ _NEG = -1e30  # big finite negative: avoids -inf − -inf = NaN in masking
 def _use_pallas(override: Optional[bool]) -> bool:
     if override is not None:
         return override
+    # Kill switch for on-chip A/B (tools/chip_playbook.sh): the custom-VJP
+    # kernels block XLA fusion around them, so their win must be measured,
+    # not assumed — TTD_NO_PALLAS=1 falls back to the pure-jax path.
+    # ("0"/"false"/empty mean OFF — a raw truthiness check would make
+    # TTD_NO_PALLAS=0 silently disable the kernels and corrupt the A/B.)
+    if os.environ.get("TTD_NO_PALLAS", "").lower() not in ("", "0", "false"):
+        return False
     return jax.default_backend() == "tpu"
 
 

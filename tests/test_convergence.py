@@ -1,0 +1,134 @@
+"""Multi-epoch mini-convergence: sustained training actually converges.
+
+The reference's north star is training runs whose loss curves match the
+baseline (BASELINE.json); these tests are the CPU-mesh scale model of
+that contract (VERDICT r3 items 5/8): a few hundred steps over several
+epochs through the REAL CLI must show a decreasing loss for each family,
+and the strided-BN-statistics variant (``resnet50_s2d_bnsub``) must
+track the exact-BN baseline closely enough to be a legitimate headline
+config.  The committed artifacts under ``profiles/convergence/`` are the
+300-step versions of exactly these runs (rendered by
+``tools/render_convergence.py``).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # fit-heavy: full-suite tier
+
+from tensorflow_train_distributed_tpu import launch
+
+
+def _losses(argv):
+    result = launch.run(launch.build_parser().parse_args(argv))
+    losses = np.asarray(result.history["loss"], np.float64)
+    assert np.isfinite(losses).all()
+    return losses
+
+
+def _quarter_means(losses):
+    q = max(1, len(losses) // 4)
+    return float(losses[:q].mean()), float(losses[-q:].mean())
+
+
+class TestMiniConvergence:
+    def test_bert_mlm_multi_epoch_loss_decreases(self):
+        # 256 examples / batch 16 = 16 steps/epoch → 80 steps = 5 epochs.
+        losses = _losses([
+            "--config", "bert_tiny_mlm", "--steps", "80",
+            "--global-batch-size", "16", "--log-every", "1",
+            "--dataset-kwarg", "num_examples=256"])
+        first, last = _quarter_means(losses)
+        assert last < 0.9 * first, (first, last)
+
+    def test_decoder_multi_epoch_loss_decreases(self):
+        losses = _losses([
+            "--config", "llama_tiny_sft", "--steps", "80",
+            "--global-batch-size", "16", "--log-every", "1",
+            "--dataset-kwarg", "num_examples=256"])
+        first, last = _quarter_means(losses)
+        assert last < 0.9 * first, (first, last)
+
+    def test_bnsub_tracks_exact_bn_statistics(self):
+        """Pre-certification for the bnsub headline claim: subsampled
+        BN statistics must not change the training trajectory
+        materially — final-quarter loss gap under 15% of the baseline's
+        total drop on identical data/seed/LR."""
+        argv_tail = [
+            "--steps", "80", "--global-batch-size", "8",
+            "--log-every", "1", "--lr-schedule", "constant",
+            "--learning-rate", "0.01",
+            "--dataset-kwarg", "image_size=32",
+            "--dataset-kwarg", "num_examples=256",
+            "--dataset-kwarg", "num_classes=100"]
+        base = _losses(["--config", "resnet50_imagenet_s2d"] + argv_tail)
+        sub = _losses(["--config", "resnet50_imagenet_s2d_bnsub"]
+                      + argv_tail)
+        b_first, b_last = _quarter_means(base)
+        s_first, s_last = _quarter_means(sub)
+        drop = b_first - b_last
+        assert drop > 0, "baseline did not converge; test is vacuous"
+        # Identical data + init: trajectories start together...
+        np.testing.assert_allclose(base[0], sub[0], rtol=0.05)
+        # ...and end together, within a sliver of the achieved drop.
+        assert abs(b_last - s_last) < 0.15 * drop, (
+            f"bnsub diverged: baseline {b_last:.4f} vs bnsub "
+            f"{s_last:.4f} (drop {drop:.4f})")
+
+
+class TestDatasetKwargOverride:
+    def test_values_parse_as_json(self):
+        entry = {"dataset_kwargs": {"image_size": 224}}
+        args = launch.build_parser().parse_args([
+            "--config", "mnist",
+            "--dataset-kwarg", "image_size=64",
+            "--dataset-kwarg", "name=foo",
+            "--dataset-kwarg", "space_to_depth=true"])
+        kw = launch._dataset_kwargs(entry, args)
+        assert kw == {"image_size": 64, "name": "foo",
+                      "space_to_depth": True}
+
+    def test_malformed_pair_rejected(self):
+        entry = {"dataset_kwargs": {}}
+        args = launch.build_parser().parse_args([
+            "--config", "mnist", "--dataset-kwarg", "image_size"])
+        with pytest.raises(SystemExit, match="KEY=VALUE"):
+            launch._dataset_kwargs(entry, args)
+
+    def test_incompatible_with_data_dir(self, tmp_path):
+        with pytest.raises(SystemExit, match="data-dir"):
+            launch.run(launch.build_parser().parse_args([
+                "--config", "mnist", "--steps", "1",
+                "--data-dir", str(tmp_path),
+                "--dataset-kwarg", "image_size=64"]))
+
+
+def test_render_convergence_report(tmp_path):
+    """Renderer: curves → sparkline report with the A/B section."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "render_convergence_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "render_convergence.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rng = np.random.default_rng(0)
+    for name, offset in (("resnet50_imagenet_s2d_32px", 0.0),
+                         ("resnet50_imagenet_s2d_bnsub_32px", 0.01)):
+        with open(tmp_path / f"{name}.jsonl", "w") as fh:
+            for i in range(100):
+                loss = 5.0 * np.exp(-i / 40) + offset + rng.normal(0, 0.01)
+                fh.write(json.dumps({"step": i + 1, "loss": loss}) + "\n")
+    assert mod.main(["--dir", str(tmp_path), "--write"]) == 0
+    report = (tmp_path / "README.md").read_text()
+    assert "bnsub numerics certification" in report
+    assert "final-quarter loss gap" in report
+    for c in mod.BLOCKS:
+        if c in report:
+            break
+    else:
+        pytest.fail("no sparkline characters in report")

@@ -111,3 +111,21 @@ class TestFusedCrossEntropy:
         got = pk.fused_cross_entropy(logits, labels, use_pallas=False)
         np.testing.assert_allclose(
             got, pk.cross_entropy_reference(logits, labels), rtol=1e-6)
+
+
+def test_env_kill_switch_disables_pallas(monkeypatch):
+    """TTD_NO_PALLAS=1 (the chip-playbook A/B switch) forces the
+    pure-jax path regardless of backend; explicit overrides still win."""
+    monkeypatch.setenv("TTD_NO_PALLAS", "1")
+    assert pk._use_pallas(None) is False
+    assert pk._use_pallas(True) is True
+    # "0"/"false" mean OFF — TTD_NO_PALLAS=0 must NOT disable kernels.
+    monkeypatch.setenv("TTD_NO_PALLAS", "0")
+    assert pk._use_pallas(None) is (__import__("jax").default_backend()
+                                    == "tpu")
+    monkeypatch.setenv("TTD_NO_PALLAS", "false")
+    assert pk._use_pallas(None) is (__import__("jax").default_backend()
+                                    == "tpu")
+    monkeypatch.delenv("TTD_NO_PALLAS")
+    # Default is backend-keyed (cpu in tests → False).
+    assert pk._use_pallas(None) is False

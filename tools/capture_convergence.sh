@@ -1,0 +1,34 @@
+#!/bin/bash
+# Mini-convergence capture: the EXACT recipes behind the committed
+# profiles/convergence/*.jsonl artifacts (300 steps each through the
+# real CLI on the host CPU; ~25 min on a 1-core box).  Re-render the
+# report afterwards: python tools/render_convergence.py --write
+# CI pins 80-step versions of the same runs (tests/test_convergence.py).
+set -e
+cd "$(dirname "$0")/.."
+OUT=profiles/convergence
+# bnsub certification pair: identical data/seed/LR; only BN statistics differ.
+for cfg in resnet50_imagenet_s2d resnet50_imagenet_s2d_bnsub; do
+  rm -f $OUT/${cfg}_32px.jsonl
+  timeout 3000 python -m tensorflow_train_distributed_tpu \
+      --config $cfg --steps 300 --global-batch-size 8 --platform cpu \
+      --log-every 1 --lr-schedule constant --learning-rate 0.01 \
+      --dataset-kwarg image_size=32 --dataset-kwarg num_examples=512 \
+      --dataset-kwarg num_classes=100 \
+      --jsonl-log $OUT/${cfg}_32px.jsonl >/dev/null 2>&1
+  echo "done: $cfg"
+done
+# Multi-epoch mini-convergence: 1024 examples / batch 16 = 64 steps/epoch,
+# 300 steps = ~4.7 epochs.
+rm -f $OUT/bert_tiny_mlm.jsonl $OUT/llama_tiny_sft.jsonl
+timeout 3000 python -m tensorflow_train_distributed_tpu \
+    --config bert_tiny_mlm --steps 300 --global-batch-size 16 \
+    --platform cpu --log-every 1 --dataset-kwarg num_examples=1024 \
+    --jsonl-log $OUT/bert_tiny_mlm.jsonl >/dev/null 2>&1
+echo "done: bert_tiny_mlm"
+timeout 3000 python -m tensorflow_train_distributed_tpu \
+    --config llama_tiny_sft --steps 300 --global-batch-size 16 \
+    --platform cpu --log-every 1 --dataset-kwarg num_examples=1024 \
+    --jsonl-log $OUT/llama_tiny_sft.jsonl >/dev/null 2>&1
+echo "done: llama_tiny_sft"
+echo ALL_DONE

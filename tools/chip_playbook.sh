@@ -1,0 +1,48 @@
+#!/bin/bash
+# Chip-return playbook — run ONCE, top to bottom, the moment the TPU
+# tunnel answers (probe first: timeout 45 python -c "import jax;
+# print(jax.devices())").  Encodes VERDICT r3 items 1-3: the
+# three-rounds-missing BERT number first, then the persisted multi-family
+# capture, then the unmeasured perf levers (no_ffn remat policy, pallas
+# kernel A/B).  Every tool takes the host-wide chip lock itself
+# (runtime/chip_lock.py) — but never run two of these concurrently
+# anyway: concurrent tunnel use corrupts timings (PROFILE.md).
+#
+# Afterwards: fold the numbers into PROFILE.md (replace "chip measurement
+# pending") and commit profiles/bench/last_tpu_result.json.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/chip_results_$(date +%H%M).log}
+say() { echo "$@" | tee -a "$LOG"; }
+say "=== chip playbook start $(date -u) ==="
+
+say "--- 1. BERT-base MLM samples/sec (BASELINE.md driver metric) ---"
+timeout 1200 python tools/bench_bert.py --preset bert_base \
+    --batch-per-chip 32 --seq 128 --warmup 3 --iters 20 \
+    2>>"$LOG" | tee -a "$LOG"
+
+say "--- 2. bench.py live multi-family capture (persists the record) ---"
+timeout 3600 python bench.py --acquire-timeout 300 2>>"$LOG" | tee -a "$LOG"
+
+say "--- 3. decoder remat_policy=no_ffn at b8 then b12 ---"
+for B in 8 12; do
+  timeout 1200 python tools/bench_lm.py --preset llama_125m \
+      --batch-per-chip $B --seq 2048 --remat --remat-policy no_ffn \
+      2>>"$LOG" | tee -a "$LOG"
+done
+
+say "--- 4. pallas kernel A/B (rms_norm + fused CE vs pure-jax/XLA) ---"
+say "  4a. pallas ON (default on tpu):"
+timeout 1200 python tools/bench_lm.py --preset llama_125m \
+    --batch-per-chip 8 --seq 2048 --no-remat 2>>"$LOG" | tee -a "$LOG"
+say "  4b. pallas OFF (TTD_NO_PALLAS=1):"
+TTD_NO_PALLAS=1 timeout 1200 python tools/bench_lm.py --preset llama_125m \
+    --batch-per-chip 8 --seq 2048 --no-remat 2>>"$LOG" | tee -a "$LOG"
+
+say "--- 5. decode throughput (serving) ---"
+timeout 1200 python tools/bench_generate.py --preset llama_125m \
+    --batch 8 --prompt-len 128 --max-new 256 2>>"$LOG" | tee -a "$LOG"
+
+say "=== playbook done $(date -u); results in $LOG ==="
+say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
+say "pallas verdict from 4 — keep whichever wins as the default)."
