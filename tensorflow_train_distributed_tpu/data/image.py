@@ -86,8 +86,57 @@ def _pil_image():
     return Image
 
 
+_NATIVE_JPEG_OK: "bool | None" = None
+
+
+def _native_jpeg_parity_ok() -> bool:
+    """One-time self-check: the system libjpeg the native decoder links
+    must produce the SAME pixels as PIL's bundled one on a
+    chroma-subsampled probe, or the crc32-seeded augmentation contract
+    ("identical on every worker and restart") would silently break on
+    fleets with heterogeneous libjpeg variants — mismatch falls back to
+    PIL everywhere."""
+    global _NATIVE_JPEG_OK
+    if _NATIVE_JPEG_OK is None:
+        try:
+            from tensorflow_train_distributed_tpu.native import (
+                jpeg as njpeg,
+            )
+
+            Image = _pil_image()
+            y, x = np.mgrid[0:48, 0:64]
+            probe = np.stack(
+                [y * 5 % 256, x * 3 % 256, (y + x) * 7 % 256],
+                axis=-1).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(probe).save(buf, "JPEG", quality=85)
+            data = buf.getvalue()
+            with Image.open(io.BytesIO(data)) as im:
+                pil = np.asarray(im.convert("RGB"), np.uint8)
+            _NATIVE_JPEG_OK = np.array_equal(njpeg.decode_rgb(data), pil)
+        except Exception:
+            _NATIVE_JPEG_OK = False
+    return _NATIVE_JPEG_OK
+
+
 def decode_image(data: bytes) -> np.ndarray:
-    """Encoded image bytes (JPEG/PNG/...) → uint8 [H, W, 3] RGB."""
+    """Encoded image bytes (JPEG/PNG/...) → uint8 [H, W, 3] RGB.
+
+    JPEGs take the native libjpeg path when built AND bit-identical to
+    PIL on a runtime probe (``_native_jpeg_parity_ok`` — both stacks are
+    libjpeg underneath, but heterogeneous fleets could link different
+    variants); PNG/exotic color spaces/missing toolchain fall back to
+    PIL.  Batch consumers wanting GIL-free threaded decode use
+    ``native.jpeg.decode_batch`` directly.
+    """
+    if data[:2] == b"\xff\xd8":  # JPEG SOI marker
+        from tensorflow_train_distributed_tpu.native import jpeg as njpeg
+
+        if njpeg.available() and _native_jpeg_parity_ok():
+            try:
+                return njpeg.decode_rgb(data)
+            except ValueError:
+                pass  # CMYK/YCCK or corrupt: let PIL decide
     Image = _pil_image()
 
     with Image.open(io.BytesIO(data)) as im:
@@ -140,6 +189,16 @@ def center_crop(img: np.ndarray, size: int,
     return resized[top:top + size, left:left + size]
 
 
+def _train_crop_u8(data: bytes, size: int, epoch: int) -> np.ndarray:
+    """Shared decode/crop/flip core: JPEG bytes → augmented uint8 crop."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([zlib.crc32(data), int(epoch)]))
+    img = random_resized_crop(decode_image(data), size, rng)
+    if rng.random() < 0.5:
+        img = img[:, ::-1]
+    return img
+
+
 def imagenet_train_record(rec: dict, *, size: int = 224,
                           epoch: int = 0) -> dict:
     """JPEG record → augmented training record (decode/crop/flip/norm).
@@ -150,12 +209,22 @@ def imagenet_train_record(rec: dict, *, size: int = 224,
     (``filesource.fetch_record`` / ``transform_is_epoch_aware``).
     """
     data = _encoded_bytes(rec)
-    rng = np.random.default_rng(
-        np.random.SeedSequence([zlib.crc32(data), int(epoch)]))
-    img = random_resized_crop(decode_image(data), size, rng)
-    if rng.random() < 0.5:
-        img = img[:, ::-1]
-    return {"image": np.ascontiguousarray(_normalize(img)),
+    return {"image": np.ascontiguousarray(
+                _normalize(_train_crop_u8(data, size, epoch))),
+            "label": _label(rec)}
+
+
+def imagenet_train_record_u8(rec: dict, *, size: int = 224,
+                             epoch: int = 0) -> dict:
+    """Like ``imagenet_train_record`` but ships RAW uint8 pixels —
+    normalization happens ON DEVICE (``models.resnet`` normalizes uint8
+    inputs with the ImageNet constants; XLA fuses it into the stem
+    conv).  4x less host→device transfer and no host-side f32 math —
+    the TPU-first layout for input-bound hosts (tools/bench_input.py
+    measures the delta)."""
+    data = _encoded_bytes(rec)
+    return {"image": np.ascontiguousarray(_train_crop_u8(
+                data, size, epoch)),
             "label": _label(rec)}
 
 
@@ -165,20 +234,31 @@ def imagenet_eval_record(rec: dict, *, size: int = 224) -> dict:
     return {"image": _normalize(img), "label": _label(rec)}
 
 
-_NAME_RE = re.compile(r"imagenet_(train|eval)_(\d+)$")
+def imagenet_eval_record_u8(rec: dict, *, size: int = 224) -> dict:
+    """Uint8 twin of ``imagenet_eval_record`` (device-side normalize)."""
+    img = center_crop(decode_image(_encoded_bytes(rec)), size)
+    return {"image": np.ascontiguousarray(img), "label": _label(rec)}
+
+
+_NAME_RE = re.compile(r"imagenet_(train|eval)(_u8)?_(\d+)$")
 
 
 def ensure_registered(name: str) -> None:
-    """Register ``imagenet_(train|eval)_{SIZE}`` for ANY size on demand —
-    the size is encoded in the name, so no fixed list gates resolutions."""
+    """Register ``imagenet_(train|eval)[_u8]_{SIZE}`` for ANY size on
+    demand — the size is encoded in the name, so no fixed list gates
+    resolutions (``_u8`` ships raw pixels for device-side normalize)."""
     m = _NAME_RE.fullmatch(name)
     if m is None:
         return
     from tensorflow_train_distributed_tpu.data.filesource import TRANSFORMS
 
-    fn = (imagenet_train_record if m.group(1) == "train"
-          else imagenet_eval_record)
-    TRANSFORMS.setdefault(name, partial(fn, size=int(m.group(2))))
+    if m.group(2):  # _u8
+        fn = (imagenet_train_record_u8 if m.group(1) == "train"
+              else imagenet_eval_record_u8)
+    else:
+        fn = (imagenet_train_record if m.group(1) == "train"
+              else imagenet_eval_record)
+    TRANSFORMS.setdefault(name, partial(fn, size=int(m.group(3))))
 
 
 def register_transforms() -> None:

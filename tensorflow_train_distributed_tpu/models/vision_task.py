@@ -21,24 +21,59 @@ from tensorflow_train_distributed_tpu.ops.losses import (
 
 class VisionTask:
     def __init__(self, model, *, label_smoothing: float = 0.0,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0,
+                 uint8_mean_std=None):
         self.model = model
         self.label_smoothing = label_smoothing
         self.weight_decay = weight_decay
+        # (mean, std) per channel in 0..255 pixel units: enables the
+        # ship-raw-uint8 input contract (imagenet_*_u8_* transforms) —
+        # hosts send raw bytes, normalization happens on device.
+        self.uint8_mean_std = uint8_mean_std
+
+    def _prep_image(self, image, params):
+        """Device-side normalization for uint8 image batches.
+
+        The ship-raw transforms move 4x less host→device data and skip
+        host f32 math; here the raw pixels normalize in f32 and then
+        JOIN THE POLICY COMPUTE DTYPE — taken from the already-cast
+        params — so a bfloat16 policy keeps bf16 convs (an f32
+        activations path would silently promote every conv to f32).
+        0..255 and the affine are exact in f32, so this is bit-identical
+        to host-side normalization followed by the policy cast.
+        """
+        if image.dtype != jnp.uint8:
+            return image
+        if self.uint8_mean_std is None:
+            raise ValueError(
+                "this task received a uint8 image batch but has no "
+                "uint8_mean_std normalization constants; use a float "
+                "transform (e.g. imagenet_train_224 / u8_image_to_f32) "
+                "or construct the task with uint8_mean_std=(mean, std) "
+                "in 0..255 pixel units")
+        mean, std = self.uint8_mean_std
+        reps = image.shape[-1] // len(mean)  # host-s2d ships 4x3 channels
+        mean = jnp.tile(jnp.asarray(mean, jnp.float32), reps)
+        std = jnp.tile(jnp.asarray(std, jnp.float32), reps)
+        x = (image.astype(jnp.float32) - mean) / std
+        leaves = jax.tree.leaves(params)
+        return x.astype(leaves[0].dtype) if leaves else x
 
     def init_variables(self, rng, batch):
-        return self.model.init(rng, batch["image"], train=False)
+        return self.model.init(rng, self._prep_image(batch["image"], {}),
+                               train=False)
 
     def loss_fn(self, params, model_state, batch, rng, train):
         variables = {"params": params, **model_state}
+        image = self._prep_image(batch["image"], params)
         if train and model_state:
             logits, updates = self.model.apply(
-                variables, batch["image"], train=True,
+                variables, image, train=True,
                 mutable=list(model_state.keys()),
             )
             new_model_state = updates
         else:
-            logits = self.model.apply(variables, batch["image"], train=train)
+            logits = self.model.apply(variables, image, train=train)
             new_model_state = model_state
         # Per-example weights (the padded-final-batch eval contract,
         # data.pipeline drop_remainder=False): pad rows carry weight 0 so
@@ -77,4 +112,5 @@ class VisionTask:
     def predict_fn(self, params, model_state, batch):
         """Inference logits (Trainer.predict contract)."""
         return self.model.apply({"params": params, **model_state},
-                                batch["image"], train=False)
+                                self._prep_image(batch["image"], params),
+                                train=False)

@@ -9,6 +9,10 @@ host-side runtime around it keeps two native components:
                   (the tf.data-kernel analog), `src/staging.cpp`.
 - ``ringcoll``  — TCP ring allreduce/broadcast for host/DCN-side data
                   (the `RingAlg`/`RingReducer` analog), `src/ringcoll.cpp`.
+- ``jpegdec``   — libjpeg decode with a GIL-free thread pool + DCT-domain
+                  downscaling (the tf.image JPEG-kernel analog),
+                  `src/jpegdec.cpp` — built as a SEPARATE library
+                  (links -ljpeg) so this one keeps zero external deps.
 
 The shared library builds on demand with g++ (no pybind11 in this
 environment — plain C ABI + ctypes).  Environments without a toolchain
@@ -31,9 +35,14 @@ _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libttd_native.so")
 _SOURCES = ("staging.cpp", "ringcoll.cpp")
 
+_JPEG_LIB_PATH = os.path.join(_BUILD_DIR, "libttd_jpeg.so")
+_JPEG_SOURCE = "jpegdec.cpp"
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_jpeg_lib: Optional[ctypes.CDLL] = None
+_jpeg_load_failed = False
 
 
 def _needs_build() -> bool:
@@ -46,19 +55,30 @@ def _needs_build() -> bool:
     )
 
 
+def _compile_shared(sources, out_path, extra_flags=()) -> None:
+    """g++ → temp file → atomic rename: concurrent processes (e.g. a
+    --data-workers fleet all lazily building on first decode) never
+    dlopen a half-written .so."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
+           *sources, "-o", tmp, *extra_flags]
+    logger.info("building native library: %s", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def build(force: bool = False) -> str:
     """Compile the native library (idempotent; mtime-cached)."""
     with _lock:
         if not force and not _needs_build():
             return _LIB_PATH
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        cmd = [
-            "g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
-            *(os.path.join(_SRC_DIR, s) for s in _SOURCES),
-            "-o", _LIB_PATH,
-        ]
-        logger.info("building native library: %s", " ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        _compile_shared(
+            [os.path.join(_SRC_DIR, s) for s in _SOURCES], _LIB_PATH)
         return _LIB_PATH
 
 
@@ -78,6 +98,52 @@ def load_library() -> Optional[ctypes.CDLL]:
                        "fallbacks", detail.strip()[:500])
         _load_failed = True
     return _lib
+
+
+def load_jpeg_library() -> Optional[ctypes.CDLL]:
+    """Build (g++ -ljpeg) and dlopen the JPEG decoder; None when the
+    toolchain or libjpeg is missing — callers keep the PIL path."""
+    global _jpeg_lib, _jpeg_load_failed
+    if _jpeg_lib is not None or _jpeg_load_failed:
+        return _jpeg_lib
+    with _lock:
+        if _jpeg_lib is not None or _jpeg_load_failed:
+            return _jpeg_lib
+        try:
+            src = os.path.join(_SRC_DIR, _JPEG_SOURCE)
+            if (not os.path.exists(_JPEG_LIB_PATH)
+                    or os.path.getmtime(src)
+                    > os.path.getmtime(_JPEG_LIB_PATH)):
+                _compile_shared([src], _JPEG_LIB_PATH,
+                                extra_flags=("-ljpeg",))
+            lib = ctypes.CDLL(_JPEG_LIB_PATH)
+            _bind_jpeg_signatures(lib)
+            _jpeg_lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("jpeg library unavailable (%s); using PIL",
+                           detail.strip()[:500])
+            _jpeg_load_failed = True
+    return _jpeg_lib
+
+
+def _bind_jpeg_signatures(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u8pp = ctypes.POINTER(u8p)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int)
+
+    lib.ttd_jpeg_dims.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int, i32p, i32p]
+    lib.ttd_jpeg_dims.restype = ctypes.c_int
+    lib.ttd_jpeg_decode_rgb.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int, u8p, ctypes.c_uint64,
+        i32p, i32p]
+    lib.ttd_jpeg_decode_rgb.restype = ctypes.c_int
+    lib.ttd_jpeg_decode_batch.argtypes = [
+        ctypes.c_int, u8pp, u64p, ctypes.c_int, u8pp, u64p,
+        i32p, i32p, i32p, ctypes.c_int]
+    lib.ttd_jpeg_decode_batch.restype = ctypes.c_int
 
 
 def _bind_signatures(lib: ctypes.CDLL) -> None:

@@ -151,6 +151,9 @@ def test_bench_input_cpu_smoke():
         capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stderr[-800:]
     rec = json.loads(out.stdout.strip().splitlines()[-1])
-    assert set(rec["modes"]) == {"inprocess", "workers2",
+    assert set(rec["modes"]) == {"inprocess", "inprocess_u8", "workers2",
                                  "mmap_predecoded"}
     assert all(v > 0 for v in rec["modes"].values())
+    assert rec["decode_modes"]["pil"] > 0
+    if any(k.startswith("native") for k in rec["decode_modes"]):
+        assert rec["decode_modes"]["native_t1"] > 0

@@ -237,6 +237,134 @@ class TestPerEpochAugmentation:
             np.testing.assert_array_equal(x["image"], y["image"])
 
 
+class TestUint8DeviceNormalize:
+    """Ship-raw-uint8 transforms + device-side ImageNet normalization:
+    4x less host→device transfer, no host f32 math (measured +60%
+    in-process host throughput, tools/bench_input.py)."""
+
+    def test_u8_transform_matches_f32_pre_normalize(self):
+        rng = np.random.default_rng(21)
+        data, _ = _jpeg_bytes(rng, 80, 60)
+        rec = {"jpeg": data, "label": 3}
+        u8 = I.imagenet_train_record_u8(rec, size=32, epoch=1)
+        f32 = I.imagenet_train_record(rec, size=32, epoch=1)
+        assert u8["image"].dtype == np.uint8
+        np.testing.assert_allclose(
+            I._normalize(u8["image"]), f32["image"], rtol=1e-6, atol=1e-6)
+        ev = I.imagenet_eval_record_u8(rec, size=32)
+        assert ev["image"].dtype == np.uint8
+
+    def test_u8_names_resolve_on_demand(self):
+        from tensorflow_train_distributed_tpu.data.filesource import (
+            resolve_transform,
+        )
+
+        fn = resolve_transform("imagenet_eval_u8_48")
+        rng = np.random.default_rng(22)
+        data, _ = _jpeg_bytes(rng, 64, 64)
+        rec = fn({"jpeg": data, "label": 1})
+        assert rec["image"].shape == (48, 48, 3)
+        assert rec["image"].dtype == np.uint8
+
+    def test_resnet_task_normalizes_uint8_on_device(self):
+        import jax
+
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        rng = np.random.default_rng(23)
+        u8 = rng.integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+        f32 = (((u8.astype(np.float32) / 255.0) - I.MEAN_RGB)
+               / I.STDDEV_RGB)
+        labels = np.array([1, 2], np.int32)
+        for preset in ("resnet_tiny", "resnet50_s2d"):
+            task = resnet.make_task(resnet.RESNET_PRESETS[preset],
+                                    label_smoothing=0.0, weight_decay=0.0)
+            variables = task.init_variables(
+                jax.random.key(0), {"image": f32, "label": labels})
+            state = {"batch_stats": variables["batch_stats"]}
+            la, _ = task.loss_fn(variables["params"], state,
+                                 {"image": f32, "label": labels},
+                                 None, False)
+            lb, _ = task.loss_fn(variables["params"], state,
+                                 {"image": u8, "label": labels},
+                                 None, False)
+            np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+
+    def test_resnet_task_normalizes_host_s2d_uint8(self):
+        """12-channel uint8 (host-side space_to_depth) tiles the
+        normalization constants in s2d channel order."""
+        import jax
+
+        from tensorflow_train_distributed_tpu.models import resnet
+        from tensorflow_train_distributed_tpu.models.resnet import (
+            space_to_depth,
+        )
+
+        rng = np.random.default_rng(24)
+        u8 = rng.integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+        f32 = (((u8.astype(np.float32) / 255.0) - I.MEAN_RGB)
+               / I.STDDEV_RGB)
+        labels = np.array([3, 4], np.int32)
+        task = resnet.make_task(resnet.RESNET_PRESETS["resnet50_s2d"],
+                                label_smoothing=0.0, weight_decay=0.0)
+        import jax.numpy as jnp
+
+        f32_s2d = np.asarray(space_to_depth(jnp.asarray(f32)))
+        u8_s2d = np.asarray(space_to_depth(jnp.asarray(u8)))
+        assert u8_s2d.dtype == np.uint8
+        variables = task.init_variables(
+            jax.random.key(0), {"image": f32_s2d, "label": labels})
+        state = {"batch_stats": variables["batch_stats"]}
+        la, _ = task.loss_fn(variables["params"], state,
+                             {"image": f32_s2d, "label": labels},
+                             None, False)
+        lb, _ = task.loss_fn(variables["params"], state,
+                             {"image": u8_s2d, "label": labels},
+                             None, False)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+
+    def test_prep_image_joins_policy_compute_dtype(self):
+        """Under a bf16 policy the normalized uint8 image must land in
+        bf16 (f32 activations would silently promote every conv to f32,
+        defeating the MXU win)."""
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models import resnet
+
+        task = resnet.make_task(resnet.RESNET_PRESETS["resnet_tiny"])
+        u8 = jnp.zeros((2, 8, 8, 3), jnp.uint8)
+        bf16_params = {"w": jnp.ones((3,), jnp.bfloat16)}
+        assert task._prep_image(u8, bf16_params).dtype == jnp.bfloat16
+        f32_params = {"w": jnp.ones((3,), jnp.float32)}
+        assert task._prep_image(u8, f32_params).dtype == jnp.float32
+        # float inputs pass through untouched (policy already cast them)
+        bf16_img = jnp.zeros((2, 8, 8, 3), jnp.bfloat16)
+        assert task._prep_image(bf16_img, f32_params) is bf16_img
+
+    def test_uint8_without_constants_fails_loudly(self):
+        from tensorflow_train_distributed_tpu.models.lenet import LeNet
+        from tensorflow_train_distributed_tpu.models.vision_task import (
+            VisionTask,
+        )
+
+        task = VisionTask(LeNet())
+        import jax.numpy as jnp
+
+        with pytest.raises(ValueError, match="uint8_mean_std"):
+            task._prep_image(jnp.zeros((1, 8, 8, 3), jnp.uint8), {})
+
+    def test_cli_trains_resnet_from_u8_transform(self, tmp_path):
+        from tensorflow_train_distributed_tpu import launch
+
+        root = _write_corpus(str(tmp_path))
+        result = launch.run(launch.build_parser().parse_args([
+            "--config", "resnet_tiny", "--steps", "2",
+            "--global-batch-size", "8", "--data-dir", root,
+            "--data-transform", "imagenet_train_u8_32",
+            "--log-every", "1"]))
+        assert np.isfinite(result.history["loss"]).all()
+
+
 class TestJpegTfrecordPath:
     def test_raw_sidecar_roundtrip(self, tmp_path):
         from tensorflow_train_distributed_tpu.data.tfrecord import (

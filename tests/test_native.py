@@ -272,3 +272,96 @@ def test_mesh_world_one_is_noop():
     out = mesh.allreduce(np.asarray([3.0], np.float32))
     np.testing.assert_allclose(out, [3.0])
     mesh.close()
+
+
+# ---------------------------------------------------------------------------
+# Native JPEG decoder (src/jpegdec.cpp): libjpeg + GIL-free thread pool.
+# ---------------------------------------------------------------------------
+
+
+def _jpeg(rng, h, w, gray=False):
+    import io
+
+    from PIL import Image
+
+    arr = rng.integers(0, 255, (h, w) if gray else (h, w, 3)).astype(
+        np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+def _njpeg():
+    from tensorflow_train_distributed_tpu.native import jpeg as njpeg
+
+    if not njpeg.available():
+        pytest.skip("native jpeg library not available (toolchain/libjpeg)")
+    return njpeg
+
+
+def test_jpeg_decode_matches_pil_exactly():
+    """Both stacks are libjpeg underneath: outputs are bit-identical,
+    so the native fast path in decode_image changes no pixels."""
+    import io
+
+    from PIL import Image
+
+    njpeg = _njpeg()
+    rng = np.random.default_rng(0)
+    data = _jpeg(rng, 97, 133)
+    nat = njpeg.decode_rgb(data)
+    with Image.open(io.BytesIO(data)) as im:
+        pil = np.asarray(im.convert("RGB"), np.uint8)
+    np.testing.assert_array_equal(nat, pil)
+
+
+def test_jpeg_grayscale_converts_to_rgb():
+    njpeg = _njpeg()
+    data = _jpeg(np.random.default_rng(1), 40, 56, gray=True)
+    out = njpeg.decode_rgb(data)
+    assert out.shape == (40, 56, 3)
+    # Gray → identical channels.
+    np.testing.assert_array_equal(out[..., 0], out[..., 1])
+
+
+def test_jpeg_scale_denom_dims():
+    njpeg = _njpeg()
+    data = _jpeg(np.random.default_rng(2), 96, 132)
+    assert njpeg.output_dims(data, 1) == (96, 132)
+    assert njpeg.output_dims(data, 2) == (48, 66)
+    assert njpeg.output_dims(data, 4) == (24, 33)
+    half = njpeg.decode_rgb(data, scale_denom=2)
+    assert half.shape == (48, 66, 3)
+
+
+def test_jpeg_batch_threaded_matches_single_and_flags_failures():
+    njpeg = _njpeg()
+    rng = np.random.default_rng(3)
+    datas = [_jpeg(rng, int(rng.integers(30, 90)),
+                   int(rng.integers(30, 90))) for _ in range(12)]
+    datas.insert(5, b"not a jpeg at all")
+    out = njpeg.decode_batch(datas, num_threads=4)
+    assert out[5] is None
+    for i, data in enumerate(datas):
+        if i == 5:
+            continue
+        np.testing.assert_array_equal(out[i], njpeg.decode_rgb(data))
+
+
+def test_jpeg_garbage_raises_cleanly():
+    njpeg = _njpeg()
+    with pytest.raises(ValueError):
+        njpeg.decode_rgb(b"\xff\xd8garbage-after-soi")
+    with pytest.raises(ValueError):
+        njpeg.output_dims(b"")
+
+
+def test_decode_image_uses_native_path_transparently():
+    """data.image.decode_image must yield identical pixels whether the
+    native library is present or not (PIL fallback parity)."""
+    from tensorflow_train_distributed_tpu.data import image as I
+
+    njpeg = _njpeg()
+    data = _jpeg(np.random.default_rng(4), 50, 70)
+    np.testing.assert_array_equal(I.decode_image(data),
+                                  njpeg.decode_rgb(data))
