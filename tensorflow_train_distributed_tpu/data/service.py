@@ -130,24 +130,40 @@ def _worker_main(spec: SourceSpec, config: DataConfig, shard_index: int,
 
 
 class DataServiceDispatcher:
-    """Owns the worker fleet; hands out a connected client.
+    """Owns this host's worker fleet; hands out a connected client.
 
-    ``num_workers`` workers each produce ``global_batch/num_workers``
-    examples per step (the per-worker rebatch rule,
-    ``batch_sizes_for_worker``); the client reassembles full global
-    batches, so the trainer sees exactly the single-process loader
-    contract.
+    ``num_workers`` workers each produce
+    ``global_batch/(host_count*num_workers)`` examples per step (the
+    per-worker rebatch rule, ``batch_sizes_for_worker``); the client
+    reassembles this HOST's share (``global_batch/host_count`` rows), so
+    the trainer sees exactly the per-process loader contract.
+
+    Multi-host (the reference's tf.data service over a worker cluster):
+    every host runs its own dispatcher with its ``host_index``; worker w
+    of host h autoshard-slices the corpus as process h·W+w of H·W.  The
+    union over all hosts' workers covers each epoch exactly once and
+    every host draws the same number of batches — the SPMD contract —
+    though the record→host assignment differs from the in-process
+    loader's h-of-H striding (same property the reference's
+    ``distribute`` has: sharding granularity follows the worker fleet).
     """
 
     def __init__(self, spec: SourceSpec, config: DataConfig,
-                 num_workers: int = 2):
-        if config.global_batch_size % num_workers:
+                 num_workers: int = 2, *, host_index: int = 0,
+                 host_count: int = 1):
+        shards = host_count * num_workers
+        if config.global_batch_size % shards:
             raise ValueError(
                 f"global_batch_size={config.global_batch_size} not "
-                f"divisible by num_workers={num_workers}")
+                f"divisible by host_count*num_workers={shards}")
+        if not 0 <= host_index < host_count:
+            raise ValueError(
+                f"host_index={host_index} outside [0, {host_count})")
         self.spec = spec
         self.config = config
         self.num_workers = num_workers
+        self.host_index = host_index
+        self.host_count = host_count
         self._procs: list[mp.process.BaseProcess] = []
         self.ports: list[int] = []
 
@@ -159,7 +175,9 @@ class DataServiceDispatcher:
         for w in range(self.num_workers):
             p = ctx.Process(
                 target=_worker_main,
-                args=(self.spec, self.config, w, self.num_workers,
+                args=(self.spec, self.config,
+                      self.host_index * self.num_workers + w,
+                      self.host_count * self.num_workers,
                       queues[w]),
                 daemon=True,
             )
@@ -208,7 +226,8 @@ class DataServiceDispatcher:
 
 
 class DataServiceClient:
-    """Iterates global batches assembled from every worker's shard."""
+    """Iterates this host's batch share assembled from its workers'
+    slices (the full global batch on a single-host cluster)."""
 
     def __init__(self, ports: list[int], host: str = "127.0.0.1"):
         self._socks = []

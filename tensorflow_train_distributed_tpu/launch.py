@@ -136,10 +136,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "--pack-seq")
     p.add_argument("--data-workers", type=int, default=0, metavar="N",
                    help="serve training batches from N out-of-process "
-                        "workers (the tf.data-service analog): record "
-                        "read + decode/augment CPU work runs in the "
-                        "workers, off the trainer's Python thread "
-                        "(single-host; synthetic and --data-dir sources)")
+                        "workers PER HOST (the tf.data-service analog): "
+                        "record read + decode/augment CPU work runs in "
+                        "the workers, off the trainer's Python thread; "
+                        "on a multi-host cluster each host runs its own "
+                        "fleet serving its batch share (synthetic and "
+                        "--data-dir sources)")
     p.add_argument("--data-transform", default=None,
                    help="named record transform for --data-dir (e.g. "
                         "u8_image_to_f32)")
@@ -567,10 +569,15 @@ def run(args: argparse.Namespace) -> RunResult:
         from tensorflow_train_distributed_tpu.data.service import SourceSpec
 
         if cluster.is_multiprocess:
-            raise SystemExit(
-                "--data-workers is single-host (per-host worker fleets "
-                "over a multiprocess cluster are not wired); drop the "
-                "flag or run single-process")
+            # Per-host worker fleets: every process runs its own
+            # dispatcher; worker w of host h autoshard-slices as process
+            # h*W+w of H*W (reference tf.data service over a cluster).
+            shards = cluster.num_processes * args.data_workers
+            if global_batch % shards:
+                raise SystemExit(
+                    f"--global-batch-size={global_batch} must divide by "
+                    f"num_hosts*data_workers={shards} (each worker "
+                    "serves one equal slice)")
         if args.data_dir:
             service_spec = SourceSpec(
                 dir_kind, {"root": args.data_dir,
@@ -847,7 +854,12 @@ def run(args: argparse.Namespace) -> RunResult:
                     service_spec,
                     DataConfig(global_batch_size=global_batch,
                                seed=args.seed),
-                    num_workers=args.data_workers).start()
+                    num_workers=args.data_workers,
+                    host_index=(cluster.process_id
+                                if cluster.is_multiprocess else 0),
+                    host_count=(cluster.num_processes
+                                if cluster.is_multiprocess else 1),
+                    ).start()
                 service = dispatcher
                 batches = iter(dispatcher.client())
             eval_kwargs = {}

@@ -133,6 +133,17 @@ def _host_ring_worker(rank, ports):
     return {"sum": float(out[0])}
 
 
+def _cli_service_train(rank):
+    """Real CLI with per-host input-worker fleets (tf.data service over a
+    cluster): every host spawns its own workers serving its batch share."""
+    from tensorflow_train_distributed_tpu import launch
+
+    result = launch.run(launch.build_parser().parse_args([
+        "--config", "mnist", "--steps", "4", "--global-batch-size", "16",
+        "--data-workers", "2", "--log-every", "1"]))
+    return {"losses": [float(x) for x in result.history["loss"]]}
+
+
 # --- tests ------------------------------------------------------------------
 
 
@@ -164,6 +175,18 @@ def test_input_autoshard_across_hosts():
     assert a["num_batches"] == b["num_batches"] == 8
     assert a["host_batch"] == b["host_batch"] == 8
     assert a["first_labels"] != b["first_labels"]  # disjoint shards
+
+
+def test_cli_data_workers_across_hosts():
+    """2-host cluster x 2 input workers each: the CLI trains with
+    per-host fleets and every host sees the SAME global loss stream
+    (the SPMD contract over service-fed batches)."""
+    results = MultiProcessRunner(
+        "test_multihost:_cli_service_train", 2, local_devices=2).run()
+    a, b = (r.value for r in results)
+    assert len(a["losses"]) == len(b["losses"]) == 4
+    np.testing.assert_allclose(a["losses"], b["losses"], rtol=1e-5)
+    assert np.isfinite(a["losses"]).all()
 
 
 def test_tf_config_cluster_resolution():
