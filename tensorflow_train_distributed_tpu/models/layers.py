@@ -143,6 +143,11 @@ class MultiHeadAttention(nn.Module):
     # effect when the ambient mesh (jax.set_mesh, as the Trainer binds)
     # has a seq axis > 1; self-attention only.
     seq_parallel: Optional[str] = None
+    # Sliding-window causal attention (Mistral convention): each query
+    # sees the last ``window`` keys including itself.  Long training
+    # sequences take the O(S·window) chunked path; decode masks the KV
+    # cache to the window.  Not composable with seq_parallel (yet).
+    window: Optional[int] = None
     # Autoregressive decode: keep a KV cache of ``cache_len`` positions in
     # the mutable "cache" collection; each call appends this call's k/v at
     # the running index and attends over the filled prefix.  Works for
@@ -236,6 +241,12 @@ class MultiHeadAttention(nn.Module):
                     "segment_ids), not dense masks")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
+            if self.window is not None:
+                raise ValueError(
+                    "sliding-window attention under seq_parallel is not "
+                    "wired (a window <= the shard span could skip ring "
+                    "hops; file as a perf follow-up) — drop seq_parallel "
+                    "or the window")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
                 import shard_mapped_attention
 
@@ -246,7 +257,7 @@ class MultiHeadAttention(nn.Module):
         else:
             out = multihead_attention_kernel(
                 qh, kh, vh, causal=self.causal, mask=mask,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, window=self.window,
             ).transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
             out, ("batch", "length", "heads", "kv"))
@@ -314,6 +325,11 @@ class MultiHeadAttention(nn.Module):
         vh = vh.transpose(0, 2, 1, 3)
         kv_pos = jnp.arange(self.cache_len)
         mask = kv_pos[None, :] <= positions[:, None]       # [q, cache]
+        if self.window is not None:
+            # Sliding window over the cache: only the last `window`
+            # positions (including self) stay visible.
+            mask = jnp.logical_and(
+                mask, kv_pos[None, :] > positions[:, None] - self.window)
         mask = mask[None, None]                            # [1, 1, q, cache]
         from tensorflow_train_distributed_tpu.ops.attention import (
             dot_product_attention,

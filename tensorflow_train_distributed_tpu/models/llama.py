@@ -62,6 +62,12 @@ class LlamaConfig:
     remat_policy: str = "full"
     # "ring" | "ulysses" | None — context parallelism over the seq mesh axis.
     seq_parallel: object = None
+    # Sliding-window causal attention (Mistral-7B convention): each token
+    # attends to the last ``sliding_window`` positions including itself.
+    # Long sequences take the O(S·window) chunked attention path — the
+    # long-context lever when full attention's S² won't fit; None = full
+    # causal attention.  Not composable with seq_parallel (loud error).
+    sliding_window: Optional[int] = None
     # GPipe microbatch count: when set AND the ambient mesh has a
     # ``pipeline`` axis > 1, the depth scan is replaced by the
     # ``parallel.pipeline`` schedule (each stage holds a contiguous layer
@@ -72,6 +78,12 @@ class LlamaConfig:
 
 LLAMA_PRESETS = {
     "llama2_7b": LlamaConfig(),
+    # Mistral-7B shape: GQA(8) + sliding-window 4096 over 32k positions —
+    # the long-context config where chunked local attention replaces the
+    # S² score matrix.
+    "mistral_7b": LlamaConfig(num_kv_heads=8, ffn_size=14_336,
+                              max_positions=32_768, rope_base=1e6,
+                              sliding_window=4096),
     "llama2_13b": LlamaConfig(d_model=5120, num_layers=40, num_heads=40,
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
@@ -134,6 +146,7 @@ class DecoderBlock(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
+            window=cfg.sliding_window,
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
             name="attention",

@@ -29,9 +29,16 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
-    """Reference attention. q/k/v: [B, H, S, D] (q may have different S)."""
+    """Reference attention. q/k/v: [B, H, S, D] (q may have different S).
+
+    ``window`` (requires ``causal``): sliding-window attention — each
+    query sees only the last ``window`` keys including itself (the
+    Mistral convention), masked here exactly; this is the numerics
+    oracle for ``local_attention_chunked``.
+    """
     *_, q_len, head_dim = q.shape
     kv_len = k.shape[-2]
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
@@ -40,15 +47,95 @@ def dot_product_attention(
     # Large finite negative, not -inf: a fully-masked query row must produce
     # ~zeros after softmax, not NaN (all--inf rows NaN out the whole batch).
     mask_value = jnp.finfo(jnp.float32).min / 2
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     if causal:
         # Bottom-right aligned causal mask (supports q_len != kv_len).
         q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
         k_pos = jnp.arange(kv_len)[None, :]
-        logits = jnp.where(q_pos >= k_pos, logits, mask_value)
+        keep = q_pos >= k_pos
+        if window is not None:
+            keep = jnp.logical_and(keep, q_pos - k_pos < window)
+        logits = jnp.where(keep, logits, mask_value)
     if mask is not None:
         logits = jnp.where(mask, logits, mask_value)
     weights = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def local_attention_chunked(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    window: int,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Sliding-window causal self-attention in O(S·window), TPU-native.
+
+    Chunks the sequence into ``window``-sized blocks; each query block
+    attends to (previous block, own block) — exactly the keys its
+    sliding window can reach — so scores are [.., nc, w, 2w] instead of
+    [.., S, S]: no quadratic materialization, static shapes, plain
+    einsums XLA tiles onto the MXU.  Numerically matches
+    ``dot_product_attention(causal=True, window=w)`` (oracle-tested).
+
+    ``segment_ids`` [B, S] (sequence packing) stays structured: ids ride
+    the same shift-concat as the keys, so packing composes WITHOUT the
+    dense S×S mask.  Requires q_len == kv_len and q_len % window == 0
+    (the dispatcher falls back to the masked oracle otherwise).
+    """
+    *lead, s, d = q.shape
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if s % window or k.shape[-2] != s:
+        raise ValueError(
+            f"local_attention_chunked wants self-attention with seq "
+            f"divisible by window, got seq={s} window={window}")
+    w = window
+    nc = s // w
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    def chunk(t):  # [..., S, D] → [..., nc, w, D]
+        return t.reshape(*lead, nc, w, d)
+
+    def shift_concat(tc, pad_axes):
+        """(chunk i-1, chunk i) along the chunk axis; chunk -1 is zeros
+        (masked by pad_slot below)."""
+        prev = jnp.pad(tc[..., :-1, :, :] if tc.ndim > 3
+                       else tc[:, :-1, :], pad_axes)
+        return jnp.concatenate([prev, tc], axis=-2 if tc.ndim > 3 else -1)
+
+    qc = chunk(q)
+    pad4 = [(0, 0)] * len(lead) + [(1, 0), (0, 0), (0, 0)]
+    kwin = shift_concat(chunk(k), pad4)                  # [.., nc, 2w, D]
+    vwin = shift_concat(chunk(v), pad4)
+    logits = jnp.einsum("...cqd,...ckd->...cqk", qc, kwin) * scale
+    logits = logits.astype(jnp.float32)
+    mask_value = jnp.finfo(jnp.float32).min / 2
+    qi = jnp.arange(w)[:, None]          # query pos within chunk
+    kj = jnp.arange(2 * w)[None, :]      # key pos within (prev, own)
+    # Window band: key global = base + kj - w, query global = base + qi;
+    # keep 0 <= qi - (kj - w) < w  ⇔  qi < kj <= qi + w.
+    band = jnp.logical_and(kj > qi, kj <= qi + w)        # [w, 2w]
+    # Chunk 0 has no previous block: its first w key slots are padding.
+    first = (jnp.arange(nc) == 0)[:, None, None]         # [nc, 1, 1]
+    pad_slot = (kj < w)[None, :, :] & first              # [nc, w, 2w]
+    keep = band[None, :, :] & ~pad_slot                  # [nc, w, 2w]
+    if segment_ids is not None:
+        b = segment_ids.shape[0]
+        segc = segment_ids.reshape(b, nc, w)
+        seg_win = shift_concat(segc, [(0, 0), (1, 0), (0, 0)])
+        seg_keep = segc[..., :, None] == seg_win[..., None, :]
+        # [B, nc, w, 2w] → broadcast over the head axis.
+        keep = keep[None, None] & seg_keep[:, None]
+    logits = jnp.where(keep, logits, mask_value)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("...cqk,...ckd->...cqd", weights.astype(vwin.dtype),
+                     vwin)
+    return out.reshape(*lead, s, d)
 
 
 def _pallas_friendly(q, k, v) -> bool:
@@ -76,6 +163,7 @@ def multihead_attention_kernel(
     causal: bool = False,
     mask: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
     force_reference: bool = False,
 ) -> jax.Array:
@@ -85,14 +173,53 @@ def multihead_attention_kernel(
     sequence-packing mask) — structured, so the pallas kernel handles it
     natively (``SegmentIds``); an arbitrary dense ``mask`` forces the
     reference path instead.
+
+    ``window``: sliding-window causal attention (Mistral convention —
+    each query sees the last ``window`` keys including itself).  Plain
+    long self-attention takes the O(S·window) chunked path
+    (``local_attention_chunked``); combinations with packing/masks/
+    cross-length fall back to the exactly-masked oracle.
     """
-    if force_reference or mask is not None or not _pallas_friendly(q, k, v):
-        if segment_ids is not None:
-            seg = (segment_ids[:, None, :, None]
-                   == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
-            mask = seg if mask is None else jnp.logical_and(mask, seg)
+    def _fold_segments(mask):
+        """Dense same-segment mask (the packing restriction) — only for
+        the S×S fallback paths; the chunked path keeps ids structured."""
+        if segment_ids is None:
+            return mask
+        seg = (segment_ids[:, None, :, None]
+               == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
+        return seg if mask is None else jnp.logical_and(mask, seg)
+
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        chunkable = (mask is None and not force_reference
+                     and q.shape[-2] == k.shape[-2]
+                     and q.shape[-2] % window == 0
+                     and q.shape[-2] > window)
+        if chunkable:
+            return local_attention_chunked(
+                q, k, v, window=window, segment_ids=segment_ids,
+                softmax_scale=softmax_scale)
+        if q.shape[-2] >= 4 * window and not force_reference:
+            import warnings
+
+            warnings.warn(
+                f"sliding-window attention fell back to the DENSE "
+                f"S×S path (seq={q.shape[-2]}, window={window}: "
+                f"seq not divisible by window, a dense mask, or "
+                f"cross-length) — the O(S·window) chunked path "
+                f"needs seq %% window == 0; at long context this "
+                f"fallback can OOM", stacklevel=2)
         return dot_product_attention(
-            q, k, v, causal=causal, mask=mask, softmax_scale=softmax_scale
+            q, k, v, causal=True, mask=_fold_segments(mask), window=window,
+            softmax_scale=softmax_scale)
+    if force_reference or mask is not None or not _pallas_friendly(q, k, v):
+        return dot_product_attention(
+            q, k, v, causal=causal, mask=_fold_segments(mask),
+            softmax_scale=softmax_scale
         )
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         SegmentIds, flash_attention,

@@ -1,0 +1,240 @@
+"""Sliding-window attention: oracle, chunked O(S·w) path, model wiring.
+
+The window semantics are the Mistral convention — each query sees the
+last ``window`` keys including itself.  ``local_attention_chunked`` must
+match the exactly-masked oracle bit-for-tolerance, the dispatcher must
+route combinations (packing, decode cache) to correctly masked paths,
+and the Llama config plumbing must reach the layer.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: full-suite tier
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.ops.attention import (
+    dot_product_attention,
+    local_attention_chunked,
+    multihead_attention_kernel,
+)
+
+
+def _qkv(rng, b=2, h=3, s=64, d=16, dtype=np.float32):
+    def t():
+        return jnp.asarray(rng.normal(0, 1, (b, h, s, d)).astype(dtype))
+
+    return t(), t(), t()
+
+
+class TestChunkedMatchesOracle:
+    @pytest.mark.parametrize("s,w", [(64, 16), (128, 32), (48, 24),
+                                     (64, 32)])
+    def test_forward_parity(self, s, w):
+        rng = np.random.default_rng(s + w)
+        q, k, v = _qkv(rng, s=s)
+        oracle = dot_product_attention(q, k, v, causal=True, window=w)
+        got = local_attention_chunked(q, k, v, window=w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradient_parity(self):
+        rng = np.random.default_rng(7)
+        q, k, v = _qkv(rng, s=32, d=8)
+
+        def loss_oracle(q, k, v):
+            return jnp.sum(jnp.square(dot_product_attention(
+                q, k, v, causal=True, window=8)))
+
+        def loss_chunked(q, k, v):
+            return jnp.sum(jnp.square(local_attention_chunked(
+                q, k, v, window=8)))
+
+        go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+        gc = jax.grad(loss_chunked, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(go, gc):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_first_window_matches_plain_causal(self):
+        """Queries before the window fills see plain causal attention."""
+        rng = np.random.default_rng(9)
+        q, k, v = _qkv(rng, s=64)
+        full = dot_product_attention(q, k, v, causal=True)
+        win = local_attention_chunked(q, k, v, window=32)
+        np.testing.assert_allclose(np.asarray(win)[..., :32, :],
+                                   np.asarray(full)[..., :32, :],
+                                   rtol=2e-5, atol=2e-5)
+        # ...and later queries genuinely differ (the window binds).
+        assert not np.allclose(np.asarray(win)[..., 32:, :],
+                               np.asarray(full)[..., 32:, :], atol=1e-3)
+
+    def test_rejects_indivisible(self):
+        rng = np.random.default_rng(11)
+        q, k, v = _qkv(rng, s=60)
+        with pytest.raises(ValueError, match="divisible"):
+            local_attention_chunked(q, k, v, window=16)
+
+
+class TestDispatcher:
+    def test_window_requires_causal(self):
+        rng = np.random.default_rng(13)
+        q, k, v = _qkv(rng, s=32)
+        with pytest.raises(ValueError, match="causal"):
+            multihead_attention_kernel(q, k, v, window=8)
+
+    def test_window_with_packing_composes_masks(self):
+        """Packed segments + window stay on the O(S·w) chunked path
+        (segment ids ride the shift-concat) and match the dense-mask
+        oracle composition exactly."""
+        rng = np.random.default_rng(15)
+        q, k, v = _qkv(rng, b=1, s=32)
+        seg = jnp.asarray(
+            np.repeat([1, 2], 16)[None, :])  # two 16-token documents
+        got = multihead_attention_kernel(
+            q, k, v, causal=True, segment_ids=seg, window=8)
+        segmask = (seg[:, None, :, None] == seg[:, None, None, :])
+        want = dot_product_attention(q, k, v, causal=True, mask=segmask,
+                                     window=8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # And it really is the chunked path (identical, not just close).
+        direct = local_attention_chunked(q, k, v, window=8,
+                                         segment_ids=seg)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(direct))
+
+    def test_uneven_doc_boundaries_in_chunked_path(self):
+        """Doc boundaries that do NOT align with window chunks still
+        mask exactly (ids shift-concat like the keys)."""
+        rng = np.random.default_rng(16)
+        q, k, v = _qkv(rng, b=2, s=64)
+        lens = [(11, 29, 24), (5, 3, 56)]
+        seg = jnp.asarray(np.stack([
+            np.repeat(np.arange(1, len(l) + 1), l) for l in lens]))
+        got = multihead_attention_kernel(
+            q, k, v, causal=True, segment_ids=seg, window=16)
+        segmask = (seg[:, None, :, None] == seg[:, None, None, :])
+        want = dot_product_attention(q, k, v, causal=True, mask=segmask,
+                                     window=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_window_zero_rejected(self):
+        rng = np.random.default_rng(18)
+        q, k, v = _qkv(rng, s=32)
+        with pytest.raises(ValueError, match=">= 1"):
+            multihead_attention_kernel(q, k, v, causal=True, window=0)
+
+    def test_dense_fallback_warns_at_long_context(self):
+        rng = np.random.default_rng(19)
+        q, k, v = _qkv(rng, s=60)  # 60 % 14 != 0, 60 >= 4*14
+        with pytest.warns(UserWarning, match="DENSE"):
+            multihead_attention_kernel(q, k, v, causal=True, window=14)
+
+    def test_kernel_window_routes_to_chunked(self):
+        rng = np.random.default_rng(17)
+        q, k, v = _qkv(rng, s=64)
+        got = multihead_attention_kernel(q, k, v, causal=True, window=16)
+        want = local_attention_chunked(q, k, v, window=16)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestLlamaSlidingWindow:
+    def _cfgs(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import llama
+
+        base = llama.LLAMA_PRESETS["llama_tiny"]
+        return base, dataclasses.replace(base, sliding_window=32)
+
+    def test_short_sequences_match_full_attention(self):
+        """S <= window: sliding window is vacuous, logits identical."""
+        from tensorflow_train_distributed_tpu.models import llama
+
+        full_cfg, win_cfg = self._cfgs()
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (2, 32)), jnp.int32)
+        params = llama.LlamaModel(full_cfg).init(jax.random.key(0), toks)
+        a = llama.LlamaModel(full_cfg).apply(params, toks)
+        b = llama.LlamaModel(win_cfg).apply(params, toks)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_long_sequences_differ_and_train(self):
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import llama
+
+        full_cfg, win_cfg = self._cfgs()
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, 256, (2, 96)), jnp.int32)
+        params = llama.LlamaModel(full_cfg).init(jax.random.key(0), toks)
+        a = np.asarray(llama.LlamaModel(full_cfg).apply(params, toks))
+        b = np.asarray(llama.LlamaModel(win_cfg).apply(params, toks))
+        # The window binds beyond position 32 → different logits there.
+        assert not np.allclose(a[:, 40:], b[:, 40:], atol=1e-3)
+        # And a grad step is finite.
+        task = llama.CausalLmTask(win_cfg)
+        batch = {"tokens": np.asarray(toks),
+                 "targets": rng.integers(0, 256, (2, 96)).astype(np.int32)}
+        variables = task.init_variables(jax.random.key(0), batch)
+
+        def loss(p):
+            l, _ = task.loss_fn(p, {}, batch, jax.random.key(1), True)
+            return l
+
+        grads = jax.grad(loss)(variables["params"])
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(grads))
+
+    def test_decode_matches_teacher_forcing(self):
+        """Greedy decode through the windowed KV cache reproduces the
+        windowed model's full-forward argmax tokens."""
+        from tensorflow_train_distributed_tpu.models import generate, llama
+
+        _, win_cfg = self._cfgs()
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(2, 256, (1, 48)).astype(np.int32)
+        params = llama.LlamaModel(win_cfg).init(
+            jax.random.key(0), jnp.asarray(prompt))["params"]
+        out = generate.generate(win_cfg, params, prompt,
+                                max_new_tokens=8)
+        # Teacher-forced check: feeding the generated prefix reproduces
+        # each next token via the full windowed forward.
+        model = llama.LlamaModel(win_cfg)
+        seq = np.asarray(out)
+        for t in range(prompt.shape[1], seq.shape[1]):
+            logits = model.apply({"params": params},
+                                 jnp.asarray(seq[:, :t]))
+            np.testing.assert_array_equal(
+                np.argmax(np.asarray(logits)[:, -1], -1), seq[:, t])
+
+    def test_window_under_seq_parallel_rejected(self, mesh8):
+        import dataclasses
+
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.training import (
+            Trainer, TrainerConfig,
+        )
+
+        cfg = dataclasses.replace(
+            llama.LLAMA_PRESETS["llama_tiny"], sliding_window=16,
+            seq_parallel="ring")
+        rng = np.random.default_rng(3)
+        batch = {"tokens": rng.integers(0, 256, (8, 64)).astype(np.int32),
+                 "targets": rng.integers(0, 256, (8, 64)).astype(np.int32)}
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+
+        sp_mesh = build_mesh(MeshConfig(data=4, seq=2),
+                             devices=jax.devices()[:8])
+        trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3),
+                          sp_mesh, config=TrainerConfig(log_every=1))
+        with pytest.raises(ValueError, match="sliding-window"):
+            trainer.create_state(batch)
