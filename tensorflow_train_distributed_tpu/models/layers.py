@@ -277,9 +277,21 @@ class MultiHeadAttention(nn.Module):
         collection is new.  Causal structure comes from the index mask, not
         the kernel — decode q_len is tiny, the einsum path is the right
         tool.
+
+        With ``window`` set and ``cache_len > window``, the cache is a
+        ROLLING ring buffer of ``window`` rows (slot = position %% window)
+        — serving memory and per-step attention cost scale with the
+        window, not the total generation length (Mistral 32k decode keeps
+        a 4k cache/layer).  Multi-token calls work at any position
+        (first prefill, chunked prefill, speculative blocks): the block
+        attends over (unrolled ring, fresh block) with the window band,
+        and the last ``window`` positions re-pack into the ring.
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True needs cache_len > 0")
+        rolling = (self.window is not None
+                   and self.cache_len > self.window)
+        cache_rows = self.window if rolling else self.cache_len
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
 
@@ -289,10 +301,10 @@ class MultiHeadAttention(nn.Module):
 
         cache_k = self.variable(
             "cache", "key_cache", jnp.zeros,
-            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+            (b, cache_rows, kv_heads, self.head_dim), self.dtype)
         cache_v = self.variable(
             "cache", "value_cache", jnp.zeros,
-            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+            (b, cache_rows, kv_heads, self.head_dim), self.dtype)
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((), jnp.int32))
         cur = index.value
@@ -302,35 +314,61 @@ class MultiHeadAttention(nn.Module):
             pos_b = jnp.broadcast_to(positions, (b, q_len))
             q = apply_rope(q, pos_b, base=self.rope_base)
             k = apply_rope(k, pos_b, base=self.rope_base)
-        cache_k.value = jax.lax.dynamic_update_slice(
-            cache_k.value, k.astype(cache_k.value.dtype), (0, cur, 0, 0))
-        cache_v.value = jax.lax.dynamic_update_slice(
-            cache_v.value, v.astype(cache_v.value.dtype), (0, cur, 0, 0))
         index.value = cur + q_len
 
+        if rolling and q_len > 1:
+            return self._rolling_block(x, q, k, v, cache_k, cache_v,
+                                       cur, kv_heads, b, q_len)
+
+        kdt = cache_k.value.dtype
+        if rolling:
+            # Single-token step: own slot = cur % window; slot j then
+            # holds absolute position cur - ((cur - j) % window), which
+            # is automatically within the window — only unfilled slots
+            # (negative position) need masking.
+            w = self.window
+            slot = jnp.mod(cur, w)
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(kdt), (0, slot, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(kdt), (0, slot, 0, 0))
+            j = jnp.arange(w)
+            slot_pos = cur - jnp.mod(cur - j, w)  # mod ≥ 0 (Python sem.)
+            mask = (slot_pos >= 0)[None, :]                # [q=1, cache]
+        else:
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(kdt), (0, cur, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(kdt), (0, cur, 0, 0))
+            kv_pos = jnp.arange(cache_rows)
+            mask = kv_pos[None, :] <= positions[:, None]   # [q, cache]
+            if self.window is not None:
+                # Linear cache + window: only the last `window` positions
+                # (including self) stay visible.
+                mask = jnp.logical_and(
+                    mask,
+                    kv_pos[None, :] > positions[:, None] - self.window)
+        return self._cache_attend(q, cache_k.value, cache_v.value,
+                                  mask[None, None], kv_heads, b, q_len,
+                                  x.shape[-1])
+
+    def _cache_attend(self, q, kc, vc, mask, kv_heads, b, q_len, features):
+        """Masked einsum attention of q over the cache buffers."""
         # Same logical sharding as the training path: under a tensor/fsdp
         # mesh the cache reads and attention activations shard over heads
         # rather than replicating (B, cache_len, H, D) per device.
         kh = nn.with_logical_constraint(
-            cache_k.value, ("batch", "length", "heads", "kv"))
+            kc, ("batch", "length", "heads", "kv"))
         vh = nn.with_logical_constraint(
-            cache_v.value, ("batch", "length", "heads", "kv"))
+            vc, ("batch", "length", "heads", "kv"))
         if kv_heads != self.num_heads:
             rep = self.num_heads // kv_heads
             kh = jnp.repeat(kh, rep, axis=2)
             vh = jnp.repeat(vh, rep, axis=2)
-        # [B, S, H, D] → [B, H, S, D]; valid kv = filled AND causal ≤ q pos.
+        # [B, S, H, D] → [B, H, S, D].
         qh = q.transpose(0, 2, 1, 3)
         kh = kh.transpose(0, 2, 1, 3)
         vh = vh.transpose(0, 2, 1, 3)
-        kv_pos = jnp.arange(self.cache_len)
-        mask = kv_pos[None, :] <= positions[:, None]       # [q, cache]
-        if self.window is not None:
-            # Sliding window over the cache: only the last `window`
-            # positions (including self) stay visible.
-            mask = jnp.logical_and(
-                mask, kv_pos[None, :] > positions[:, None] - self.window)
-        mask = mask[None, None]                            # [1, 1, q, cache]
         from tensorflow_train_distributed_tpu.ops.attention import (
             dot_product_attention,
         )
@@ -340,8 +378,39 @@ class MultiHeadAttention(nn.Module):
         out = nn.with_logical_constraint(
             out, ("batch", "length", "heads", "kv"))
         out = out.reshape(b, q_len, self.num_heads * self.head_dim)
-        y = self._out_proj(out, x.shape[-1])
+        y = self._out_proj(out, features)
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+
+    def _rolling_block(self, x, q, k, v, cache_k, cache_v, cur, kv_heads,
+                       b, q_len):
+        """Multi-token call under the rolling cache, correct at ANY
+        ``cur`` (first prefill, chunked prefill, speculative blocks).
+
+        The ring unrolls into positional order (slot j holds position
+        ``cur - ((cur - j) %% w)``, so rolling by ``-cur`` sorts it to
+        positions ``cur-w .. cur-1``), concatenates with the block's
+        fresh k/v, and each query applies the causal+window+validity
+        band over the w+q_len keys — then the last w rows of that
+        concat re-roll into slot order as the new ring state."""
+        w = self.window
+        kdt = cache_k.value.dtype
+        shift = jnp.mod(cur, w)
+        ordered_k = jnp.roll(cache_k.value, -shift, axis=1)
+        ordered_v = jnp.roll(cache_v.value, -shift, axis=1)
+        kcat = jnp.concatenate([ordered_k, k.astype(kdt)], axis=1)
+        vcat = jnp.concatenate([ordered_v, v.astype(kdt)], axis=1)
+        kv_pos = cur - w + jnp.arange(w + q_len)          # global positions
+        q_pos = cur + jnp.arange(q_len)
+        keep = ((kv_pos[None, :] >= 0)
+                & (kv_pos[None, :] <= q_pos[:, None])
+                & (q_pos[:, None] - kv_pos[None, :] < w))
+        # New ring = last w positions of the concat, re-packed so each
+        # row with position p sits at slot p % w.
+        end = jnp.mod(cur + q_len, w)
+        cache_k.value = jnp.roll(kcat[:, -w:], end, axis=1)
+        cache_v.value = jnp.roll(vcat[:, -w:], end, axis=1)
+        return self._cache_attend(q, kcat, vcat, keep[None, None],
+                                  kv_heads, b, q_len, x.shape[-1])
 
 
 class MlpBlock(nn.Module):

@@ -212,6 +212,76 @@ class TestLlamaSlidingWindow:
             np.testing.assert_array_equal(
                 np.argmax(np.asarray(logits)[:, -1], -1), seq[:, t])
 
+    def test_rolling_cache_window_sized_and_wrap_exact(self):
+        """cache_len > window → ring buffer of WINDOW rows per layer
+        (the serving-memory win), and generation deep past several slot
+        wraps still reproduces the windowed model's teacher-forced
+        argmax stream."""
+        import dataclasses
+
+        import flax
+
+        from tensorflow_train_distributed_tpu.models import generate, llama
+
+        base = llama.LLAMA_PRESETS["llama_tiny"]
+        cfg = dataclasses.replace(base, sliding_window=16)
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(2, 256, (1, 20)).astype(np.int32)
+        params = llama.LlamaModel(cfg).init(
+            jax.random.key(0), jnp.asarray(prompt))["params"]
+        # Cache buffers are window-sized, not request-sized.
+        model = llama.LlamaModel(cfg, decode=True, cache_len=60)
+        _, variables = model.apply({"params": params},
+                                   jnp.asarray(prompt), mutable=["cache"])
+        for path, leaf in flax.traverse_util.flatten_dict(
+                dict(variables["cache"])).items():
+            if path[-1] in ("key_cache", "value_cache"):
+                assert leaf.shape[1] == 16, (path, leaf.shape)
+        # 40 new tokens → positions to 59: slots wrap ~3.7 times.  One
+        # causal forward teacher-forces every step at once: logits at
+        # t-1 must argmax to the generated token t.
+        out = np.asarray(generate.generate(cfg, params, prompt,
+                                           max_new_tokens=40))
+        logits = np.asarray(llama.LlamaModel(cfg).apply(
+            {"params": params}, jnp.asarray(out)))
+        p = prompt.shape[1]
+        np.testing.assert_array_equal(
+            np.argmax(logits[:, p - 1:-1], -1), out[:, p:])
+
+    def test_rolling_chunked_prefill_matches_one_shot(self):
+        """Multi-token calls at cur > 0 (chunked prefill) are exact under
+        the rolling cache: feeding the prompt in two chunks produces the
+        same logits and the same subsequent step logits as one prefill."""
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LLAMA_PRESETS["llama_tiny"],
+                                  sliding_window=8)
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(2, 256, (1, 26)), jnp.int32)
+        params = llama.LlamaModel(cfg).init(jax.random.key(0),
+                                            prompt)["params"]
+        model = llama.LlamaModel(cfg, decode=True, cache_len=40)
+        one, v_one = model.apply({"params": params}, prompt,
+                                 mutable=["cache"])
+        a, va = model.apply({"params": params}, prompt[:, :11],
+                            mutable=["cache"])
+        b, vb = model.apply({"params": params, "cache": va["cache"]},
+                            prompt[:, 11:], mutable=["cache"])
+        np.testing.assert_allclose(
+            np.asarray(one), np.concatenate([np.asarray(a),
+                                             np.asarray(b)], axis=1),
+            rtol=1e-5, atol=1e-5)
+        # And the cache states agree for the NEXT step.
+        tok = jnp.asarray([[7]], jnp.int32)
+        s1, _ = model.apply({"params": params, "cache": v_one["cache"]},
+                            tok, mutable=["cache"])
+        s2, _ = model.apply({"params": params, "cache": vb["cache"]},
+                            tok, mutable=["cache"])
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_window_under_seq_parallel_rejected(self, mesh8):
         import dataclasses
 
