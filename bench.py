@@ -27,8 +27,11 @@ Benched families (``--families``): ``resnet`` (both ``resnet50`` and
 ``resnet50_s2d``, the MXU-friendly space-to-depth stem — the headline is
 the faster one), plus on TPU ``lm`` (llama_125m decoder, tools/bench_lm)
 and ``bert`` (bert_base MLM, tools/bench_bert) so the persisted record
-carries every driver-designated metric, not just ResNet; ``gen``
-(opt-in, tools/bench_generate) adds KV-cache decode throughput + MBU.  The lm/bert
+carries every driver-designated metric, not just ResNet; ``input``
+(tools/bench_input, pure host — runs even on a CPU fallback) records the
+JPEG-ingest pipeline incl. the ship-raw-uint8 and native-libjpeg modes;
+``gen`` (opt-in, tools/bench_generate) adds KV-cache decode throughput
++ MBU.  The lm/bert
 families run as subprocesses: allocator isolation (a fresh HBM heap per
 family — in-process leftovers could push a fitting config over the
 budget) while inheriting the chip lock.  A jax.profiler trace is captured
@@ -257,7 +260,20 @@ FAMILY_CMDS = {
              "--preset", "llama_125m", "--batch", "8",
              "--prompt-len", "128", "--max-new", "256"],
             "llama_125m_decode"),
+    # Pure host (never touches the tunnel): JPEG decode+augment pipeline
+    # throughput incl. the ship-raw-uint8 and native-libjpeg modes.  Runs
+    # even on a CPU fallback, so a dead-tunnel record still carries real
+    # measurements.
+    "input": ([sys.executable, os.path.join(_HERE, "tools",
+                                            "bench_input.py"),
+               "--records", "128", "--image-hw", "192", "--size", "160",
+               "--batch", "32", "--workers", "2"],
+              "host_input"),
 }
+
+# Families that never touch the device — they survive the CPU-fallback
+# family cull and run outside any chip concern.
+HOST_ONLY_FAMILIES = ("input",)
 
 
 def _run_family(cmd, timeout_s: float):
@@ -298,11 +314,12 @@ def main(argv=None) -> int:
                    help="comma-separated RESNET_PRESETS names to bench "
                         "(bnsub = strided-BN-statistics variant, the "
                         "PROFILE.md BN-traffic attack)")
-    p.add_argument("--families", default="resnet,lm,bert",
+    p.add_argument("--families", default="resnet,lm,bert,input",
                    help="model families in the emit: resnet (in-process "
                         "headline) plus lm/bert subprocess benches (TPU "
-                        "only); 'gen' (opt-in) adds KV-cache decode "
-                        "throughput + MBU")
+                        "only); 'input' = host JPEG-pipeline throughput "
+                        "(pure CPU, runs even on fallback); 'gen' "
+                        "(opt-in) adds KV-cache decode throughput + MBU")
     p.add_argument("--batch-per-chip", type=int, default=256)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
@@ -431,8 +448,9 @@ def _bench_phase(args, record, errors, want_tpu: bool):
         batch_per_chip = min(batch_per_chip, 8)
         warmup, iters = min(warmup, 1), min(iters, 2)
         configs, skipped_configs = configs[:1], configs[1:]
-        skipped_configs += [f for f in families if f != "resnet"]
-        families = [f for f in families if f == "resnet"]
+        keep = ("resnet",) + HOST_ONLY_FAMILIES
+        skipped_configs += [f for f in families if f not in keep]
+        families = [f for f in families if f in keep]
 
     # The DEFAULT trace dir holds committed TPU evidence; a CPU fallback
     # must not bury it under CPU traces.  An explicitly chosen dir is

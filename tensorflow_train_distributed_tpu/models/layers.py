@@ -386,29 +386,49 @@ class MultiHeadAttention(nn.Module):
         """Multi-token call under the rolling cache, correct at ANY
         ``cur`` (first prefill, chunked prefill, speculative blocks).
 
-        The ring unrolls into positional order (slot j holds position
-        ``cur - ((cur - j) %% w)``, so rolling by ``-cur`` sorts it to
-        positions ``cur-w .. cur-1``), concatenates with the block's
-        fresh k/v, and each query applies the causal+window+validity
-        band over the w+q_len keys — then the last w rows of that
-        concat re-roll into slot order as the new ring state."""
+        Ring invariant BEFORE the block: slot j holds position
+        ``cur - w + ((j - cur) %% w)`` — the last w positions
+        ``cur-w .. cur-1``, so rolling by ``-cur`` sorts the ring into
+        positional order.  The block concatenates its fresh k/v after
+        the unrolled ring, each query applies the causal+window+validity
+        band over the w+q_len keys, and the last w rows of that concat
+        re-roll into slot order as the new ring state."""
         w = self.window
         kdt = cache_k.value.dtype
-        shift = jnp.mod(cur, w)
-        ordered_k = jnp.roll(cache_k.value, -shift, axis=1)
-        ordered_v = jnp.roll(cache_v.value, -shift, axis=1)
-        kcat = jnp.concatenate([ordered_k, k.astype(kdt)], axis=1)
-        vcat = jnp.concatenate([ordered_v, v.astype(kdt)], axis=1)
-        kv_pos = cur - w + jnp.arange(w + q_len)          # global positions
-        q_pos = cur + jnp.arange(q_len)
+        # First prefill: cur is the cache's fresh-init constant (a real
+        # tracer only when a caller passes cache state in), so the ring
+        # is knowably empty — skip the unroll/concat and attend the
+        # block alone (a 128-token prompt must not pay a w+128-key
+        # attention against w masked zeros).
+        fresh = not isinstance(cur, jax.core.Tracer) and int(cur) == 0
+        if fresh:
+            kcat, vcat = k.astype(kdt), v.astype(kdt)
+            kv_pos = jnp.arange(q_len)
+            q_pos = jnp.arange(q_len)
+        else:
+            shift = jnp.mod(cur, w)
+            ordered_k = jnp.roll(cache_k.value, -shift, axis=1)
+            ordered_v = jnp.roll(cache_v.value, -shift, axis=1)
+            kcat = jnp.concatenate([ordered_k, k.astype(kdt)], axis=1)
+            vcat = jnp.concatenate([ordered_v, v.astype(kdt)], axis=1)
+            kv_pos = cur - w + jnp.arange(w + q_len)      # global positions
+            q_pos = cur + jnp.arange(q_len)
         keep = ((kv_pos[None, :] >= 0)
                 & (kv_pos[None, :] <= q_pos[:, None])
                 & (q_pos[:, None] - kv_pos[None, :] < w))
-        # New ring = last w positions of the concat, re-packed so each
-        # row with position p sits at slot p % w.
-        end = jnp.mod(cur + q_len, w)
-        cache_k.value = jnp.roll(kcat[:, -w:], end, axis=1)
-        cache_v.value = jnp.roll(vcat[:, -w:], end, axis=1)
+        # New ring = last w positions written so far, re-packed so each
+        # row with position p sits at slot p % w.  A fresh block shorter
+        # than w writes positions 0..q_len-1 straight to slots 0..q_len-1
+        # (untouched tail slots read as position < 0 → masked later).
+        if fresh and q_len < w:
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, kcat, (0, 0, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, vcat, (0, 0, 0, 0))
+        else:
+            end = jnp.mod(cur + q_len, w)
+            cache_k.value = jnp.roll(kcat[:, -w:], end, axis=1)
+            cache_v.value = jnp.roll(vcat[:, -w:], end, axis=1)
         return self._cache_attend(q, kcat, vcat, keep[None, None],
                                   kv_heads, b, q_len, x.shape[-1])
 
