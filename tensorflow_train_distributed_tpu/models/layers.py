@@ -241,18 +241,13 @@ class MultiHeadAttention(nn.Module):
                     "segment_ids), not dense masks")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
-            if self.window is not None:
-                raise ValueError(
-                    "sliding-window attention under seq_parallel is not "
-                    "wired (a window <= the shard span could skip ring "
-                    "hops; file as a perf follow-up) — drop seq_parallel "
-                    "or the window")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
                 import shard_mapped_attention
 
             out = shard_mapped_attention(
                 sp_mesh, qh, kh, vh, method=self.seq_parallel,
                 causal=self.causal, segment_ids=segment_ids,
+                window=self.window,
             ).transpose(0, 2, 1, 3)
         else:
             out = multihead_attention_kernel(

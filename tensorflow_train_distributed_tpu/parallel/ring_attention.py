@@ -59,6 +59,7 @@ def ring_attention(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-shard ring attention.  q: [B, H, Sq, D]; k/v: [B, Hkv, Sk, D]
     (Hkv may divide H — GQA), all sharded on ``axis``.
@@ -67,11 +68,20 @@ def ring_attention(
     restricts attention to same-segment pairs — packed long-context rows:
     the KV shard's segment ids rotate around the ring WITH the k/v blocks
     so every hop masks against the correct metadata.
+
+    ``window`` (sliding-window attention, requires ``causal``) both
+    masks the band AND SHORTENS THE RING: only ``ceil((window-1)/Sk)``
+    previous blocks can hold in-window keys, so the scan runs that many
+    hops instead of n-1 — at 32k over 8 shards with a 4k window, 1 hop
+    instead of 7 (7× less ICI for attention).
     """
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     q32 = q.astype(jnp.float32) * scale
 
@@ -82,7 +92,10 @@ def ring_attention(
         if causal:
             q_pos = idx * sq + jnp.arange(sq)[:, None]
             k_pos = kv_idx * sk + jnp.arange(sk)[None, :]
-            block_mask = (q_pos >= k_pos)[None, None]
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep = keep & (q_pos - k_pos < window)
+            block_mask = keep[None, None]
         else:
             block_mask = jnp.ones((1, 1, sq, sk), bool)
         if kv_seg is not None:
@@ -120,9 +133,15 @@ def ring_attention(
         olm = attend_block(olm, k_nxt, v_nxt, kv_idx, seg_nxt)
         return (olm, k_nxt, v_nxt, seg_nxt), None
 
-    if n > 1:
+    # Window shortens the ring: a block j hops back holds keys at least
+    # (j-1)·Sk + 1 positions behind every local query, so blocks beyond
+    # ceil((window-1)/Sk) are fully out-of-window — don't rotate them in.
+    hops = n - 1
+    if window is not None:
+        hops = min(hops, -(-(window - 1) // sk))
+    if hops > 0:
         (olm, _, _, _), _ = jax.lax.scan(
-            body, (olm, k, v, segment_ids), jnp.arange(n - 1))
+            body, (olm, k, v, segment_ids), jnp.arange(hops))
     o, _, l = olm
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
@@ -136,6 +155,7 @@ def ulysses_attention(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-shard Ulysses attention.  q: [B, H, S_local, D]; k/v may carry
     fewer (GQA) heads.  Requires H % axis_size == 0.  Local attention uses
@@ -173,7 +193,7 @@ def ulysses_attention(
     out = multihead_attention_kernel(
         qg, _repeat_kv(kg, qg.shape[1]), _repeat_kv(vg, qg.shape[1]),
         causal=causal, softmax_scale=softmax_scale,
-        segment_ids=full_seg,
+        segment_ids=full_seg, window=window,
     )
     return heads_to_seq(out.astype(q.dtype))
 
@@ -189,10 +209,13 @@ def shard_mapped_attention(
     softmax_scale: Optional[float] = None,
     axis: str = "seq",
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Global-array entry point: q/k/v [B, H, S, D] with S sharded on
     ``axis``, batch on (data, fsdp), heads on tensor — SP × DP × TP.
-    ``segment_ids`` [B, S] (packed rows) shards with the sequence."""
+    ``segment_ids`` [B, S] (packed rows) shards with the sequence;
+    ``window`` = sliding-window attention (ring additionally skips
+    out-of-window hops)."""
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[method]
     batch_dims = tuple(a for a in ("data", "fsdp")
                        if mesh.shape.get(a, 1) > 1) or None
@@ -206,7 +229,8 @@ def shard_mapped_attention(
 
     def per_shard(q_, k_, v_, seg_=None):
         return fn(q_, k_, v_, axis=axis, causal=causal,
-                  softmax_scale=softmax_scale, segment_ids=seg_)
+                  softmax_scale=softmax_scale, segment_ids=seg_,
+                  window=window)
 
     return shard_map(
         per_shard,

@@ -282,29 +282,70 @@ class TestLlamaSlidingWindow:
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_window_under_seq_parallel_rejected(self, mesh8):
+    def test_window_composes_with_seq_parallel(self):
+        """Windowed llama trains under ring AND Ulysses SP with the
+        SAME first-step loss as the unsharded windowed model — and the
+        ring additionally skips out-of-window hops (if it skipped a
+        NEEDED one, the losses would differ)."""
         import dataclasses
 
         import optax
 
         from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            shard_batch,
+        )
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
         from tensorflow_train_distributed_tpu.training import (
             Trainer, TrainerConfig,
         )
 
-        cfg = dataclasses.replace(
-            llama.LLAMA_PRESETS["llama_tiny"], sliding_window=16,
-            seq_parallel="ring")
         rng = np.random.default_rng(3)
-        batch = {"tokens": rng.integers(0, 256, (8, 64)).astype(np.int32),
-                 "targets": rng.integers(0, 256, (8, 64)).astype(np.int32)}
+        batch = {"tokens": rng.integers(0, 256, (4, 64)).astype(np.int32),
+                 "targets": rng.integers(0, 256,
+                                         (4, 64)).astype(np.int32)}
+
+        def first_loss(seq_parallel, mesh_cfg):
+            import math
+
+            cfg = dataclasses.replace(
+                llama.LLAMA_PRESETS["llama_tiny"], sliding_window=16,
+                seq_parallel=seq_parallel)
+            n = math.prod(mesh_cfg.axis_sizes().values())
+            mesh = build_mesh(mesh_cfg, devices=jax.devices()[:n])
+            trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3),
+                              mesh, config=TrainerConfig(log_every=1))
+            state = trainer.create_state(batch)
+            step = trainer._compiled_train_step()
+            _, metrics = step(state, shard_batch(mesh, batch))
+            return float(metrics["loss"])
+
+        base = first_loss(None, MeshConfig(data=2))
+        ring = first_loss("ring", MeshConfig(data=2, seq=4))
+        uly = first_loss("ulysses", MeshConfig(data=2, seq=2))
+        assert base == pytest.approx(ring, rel=1e-4)
+        assert base == pytest.approx(uly, rel=1e-4)
+
+    def test_ring_window_parity_at_shard_boundaries(self):
+        """shard_mapped ring attention with a window spanning shard
+        boundaries matches the full windowed oracle (the skipped-hops
+        optimization must keep every in-window key)."""
+        from tensorflow_train_distributed_tpu.parallel.ring_attention \
+            import shard_mapped_attention
         from tensorflow_train_distributed_tpu.runtime.mesh import (
             MeshConfig, build_mesh,
         )
 
-        sp_mesh = build_mesh(MeshConfig(data=4, seq=2),
-                             devices=jax.devices()[:8])
-        trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3),
-                          sp_mesh, config=TrainerConfig(log_every=1))
-        with pytest.raises(ValueError, match="sliding-window"):
-            trainer.create_state(batch)
+        mesh = build_mesh(MeshConfig(data=2, seq=4),
+                          devices=jax.devices()[:8])
+        rng = np.random.default_rng(6)
+        q, k, v = _qkv(rng, b=2, h=4, s=64, d=8)
+        for w in (8, 16, 24, 40):  # shard span 16: below/at/cross/2-hop
+            out = shard_mapped_attention(mesh, q, k, v, method="ring",
+                                         causal=True, window=w)
+            ref = dot_product_attention(q, k, v, causal=True, window=w)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4,
+                err_msg=f"window={w}")
