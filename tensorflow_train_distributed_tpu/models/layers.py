@@ -145,9 +145,15 @@ class MultiHeadAttention(nn.Module):
     seq_parallel: Optional[str] = None
     # Sliding-window causal attention (Mistral convention): each query
     # sees the last ``window`` keys including itself.  Long training
-    # sequences take the O(S·window) chunked path; decode masks the KV
-    # cache to the window.  Not composable with seq_parallel (yet).
+    # sequences take the O(S·window) chunked path; decode keeps a
+    # rolling window-sized KV cache.  Composes with ring/Ulysses SP.
     window: Optional[int] = None
+    # StreamingLLM attention sinks (needs ``window``): the first
+    # ``sinks`` positions stay attendable past the window — keeps
+    # unbounded streaming decode stable.  Decode stores them in a small
+    # separate buffer beside the rolling ring.  Ulysses-compatible;
+    # ring SP would need a shard-0 broadcast (rejected loudly).
+    sinks: int = 0
     # Autoregressive decode: keep a KV cache of ``cache_len`` positions in
     # the mutable "cache" collection; each call appends this call's k/v at
     # the running index and attends over the filled prefix.  Works for
@@ -241,18 +247,25 @@ class MultiHeadAttention(nn.Module):
                     "segment_ids), not dense masks")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
+            if self.sinks and self.seq_parallel == "ring":
+                raise ValueError(
+                    "attention sinks under RING seq_parallel are not "
+                    "wired (the sink keys live on shard 0 and would "
+                    "need a broadcast); use seq_parallel='ulysses' or "
+                    "drop the sinks")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
                 import shard_mapped_attention
 
             out = shard_mapped_attention(
                 sp_mesh, qh, kh, vh, method=self.seq_parallel,
                 causal=self.causal, segment_ids=segment_ids,
-                window=self.window,
+                window=self.window, sinks=self.sinks,
             ).transpose(0, 2, 1, 3)
         else:
             out = multihead_attention_kernel(
                 qh, kh, vh, causal=self.causal, mask=mask,
                 segment_ids=segment_ids, window=self.window,
+                sinks=self.sinks,
             ).transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
             out, ("batch", "length", "heads", "kv"))
@@ -284,11 +297,21 @@ class MultiHeadAttention(nn.Module):
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True needs cache_len > 0")
+        if self.sinks and (self.window is None
+                           or self.sinks > self.window):
+            raise ValueError(
+                f"sinks={self.sinks} needs a sliding window >= sinks, "
+                f"got window={self.window}")
         rolling = (self.window is not None
                    and self.cache_len > self.window)
         cache_rows = self.window if rolling else self.cache_len
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
+        # STATIC first-call signal: the cache collection does not exist
+        # yet on the very first apply (generate's prefill) — a Python
+        # bool, trustworthy under jit, unlike sniffing whether `cur` is
+        # a tracer (inside jit even the fresh-init zero is one).
+        fresh_cache = not self.has_variable("cache", "index")
 
         q = self._proj(x, self.num_heads, "query")
         k = self._proj(x, kv_heads, "key")
@@ -313,14 +336,16 @@ class MultiHeadAttention(nn.Module):
 
         if rolling and q_len > 1:
             return self._rolling_block(x, q, k, v, cache_k, cache_v,
-                                       cur, kv_heads, b, q_len)
+                                       cur, kv_heads, b, q_len,
+                                       fresh_cache)
 
         kdt = cache_k.value.dtype
         if rolling:
             # Single-token step: own slot = cur % window; slot j then
             # holds absolute position cur - ((cur - j) % window), which
-            # is automatically within the window — only unfilled slots
-            # (negative position) need masking.
+            # is automatically within the window — unfilled slots
+            # (negative position) and slots the SINK buffer serves
+            # (position < sinks) are masked out.
             w = self.window
             slot = jnp.mod(cur, w)
             cache_k.value = jax.lax.dynamic_update_slice(
@@ -329,23 +354,62 @@ class MultiHeadAttention(nn.Module):
                 cache_v.value, v.astype(kdt), (0, slot, 0, 0))
             j = jnp.arange(w)
             slot_pos = cur - jnp.mod(cur - j, w)  # mod ≥ 0 (Python sem.)
-            mask = (slot_pos >= 0)[None, :]                # [q=1, cache]
-        else:
-            cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k.astype(kdt), (0, cur, 0, 0))
-            cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v.astype(kdt), (0, cur, 0, 0))
-            kv_pos = jnp.arange(cache_rows)
-            mask = kv_pos[None, :] <= positions[:, None]   # [q, cache]
-            if self.window is not None:
-                # Linear cache + window: only the last `window` positions
-                # (including self) stay visible.
-                mask = jnp.logical_and(
-                    mask,
-                    kv_pos[None, :] > positions[:, None] - self.window)
+            # Exclusivity: the sink buffer serves positions < sinks, the
+            # ring serves >= sinks — uniform at every cur, no double
+            # counting even while the sink range itself is decoding.
+            mask = (slot_pos >= max(self.sinks, 0))[None, :]  # [1, cache]
+            kc, vc = cache_k.value, cache_v.value
+            if self.sinks:
+                sink_k, sink_v = self._sink_buffers(b, kv_heads)
+                self._write_sinks(sink_k, sink_v, k, v, cur, q_len, kdt)
+                kc = jnp.concatenate([sink_k.value, kc], axis=1)
+                vc = jnp.concatenate([sink_v.value, vc], axis=1)
+                # Causal: sink position si visible once decoded (si <=
+                # cur); unwritten rows are > cur and excluded with it.
+                mask = jnp.concatenate(
+                    [(jnp.arange(self.sinks) <= cur)[None, :], mask],
+                    axis=1)
+            return self._cache_attend(q, kc, vc, mask[None, None],
+                                      kv_heads, b, q_len, x.shape[-1])
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(kdt), (0, cur, 0, 0))
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(kdt), (0, cur, 0, 0))
+        kv_pos = jnp.arange(cache_rows)
+        mask = kv_pos[None, :] <= positions[:, None]   # [q, cache]
+        if self.window is not None:
+            # Linear cache + window: the last `window` positions
+            # (including self) and the sink prefix stay visible.
+            band = kv_pos[None, :] > positions[:, None] - self.window
+            if self.sinks:
+                band = jnp.logical_or(band, (kv_pos < self.sinks)[None, :])
+            mask = jnp.logical_and(mask, band)
         return self._cache_attend(q, cache_k.value, cache_v.value,
                                   mask[None, None], kv_heads, b, q_len,
                                   x.shape[-1])
+
+    def _sink_buffers(self, b, kv_heads):
+        """The StreamingLLM sink KV buffer pair ([B, sinks, Hkv, D])."""
+        sink_k = self.variable(
+            "cache", "sink_key", jnp.zeros,
+            (b, self.sinks, kv_heads, self.head_dim), self.dtype)
+        sink_v = self.variable(
+            "cache", "sink_value", jnp.zeros,
+            (b, self.sinks, kv_heads, self.head_dim), self.dtype)
+        return sink_k, sink_v
+
+    def _write_sinks(self, sink_k, sink_v, k, v, cur, q_len, kdt):
+        """Merge any of this call's rows that land in the sink range
+        (positions [cur, cur+q_len) ∩ [0, sinks)) into the sink buffers
+        — trace-safe at any ``cur``, a no-op once cur >= sinks."""
+        sp = jnp.arange(self.sinks)
+        covered = (sp >= cur) & (sp < cur + q_len)
+        row = jnp.clip(sp - cur, 0, q_len - 1)
+        sel = covered[None, :, None, None]
+        sink_k.value = jnp.where(
+            sel, jnp.take(k, row, axis=1).astype(kdt), sink_k.value)
+        sink_v.value = jnp.where(
+            sel, jnp.take(v, row, axis=1).astype(kdt), sink_v.value)
 
     def _cache_attend(self, q, kc, vc, mask, kv_heads, b, q_len, features):
         """Masked einsum attention of q over the cache buffers."""
@@ -377,7 +441,7 @@ class MultiHeadAttention(nn.Module):
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))
 
     def _rolling_block(self, x, q, k, v, cache_k, cache_v, cur, kv_heads,
-                       b, q_len):
+                       b, q_len, fresh):
         """Multi-token call under the rolling cache, correct at ANY
         ``cur`` (first prefill, chunked prefill, speculative blocks).
 
@@ -390,16 +454,23 @@ class MultiHeadAttention(nn.Module):
         re-roll into slot order as the new ring state."""
         w = self.window
         kdt = cache_k.value.dtype
-        # First prefill: cur is the cache's fresh-init constant (a real
-        # tracer only when a caller passes cache state in), so the ring
-        # is knowably empty — skip the unroll/concat and attend the
-        # block alone (a 128-token prompt must not pay a w+128-key
-        # attention against w masked zeros).
-        fresh = not isinstance(cur, jax.core.Tracer) and int(cur) == 0
+        sinks = self.sinks
+        if sinks:
+            sink_k, sink_v = self._sink_buffers(b, kv_heads)
+            # Merge this block's rows that land in the sink range first:
+            # the sink COLUMNS below read the post-merge buffer, so a
+            # block that decodes across the sink boundary sees its own
+            # sink keys (trace-safe at any cur).
+            self._write_sinks(sink_k, sink_v, k, v, cur, q_len, kdt)
+        # First prefill (`fresh`: the cache collection was created THIS
+        # call): the ring is knowably empty — skip the unroll/concat and
+        # attend the block alone (a 128-token prompt must not pay a
+        # w+128-key attention against w masked zeros).
         if fresh:
             kcat, vcat = k.astype(kdt), v.astype(kdt)
             kv_pos = jnp.arange(q_len)
             q_pos = jnp.arange(q_len)
+            sink_cols = 0
         else:
             shift = jnp.mod(cur, w)
             ordered_k = jnp.roll(cache_k.value, -shift, axis=1)
@@ -408,9 +479,27 @@ class MultiHeadAttention(nn.Module):
             vcat = jnp.concatenate([ordered_v, v.astype(kdt)], axis=1)
             kv_pos = cur - w + jnp.arange(w + q_len)      # global positions
             q_pos = cur + jnp.arange(q_len)
-        keep = ((kv_pos[None, :] >= 0)
+            sink_cols = sinks
+            if sinks:
+                kcat = jnp.concatenate([sink_k.value, kcat], axis=1)
+                vcat = jnp.concatenate([sink_v.value, vcat], axis=1)
+        band = ((kv_pos[None, :] >= 0)
                 & (kv_pos[None, :] <= q_pos[:, None])
                 & (q_pos[:, None] - kv_pos[None, :] < w))
+        if fresh and sinks:
+            # StreamingLLM keep-set during the first block: band OR sink
+            # prefix (the block holds its own sink keys — no columns).
+            band = band | ((kv_pos[None, :] < sinks)
+                           & (kv_pos[None, :] <= q_pos[:, None]))
+        if sink_cols:
+            # Exclusivity at any cur: sink columns serve positions
+            # < sinks (causally: si <= q_pos; unwritten rows are beyond
+            # every q_pos), ring/block entries serve >= sinks.
+            band = band & (kv_pos[None, :] >= sinks)
+            sink_keep = (jnp.arange(sinks)[None, :] <= q_pos[:, None])
+            keep = jnp.concatenate([sink_keep, band], axis=1)
+        else:
+            keep = band
         # New ring = last w positions written so far, re-packed so each
         # row with position p sits at slot p % w.  A fresh block shorter
         # than w writes positions 0..q_len-1 straight to slots 0..q_len-1

@@ -66,8 +66,14 @@ class LlamaConfig:
     # attends to the last ``sliding_window`` positions including itself.
     # Long sequences take the O(S·window) chunked attention path — the
     # long-context lever when full attention's S² won't fit; None = full
-    # causal attention.  Not composable with seq_parallel (loud error).
+    # causal attention.  Composes with ring/Ulysses seq_parallel (the
+    # ring skips out-of-window hops) and with packing.
     sliding_window: Optional[int] = None
+    # StreamingLLM attention sinks (needs sliding_window): the first N
+    # positions stay attendable past the window; decode keeps them in a
+    # small buffer beside the rolling KV ring, so unbounded streaming
+    # generation stays stable.  Ulysses-compatible; ring SP rejects.
+    attention_sinks: int = 0
     # GPipe microbatch count: when set AND the ambient mesh has a
     # ``pipeline`` axis > 1, the depth scan is replaced by the
     # ``parallel.pipeline`` schedule (each stage holds a contiguous layer
@@ -146,7 +152,7 @@ class DecoderBlock(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
-            window=cfg.sliding_window,
+            window=cfg.sliding_window, sinks=cfg.attention_sinks,
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
             name="attention",

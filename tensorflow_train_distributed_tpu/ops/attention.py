@@ -30,6 +30,7 @@ def dot_product_attention(
     causal: bool = False,
     mask: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
     """Reference attention. q/k/v: [B, H, S, D] (q may have different S).
@@ -37,7 +38,10 @@ def dot_product_attention(
     ``window`` (requires ``causal``): sliding-window attention — each
     query sees only the last ``window`` keys including itself (the
     Mistral convention), masked here exactly; this is the numerics
-    oracle for ``local_attention_chunked``.
+    oracle for ``local_attention_chunked``.  ``sinks`` (StreamingLLM):
+    the first ``sinks`` absolute positions stay attendable past the
+    window — the attention-sink trick that keeps streaming decode
+    stable.
     """
     *_, q_len, head_dim = q.shape
     kv_len = k.shape[-2]
@@ -50,13 +54,19 @@ def dot_product_attention(
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
+    if sinks and window is None:
+        raise ValueError("sinks (attention sinks) only apply with a "
+                         "sliding window")
     if causal:
         # Bottom-right aligned causal mask (supports q_len != kv_len).
         q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
         k_pos = jnp.arange(kv_len)[None, :]
         keep = q_pos >= k_pos
         if window is not None:
-            keep = jnp.logical_and(keep, q_pos - k_pos < window)
+            band = q_pos - k_pos < window
+            if sinks:
+                band = jnp.logical_or(band, k_pos < sinks)
+            keep = jnp.logical_and(keep, band)
         logits = jnp.where(keep, logits, mask_value)
     if mask is not None:
         logits = jnp.where(mask, logits, mask_value)
@@ -71,6 +81,7 @@ def local_attention_chunked(
     *,
     window: int,
     segment_ids: Optional[jax.Array] = None,
+    sinks: int = 0,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
     """Sliding-window causal self-attention in O(S·window), TPU-native.
@@ -84,12 +95,19 @@ def local_attention_chunked(
 
     ``segment_ids`` [B, S] (sequence packing) stays structured: ids ride
     the same shift-concat as the keys, so packing composes WITHOUT the
-    dense S×S mask.  Requires q_len == kv_len and q_len % window == 0
-    (the dispatcher falls back to the masked oracle otherwise).
+    dense S×S mask.  ``sinks`` prepends the sequence's first ``sinks``
+    keys to every chunk's key set (StreamingLLM attention sinks) — cost
+    grows to O(S·(window+sinks)), still linear.  Requires q_len ==
+    kv_len and q_len % window == 0 (the dispatcher falls back to the
+    masked oracle otherwise).
     """
     *lead, s, d = q.shape
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    if not 0 <= sinks <= window:
+        raise ValueError(
+            f"sinks must be in [0, window], got sinks={sinks} "
+            f"window={window}")
     if s % window or k.shape[-2] != s:
         raise ValueError(
             f"local_attention_chunked wants self-attention with seq "
@@ -112,6 +130,19 @@ def local_attention_chunked(
     pad4 = [(0, 0)] * len(lead) + [(1, 0), (0, 0), (0, 0)]
     kwin = shift_concat(chunk(k), pad4)                  # [.., nc, 2w, D]
     vwin = shift_concat(chunk(v), pad4)
+    kv = 2 * w
+    if sinks:
+        # Every chunk also sees the sequence's first `sinks` keys —
+        # broadcast along the chunk axis (zero-copy under XLA).
+        def with_sinks(twin, t):
+            sink = jnp.broadcast_to(
+                t[..., None, :sinks, :],
+                (*lead, nc, sinks, d))
+            return jnp.concatenate([sink, twin], axis=-2)
+
+        kwin = with_sinks(kwin, k)
+        vwin = with_sinks(vwin, v)
+        kv += sinks
     logits = jnp.einsum("...cqd,...ckd->...cqk", qc, kwin) * scale
     logits = logits.astype(jnp.float32)
     mask_value = jnp.finfo(jnp.float32).min / 2
@@ -124,12 +155,30 @@ def local_attention_chunked(
     first = (jnp.arange(nc) == 0)[:, None, None]         # [nc, 1, 1]
     pad_slot = (kj < w)[None, :, :] & first              # [nc, w, 2w]
     keep = band[None, :, :] & ~pad_slot                  # [nc, w, 2w]
+    if sinks:
+        # Sink columns: key global = si (< sinks), query global =
+        # base + qi.  Keep when causal (si <= base+qi) and NOT already
+        # a band key of this chunk (the band covers globals
+        # > base+qi-w >= base-w; sinks overlap only for chunks 0/1 where
+        # base - w < sinks is possible) — dedupe by excluding sink
+        # columns the band already reaches: si > base + qi - w.
+        base = (jnp.arange(nc) * w)[:, None, None]       # [nc, 1, 1]
+        si = jnp.arange(sinks)[None, None, :]            # [1, 1, sinks]
+        qg = base + qi[None]                             # [nc, w, 1]
+        sink_keep = (si <= qg) & (si <= qg - w)          # causal & not-in-band
+        keep = jnp.concatenate(
+            [jnp.broadcast_to(sink_keep, (nc, w, sinks)),
+             jnp.broadcast_to(keep, (nc, w, 2 * w))], axis=-1)
     if segment_ids is not None:
         b = segment_ids.shape[0]
         segc = segment_ids.reshape(b, nc, w)
         seg_win = shift_concat(segc, [(0, 0), (1, 0), (0, 0)])
+        if sinks:
+            sink_seg = jnp.broadcast_to(
+                segment_ids[:, None, :sinks], (b, nc, sinks))
+            seg_win = jnp.concatenate([sink_seg, seg_win], axis=-1)
         seg_keep = segc[..., :, None] == seg_win[..., None, :]
-        # [B, nc, w, 2w] → broadcast over the head axis.
+        # [B, nc, w, kv] → broadcast over the head axis.
         keep = keep[None, None] & seg_keep[:, None]
     logits = jnp.where(keep, logits, mask_value)
     weights = jax.nn.softmax(logits, axis=-1)
@@ -164,6 +213,7 @@ def multihead_attention_kernel(
     mask: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
     softmax_scale: Optional[float] = None,
     force_reference: bool = False,
 ) -> jax.Array:
@@ -178,7 +228,9 @@ def multihead_attention_kernel(
     each query sees the last ``window`` keys including itself).  Plain
     long self-attention takes the O(S·window) chunked path
     (``local_attention_chunked``); combinations with packing/masks/
-    cross-length fall back to the exactly-masked oracle.
+    cross-length fall back to the exactly-masked oracle.  ``sinks``
+    (StreamingLLM attention sinks, needs ``window``): the first
+    ``sinks`` positions stay attendable past the window.
     """
     def _fold_segments(mask):
         """Dense same-segment mask (the packing restriction) — only for
@@ -189,6 +241,9 @@ def multihead_attention_kernel(
                == segment_ids[:, None, None, :])  # [B, 1, Sq, Skv]
         return seg if mask is None else jnp.logical_and(mask, seg)
 
+    if sinks and window is None:
+        raise ValueError("sinks (attention sinks) only apply with a "
+                         "sliding window")
     if window is not None:
         if not causal:
             raise ValueError("window (sliding-window attention) requires "
@@ -198,11 +253,12 @@ def multihead_attention_kernel(
         chunkable = (mask is None and not force_reference
                      and q.shape[-2] == k.shape[-2]
                      and q.shape[-2] % window == 0
-                     and q.shape[-2] > window)
+                     and q.shape[-2] > window
+                     and sinks <= window)
         if chunkable:
             return local_attention_chunked(
                 q, k, v, window=window, segment_ids=segment_ids,
-                softmax_scale=softmax_scale)
+                sinks=sinks, softmax_scale=softmax_scale)
         if q.shape[-2] >= 4 * window and not force_reference:
             import warnings
 
@@ -215,7 +271,7 @@ def multihead_attention_kernel(
                 f"fallback can OOM", stacklevel=2)
         return dot_product_attention(
             q, k, v, causal=True, mask=_fold_segments(mask), window=window,
-            softmax_scale=softmax_scale)
+            sinks=sinks, softmax_scale=softmax_scale)
     if force_reference or mask is not None or not _pallas_friendly(q, k, v):
         return dot_product_attention(
             q, k, v, causal=causal, mask=_fold_segments(mask),

@@ -60,6 +60,7 @@ def ring_attention(
     softmax_scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Per-shard ring attention.  q: [B, H, Sq, D]; k/v: [B, Hkv, Sk, D]
     (Hkv may divide H — GQA), all sharded on ``axis``.
@@ -82,6 +83,10 @@ def ring_attention(
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
+    if sinks:
+        raise ValueError(
+            "attention sinks under ring attention are not wired (sink "
+            "keys live on shard 0); use ulysses")
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     q32 = q.astype(jnp.float32) * scale
 
@@ -156,6 +161,7 @@ def ulysses_attention(
     softmax_scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Per-shard Ulysses attention.  q: [B, H, S_local, D]; k/v may carry
     fewer (GQA) heads.  Requires H % axis_size == 0.  Local attention uses
@@ -193,7 +199,7 @@ def ulysses_attention(
     out = multihead_attention_kernel(
         qg, _repeat_kv(kg, qg.shape[1]), _repeat_kv(vg, qg.shape[1]),
         causal=causal, softmax_scale=softmax_scale,
-        segment_ids=full_seg, window=window,
+        segment_ids=full_seg, window=window, sinks=sinks,
     )
     return heads_to_seq(out.astype(q.dtype))
 
@@ -210,6 +216,7 @@ def shard_mapped_attention(
     axis: str = "seq",
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Global-array entry point: q/k/v [B, H, S, D] with S sharded on
     ``axis``, batch on (data, fsdp), heads on tensor — SP × DP × TP.
@@ -230,7 +237,7 @@ def shard_mapped_attention(
     def per_shard(q_, k_, v_, seg_=None):
         return fn(q_, k_, v_, axis=axis, causal=causal,
                   softmax_scale=softmax_scale, segment_ids=seg_,
-                  window=window)
+                  window=window, sinks=sinks)
 
     return shard_map(
         per_shard,

@@ -349,3 +349,150 @@ class TestLlamaSlidingWindow:
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4,
                 err_msg=f"window={w}")
+
+
+class TestAttentionSinks:
+    """StreamingLLM attention sinks: first-N positions stay attendable
+    past the window — oracle, chunked path, decode sink buffers."""
+
+    @pytest.mark.parametrize("s,w,sk", [(64, 16, 4), (64, 16, 16),
+                                        (96, 32, 2)])
+    def test_chunked_matches_oracle(self, s, w, sk):
+        rng = np.random.default_rng(s + w + sk)
+        q, k, v = _qkv(rng, s=s)
+        want = dot_product_attention(q, k, v, causal=True, window=w,
+                                     sinks=sk)
+        got = local_attention_chunked(q, k, v, window=w, sinks=sk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=2e-5)
+
+    def test_sinks_actually_extend_reach(self):
+        """Beyond the window, sink keys change the output vs plain SWA."""
+        rng = np.random.default_rng(31)
+        q, k, v = _qkv(rng, s=64)
+        plain = local_attention_chunked(q, k, v, window=16)
+        sunk = local_attention_chunked(q, k, v, window=16, sinks=4)
+        # Early queries (window covers everything incl. sinks): equal.
+        np.testing.assert_allclose(np.asarray(plain)[..., :16, :],
+                                   np.asarray(sunk)[..., :16, :],
+                                   rtol=1e-5, atol=1e-5)
+        assert not np.allclose(np.asarray(plain)[..., 32:, :],
+                               np.asarray(sunk)[..., 32:, :], atol=1e-3)
+
+    def test_sinks_require_window(self):
+        rng = np.random.default_rng(33)
+        q, k, v = _qkv(rng, s=32)
+        with pytest.raises(ValueError, match="sliding window"):
+            multihead_attention_kernel(q, k, v, causal=True, sinks=2)
+
+    def test_packed_sinks_compose(self):
+        rng = np.random.default_rng(35)
+        q, k, v = _qkv(rng, b=1, s=64)
+        seg = jnp.asarray(np.repeat([1, 2], 32)[None, :])
+        got = multihead_attention_kernel(
+            q, k, v, causal=True, window=16, sinks=4, segment_ids=seg)
+        segmask = (seg[:, None, :, None] == seg[:, None, None, :])
+        want = dot_product_attention(q, k, v, causal=True, window=16,
+                                     sinks=4, mask=segmask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=2e-5)
+
+    def test_streaming_decode_teacher_forcing_exact(self):
+        """Generation deep past the window with sink buffers + rolling
+        ring reproduces the full-forward argmax stream (several slot
+        wraps; the sink buffer carries positions the ring evicted)."""
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import generate, llama
+
+        cfg = dataclasses.replace(llama.LLAMA_PRESETS["llama_tiny"],
+                                  sliding_window=16, attention_sinks=4)
+        rng = np.random.default_rng(37)
+        prompt = rng.integers(2, 256, (1, 24)).astype(np.int32)
+        params = llama.LlamaModel(cfg).init(
+            jax.random.key(0), jnp.asarray(prompt))["params"]
+        out = np.asarray(generate.generate(cfg, params, prompt,
+                                           max_new_tokens=40))
+        logits = np.asarray(llama.LlamaModel(cfg).apply(
+            {"params": params}, jnp.asarray(out)))
+        p = prompt.shape[1]
+        np.testing.assert_array_equal(
+            np.argmax(logits[:, p - 1:-1], -1), out[:, p:])
+
+    def test_chunked_prefill_with_sinks_matches_one_shot(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LLAMA_PRESETS["llama_tiny"],
+                                  sliding_window=8, attention_sinks=3)
+        rng = np.random.default_rng(39)
+        prompt = jnp.asarray(rng.integers(2, 256, (1, 26)), jnp.int32)
+        params = llama.LlamaModel(cfg).init(jax.random.key(0),
+                                            prompt)["params"]
+        model = llama.LlamaModel(cfg, decode=True, cache_len=40)
+        one, v_one = model.apply({"params": params}, prompt,
+                                 mutable=["cache"])
+        a, va = model.apply({"params": params}, prompt[:, :11],
+                            mutable=["cache"])
+        b, vb = model.apply({"params": params, "cache": va["cache"]},
+                            prompt[:, 11:], mutable=["cache"])
+        np.testing.assert_allclose(
+            np.asarray(one),
+            np.concatenate([np.asarray(a), np.asarray(b)], axis=1),
+            rtol=1e-5, atol=1e-5)
+        tok = jnp.asarray([[9]], jnp.int32)
+        s1, _ = model.apply({"params": params, "cache": v_one["cache"]},
+                            tok, mutable=["cache"])
+        s2, _ = model.apply({"params": params, "cache": vb["cache"]},
+                            tok, mutable=["cache"])
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_streaming_from_single_token_prompt(self):
+        """Degenerate-but-legal: prompt SHORTER than the sink count.
+        The sink buffer fills incrementally as positions decode (masked
+        merge), exclusivity holds at every cur, and the stream still
+        teacher-forces exactly."""
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models import generate, llama
+
+        cfg = dataclasses.replace(llama.LLAMA_PRESETS["llama_tiny"],
+                                  sliding_window=8, attention_sinks=4)
+        prompt = np.asarray([[5]], np.int32)
+        params = llama.LlamaModel(cfg).init(
+            jax.random.key(0), jnp.asarray(prompt))["params"]
+        out = np.asarray(generate.generate(cfg, params, prompt,
+                                           max_new_tokens=30))
+        logits = np.asarray(llama.LlamaModel(cfg).apply(
+            {"params": params}, jnp.asarray(out)))
+        np.testing.assert_array_equal(
+            np.argmax(logits[:, :-1], -1), out[:, 1:])
+
+    def test_sinks_under_ring_sp_rejected(self):
+        import dataclasses
+
+        import optax
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+        from tensorflow_train_distributed_tpu.training import (
+            Trainer, TrainerConfig,
+        )
+
+        cfg = dataclasses.replace(
+            llama.LLAMA_PRESETS["llama_tiny"], sliding_window=16,
+            attention_sinks=4, seq_parallel="ring")
+        mesh = build_mesh(MeshConfig(data=2, seq=4),
+                          devices=jax.devices()[:8])
+        rng = np.random.default_rng(41)
+        batch = {"tokens": rng.integers(0, 256, (4, 64)).astype(np.int32),
+                 "targets": rng.integers(0, 256,
+                                         (4, 64)).astype(np.int32)}
+        trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3),
+                          mesh, config=TrainerConfig(log_every=1))
+        with pytest.raises(ValueError, match="sink"):
+            trainer.create_state(batch)

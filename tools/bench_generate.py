@@ -28,7 +28,9 @@ from tensorflow_train_distributed_tpu.training.memory import (  # noqa: E402
 def bench_generate(preset: str, batch: int, prompt_len: int,
                    max_new: int, warmup: int, iters: int,
                    temperature: float = 0.0,
-                   force_hbm: bool = False):
+                   force_hbm: bool = False,
+                   sliding_window: int = 0):
+    import dataclasses
     import time
 
     import jax
@@ -43,6 +45,10 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         # library callers get the clean error, not ZeroDivisionError.
         raise ValueError(f"max_new must be >= 2, got {max_new}")
     cfg = llama.LLAMA_PRESETS[preset]
+    if sliding_window:
+        # A/B the rolling window-sized KV cache against the preset's full
+        # attention (cache rows = window instead of prompt+new).
+        cfg = dataclasses.replace(cfg, sliding_window=sliding_window)
     total_len = prompt_len + max_new
     if total_len > cfg.max_positions:
         raise SystemExit(
@@ -63,7 +69,10 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     # head_dim).
     itemsize = jnp.dtype(cfg.dtype).itemsize
     kv_heads = cfg.num_kv_heads or cfg.num_heads
-    cache_bytes = (2 * cfg.num_layers * batch * total_len
+    cache_rows = total_len
+    if cfg.sliding_window and cfg.sliding_window < total_len:
+        cache_rows = cfg.sliding_window  # rolling ring buffer
+    cache_bytes = (2 * cfg.num_layers * batch * cache_rows
                    * kv_heads * (cfg.d_model // cfg.num_heads) * itemsize)
     need = n_params * (itemsize + 4) + cache_bytes  # cast copy + f32 init
     budget = (hbm_budget_bytes(dev.device_kind)
@@ -116,6 +125,9 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         "n_params": n_params,
         "backend": dev.platform,
     }
+    if cfg.sliding_window:
+        rec["sliding_window"] = cfg.sliding_window
+        rec["kv_cache_rows"] = cache_rows
     bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
           if dev.platform == "tpu" else None)
     if bw is not None:
@@ -150,6 +162,11 @@ def main(argv=None) -> int:
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
     p.add_argument("--force-hbm", action="store_true")
+    p.add_argument("--sliding-window", type=int, default=0,
+                   help="override the preset with sliding-window "
+                        "attention: decode keeps a rolling WINDOW-row "
+                        "KV cache (A/B vs full attention; 0 = preset "
+                        "default)")
     args = p.parse_args(argv)
     if args.platform:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -170,7 +187,8 @@ def main(argv=None) -> int:
             rec = bench_generate(args.preset, args.batch, args.prompt_len,
                                  args.max_new, args.warmup, args.iters,
                                  temperature=args.temperature,
-                                 force_hbm=args.force_hbm)
+                                 force_hbm=args.force_hbm,
+                                 sliding_window=args.sliding_window)
     except Exception as e:
         print(json.dumps({
             "metric": f"{args.preset}_decode_tokens_per_sec_per_chip",
