@@ -1,10 +1,11 @@
 """Import HuggingFace Llama-family checkpoints into the native model.
 
 Migration path for the reference's SFT config (SURVEY.md §2.1 config[4]:
-"Llama-2-7B SFT"): users arrive with HF ``LlamaForCausalLM`` weights; this
-maps them onto ``models.llama.LlamaModel``'s parameter tree so fine-tuning
-continues here with TP/SP/FSDP shardings instead of the reference's DTensor
-mesh.
+"Llama-2-7B SFT"): users arrive with HF ``LlamaForCausalLM`` (or
+``MistralForCausalLM`` — GQA + sliding window map onto the native
+``num_kv_heads``/``sliding_window``) weights; this maps them onto
+``models.llama.LlamaModel``'s parameter tree so fine-tuning continues
+here with TP/SP/FSDP shardings instead of the reference's DTensor mesh.
 
 Conventions that make the mapping exact (verified by the forward-parity
 test against the torch implementation, tests/test_import_hf.py):
@@ -46,10 +47,6 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "checkpoint uses rope_scaling (Llama-3-style scaled RoPE), "
             "which the native model does not implement — importing would "
             "silently change logits at every position")
-    if getattr(hf_config, "sliding_window", None):
-        raise ValueError(
-            "checkpoint uses sliding-window attention; the native model "
-            "attends globally — not exactly representable")
     if getattr(hf_config, "attention_bias", False):
         raise ValueError(
             "checkpoint has q/k/v/o projection biases; the native "
@@ -66,6 +63,13 @@ def config_from_hf(hf_config) -> LlamaConfig:
         max_positions=hf_config.max_position_embeddings,
         rope_base=getattr(hf_config, "rope_theta", 10_000.0),
         rms_epsilon=hf_config.rms_norm_eps,
+        # Mistral-family checkpoints: HF masks keys at distance >=
+        # sliding_window — identical semantics to the native window
+        # (last `window` keys including self), torch-parity-tested.
+        # `or None`: a checkpoint carrying sliding_window=0 means
+        # disabled, and must import as full attention, not crash at the
+        # first forward (exact-or-rejected happens HERE).
+        sliding_window=getattr(hf_config, "sliding_window", None) or None,
     )
 
 

@@ -279,3 +279,75 @@ class TestBertImport:
         step = trainer._compiled_train_step()
         state, metrics = step(state, shard_batch(mesh8, batch))
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestMistralImport:
+    """HF MistralForCausalLM (GQA + sliding window) → native model,
+    forward-parity vs torch WITH the window binding (seq > window)."""
+
+    @pytest.fixture(scope="class")
+    def hf_mistral(self):
+        cfg = transformers.MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            rms_norm_eps=1e-5, rope_theta=10_000.0,
+            sliding_window=16, tie_word_embeddings=False,
+        )
+        torch.manual_seed(1)
+        model = transformers.MistralForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def test_config_maps_sliding_window(self, hf_mistral):
+        cfg = config_from_hf(hf_mistral.config)
+        assert cfg.sliding_window == 16
+        assert cfg.num_kv_heads == 2
+
+    def test_forward_parity_with_binding_window(self, hf_mistral):
+        import jax.numpy as jnp
+
+        cfg, params = import_llama(hf_mistral, remat=False,
+                                   dtype=jnp.float32)
+        rng = np.random.default_rng(3)
+        # seq 48 > window 16: parity here proves the window SEMANTICS
+        # match HF's (not just the weight mapping).
+        tokens = rng.integers(0, 256, (2, 48)).astype(np.int32)
+        with torch.no_grad():
+            want = hf_mistral(torch.asarray(tokens)).logits.float().numpy()
+        got = np.asarray(LlamaModel(cfg).apply(
+            {"params": params}, tokens).astype(np.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+        # And the full model really windows: beyond-window positions
+        # differ from a no-window import of the same weights.
+        import dataclasses
+
+        nowin = dataclasses.replace(cfg, sliding_window=None)
+        far = np.asarray(LlamaModel(nowin).apply(
+            {"params": params}, tokens).astype(np.float32))
+        assert not np.allclose(got[:, 20:], far[:, 20:], atol=1e-3)
+
+    def test_sliding_window_zero_imports_as_full_attention(self,
+                                                           hf_mistral):
+        import copy
+
+        cfg_hf = copy.deepcopy(hf_mistral.config)
+        cfg_hf.sliding_window = 0  # some checkpoints mean "disabled"
+        cfg = config_from_hf(cfg_hf)
+        assert cfg.sliding_window is None
+
+    def test_generate_token_exact_vs_hf(self, hf_mistral):
+        """Greedy decode through the ROLLING window cache reproduces
+        HF Mistral's generate token-for-token."""
+        from tensorflow_train_distributed_tpu.models import generate
+
+        cfg, params = import_llama(hf_mistral)
+        prompt = np.random.default_rng(0).integers(
+            2, 256, (1, 24)).astype(np.int32)
+        out = np.asarray(generate.generate(cfg, params, prompt,
+                                           max_new_tokens=40))
+        with torch.no_grad():
+            want = hf_mistral.generate(
+                torch.asarray(prompt), max_new_tokens=40,
+                do_sample=False).numpy()
+        np.testing.assert_array_equal(out, want)
