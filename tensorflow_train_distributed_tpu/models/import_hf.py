@@ -51,6 +51,14 @@ def config_from_hf(hf_config) -> LlamaConfig:
         raise ValueError(
             "checkpoint has q/k/v/o projection biases; the native "
             "attention is bias-free — not exactly representable")
+    hd = getattr(hf_config, "head_dim", None)
+    if hd and hd != hf_config.hidden_size // hf_config.num_attention_heads:
+        raise ValueError(
+            f"checkpoint uses an explicit head_dim={hd} != hidden_size/"
+            f"num_heads ({hf_config.hidden_size}/"
+            f"{hf_config.num_attention_heads}) — Mistral-Nemo-style "
+            "decoupled head width is not representable (the native "
+            "model derives head_dim = d_model // num_heads)")
     kv = getattr(hf_config, "num_key_value_heads",
                  hf_config.num_attention_heads)
     return LlamaConfig(
@@ -288,9 +296,12 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
     shapes is safe.
     """
     if isinstance(model_or_path, str):
-        from transformers import LlamaForCausalLM
+        # Auto resolves the checkpoint's own class (Llama OR Mistral) —
+        # loading a mistral checkpoint through LlamaForCausalLM would
+        # keep sliding_window only by PretrainedConfig accident.
+        from transformers import AutoModelForCausalLM
 
-        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
     if config is None:
         config = config_from_hf(model_or_path.config)
     if config_overrides:

@@ -151,8 +151,8 @@ class MultiHeadAttention(nn.Module):
     # StreamingLLM attention sinks (needs ``window``): the first
     # ``sinks`` positions stay attendable past the window — keeps
     # unbounded streaming decode stable.  Decode stores them in a small
-    # separate buffer beside the rolling ring.  Ulysses-compatible;
-    # ring SP would need a shard-0 broadcast (rejected loudly).
+    # separate buffer beside the rolling ring; both SP methods compose
+    # (ring broadcasts shard 0's sink block with one tiny psum).
     sinks: int = 0
     # Autoregressive decode: keep a KV cache of ``cache_len`` positions in
     # the mutable "cache" collection; each call appends this call's k/v at
@@ -247,12 +247,6 @@ class MultiHeadAttention(nn.Module):
                     "segment_ids), not dense masks")
             if x_kv is not x_q:
                 raise ValueError("seq_parallel supports self-attention only")
-            if self.sinks and self.seq_parallel == "ring":
-                raise ValueError(
-                    "attention sinks under RING seq_parallel are not "
-                    "wired (the sink keys live on shard 0 and would "
-                    "need a broadcast); use seq_parallel='ulysses' or "
-                    "drop the sinks")
             from tensorflow_train_distributed_tpu.parallel.ring_attention \
                 import shard_mapped_attention
 

@@ -72,7 +72,7 @@ class LlamaConfig:
     # StreamingLLM attention sinks (needs sliding_window): the first N
     # positions stay attendable past the window; decode keeps them in a
     # small buffer beside the rolling KV ring, so unbounded streaming
-    # generation stays stable.  Ulysses-compatible; ring SP rejects.
+    # generation stays stable.  Composes with ring AND Ulysses SP.
     attention_sinks: int = 0
     # GPipe microbatch count: when set AND the ambient mesh has a
     # ``pipeline`` axis > 1, the depth scan is replaced by the

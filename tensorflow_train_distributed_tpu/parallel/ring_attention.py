@@ -83,17 +83,33 @@ def ring_attention(
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
-    if sinks:
+    if sinks and (window is None or sinks > sk):
         raise ValueError(
-            "attention sinks under ring attention are not wired (sink "
-            "keys live on shard 0); use ulysses")
+            f"ring attention sinks need a sliding window and must fit "
+            f"one shard (sinks={sinks}, shard span={sk})")
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     q32 = q.astype(jnp.float32) * scale
 
-    def attend_block(carry_olm, k_blk, v_blk, kv_idx, kv_seg):
+    def fold(carry_olm, k_blk, v_blk, block_mask):
+        """Online-softmax accumulation of one masked KV block — the ONE
+        numerically sensitive update, shared by ring hops and the sink
+        block."""
         o, m, l = carry_olm
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
                        _repeat_kv(k_blk, h).astype(jnp.float32))
+        s = jnp.where(block_mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        # Mask again on p: a fully-masked block must contribute exactly 0
+        # (exp(s - m_new) would be 1 on its own masked rows).
+        p = jnp.where(block_mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(-1, keepdims=True)
+        o_new = o * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p,
+            _repeat_kv(v_blk, h).astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    def attend_block(carry_olm, k_blk, v_blk, kv_idx, kv_seg):
         if causal:
             q_pos = idx * sq + jnp.arange(sq)[:, None]
             k_pos = kv_idx * sk + jnp.arange(sk)[None, :]
@@ -107,17 +123,7 @@ def ring_attention(
             # [B,1,Sq,Sk] segment mask; & broadcasts the positional mask.
             block_mask = block_mask & (
                 segment_ids[:, :, None] == kv_seg[:, None, :])[:, None]
-        s = jnp.where(block_mask, s, _NEG)
-        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
-        # Mask again on p: a fully-masked block must contribute exactly 0
-        # (exp(s - m_new) would be 1 on its own masked rows).
-        p = jnp.where(block_mask, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(-1, keepdims=True)
-        o_new = o * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p,
-            _repeat_kv(v_blk, h).astype(jnp.float32))
-        return o_new, m_new, l_new
+        return fold(carry_olm, k_blk, v_blk, block_mask)
 
     o0 = jnp.zeros((b, h, sq, d), jnp.float32)
     m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
@@ -127,6 +133,32 @@ def ring_attention(
     # KV shard's segment ids ride the carry ONLY when packing is active:
     # the unpacked path must not pay an extra ppermute per hop.
     olm = attend_block((o0, m0, l0), k, v, idx, segment_ids)
+
+    if sinks:
+        # StreamingLLM sinks: the sequence's first `sinks` keys live on
+        # shard 0 — one tiny masked-psum broadcast (sinks·Hkv·D per
+        # batch row, negligible next to a KV hop) hands every shard the
+        # sink block.  The online softmax folds it in like any other
+        # block; exclusivity with the window band: queries that can
+        # reach a sink key through the band (q_pos - si < window) mask
+        # it here, so no key is double-counted across blocks.
+        def bcast0(t):
+            return jax.lax.psum(
+                jnp.where(idx == 0, t[:, :, :sinks], 0), axis)
+
+        sink_k, sink_v = bcast0(k), bcast0(v)
+        sink_seg = (None if segment_ids is None else jax.lax.psum(
+            jnp.where(idx == 0, segment_ids[:, :sinks], 0), axis))
+        q_pos = idx * sq + jnp.arange(sq)[:, None]
+        si = jnp.arange(sinks)[None, :]
+        keep = (si <= q_pos) & (q_pos - si >= window)
+        if sink_seg is not None:
+            keep = keep[None, None] & (
+                segment_ids[:, :, None] == sink_seg[:, None, :])[:, None]
+        else:
+            keep = jnp.broadcast_to(keep[None, None],
+                                    (1, 1, sq, sinks))
+        olm = fold(olm, sink_k, sink_v, keep)
 
     def body(carry, step):
         olm, k_blk, v_blk, seg_blk = carry

@@ -470,29 +470,62 @@ class TestAttentionSinks:
         np.testing.assert_array_equal(
             np.argmax(logits[:, :-1], -1), out[:, 1:])
 
-    def test_sinks_under_ring_sp_rejected(self):
-        import dataclasses
-
-        import optax
-
-        from tensorflow_train_distributed_tpu.models import llama
+    @pytest.mark.parametrize("sk", [2, 8, 16])
+    def test_ring_sp_sinks_match_oracle(self, sk):
+        """Ring SP + sinks: shard 0's sink block broadcasts (tiny psum)
+        and every shard folds it into the online softmax — matches the
+        full windowed+sinks oracle at sink counts below/at the shard
+        span (span 16 on a 4-way seq axis over S=64)."""
+        from tensorflow_train_distributed_tpu.parallel.ring_attention \
+            import shard_mapped_attention
         from tensorflow_train_distributed_tpu.runtime.mesh import (
             MeshConfig, build_mesh,
         )
-        from tensorflow_train_distributed_tpu.training import (
-            Trainer, TrainerConfig,
-        )
 
-        cfg = dataclasses.replace(
-            llama.LLAMA_PRESETS["llama_tiny"], sliding_window=16,
-            attention_sinks=4, seq_parallel="ring")
         mesh = build_mesh(MeshConfig(data=2, seq=4),
                           devices=jax.devices()[:8])
-        rng = np.random.default_rng(41)
-        batch = {"tokens": rng.integers(0, 256, (4, 64)).astype(np.int32),
-                 "targets": rng.integers(0, 256,
-                                         (4, 64)).astype(np.int32)}
-        trainer = Trainer(llama.CausalLmTask(cfg), optax.adam(1e-3),
-                          mesh, config=TrainerConfig(log_every=1))
-        with pytest.raises(ValueError, match="sink"):
-            trainer.create_state(batch)
+        rng = np.random.default_rng(43 + sk)
+        q, k, v = _qkv(rng, b=2, h=4, s=64, d=8)
+        out = shard_mapped_attention(mesh, q, k, v, method="ring",
+                                     causal=True, window=24, sinks=sk)
+        ref = dot_product_attention(q, k, v, causal=True, window=24,
+                                    sinks=sk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_ring_sp_sinks_with_packing(self):
+        from tensorflow_train_distributed_tpu.parallel.ring_attention \
+            import shard_mapped_attention
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+
+        mesh = build_mesh(MeshConfig(data=2, seq=4),
+                          devices=jax.devices()[:8])
+        rng = np.random.default_rng(47)
+        q, k, v = _qkv(rng, b=2, h=4, s=64, d=8)
+        seg = jnp.asarray(np.stack([
+            np.repeat([1, 2], [30, 34]), np.repeat([1, 2], [10, 54])]))
+        out = shard_mapped_attention(mesh, q, k, v, method="ring",
+                                     causal=True, window=24, sinks=4,
+                                     segment_ids=seg)
+        segmask = (seg[:, None, :, None] == seg[:, None, None, :])
+        ref = dot_product_attention(q, k, v, causal=True, window=24,
+                                    sinks=4, mask=segmask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_ring_sp_sinks_exceeding_shard_rejected(self):
+        from tensorflow_train_distributed_tpu.parallel.ring_attention \
+            import shard_mapped_attention
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            MeshConfig, build_mesh,
+        )
+
+        mesh = build_mesh(MeshConfig(data=2, seq=4),
+                          devices=jax.devices()[:8])
+        rng = np.random.default_rng(49)
+        q, k, v = _qkv(rng, b=2, h=4, s=64, d=8)
+        with pytest.raises(ValueError, match="shard"):
+            shard_mapped_attention(mesh, q, k, v, method="ring",
+                                   causal=True, window=24, sinks=20)
