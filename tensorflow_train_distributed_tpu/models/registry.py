@@ -7,6 +7,7 @@ every family so each model's full path runs on CPU test meshes.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable
 
 _REGISTRY: dict[str, dict[str, Any]] = {}
@@ -133,6 +134,26 @@ def _setup():
              strategy="dp", global_batch_size=8,
              learning_rate=3e-4, lr_schedule="warmup_cosine",
              warmup_ratio=0.01, grad_clip_norm=1.0)
+    # Mistral-family flagship: GQA + sliding-window attention (O(S·w)
+    # chunked path) over 32k positions; same weight layout as llama so
+    # --init-from-hf imports real Mistral checkpoints.
+    register("mistral_7b_lm",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["mistral_7b"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=32_000, seq_len=8192),
+             strategy="fsdp_tp", global_batch_size=8,
+             learning_rate=3e-4, lr_schedule="warmup_cosine",
+             warmup_ratio=0.01, grad_clip_norm=1.0)
+    # CPU-trainable windowed-family canary (CI-sized mistral shape).
+    register("mistral_tiny_lm",
+             task_factory=lambda: llama.make_task(
+                 dataclasses.replace(
+                     llama.LLAMA_PRESETS["llama_tiny"],
+                     sliding_window=16, attention_sinks=4)),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=64),
+             strategy="dp", global_batch_size=16, learning_rate=1e-3)
     # Beyond the reference (it has no MoE): expert-parallel decoder LM.
     register("mixtral_8x7b",
              task_factory=lambda: moe.make_task(

@@ -529,3 +529,15 @@ class TestAttentionSinks:
         with pytest.raises(ValueError, match="shard"):
             shard_mapped_attention(mesh, q, k, v, method="ring",
                                    causal=True, window=24, sinks=20)
+
+
+def test_cli_trains_windowed_family():
+    """The registered mistral-shaped config (sliding window + sinks)
+    trains through the real CLI."""
+    from tensorflow_train_distributed_tpu import launch
+
+    result = launch.run(launch.build_parser().parse_args([
+        "--config", "mistral_tiny_lm", "--steps", "3",
+        "--global-batch-size", "8", "--platform", "cpu",
+        "--log-every", "1"]))
+    assert np.isfinite(result.history["loss"]).all()

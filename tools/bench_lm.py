@@ -119,7 +119,8 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
 
 
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
-             remat=None, remat_policy=None, force_hbm: bool = False):
+             remat=None, remat_policy=None, force_hbm: bool = False,
+             sliding_window: int = 0):
     import jax
     import numpy as np
     import optax
@@ -136,6 +137,8 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
     import dataclasses
 
     cfg = llama.LLAMA_PRESETS[preset]
+    if sliding_window:
+        cfg = dataclasses.replace(cfg, sliding_window=sliding_window)
     if remat is not None:
         # remat trades recompute for memory; when the model fits without
         # it (small presets, single chip) turning it off is pure speed.
@@ -184,8 +187,14 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
     dt = timed_step_seconds(step, state, dev_batch, warmup, iters)
     tok_per_sec_chip = global_batch * seq / dt / n_chips
     dev0 = mesh.devices.flat[0]
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.d_model * \
-        seq * 0.5
+    # Average attended context per token: seq/2 causal; a binding
+    # sliding window caps it (honest MFU — full-attention FLOPs would
+    # overstate the windowed model's utilization).
+    ctx = seq * 0.5
+    if cfg.sliding_window and cfg.sliding_window < seq:
+        w = cfg.sliding_window
+        ctx = (w * (w + 1) / 2 + (seq - w) * w) / seq
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.d_model * ctx
     rec = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_chip, 1),
@@ -197,6 +206,8 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         "n_params": n_params,
         "backend": dev0.platform,
     }
+    if cfg.sliding_window:
+        rec["sliding_window"] = cfg.sliding_window
     peak = peak_tflops(dev0)
     if peak is not None:
         mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
@@ -208,6 +219,10 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--preset", default="llama_125m")
+    p.add_argument("--sliding-window", type=int, default=0,
+                   help="override the preset with sliding-window "
+                        "attention (O(seq*window) chunked path) — A/B "
+                        "vs full attention; 0 = preset default")
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--seq", type=int, default=2048)
     p.add_argument("--warmup", type=int, default=3)
@@ -250,7 +265,8 @@ def main(argv=None) -> int:
             rec = bench_lm(args.preset, args.batch_per_chip, args.seq,
                            args.warmup, args.iters, remat=args.remat,
                            remat_policy=args.remat_policy,
-                           force_hbm=args.force_hbm)
+                           force_hbm=args.force_hbm,
+                           sliding_window=args.sliding_window)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
                           "_per_chip", "value": 0.0,
