@@ -1,0 +1,172 @@
+"""Export native Llama-family checkpoints AS HuggingFace models.
+
+The inverse of ``models.import_hf`` — closes the interop loop for the
+reference's SFT story (SURVEY.md §2.1 config[4]): fine-tune here on TPU
+meshes, then hand the result to any HF-stack consumer
+(``AutoModelForCausalLM.from_pretrained`` loads the exported directory
+directly; forward parity and import→export→import round trips are
+tested).  Windowed configs export as ``model_type: mistral`` so the HF
+side applies the same sliding-window masking.
+
+Weight conventions mirror import_hf exactly in reverse: flax ``[in,
+out]`` kernels transpose back to torch ``[out, in]``; scan-stacked
+layer params unstack into ``model.layers.{i}.*``; the head is always
+written explicitly (``tie_word_embeddings: false``).  Params may be
+live (possibly sharded) jax arrays — leaves are gathered with
+``np.asarray``, so every shard must be addressable from this host.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from tensorflow_train_distributed_tpu.models.llama import LlamaConfig
+
+
+def hf_config_dict(config: LlamaConfig) -> dict:
+    """``config.json`` contents for the exported checkpoint."""
+    if config.attention_sinks:
+        raise ValueError(
+            "attention_sinks have no HF config field — export the model "
+            "without sinks (they are a decode-time technique; the "
+            "weights are identical)")
+    mistral = config.sliding_window is not None
+    head_dim = config.d_model // config.num_heads
+    out = {
+        "model_type": "mistral" if mistral else "llama",
+        "architectures": ["MistralForCausalLM" if mistral
+                          else "LlamaForCausalLM"],
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.d_model,
+        "intermediate_size": config.ffn_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads or config.num_heads,
+        "head_dim": head_dim,
+        "max_position_embeddings": config.max_positions,
+        "rms_norm_eps": config.rms_epsilon,
+        "rope_theta": config.rope_base,
+        "hidden_act": "silu",
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+    }
+    if mistral:
+        out["sliding_window"] = config.sliding_window
+    return out
+
+
+def _t(x) -> "object":
+    import torch
+
+    return torch.from_numpy(np.asarray(x, np.float32))
+
+
+def export_llama_state_dict(params, config: LlamaConfig) -> dict:
+    """Native flax ``params`` tree → HF ``LlamaForCausalLM`` state dict
+    (torch tensors, f32)."""
+    import flax.linen as nn
+
+    params = nn.unbox(params)  # strip LogicallyPartitioned metadata
+    if config.scan_layers:
+        import jax
+
+        # Gather the stacked leaves host-side ONCE; per-layer slicing of
+        # a ~13 GB 7B stack inside the loop would re-transfer the whole
+        # model num_layers times.
+        gathered = jax.tree_util.tree_map(
+            np.asarray, params["layers"]["stack"]["block"])
+
+        def layer(i):
+            return jax.tree_util.tree_map(lambda x: x[i], gathered)
+    else:
+        def layer(i):
+            return params[f"layer_{i}"]
+
+    sd = {
+        "model.embed_tokens.weight": _t(params["token_embed"]["embedding"]),
+        "model.norm.weight": _t(params["final_norm"]["scale"]),
+        "lm_head.weight": _t(np.asarray(
+            params["lm_head"]["kernel"]).T),
+    }
+    for i in range(config.num_layers):
+        lt = layer(i)
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _t(lt["attn_norm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _t(
+            lt["mlp_norm"]["scale"])
+        attn = lt["attention"]
+        for hf, ours in (("q_proj", "query"), ("k_proj", "key"),
+                         ("v_proj", "value"), ("o_proj", "out")):
+            sd[p + f"self_attn.{hf}.weight"] = _t(
+                np.asarray(attn[ours]["kernel"]).T)
+        mlp = lt["mlp"]
+        for hf, ours in (("gate_proj", "wi_gate"), ("up_proj", "wi_up"),
+                         ("down_proj", "wo")):
+            sd[p + f"mlp.{hf}.weight"] = _t(
+                np.asarray(mlp[ours]["kernel"]).T)
+    return sd
+
+
+def export_llama(config: LlamaConfig, params, out_dir) -> Path:
+    """Write an HF-loadable checkpoint directory (config.json +
+    pytorch_model.bin); returns the directory path."""
+    import torch
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "config.json").write_text(
+        json.dumps(hf_config_dict(config), indent=2))
+    torch.save(export_llama_state_dict(params, config),
+               out / "pytorch_model.bin")
+    return out
+
+
+def export_hf_from_registry(config_name: str, checkpoint_dir,
+                            out_dir, *, platform: str = "cpu") -> Path:
+    """CLI-oriented wrapper: registry llama-family config + orbax
+    checkpoint → HF directory.  ``checkpoint_dir=None`` exports a fresh
+    init (interop smoke test)."""
+    from tensorflow_train_distributed_tpu.models import registry
+    from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
+    from tensorflow_train_distributed_tpu.runtime.mesh import force_platform
+
+    if platform:
+        force_platform(platform)
+    task = registry.get_entry(config_name)["task_factory"]()
+    if not isinstance(task, CausalLmTask):
+        raise SystemExit(
+            f"--config {config_name} is not a Llama-family decoder "
+            "(HF export maps LlamaForCausalLM/MistralForCausalLM "
+            "checkpoints only)")
+    config = task.config
+    if config.attention_sinks:
+        # Sinks are decode-time; the exported weights are identical.
+        import dataclasses
+
+        config = dataclasses.replace(config, attention_sinks=0)
+    if checkpoint_dir is not None:
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        mgr = CheckpointManager(str(checkpoint_dir), async_save=False)
+        # Weights only (the purpose-built analysis-tool restore): a
+        # decoder has no mutable model_state, and the optimizer moments
+        # are irrelevant to the exported checkpoint.
+        params = mgr.restore_params()
+        mgr.close()
+        if params is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    else:
+        import jax
+        import numpy as np_
+
+        from tensorflow_train_distributed_tpu.models.llama import LlamaModel
+
+        toks = np_.zeros((1, 8), np_.int32)
+        params = LlamaModel(config).init(jax.random.key(0),
+                                         toks)["params"]
+    return export_llama(config, params, out_dir)

@@ -6,6 +6,8 @@ local config; parity of the two forwards is the proof the weight mapping
 shape-compatible.
 """
 
+import os
+
 import pytest
 
 pytestmark = pytest.mark.slow  # compile/fit-heavy: full-suite tier
@@ -351,3 +353,83 @@ class TestMistralImport:
                 torch.asarray(prompt), max_new_tokens=40,
                 do_sample=False).numpy()
         np.testing.assert_array_equal(out, want)
+
+
+class TestExportHf:
+    """Native → HF export (the import inverse): AutoModel loads the
+    directory, forward parity is exact, import(export) round-trips."""
+
+    @pytest.mark.parametrize("preset,extra,hf_cls", [
+        ("llama_tiny", {}, "LlamaForCausalLM"),
+        ("llama_tiny_scan", {}, "LlamaForCausalLM"),
+        ("llama_tiny", {"sliding_window": 16}, "MistralForCausalLM"),
+    ])
+    def test_roundtrip_and_forward_parity(self, tmp_path, preset, extra,
+                                          hf_cls):
+        import dataclasses
+
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models import llama
+        from tensorflow_train_distributed_tpu.models.export_hf import (
+            export_llama,
+        )
+
+        cfg = dataclasses.replace(llama.LLAMA_PRESETS[preset],
+                                  dtype=jnp.float32, remat=False, **extra)
+        toks = np.random.default_rng(0).integers(
+            0, 256, (2, 48)).astype(np.int32)
+        params = llama.LlamaModel(cfg).init(
+            jax.random.key(0), np.asarray(toks))["params"]
+        native = np.asarray(llama.LlamaModel(cfg).apply(
+            {"params": params}, toks))
+        out = export_llama(cfg, params, tmp_path / "hf")
+        hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+        hf.eval()
+        assert type(hf).__name__ == hf_cls
+        with torch.no_grad():
+            want = hf(torch.asarray(toks)).logits.float().numpy()
+        np.testing.assert_allclose(native, want, rtol=2e-3, atol=2e-4)
+        # import(export) is the identity on weights.
+        cfg2, params2 = import_llama(hf, scan_layers=cfg.scan_layers,
+                                     dtype=jnp.float32, remat=False)
+        assert cfg2.sliding_window == cfg.sliding_window
+        for a, b in zip(jax.tree.leaves(nn.unbox(params)),
+                        jax.tree.leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_cli_train_then_export(self, tmp_path):
+        """Real flow: CLI-train with a checkpoint, export via the tool,
+        reload with HF."""
+        import importlib.util
+
+        from tensorflow_train_distributed_tpu import launch
+
+        ck = tmp_path / "ck"
+        launch.run(launch.build_parser().parse_args([
+            "--config", "llama_tiny_sft", "--steps", "2",
+            "--global-batch-size", "8", "--platform", "cpu",
+            "--checkpoint-dir", str(ck), "--checkpoint-every", "2"]))
+        spec = importlib.util.spec_from_file_location(
+            "export_hf_tool", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "export_hf_checkpoint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = tmp_path / "hf"
+        assert mod.main(["--config", "llama_tiny_sft",
+                         "--checkpoint-dir", str(ck),
+                         "--out", str(out), "--platform", ""]) == 0
+        hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+        assert hf.config.vocab_size == 256
+
+    def test_non_decoder_config_rejected(self, tmp_path):
+        from tensorflow_train_distributed_tpu.models.export_hf import (
+            export_hf_from_registry,
+        )
+
+        with pytest.raises(SystemExit, match="Llama-family"):
+            export_hf_from_registry("mnist", None, tmp_path / "x",
+                                    platform="")

@@ -1,0 +1,39 @@
+"""Export a trained Llama-family config as a HuggingFace checkpoint.
+
+The inverse of ``--init-from-hf``: fine-tune on TPU meshes here, then
+hand the directory to any HF consumer (``AutoModelForCausalLM.
+from_pretrained`` loads it; sliding-window configs export as Mistral).
+
+Usage:
+  python tools/export_hf_checkpoint.py --config llama_tiny_sft \
+      --checkpoint-dir /ckpt --out /tmp/hf_export
+  (omit --checkpoint-dir to export a fresh init — interop smoke test)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--config", required=True)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--out", required=True)
+    p.add_argument("--platform", default="cpu",
+                   help="jax platform for the restore ('' = default)")
+    args = p.parse_args(argv)
+    from tensorflow_train_distributed_tpu.models.export_hf import (
+        export_hf_from_registry,
+    )
+
+    out = export_hf_from_registry(args.config, args.checkpoint_dir,
+                                  args.out, platform=args.platform)
+    print(f"HF checkpoint written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
