@@ -43,6 +43,14 @@ say "--- 5. decode throughput (serving) ---"
 timeout 1200 python tools/bench_generate.py --preset llama_125m \
     --batch 8 --prompt-len 128 --max-new 256 2>>"$LOG" | tee -a "$LOG"
 
+say "--- 6. sliding-window A/B (train + serve; chunked path vs full) ---"
+timeout 1200 python tools/bench_lm.py --preset llama_125m \
+    --batch-per-chip 8 --seq 2048 --no-remat --sliding-window 512 \
+    2>>"$LOG" | tee -a "$LOG"
+timeout 1200 python tools/bench_generate.py --preset llama_125m \
+    --batch 8 --prompt-len 128 --max-new 256 --sliding-window 512 \
+    2>>"$LOG" | tee -a "$LOG"
+
 say "=== playbook done $(date -u); results in $LOG ==="
 say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
 say "pallas verdict from 4 — keep whichever wins as the default)."
