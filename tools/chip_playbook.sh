@@ -47,8 +47,10 @@ say "--- 6. sliding-window A/B (train + serve; chunked path vs full) ---"
 timeout 1200 python tools/bench_lm.py --preset llama_125m \
     --batch-per-chip 8 --seq 2048 --no-remat --sliding-window 512 \
     2>>"$LOG" | tee -a "$LOG"
+# serve leg: window must be < prompt+max_new (384) or the rolling cache
+# never engages and this measures full attention twice.
 timeout 1200 python tools/bench_generate.py --preset llama_125m \
-    --batch 8 --prompt-len 128 --max-new 256 --sliding-window 512 \
+    --batch 8 --prompt-len 128 --max-new 256 --sliding-window 256 \
     2>>"$LOG" | tee -a "$LOG"
 
 say "=== playbook done $(date -u); results in $LOG ==="
