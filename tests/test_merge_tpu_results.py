@@ -1,0 +1,127 @@
+"""tools/merge_tpu_results.py: hunter-results → persisted-record merge.
+
+Pure host logic (no jax): the merge must enrich the record without
+clobbering families it did not re-measure, recompute the resnet headline
+by bench.py's best-of rule, and stamp per-entry honesty timestamps.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from merge_tpu_results import merge  # noqa: E402
+
+BASE = {
+    "metric": "resnet50_train_images_per_sec_per_chip",
+    "value": 2433.7, "unit": "images/sec/chip", "vs_baseline": 0.973,
+    "backend": "tpu", "config": "resnet50_s2d",
+    "configs": {
+        "resnet50": {"images_per_sec_per_chip": 2403.0, "mfu_pct": 15.0},
+        "resnet50_s2d": {"images_per_sec_per_chip": 2433.7,
+                         "mfu_pct": 15.2},
+    },
+    "mfu_pct": 15.2, "measured_at": "2026-07-29T20:41Z",
+}
+
+
+def step(name, js, at="2026-07-31T02:00:00Z"):
+    return {"step": name, "at": at, "json": js}
+
+
+def test_resnet_config_merge_updates_headline():
+    out = merge(BASE, [step("resnet_bnsub", {
+        "backend": "tpu",
+        "configs": {"resnet50_s2d_bnsub": {
+            "images_per_sec_per_chip": 2600.0, "mfu_pct": 16.2}},
+    })])
+    assert out["config"] == "resnet50_s2d_bnsub"
+    assert out["value"] == 2600.0
+    assert out["mfu_pct"] == 16.2
+    assert out["vs_baseline"] == round(2600.0 / 2500.0, 3)
+    # untouched families survive
+    assert out["configs"]["resnet50"]["images_per_sec_per_chip"] == 2403.0
+    assert out["configs"]["resnet50_s2d_bnsub"]["at"].startswith("2026-07-31")
+    assert out["measured_at"] == "2026-07-31T02:00:00Z"
+
+
+def test_family_step_lands_under_mapped_key():
+    bert = {"metric": "bert_base_mlm_samples_per_sec_per_chip",
+            "value": 416.4, "backend": "tpu", "mfu_pct": 18.16,
+            "device_kind": "TPU v5 lite"}
+    out = merge(BASE, [step("bert", bert)])
+    assert out["configs"]["bert_base"]["value"] == 416.4
+    assert "device_kind" not in out["configs"]["bert_base"]
+    # resnet headline unchanged (no better resnet entry arrived)
+    assert out["config"] == "resnet50_s2d"
+    assert out["value"] == 2433.7
+
+
+def test_experiment_steps_keep_descriptive_keys():
+    out = merge(BASE, [
+        step("lm_noffn_b12", {"value": 31000.0, "backend": "tpu"}),
+        step("lm_pallas_off", {"value": 30000.0, "backend": "tpu"}),
+    ])
+    assert out["configs"]["llama_125m_noffn_b12"]["value"] == 31000.0
+    assert out["configs"]["llama_125m_nopallas"]["value"] == 30000.0
+
+
+def test_non_tpu_step_is_ignored():
+    out = merge(BASE, [step("bert", {"value": 1.0, "backend": "cpu"})])
+    assert "bert_base" not in out["configs"]
+    assert out["measured_at"] == BASE["measured_at"]
+
+
+def test_full_bench_headline_preferred():
+    out = merge(BASE, [step("full_bench", {
+        "backend": "tpu", "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 2450.0, "unit": "images/sec/chip", "vs_baseline": 0.98,
+        "config": "resnet50_s2d", "mfu_pct": 15.3,
+        "configs": {"resnet50_s2d": {"images_per_sec_per_chip": 2450.0,
+                                     "mfu_pct": 15.3}},
+    })])
+    assert out["value"] == 2450.0
+    assert out["configs"]["resnet50_s2d"]["images_per_sec_per_chip"] == 2450.0
+
+
+def test_implausible_resnet_entries_never_take_headline():
+    out = merge(BASE, [step("resnet_s2d", {
+        "backend": "tpu",
+        "configs": {"resnet50_s2d": {
+            "images_per_sec_per_chip": 73000.0, "implausible": True}},
+    })])
+    assert out["value"] == 2433.7  # flaky-tunnel artifact rejected
+
+
+def test_cli_round_trip(tmp_path):
+    rec = tmp_path / "last.json"
+    rec.write_text(json.dumps(BASE))
+    results = tmp_path / "results.jsonl"
+    results.write_text(json.dumps(step("bert", {
+        "value": 416.4, "backend": "tpu"})) + "\n")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "merge_tpu_results.py")
+    out = subprocess.run([sys.executable, tool, "--results", str(results),
+                          "--record", str(rec)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    persisted = json.loads(rec.read_text())
+    assert persisted["configs"]["bert_base"]["value"] == 416.4
+    assert persisted["merged_from"] == "chip_hunter"
+
+
+def test_empty_results_is_an_error(tmp_path):
+    rec = tmp_path / "last.json"
+    rec.write_text(json.dumps(BASE))
+    results = tmp_path / "results.jsonl"
+    results.write_text("")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "merge_tpu_results.py")
+    out = subprocess.run([sys.executable, tool, "--results", str(results),
+                          "--record", str(rec)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1
+    assert json.loads(rec.read_text()) == BASE  # record untouched

@@ -30,15 +30,29 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-PROBE = [sys.executable, "-c",
-         "import jax; ds=jax.devices(); "
-         "print('PROBE-OK', len(ds), ds[0].platform)"]
+# The probe honors the host-wide chip lock (chip_lock.py contract: every
+# TPU-backend init takes it) with a short budget: a held lock means some
+# framework process is mid-measurement — report that distinctly so the
+# hunter waits without calling the tunnel dead.
+PROBE = [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from tensorflow_train_distributed_tpu.runtime.chip_lock import chip_lock
+try:
+    with chip_lock(timeout=8.0, poll=2.0):
+        import jax
+        ds = jax.devices()
+        print('PROBE-OK', len(ds), ds[0].platform)
+except TimeoutError as e:
+    print('PROBE-HELD', e)
+"""]
 
 # (name, timeout_s, argv) — priority order.  Every command must print a
 # JSON line on success (the bench tools' contract); rc==0 AND a parseable
@@ -113,15 +127,20 @@ def log(state_dir: str, msg: str) -> None:
         f.write(line + "\n")
 
 
-def probe(timeout_s: float) -> bool:
+def probe(timeout_s: float) -> str:
+    """'alive' | 'held' (another framework process on the chip) | 'dead'."""
     try:
         out = subprocess.run(PROBE, capture_output=True, text=True,
                              timeout=timeout_s, cwd=REPO)
-        return "PROBE-OK" in out.stdout and "tpu" in out.stdout.lower()
+        if "PROBE-OK" in out.stdout and "tpu" in out.stdout.lower():
+            return "alive"
+        if "PROBE-HELD" in out.stdout:
+            return "held"
+        return "dead"
     except subprocess.TimeoutExpired:
-        return False
+        return "dead"
     except OSError:
-        return False
+        return "dead"
 
 
 def last_json_line(text: str):
@@ -141,16 +160,28 @@ def run_step(name, timeout_s, argv, extra_env, state_dir):
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "4")
     env.update(extra_env or {})
     t0 = time.time()
+    # New session + killpg on timeout: bench.py spawns per-family
+    # grandchildren that deliberately keep the chip flock alive past
+    # their parent's death (pass_fds) — killing only the direct child
+    # would leave an orphan holding the lock and poison every later step.
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=REPO,
+                            env=env, start_new_session=True)
     try:
-        out = subprocess.run(argv, capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO, env=env)
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout_s}s"
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None, f"timeout after {timeout_s}s (process group killed)"
     dt = time.time() - t0
-    rec = last_json_line(out.stdout)
-    if out.returncode != 0:
-        tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
-        return None, f"rc={out.returncode} after {dt:.0f}s: {' | '.join(tail)}"
+    rec = last_json_line(stdout)
+    if proc.returncode != 0:
+        tail = (stderr or stdout).strip().splitlines()[-3:]
+        return None, (f"rc={proc.returncode} after {dt:.0f}s: "
+                      f"{' | '.join(tail)}")
     if rec is None:
         return None, f"rc=0 but no JSON line after {dt:.0f}s"
     if rec.get("backend", "tpu") != "tpu":
@@ -184,20 +215,44 @@ def main(argv=None) -> int:
     queue = [s[0] for s in STEPS]
     if args.only:
         keep = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = [n for n in keep if n not in steps]
+        if unknown:
+            p.error(f"unknown step(s) in --only: {unknown}; "
+                    f"valid: {sorted(steps)}")
         queue = [n for n in queue if n in keep]
-    # Resume: drop steps already recorded in results.jsonl.
+    # Resume: drop steps already recorded in results.jsonl, steps already
+    # abandoned in a previous run, and reload persisted attempt counts so
+    # the abandon backstop survives restarts.
     res_path = os.path.join(args.state_dir, "results.jsonl")
     if os.path.exists(res_path):
         with open(res_path) as f:
             done = {json.loads(ln)["step"] for ln in f if ln.strip()}
         queue = [n for n in queue if n not in done]
+    aband_path = os.path.join(args.state_dir, "abandoned.jsonl")
+    if os.path.exists(aband_path):
+        with open(aband_path) as f:
+            gone = {json.loads(ln)["step"] for ln in f if ln.strip()}
+        if gone:
+            log(args.state_dir,
+                f"skipping previously abandoned steps: {sorted(gone)} "
+                f"(delete {aband_path} to retry them)")
+        queue = [n for n in queue if n not in gone]
+    att_path = os.path.join(args.state_dir, "attempts.json")
+    attempts: dict[str, int] = {}
+    if os.path.exists(att_path):
+        try:
+            with open(att_path) as f:
+                attempts = {k: int(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            attempts = {}
 
     deadline = time.time() + args.deadline_hours * 3600
-    attempts: dict[str, int] = {}
+    n_abandoned = 0
     log(args.state_dir, f"hunter start: queue={queue}")
     while queue and time.time() < deadline:
-        if not probe(args.probe_timeout):
-            log(args.state_dir, "probe: tunnel dead; sleeping "
+        state = probe(args.probe_timeout)
+        if state != "alive":
+            log(args.state_dir, f"probe: tunnel {state}; sleeping "
                 f"{args.sleep:.0f}s ({len(queue)} steps pending)")
             time.sleep(args.sleep)
             continue
@@ -208,12 +263,14 @@ def main(argv=None) -> int:
                             args.state_dir)
         if err:
             attempts[name] = attempts.get(name, 0) + 1
+            with open(att_path, "w") as f:
+                json.dump(attempts, f)
             if attempts[name] >= args.max_attempts:
+                n_abandoned += 1
                 log(args.state_dir, f"step {name} FAILED attempt "
                     f"{attempts[name]}/{args.max_attempts}: {err} — "
                     f"ABANDONED")
-                with open(os.path.join(args.state_dir,
-                                       "abandoned.jsonl"), "a") as f:
+                with open(aband_path, "a") as f:
                     f.write(json.dumps({"step": name, "err": err}) + "\n")
             else:
                 log(args.state_dir, f"step {name} FAILED attempt "
@@ -228,6 +285,10 @@ def main(argv=None) -> int:
     if queue:
         log(args.state_dir, f"deadline reached; pending={queue}")
         return 3
+    if n_abandoned:
+        log(args.state_dir, f"queue drained with {n_abandoned} step(s) "
+                            f"ABANDONED — see abandoned.jsonl")
+        return 4
     log(args.state_dir, "ALL STEPS DONE")
     return 0
 

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Fold chip_hunter results into the persisted TPU bench record.
+
+``bench.py`` persists ``profiles/bench/last_tpu_result.json`` only on a
+full TPU run, and a partial (``--configs X --no-persist``) run would
+otherwise clobber the richer record.  The hunter (tools/chip_hunter.py)
+therefore accumulates atomic step results in ``results.jsonl``; this
+tool merges them into the persisted record so the driver's end-of-round
+``bench.py`` — which embeds ``last_known_tpu`` whenever the tunnel is
+dead — carries every number actually measured this round.
+
+Merge semantics:
+- a step whose JSON has ``configs`` (a bench.py emit) contributes those
+  config entries verbatim;
+- a family-tool step (bench_lm / bench_bert / bench_generate emits)
+  contributes one entry under a descriptive config key (see STEP_KEYS);
+- the headline (metric/value/vs_baseline/mfu_pct/config) is recomputed
+  from the freshest resnet configs by the same best-of rule bench.py
+  uses;
+- ``measured_at`` becomes the newest timestamp among contributions and
+  each merged entry keeps its own ``at`` stamp for honesty.
+
+Usage: python tools/merge_tpu_results.py [--results /tmp/chip_hunter/results.jsonl]
+                                         [--record profiles/bench/last_tpu_result.json]
+                                         [--dry-run]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGET_IMG_PER_SEC_PER_CHIP = 2500.0  # bench.py's north-star target
+
+# hunter step name -> config key in the persisted record.  Steps not
+# listed here that carry a bench.py-style "configs" dict are merged by
+# their config names; anything else lands under the step name itself.
+STEP_KEYS = {
+    "lm_pallas_on": "llama_125m",          # matches bench.py FAMILY_CMDS
+    "bert": "bert_base",
+    "gen": "llama_125m_decode",
+    "lm_noffn_b8": "llama_125m_noffn_b8",
+    "lm_noffn_b12": "llama_125m_noffn_b12",
+    "lm_pallas_off": "llama_125m_nopallas",
+    "lm_window": "llama_125m_window512",
+    "gen_window": "llama_125m_decode_window256",
+}
+
+
+def merge(record: dict, step_lines: list[dict]) -> dict:
+    record = dict(record)
+    configs = dict(record.get("configs", {}))
+    newest = record.get("measured_at", "")
+    for entry in step_lines:
+        step, rec, at = entry["step"], entry["json"], entry.get("at", "")
+        if rec.get("backend", "tpu") != "tpu":
+            continue
+        newest = max(newest, at)
+        if step == "full_bench" or (
+                "configs" in rec and isinstance(rec["configs"], dict)
+                and step.startswith(("resnet", "full"))):
+            for name, cfg in rec.get("configs", {}).items():
+                if isinstance(cfg, dict) and cfg.get("implausible"):
+                    continue  # flaky-tunnel timing artifact: never merge
+                configs[name] = dict(cfg, at=at)
+            # A full bench emit also carries a fresh headline; prefer it.
+            if step == "full_bench":
+                for k in ("metric", "value", "unit", "vs_baseline",
+                          "config", "mfu_pct"):
+                    if k in rec:
+                        record[k] = rec[k]
+        else:
+            key = STEP_KEYS.get(step, step)
+            slim = {k: v for k, v in rec.items()
+                    if k not in ("backend", "device_kind")}
+            configs[key] = dict(slim, at=at)
+    record["configs"] = configs
+
+    # Recompute the resnet headline from the freshest entries (bench.py
+    # best-of rule), unless a full_bench emit already set it above.
+    resnets = {n: c for n, c in configs.items()
+               if "images_per_sec_per_chip" in c and not c.get("implausible")}
+    if resnets:
+        best_name = max(resnets, key=lambda n:
+                        resnets[n]["images_per_sec_per_chip"])
+        best = resnets[best_name]
+        record.update(
+            metric="resnet50_train_images_per_sec_per_chip",
+            value=best["images_per_sec_per_chip"],
+            unit="images/sec/chip",
+            vs_baseline=round(best["images_per_sec_per_chip"]
+                              / TARGET_IMG_PER_SEC_PER_CHIP, 3),
+            config=best_name,
+        )
+        if "mfu_pct" in best:
+            record["mfu_pct"] = best["mfu_pct"]
+    if newest:
+        record["measured_at"] = newest
+    record["backend"] = "tpu"
+    record["merged_from"] = "chip_hunter"
+    return record
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--results", default="/tmp/chip_hunter/results.jsonl")
+    p.add_argument("--record", default=os.path.join(
+        REPO, "profiles", "bench", "last_tpu_result.json"))
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        record = {}
+    try:
+        with open(args.results) as f:
+            steps = [json.loads(ln) for ln in f if ln.strip()]
+    except OSError as e:
+        print(json.dumps({"error": f"no hunter results: {e}"}))
+        return 1
+    if not steps:
+        print(json.dumps({"error": "no hunter results to merge"}))
+        return 1
+    merged = merge(record, steps)
+    if not args.dry_run:
+        os.makedirs(os.path.dirname(args.record), exist_ok=True)
+        with open(args.record, "w") as f:
+            json.dump(merged, f)
+    print(json.dumps(merged))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
