@@ -87,6 +87,22 @@ def test_full_bench_headline_preferred():
     assert out["configs"]["resnet50_s2d"]["images_per_sec_per_chip"] == 2450.0
 
 
+def test_stale_unstamped_entry_never_takes_headline():
+    # A pre-existing (unstamped) config faster than everything measured
+    # this round must not silently become the freshly-stamped headline.
+    base = dict(BASE, configs=dict(BASE["configs"],
+                retired_variant={"images_per_sec_per_chip": 9999.0}))
+    out = merge(base, [step("resnet_bnsub", {
+        "backend": "tpu",
+        "configs": {"resnet50_s2d_bnsub": {
+            "images_per_sec_per_chip": 2300.0, "mfu_pct": 14.4}},
+    })])
+    assert out["config"] == "resnet50_s2d_bnsub"  # freshest measurement
+    assert out["value"] == 2300.0
+    assert out["configs"]["retired_variant"]["images_per_sec_per_chip"] \
+        == 9999.0  # preserved, just not the headline
+
+
 def test_implausible_resnet_entries_never_take_headline():
     out = merge(BASE, [step("resnet_s2d", {
         "backend": "tpu",

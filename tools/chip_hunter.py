@@ -18,10 +18,15 @@ window on whichever step happens to be next.  This driver:
 - enables the persistent XLA compile cache for every step so a retry
   after a mid-compile death does not pay the compile twice.
 
-State lives under ``--state-dir`` (default /tmp/chip_hunter): the queue,
-per-step attempt counts, and ``results.jsonl`` (one line per successful
-step: {"step": name, "rc": 0, "json": <parsed emit>}).  Exits 0 when the
-queue is empty, 3 at the deadline with steps still pending.
+State lives under ``--state-dir`` (default /tmp/chip_hunter): per-step
+attempt counts (``attempts.json``), abandoned steps
+(``abandoned.jsonl``), and ``results.jsonl`` (one line per successful
+step: {"step": name, "secs": wall, "at": utc-iso, "json": <parsed
+emit>}).  Exit codes: 0 = every configured step has a result; 3 =
+deadline hit with steps still pending; 4 = queue drained but one or
+more steps were abandoned (this run or a previous one).  To retry
+abandoned steps delete ``abandoned.jsonl`` (their attempt counts reset
+on abandonment, so they get a full ``--max-attempts`` again).
 
 Usage:  python tools/chip_hunter.py [--deadline-hours 9] [--only s1,s2]
 """
@@ -40,16 +45,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The probe honors the host-wide chip lock (chip_lock.py contract: every
 # TPU-backend init takes it) with a short budget: a held lock means some
 # framework process is mid-measurement — report that distinctly so the
-# hunter waits without calling the tunnel dead.
+# hunter waits without calling the tunnel dead.  It must EXECUTE a real
+# (tiny) program, not just enumerate devices: at 01:05 on 07-31 the
+# tunnel answered jax.devices() instantly and then hung every compile —
+# an enumerate-only probe green-lights a window that cannot run work.
 PROBE = [sys.executable, "-c", f"""
 import sys
 sys.path.insert(0, {REPO!r})
 from tensorflow_train_distributed_tpu.runtime.chip_lock import chip_lock
 try:
     with chip_lock(timeout=8.0, poll=2.0):
-        import jax
+        import jax, jax.numpy as jnp
         ds = jax.devices()
-        print('PROBE-OK', len(ds), ds[0].platform)
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        y = (x @ x).block_until_ready()
+        print('PROBE-OK', len(ds), ds[0].platform, y.dtype)
 except TimeoutError as e:
     print('PROBE-HELD', e)
 """]
@@ -263,8 +273,6 @@ def main(argv=None) -> int:
                             args.state_dir)
         if err:
             attempts[name] = attempts.get(name, 0) + 1
-            with open(att_path, "w") as f:
-                json.dump(attempts, f)
             if attempts[name] >= args.max_attempts:
                 n_abandoned += 1
                 log(args.state_dir, f"step {name} FAILED attempt "
@@ -272,22 +280,39 @@ def main(argv=None) -> int:
                     f"ABANDONED")
                 with open(aband_path, "a") as f:
                     f.write(json.dumps({"step": name, "err": err}) + "\n")
+                # Reset the count so "delete abandoned.jsonl to retry"
+                # grants a full --max-attempts budget again.
+                attempts.pop(name, None)
             else:
                 log(args.state_dir, f"step {name} FAILED attempt "
                     f"{attempts[name]}/{args.max_attempts}: {err} — "
                     f"requeued at back")
                 queue.append(name)
+            with open(att_path, "w") as f:
+                json.dump(attempts, f)
             # The window probably closed; next loop iteration re-probes.
         else:
+            # A success wipes the step's failure history: a later
+            # re-measure round (results.jsonl cleared) starts fresh.
+            if attempts.pop(name, None) is not None:
+                with open(att_path, "w") as f:
+                    json.dump(attempts, f)
             val = rec.get("value", rec.get("metric", "?"))
             log(args.state_dir, f"step {name} OK: value={val} "
                                 f"(queue: {queue})")
     if queue:
         log(args.state_dir, f"deadline reached; pending={queue}")
         return 3
-    if n_abandoned:
-        log(args.state_dir, f"queue drained with {n_abandoned} step(s) "
-                            f"ABANDONED — see abandoned.jsonl")
+    # Count abandonments from the FILE, not this process's counter: a
+    # resumed run that silently skipped previously abandoned steps must
+    # not report full coverage.
+    total_abandoned = 0
+    if os.path.exists(aband_path):
+        with open(aband_path) as f:
+            total_abandoned = sum(1 for ln in f if ln.strip())
+    if total_abandoned:
+        log(args.state_dir, f"queue drained with {total_abandoned} "
+                            f"step(s) ABANDONED — see abandoned.jsonl")
         return 4
     log(args.state_dir, "ALL STEPS DONE")
     return 0

@@ -77,10 +77,15 @@ def merge(record: dict, step_lines: list[dict]) -> dict:
             configs[key] = dict(slim, at=at)
     record["configs"] = configs
 
-    # Recompute the resnet headline from the freshest entries (bench.py
-    # best-of rule), unless a full_bench emit already set it above.
+    # Recompute the resnet headline by bench.py's best-of rule, but only
+    # from entries carrying an ``at`` stamp (i.e. actually measured by a
+    # hunter step and merged here) — a stale unstamped entry from the
+    # base record must never silently take a freshly-stamped headline.
+    # Entries merged from a full_bench emit are stamped too, so a faster
+    # atomic result can still honestly beat the full capture's headline.
     resnets = {n: c for n, c in configs.items()
-               if "images_per_sec_per_chip" in c and not c.get("implausible")}
+               if "images_per_sec_per_chip" in c
+               and not c.get("implausible") and c.get("at")}
     if resnets:
         best_name = max(resnets, key=lambda n:
                         resnets[n]["images_per_sec_per_chip"])
