@@ -44,7 +44,7 @@ def available() -> list[str]:
 
 def _setup():
     from tensorflow_train_distributed_tpu.models import (
-        bert, lenet, llama, moe, resnet, transformer,
+        bert, lenet, llama, moe, resnet, transformer, vit,
     )
 
     # Reference config[0]: MNIST LeNet (MirroredStrategy smoke test).
@@ -80,6 +80,22 @@ def _setup():
              task_factory=lambda: resnet.make_task(
                  resnet.RESNET_PRESETS["resnet_tiny"],
                  label_smoothing=0.0, weight_decay=0.0),
+             dataset="imagenet",
+             dataset_kwargs=dict(num_classes=10, image_size=32),
+             strategy="dp", global_batch_size=64, learning_rate=1e-3)
+    # ViT (beyond the reference's vision list): same ImageNet pipeline
+    # as ResNet, transformer encoder stack; AdamW-style training
+    # (warmup+cosine, grad clip 1.0 — the AugReg recipe shape).
+    register("vit_b16_imagenet",
+             task_factory=lambda: vit.make_task(
+                 vit.VIT_PRESETS["vit_b16"]),
+             dataset="imagenet", strategy="dp", global_batch_size=1024,
+             learning_rate=3e-3, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03, grad_clip_norm=1.0)
+    register("vit_tiny",
+             task_factory=lambda: vit.make_task(
+                 vit.VIT_PRESETS["vit_tiny"],
+                 label_smoothing=0.0),
              dataset="imagenet",
              dataset_kwargs=dict(num_classes=10, image_size=32),
              strategy="dp", global_batch_size=64, learning_rate=1e-3)

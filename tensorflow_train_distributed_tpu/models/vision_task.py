@@ -66,14 +66,19 @@ class VisionTask:
     def loss_fn(self, params, model_state, batch, rng, train):
         variables = {"params": params, **model_state}
         image = self._prep_image(batch["image"], params)
+        # Dropout-bearing models (ViT) consume the step rng; BN models
+        # (ResNet/LeNet) have no 'dropout' rng collection and flax
+        # ignores the extra entry.
+        rngs = {"dropout": rng} if (train and rng is not None) else {}
         if train and model_state:
             logits, updates = self.model.apply(
                 variables, image, train=True,
-                mutable=list(model_state.keys()),
+                mutable=list(model_state.keys()), rngs=rngs,
             )
             new_model_state = updates
         else:
-            logits = self.model.apply(variables, image, train=train)
+            logits = self.model.apply(variables, image, train=train,
+                                      rngs=rngs)
             new_model_state = model_state
         # Per-example weights (the padded-final-batch eval contract,
         # data.pipeline drop_remainder=False): pad rows carry weight 0 so

@@ -1,0 +1,179 @@
+"""Vision Transformer (ViT) for image classification.
+
+Beyond the reference's model list (its vision configs are LeNet and
+ResNet-50, SURVEY.md §2.1) — added because the zoo's encoder stack
+(`layers.MultiHeadAttention` + `MlpBlock`, the same modules BERT and the
+WMT transformer run on) plus the ImageNet input pipeline make ViT nearly
+free, and it is the standard vision architecture a reference user would
+expect from a modern framework.  TPU-first choices:
+
+- Patch embedding as a stride-``patch`` conv: one big matmul per image
+  on the MXU (224/16 → 196 patches), no gather/reshape shuffle.
+- Pre-LN blocks (ViT convention) reusing the shared attention kernel —
+  so ViT inherits flash attention on TPU, Megatron-style TP via the
+  ("embed", "heads") kernel axes, and the mixed-precision policy.
+- Learned position embeddings sized to the config's grid; bilinear
+  resize at load time is a checkpoint-tool concern, not a model one.
+- Classification via mean-pool ("gap", default — one less special
+  token keeps the sequence length a clean 4·k for the MXU) or a CLS
+  token ("cls", the paper's variant) — both CLI-selectable.
+
+VisionTask provides the softmax-CE + label-smoothing + top-5 task
+wrapper (the reference harness's per-model ``train_step`` equivalent),
+so ViT composes with every data path (JPEG ingestion, ship-raw-uint8,
+packing-free image batches) and every mesh strategy the CLI offers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models import layers as L
+from tensorflow_train_distributed_tpu.models.vision_task import VisionTask
+
+
+@dataclasses.dataclass(frozen=True)
+class VitConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dropout_rate: float = 0.0
+    pooling: str = "gap"  # "gap" (mean-pool) | "cls" (class token)
+    dtype: object = jnp.float32
+    layer_norm_eps: float = 1e-6
+    # Activation checkpointing per encoder layer (nn.remat).
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        side, rem = divmod(self.image_size, self.patch_size)
+        if rem:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}")
+        return side * side
+
+
+VIT_PRESETS = {
+    # Standard sizes (ViT paper / AugReg naming).
+    "vit_b16": VitConfig(),
+    "vit_s16": VitConfig(hidden_size=384, num_layers=12, num_heads=6,
+                         mlp_dim=1536),
+    "vit_l16": VitConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                         mlp_dim=4096),
+    # CPU-mesh test config.
+    "vit_tiny": VitConfig(image_size=32, patch_size=8, hidden_size=32,
+                          num_layers=2, num_heads=2, mlp_dim=64,
+                          num_classes=10),
+}
+
+
+class VitEncoderLayer(nn.Module):
+    """Pre-LN transformer block (LN → attn → +x; LN → MLP → +x)."""
+
+    config: VitConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                         name="attn_ln")(x)
+        h = L.MultiHeadAttention(
+            num_heads=cfg.num_heads,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            dtype=cfg.dtype,
+            dropout_rate=cfg.dropout_rate,
+            use_bias=True,  # ViT convention: qkv/out projections biased
+            name="attention",
+        )(h, deterministic=deterministic)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                         name="mlp_ln")(x)
+        h = L.MlpBlock(
+            hidden=cfg.mlp_dim, dtype=cfg.dtype,
+            dropout_rate=cfg.dropout_rate, name="mlp",
+            activation=nn.gelu,
+        )(h, deterministic=deterministic)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    config: VitConfig = VitConfig()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        deterministic = not train
+        # Patch embedding: stride-P conv == per-patch linear projection,
+        # lowered by XLA to one [B·N, P²·C]×[P²·C, H] MXU matmul.
+        x = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID", dtype=cfg.dtype, name="patch_embed",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                (None, None, "conv_in", "embed")),
+        )(x)
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_size)  # [B, N_patches, H]
+        seq = cfg.num_patches
+        if x.shape[1] != seq:
+            raise ValueError(
+                f"got {x.shape[1]} patches for input {x.shape}, config "
+                f"expects {seq} ({cfg.image_size}px / {cfg.patch_size}px "
+                f"grid); check the dataset image_size")
+        if cfg.pooling == "cls":
+            cls = self.param(
+                "cls_token",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros, (None, None, "embed")),
+                (1, 1, cfg.hidden_size))
+            x = jnp.concatenate(
+                [jnp.tile(cls.astype(cfg.dtype), (b, 1, 1)), x], axis=1)
+            seq += 1
+        pos = self.param(
+            "pos_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+            (seq, cfg.hidden_size))
+        x = x + pos[None].astype(cfg.dtype)
+        if cfg.dropout_rate:
+            x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic,
+                           name="embed_dropout")(x)
+        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        layer_cls = (nn.remat(VitEncoderLayer, static_argnums=(2,))
+                     if cfg.remat else VitEncoderLayer)
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, epsilon=cfg.layer_norm_eps,
+                         name="final_ln")(x)
+        x = x[:, 0] if cfg.pooling == "cls" else x.mean(axis=1)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype, name="head",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")),
+        )(x)
+        return nn.with_logical_constraint(logits, ("batch", "vocab"))
+
+
+def make_task(config: VitConfig = VIT_PRESETS["vit_b16"], *,
+              label_smoothing: float = 0.1,
+              weight_decay: float = 0.0) -> VisionTask:
+    """ViT task (AdamW-style decoupled decay belongs in the optimizer,
+    so ``weight_decay`` defaults off here unlike ResNet's L2)."""
+    from tensorflow_train_distributed_tpu.data.image import (
+        MEAN_RGB, STDDEV_RGB,
+    )
+    return VisionTask(VisionTransformer(config),
+                      label_smoothing=label_smoothing,
+                      weight_decay=weight_decay,
+                      uint8_mean_std=(MEAN_RGB * 255.0,
+                                      STDDEV_RGB * 255.0))

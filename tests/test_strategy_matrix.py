@@ -34,6 +34,7 @@ from tensorflow_train_distributed_tpu.training import (
 MATRIX = [
     ("mnist", ["dp", "mirrored"]),
     ("resnet_tiny", ["dp", "dp_tp"]),
+    ("vit_tiny", ["dp", "dp_tp"]),
     ("bert_tiny_mlm", ["dp", "dp_tp", "fsdp"]),
     ("transformer_tiny_wmt", ["dp", "dp_tp"]),
     ("llama_tiny_sft", ["dp", "dp_tp", "fsdp", "dtensor"]),

@@ -1,0 +1,143 @@
+"""ViT family: architecture pins, pooling variants, dropout plumbing,
+and end-to-end training on the CPU mesh (zoo convention: every family's
+full path runs on the virtual mesh, tests/test_models.py docstring).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.models import registry
+from tensorflow_train_distributed_tpu.models.vit import (
+    VIT_PRESETS, VisionTransformer, VitConfig,
+)
+
+
+def _param_count(model, *args):
+    shapes = jax.eval_shape(lambda: model.init(jax.random.key(0), *args))
+    return sum(np.prod(x.shape) for x in jax.tree.leaves(shapes))
+
+
+TINY = VIT_PRESETS["vit_tiny"]
+
+
+class TestArchitecture:
+    def test_vit_b16_param_count(self):
+        # ViT-B/16 @224, 1000 classes: ~86M (paper Table 1; gap pooling
+        # drops only the 768-wide cls token vs the canonical 86.57M).
+        n = _param_count(VisionTransformer(VIT_PRESETS["vit_b16"]),
+                         jnp.zeros((1, 224, 224, 3)))
+        assert abs(n - 86.4e6) < 1.5e6, n
+
+    def test_forward_shapes_both_poolings(self):
+        for pooling in ("gap", "cls"):
+            cfg = dataclasses.replace(TINY, pooling=pooling)
+            model = VisionTransformer(cfg)
+            x = jnp.zeros((2, 32, 32, 3))
+            variables = model.init(jax.random.key(0), x)
+            out = model.apply(variables, x)
+            assert out.shape == (2, 10), (pooling, out.shape)
+
+    def test_cls_token_changes_param_set(self):
+        n_gap = _param_count(VisionTransformer(TINY),
+                             jnp.zeros((1, 32, 32, 3)))
+        cls_cfg = dataclasses.replace(TINY, pooling="cls")
+        n_cls = _param_count(VisionTransformer(cls_cfg),
+                             jnp.zeros((1, 32, 32, 3)))
+        # cls token (H) + one extra position row (H)
+        assert n_cls - n_gap == 2 * TINY.hidden_size
+
+    def test_wrong_image_size_raises(self):
+        model = VisionTransformer(TINY)  # expects 32px
+        with pytest.raises(ValueError, match="patches"):
+            model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+
+    def test_indivisible_patch_grid_raises(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            dataclasses.replace(TINY, image_size=30).num_patches
+
+    def test_dropout_needs_rng_only_in_train(self):
+        cfg = dataclasses.replace(TINY, dropout_rate=0.1)
+        model = VisionTransformer(cfg)
+        x = jnp.ones((2, 32, 32, 3))
+        import flax.linen as nn
+        variables = nn.unbox(model.init(jax.random.key(0), x))
+        # The head kernel is zeros-init (ViT convention) — logits would
+        # be identically 0 under any dropout mask; randomize it so the
+        # masks become observable.
+        variables["params"]["head"]["kernel"] = jax.random.normal(
+            jax.random.key(9),
+            variables["params"]["head"]["kernel"].shape)
+        # eval: deterministic, no rng needed
+        a = model.apply(variables, x, train=False)
+        b = model.apply(variables, x, train=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # train: dropout rng drives stochasticity
+        c = model.apply(variables, x, train=True,
+                        rngs={"dropout": jax.random.key(1)})
+        d = model.apply(variables, x, train=True,
+                        rngs={"dropout": jax.random.key(2)})
+        assert not np.allclose(np.asarray(c), np.asarray(d))
+
+    def test_remat_matches_exact(self):
+        x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+        base = VisionTransformer(TINY)
+        variables = base.init(jax.random.key(1), x)
+        ref = base.apply(variables, x)
+        rem = VisionTransformer(
+            dataclasses.replace(TINY, remat=True)).apply(variables, x)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(rem),
+                                   atol=1e-6)
+
+
+class TestTask:
+    def test_task_loss_and_dropout_rng_through_vision_task(self):
+        """VisionTask must thread the step rng into dropout-bearing
+        models (the vision_task rngs plumbing)."""
+        from tensorflow_train_distributed_tpu.models import vit
+
+        cfg = dataclasses.replace(TINY, dropout_rate=0.1)
+        task = vit.make_task(cfg, label_smoothing=0.0)
+        batch = {"image": jnp.ones((4, 32, 32, 3)),
+                 "label": jnp.zeros((4,), jnp.int32)}
+        import flax.linen as nn
+        variables = nn.unbox(task.init_variables(jax.random.key(0), batch))
+        params = variables["params"]
+        params["head"]["kernel"] = jax.random.normal(
+            jax.random.key(9), params["head"]["kernel"].shape)
+        loss1, (metrics, _) = task.loss_fn(
+            params, {}, batch, jax.random.key(1), True)
+        loss2, _ = task.loss_fn(params, {}, batch, jax.random.key(2), True)
+        assert np.isfinite(loss1) and np.isfinite(loss2)
+        assert loss1 != loss2  # different dropout masks
+        assert "accuracy" in metrics
+
+    def test_uint8_batch_path(self):
+        """ship-raw-uint8 contract: uint8 batches normalize on device."""
+        from tensorflow_train_distributed_tpu.models import vit
+
+        task = vit.make_task(TINY)
+        batch = {"image": jnp.full((2, 32, 32, 3), 128, jnp.uint8),
+                 "label": jnp.zeros((2,), jnp.int32)}
+        variables = task.init_variables(jax.random.key(0), batch)
+        loss, _ = task.loss_fn(variables["params"], {}, batch,
+                               None, False)
+        assert np.isfinite(loss)
+
+
+@pytest.mark.slow
+class TestTraining:
+    def test_vit_tiny_trains(self, mesh8):
+        from tests.test_models import _train_config
+
+        state, hist = _train_config("vit_tiny", steps=10, mesh=mesh8,
+                                    global_batch_size=32)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_registry_entries_present(self):
+        names = registry.available()
+        assert "vit_b16_imagenet" in names
+        assert "vit_tiny" in names

@@ -257,7 +257,6 @@ def main(argv=None) -> int:
             attempts = {}
 
     deadline = time.time() + args.deadline_hours * 3600
-    n_abandoned = 0
     log(args.state_dir, f"hunter start: queue={queue}")
     while queue and time.time() < deadline:
         state = probe(args.probe_timeout)
@@ -274,7 +273,6 @@ def main(argv=None) -> int:
         if err:
             attempts[name] = attempts.get(name, 0) + 1
             if attempts[name] >= args.max_attempts:
-                n_abandoned += 1
                 log(args.state_dir, f"step {name} FAILED attempt "
                     f"{attempts[name]}/{args.max_attempts}: {err} — "
                     f"ABANDONED")

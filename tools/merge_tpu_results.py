@@ -100,6 +100,10 @@ def merge(record: dict, step_lines: list[dict]) -> dict:
         )
         if "mfu_pct" in best:
             record["mfu_pct"] = best["mfu_pct"]
+        else:
+            # Never leave the previous headline config's MFU attached to
+            # a new headline entry that did not report one.
+            record.pop("mfu_pct", None)
     if newest:
         record["measured_at"] = newest
     record["backend"] = "tpu"
