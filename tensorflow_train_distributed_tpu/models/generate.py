@@ -27,13 +27,18 @@ from tensorflow_train_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaModel,
 )
+from tensorflow_train_distributed_tpu.models.quant import (
+    maybe_quant_variables,
+    quantized_inference,
+)
 
 
 def generate(config: LlamaConfig, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
-             cast_params: bool = True) -> jax.Array:
+             cast_params: bool = True,
+             quant_scales=None) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, S].
 
     ``temperature`` 0 → greedy argmax; > 0 → categorical sampling with
@@ -48,6 +53,12 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
     inference — a trained state carries f32 masters (26 GB at 7B), which
     inference neither needs nor fits on one chip; the compute path runs in
     ``config.dtype`` either way.  No-op for f32 configs.
+
+    ``quant_scales``: the scale tree from ``models.quant.quantize_params``
+    — pass it together with the int8 ``params`` that call returned and
+    every Dense runs the fused weight-only-int8 path (decode weight
+    traffic halves vs bf16).  int8 kernels are untouched by
+    ``cast_params``.
     """
     b, prompt_len = prompt.shape
     if max_new_tokens < 0:
@@ -91,13 +102,15 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
     return _generate(config, max_new_tokens, greedy, top_k,
                      top_p is not None, params, prompt,
                      jnp.float32(temperature),
-                     jnp.float32(1.0 if top_p is None else top_p), rng)
+                     jnp.float32(1.0 if top_p is None else top_p), rng,
+                     quant_scales)
 
 
 @partial(jax.jit, static_argnames=("config", "max_new_tokens", "greedy",
                                    "top_k", "use_top_p"))
 def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
-              top_k, use_top_p, params, prompt, temperature, top_p, rng):
+              top_k, use_top_p, params, prompt, temperature, top_p, rng,
+              quant_scales=None):
     # Cache sized to the request, not max_positions: a 30-token generation
     # from a 4k-context config must not allocate (or attend over) 4k
     # cache rows per layer.
@@ -125,17 +138,21 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
         return jax.random.categorical(
             step_rng, logits, axis=-1).astype(prompt.dtype)
 
+    base_vars = maybe_quant_variables(params, quant_scales)
+
     # Prefill: whole prompt at once; next token comes from the last logit.
-    logits, variables = model.apply(
-        {"params": params}, prompt, mutable=["cache"])
+    with quantized_inference():
+        logits, variables = model.apply(
+            base_vars, prompt, mutable=["cache"])
     rngs = jax.random.split(rng, max_new_tokens)
     first = pick(logits[:, -1], rngs[0])
 
     def step(carry, step_rng):
         cache, tok = carry
-        logits, updated = model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            mutable=["cache"])
+        with quantized_inference():
+            logits, updated = model.apply(
+                dict(base_vars, cache=cache), tok[:, None],
+                mutable=["cache"])
         nxt = pick(logits[:, -1], step_rng)
         return (updated["cache"], nxt), tok
 

@@ -223,7 +223,10 @@ class _ScannedBlock(nn.Module):
                             policy=_checkpoint_policy(self.config))
         scanned = nn.scan(
             step,
-            variable_axes={"params": 0, "cache": 0},
+            # "quant": stacked int8 serving scales (models.quant) slice
+            # per-layer exactly like the stacked params they mirror;
+            # absent collections are ignored by nn.scan.
+            variable_axes={"params": 0, "cache": 0, "quant": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,  # (segment_ids, positions): all layers
             length=self.config.num_layers,

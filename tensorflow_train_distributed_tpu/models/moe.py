@@ -193,7 +193,10 @@ class MoEMlpBlock(nn.Module):
         experts = nn.vmap(
             _ExpertFfn,
             in_axes=0, out_axes=0,
-            variable_axes={"params": 0},
+            # "quant": expert-stacked int8 serving scales (models.quant)
+            # slice per-expert like the stacked kernels they mirror, so
+            # the fused int8 Dense path is exact for MoE too.
+            variable_axes={"params": 0, "quant": 0},
             split_rngs={"params": True},
             metadata_params={nn.PARTITION_NAME: "expert"},
         )(hidden=cfg.ffn_size, dtype=cfg.dtype, name="experts")

@@ -124,6 +124,25 @@ def test_bench_generate_cpu_smoke():
     assert rec["max_new_tokens"] == 16
 
 
+def test_bench_generate_int8_cpu_smoke():
+    """--quant int8 runs the weight-only serving path end-to-end and
+    stamps the record."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "bench_generate.py"),
+         "--preset", "llama_tiny", "--batch", "2", "--prompt-len", "16",
+         "--max-new", "16", "--iters", "2", "--platform", "cpu",
+         "--quant", "int8"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert rec["quant"] == "int8"
+
+
 def test_bench_generate_rejects_max_new_one():
     """--max-new 1 cannot measure a decode rate (it IS the prefill call);
     argparse rejects it cleanly instead of a ZeroDivisionError."""

@@ -128,6 +128,12 @@ class TestTask:
         assert np.isfinite(loss)
 
 
+def test_registry_entries_present():
+    names = registry.available()
+    assert "vit_b16_imagenet" in names
+    assert "vit_tiny" in names
+
+
 @pytest.mark.slow
 class TestTraining:
     def test_vit_tiny_trains(self, mesh8):
@@ -136,8 +142,3 @@ class TestTraining:
         state, hist = _train_config("vit_tiny", steps=10, mesh=mesh8,
                                     global_batch_size=32)
         assert hist.history["loss"][-1] < hist.history["loss"][0]
-
-    def test_registry_entries_present(self):
-        names = registry.available()
-        assert "vit_b16_imagenet" in names
-        assert "vit_tiny" in names

@@ -29,7 +29,8 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
                    max_new: int, warmup: int, iters: int,
                    temperature: float = 0.0,
                    force_hbm: bool = False,
-                   sliding_window: int = 0):
+                   sliding_window: int = 0,
+                   quant: str = ""):
     import dataclasses
     import time
 
@@ -86,11 +87,26 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
             "budget_gib": round(budget / 2**30, 2)}), flush=True)
         raise SystemExit(2)
     params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+    quant_scales = None
+    weight_itemsize = itemsize
+    if quant:
+        if quant != "int8":
+            raise SystemExit(f"--quant supports 'int8', got {quant!r}")
+        from tensorflow_train_distributed_tpu.models.quant import (
+            quantize_params,
+        )
+
+        params, quant_scales = quantize_params(params)
+        # Matmul kernels now stream at 1 byte/param; for the MBU model
+        # approximate ALL param traffic at 1B (embeds/norms are a small
+        # share in decoder presets).
+        weight_itemsize = 1
 
     def run(n):
         return generate.generate(cfg, params, prompt, n,
                                  temperature=temperature,
-                                 rng=jax.random.key(1))
+                                 rng=jax.random.key(1),
+                                 quant_scales=quant_scales)
 
     def timed(n):
         jax.block_until_ready(run(n))  # compile
@@ -128,13 +144,15 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     if cfg.sliding_window:
         rec["sliding_window"] = cfg.sliding_window
         rec["kv_cache_rows"] = cache_rows
+    if quant:
+        rec["quant"] = quant
     bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
           if dev.platform == "tpu" else None)
     if bw is not None:
         # Each decode step streams the cast params + the filled cache
         # once, whatever the batch (that's why batching decode is nearly
         # free until compute-bound).
-        bytes_per_step = n_params * itemsize + cache_bytes
+        bytes_per_step = n_params * weight_itemsize + cache_bytes
         rec["mbu_pct"] = round(100 * bytes_per_step / step_s / bw, 2)
         rec["device_kind"] = dev.device_kind
     return rec
@@ -167,6 +185,10 @@ def main(argv=None) -> int:
                         "attention: decode keeps a rolling WINDOW-row "
                         "KV cache (A/B vs full attention; 0 = preset "
                         "default)")
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="'int8': weight-only int8 serving "
+                        "(models.quant) — kernels stream from HBM at "
+                        "1 byte/param in the decode loop")
     args = p.parse_args(argv)
     if args.platform:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -188,7 +210,8 @@ def main(argv=None) -> int:
                                  args.max_new, args.warmup, args.iters,
                                  temperature=args.temperature,
                                  force_hbm=args.force_hbm,
-                                 sliding_window=args.sliding_window)
+                                 sliding_window=args.sliding_window,
+                                 quant=args.quant)
     except Exception as e:
         print(json.dumps({
             "metric": f"{args.preset}_decode_tokens_per_sec_per_chip",

@@ -97,10 +97,15 @@ STEPS = [
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "12", "--seq", "2048",
       "--remat", "--remat-policy", "no_ffn"]),
-    # Serving path.
+    # Serving path (+ int8 weight-only A/B: decode is weight-HBM-bound,
+    # so int8 kernels should approach 2x the bf16 step rate).
     ("gen", 600,
      [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
       "--batch", "8", "--prompt-len", "128", "--max-new", "256"]),
+    ("gen_int8", 600,
+     [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
+      "--batch", "8", "--prompt-len", "128", "--max-new", "256",
+      "--quant", "int8"]),
     # Long-context levers (round-4 additions).
     ("lm_window", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
