@@ -87,6 +87,18 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
+    has_int8 = any(
+        getattr(x, "dtype", None) == jnp.int8
+        for x in jax.tree.leaves(params))
+    if has_int8 != (quant_scales is not None):
+        # Either pairing mistake yields plausibly-shaped garbage tokens
+        # (unscaled int8 matmuls, or scales applied to full-precision
+        # kernels) — fail loudly instead.
+        raise ValueError(
+            "int8 params and quant_scales must be passed together: got "
+            f"int8 kernels={has_int8}, quant_scales="
+            f"{'set' if quant_scales is not None else 'None'} "
+            "(both come from models.quant.quantize_params)")
     if cast_params:
         # Read .dtype directly — jnp.asarray would round-trip every leaf
         # through the device just to inspect it (26 GB of H2D at 7B).

@@ -519,17 +519,17 @@ class MlpBlock(nn.Module):
     activation: Callable = nn.gelu
     gated: bool = False
     dropout_rate: float = 0.0
-    # Mark every [B,S,ffn] intermediate non-saveable for the "no_ffn"
-    # remat policy: "mlp_hidden" checkpoint_name tags on the dense
-    # outputs/products (identity unless a policy names them), plus an
-    # inner nothing-saveable checkpoint around the activation so its
-    # elementwise internals (e.g. silu's sigmoid) can't be saved either.
-    # The inner checkpoint only wraps when this flag is on — a plain
-    # no-remat model must not pay activation recompute.
-    remat_hiddens: bool = False
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
+        # "mlp_hidden" checkpoint_name tags document the [B,S,ffn]
+        # intermediates (identity unless a policy names them).  NOTE:
+        # name-based EXCLUSION policies (save_anything_except_these_names)
+        # do not work here — the pre-tag producer value stays saveable, so
+        # the hiddens get saved anyway (measured: 6 stacked [L,B,S,ffn]
+        # buffers in the v5e OOM dump).  The "no_ffn" remat policy
+        # therefore wraps this whole module in an inner nothing-saveable
+        # nn.remat at the call site (llama.DecoderBlock) instead.
         from jax.ad_checkpoint import checkpoint_name
 
         d = x.shape[-1]
@@ -540,20 +540,12 @@ class MlpBlock(nn.Module):
             up = checkpoint_name(
                 dense(self.hidden, ("embed", "mlp"), use_bias=False,
                       dtype=self.dtype, name="wi_up")(x), "mlp_hidden")
-            act = (lambda g, u: self.activation(g) * u)
-            if self.remat_hiddens:
-                act = jax.checkpoint(
-                    act, policy=jax.checkpoint_policies.nothing_saveable)
-            h = checkpoint_name(act(gate, up), "mlp_hidden")
+            h = checkpoint_name(self.activation(gate) * up, "mlp_hidden")
         else:
             h = checkpoint_name(
                 dense(self.hidden, ("embed", "mlp"), dtype=self.dtype,
                       name="wi")(x), "mlp_hidden")
-            act = self.activation
-            if self.remat_hiddens:
-                act = jax.checkpoint(
-                    act, policy=jax.checkpoint_policies.nothing_saveable)
-            h = checkpoint_name(act(h), "mlp_hidden")
+            h = checkpoint_name(self.activation(h), "mlp_hidden")
         h = checkpoint_name(
             nn.with_logical_constraint(h, ("batch", "length", "mlp")),
             "mlp_hidden")

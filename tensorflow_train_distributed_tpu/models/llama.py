@@ -124,16 +124,30 @@ def _checkpoint_policy(cfg: LlamaConfig):
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
     if cfg.remat_policy == "no_ffn":
-        # Save every intermediate EXCEPT the [B,S,ffn] SwiGLU hiddens —
-        # the buffers that dominate the no-remat footprint (PROFILE.md).
-        # Backward re-runs only the two FFN input matmuls + activation
-        # (~no-remat speed, a fraction of its memory; the flash kernel's
-        # saved residuals stay saved, unlike "full"/"dots" re-runs).
-        return jax.checkpoint_policies.save_anything_except_these_names(
-            "mlp_hidden")
+        # "no_ffn" has NO outer block checkpoint (callers must not wrap;
+        # see _wrap_outer_remat).  The exclusion of the [B,S,ffn] SwiGLU
+        # hiddens — the buffers that dominate the no-remat footprint
+        # (PROFILE.md) — is STRUCTURAL: DecoderBlock wraps the MlpBlock
+        # in an inner nothing-saveable nn.remat, and everything outside
+        # it is saved scan-normally.  Two approaches that do NOT work,
+        # both verified empirically: (a) save_anything_except_these_names
+        # leaves the pre-tag producer values saveable (6 stacked
+        # [L,B,S,ffn] buffers in the v5e OOM dump); (b) an outer
+        # everything_saveable checkpoint DISSOLVES inner nothing-saveable
+        # regions (their internals become the outer's residuals).
+        raise AssertionError(
+            "no_ffn takes no outer checkpoint; gate on wants_outer_remat")
     raise ValueError(
         f"Unknown remat_policy {cfg.remat_policy!r}; expected 'full', "
         "'dots' or 'no_ffn'")
+
+
+def wants_outer_remat(cfg: LlamaConfig) -> bool:
+    """Whether the per-block (outer) nn.remat wrap applies.  False for
+    remat=False and for the "no_ffn" policy, whose only checkpoint is the
+    inner FFN region (an outer wrap would either re-introduce full
+    recompute or dissolve the inner region — see _checkpoint_policy)."""
+    return cfg.remat and cfg.remat_policy != "no_ffn"
 
 
 class DecoderBlock(nn.Module):
@@ -159,11 +173,22 @@ class DecoderBlock(nn.Module):
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
-        x = x + L.MlpBlock(
+        mlp_cls = L.MlpBlock
+        if cfg.remat and cfg.remat_policy == "no_ffn" and not self.decode:
+            # "no_ffn": the FFN runs inside an inner nothing-saveable
+            # remat region, so no [B,S,ffn] intermediate can be saved —
+            # backward re-runs the FFN from its (saved) input.  nn.remat
+            # on the module class is param-path-transparent, so
+            # checkpoints load unchanged.  The outer block policy is
+            # everything_saveable (see _checkpoint_policy): name-based
+            # exclusion does NOT drop the hiddens (the pre-tag producer
+            # value stays saveable — verified in a v5e OOM dump).
+            mlp_cls = nn.remat(
+                L.MlpBlock, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        x = x + mlp_cls(
             hidden=cfg.ffn_size, dtype=cfg.dtype, activation=nn.silu,
-            gated=True,
-            remat_hiddens=(cfg.remat and cfg.remat_policy == "no_ffn"),
-            name="mlp")(h)
+            gated=True, name="mlp")(h)
         return x
 
 
@@ -218,7 +243,7 @@ class _ScannedBlock(nn.Module):
                 else _BlockStep)
         # No remat in decode mode: there is no backward pass to save memory
         # for, and the KV-cache writes must not replay under a checkpoint.
-        if self.config.remat and not self.decode:
+        if wants_outer_remat(self.config) and not self.decode:
             step = nn.remat(step, prevent_cse=False,
                             policy=_checkpoint_policy(self.config))
         scanned = nn.scan(
@@ -276,7 +301,7 @@ def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh,
             h = DecoderBlock(cfg).apply({"params": p}, h, seg, pos)
         return (h, seg, pos)
 
-    if cfg.remat:
+    if wants_outer_remat(cfg):
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False,
                                   policy=_checkpoint_policy(cfg))
     data_axes = tuple(a for a in ("data", "fsdp")
@@ -332,7 +357,7 @@ class LlamaModel(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 blk = DecoderBlock
-                if cfg.remat and not self.decode:
+                if wants_outer_remat(cfg) and not self.decode:
                     blk = nn.remat(blk, prevent_cse=False,
                                    policy=_checkpoint_policy(cfg))
                 x = blk(cfg, decode=self.decode,

@@ -88,19 +88,24 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         raise SystemExit(2)
     params = model.init(jax.random.key(0), prompt[:, :8])["params"]
     quant_scales = None
-    weight_itemsize = itemsize
+    weight_bytes = n_params * itemsize
     if quant:
         if quant != "int8":
             raise SystemExit(f"--quant supports 'int8', got {quant!r}")
         from tensorflow_train_distributed_tpu.models.quant import (
             quantize_params,
+            quantized_bytes,
         )
 
         params, quant_scales = quantize_params(params)
-        # Matmul kernels now stream at 1 byte/param; for the MBU model
-        # approximate ALL param traffic at 1B (embeds/norms are a small
-        # share in decoder presets).
-        weight_itemsize = 1
+        # Exact per-step weight traffic: int8 kernels at 1 B, their f32
+        # scales, and everything unquantized (embeds/norms — ~20% of a
+        # 125M-class decoder, NOT negligible) at the compute dtype the
+        # decode loop streams them in.
+        weight_bytes = quantized_bytes(quant_scales) + sum(
+            x.size * (1 if x.dtype == jnp.int8 else itemsize)
+            for x in jax.tree_util.tree_leaves(params)
+            if hasattr(x, "dtype"))
 
     def run(n):
         return generate.generate(cfg, params, prompt, n,
@@ -109,9 +114,17 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
                                  quant_scales=quant_scales)
 
     def timed(n):
-        jax.block_until_ready(run(n))  # compile
+        # Warmup MUST fetch (np.asarray), not just block: on the axon
+        # tunnel, block_until_ready on a never-fetched computation can
+        # return at RPC-ack time (measured: a 256-token generate
+        # "completing" in 0.92 ms — 100x the HBM roofline).  After one
+        # real fetch the block path reflects device time (597 ms for the
+        # same call), so the timed loop can keep the cheap block (a
+        # per-iteration fetch would add ~85 ms of tunnel D2H latency to
+        # every sample).
+        np.asarray(run(n))  # compile + materialize
         for _ in range(warmup):
-            jax.block_until_ready(run(n))
+            np.asarray(run(n))
         t0 = time.perf_counter()
         out = None
         for _ in range(iters):
@@ -152,9 +165,13 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         # Each decode step streams the cast params + the filled cache
         # once, whatever the batch (that's why batching decode is nearly
         # free until compute-bound).
-        bytes_per_step = n_params * weight_itemsize + cache_bytes
+        bytes_per_step = weight_bytes + cache_bytes
         rec["mbu_pct"] = round(100 * bytes_per_step / step_s / bw, 2)
         rec["device_kind"] = dev.device_kind
+        if step_s < 0.5 * bytes_per_step / bw:
+            # Faster than 2x the weight-streaming roofline: a timing
+            # artifact (tunnel ack instead of device time), not physics.
+            rec["implausible"] = True
     return rec
 
 

@@ -106,10 +106,14 @@ STEPS = [
      [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
       "--batch", "8", "--prompt-len", "128", "--max-new", "256",
       "--quant", "int8"]),
-    # Long-context levers (round-4 additions).
+    # Long-context levers (round-4 additions).  Window training pairs
+    # with FULL remat: the chunked path's per-layer f32 score stacks
+    # ([L,B,H,chunks,c,c+w]) OOM the chip if saved (measured 25 GB under
+    # no-remat AND under no_ffn, whose outer scan saves attention
+    # internals) — full remat keeps them per-layer transients.
     ("lm_window", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
-      "--batch-per-chip", "8", "--seq", "2048", "--no-remat",
+      "--batch-per-chip", "8", "--seq", "2048", "--remat",
       "--sliding-window", "512"]),
     # Serve leg: window MUST be < prompt+max_new (384) or the rolling
     # cache never engages and the A/B measures full attention twice.
@@ -203,6 +207,9 @@ def run_step(name, timeout_s, argv, extra_env, state_dir):
         return None, f"emitted backend={rec.get('backend')!r} (not tpu)"
     if "error" in rec:
         return None, f"emitted error: {rec['error']!r}"
+    if rec.get("implausible"):
+        return None, ("emitted implausible=true (timing artifact faster "
+                      "than the hardware roofline)")
     with open(os.path.join(state_dir, "results.jsonl"), "a") as f:
         f.write(json.dumps({"step": name, "secs": round(dt, 1),
                             "at": time.strftime("%Y-%m-%dT%H:%M:%SZ",

@@ -45,6 +45,10 @@ STEP_KEYS = {
     "lm_pallas_off": "llama_125m_nopallas",
     "lm_window": "llama_125m_window512",
     "gen_window": "llama_125m_decode_window256",
+    "gen_int8": "llama_125m_decode_int8",
+    # One-off manual capture in this round's results.jsonl (decode batch
+    # sweep) — kept so re-merges keep resolving it.
+    "gen_b32": "llama_125m_decode_b32",
 }
 
 
@@ -71,6 +75,8 @@ def merge(record: dict, step_lines: list[dict]) -> dict:
                     if k in rec:
                         record[k] = rec[k]
         else:
+            if rec.get("implausible"):
+                continue  # roofline-violating timing artifact
             key = STEP_KEYS.get(step, step)
             slim = {k: v for k, v in rec.items()
                     if k not in ("backend", "device_kind")}
