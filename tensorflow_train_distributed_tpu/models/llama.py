@@ -125,7 +125,7 @@ def _checkpoint_policy(cfg: LlamaConfig):
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
     if cfg.remat_policy == "no_ffn":
         # "no_ffn" has NO outer block checkpoint (callers must not wrap;
-        # see _wrap_outer_remat).  The exclusion of the [B,S,ffn] SwiGLU
+        # gate on wants_outer_remat below).  The exclusion of the [B,S,ffn] SwiGLU
         # hiddens — the buffers that dominate the no-remat footprint
         # (PROFILE.md) — is STRUCTURAL: DecoderBlock wraps the MlpBlock
         # in an inner nothing-saveable nn.remat, and everything outside

@@ -1,14 +1,14 @@
-"""BERT-base MLM pretrain throughput: samples/sec/chip + MFU.
+"""ViT image-classification train throughput: images/sec/chip + MFU.
 
-One of the driver-designated metrics (BASELINE.md: "BERT-base MLM
-samples/sec") with no published reference number — this tool establishes
-the rebuild's own baseline on the live backend, end-to-end through the
-jitted Trainer step (mixed bf16, adamw, masked-token-weighted loss).
+Beyond the reference's model list (SURVEY.md §2.1 has LeNet/ResNet-50 for
+vision) — the ViT family rides the shared encoder stack, so this bench
+gives the transformer-vision silicon number next to ResNet's.  Runs the
+jitted Trainer step end-to-end (mixed bf16, adamw, label smoothing).
 
-MFU uses the standard encoder FLOP estimate:
-  flops/token ≈ 6·N_params + 12·L·d_model·seq
-(6·N covers fwd+bwd matmuls; the attention term is un-halved — BERT
-attention is bidirectional, not causal).
+MFU uses the encoder FLOP estimate over the patch sequence:
+  flops/image ≈ S·(6·N_params + 12·L·hidden·S)
+(S = patches (+1 for cls pooling); bidirectional attention, un-halved —
+the BERT convention in tools/bench_bert.py).
 
 Prints one JSON line per run (bench_lm.py conventions).
 """
@@ -30,13 +30,16 @@ from bench_lm import (  # noqa: E402
 )
 
 
-def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
-               force_hbm: bool = False, remat: bool = False):
+def bench_vit(preset: str, batch: int, warmup: int, iters: int,
+              force_hbm: bool = False, remat: bool = False):
+    import dataclasses
+
     import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
 
-    from tensorflow_train_distributed_tpu.models import bert
+    from tensorflow_train_distributed_tpu.models import vit
     from tensorflow_train_distributed_tpu.parallel.sharding import shard_batch
     from tensorflow_train_distributed_tpu.runtime.mesh import (
         MeshConfig, build_mesh,
@@ -45,69 +48,59 @@ def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
         Policy, Trainer, TrainerConfig,
     )
 
-    import dataclasses
-
-    cfg = bert.BERT_PRESETS[preset]
+    cfg = vit.VIT_PRESETS[preset]
     if remat:
         cfg = dataclasses.replace(cfg, remat=True)
-    if seq > cfg.max_positions:
-        raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
-    task = bert.make_task(cfg)
-    import jax.numpy as jnp
-
+    task = vit.make_task(cfg)
     mesh = build_mesh(MeshConfig(data=-1))
     n_chips = mesh.devices.size
+    seq = cfg.num_patches + (1 if cfg.pooling == "cls" else 0)
     abstract = jax.eval_shape(lambda: task.init_variables(
         jax.random.key(0),
-        {"input_ids": jnp.zeros((1, seq), jnp.int32)}))
-    # Bidirectional attention; BERT runs the reference einsum attention,
-    # which saves per-head [B,H,S,S] for backward when remat is off —
-    # score_heads makes the estimate account for that.
+        {"image": jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+         "label": jnp.zeros((1,), jnp.int32)}))
+    # Encoder shapes: bidirectional einsum attention saves per-head
+    # [B,H,S,S] for backward when remat is off (the BERT guard setup).
     check_hbm_budget(
         param_count(abstract["params"]), cfg.num_layers, cfg.hidden_size,
         batch, seq, remat=cfg.remat, causal=False, force=force_hbm,
         device=mesh.devices.flat[0], score_heads=cfg.num_heads)
     trainer = Trainer(
-        task, optax.adamw(1e-4, weight_decay=0.01), mesh,
+        task, optax.adamw(1e-3, weight_decay=0.05), mesh,
         policy=Policy.from_name("mixed_bfloat16"),
         config=TrainerConfig(log_every=1_000_000),
     )
     rng = np.random.default_rng(0)
     global_batch = batch * n_chips
-    # 15% masked positions, the BERT pretrain convention.
-    weights = np.zeros((global_batch, seq), np.float32)
-    for row in weights:
-        row[rng.choice(seq, max(1, int(0.15 * seq)), replace=False)] = 1.0
     data = {
-        "input_ids": rng.integers(0, cfg.vocab_size,
-                                  (global_batch, seq)).astype(np.int32),
-        "labels": rng.integers(0, cfg.vocab_size,
-                               (global_batch, seq)).astype(np.int32),
-        "mask_weights": weights,
+        "image": rng.normal(0, 1, (global_batch, cfg.image_size,
+                                   cfg.image_size, 3)).astype(np.float32),
+        "label": rng.integers(0, cfg.num_classes,
+                              (global_batch,)).astype(np.int32),
     }
     state = trainer.create_state(data)
     n_params = param_count(state.params)
     step = trainer._compiled_train_step()
     dev_batch = shard_batch(mesh, data)
     dt = timed_step_seconds(step, state, dev_batch, warmup, iters)
-    samples_per_sec_chip = global_batch / dt / n_chips
+    images_per_sec_chip = global_batch / dt / n_chips
     dev0 = mesh.devices.flat[0]
-    flops_per_token = (6 * n_params
-                       + 12 * cfg.num_layers * cfg.hidden_size * seq)
+    flops_per_image = seq * (
+        6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq)
     rec = {
-        "metric": f"{preset}_mlm_samples_per_sec_per_chip",
-        "value": round(samples_per_sec_chip, 1),
-        "unit": "samples/sec/chip",
+        "metric": f"{preset}_train_images_per_sec_per_chip",
+        "value": round(images_per_sec_chip, 1),
+        "unit": "images/sec/chip",
         "step_time_ms": round(dt * 1e3, 2),
         "batch_per_chip": batch,
-        "seq_len": seq,
+        "patch_seq": seq,
         "n_chips": n_chips,
         "n_params": n_params,
         "backend": dev0.platform,
     }
     peak = peak_tflops(dev0)
     if peak is not None:
-        mfu = samples_per_sec_chip * seq * flops_per_token / (peak * 1e12)
+        mfu = images_per_sec_chip * flops_per_image / (peak * 1e12)
         rec["mfu_pct"] = round(100 * mfu, 2)
         rec["device_kind"] = dev0.device_kind
         if mfu > 0.75:
@@ -119,10 +112,8 @@ def bench_bert(preset: str, batch: int, seq: int, warmup: int, iters: int,
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--preset", default="bert_base")
-    p.add_argument("--batch-per-chip", type=int, default=32)
-    p.add_argument("--seq", type=int, default=128,
-                   help="pretrain phase-1 convention: seq 128")
+    p.add_argument("--preset", default="vit_b16")
+    p.add_argument("--batch-per-chip", type=int, default=64)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--platform", default="",
@@ -132,8 +123,8 @@ def main(argv=None) -> int:
                    help="skip the pre-flight HBM estimate (an OOM compile "
                         "can kill the chip tunnel)")
     p.add_argument("--remat", action="store_true",
-                   help="per-layer activation checkpointing (bigger "
-                        "batch/seq at recompute cost)")
+                   help="per-layer activation checkpointing (bigger batch "
+                        "at recompute cost)")
     args = p.parse_args(argv)
     if args.platform:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -146,8 +137,6 @@ def main(argv=None) -> int:
     if args.platform and args.platform != "tpu":
         cm = contextlib.nullcontext()
     else:
-        # May touch the single-chip tunnel: serialize with every other
-        # framework TPU process (concurrent use corrupts timings).
         from tensorflow_train_distributed_tpu.runtime.chip_lock import (
             chip_lock,
         )
@@ -155,13 +144,13 @@ def main(argv=None) -> int:
         cm = chip_lock()
     try:
         with cm:
-            rec = bench_bert(args.preset, args.batch_per_chip, args.seq,
-                             args.warmup, args.iters,
-                             force_hbm=args.force_hbm, remat=args.remat)
+            rec = bench_vit(args.preset, args.batch_per_chip,
+                            args.warmup, args.iters,
+                            force_hbm=args.force_hbm, remat=args.remat)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({
-            "metric": f"{args.preset}_mlm_samples_per_sec_per_chip",
-            "value": 0.0, "unit": "samples/sec/chip",
+            "metric": f"{args.preset}_train_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip",
             "error": f"{type(e).__name__}: {e}"}), flush=True)
         return 1
     print(json.dumps(rec), flush=True)

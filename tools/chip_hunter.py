@@ -121,6 +121,10 @@ STEPS = [
      [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
       "--batch", "8", "--prompt-len", "128", "--max-new", "256",
       "--sliding-window", "256"]),
+    # ViT family: the transformer-vision number beside ResNet's.
+    ("vit", 700,
+     [sys.executable, "tools/bench_vit.py", "--preset", "vit_b16",
+      "--batch-per-chip", "64", "--warmup", "3", "--iters", "10"]),
     # BERT re-capture only if the early-session number needs refreshing;
     # cheap with a warm compile cache, lowest priority.
     ("bert", 480,

@@ -49,6 +49,7 @@ STEP_KEYS = {
     # One-off manual capture in this round's results.jsonl (decode batch
     # sweep) — kept so re-merges keep resolving it.
     "gen_b32": "llama_125m_decode_b32",
+    "vit": "vit_b16",
 }
 
 
@@ -60,6 +61,9 @@ def merge(record: dict, step_lines: list[dict]) -> dict:
         step, rec, at = entry["step"], entry["json"], entry.get("at", "")
         if rec.get("backend", "tpu") != "tpu":
             continue
+        if rec.get("implausible"):
+            continue  # skip BEFORE advancing measured_at: a skipped
+            # record must not claim its timestamp for the merge
         newest = max(newest, at)
         if step == "full_bench" or (
                 "configs" in rec and isinstance(rec["configs"], dict)
