@@ -31,7 +31,8 @@ carries every driver-designated metric, not just ResNet; ``input``
 (tools/bench_input, pure host — runs even on a CPU fallback) records the
 JPEG-ingest pipeline incl. the ship-raw-uint8 and native-libjpeg modes;
 ``gen`` (opt-in, tools/bench_generate) adds KV-cache decode throughput
-+ MBU.  The lm/bert
++ MBU; ``vit`` (opt-in, tools/bench_vit) the transformer-vision
+throughput.  The lm/bert
 families run as subprocesses: allocator isolation (a fresh HBM heap per
 family — in-process leftovers could push a fitting config over the
 budget) while inheriting the chip lock.  A jax.profiler trace is captured
@@ -260,6 +261,11 @@ FAMILY_CMDS = {
              "--preset", "llama_125m", "--batch", "8",
              "--prompt-len", "128", "--max-new", "256"],
             "llama_125m_decode"),
+    # Opt-in: transformer-vision throughput beside ResNet's.
+    "vit": ([sys.executable, os.path.join(_HERE, "tools", "bench_vit.py"),
+             "--preset", "vit_b16", "--batch-per-chip", "64",
+             "--warmup", "3", "--iters", "10"],
+            "vit_b16"),
     # Pure host (never touches the tunnel): JPEG decode+augment pipeline
     # throughput incl. the ship-raw-uint8 and native-libjpeg modes.  Runs
     # even on a CPU fallback, so a dead-tunnel record still carries real
@@ -317,7 +323,8 @@ def main(argv=None) -> int:
     p.add_argument("--families", default="resnet,lm,bert,input",
                    help="model families in the emit: resnet (in-process "
                         "headline) plus lm/bert subprocess benches (TPU "
-                        "only); 'input' = host JPEG-pipeline throughput "
+                        "only); opt-in: gen (decode), vit; "
+                        "'input' = host JPEG-pipeline throughput "
                         "(pure CPU, runs even on fallback); 'gen' "
                         "(opt-in) adds KV-cache decode throughput + MBU")
     p.add_argument("--batch-per-chip", type=int, default=256)

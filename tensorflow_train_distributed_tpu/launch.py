@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip gradients to this global norm before the "
                         "optimizer update (default: the config's "
                         "convention, e.g. 1.0 for BERT/Llama; 0 disables)")
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="track an exponential moving average of the "
+                        "params in optimizer state (Polyak averaging — "
+                        "the Keras ExponentialMovingAverage equivalent); "
+                        "eval/--eval-only then score the EMA weights. "
+                        "Typical: 0.999")
     p.add_argument("--warmup-steps", type=int, default=None,
                    help="linear LR warmup steps (default: the config's "
                         "warmup_ratio × --steps)")
@@ -246,6 +252,18 @@ def _validate_constant_lr(args, entry):
             "the same knob")
 
 
+def _eval_view(args, state):
+    """The state eval should score: the EMA weights when --ema-decay is
+    on (a read-only swapped view; training continues from ``state``)."""
+    if getattr(args, "ema_decay", None) is not None:
+        from tensorflow_train_distributed_tpu.training.ema import (
+            swap_ema_params,
+        )
+
+        return swap_ema_params(state)
+    return state
+
+
 def _make_optimizer(args, entry):
     """(optimizer, lr_schedule) from flags + the config's LR convention."""
     import optax
@@ -301,6 +319,14 @@ def _make_optimizer(args, entry):
         # Trainer unscales before tx), so the clip norm means the same
         # thing at any loss-scale or batch size.
         tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    if getattr(args, "ema_decay", None) is not None:
+        from tensorflow_train_distributed_tpu.training.ema import (
+            wrap_with_ema,
+        )
+
+        # Range validation (incl. the 0.0 and 1.0 edges) lives in
+        # ema_of_params — one source of truth.
+        tx = wrap_with_ema(tx, args.ema_decay)
     # Under ReduceLROnPlateau the LR is optimizer STATE, not a schedule —
     # there is no step->lr function for the observational metric.
     return tx, (None if wrap else lr)
@@ -729,6 +755,11 @@ def run(args: argparse.Namespace) -> RunResult:
             checkpoint_every=args.checkpoint_every,
             log_grad_norm=args.log_grad_norm,
             zero1=args.zero1,
+            # Mid-training eval (--eval-every) must score the SAME model
+            # the final eval/export does: the EMA view when enabled.
+            eval_state_view=(
+                (lambda s: _eval_view(args, s))
+                if args.ema_decay is not None else None),
         ),
         callbacks=callbacks,
         checkpoint_manager=ckpt,
@@ -806,10 +837,12 @@ def run(args: argparse.Namespace) -> RunResult:
                     "(--checkpoint-dir with a saved state) or "
                     "--init-from-hf")
             eval_metrics = trainer.evaluate(
-                make_eval_loader(), state, steps=args.eval_steps)
+                make_eval_loader(), _eval_view(args, state),
+                steps=args.eval_steps)
             logger.info("eval-only: %s", eval_metrics)
             if args.bleu_eval > 0:
-                bleu = _bleu_eval(args, task, state, make_eval_loader())
+                bleu = _bleu_eval(args, task, _eval_view(args, state),
+                                  make_eval_loader())
                 eval_metrics = dict(eval_metrics or {}, bleu=bleu)
                 logger.info("BLEU (beam %d, %d batches): %.2f",
                             args.beam_size, args.bleu_eval, bleu)
@@ -888,10 +921,12 @@ def run(args: argparse.Namespace) -> RunResult:
             # Skip eval when preempted: the grace window is for the save,
             # and the restarted job re-runs eval at its own end.
             eval_metrics = trainer.evaluate(
-                make_eval_loader(), state, steps=args.eval_steps)
+                make_eval_loader(), _eval_view(args, state),
+                steps=args.eval_steps)
             logger.info("eval: %s", eval_metrics)
         if args.bleu_eval > 0 and not preempted:
-            bleu = _bleu_eval(args, task, state, make_eval_loader())
+            bleu = _bleu_eval(args, task, _eval_view(args, state),
+                              make_eval_loader())
             eval_metrics = dict(eval_metrics or {}, bleu=bleu)
             logger.info("BLEU (beam %d, %d batches): %.2f",
                         args.beam_size, args.bleu_eval, bleu)

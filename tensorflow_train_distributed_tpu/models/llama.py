@@ -94,6 +94,11 @@ LLAMA_PRESETS = {
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
                             ffn_size=5504),
+    # ~350M-param GPT-medium-class decoder: the mid-size MFU point — big
+    # enough that matmuls dominate per-op overheads (the measured 125m
+    # ceiling), small enough to train on one 16 GiB chip with no_ffn.
+    "llama_350m": LlamaConfig(d_model=1024, num_layers=24, num_heads=16,
+                              ffn_size=2816, max_positions=2048),
     # ~125M-param GPT-2-small-class decoder: the flagship fwd path at a
     # size that compiles fast everywhere (same code path as llama2_7b;
     # also the __graft_entry__ flagship and the LM benchmark default).

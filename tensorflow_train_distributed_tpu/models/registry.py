@@ -150,6 +150,17 @@ def _setup():
              strategy="dp", global_batch_size=8,
              learning_rate=3e-4, lr_schedule="warmup_cosine",
              warmup_ratio=0.01, grad_clip_norm=1.0)
+    # Mid-size decoder (GPT-medium-class): the single-chip MFU point
+    # above 125m; no_ffn remat is what makes b4×2048 fit 16 GiB.
+    register("llama_350m_lm",
+             task_factory=lambda: llama.make_task(dataclasses.replace(
+                 llama.LLAMA_PRESETS["llama_350m"],
+                 remat=True, remat_policy="no_ffn")),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=32_000, seq_len=2048),
+             strategy="dp", global_batch_size=4,
+             learning_rate=3e-4, lr_schedule="warmup_cosine",
+             warmup_ratio=0.01, grad_clip_norm=1.0)
     # Mistral-family flagship: GQA + sliding-window attention (O(S·w)
     # chunked path) over 32k positions; same weight layout as llama so
     # --init-from-hf imports real Mistral checkpoints.

@@ -38,3 +38,9 @@ from tensorflow_train_distributed_tpu.training.callbacks import (  # noqa: F401
     TerminateOnNaN,
 )
 from tensorflow_train_distributed_tpu.training import schedules  # noqa: F401
+from tensorflow_train_distributed_tpu.training.ema import (  # noqa: F401
+    ema_of_params,
+    find_ema_params,
+    swap_ema_params,
+    wrap_with_ema,
+)

@@ -94,6 +94,12 @@ class TrainerConfig:
     # an N-way dp mesh for one extra all-gather per step; numerically
     # identical (parity-tested).
     zero1: bool = False
+    # View applied to the state for EVERY eval fit runs (mid-training
+    # eval_every AND the final one launch.py drives): e.g. EMA weight
+    # swapping (training.ema.swap_ema_params), so val_* metrics feeding
+    # EarlyStopping/ReduceLROnPlateau score the same model the final
+    # eval/export does.  None = identity.
+    eval_state_view: Optional[Callable] = None
 
 
 class Trainer:
@@ -620,10 +626,12 @@ class Trainer:
                 if eval_due:
                     src = (eval_batches() if callable(eval_batches)
                            else eval_batches)
+                    view = self.config.eval_state_view
+                    eval_state = view(state) if view is not None else state
                     self.callbacks.eval_begin()
                     try:
                         val = {f"val_{kk}": v for kk, v in
-                               self.evaluate(src, state,
+                               self.evaluate(src, eval_state,
                                              steps=eval_steps).items()}
                     finally:
                         self.callbacks.eval_end()

@@ -125,6 +125,12 @@ STEPS = [
     ("vit", 700,
      [sys.executable, "tools/bench_vit.py", "--preset", "vit_b16",
       "--batch-per-chip", "64", "--warmup", "3", "--iters", "10"]),
+    # Mid-size decoder MFU point: 350M is where matmuls should outgrow
+    # the per-op overheads that cap 125m at ~15%.
+    ("lm_350m", 700,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_350m",
+      "--batch-per-chip", "4", "--seq", "2048",
+      "--remat", "--remat-policy", "no_ffn", "--iters", "10"]),
     # BERT re-capture only if the early-session number needs refreshing;
     # cheap with a warm compile cache, lowest priority.
     ("bert", 480,

@@ -50,6 +50,7 @@ STEP_KEYS = {
     # sweep) — kept so re-merges keep resolving it.
     "gen_b32": "llama_125m_decode_b32",
     "vit": "vit_b16",
+    "lm_350m": "llama_350m",
 }
 
 
