@@ -64,6 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip gradients to this global norm before the "
                         "optimizer update (default: the config's "
                         "convention, e.g. 1.0 for BERT/Llama; 0 disables)")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="LoRA fine-tuning for decoder-LM configs: freeze "
+                        "the base, train rank-N adapters on "
+                        "--lora-targets (0 = full fine-tuning). The "
+                        "optimizer updates adapters only")
+    p.add_argument("--lora-alpha", type=float, default=16.0,
+                   help="LoRA scaling numerator (delta = alpha/rank·A·B)")
+    p.add_argument("--lora-targets", default="query,value",
+                   help="comma-separated Dense names to adapt (layers.py "
+                        "names: query,key,value,out,wi_gate,wi_up,wo,"
+                        "lm_head)")
     p.add_argument("--ema-decay", type=float, default=None,
                    help="track an exponential moving average of the "
                         "params in optimizer state (Polyak averaging — "
@@ -319,6 +330,15 @@ def _make_optimizer(args, entry):
         # Trainer unscales before tx), so the clip norm means the same
         # thing at any loss-scale or batch size.
         tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    if getattr(args, "lora_rank", 0):
+        # Adapters-only updates AND optimizer state; applied after the
+        # clip chain so the global norm is over adapter grads, and
+        # before the EMA wrap so the EMA still sees full params.
+        from tensorflow_train_distributed_tpu.models.lora import (
+            freeze_base,
+        )
+
+        tx = freeze_base(tx)
     if getattr(args, "ema_decay", None) is not None:
         from tensorflow_train_distributed_tpu.training.ema import (
             wrap_with_ema,
@@ -665,6 +685,33 @@ def run(args: argparse.Namespace) -> RunResult:
 
     # 4. Trainer: task + optimizer + policy + callbacks.
     task = entry["task_factory"]()
+    if args.lora_rank:
+        from tensorflow_train_distributed_tpu.models.llama import (
+            CausalLmTask,
+        )
+        from tensorflow_train_distributed_tpu.models.lora import (
+            LoraSpec, validate_targets,
+        )
+
+        if not isinstance(task, CausalLmTask):
+            raise SystemExit(
+                f"--lora-rank applies to decoder-LM configs; "
+                f"{args.config!r} is not one")
+        if args.ema_decay is not None:
+            raise SystemExit(
+                "--ema-decay with --lora-rank is not supported: the EMA "
+                "would keep a full f32 copy of the FROZEN base (whose "
+                "average never moves) — defeating LoRA's memory point at "
+                "exactly the scale LoRA exists for")
+        try:
+            spec = LoraSpec(
+                rank=args.lora_rank, alpha=args.lora_alpha,
+                targets=validate_targets(args.lora_targets.split(",")))
+        except ValueError as e:
+            raise SystemExit(str(e))
+        task = CausalLmTask(dataclasses.replace(task.config, lora=spec))
+        logger.info("LoRA enabled: rank=%d alpha=%.1f targets=%s (base "
+                    "frozen)", spec.rank, spec.alpha, spec.targets)
     if args.bleu_eval > 0:
         # Fail at launch, not after a multi-hour run completes.
         from tensorflow_train_distributed_tpu.models import transformer as tr

@@ -87,6 +87,11 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
+    if config.lora is not None and quant_scales is not None:
+        raise ValueError(
+            "int8 serving of a LoRA model needs the adapters folded in "
+            "first: params = models.lora.merge_lora(params, spec), then "
+            "quantize the merged tree with a lora=None config")
     has_int8 = any(
         getattr(x, "dtype", None) == jnp.int8
         for x in jax.tree.leaves(params))
@@ -152,8 +157,19 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
 
     base_vars = maybe_quant_variables(params, quant_scales)
 
+    def infer_ctx():
+        # LoRA configs serve unmerged adapters through the same
+        # interceptor the training task uses; otherwise the (free when
+        # inactive) int8 interceptor.  The two do not compose — generate
+        # rejects that pairing up front.
+        from tensorflow_train_distributed_tpu.models.lora import (
+            maybe_lora_scope,
+        )
+
+        return maybe_lora_scope(config.lora, fallback=quantized_inference)
+
     # Prefill: whole prompt at once; next token comes from the last logit.
-    with quantized_inference():
+    with infer_ctx():
         logits, variables = model.apply(
             base_vars, prompt, mutable=["cache"])
     rngs = jax.random.split(rng, max_new_tokens)
@@ -161,7 +177,7 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
 
     def step(carry, step_rng):
         cache, tok = carry
-        with quantized_inference():
+        with infer_ctx():
             logits, updated = model.apply(
                 dict(base_vars, cache=cache), tok[:, None],
                 mutable=["cache"])

@@ -80,6 +80,11 @@ class LlamaConfig:
     # group; same stacked params, same math, pipelined execution).  The
     # schedule needs scan_layers (the stacked-parameter layout).
     pipeline_microbatches: Optional[int] = None
+    # LoRA fine-tuning (models.lora.LoraSpec): frozen base + trainable
+    # low-rank adapters on the targeted projections.  The task applies
+    # the model under lora_scope; pair the optimizer with
+    # lora.freeze_base.  None = full fine-tuning.
+    lora: object = None
 
 
 LLAMA_PRESETS = {
@@ -385,14 +390,24 @@ class CausalLmTask:
         self.config = config
         self.model = LlamaModel(config)
 
+    def _scope(self):
+        """LoRA interception context when the config asks for it."""
+        from tensorflow_train_distributed_tpu.models.lora import (
+            maybe_lora_scope,
+        )
+
+        return maybe_lora_scope(self.config.lora)
+
     def init_variables(self, rng, batch):
-        return self.model.init(rng, batch["tokens"])
+        with self._scope():
+            return self.model.init(rng, batch["tokens"])
 
     def loss_fn(self, params, model_state, batch, rng, train):
         del rng, train  # no dropout in llama pretraining/SFT
-        logits = self.model.apply(
-            {"params": params}, batch["tokens"],
-            segment_ids=batch.get("segment_ids")).astype(jnp.float32)
+        with self._scope():
+            logits = self.model.apply(
+                {"params": params}, batch["tokens"],
+                segment_ids=batch.get("segment_ids")).astype(jnp.float32)
         weights = fold_sample_weight(batch, batch["targets"].shape,
                                      batch.get("loss_weights"))
         loss, acc = softmax_cross_entropy(logits, batch["targets"],
@@ -408,8 +423,9 @@ class CausalLmTask:
     def predict_fn(self, params, model_state, batch):
         """Next-token logits (Trainer.predict contract)."""
         del model_state
-        return self.model.apply({"params": params}, batch["tokens"],
-                                segment_ids=batch.get("segment_ids"))
+        with self._scope():
+            return self.model.apply({"params": params}, batch["tokens"],
+                                    segment_ids=batch.get("segment_ids"))
 
 
 def make_task(config: LlamaConfig = LLAMA_PRESETS["llama2_7b"]

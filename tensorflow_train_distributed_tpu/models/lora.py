@@ -1,0 +1,222 @@
+"""LoRA fine-tuning for the decoder family (low-rank adapters).
+
+The reference's config[4] is Llama-2-7B SFT (SURVEY.md §2.1) — full
+fine-tuning, whose optimizer state alone (14 B/param) busts a 16 GiB
+chip at 7B.  LoRA (Hu et al., 2021) is the standard answer: freeze the
+base weights, train rank-r deltas ``W + (alpha/r)·A·B`` on targeted
+projections.  State shrinks to the adapters (~0.1% of params), and the
+base can stay bf16 with no master copy.
+
+TPU-first mechanics (zero model changes — the ``models.quant`` pattern):
+
+- a flax method interceptor rewrites targeted ``nn.Dense``/
+  ``nn.DenseGeneral`` calls to ``stop_gradient(base)(x) + scaling·
+  (x@A)@B``.  ``stop_gradient`` on the kernel/bias means XLA never
+  computes or stores base-weight gradients (the FLOP/memory win, not
+  just an optimizer mask);
+- adapters are ordinary flax params (``lora_a``/``lora_b`` beside each
+  target kernel), so they ride the existing checkpoint/sharding/scan
+  machinery — depth-scanned models stack them ``[L, in, r]`` exactly
+  like their kernels;
+- ``freeze_base(tx, ...)`` masks the optimizer so ONLY adapters get
+  updates or optimizer state (embeddings/norms are frozen by mask;
+  their grads are tiny);
+- ``merge_lora(params, spec)`` folds the deltas into the kernels for
+  serving/export (compose with ``models.quant`` AFTER merging).
+
+Usage::
+
+    spec = LoraSpec(rank=8, alpha=16.0)         # targets q,v by default
+    cfg = dataclasses.replace(LLAMA_PRESETS["llama2_7b"], lora=spec)
+    task = CausalLmTask(cfg)                     # applies under the scope
+    tx = freeze_base(optax.adamw(1e-4))
+    ...train as usual; checkpoint carries base + adapters...
+    serving_params = merge_lora(state.params, spec)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraSpec:
+    """Hashable (lives inside frozen model configs under jit)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    # Module NAMES to adapt (the attention/MLP Dense submodule names in
+    # models.layers: query/key/value/out, wi_gate/wi_up/wo, lm_head).
+    # The LoRA-paper default adapts q and v.
+    targets: Tuple[str, ...] = ("query", "value")
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if self.alpha <= 0:
+            # alpha=0 zeroes the delta AND its gradients — with the base
+            # frozen, nothing would train, silently.
+            raise ValueError(f"alpha must be > 0, got {self.alpha}")
+        if not self.targets:
+            raise ValueError("targets must name at least one module")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _make_interceptor(spec: LoraSpec):
+    def interceptor(next_fn, args, kwargs, context):
+        mdl = context.module
+        if (context.method_name != "__call__"
+                or not isinstance(mdl, (nn.Dense, nn.DenseGeneral))
+                or mdl.name not in spec.targets):
+            return next_fn(*args, **kwargs)
+        if isinstance(mdl, nn.DenseGeneral) and not (
+                isinstance(mdl.features, int) and mdl.axis == -1):
+            raise ValueError(
+                f"LoRA target {mdl.name!r} is a DenseGeneral beyond the "
+                "Dense-shaped case (int features, axis=-1) — unsupported")
+        (x,) = args
+        dtype = mdl.dtype or x.dtype
+        if mdl.has_variable("params", "kernel"):
+            # Frozen base: stop_gradient at the READ, so XLA neither
+            # computes nor stores dL/dW for it (dL/dx still flows).
+            kernel = jax.lax.stop_gradient(
+                mdl.get_variable("params", "kernel"))
+            y = jax.lax.dot_general(
+                x.astype(dtype), kernel.astype(dtype),
+                (((x.ndim - 1,), (0,)), ((), ())))
+            if mdl.use_bias:
+                y = y + jax.lax.stop_gradient(
+                    mdl.get_variable("params", "bias")).astype(dtype)
+        else:
+            # Init path: let the module create its own kernel/bias.
+            y = next_fn(*args, **kwargs)
+        in_dim = x.shape[-1]
+        features = mdl.features  # int: asserted above for DenseGeneral
+        # f32 masters for the trainable adapters; compute in the layer
+        # dtype.  B starts at zero, so step 0 is exactly the base model.
+        a = mdl.param("lora_a", nn.initializers.normal(0.02),
+                      (in_dim, spec.rank), jnp.float32)
+        b = mdl.param("lora_b", nn.initializers.zeros,
+                      (spec.rank, features), jnp.float32)
+        delta = (x.astype(dtype) @ a.astype(dtype)) @ b.astype(dtype)
+        return y + delta * spec.scaling
+    return interceptor
+
+
+# The Dense submodule names models.layers actually uses — the universe
+# --lora-targets / LoraSpec.targets can select from.  A typo here means
+# NO adapters get created and a frozen-base run trains nothing, so
+# callers validate eagerly (launch.py does at parse time).
+KNOWN_TARGETS = frozenset({
+    "query", "key", "value", "out",          # attention projections
+    "wi", "wi_gate", "wi_up", "wo",          # MLP (plain / gated)
+    "lm_head",
+})
+
+
+def validate_targets(targets) -> tuple:
+    """Strip + validate names against KNOWN_TARGETS; returns the tuple."""
+    clean = tuple(t.strip() for t in targets if t.strip())
+    unknown = [t for t in clean if t not in KNOWN_TARGETS]
+    if unknown:
+        raise ValueError(
+            f"unknown LoRA target(s) {unknown}: valid names are "
+            f"{sorted(KNOWN_TARGETS)} (the models.layers Dense submodule "
+            "names — a non-matching name creates NO adapters and a "
+            "frozen-base run would silently train nothing)")
+    return clean
+
+
+def lora_scope(spec: LoraSpec):
+    """Context manager activating the adapters for init/apply."""
+    return nn.intercept_methods(_make_interceptor(spec))
+
+
+def maybe_lora_scope(spec, fallback=None):
+    """``lora_scope(spec)`` when ``spec`` is set, else ``fallback()`` (or
+    a nullcontext) — the one dispatch shared by the training task and
+    ``generate`` so the two cannot drift."""
+    if spec is not None:
+        return lora_scope(spec)
+    if fallback is not None:
+        return fallback()
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def is_lora_param(path) -> bool:
+    """``path``: a tuple of str keys (flatten_dict convention)."""
+    return path[-1] in ("lora_a", "lora_b")
+
+
+def _plain(tree):
+    """Strip flax metadata boxes by value (raw ``model.init`` output;
+    trained Trainer states arrive already unboxed)."""
+    is_boxed = lambda x: isinstance(x, nn.meta.AxisMetadata)  # noqa: E731
+    return jax.tree.map(lambda x: x.value if is_boxed(x) else x,
+                        tree, is_leaf=is_boxed)
+
+
+def lora_labels(params):
+    """'lora' | 'frozen' label tree for ``optax.multi_transform``."""
+    flat = flatten_dict(params)
+    return unflatten_dict({
+        p: ("lora" if is_lora_param(p) else "frozen") for p in flat})
+
+
+def freeze_base(tx):
+    """Wrap an optimizer so ONLY LoRA adapters receive updates — and
+    only they get optimizer state (``multi_transform`` allocates the
+    inner state per label, so frozen params carry no moments)."""
+    import optax
+
+    return optax.multi_transform(
+        {"lora": tx, "frozen": optax.set_to_zero()}, lora_labels)
+
+
+def count_lora_params(params) -> tuple[int, int]:
+    """(trainable adapter params, total params)."""
+    flat = flatten_dict(_plain(params))
+    lora = sum(v.size for p, v in flat.items() if is_lora_param(p))
+    total = sum(v.size for v in flat.values())
+    return lora, total
+
+
+def merge_lora(params, spec: LoraSpec):
+    """Fold adapters into their kernels; drop the adapter leaves.
+
+    Returns a plain base-model tree (loads into a no-LoRA config;
+    quantize/export/serve from it).  Works for 2-D kernels and
+    ``nn.scan``-stacked 3-D ones (adapters stack the same way).
+    """
+    flat = flatten_dict(_plain(params))
+    out = {}
+    merged = 0
+    for path, w in flat.items():
+        if is_lora_param(path):
+            continue
+        if path[-1] == "kernel":
+            a = flat.get(path[:-1] + ("lora_a",))
+            b = flat.get(path[:-1] + ("lora_b",))
+            if a is not None and b is not None:
+                delta = jnp.einsum("...ir,...ro->...io",
+                                   a.astype(jnp.float32),
+                                   b.astype(jnp.float32)) * spec.scaling
+                w = (w.astype(jnp.float32) + delta).astype(w.dtype)
+                merged += 1
+        out[path] = w
+    if merged == 0:
+        raise ValueError(
+            "no (lora_a, lora_b) pairs found beside any kernel — was "
+            "this tree trained under lora_scope/a lora= config?")
+    return unflatten_dict(out)
