@@ -332,8 +332,10 @@ def _make_optimizer(args, entry):
         tx = optax.chain(optax.clip_by_global_norm(clip), tx)
     if getattr(args, "lora_rank", 0):
         # Adapters-only updates AND optimizer state; applied after the
-        # clip chain so the global norm is over adapter grads, and
-        # before the EMA wrap so the EMA still sees full params.
+        # clip chain so the global norm is over adapter grads.  (The CLI
+        # rejects combining with --ema-decay — a full-params EMA defeats
+        # LoRA's memory point — so the EMA wrap below never composes
+        # with this in practice.)
         from tensorflow_train_distributed_tpu.models.lora import (
             freeze_base,
         )

@@ -33,6 +33,27 @@ from tensorflow_train_distributed_tpu.models.quant import (
 )
 
 
+def cast_floating(params, dtype):
+    """Cast floating leaves to ``dtype`` (inference precision).
+
+    Reads ``.dtype`` directly — ``jnp.asarray`` would round-trip every
+    leaf through the device just to inspect it (26 GB of H2D at 7B).
+    int8 kernels, ints, and non-array leaves pass through untouched.
+    """
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params)
+
+
+def has_lora_leaves(params) -> bool:
+    """Whether a param tree carries unmerged LoRA adapters."""
+    return any(
+        getattr(p[-1], "key", None) in ("lora_a", "lora_b")
+        for p, _ in jax.tree_util.tree_flatten_with_path(params)[0])
+
+
 def generate(config: LlamaConfig, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -92,6 +113,14 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             "int8 serving of a LoRA model needs the adapters folded in "
             "first: params = models.lora.merge_lora(params, spec), then "
             "quantize the merged tree with a lora=None config")
+    if config.lora is None and has_lora_leaves(params):
+        # flax apply would silently IGNORE the extra adapter leaves and
+        # serve the un-adapted base — the fine-tuning vanishing without
+        # a trace is the worst possible failure mode here.
+        raise ValueError(
+            "params carry unmerged LoRA adapters but config.lora is not "
+            "set: either serve with the training config (lora=LoraSpec) "
+            "or fold them in first via models.lora.merge_lora")
     has_int8 = any(
         getattr(x, "dtype", None) == jnp.int8
         for x in jax.tree.leaves(params))
@@ -105,15 +134,7 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             f"{'set' if quant_scales is not None else 'None'} "
             "(both come from models.quant.quantize_params)")
     if cast_params:
-        # Read .dtype directly — jnp.asarray would round-trip every leaf
-        # through the device just to inspect it (26 GB of H2D at 7B).
-        # Non-array leaves (a Python float smuggled into a hand-built
-        # tree) have no .astype — leave them to _generate's tracing.
-        params = jax.tree.map(
-            lambda x: x.astype(config.dtype)
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-            else x,
-            params)
+        params = cast_floating(params, config.dtype)
     # top_k is static (it sets the lax.top_k shape); top_p is a TRACED
     # scalar so a sampling sweep over p reuses one compiled graph.
     return _generate(config, max_new_tokens, greedy, top_k,

@@ -400,7 +400,22 @@ class CausalLmTask:
 
     def init_variables(self, rng, batch):
         with self._scope():
-            return self.model.init(rng, batch["tokens"])
+            variables = self.model.init(rng, batch["tokens"])
+        if self.config.lora is not None:
+            # Structural check at the right altitude: a target list that
+            # matches no module (beyond what name validation can know)
+            # would freeze everything and silently train nothing.
+            from tensorflow_train_distributed_tpu.models.lora import (
+                count_lora_params,
+            )
+
+            n_lora, _ = count_lora_params(variables["params"])
+            if n_lora == 0:
+                raise ValueError(
+                    f"LoRA targets {self.config.lora.targets} matched no "
+                    "module in this model — no adapters were created, so "
+                    "a frozen-base run would train nothing")
+        return variables
 
     def loss_fn(self, params, model_state, batch, rng, train):
         del rng, train  # no dropout in llama pretraining/SFT

@@ -118,7 +118,7 @@ def _make_interceptor(spec: LoraSpec):
 # callers validate eagerly (launch.py does at parse time).
 KNOWN_TARGETS = frozenset({
     "query", "key", "value", "out",          # attention projections
-    "wi", "wi_gate", "wi_up", "wo",          # MLP (plain / gated)
+    "wi_gate", "wi_up", "wo",                # MLP (llama is always gated)
     "lm_head",
 })
 

@@ -1,0 +1,213 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies — exact greedy output at a fraction of the target steps.
+
+Beyond the reference (a training harness): the standard serving-latency
+lever for autoregressive decode (Leviathan et al., 2023, greedy case).
+Each round the draft generates ``k`` tokens autoregressively (cheap),
+then the target scores the whole ``k+1``-token block in ONE forward pass
+(decode is weight-bandwidth-bound, so a k+1-token call costs about the
+same HBM traffic as a 1-token call).  The emitted sequence is PROVABLY
+identical to the target's own greedy decode: accepted drafts are exactly
+the target's argmaxes, and the first disagreement is replaced by the
+target's choice.
+
+TPU-first mechanics, one jit end to end:
+
+- fixed shapes everywhere: ``k`` is static, each round emits between 1
+  and k+1 tokens into a fixed ``[max_new + k + 1]`` buffer (garbage tail
+  of a round is overwritten by the next round's fixed-width write);
+- ``lax.while_loop`` over rounds (1+ tokens per round ⇒ terminates);
+- cache rollback is an INDEX RESET: the linear KV cache masks rows at
+  ``kv_pos <= position`` and overwrites stale rows in place, so
+  rejected speculation costs nothing to undo.  (Rolling window caches
+  are destructive on overwrite — sliding-window configs are rejected.)
+- the draft runs ``k+1`` steps (the last append-only), so both caches
+  hold identical row sets and roll back by the same rule.
+
+Batch must be 1: acceptance length varies per sequence, and the KV
+cache keeps ONE index per batch (speculation is a small-batch latency
+optimization; larger batches should just batch normally).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModel,
+)
+
+
+def _reject_config(name: str, cfg: LlamaConfig):
+    if cfg.sliding_window is not None:
+        raise ValueError(
+            f"{name} config uses sliding_window={cfg.sliding_window}: "
+            "the rolling KV ring overwrites rows destructively, so "
+            "speculative rollback (an index reset) is unsound — use "
+            "full-attention configs")
+    if cfg.lora is not None:
+        raise ValueError(
+            f"{name} config carries LoRA adapters; merge them first "
+            "(models.lora.merge_lora) — speculative decode serves plain "
+            "base trees")
+
+
+def _set_cache_index(cache, value):
+    """Roll every layer's cache index to ``value`` (scan-stacked index
+    leaves broadcast the scalar)."""
+    def fix(path, leaf):
+        if path[-1].key == "index":
+            return jnp.broadcast_to(value, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def generate_speculative(target_config: LlamaConfig, target_params,
+                         draft_config: LlamaConfig, draft_params,
+                         prompt: jax.Array, max_new_tokens: int, *,
+                         k: int = 4, cast_params: bool = True):
+    """Greedy decode of ``max_new_tokens`` via draft speculation.
+
+    Returns ``(tokens [1, S+max_new], accepted_rounds_stats)`` where the
+    stats dict carries ``rounds`` and ``drafted_accepted`` (host ints,
+    for measuring acceptance rate).  Output tokens are identical to
+    ``generate(target_config, target_params, prompt, max_new_tokens)``.
+    """
+    if prompt.ndim != 2 or prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decode is batch-1 (per-row acceptance lengths "
+            f"need per-row cache indices); got shape {prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    if k < 1:
+        raise ValueError(f"k (draft block length) must be >= 1, got {k}")
+    if draft_config.vocab_size != target_config.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_config.vocab_size} != target vocab "
+            f"{target_config.vocab_size}: token ids would not line up")
+    _reject_config("target", target_config)
+    _reject_config("draft", draft_config)
+    total = prompt.shape[1] + max_new_tokens + k + 1
+    if total > target_config.max_positions:
+        raise ValueError(
+            f"prompt + max_new + k+1 = {total} exceeds the target's "
+            f"max_positions {target_config.max_positions}")
+    if total > draft_config.max_positions:
+        raise ValueError(
+            f"prompt + max_new + k+1 = {total} exceeds the draft's "
+            f"max_positions {draft_config.max_positions}")
+    from tensorflow_train_distributed_tpu.models.generate import (
+        cast_floating,
+        has_lora_leaves,
+    )
+
+    for name, p in (("target", target_params), ("draft", draft_params)):
+        if any(getattr(x, "dtype", None) == jnp.int8
+               for x in jax.tree.leaves(p)):
+            raise ValueError(
+                f"{name} params are int8-quantized: speculative decode "
+                "has no dequant path — pass full-precision trees "
+                "(generate() handles int8 serving)")
+        if has_lora_leaves(p):
+            raise ValueError(
+                f"{name} params carry unmerged LoRA adapters — fold them "
+                "in first (models.lora.merge_lora)")
+    if cast_params:
+        target_params = cast_floating(target_params, target_config.dtype)
+        draft_params = cast_floating(draft_params, draft_config.dtype)
+    out, rounds, accepted = _speculate(
+        target_config, draft_config, int(max_new_tokens), int(k),
+        target_params, draft_params, prompt)
+    stats = {"rounds": int(rounds),
+             "drafted_accepted": int(accepted),
+             "tokens": int(max_new_tokens)}
+    return out, stats
+
+
+@partial(jax.jit, static_argnames=("target_config", "draft_config",
+                                   "max_new", "k"))
+def _speculate(target_config, draft_config, max_new, k,
+               target_params, draft_params, prompt):
+    prompt_len = prompt.shape[1]
+    cache_len = prompt_len + max_new + k + 1
+    target = LlamaModel(target_config, decode=True, cache_len=cache_len)
+    draft = LlamaModel(draft_config, decode=True, cache_len=cache_len)
+
+    # Prefill both on the prompt; the target's last logit emits token 1.
+    t_logits, t_vars = target.apply({"params": target_params}, prompt,
+                                    mutable=["cache"])
+    _, d_vars = draft.apply({"params": draft_params}, prompt,
+                            mutable=["cache"])
+    tok0 = jnp.argmax(t_logits[:, -1].astype(jnp.float32),
+                      axis=-1).astype(prompt.dtype)  # [1]
+
+    out0 = jnp.zeros((1, max_new + k + 1), prompt.dtype)
+    out0 = out0.at[:, 0].set(tok0)
+
+    def draft_step(cache, tok):
+        logits, upd = draft.apply(
+            {"params": draft_params, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(tok.dtype)
+        return upd["cache"], nxt
+
+    def body(carry):
+        d_cache, t_cache, tok, done, out, rounds, acc_total = carry
+        ctx = prompt_len + done - 1  # non-prompt rows both caches hold
+
+        # Draft k+1 steps: inputs [tok, d0..d_{k-1}] -> emits d0..dk.
+        # The k+1-th step is append-only (dk discarded) so the draft
+        # cache finishes holding the SAME row set as the target's, and
+        # both roll back by one rule below.
+        def scan_step(c, _):
+            cache, t = c
+            cache, nxt = draft_step(cache, t)
+            return (cache, nxt), nxt  # collect OUTPUT tokens d0..dk
+
+        (d_cache, _), drafts = jax.lax.scan(
+            scan_step, (d_cache, tok), None, length=k + 1)
+        drafts = drafts[:, 0]            # [k+1]; last entry unused (dk)
+        d_block = drafts[:k]             # d0..d_{k-1}
+
+        # Target verifies [tok, d0..d_{k-1}] in one k+1-token call.
+        block = jnp.concatenate([tok, d_block], axis=0)[None, :]  # [1,k+1]
+        logits, t_upd = target.apply(
+            {"params": target_params, "cache": t_cache}, block,
+            mutable=["cache"])
+        t_cache = t_upd["cache"]
+        preds = jnp.argmax(logits[0].astype(jnp.float32),
+                           axis=-1).astype(tok.dtype)  # [k+1]: n0..nk
+
+        # a = leading i with d_i == n_i; emit d0..d_{a-1} then n_a.
+        match = (d_block == preds[:k]).astype(jnp.int32)
+        a = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), jnp.int32)]))
+        emitted = a + 1
+        idx = jnp.arange(k + 1)
+        d_padded = jnp.concatenate([d_block, jnp.zeros((1,), tok.dtype)])
+        emit = jnp.where(idx < a, d_padded,
+                         jnp.where(idx == a, preds[a], 0)).astype(tok.dtype)
+        out = jax.lax.dynamic_update_slice(out, emit[None, :], (0, done))
+
+        # Roll both caches back to the accepted context.
+        new_index = ctx + emitted
+        d_cache = _set_cache_index(d_cache, new_index)
+        t_cache = _set_cache_index(t_cache, new_index)
+        return (d_cache, t_cache, preds[a][None], done + emitted, out,
+                rounds + 1, acc_total + a)
+
+    def cond(carry):
+        return carry[3] < max_new
+
+    init = (d_vars["cache"], t_vars["cache"], tok0, jnp.asarray(1),
+            out0, jnp.asarray(0), jnp.asarray(0))
+    _, _, _, done, out, rounds, acc_total = jax.lax.while_loop(
+        cond, body, init)
+    return (jnp.concatenate([prompt, out[:, :max_new]], axis=1),
+            rounds, acc_total)

@@ -1,0 +1,117 @@
+"""Speculative decoding (models.speculative).
+
+The gold contract: for ANY draft model, the emitted tokens are
+IDENTICAL to the target's own greedy decode — speculation changes
+latency, never output.  Plus: a draft that IS the target accepts every
+proposal (rounds ≈ max_new/(k+1)), and the guards reject unsound
+configurations loudly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: full-suite tier
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_train_distributed_tpu.models.generate import generate
+from tensorflow_train_distributed_tpu.models.llama import (
+    LLAMA_PRESETS,
+    LlamaModel,
+)
+from tensorflow_train_distributed_tpu.models.speculative import (
+    generate_speculative,
+)
+
+TINY = LLAMA_PRESETS["llama_tiny"]
+
+
+def _params(cfg, seed):
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    return LlamaModel(cfg).init(jax.random.key(seed), prompt)["params"]
+
+
+def _prompt(cfg, n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                    (1, n)).astype(np.int32))
+
+
+class TestExactness:
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_matches_target_greedy_any_draft(self, k):
+        """Unrelated draft weights — output still equals target greedy."""
+        target_p = _params(TINY, 0)
+        draft_cfg = dataclasses.replace(TINY, num_layers=1, num_heads=2,
+                                        num_kv_heads=1)
+        draft_p = _params(draft_cfg, 123)
+        prompt = _prompt(TINY)
+        want = np.asarray(generate(TINY, target_p, prompt, 12))
+        got, stats = generate_speculative(
+            TINY, target_p, draft_cfg, draft_p, prompt, 12, k=k)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert stats["rounds"] >= 1
+
+    def test_matches_across_scan_variants(self):
+        """Scanned target + unrolled draft (different stack layouts)."""
+        cfg_t = LLAMA_PRESETS["llama_tiny_scan"]
+        target_p = _params(cfg_t, 1)
+        draft_p = _params(TINY, 7)
+        prompt = _prompt(cfg_t, seed=2)
+        want = np.asarray(generate(cfg_t, target_p, prompt, 10))
+        got, _ = generate_speculative(cfg_t, target_p, TINY, draft_p,
+                                      prompt, 10, k=4)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_perfect_draft_accepts_everything(self):
+        """Draft == target: every proposal accepted, so the loop runs
+        ~max_new/(k+1) rounds and acceptance is 100%."""
+        p = _params(TINY, 3)
+        prompt = _prompt(TINY, seed=5)
+        k, n = 4, 15
+        got, stats = generate_speculative(TINY, p, TINY, p, prompt, n,
+                                          k=k)
+        want = np.asarray(generate(TINY, p, prompt, n))
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert stats["drafted_accepted"] == stats["rounds"] * k or (
+            stats["drafted_accepted"] >= stats["rounds"] * k - k)
+        assert stats["rounds"] <= -(-n // (k + 1)) + 1
+
+    def test_single_new_token(self):
+        p = _params(TINY, 4)
+        prompt = _prompt(TINY, seed=6)
+        got, _ = generate_speculative(
+            TINY, p, TINY, p, prompt, 1, k=3)
+        want = np.asarray(generate(TINY, p, prompt, 1))
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestGuards:
+    def test_batch_must_be_one(self):
+        p = _params(TINY, 0)
+        with pytest.raises(ValueError, match="batch-1"):
+            generate_speculative(TINY, p, TINY, p,
+                                 jnp.zeros((2, 4), jnp.int32), 4)
+
+    def test_window_configs_rejected(self):
+        cfg = dataclasses.replace(TINY, sliding_window=8)
+        p = _params(TINY, 0)
+        with pytest.raises(ValueError, match="sliding_window"):
+            generate_speculative(cfg, p, TINY, p,
+                                 jnp.zeros((1, 4), jnp.int32), 4)
+
+    def test_vocab_mismatch_rejected(self):
+        cfg = dataclasses.replace(TINY, vocab_size=128)
+        p = _params(TINY, 0)
+        with pytest.raises(ValueError, match="vocab"):
+            generate_speculative(TINY, p, cfg, p,
+                                 jnp.zeros((1, 4), jnp.int32), 4)
+
+    def test_cache_overflow_rejected(self):
+        p = _params(TINY, 0)
+        with pytest.raises(ValueError, match="max_positions"):
+            generate_speculative(TINY, p, TINY, p,
+                                 jnp.zeros((1, 100), jnp.int32), 120)
