@@ -714,6 +714,15 @@ def run(args: argparse.Namespace) -> RunResult:
         task = CausalLmTask(dataclasses.replace(task.config, lora=spec))
         logger.info("LoRA enabled: rank=%d alpha=%.1f targets=%s (base "
                     "frozen)", spec.rank, spec.alpha, spec.targets)
+        if args.checkpoint_dir:
+            # Self-describing checkpoints: alpha is not recoverable from
+            # weights, and serving/merging with a retyped-wrong spec is
+            # silent corruption — sample.py / export read this sidecar.
+            from tensorflow_train_distributed_tpu.models.lora import (
+                save_spec,
+            )
+
+            save_spec(args.checkpoint_dir, spec)
     if args.bleu_eval > 0:
         # Fail at launch, not after a multi-hour run completes.
         from tensorflow_train_distributed_tpu.models import transformer as tr

@@ -125,10 +125,13 @@ def export_llama(config: LlamaConfig, params, out_dir) -> Path:
 
 
 def export_hf_from_registry(config_name: str, checkpoint_dir,
-                            out_dir, *, platform: str = "cpu") -> Path:
+                            out_dir, *, platform: str = "cpu",
+                            lora_alpha: float = 16.0) -> Path:
     """CLI-oriented wrapper: registry llama-family config + orbax
     checkpoint → HF directory.  ``checkpoint_dir=None`` exports a fresh
-    init (interop smoke test)."""
+    init (interop smoke test).  Checkpoints carrying LoRA adapters are
+    merged first; ``lora_alpha`` must match the training value (the CLI
+    default is 16.0) when the config itself does not carry the spec."""
     from tensorflow_train_distributed_tpu.models import registry
     from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
     from tensorflow_train_distributed_tpu.runtime.mesh import force_platform
@@ -169,4 +172,38 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
         toks = np_.zeros((1, 8), np_.int32)
         params = LlamaModel(config).init(jax.random.key(0),
                                          toks)["params"]
+    from tensorflow_train_distributed_tpu.models.generate import (
+        has_lora_leaves,
+    )
+
+    if has_lora_leaves(params):
+        # A LoRA fine-tune exports as a PLAIN HF model: fold the
+        # adapters into the kernels first (HF loaders know nothing of
+        # the lora_a/lora_b leaves and would silently drop them).
+        # Rank comes from the adapter shapes; alpha must come from the
+        # config or the caller (it is not recoverable from weights).
+        import jax as _jax
+
+        from tensorflow_train_distributed_tpu.models.lora import (
+            LoraSpec, merge_lora,
+        )
+
+        from tensorflow_train_distributed_tpu.models.lora import (
+            check_spec_matches, load_spec,
+        )
+
+        sidecar = (load_spec(checkpoint_dir)
+                   if checkpoint_dir is not None else None)
+        if sidecar is not None:
+            spec = sidecar          # authoritative: written at train time
+        elif config.lora is not None:
+            spec = config.lora
+        else:
+            rank = next(
+                v.shape[-1]
+                for p, v in _jax.tree_util.tree_flatten_with_path(params)[0]
+                if getattr(p[-1], "key", None) == "lora_a")
+            spec = LoraSpec(rank=rank, alpha=lora_alpha)
+        check_spec_matches(params, spec)
+        params = merge_lora(params, spec)
     return export_llama(config, params, out_dir)

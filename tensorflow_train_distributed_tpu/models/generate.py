@@ -113,6 +113,15 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             "int8 serving of a LoRA model needs the adapters folded in "
             "first: params = models.lora.merge_lora(params, spec), then "
             "quantize the merged tree with a lora=None config")
+    if config.lora is not None and has_lora_leaves(params):
+        # Targets/rank must agree with the adapters actually present —
+        # flax silently ignores unread leaves, so a narrower serving
+        # spec would silently drop part of the fine-tune.
+        from tensorflow_train_distributed_tpu.models.lora import (
+            check_spec_matches,
+        )
+
+        check_spec_matches(params, config.lora)
     if config.lora is None and has_lora_leaves(params):
         # flax apply would silently IGNORE the extra adapter leaves and
         # serve the un-adapted base — the fine-tuning vanishing without

@@ -154,6 +154,67 @@ def maybe_lora_scope(spec, fallback=None):
     return contextlib.nullcontext()
 
 
+SPEC_SIDECAR = "lora_spec.json"
+
+
+def save_spec(checkpoint_dir, spec: LoraSpec) -> str:
+    """Persist the spec beside the checkpoint (alpha is NOT recoverable
+    from the weights, and a mismatched serve/merge silently corrupts) —
+    the launcher writes this whenever LoRA training checkpoints."""
+    import json
+    import os
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = os.path.join(checkpoint_dir, SPEC_SIDECAR)
+    with open(path, "w") as f:
+        json.dump({"rank": spec.rank, "alpha": spec.alpha,
+                   "targets": list(spec.targets)}, f)
+    return path
+
+
+def load_spec(checkpoint_dir):
+    """The persisted LoraSpec, or None (non-LoRA checkpoint)."""
+    import json
+    import os
+
+    path = os.path.join(checkpoint_dir, SPEC_SIDECAR)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        d = json.load(f)
+    return LoraSpec(rank=int(d["rank"]), alpha=float(d["alpha"]),
+                    targets=tuple(d["targets"]))
+
+
+def check_spec_matches(params, spec: LoraSpec) -> None:
+    """Raise unless the adapters IN the tree agree with ``spec`` on
+    targets and rank.
+
+    flax apply silently ignores params the model never reads, so a
+    serving spec that targets fewer modules (or a different rank →
+    shape-check failure only for matching names) than training would
+    silently drop part of the fine-tune.  Alpha cannot be checked from
+    weights — that is what the checkpoint sidecar (save_spec) is for.
+    """
+    flat = flatten_dict(_plain(params))
+    seen_targets = {p[-2] for p in flat if p[-1] == "lora_a"}
+    ranks = {v.shape[-1] for p, v in flat.items() if p[-1] == "lora_a"}
+    if not seen_targets:
+        raise ValueError("params carry no LoRA adapters but a LoraSpec "
+                         "was given")
+    if seen_targets != set(spec.targets):
+        raise ValueError(
+            f"LoRA spec/params mismatch: params carry adapters on "
+            f"{sorted(seen_targets)} but the spec targets "
+            f"{sorted(spec.targets)} — serving would silently drop or "
+            "miss adapters (check --lora-targets against training, or "
+            "use the checkpoint's lora_spec.json)")
+    if ranks != {spec.rank}:
+        raise ValueError(
+            f"LoRA spec/params mismatch: adapter rank(s) {sorted(ranks)} "
+            f"in params vs spec rank {spec.rank}")
+
+
 def is_lora_param(path) -> bool:
     """``path``: a tuple of str keys (flatten_dict convention)."""
     return path[-1] in ("lora_a", "lora_b")

@@ -200,6 +200,43 @@ class TestMergeAndServe:
         with pytest.raises(ValueError, match="lora_a"):
             merge_lora({"kernel": jnp.ones((4, 4))}, LoraSpec(rank=2))
 
+    def test_adapter_tree_without_config_rejected(self):
+        """generate must refuse to silently serve the un-adapted base."""
+        from tensorflow_train_distributed_tpu.models.generate import (
+            generate,
+        )
+
+        cfg = _cfg(spec=LoraSpec(rank=2))
+        params = _plain(CausalLmTask(cfg).init_variables(
+            jax.random.key(0), _batch(cfg))["params"])
+        with pytest.raises(ValueError, match="merge_lora"):
+            generate(LLAMA_PRESETS["llama_tiny"], params,
+                     jnp.zeros((1, 4), jnp.int32), 2)
+
+    def test_serving_spec_mismatch_rejected(self):
+        """A narrower serving spec would silently drop adapters."""
+        from tensorflow_train_distributed_tpu.models.generate import (
+            generate,
+        )
+
+        train_spec = LoraSpec(rank=2, targets=("query", "value", "wo"))
+        cfg = _cfg(spec=train_spec)
+        params = _plain(CausalLmTask(cfg).init_variables(
+            jax.random.key(0), _batch(cfg))["params"])
+        serve_cfg = _cfg(spec=LoraSpec(rank=2))  # query,value only
+        with pytest.raises(ValueError, match="mismatch"):
+            generate(serve_cfg, params, jnp.zeros((1, 4), jnp.int32), 2)
+
+    def test_spec_sidecar_round_trip(self, tmp_path):
+        from tensorflow_train_distributed_tpu.models.lora import (
+            load_spec, save_spec,
+        )
+
+        spec = LoraSpec(rank=3, alpha=7.5, targets=("out", "wo"))
+        save_spec(str(tmp_path), spec)
+        assert load_spec(str(tmp_path)) == spec
+        assert load_spec(str(tmp_path / "nope")) is None
+
 
 class TestValidation:
     def test_unknown_target_rejected(self):
@@ -231,6 +268,55 @@ class TestValidation:
                              capture_output=True, text=True, timeout=300)
         assert out.returncode != 0
         assert "LoRA" in (out.stderr + out.stdout)
+
+
+def test_cli_lora_checkpoint_serve_and_export(tmp_path):
+    """Full LoRA lifecycle through the real CLIs: train w/ checkpoint →
+    sample with the spec (unmerged) → sample WITHOUT the spec fails
+    loudly → export merges adapters into a loadable HF model."""
+    import subprocess
+    import sys
+
+    ck = str(tmp_path / "ck")
+    out = subprocess.run(
+        [sys.executable, "-m", "tensorflow_train_distributed_tpu",
+         "--config", "llama_tiny_sft", "--strategy", "dp", "--steps", "3",
+         "--platform", "cpu", "--lora-rank", "2",
+         "--checkpoint-dir", ck, "--checkpoint-every", "3"],
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stderr or out.stdout)[-1200:]
+
+    sample = [sys.executable, "tools/sample.py", "--config",
+              "llama_tiny_sft", "--checkpoint-dir", ck, "--prompt",
+              "1,2,3", "--max-new", "4", "--platform", "cpu"]
+    ok = subprocess.run(sample + ["--lora-rank", "2"],
+                        capture_output=True, text=True, timeout=600)
+    assert ok.returncode == 0, (ok.stderr or ok.stdout)[-1200:]
+    assert '"completion"' in ok.stdout
+
+    # No flags needed: the checkpoint is self-describing (lora_spec.json
+    # sidecar) and the completions are identical.
+    auto = subprocess.run(sample, capture_output=True, text=True,
+                          timeout=600)
+    assert auto.returncode == 0, (auto.stderr or auto.stdout)[-1200:]
+    assert auto.stdout == ok.stdout
+
+    # Flags that CONTRADICT the sidecar fail loudly.
+    bad = subprocess.run(
+        sample + ["--lora-rank", "2", "--lora-targets", "query,value,wo"],
+        capture_output=True, text=True, timeout=600)
+    assert bad.returncode != 0
+    assert "lora_spec.json" in (bad.stderr + bad.stdout)
+
+    hf_out = str(tmp_path / "hf")
+    exp = subprocess.run(
+        [sys.executable, "tools/export_hf_checkpoint.py", "--config",
+         "llama_tiny_sft", "--checkpoint-dir", ck, "--out", hf_out],
+        capture_output=True, text=True, timeout=600)
+    assert exp.returncode == 0, (exp.stderr or exp.stdout)[-1200:]
+    import os
+
+    assert os.path.exists(os.path.join(hf_out, "config.json"))
 
 
 def test_cli_lora_end_to_end():

@@ -24,13 +24,19 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True)
     p.add_argument("--platform", default="cpu",
                    help="jax platform for the restore ('' = default)")
+    p.add_argument("--lora-alpha", type=float, default=16.0,
+                   help="the --lora-alpha the checkpoint was TRAINED "
+                        "with (checkpoints carrying adapters are merged "
+                        "before export; alpha is not recoverable from "
+                        "the weights)")
     args = p.parse_args(argv)
     from tensorflow_train_distributed_tpu.models.export_hf import (
         export_hf_from_registry,
     )
 
     out = export_hf_from_registry(args.config, args.checkpoint_dir,
-                                  args.out, platform=args.platform)
+                                  args.out, platform=args.platform,
+                                  lora_alpha=args.lora_alpha)
     print(f"HF checkpoint written to {out}")
     return 0
 

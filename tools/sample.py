@@ -47,6 +47,14 @@ def main(argv=None) -> int:
                    help="'int8': post-training weight-only quantization "
                         "(models.quant) before sampling — halves decode "
                         "weight HBM traffic vs bf16")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="serve a LoRA checkpoint: the rank/alpha/targets "
+                        "it was TRAINED with (adapters applied unmerged; "
+                        "generate refuses adapter-bearing trees without "
+                        "this). Composing with --quant requires merging "
+                        "via tools/export_hf_checkpoint.py instead")
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--lora-targets", default="query,value")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
     args = p.parse_args(argv)
@@ -124,6 +132,32 @@ def main(argv=None) -> int:
         mgr.close()
         if params is None:
             raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+
+    import dataclasses as _dc
+
+    from tensorflow_train_distributed_tpu.models.lora import (
+        LoraSpec, load_spec, validate_targets,
+    )
+
+    sidecar = (load_spec(args.checkpoint_dir)
+               if args.checkpoint_dir else None)
+    spec = None
+    if args.lora_rank:
+        try:
+            spec = LoraSpec(
+                rank=args.lora_rank, alpha=args.lora_alpha,
+                targets=validate_targets(args.lora_targets.split(",")))
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if sidecar is not None and spec != sidecar:
+            raise SystemExit(
+                f"--lora-* flags {spec} disagree with the checkpoint's "
+                f"persisted lora_spec.json {sidecar} — drop the flags "
+                "(the sidecar is authoritative) or fix them")
+    elif sidecar is not None:
+        spec = sidecar  # self-describing checkpoint
+    if spec is not None:
+        cfg = _dc.replace(cfg, lora=spec)
 
     quant_scales = None
     if args.quant:
