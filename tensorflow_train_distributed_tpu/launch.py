@@ -723,6 +723,17 @@ def run(args: argparse.Namespace) -> RunResult:
             )
 
             save_spec(args.checkpoint_dir, spec)
+    elif args.checkpoint_dir:
+        from tensorflow_train_distributed_tpu.models.lora import load_spec
+
+        stale = load_spec(args.checkpoint_dir)
+        if stale is not None:
+            raise SystemExit(
+                f"--checkpoint-dir carries lora_spec.json ({stale}) from "
+                "a LoRA run, but this run has no --lora-rank: pass the "
+                "matching --lora-* flags to resume it, or use a fresh "
+                "checkpoint dir (a stale sidecar would make sample.py "
+                "mis-serve the new checkpoint)")
     if args.bleu_eval > 0:
         # Fail at launch, not after a multi-hour run completes.
         from tensorflow_train_distributed_tpu.models import transformer as tr

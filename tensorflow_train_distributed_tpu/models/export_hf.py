@@ -185,11 +185,7 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
         import jax as _jax
 
         from tensorflow_train_distributed_tpu.models.lora import (
-            LoraSpec, merge_lora,
-        )
-
-        from tensorflow_train_distributed_tpu.models.lora import (
-            check_spec_matches, load_spec,
+            LoraSpec, check_spec_matches, load_spec, merge_lora,
         )
 
         sidecar = (load_spec(checkpoint_dir)
@@ -199,11 +195,15 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
         elif config.lora is not None:
             spec = config.lora
         else:
-            rank = next(
-                v.shape[-1]
-                for p, v in _jax.tree_util.tree_flatten_with_path(params)[0]
-                if getattr(p[-1], "key", None) == "lora_a")
-            spec = LoraSpec(rank=rank, alpha=lora_alpha)
+            # Pre-sidecar checkpoint: rank AND targets are recoverable
+            # from the adapter leaves; only alpha must come from the CLI.
+            flat = _jax.tree_util.tree_flatten_with_path(params)[0]
+            rank = next(v.shape[-1] for p, v in flat
+                        if getattr(p[-1], "key", None) == "lora_a")
+            targets = tuple(sorted({
+                p[-2].key for p, _ in flat
+                if getattr(p[-1], "key", None) == "lora_a"}))
+            spec = LoraSpec(rank=rank, alpha=lora_alpha, targets=targets)
         check_spec_matches(params, spec)
         params = merge_lora(params, spec)
     return export_llama(config, params, out_dir)

@@ -103,7 +103,7 @@ def check_hbm_budget(n_params: int, n_layers: int, d_model: int,
 
 
 def timed_step_seconds(step, state, dev_batch, warmup: int,
-                       iters: int) -> float:
+                       iters: int, trace_dir: str = "") -> float:
     """Shared measure loop: warmup, then a timed window; mean step s.
 
     The warmup FETCHES the step metrics (host transfer), not just
@@ -112,6 +112,11 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
     100x-roofline artifact exactly this way).  After one real fetch the
     block path reflects device time, so the timed loop keeps the cheap
     block — the chained state dependency forces each step anyway.
+
+    ``trace_dir``: capture an XPlane trace of the TIMED window (post-
+    warmup steady state) — one measure loop serves bench and profiling
+    (the step donates its state buffers, so a second loop on the same
+    state would hit deleted buffers).
     """
     import jax
     import numpy as np
@@ -121,16 +126,30 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
         state, m = step(state, dev_batch)
         jax.tree.map(np.asarray, m)
     jax.block_until_ready(state)
-    t0 = _time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, dev_batch)
-    jax.block_until_ready(m)
-    return (_time.perf_counter() - t0) / iters
+    if trace_dir:
+        from tensorflow_train_distributed_tpu.runtime.profiling import (
+            start_trace, stop_trace,
+        )
+
+        start_trace(trace_dir)
+    try:
+        # Timestamps INSIDE the trace window: start_trace is before t0
+        # and stop_trace (XPlane serialization, 100s of ms) after t1, so
+        # profiling never inflates the reported step time.
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, dev_batch)
+        jax.block_until_ready(m)
+        t1 = _time.perf_counter()
+    finally:
+        if trace_dir:
+            stop_trace()
+    return (t1 - t0) / iters
 
 
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
              remat=None, remat_policy=None, force_hbm: bool = False,
-             sliding_window: int = 0):
+             sliding_window: int = 0, profile_dir: str = ""):
     import jax
     import numpy as np
     import optax
@@ -194,7 +213,10 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
     n_params = param_count(state.params)
     step = trainer._compiled_train_step()
     dev_batch = shard_batch(mesh, data)
-    dt = timed_step_seconds(step, state, dev_batch, warmup, iters)
+    # profile_dir: XPlane trace of the timed window — the decoder analog
+    # of bench.py's ResNet traces (render: tools/profile_summary.py).
+    dt = timed_step_seconds(step, state, dev_batch, warmup, iters,
+                            trace_dir=profile_dir)
     tok_per_sec_chip = global_batch * seq / dt / n_chips
     dev0 = mesh.devices.flat[0]
     # Average attended context per token: seq/2 causal; a binding
@@ -238,6 +260,9 @@ def main(argv=None) -> int:
                         "attention (O(seq*window) chunked path) — A/B "
                         "vs full attention; 0 = preset default")
     p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--profile-dir", default="",
+                   help="capture an XPlane trace of the timed steps into "
+                        "this dir (render: tools/profile_summary.py)")
     p.add_argument("--seq", type=int, default=2048)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--iters", type=int, default=10)
@@ -280,7 +305,8 @@ def main(argv=None) -> int:
                            args.warmup, args.iters, remat=args.remat,
                            remat_policy=args.remat_policy,
                            force_hbm=args.force_hbm,
-                           sliding_window=args.sliding_window)
+                           sliding_window=args.sliding_window,
+                           profile_dir=args.profile_dir)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"
                           "_per_chip", "value": 0.0,

@@ -131,6 +131,13 @@ STEPS = [
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_350m",
       "--batch-per-chip", "4", "--seq", "2048",
       "--remat", "--remat-policy", "no_ffn", "--iters", "10"]),
+    # Decoder step-time breakdown: the committed trace feeding the next
+    # MFU push (where do the 502 ms go at 125m/no_ffn?).
+    ("lm_profile", 700,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
+      "--batch-per-chip", "8", "--seq", "2048",
+      "--remat", "--remat-policy", "no_ffn", "--iters", "8",
+      "--profile-dir", "profiles/bench/llama_125m_noffn"]),
     # BERT re-capture only if the early-session number needs refreshing;
     # cheap with a warm compile cache, lowest priority.
     ("bert", 480,

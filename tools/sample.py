@@ -142,6 +142,13 @@ def main(argv=None) -> int:
     sidecar = (load_spec(args.checkpoint_dir)
                if args.checkpoint_dir else None)
     spec = None
+    flags_given = (args.lora_alpha != 16.0
+                   or args.lora_targets != "query,value")
+    if flags_given and not args.lora_rank:
+        raise SystemExit(
+            "--lora-alpha/--lora-targets need --lora-rank too (a lone "
+            "flag would be silently dropped in favor of the checkpoint's "
+            "lora_spec.json)")
     if args.lora_rank:
         try:
             spec = LoraSpec(
