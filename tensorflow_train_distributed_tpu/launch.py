@@ -719,9 +719,17 @@ def run(args: argparse.Namespace) -> RunResult:
             # weights, and serving/merging with a retyped-wrong spec is
             # silent corruption — sample.py / export read this sidecar.
             from tensorflow_train_distributed_tpu.models.lora import (
-                save_spec,
+                load_spec, save_spec,
             )
 
+            prior = load_spec(args.checkpoint_dir)
+            if prior is not None and prior != spec:
+                # A resume with mistyped flags must not silently rewrite
+                # the authoritative record (alpha shape-checks nothing).
+                raise SystemExit(
+                    f"--lora-* flags {spec} disagree with the existing "
+                    f"lora_spec.json {prior} in --checkpoint-dir — fix "
+                    "the flags to resume, or use a fresh dir")
             save_spec(args.checkpoint_dir, spec)
     elif args.checkpoint_dir:
         from tensorflow_train_distributed_tpu.models.lora import load_spec
@@ -856,9 +864,18 @@ def run(args: argparse.Namespace) -> RunResult:
                 LlamaConfig,
             )
 
+            from tensorflow_train_distributed_tpu.models.moe import (
+                MoeConfig,
+            )
+
             task_cfg = getattr(task, "config", None)
             sample = None
-            if isinstance(task_cfg, LlamaConfig):
+            if isinstance(task_cfg, MoeConfig):
+                # Mixtral (sparse-MoE) checkpoints; capacity_factor E/k
+                # on import makes routing exactly HF's (import_hf).
+                hf_cfg, hf_params = import_hf.import_mixtral(
+                    args.init_from_hf, config=task_cfg)
+            elif isinstance(task_cfg, LlamaConfig):
                 # The task's config decides the param-tree layout (scan
                 # vs per-layer) and validates dims vs the checkpoint.
                 hf_cfg, hf_params = import_hf.import_llama(
@@ -891,8 +908,9 @@ def run(args: argparse.Namespace) -> RunResult:
                 trainer.task = task
             else:
                 raise SystemExit(
-                    f"--init-from-hf supports Llama- and BERT-family "
-                    f"--config; {args.config!r} is neither")
+                    f"--init-from-hf supports Llama-, Mixtral- and "
+                    f"BERT-family --config; {args.config!r} is none of "
+                    "these")
             if sample is None:
                 sample = next(iter(loader))
             state = trainer.create_state(sample, params=hf_params)

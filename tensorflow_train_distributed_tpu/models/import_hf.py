@@ -308,3 +308,141 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
         config = dataclasses.replace(config, **config_overrides)
     params = import_llama_state_dict(model_or_path.state_dict(), config)
     return config, params
+
+
+def _validate_hf_mixtral(hf_config) -> None:
+    """Exact-or-rejected guards — run on EVERY import path, including
+    the CLI's config=task_cfg route (which skips config derivation)."""
+    if getattr(hf_config, "model_type", "") != "mixtral":
+        raise ValueError(
+            f"expected model_type='mixtral', got "
+            f"{getattr(hf_config, 'model_type', None)!r}")
+    if getattr(hf_config, "sliding_window", None):
+        raise ValueError(
+            "checkpoint sets sliding_window; the native MoE attention is "
+            "full-causal — importing would silently change logits "
+            "(Mixtral-8x7B weights are trained/served full-attention; "
+            "re-export the checkpoint with sliding_window=null)")
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("rope_scaling is not implemented natively")
+
+
+def config_from_hf_mixtral(hf_config) -> "MoeConfig":
+    """Derive a native ``MoeConfig`` from a HF ``MixtralConfig``.
+
+    ``capacity_factor`` defaults to ``num_experts / top_k``: with that
+    capacity no token can ever be dropped (each token lands on at most
+    one slot per expert), so the GShard capacity dispatch computes
+    EXACTLY HF's dense top-k renormalized mixture — the forward-parity
+    contract.  Production fine-tunes may lower it afterwards.
+    """
+    from tensorflow_train_distributed_tpu.models.moe import MoeConfig
+
+    _validate_hf_mixtral(hf_config)
+    e = hf_config.num_local_experts
+    k = hf_config.num_experts_per_tok
+    return MoeConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=hf_config.num_key_value_heads,
+        ffn_size=hf_config.intermediate_size,
+        num_experts=e,
+        top_k=k,
+        capacity_factor=float(e) / float(k),
+        moe_every=1,
+        max_positions=hf_config.max_position_embeddings,
+        rope_base=hf_config.rope_theta,
+        rms_epsilon=hf_config.rms_norm_eps,
+    )
+
+
+def _mixtral_layer_tree(sd, i: int, num_experts: int) -> dict:
+    """One Mixtral decoder layer → native MoeDecoderBlock param tree.
+
+    HF expert weights: ``w1`` = gate, ``w3`` = up, ``w2`` = down (torch
+    [out, in] → transpose), stacked over the expert axis exactly like
+    the native ``nn.vmap`` layout.  Router ``gate.weight`` [E, d] → the
+    f32 router kernel [d, E].
+    """
+    p = f"model.layers.{i}."
+    moe = p + "block_sparse_moe."
+    def expert(e, w):
+        return _np(sd[moe + f"experts.{e}.{w}.weight"]).T
+
+    return {
+        "attn_norm": {"scale": _np(sd[p + "input_layernorm.weight"])},
+        "attention": {
+            "query": {"kernel": _np(sd[p + "self_attn.q_proj.weight"]).T},
+            "key": {"kernel": _np(sd[p + "self_attn.k_proj.weight"]).T},
+            "value": {"kernel": _np(sd[p + "self_attn.v_proj.weight"]).T},
+            "out": {"kernel": _np(sd[p + "self_attn.o_proj.weight"]).T},
+        },
+        "mlp_norm": {"scale": _np(sd[p + "post_attention_layernorm.weight"])},
+        "moe": {
+            "router": {"kernel": _np(sd[moe + "gate.weight"]).T},
+            "experts": {
+                "wi_gate": {"kernel": np.stack(
+                    [expert(e, "w1") for e in range(num_experts)])},
+                "wi_up": {"kernel": np.stack(
+                    [expert(e, "w3") for e in range(num_experts)])},
+                "wo": {"kernel": np.stack(
+                    [expert(e, "w2") for e in range(num_experts)])},
+            },
+        },
+    }
+
+
+def import_mixtral_state_dict(state_dict, config) -> dict:
+    """HF ``MixtralForCausalLM`` state dict → native ``MoeLmModel``
+    params (per-layer ``layer_{i}`` modules — the MoE stack is a Python
+    loop, not a depth scan)."""
+    sd = state_dict
+    embed = _np(sd["model.embed_tokens.weight"])
+    if embed.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"checkpoint embed is {embed.shape}, config expects "
+            f"{(config.vocab_size, config.d_model)}")
+    # Two-sided layer-count check (the llama importer's lesson): a
+    # deeper checkpoint must not silently truncate, a shallower one must
+    # fail HERE, not with an opaque KeyError mid-mapping.
+    def _has_layer(i):
+        return f"model.layers.{i}.input_layernorm.weight" in sd
+
+    if _has_layer(config.num_layers) or not _has_layer(
+            config.num_layers - 1):
+        n = 0
+        while _has_layer(n):
+            n += 1
+        raise ValueError(
+            f"checkpoint has {n} decoder layers, config expects "
+            f"{config.num_layers}")
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T
+    else:
+        lm_head = embed.T.copy()
+    params = {
+        "token_embed": {"embedding": embed},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+        "lm_head": {"kernel": lm_head},
+    }
+    for i in range(config.num_layers):
+        params[f"layer_{i}"] = _mixtral_layer_tree(sd, i,
+                                                   config.num_experts)
+    return params
+
+
+def import_mixtral(model_or_path, config=None, **config_overrides):
+    """(native MoeConfig, params) from an HF Mixtral model or local path."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
+    _validate_hf_mixtral(model_or_path.config)  # every path, config= too
+    if config is None:
+        config = config_from_hf_mixtral(model_or_path.config)
+    if config_overrides:
+        config = dataclasses.replace(config, **config_overrides)
+    params = import_mixtral_state_dict(model_or_path.state_dict(), config)
+    return config, params

@@ -433,3 +433,103 @@ class TestExportHf:
         with pytest.raises(SystemExit, match="Llama-family"):
             export_hf_from_registry("mnist", None, tmp_path / "x",
                                     platform="")
+
+
+class TestMixtralImport:
+    """HF MixtralForCausalLM (sparse MoE, top-2 of E experts) → native
+    MoeLmModel, forward-parity vs torch.  The import sets
+    capacity_factor = E/top_k, at which the GShard capacity dispatch can
+    never drop a token — so it computes exactly HF's dense renormalized
+    top-2 mixture."""
+
+    @pytest.fixture(scope="class")
+    def hf_mixtral(self):
+        cfg = transformers.MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=128,
+            rms_norm_eps=1e-5, rope_theta=10_000.0,
+            sliding_window=None, tie_word_embeddings=False,
+        )
+        torch.manual_seed(5)
+        model = transformers.MixtralForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def test_config_derivation(self, hf_mixtral):
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            config_from_hf_mixtral,
+        )
+
+        cfg = config_from_hf_mixtral(hf_mixtral.config)
+        assert cfg.num_experts == 4 and cfg.top_k == 2
+        assert cfg.capacity_factor == 2.0  # E/k: the no-drop guarantee
+        assert cfg.moe_every == 1
+
+    def test_sliding_window_checkpoint_rejected(self, hf_mixtral):
+        import copy
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            config_from_hf_mixtral,
+        )
+
+        bad = copy.deepcopy(hf_mixtral.config)
+        bad.sliding_window = 64
+        with pytest.raises(ValueError, match="sliding_window"):
+            config_from_hf_mixtral(bad)
+
+    def test_forward_parity(self, hf_mixtral):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_mixtral,
+        )
+        from tensorflow_train_distributed_tpu.models.moe import MoeLmModel
+
+        cfg, params = import_mixtral(hf_mixtral, remat=False,
+                                     dtype=jnp.float32)
+        rng = np.random.default_rng(11)
+        tokens = rng.integers(0, 256, (2, 24)).astype(np.int32)
+        with torch.no_grad():
+            want = hf_mixtral(torch.asarray(tokens)).logits.float().numpy()
+        got = np.asarray(MoeLmModel(cfg).apply(
+            {"params": params}, tokens).astype(np.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+        # Router really routes: a lower capacity (drops possible) changes
+        # outputs, proving the parity above exercised the dispatch path.
+        tight = dataclasses.replace(cfg, capacity_factor=0.25)
+        dropped = np.asarray(MoeLmModel(tight).apply(
+            {"params": params}, tokens).astype(np.float32))
+        assert not np.allclose(got, dropped, atol=1e-4)
+
+    def test_training_continues_from_import(self, hf_mixtral, mesh8):
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_mixtral,
+        )
+        from tensorflow_train_distributed_tpu.models.moe import MoeLmTask
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            shard_batch,
+        )
+        from tensorflow_train_distributed_tpu.training import (
+            Trainer, TrainerConfig,
+        )
+
+        cfg, params = import_mixtral(hf_mixtral, dtype=jnp.float32)
+        task = MoeLmTask(cfg)
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": rng.integers(0, 256, (8, 16)).astype(np.int32),
+            "targets": rng.integers(0, 256, (8, 16)).astype(np.int32),
+        }
+        trainer = Trainer(task, optax.adamw(1e-4), mesh8,
+                          config=TrainerConfig(log_every=1_000_000))
+        state = trainer.create_state(batch, params=params)
+        step = trainer._compiled_train_step()
+        state, metrics = step(state, shard_batch(mesh8, batch))
+        assert np.isfinite(float(metrics["loss"]))

@@ -126,14 +126,18 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
         state, m = step(state, dev_batch)
         jax.tree.map(np.asarray, m)
     jax.block_until_ready(state)
+    import contextlib
+
     if trace_dir:
         from tensorflow_train_distributed_tpu.runtime.profiling import (
-            start_trace, stop_trace,
+            trace,
         )
 
-        start_trace(trace_dir)
-    try:
-        # Timestamps INSIDE the trace window: start_trace is before t0
+        cm = trace(trace_dir)
+    else:
+        cm = contextlib.nullcontext()
+    with cm:
+        # Timestamps INSIDE the trace window: start_trace runs before t0
         # and stop_trace (XPlane serialization, 100s of ms) after t1, so
         # profiling never inflates the reported step time.
         t0 = _time.perf_counter()
@@ -141,9 +145,6 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
             state, m = step(state, dev_batch)
         jax.block_until_ready(m)
         t1 = _time.perf_counter()
-    finally:
-        if trace_dir:
-            stop_trace()
     return (t1 - t0) / iters
 
 

@@ -53,8 +53,10 @@ def main(argv=None) -> int:
                         "generate refuses adapter-bearing trees without "
                         "this). Composing with --quant requires merging "
                         "via tools/export_hf_checkpoint.py instead")
-    p.add_argument("--lora-alpha", type=float, default=16.0)
-    p.add_argument("--lora-targets", default="query,value")
+    p.add_argument("--lora-alpha", type=float, default=None,
+                   help="default: the sidecar's, else 16.0")
+    p.add_argument("--lora-targets", default=None,
+                   help="default: the sidecar's, else query,value")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
     args = p.parse_args(argv)
@@ -142,8 +144,8 @@ def main(argv=None) -> int:
     sidecar = (load_spec(args.checkpoint_dir)
                if args.checkpoint_dir else None)
     spec = None
-    flags_given = (args.lora_alpha != 16.0
-                   or args.lora_targets != "query,value")
+    flags_given = (args.lora_alpha is not None
+                   or args.lora_targets is not None)
     if flags_given and not args.lora_rank:
         raise SystemExit(
             "--lora-alpha/--lora-targets need --lora-rank too (a lone "
@@ -152,8 +154,12 @@ def main(argv=None) -> int:
     if args.lora_rank:
         try:
             spec = LoraSpec(
-                rank=args.lora_rank, alpha=args.lora_alpha,
-                targets=validate_targets(args.lora_targets.split(",")))
+                rank=args.lora_rank,
+                alpha=(16.0 if args.lora_alpha is None
+                       else args.lora_alpha),
+                targets=validate_targets(
+                    ("query,value" if args.lora_targets is None
+                     else args.lora_targets).split(",")))
         except ValueError as e:
             raise SystemExit(str(e))
         if sidecar is not None and spec != sidecar:
