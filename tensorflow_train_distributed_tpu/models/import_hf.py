@@ -418,6 +418,20 @@ def import_mixtral_state_dict(state_dict, config) -> dict:
         raise ValueError(
             f"checkpoint has {n} decoder layers, config expects "
             f"{config.num_layers}")
+
+    def _has_expert(e):
+        return (f"model.layers.0.block_sparse_moe.experts.{e}.w1.weight"
+                in sd)
+
+    if _has_expert(config.num_experts) or not _has_expert(
+            config.num_experts - 1):
+        n = 0
+        while _has_expert(n):
+            n += 1
+        raise ValueError(
+            f"checkpoint has {n} experts per layer, config expects "
+            f"{config.num_experts} (a mismatch would truncate experts "
+            "or KeyError mid-mapping)")
     if "lm_head.weight" in sd:
         lm_head = _np(sd["lm_head.weight"]).T
     else:
@@ -442,6 +456,16 @@ def import_mixtral(model_or_path, config=None, **config_overrides):
     _validate_hf_mixtral(model_or_path.config)  # every path, config= too
     if config is None:
         config = config_from_hf_mixtral(model_or_path.config)
+    elif "capacity_factor" not in config_overrides:
+        # The parity contract holds only at capacity E/k (no drops) —
+        # a preset's production capacity_factor (e.g. 1.25) would drop
+        # tokens from step 0 and silently diverge from the HF forward.
+        # Callers who explicitly want a tighter capacity pass it as an
+        # override.
+        hf = model_or_path.config
+        config = dataclasses.replace(
+            config, capacity_factor=(
+                float(hf.num_local_experts) / hf.num_experts_per_tok))
     if config_overrides:
         config = dataclasses.replace(config, **config_overrides)
     params = import_mixtral_state_dict(model_or_path.state_dict(), config)

@@ -160,6 +160,13 @@ class MultiHeadAttention(nn.Module):
     # prefill (q_len = prompt length) and stepping (q_len = 1) alike.
     decode: bool = False
     cache_len: int = 0
+    # int8 KV cache (decode only, linear cache): rows quantize per
+    # (position, kv_head) with an f32 scale — halves cache HBM vs bf16
+    # (cache reads dominate large-batch/long-context decode) and the
+    # dequant fuses into the attention einsum's read.  Unsupported with
+    # the rolling window cache (roll/concat would need scale plumbing;
+    # the window already bounds cache memory).
+    kv_cache_int8: bool = False
     # Projection biases (BERT-style encoders; Llama-family stays False).
     use_bias: bool = False
 
@@ -298,6 +305,12 @@ class MultiHeadAttention(nn.Module):
                 f"got window={self.window}")
         rolling = (self.window is not None
                    and self.cache_len > self.window)
+        if self.kv_cache_int8 and (rolling or self.sinks):
+            raise ValueError(
+                "kv_cache_int8 supports the LINEAR cache only (the "
+                "rolling window ring / sink buffers would need scale "
+                "plumbing through roll/concat, and the window already "
+                "bounds cache memory)")
         cache_rows = self.window if rolling else self.cache_len
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
@@ -311,12 +324,19 @@ class MultiHeadAttention(nn.Module):
         k = self._proj(x, kv_heads, "key")
         v = self._proj(x, kv_heads, "value")
 
+        cache_dtype = jnp.int8 if self.kv_cache_int8 else self.dtype
         cache_k = self.variable(
             "cache", "key_cache", jnp.zeros,
-            (b, cache_rows, kv_heads, self.head_dim), self.dtype)
+            (b, cache_rows, kv_heads, self.head_dim), cache_dtype)
         cache_v = self.variable(
             "cache", "value_cache", jnp.zeros,
-            (b, cache_rows, kv_heads, self.head_dim), self.dtype)
+            (b, cache_rows, kv_heads, self.head_dim), cache_dtype)
+        if self.kv_cache_int8:
+            # One f32 scale per (batch, row, kv_head): symmetric over the
+            # head_dim — the standard per-token KV quantization grain.
+            kv_scales = self.variable(
+                "cache", "kv_scales", jnp.zeros,
+                (2, b, cache_rows, kv_heads), jnp.float32)
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((), jnp.int32))
         cur = index.value
@@ -365,10 +385,37 @@ class MultiHeadAttention(nn.Module):
                     axis=1)
             return self._cache_attend(q, kc, vc, mask[None, None],
                                       kv_heads, b, q_len, x.shape[-1])
-        cache_k.value = jax.lax.dynamic_update_slice(
-            cache_k.value, k.astype(kdt), (0, cur, 0, 0))
-        cache_v.value = jax.lax.dynamic_update_slice(
-            cache_v.value, v.astype(kdt), (0, cur, 0, 0))
+        if self.kv_cache_int8:
+            # Quantize this call's rows: amax over head_dim per
+            # (batch, position, kv_head).
+            def quantize(t):
+                amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1)
+                scale = jnp.where(amax > 0, amax / 127.0, 1.0)  # [b,q,h]
+                qt = jnp.clip(jnp.round(
+                    t.astype(jnp.float32) / scale[..., None]),
+                    -127, 127).astype(jnp.int8)
+                return qt, scale
+
+            qk, sk = quantize(k)
+            qv, sv = quantize(v)
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, qk, (0, cur, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, qv, (0, cur, 0, 0))
+            kv_scales.value = jax.lax.dynamic_update_slice(
+                kv_scales.value, jnp.stack([sk, sv]), (0, 0, cur, 0))
+            # Dequant at read: XLA fuses the convert+multiply into the
+            # attention einsum's cache read (int8 bytes off HBM).
+            kc = (cache_k.value.astype(self.dtype)
+                  * kv_scales.value[0][..., None].astype(self.dtype))
+            vc = (cache_v.value.astype(self.dtype)
+                  * kv_scales.value[1][..., None].astype(self.dtype))
+        else:
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(kdt), (0, cur, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(kdt), (0, cur, 0, 0))
+            kc, vc = cache_k.value, cache_v.value
         kv_pos = jnp.arange(cache_rows)
         mask = kv_pos[None, :] <= positions[:, None]   # [q, cache]
         if self.window is not None:
@@ -378,7 +425,7 @@ class MultiHeadAttention(nn.Module):
             if self.sinks:
                 band = jnp.logical_or(band, (kv_pos < self.sinks)[None, :])
             mask = jnp.logical_and(mask, band)
-        return self._cache_attend(q, cache_k.value, cache_v.value,
+        return self._cache_attend(q, kc, vc,
                                   mask[None, None], kv_heads, b, q_len,
                                   x.shape[-1])
 

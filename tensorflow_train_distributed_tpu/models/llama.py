@@ -85,6 +85,11 @@ class LlamaConfig:
     # the model under lora_scope; pair the optimizer with
     # lora.freeze_base.  None = full fine-tuning.
     lora: object = None
+    # int8 KV cache for decode (linear cache only): halves cache HBM
+    # traffic/footprint — the large-batch/long-context serving lever;
+    # per-(position, kv_head) scales, dequant fused into the attention
+    # read.  Training is unaffected (no cache there).
+    kv_cache_int8: bool = False
 
 
 LLAMA_PRESETS = {
@@ -179,6 +184,7 @@ class DecoderBlock(nn.Module):
             window=cfg.sliding_window, sinks=cfg.attention_sinks,
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
+            kv_cache_int8=cfg.kv_cache_int8,
             name="attention",
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,

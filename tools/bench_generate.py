@@ -30,7 +30,8 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
                    temperature: float = 0.0,
                    force_hbm: bool = False,
                    sliding_window: int = 0,
-                   quant: str = ""):
+                   quant: str = "",
+                   kv_cache_int8: bool = False):
     import dataclasses
     import time
 
@@ -46,6 +47,8 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         # library callers get the clean error, not ZeroDivisionError.
         raise ValueError(f"max_new must be >= 2, got {max_new}")
     cfg = llama.LLAMA_PRESETS[preset]
+    if kv_cache_int8:
+        cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     if sliding_window:
         # A/B the rolling window-sized KV cache against the preset's full
         # attention (cache rows = window instead of prompt+new).
@@ -73,8 +76,15 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     cache_rows = total_len
     if cfg.sliding_window and cfg.sliding_window < total_len:
         cache_rows = cfg.sliding_window  # rolling ring buffer
+    kv_itemsize = 1 if cfg.kv_cache_int8 else itemsize
     cache_bytes = (2 * cfg.num_layers * batch * cache_rows
-                   * kv_heads * (cfg.d_model // cfg.num_heads) * itemsize)
+                   * kv_heads * (cfg.d_model // cfg.num_heads)
+                   * kv_itemsize)
+    if cfg.kv_cache_int8:
+        # Plus the f32 per-(position, kv_head) scale buffers (2 per
+        # layer: k and v) — ~6% of the bf16 cache at head_dim 64, and
+        # they stream on every step just like the cache rows.
+        cache_bytes += 2 * cfg.num_layers * batch * cache_rows             * kv_heads * 4
     need = n_params * (itemsize + 4) + cache_bytes  # cast copy + f32 init
     budget = (hbm_budget_bytes(dev.device_kind)
               if dev.platform == "tpu" else None)
@@ -159,6 +169,8 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         rec["kv_cache_rows"] = cache_rows
     if quant:
         rec["quant"] = quant
+    if cfg.kv_cache_int8:
+        rec["kv_cache"] = "int8"
     bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
           if dev.platform == "tpu" else None)
     if bw is not None:
@@ -202,6 +214,10 @@ def main(argv=None) -> int:
                         "attention: decode keeps a rolling WINDOW-row "
                         "KV cache (A/B vs full attention; 0 = preset "
                         "default)")
+    p.add_argument("--kv-cache", default="", choices=["", "int8"],
+                   help="'int8': quantized KV cache (linear cache only) "
+                        "— halves cache HBM traffic, the large-batch "
+                        "decode lever")
     p.add_argument("--quant", default="", choices=["", "int8"],
                    help="'int8': weight-only int8 serving "
                         "(models.quant) — kernels stream from HBM at "
@@ -228,7 +244,8 @@ def main(argv=None) -> int:
                                  temperature=args.temperature,
                                  force_hbm=args.force_hbm,
                                  sliding_window=args.sliding_window,
-                                 quant=args.quant)
+                                 quant=args.quant,
+                                 kv_cache_int8=args.kv_cache == "int8")
     except Exception as e:
         print(json.dumps({
             "metric": f"{args.preset}_decode_tokens_per_sec_per_chip",

@@ -106,6 +106,12 @@ STEPS = [
      [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
       "--batch", "8", "--prompt-len", "128", "--max-new", "256",
       "--quant", "int8"]),
+    # int8 KV cache at the batch where cache reads bound the step
+    # (measured: b32 bf16 cache = 6.79 ms/step) — A/B against gen_b32.
+    ("gen_kv8_b32", 700,
+     [sys.executable, "tools/bench_generate.py", "--preset", "llama_125m",
+      "--batch", "32", "--prompt-len", "128", "--max-new", "256",
+      "--kv-cache", "int8"]),
     # Long-context levers (round-4 additions).  Window training pairs
     # with FULL remat: the chunked path's per-layer f32 score stacks
     # ([L,B,H,chunks,c,c+w]) OOM the chip if saved (measured 25 GB under
