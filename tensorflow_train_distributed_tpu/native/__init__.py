@@ -172,6 +172,9 @@ def _bind_signatures(lib: ctypes.CDLL) -> None:
     lib.ttd_ring_allreduce_f32.argtypes = [
         ctypes.c_void_p, f32p, ctypes.c_uint64]
     lib.ttd_ring_allreduce_f32.restype = ctypes.c_int
+    lib.ttd_ring_allreduce_q8_f32.argtypes = \
+        lib.ttd_ring_allreduce_f32.argtypes
+    lib.ttd_ring_allreduce_q8_f32.restype = ctypes.c_int
     lib.ttd_ring_broadcast.argtypes = [
         ctypes.c_void_p, u8p, ctypes.c_uint64, ctypes.c_int]
     lib.ttd_ring_broadcast.restype = ctypes.c_int

@@ -101,6 +101,15 @@ class HostRing(_NativeGroup):
         """Sum-allreduce; returns a new float32 array of ``x``'s shape."""
         return self._reduce_f32(self._lib.ttd_ring_allreduce_f32, x)
 
+    def allreduce_q8(self, x: np.ndarray) -> np.ndarray:
+        """Quantized sum-allreduce (EQuARX-style): int8 blocks + f32
+        scales on the wire — ~4x less traffic than f32, for the
+        bandwidth-scarce host/DCN path.  Approximate (per-hop
+        requantization in the reduce-scatter phase; error ~(W-1)·
+        max|partial|/254 per element) but BIT-CONSISTENT across ranks
+        (the all-gather forwards each owner's bytes verbatim)."""
+        return self._reduce_f32(self._lib.ttd_ring_allreduce_q8_f32, x)
+
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
         """Broadcast ``x`` (same shape/dtype everywhere) from ``root``."""
         self._require_handle()
