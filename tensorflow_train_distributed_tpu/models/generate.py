@@ -33,6 +33,20 @@ from tensorflow_train_distributed_tpu.models.quant import (
 )
 
 
+def _decode_model(config, cache_len: int):
+    """The decode-mode model for a decoder-family config: LlamaModel for
+    LlamaConfig, MoeLmModel for MoeConfig (Mixtral-style) — one generate
+    path serves every decoder family."""
+    from tensorflow_train_distributed_tpu.models.moe import (
+        MoeConfig,
+        MoeLmModel,
+    )
+
+    if isinstance(config, MoeConfig):
+        return MoeLmModel(config, decode=True, cache_len=cache_len)
+    return LlamaModel(config, decode=True, cache_len=cache_len)
+
+
 def cast_floating(params, dtype):
     """Cast floating leaves to ``dtype`` (inference precision).
 
@@ -108,12 +122,14 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
-    if config.lora is not None and quant_scales is not None:
+    from tensorflow_train_distributed_tpu.models.lora import spec_of
+
+    if spec_of(config) is not None and quant_scales is not None:
         raise ValueError(
             "int8 serving of a LoRA model needs the adapters folded in "
             "first: params = models.lora.merge_lora(params, spec), then "
             "quantize the merged tree with a lora=None config")
-    if config.lora is not None and has_lora_leaves(params):
+    if spec_of(config) is not None and has_lora_leaves(params):
         # Targets/rank must agree with the adapters actually present —
         # flax silently ignores unread leaves, so a narrower serving
         # spec would silently drop part of the fine-tune.
@@ -121,8 +137,8 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             check_spec_matches,
         )
 
-        check_spec_matches(params, config.lora)
-    if config.lora is None and has_lora_leaves(params):
+        check_spec_matches(params, spec_of(config))
+    if spec_of(config) is None and has_lora_leaves(params):
         # flax apply would silently IGNORE the extra adapter leaves and
         # serve the un-adapted base — the fine-tuning vanishing without
         # a trace is the worst possible failure mode here.
@@ -161,8 +177,8 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
     # Cache sized to the request, not max_positions: a 30-token generation
     # from a 4k-context config must not allocate (or attend over) 4k
     # cache rows per layer.
-    model = LlamaModel(config, decode=True,
-                       cache_len=prompt.shape[1] + max_new_tokens)
+    model = _decode_model(config,
+                          cache_len=prompt.shape[1] + max_new_tokens)
 
     def pick(logits, step_rng):
         logits = logits.astype(jnp.float32)
@@ -193,10 +209,11 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
         # inactive) int8 interceptor.  The two do not compose — generate
         # rejects that pairing up front.
         from tensorflow_train_distributed_tpu.models.lora import (
-            maybe_lora_scope,
+            maybe_lora_scope, spec_of,
         )
 
-        return maybe_lora_scope(config.lora, fallback=quantized_inference)
+        return maybe_lora_scope(spec_of(config),
+                                fallback=quantized_inference)
 
     # Prefill: whole prompt at once; next token comes from the last logit.
     with infer_ctx():

@@ -141,6 +141,13 @@ def lora_scope(spec: LoraSpec):
     return nn.intercept_methods(_make_interceptor(spec))
 
 
+def spec_of(config):
+    """The config's LoraSpec, or None — the ONE accessor for configs
+    that may lack the field entirely (MoeConfig has no LoRA support; a
+    scattered getattr at every touch point would mask typos)."""
+    return getattr(config, "lora", None)
+
+
 def maybe_lora_scope(spec, fallback=None):
     """``lora_scope(spec)`` when ``spec`` is set, else ``fallback()`` (or
     a nullcontext) — the one dispatch shared by the training task and

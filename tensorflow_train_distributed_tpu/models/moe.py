@@ -211,6 +211,11 @@ class MoEMlpBlock(nn.Module):
 class MoeDecoderBlock(nn.Module):
     config: MoeConfig
     use_moe: bool = True
+    # Autoregressive decode (models.generate): KV-cached attention; the
+    # MoE dispatch needs nothing special — at q_len 1 each group holds
+    # one token, capacity is >= 1 per expert, so routing never drops.
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -223,6 +228,8 @@ class MoeDecoderBlock(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, name="attention",
+            decode=self.decode,
+            cache_len=self.cache_len or cfg.max_positions,
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
@@ -243,10 +250,22 @@ class MoeLmModel(nn.Module):
     """
 
     config: MoeConfig = MoeConfig()
+    # models.generate contract (same as LlamaModel): decode=True adds
+    # the mutable "cache" collection, sized by cache_len.  Decode routes
+    # each step as a one-token group, so capacity NEVER binds there —
+    # cached decode equals the training-time forward exactly only while
+    # the training capacity doesn't bind either (the Mixtral-import E/k
+    # default guarantees that; a binding capacity_factor makes the
+    # full-sequence forward drop tokens decode would not, the same
+    # caveat as packed segments above).
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None):
         cfg = self.config
+        if segment_ids is not None and self.decode:
+            raise ValueError("decode mode does not take packed segments")
         if segment_ids is not None and positions is None:
             # Packed rows (llama-path contract): segment-masked attention
             # + RoPE positions restarting at each document boundary.
@@ -267,9 +286,12 @@ class MoeLmModel(nn.Module):
                     name="token_embed")(tokens)
         for i in range(cfg.num_layers):
             blk = MoeDecoderBlock
-            if cfg.remat:
+            if cfg.remat and not self.decode:
+                # No backward in decode, and KV-cache writes must not
+                # replay under a checkpoint.
                 blk = nn.remat(blk, prevent_cse=False)
             x = blk(cfg, use_moe=(i % cfg.moe_every == 0),
+                    decode=self.decode, cache_len=self.cache_len,
                     name=f"layer_{i}")(x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)

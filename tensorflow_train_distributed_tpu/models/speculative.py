@@ -43,13 +43,18 @@ from tensorflow_train_distributed_tpu.models.llama import (
 
 
 def _reject_config(name: str, cfg: LlamaConfig):
-    if cfg.sliding_window is not None:
+    if not isinstance(cfg, LlamaConfig):
+        raise ValueError(
+            f"{name} config is {type(cfg).__name__}; speculative decode "
+            "supports the Llama family only (MoE decode serves through "
+            "generate(), but draft/verify rollback is untested there)")
+    if getattr(cfg, "sliding_window", None) is not None:
         raise ValueError(
             f"{name} config uses sliding_window={cfg.sliding_window}: "
             "the rolling KV ring overwrites rows destructively, so "
             "speculative rollback (an index reset) is unsound — use "
             "full-attention configs")
-    if cfg.lora is not None:
+    if getattr(cfg, "lora", None) is not None:
         raise ValueError(
             f"{name} config carries LoRA adapters; merge them first "
             "(models.lora.merge_lora) — speculative decode serves plain "

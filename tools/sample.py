@@ -74,12 +74,14 @@ def main(argv=None) -> int:
     from tensorflow_train_distributed_tpu.models import registry
     from tensorflow_train_distributed_tpu.models.generate import generate
     from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
+    from tensorflow_train_distributed_tpu.models.moe import MoeLmTask
 
     task = registry.get_entry(args.config)["task_factory"]()
-    if not isinstance(task, CausalLmTask):
+    is_moe = isinstance(task, MoeLmTask)
+    if not isinstance(task, (CausalLmTask, MoeLmTask)):
         raise SystemExit(
             f"--config {args.config} is not a decoder LM; sampling needs "
-            "a llama-family config")
+            "a llama- or moe-family config")
     cfg = task.config
 
     rows = []
@@ -115,11 +117,18 @@ def main(argv=None) -> int:
     prompt = np.asarray(rows, np.int32)
 
     if args.init_from_hf:
-        from tensorflow_train_distributed_tpu.models.import_hf import (
-            import_llama,
-        )
+        if is_moe:
+            from tensorflow_train_distributed_tpu.models.import_hf import (
+                import_mixtral,
+            )
 
-        cfg, params = import_llama(args.init_from_hf, cfg)
+            cfg, params = import_mixtral(args.init_from_hf, cfg)
+        else:
+            from tensorflow_train_distributed_tpu.models.import_hf import (
+                import_llama,
+            )
+
+            cfg, params = import_llama(args.init_from_hf, cfg)
     else:
         from tensorflow_train_distributed_tpu.training.checkpoint import (
             CheckpointManager,
@@ -146,6 +155,12 @@ def main(argv=None) -> int:
     spec = None
     flags_given = (args.lora_alpha is not None
                    or args.lora_targets is not None)
+    if is_moe and (flags_given or args.lora_rank or sidecar is not None):
+        raise SystemExit(
+            "--lora-* applies to llama-family configs only (and this "
+            "checkpoint dir carries a lora_spec.json sidecar, which a "
+            "MoE config cannot serve)" if sidecar is not None else
+            "--lora-* applies to llama-family configs only")
     if flags_given and not args.lora_rank:
         raise SystemExit(
             "--lora-alpha/--lora-targets need --lora-rank too (a lone "
