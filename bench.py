@@ -31,8 +31,8 @@ carries every driver-designated metric, not just ResNet; ``input``
 (tools/bench_input, pure host — runs even on a CPU fallback) records the
 JPEG-ingest pipeline incl. the ship-raw-uint8 and native-libjpeg modes;
 ``gen`` (opt-in, tools/bench_generate) adds KV-cache decode throughput
-+ MBU; ``vit`` (opt-in, tools/bench_vit) the transformer-vision
-throughput.  The lm/bert
++ MBU; ``vit`` (tools/bench_vit, in the default list) the
+transformer-vision throughput.  The lm/bert
 families run as subprocesses: allocator isolation (a fresh HBM heap per
 family — in-process leftovers could push a fitting config over the
 budget) while inheriting the chip lock.  A jax.profiler trace is captured
@@ -325,8 +325,8 @@ def main(argv=None) -> int:
                         "time by default")
     p.add_argument("--families", default="resnet,lm,bert,vit,input",
                    help="model families in the emit: resnet (in-process "
-                        "headline) plus lm/bert subprocess benches (TPU "
-                        "only); opt-in: gen (decode), vit; "
+                        "headline) plus lm/bert/vit subprocess benches "
+                        "(TPU only); opt-in: gen (decode); "
                         "'input' = host JPEG-pipeline throughput "
                         "(pure CPU, runs even on fallback); 'gen' "
                         "(opt-in) adds KV-cache decode throughput + MBU")

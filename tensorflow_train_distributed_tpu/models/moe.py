@@ -68,6 +68,11 @@ MOE_PRESETS = {
     "mixtral_8x7b": MoeConfig(),
     "moe_1b": MoeConfig(d_model=1024, num_layers=8, num_heads=16,
                         num_kv_heads=4, ffn_size=4096, num_experts=8),
+    # Single-16GiB-chip bench point (~370M total / ~135M active params):
+    # the EP family's silicon number (tools/bench_moe.py).
+    "moe_370m": MoeConfig(d_model=768, num_layers=8, num_heads=12,
+                          num_kv_heads=4, ffn_size=2048, num_experts=8,
+                          top_k=2, max_positions=2048),
     "moe_tiny": MoeConfig(vocab_size=256, d_model=64, num_layers=2,
                           num_heads=4, num_kv_heads=2, ffn_size=128,
                           num_experts=4, top_k=2, max_positions=128,

@@ -176,3 +176,21 @@ def test_bench_input_cpu_smoke():
     assert rec["decode_modes"]["pil"] > 0
     if any(k.startswith("native") for k in rec["decode_modes"]):
         assert rec["decode_modes"]["native_t1"] > 0
+
+
+def test_bench_moe_cpu_smoke():
+    """MoE train-throughput tool: full jitted step on CPU, one JSON
+    record with active-param accounting."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "bench_moe.py"),
+         "--preset", "moe_tiny", "--batch-per-chip", "4", "--seq", "64",
+         "--iters", "2", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert 0 < rec["n_active_params"] < rec["n_params"]

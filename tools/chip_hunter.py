@@ -74,12 +74,8 @@ STEPS = [
       "--families", "resnet", "--warmup", "3", "--iters", "10",
       "--acquire-timeout", "60", "--probe-timeout", "45",
       "--bench-timeout", "400", "--no-cpu-fallback", "--no-persist"]),
-    # The round-3 BN-stats lever, never yet on silicon.
-    ("resnet_bnsub", 560,
-     [sys.executable, "bench.py", "--configs", "resnet50_s2d_bnsub",
-      "--families", "resnet", "--warmup", "3", "--iters", "10",
-      "--acquire-timeout", "60", "--probe-timeout", "45",
-      "--bench-timeout", "400", "--no-cpu-fallback", "--no-persist"]),
+    # (resnet50_s2d_bnsub was a queued step here until it was MEASURED
+    # and rejected on silicon: 2134 img/s vs s2d's 2436 — PROFILE.md.)
     # Decoder remat lever (VERDICT r3 item 2).
     ("lm_noffn_b8", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
@@ -137,6 +133,10 @@ STEPS = [
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_350m",
       "--batch-per-chip", "4", "--seq", "2048",
       "--remat", "--remat-policy", "no_ffn", "--iters", "10"]),
+    # EP family silicon number: MoE train throughput, active-param MFU.
+    ("moe", 700,
+     [sys.executable, "tools/bench_moe.py", "--preset", "moe_370m",
+      "--batch-per-chip", "8", "--seq", "1024", "--iters", "10"]),
     # Decoder step-time breakdown: the committed trace feeding the next
     # MFU push (where do the 502 ms go at 125m/no_ffn?).
     ("lm_profile", 700,

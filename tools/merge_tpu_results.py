@@ -53,6 +53,7 @@ STEP_KEYS = {
     "lm_350m": "llama_350m",
     "lm_profile": "llama_125m_noffn_b8_profiled",  # never clobbers the clean bench
     "gen_kv8_b32": "llama_125m_decode_b32_kv8",
+    "moe": "moe_370m",
 }
 
 
