@@ -120,7 +120,7 @@ class TestImport:
 
         ckpt_dir = tmp_path / "hf_ckpt"
         hf_model.save_pretrained(ckpt_dir)
-        with pytest.raises(SystemExit, match="neither"):
+        with pytest.raises(SystemExit, match="none of these"):
             launch.run(launch.build_parser().parse_args([
                 "--config", "mnist", "--strategy", "dp",
                 "--steps", "1", "--platform", "cpu",
