@@ -204,6 +204,68 @@ def _pallas_friendly(q, k, v) -> bool:
     )
 
 
+def _splash_window_friendly(q, k, sinks, mask, force_reference) -> bool:
+    """Whether the splash local-attention kernel can take this call."""
+    import os
+
+    # A/B kill switch (chip playbook).  "0"/"false"/empty mean OFF —
+    # a raw truthiness check would make TTD_NO_SPLASH=0 silently fall
+    # back to the chunked path and corrupt the A/B (the TTD_NO_PALLAS
+    # lesson, pallas_kernels.py).
+    if os.environ.get("TTD_NO_SPLASH", "").lower() not in ("", "0",
+                                                           "false"):
+        return False
+    if force_reference or mask is not None or sinks:
+        return False
+    # Same kernel-friendliness rules as the flash path (one source).
+    return _pallas_friendly(q, k, q)
+
+
+def splash_window_attention(q, k, v, *, window: int,
+                            segment_ids=None,
+                            softmax_scale: Optional[float] = None,
+                            interpret: bool = False) -> jax.Array:
+    """Sliding-window causal attention via the library SPLASH kernel.
+
+    Splash supports local masks NATIVELY (``LocalMask``), streaming KV
+    blocks through VMEM and SKIPPING fully-masked blocks — so unlike the
+    jnp chunked path nothing [B,H,chunks,c,c+w]-shaped ever
+    materializes, which removes the full-remat pairing constraint the
+    chunked path has (PROFILE.md: its saved f32 score stacks OOM a 16
+    GiB chip under no-remat/no_ffn).  q/k/v: [B, H, S, D] with KV
+    already repeated to full heads (the caller's GQA contract).
+
+    ``interpret=True`` runs the kernel in pallas interpret mode — the
+    CPU parity-test path (slow; tiny shapes only).
+    """
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    b, h, s, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # LocalMask window_size is (left, right) EXCLUSIVE of self; our
+    # ``window`` counts the query itself (Mistral), hence window - 1.
+    mask = _sm.MultiHeadMask(
+        [_sm.LocalMask((s, s), (window - 1, 0), 0) for _ in range(h)])
+    kernel = _sk.make_splash_mha(
+        mask, head_shards=1, q_seq_shards=1, interpret=interpret)
+    qs = (q * scale).astype(q.dtype)  # splash does not scale internally
+
+    if segment_ids is None:
+        def one(qi, ki, vi):
+            return kernel(qi, ki, vi)
+
+        return jax.vmap(one)(qs, k, v)
+
+    def one_seg(qi, ki, vi, si):
+        return kernel(qi, ki, vi,
+                      segment_ids=_sk.SegmentIds(q=si, kv=si))
+
+    return jax.vmap(one_seg)(qs, k, v, segment_ids)
+
+
 def multihead_attention_kernel(
     q: jax.Array,
     k: jax.Array,
@@ -250,6 +312,13 @@ def multihead_attention_kernel(
                              "causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        if (_splash_window_friendly(q, k, sinks, mask, force_reference)
+                and q.shape[-2] > window):
+            # TPU: the splash kernel handles the local mask natively —
+            # no score materialization, no remat pairing constraint.
+            return splash_window_attention(
+                q, k, v, window=window, segment_ids=segment_ids,
+                softmax_scale=softmax_scale)
         chunkable = (mask is None and not force_reference
                      and q.shape[-2] == k.shape[-2]
                      and q.shape[-2] % window == 0

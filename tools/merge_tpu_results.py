@@ -54,6 +54,8 @@ STEP_KEYS = {
     "lm_profile": "llama_125m_noffn_b8_profiled",  # never clobbers the clean bench
     "gen_kv8_b32": "llama_125m_decode_b32_kv8",
     "moe": "moe_370m",
+    "lm_window_splash": "llama_125m_window512_splash",
+    "lm_window_noffn_splash": "llama_125m_window512_noffn_splash",
 }
 
 
