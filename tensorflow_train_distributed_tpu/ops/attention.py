@@ -206,14 +206,13 @@ def _pallas_friendly(q, k, v) -> bool:
 
 def _splash_window_friendly(q, k, sinks, mask, force_reference) -> bool:
     """Whether the splash local-attention kernel can take this call."""
-    import os
+    from tensorflow_train_distributed_tpu.ops.pallas_kernels import (
+        env_flag,
+    )
 
-    # A/B kill switch (chip playbook).  "0"/"false"/empty mean OFF —
-    # a raw truthiness check would make TTD_NO_SPLASH=0 silently fall
-    # back to the chunked path and corrupt the A/B (the TTD_NO_PALLAS
-    # lesson, pallas_kernels.py).
-    if os.environ.get("TTD_NO_SPLASH", "").lower() not in ("", "0",
-                                                           "false"):
+    # A/B kill switch (chip playbook); env_flag is the one shared
+    # parser ("0"/"false"/empty mean OFF — the TTD_NO_PALLAS lesson).
+    if env_flag("TTD_NO_SPLASH"):
         return False
     if force_reference or mask is not None or sinks:
         return False

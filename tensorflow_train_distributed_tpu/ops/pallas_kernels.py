@@ -36,6 +36,18 @@ from jax.experimental import pallas as pl
 _NEG = -1e30  # big finite negative: avoids -inf − -inf = NaN in masking
 
 
+def env_flag(name: str) -> bool:
+    """True when the A/B kill-switch env var ``name`` is SET (on).
+
+    "", "0", and "false" (any case) mean OFF — a raw truthiness check
+    would make NAME=0 silently flip the A/B (the TTD_NO_PALLAS lesson).
+    One parser for every switch so the semantics cannot diverge.
+    """
+    import os
+
+    return os.environ.get(name, "").lower() not in ("", "0", "false")
+
+
 def _use_pallas(override: Optional[bool]) -> bool:
     if override is not None:
         return override
@@ -44,7 +56,7 @@ def _use_pallas(override: Optional[bool]) -> bool:
     # not assumed — TTD_NO_PALLAS=1 falls back to the pure-jax path.
     # ("0"/"false"/empty mean OFF — a raw truthiness check would make
     # TTD_NO_PALLAS=0 silently disable the kernels and corrupt the A/B.)
-    if os.environ.get("TTD_NO_PALLAS", "").lower() not in ("", "0", "false"):
+    if env_flag("TTD_NO_PALLAS"):
         return False
     return jax.default_backend() == "tpu"
 

@@ -615,6 +615,7 @@ class TestSplashWindow:
         (the TTD_NO_PALLAS lesson)."""
         from tensorflow_train_distributed_tpu.ops import attention
 
+        monkeypatch.delenv("TTD_NO_SPLASH", raising=False)  # dev shells
         q = jnp.zeros((1, 2, 256, 64))
         args = dict(sinks=0, mask=None, force_reference=False)
         assert not attention._splash_window_friendly(q, q, **args)  # cpu

@@ -186,19 +186,35 @@ def log(state_dir: str, msg: str) -> None:
 
 
 def probe(timeout_s: float) -> str:
-    """'alive' | 'held' (another framework process on the chip) | 'dead'."""
+    """'alive' | 'held' (another framework process on the chip) | 'dead'.
+
+    The probe runs in its OWN process group and is group-killed on
+    timeout: a probe hung in backend init holds the chip flock, and an
+    orphaned one (observed when a hunter was SIGKILLed mid-probe) makes
+    every later probe read 'held' forever.
+    """
     try:
-        out = subprocess.run(PROBE, capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO)
-        if "PROBE-OK" in out.stdout and "tpu" in out.stdout.lower():
-            return "alive"
-        if "PROBE-HELD" in out.stdout:
-            return "held"
-        return "dead"
+        proc = subprocess.Popen(PROBE, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                cwd=REPO, start_new_session=True)
+    except OSError:
+        return "dead"  # fork/pid pressure: sleep and re-probe, not die
+    try:
+        stdout, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
         return "dead"
     except OSError:
         return "dead"
+    if "PROBE-OK" in stdout and "tpu" in stdout.lower():
+        return "alive"
+    if "PROBE-HELD" in stdout:
+        return "held"
+    return "dead"
 
 
 def last_json_line(text: str):
