@@ -124,6 +124,85 @@ def export_llama(config: LlamaConfig, params, out_dir) -> Path:
     return out
 
 
+def hf_config_dict_mixtral(config) -> dict:
+    """``config.json`` for a Mixtral (sparse-MoE) export."""
+    head_dim = config.d_model // config.num_heads
+    return {
+        "model_type": "mixtral",
+        "architectures": ["MixtralForCausalLM"],
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.d_model,
+        "intermediate_size": config.ffn_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads or config.num_heads,
+        "head_dim": head_dim,
+        "num_local_experts": config.num_experts,
+        "num_experts_per_tok": config.top_k,
+        "max_position_embeddings": config.max_positions,
+        "rms_norm_eps": config.rms_epsilon,
+        "rope_theta": config.rope_base,
+        "hidden_act": "silu",
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+        "sliding_window": None,
+    }
+
+
+def export_mixtral_state_dict(params, config) -> dict:
+    """Native ``MoeLmModel`` params → HF ``MixtralForCausalLM`` state
+    dict (the inverse of ``import_hf.import_mixtral_state_dict``):
+    expert stacks unstack to ``experts.{e}.w1/w3/w2``, the f32 router
+    kernel transposes back to ``block_sparse_moe.gate.weight``."""
+    import flax.linen as nn
+
+    if config.moe_every != 1:
+        raise ValueError(
+            "HF Mixtral has MoE on EVERY layer; this config's "
+            f"moe_every={config.moe_every} is not representable")
+    params = nn.unbox(params)
+    sd = {
+        "model.embed_tokens.weight": _t(params["token_embed"]["embedding"]),
+        "model.norm.weight": _t(params["final_norm"]["scale"]),
+        "lm_head.weight": _t(np.asarray(params["lm_head"]["kernel"]).T),
+    }
+    for i in range(config.num_layers):
+        lt = params[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _t(lt["attn_norm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _t(
+            lt["mlp_norm"]["scale"])
+        attn = lt["attention"]
+        for hf, ours in (("q_proj", "query"), ("k_proj", "key"),
+                         ("v_proj", "value"), ("o_proj", "out")):
+            sd[p + f"self_attn.{hf}.weight"] = _t(
+                np.asarray(attn[ours]["kernel"]).T)
+        moe_p = lt["moe"]
+        sd[p + "block_sparse_moe.gate.weight"] = _t(
+            np.asarray(moe_p["router"]["kernel"]).T)
+        experts = moe_p["experts"]
+        for e in range(config.num_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            for hf, ours in (("w1", "wi_gate"), ("w3", "wi_up"),
+                             ("w2", "wo")):
+                sd[ep + f"{hf}.weight"] = _t(
+                    np.asarray(experts[ours]["kernel"][e]).T)
+    return sd
+
+
+def export_mixtral(config, params, out_dir) -> Path:
+    """Write an HF-loadable Mixtral checkpoint directory."""
+    import torch
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "config.json").write_text(
+        json.dumps(hf_config_dict_mixtral(config), indent=2))
+    torch.save(export_mixtral_state_dict(params, config),
+               out / "pytorch_model.bin")
+    return out
+
+
 def export_hf_from_registry(config_name: str, checkpoint_dir,
                             out_dir, *, platform: str = "cpu",
                             lora_alpha: float = 16.0) -> Path:
@@ -138,14 +217,19 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
 
     if platform:
         force_platform(platform)
+    from tensorflow_train_distributed_tpu.models.moe import MoeLmTask
+
     task = registry.get_entry(config_name)["task_factory"]()
-    if not isinstance(task, CausalLmTask):
+    is_moe = isinstance(task, MoeLmTask)
+    if not isinstance(task, (CausalLmTask, MoeLmTask)):
         raise SystemExit(
-            f"--config {config_name} is not a Llama-family decoder "
-            "(HF export maps LlamaForCausalLM/MistralForCausalLM "
+            f"--config {config_name} is not a Llama- or MoE-family "
+            "decoder (HF export maps Llama/Mistral/Mixtral ForCausalLM "
             "checkpoints only)")
     config = task.config
-    if config.attention_sinks:
+    if is_moe:
+        pass  # MoE export validated in export_mixtral_state_dict
+    elif config.attention_sinks:
         # Sinks are decode-time; the exported weights are identical.
         import dataclasses
 
@@ -167,11 +251,21 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
         import jax
         import numpy as np_
 
-        from tensorflow_train_distributed_tpu.models.llama import LlamaModel
-
         toks = np_.zeros((1, 8), np_.int32)
-        params = LlamaModel(config).init(jax.random.key(0),
-                                         toks)["params"]
+        if is_moe:
+            from tensorflow_train_distributed_tpu.models.moe import (
+                MoeLmModel,
+            )
+
+            params = MoeLmModel(config).init(jax.random.key(0),
+                                             toks)["params"]
+        else:
+            from tensorflow_train_distributed_tpu.models.llama import (
+                LlamaModel,
+            )
+
+            params = LlamaModel(config).init(jax.random.key(0),
+                                             toks)["params"]
     from tensorflow_train_distributed_tpu.models.generate import (
         has_lora_leaves,
     )
@@ -206,4 +300,6 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
             spec = LoraSpec(rank=rank, alpha=lora_alpha, targets=targets)
         check_spec_matches(params, spec)
         params = merge_lora(params, spec)
+    if is_moe:
+        return export_mixtral(config, params, out_dir)
     return export_llama(config, params, out_dir)
