@@ -1,15 +1,15 @@
 #!/bin/bash
-# Chip-return playbook — run ONCE, top to bottom, the moment the TPU
-# tunnel answers (probe first: timeout 45 python -c "import jax;
-# print(jax.devices())").  Encodes VERDICT r3 items 1-3: the
-# three-rounds-missing BERT number first, then the persisted multi-family
-# capture, then the unmeasured perf levers (no_ffn remat policy, pallas
-# kernel A/B).  Every tool takes the host-wide chip lock itself
-# (runtime/chip_lock.py) — but never run two of these concurrently
-# anyway: concurrent tunnel use corrupts timings (PROFILE.md).
+# SUPERSEDED by tools/chip_hunter.py for the intermittent-tunnel regime
+# (PROFILE.md round-4: the tunnel returns in alive-windows of minutes —
+# a monolithic playbook wastes the window on whichever step is next;
+# the hunter probes continuously and fires short atomic steps).  This
+# script remains as the ONE-SHOT form for a KNOWN-stable chip session:
+# run top to bottom, then tools/merge_tpu_results.py is unnecessary
+# (bench.py persists directly).
 #
-# Afterwards: fold the numbers into PROFILE.md (replace "chip measurement
-# pending") and commit profiles/bench/last_tpu_result.json.
+# Every tool takes the host-wide chip lock itself (runtime/chip_lock.py)
+# — but never run two of these concurrently anyway: concurrent tunnel
+# use corrupts timings (PROFILE.md).
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/chip_results_$(date +%H%M).log}
