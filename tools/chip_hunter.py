@@ -365,6 +365,19 @@ def main(argv=None) -> int:
             val = rec.get("value", rec.get("metric", "?"))
             log(args.state_dir, f"step {name} OK: value={val} "
                                 f"(queue: {queue})")
+            # Fold into the persisted TPU record immediately (idempotent;
+            # re-merges replay the whole results file), so a window that
+            # opens unattended still lands in the repo artifact.
+            try:
+                out = subprocess.run(
+                    [sys.executable, "tools/merge_tpu_results.py",
+                     "--results",
+                     os.path.join(args.state_dir, "results.jsonl")],
+                    capture_output=True, text=True, timeout=60, cwd=REPO)
+                log(args.state_dir,
+                    f"merged into persisted record (rc={out.returncode})")
+            except (subprocess.TimeoutExpired, OSError) as e:
+                log(args.state_dir, f"merge failed (non-fatal): {e}")
     if queue:
         log(args.state_dir, f"deadline reached; pending={queue}")
         return 3
