@@ -430,7 +430,7 @@ class TestExportHf:
             export_hf_from_registry,
         )
 
-        with pytest.raises(SystemExit, match="Llama-family"):
+        with pytest.raises(SystemExit, match="Llama- or MoE-family"):
             export_hf_from_registry("mnist", None, tmp_path / "x",
                                     platform="")
 
