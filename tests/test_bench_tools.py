@@ -194,3 +194,23 @@ def test_bench_moe_cpu_smoke():
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["value"] > 0
     assert 0 < rec["n_active_params"] < rec["n_params"]
+
+
+def test_bench_generate_moe_preset_cpu_smoke():
+    """MoE presets decode through the same bench path (generate's
+    config dispatch); llama-only flags are rejected for them."""
+    import json
+    import subprocess
+    import sys
+
+    base = [sys.executable, os.path.join(_TOOLS, "bench_generate.py"),
+            "--preset", "moe_tiny", "--batch", "2", "--prompt-len", "8",
+            "--max-new", "8", "--iters", "2", "--platform", "cpu"]
+    out = subprocess.run(base, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    out = subprocess.run(base + ["--kv-cache", "int8"],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode != 0
+    assert "llama-family" in (out.stderr + out.stdout)

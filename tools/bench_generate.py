@@ -46,7 +46,25 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         # single new token IS the prefill call. Guarded here too so
         # library callers get the clean error, not ZeroDivisionError.
         raise ValueError(f"max_new must be >= 2, got {max_new}")
-    cfg = llama.LLAMA_PRESETS[preset]
+    if preset in llama.LLAMA_PRESETS:
+        cfg = llama.LLAMA_PRESETS[preset]
+        model_cls = llama.LlamaModel
+    else:
+        # MoE presets decode through the same generate() dispatch.
+        from tensorflow_train_distributed_tpu.models import moe
+
+        if preset not in moe.MOE_PRESETS:
+            # ValueError, not SystemExit: main()'s except-Exception turns
+            # it into the one-JSON-line error record consumers parse.
+            raise ValueError(
+                f"unknown preset {preset!r}: not in LLAMA_PRESETS or "
+                f"MOE_PRESETS")
+        cfg = moe.MOE_PRESETS[preset]
+        model_cls = moe.MoeLmModel
+        if kv_cache_int8 or sliding_window:
+            raise ValueError(
+                "--kv-cache/--sliding-window apply to llama-family "
+                "presets only")
     if kv_cache_int8:
         cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     if sliding_window:
@@ -62,7 +80,7 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(
         1, cfg.vocab_size, (batch, prompt_len)).astype(np.int32))
-    model = llama.LlamaModel(cfg)
+    model = model_cls(cfg)
     abstract = jax.eval_shape(
         lambda: model.init(jax.random.key(0), prompt[:, :8]))
     n_params = sum(x.size for x in
@@ -73,14 +91,19 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
     # head_dim).
     itemsize = jnp.dtype(cfg.dtype).itemsize
     kv_heads = cfg.num_kv_heads or cfg.num_heads
+    # Normalize the llama-only knobs ONCE (MoeConfig lacks the fields and
+    # its branch above rejected the flags) — scattered getattrs would
+    # mask attribute typos (the lora.spec_of lesson).
+    cfg_window = getattr(cfg, "sliding_window", None)
+    cfg_kv8 = bool(getattr(cfg, "kv_cache_int8", False))
     cache_rows = total_len
-    if cfg.sliding_window and cfg.sliding_window < total_len:
-        cache_rows = cfg.sliding_window  # rolling ring buffer
-    kv_itemsize = 1 if cfg.kv_cache_int8 else itemsize
+    if cfg_window and cfg_window < total_len:
+        cache_rows = cfg_window  # rolling ring buffer
+    kv_itemsize = 1 if cfg_kv8 else itemsize
     cache_bytes = (2 * cfg.num_layers * batch * cache_rows
                    * kv_heads * (cfg.d_model // cfg.num_heads)
                    * kv_itemsize)
-    if cfg.kv_cache_int8:
+    if cfg_kv8:
         # Plus the f32 per-(position, kv_head) scale buffers (2 per
         # layer: k and v) — ~6% of the bf16 cache at head_dim 64, and
         # they stream on every step just like the cache rows.
@@ -164,12 +187,12 @@ def bench_generate(preset: str, batch: int, prompt_len: int,
         "n_params": n_params,
         "backend": dev.platform,
     }
-    if cfg.sliding_window:
-        rec["sliding_window"] = cfg.sliding_window
+    if cfg_window:
+        rec["sliding_window"] = cfg_window
         rec["kv_cache_rows"] = cache_rows
     if quant:
         rec["quant"] = quant
-    if cfg.kv_cache_int8:
+    if cfg_kv8:
         rec["kv_cache"] = "int8"
     bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
           if dev.platform == "tpu" else None)
