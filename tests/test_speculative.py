@@ -115,3 +115,42 @@ class TestGuards:
         with pytest.raises(ValueError, match="max_positions"):
             generate_speculative(TINY, p, TINY, p,
                                  jnp.zeros((1, 100), jnp.int32), 120)
+
+
+def test_cli_speculative_matches_greedy(tmp_path):
+    """Through the real CLIs: train target (4 steps) + draft (2 steps),
+    then sample.py --speculative-* emits EXACTLY the greedy completion."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t_ck, d_ck = str(tmp_path / "t"), str(tmp_path / "d")
+    for ck, steps in ((t_ck, 4), (d_ck, 2)):
+        out = subprocess.run(
+            [sys.executable, "-m", "tensorflow_train_distributed_tpu",
+             "--config", "llama_tiny_sft", "--strategy", "dp", "--steps",
+             str(steps), "--platform", "cpu", "--checkpoint-dir", ck,
+             "--checkpoint-every", str(steps)],
+            capture_output=True, text=True, timeout=600, cwd=repo)
+        assert out.returncode == 0, (out.stderr or out.stdout)[-800:]
+    base = [sys.executable, os.path.join(repo, "tools", "sample.py"),
+            "--config", "llama_tiny_sft", "--checkpoint-dir", t_ck,
+            "--prompt", "1,2,3", "--max-new", "8", "--platform", "cpu"]
+    greedy = subprocess.run(base, capture_output=True, text=True,
+                            timeout=600)
+    spec = subprocess.run(
+        base + ["--speculative-draft-config", "llama_tiny_sft",
+                "--speculative-draft-checkpoint", d_ck,
+                "--speculative-k", "3"],
+        capture_output=True, text=True, timeout=600)
+    assert greedy.returncode == 0 and spec.returncode == 0, (
+        (spec.stderr or spec.stdout)[-800:])
+    g = json.loads(greedy.stdout.strip().splitlines()[-1])
+    s = json.loads(spec.stdout.strip().splitlines()[-1])
+    assert g["completion"] == s["completion"]
+    stats = json.loads(
+        [ln for ln in spec.stdout.splitlines()
+         if "speculative_stats" in ln][-1])["speculative_stats"]
+    assert stats["rounds"] >= 1

@@ -23,6 +23,25 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _restore_params(checkpoint_dir: str):
+    """Params-only orbax restore, with the isdir guard FIRST: orbax
+    would create a typo'd directory as a side effect of opening it."""
+    import os as _os
+
+    from tensorflow_train_distributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    if not _os.path.isdir(checkpoint_dir):
+        raise SystemExit(f"no checkpoint dir at {checkpoint_dir}")
+    mgr = CheckpointManager(checkpoint_dir, async_save=False)
+    params = mgr.restore_params()
+    mgr.close()
+    if params is None:
+        raise SystemExit(f"no checkpoint under {checkpoint_dir}")
+    return params
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--config", required=True,
@@ -57,6 +76,15 @@ def main(argv=None) -> int:
                    help="default: the sidecar's, else 16.0")
     p.add_argument("--lora-targets", default=None,
                    help="default: the sidecar's, else query,value")
+    p.add_argument("--speculative-draft-config", default=None,
+                   help="enable speculative decoding: registry config of "
+                        "the DRAFT model (same vocab; greedy only, "
+                        "batch-1). Output is provably identical to the "
+                        "target's own greedy decode")
+    p.add_argument("--speculative-draft-checkpoint", default=None,
+                   help="orbax checkpoint dir for the draft's weights")
+    p.add_argument("--speculative-k", type=int, default=4,
+                   help="draft block length per round")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
     args = p.parse_args(argv)
@@ -69,6 +97,7 @@ def main(argv=None) -> int:
         force_platform(args.platform)
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from tensorflow_train_distributed_tpu.models import registry
@@ -130,19 +159,7 @@ def main(argv=None) -> int:
 
             cfg, params = import_llama(args.init_from_hf, cfg)
     else:
-        from tensorflow_train_distributed_tpu.training.checkpoint import (
-            CheckpointManager,
-        )
-
-        if not os.path.isdir(args.checkpoint_dir):
-            # Check BEFORE constructing the manager: orbax would create
-            # the (typo'd) directory as a side effect of opening it.
-            raise SystemExit(f"no checkpoint dir at {args.checkpoint_dir}")
-        mgr = CheckpointManager(args.checkpoint_dir, async_save=False)
-        params = mgr.restore_params()
-        mgr.close()
-        if params is None:
-            raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+        params = _restore_params(args.checkpoint_dir)
 
     import dataclasses as _dc
 
@@ -187,6 +204,30 @@ def main(argv=None) -> int:
     if spec is not None:
         cfg = _dc.replace(cfg, lora=spec)
 
+    # Speculative flag validation BEFORE any quantization work: these
+    # checks only read args, and a doomed invocation must not pay a
+    # full-tree quantize first.
+    draft_task = None
+    if args.speculative_draft_config:
+        if args.temperature > 0 or args.quant or spec is not None:
+            raise SystemExit(
+                "--speculative-draft-config is greedy-only and does not "
+                "compose with --quant or LoRA serving (merge first)")
+        if not isinstance(task, CausalLmTask):
+            raise SystemExit("speculative decoding needs a llama-family "
+                             "TARGET --config")
+        if prompt.shape[0] != 1:
+            raise SystemExit("speculative decoding is batch-1: pass ONE "
+                             "--prompt")
+        if not args.speculative_draft_checkpoint:
+            raise SystemExit("--speculative-draft-checkpoint is required "
+                             "with --speculative-draft-config")
+        draft_task = registry.get_entry(
+            args.speculative_draft_config)["task_factory"]()
+        if not isinstance(draft_task, CausalLmTask):
+            raise SystemExit("the draft config must be a llama-family "
+                             "decoder")
+
     quant_scales = None
     if args.quant:
         from tensorflow_train_distributed_tpu.models.quant import (
@@ -195,12 +236,32 @@ def main(argv=None) -> int:
 
         params, quant_scales = quantize_params(params)
 
-    rng = (jax.random.key(args.seed)
-           if args.temperature > 0 else None)
-    out = np.asarray(generate(
-        cfg, params, prompt, args.max_new,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        rng=rng, quant_scales=quant_scales))
+    if draft_task is not None:
+        from tensorflow_train_distributed_tpu.models.speculative import (
+            generate_speculative,
+        )
+
+        draft_params = _restore_params(args.speculative_draft_checkpoint)
+        try:
+            toks, stats = generate_speculative(
+                cfg, params, draft_task.config, draft_params,
+                jnp.asarray(prompt), args.max_new,
+                k=args.speculative_k)
+        except ValueError as e:
+            # The library's guards (vocab match, k >= 1, the
+            # prompt+max_new+k+1 cache budget on BOTH models, LoRA
+            # leaves) — surface them as the clean CLI error every other
+            # bad input gets.
+            raise SystemExit(str(e))
+        out = np.asarray(toks)
+        print(json.dumps({"speculative_stats": stats}), flush=True)
+    else:
+        rng = (jax.random.key(args.seed)
+               if args.temperature > 0 else None)
+        out = np.asarray(generate(
+            cfg, params, prompt, args.max_new,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=rng, quant_scales=quant_scales))
     for row_in, row_out in zip(rows, out):
         print(json.dumps({
             "prompt": row_in,
