@@ -61,6 +61,18 @@ class MoeConfig:
     z_loss_weight: float = 1e-3
     dtype: object = jnp.bfloat16
     remat: bool = True
+    # Expert-compute formulation.  "dense": GShard dispatch/combine
+    # einsums — capacity-bounded, static-shaped, and the EP-sharded path
+    # (GSPMD turns the einsums into all-to-alls under an ``expert``
+    # mesh axis).  "gmm": MegaBlocks-style DROPLESS dispatch — tokens
+    # are sorted by expert and the three FFN matmuls run as megablox
+    # grouped matmuls (``jax.experimental.pallas.ops.tpu.megablox``),
+    # skipping the dispatch-einsum FLOPs and the capacity padding
+    # entirely (capacity_factor is ignored; nothing is ever dropped).
+    # Same parameter tree either way, so checkpoints transfer between
+    # formulations.  "gmm" is the single-shard throughput path; keep
+    # "dense" for expert-sharded meshes.
+    dispatch: str = "dense"
 
 
 MOE_PRESETS = {
@@ -142,6 +154,83 @@ class _ExpertFfn(nn.Module):
                        dtype=self.dtype, name="wo")(h)
 
 
+class _StackedKernel(nn.Module):
+    """One expert-stacked ``[num_experts, ...]`` kernel parameter.
+
+    Exists to give the gmm dispatch path the SAME parameter tree as the
+    dense path's ``nn.vmap(_ExpertFfn)`` — ``experts/<name>/kernel``,
+    expert-stacked, logical axes ``("expert", ...)`` — so checkpoints
+    transfer freely between the two formulations.  ``batch_axis=(0,)``
+    keeps per-expert init statistics identical to the vmap'd per-expert
+    lecun_normal (without it the expert axis would inflate fan_in).
+    """
+
+    shape: tuple
+    logical_axes: tuple
+
+    @nn.compact
+    def __call__(self):
+        if self.has_variable("quant", "scale"):
+            # The int8 serving path rewrites nn.Dense call sites via a
+            # method interceptor (models/quant.py) — this raw-param read
+            # would cast int8 CODES to bf16 with no scale applied and
+            # produce garbage silently.
+            raise NotImplementedError(
+                "int8 weight-only serving is not wired for the gmm "
+                "dispatch path — serve quantized MoE checkpoints with "
+                "dispatch='dense', or dequantize_params() first")
+        return self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                self.logical_axes),
+            self.shape)
+
+
+def _gmm(lhs, rhs, group_sizes, interpret):
+    """Megablox grouped matmul: rows of ``lhs`` hit the ``rhs`` slice of
+    their group (``group_sizes`` [E] row counts, summing to lhs rows).
+
+    ``ops.gmm`` is the differentiable (custom-VJP) wrapper — the
+    backward pass runs as grouped matmuls too.  ``interpret`` runs the
+    kernel in pallas interpret mode for CPU tests.
+    """
+    from jax.experimental.pallas.ops.tpu.megablox import ops as _mb
+
+    return _mb.gmm(lhs, rhs, group_sizes,
+                   preferred_element_type=jnp.float32, interpret=interpret)
+
+
+class _GmmExperts(nn.Module):
+    """Dropless expert FFN: grouped matmuls over expert-sorted rows.
+
+    ``xs`` [M, d_model] holds token copies sorted by assigned expert and
+    ``group_sizes`` [E] the per-expert row counts.  Same SwiGLU math as
+    ``_ExpertFfn``; the three matmuls run as ``megablox.gmm`` so each
+    expert's rows hit its own kernel slice without materializing
+    ``[E, capacity]`` buffers or dispatch one-hots.
+    """
+
+    num_experts: int
+    hidden: int
+    dtype: object
+
+    @nn.compact
+    def __call__(self, xs, group_sizes, *, interpret):
+        d = xs.shape[-1]
+        e, f = self.num_experts, self.hidden
+        wi_gate = _StackedKernel((e, d, f), ("expert", "embed", "mlp"),
+                                 name="wi_gate")()
+        wi_up = _StackedKernel((e, d, f), ("expert", "embed", "mlp"),
+                               name="wi_up")()
+        wo = _StackedKernel((e, f, d), ("expert", "mlp", "embed"),
+                            name="wo")()
+        gate = _gmm(xs, wi_gate.astype(self.dtype), group_sizes, interpret)
+        up = _gmm(xs, wi_up.astype(self.dtype), group_sizes, interpret)
+        h = (nn.silu(gate) * up).astype(self.dtype)
+        return _gmm(h, wo.astype(self.dtype), group_sizes, interpret)
+
+
 class MoEMlpBlock(nn.Module):
     """Routed expert FFN, a drop-in for ``layers.MlpBlock``."""
 
@@ -164,6 +253,12 @@ class MoEMlpBlock(nn.Module):
                          use_bias=False, dtype=jnp.float32,
                          name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)          # [G, S, E]
+        if cfg.dispatch == "gmm":
+            return self._gmm_moe(x, logits, probs)
+        if cfg.dispatch != "dense":
+            raise ValueError(
+                f"unknown MoeConfig.dispatch {cfg.dispatch!r} "
+                "(expected 'dense' or 'gmm')")
         capacity = max(
             1, int(cfg.capacity_factor * cfg.top_k * group_size
                    / cfg.num_experts))
@@ -211,6 +306,71 @@ class MoEMlpBlock(nn.Module):
         y = jnp.einsum("gsec,egcd->gsd", combine.astype(cfg.dtype),
                        expert_out)
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+
+    def _gmm_moe(self, x, logits, probs):
+        """Dropless dispatch (MegaBlocks, arXiv:2211.15841): sort token
+        copies by expert, run the FFN as grouped matmuls.
+
+        No capacity, no drops — every top-k assignment is computed, so
+        ``capacity_factor`` is ignored and packed==lone-document parity
+        holds unconditionally (the dense path's binding-capacity caveat
+        does not exist here).  Output matches the dense path exactly
+        whenever the dense path drops nothing.
+        """
+        cfg = self.config
+        groups, group_size, d_model = x.shape
+        n_tokens = groups * group_size
+        k = cfg.top_k
+        flat = x.reshape(n_tokens, d_model)
+        p2 = probs.reshape(n_tokens, cfg.num_experts)
+        top_p, top_e = jax.lax.top_k(p2, k)              # [T, k]
+        # GShard top-k gate rule: normalize over the chosen experts.
+        # (The dense path normalizes over *kept* gates — identical here
+        # because nothing is ever dropped.)
+        gate_w = top_p / jnp.maximum(
+            jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
+
+        # Aux losses — same definitions as the dense path, with
+        # routed = all top-k assignments (dropless).
+        routed = jnp.sum(jax.nn.one_hot(top_e, cfg.num_experts,
+                                        dtype=jnp.float32), axis=1)
+        lb = cfg.num_experts * jnp.sum(
+            jnp.mean(routed, axis=0) * jnp.mean(p2, axis=0)) / k
+        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        self.sow("aux_loss", "load_balance", cfg.aux_loss_weight * lb)
+        self.sow("aux_loss", "router_z", cfg.z_loss_weight * z)
+        self.sow("router_stats", "dropped_frac", jnp.zeros((), jnp.float32))
+        self.sow("router_stats", "expert_load",
+                 jnp.sum(routed, axis=0) / float(n_tokens * k))
+
+        # Sort token copies by expert; grouped-matmul group sizes are the
+        # per-expert assignment counts.  Static shapes throughout — only
+        # the *contents* of ``sizes`` are data-dependent, which is
+        # exactly what megablox's group_sizes operand is for.
+        e_flat = top_e.reshape(-1)                       # [T*k] token-major
+        order = jnp.argsort(e_flat)                      # stable
+        xs = jnp.take(flat, order // k, axis=0).astype(cfg.dtype)
+        sizes = jnp.bincount(e_flat, length=cfg.num_experts).astype(
+            jnp.int32)
+        m = n_tokens * k
+        m_pad = -(-m // 128) * 128                       # kernel row tile
+        if m_pad != m:
+            # Zero rows appended to the LAST expert's range: computed,
+            # then sliced off before the combine — never observable.
+            xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
+            sizes = sizes.at[cfg.num_experts - 1].add(m_pad - m)
+
+        out = _GmmExperts(num_experts=cfg.num_experts, hidden=cfg.ffn_size,
+                          dtype=cfg.dtype, name="experts")(
+            xs, sizes,
+            interpret=jax.default_backend() != "tpu")    # [m_pad, D] f32
+        inv = jnp.zeros((m,), jnp.int32).at[order].set(
+            jnp.arange(m, dtype=jnp.int32))
+        y = jnp.take(out[:m], inv, axis=0).reshape(n_tokens, k, d_model)
+        y = jnp.sum(y * gate_w[..., None], axis=1).astype(cfg.dtype)
+        return nn.with_logical_constraint(
+            y.reshape(groups, group_size, d_model),
+            ("batch", "length", "embed"))
 
 
 class MoeDecoderBlock(nn.Module):

@@ -1,0 +1,176 @@
+"""Dropless (megablox grouped-matmul) MoE dispatch tests.
+
+Ground truths: with ample capacity the gmm path reproduces the dense
+GShard dispatch exactly (same router, same gate normalization, same
+SwiGLU — only the data movement differs); with a BINDING capacity the
+dense path drops tokens but gmm still equals the no-drop oracle
+(dropless by construction, ``dropped_frac`` pinned to 0).  The two
+formulations share one parameter tree, so checkpoints transfer.
+
+Kernels run in pallas interpret mode on the CPU test mesh
+(``models/moe.py`` gates ``interpret`` on the backend) — slow, so
+shapes here are tiny.
+"""
+
+import dataclasses
+
+import pytest
+
+pytestmark = pytest.mark.slow  # interpret-mode pallas: full-suite tier
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensorflow_train_distributed_tpu.models import moe
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    """(dense_cfg, gmm_cfg, params, x): ample capacity, shared params."""
+    cfg_d = dataclasses.replace(moe.MOE_PRESETS["moe_tiny"],
+                                capacity_factor=100.0)
+    cfg_g = dataclasses.replace(cfg_d, dispatch="gmm")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, cfg_d.d_model),
+                          jnp.float32)
+    params = moe.MoEMlpBlock(cfg_d).init(jax.random.PRNGKey(1), x)["params"]
+    return cfg_d, cfg_g, params, x
+
+
+def _apply(cfg, params, x):
+    return moe.MoEMlpBlock(cfg).apply(
+        {"params": params}, x, mutable=["aux_loss", "router_stats"])
+
+
+def test_same_param_tree(tiny_pair):
+    cfg_d, cfg_g, params, x = tiny_pair
+    params_g = moe.MoEMlpBlock(cfg_g).init(
+        jax.random.PRNGKey(1), x)["params"]
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(params_g))
+    shapes_d = jax.tree.map(lambda a: a.shape, params)
+    shapes_g = jax.tree.map(lambda a: a.shape, params_g)
+    assert shapes_d == shapes_g
+
+
+def test_forward_matches_dense_with_ample_capacity(tiny_pair):
+    cfg_d, cfg_g, params, x = tiny_pair
+    yd, _ = _apply(cfg_d, params, x)
+    yg, _ = _apply(cfg_g, params, x)
+    np.testing.assert_allclose(np.asarray(yd), np.asarray(yg),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_aux_losses_match_dense(tiny_pair):
+    cfg_d, cfg_g, params, x = tiny_pair
+    _, sd = _apply(cfg_d, params, x)
+    _, sg = _apply(cfg_g, params, x)
+    for name in ("load_balance", "router_z"):
+        np.testing.assert_allclose(
+            float(sd["aux_loss"][name][0]), float(sg["aux_loss"][name][0]),
+            rtol=1e-5)
+
+
+def test_grads_match_dense(tiny_pair):
+    cfg_d, cfg_g, params, x = tiny_pair
+
+    def loss(p, cfg):
+        return jnp.sum(_apply(cfg, p, x)[0] ** 2)
+
+    gd = jax.grad(loss)(params, cfg_d)
+    gg = jax.grad(loss)(params, cfg_g)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3),
+        gd, gg)
+
+
+def test_dropless_under_binding_capacity(tiny_pair):
+    cfg_d, cfg_g, params, x = tiny_pair
+    cfg_bind = dataclasses.replace(cfg_d, capacity_factor=0.5)
+    yb, sb = _apply(cfg_bind, params, x)
+    yg, sg = _apply(cfg_g, params, x)
+    yd_ample, _ = _apply(cfg_d, params, x)
+    # Dense with binding capacity really drops...
+    assert float(sb["router_stats"]["dropped_frac"][0]) > 0.1
+    # ...gmm never does, and still equals the no-drop oracle.
+    assert float(sg["router_stats"]["dropped_frac"][0]) == 0.0
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(yd_ample),
+                               atol=1e-5, rtol=1e-5)
+    assert float(jnp.max(jnp.abs(yb - yg))) > 1e-2
+
+
+def test_expert_load_sums_to_one(tiny_pair):
+    _, cfg_g, params, x = tiny_pair
+    _, sg = _apply(cfg_g, params, x)
+    load = np.asarray(sg["router_stats"]["expert_load"][0])
+    np.testing.assert_allclose(load.sum(), 1.0, atol=1e-5)
+    assert (load >= 0).all()
+
+
+def test_unknown_dispatch_rejected(tiny_pair):
+    cfg_d, _, params, x = tiny_pair
+    bad = dataclasses.replace(cfg_d, dispatch="scatter")
+    with pytest.raises(ValueError, match="dispatch"):
+        _apply(bad, params, x)
+
+
+def test_gmm_rejects_quantized_serving(tiny_pair):
+    """int8 serving scales present → loud refusal, not silent garbage
+    (the quant interceptor only rewrites nn.Dense call sites, which the
+    gmm path bypasses)."""
+    _, cfg_g, params, x = tiny_pair
+    scales = {"experts": {"wi_gate": {"scale": jnp.ones((4, 128))}}}
+    with pytest.raises(NotImplementedError, match="gmm"):
+        moe.MoEMlpBlock(cfg_g).apply(
+            {"params": params, "quant": scales}, x,
+            mutable=["aux_loss", "router_stats"])
+
+
+def test_full_task_trains_with_gmm():
+    """One gradient step through MoeLmTask(dispatch='gmm') under remat:
+    finite loss, finite grads touching every expert kernel."""
+    cfg = dataclasses.replace(moe.MOE_PRESETS["moe_tiny"], dispatch="gmm",
+                              remat=True)
+    task = moe.MoeLmTask(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32),
+    }
+    variables = task.init_variables(jax.random.PRNGKey(0), batch)
+    loss, (metrics, _) = task.loss_fn(variables["params"], {}, batch,
+                                      jax.random.PRNGKey(0), True)
+    assert np.isfinite(float(loss))
+    assert float(metrics["dropped_frac"]) == 0.0
+    grads = jax.grad(lambda p: task.loss_fn(p, {}, batch,
+                                            jax.random.PRNGKey(0), True)[0])(
+        variables["params"])
+    for leaf in jax.tree.leaves(grads):
+        assert bool(jnp.isfinite(leaf).all())
+    # Every expert's kernels get gradient signal (routing reaches all
+    # experts on this random batch; a broken group_sizes mapping or a
+    # collapsed router would zero some expert's slice).
+    flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+    expert_leaves = [leaf for path, leaf in flat
+                     if any(getattr(p, "key", "") == "experts"
+                            for p in path)]
+    assert expert_leaves
+    for leaf in expert_leaves:  # [E, ...] stacked: per-expert norms
+        norms = jnp.sqrt(jnp.sum(leaf ** 2, axis=tuple(
+            range(1, leaf.ndim))))
+        assert bool((norms > 0).all()), norms
+
+
+def test_decode_smoke_with_gmm():
+    """The decode path (one-token groups) routes through gmm too."""
+    cfg = dataclasses.replace(moe.MOE_PRESETS["moe_tiny"], dispatch="gmm",
+                              remat=False)
+    model = moe.MoeLmModel(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply({"params": variables["params"]}, tokens,
+                         mutable=["aux_loss", "router_stats"])[0]
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())

@@ -56,7 +56,9 @@ def _split_params(abstract_params):
 
 
 def bench_moe(preset: str, batch: int, seq: int, warmup: int, iters: int,
-              force_hbm: bool = False):
+              force_hbm: bool = False, dispatch: str = "dense"):
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -71,7 +73,7 @@ def bench_moe(preset: str, batch: int, seq: int, warmup: int, iters: int,
         Policy, Trainer, TrainerConfig,
     )
 
-    cfg = moe.MOE_PRESETS[preset]
+    cfg = dataclasses.replace(moe.MOE_PRESETS[preset], dispatch=dispatch)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
     task = moe.MoeLmTask(cfg)
@@ -89,13 +91,20 @@ def bench_moe(preset: str, batch: int, seq: int, warmup: int, iters: int,
         # State + the routing/dispatch buffers; remat keeps per-layer
         # activations transient.  Conservative on purpose (an OOM compile
         # can kill the chip tunnel).
-        capacity = max(1, int(cfg.capacity_factor * cfg.top_k * seq
-                              / cfg.num_experts))
         n_moe_layers = -(-cfg.num_layers // max(cfg.moe_every, 1))
-        dispatch = (2 * batch * seq * cfg.num_experts * capacity * 4
-                    * n_moe_layers)
+        if dispatch == "gmm":
+            # Dropless path: expert-sorted row copies + f32 gate/up
+            # activations instead of [G,S,E,C] dispatch one-hots.
+            m = batch * seq * cfg.top_k
+            dispatch_bytes = (m * (4 * cfg.d_model + 8 * cfg.ffn_size)
+                              * n_moe_layers)
+        else:
+            capacity = max(1, int(cfg.capacity_factor * cfg.top_k * seq
+                                  / cfg.num_experts))
+            dispatch_bytes = (2 * batch * seq * cfg.num_experts * capacity
+                              * 4 * n_moe_layers)
         act = 30 * cfg.num_layers * batch * seq * cfg.d_model * 2
-        need = n_params * STATE_BYTES_PER_PARAM + dispatch + act
+        need = n_params * STATE_BYTES_PER_PARAM + dispatch_bytes + act
         if need > budget:
             print(json.dumps({
                 "error": "pre-flight HBM estimate exceeds budget — rerun "
@@ -124,8 +133,9 @@ def bench_moe(preset: str, batch: int, seq: int, warmup: int, iters: int,
     active = n_dense + n_expert * cfg.top_k / cfg.num_experts
     flops_per_token = (6 * active
                        + 12 * cfg.num_layers * cfg.d_model * seq * 0.5)
+    name = preset if dispatch == "dense" else f"{preset}_{dispatch}"
     rec = {
-        "metric": f"{preset}_train_tokens_per_sec_per_chip",
+        "metric": f"{name}_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
         "step_time_ms": round(dt * 1e3, 2),
@@ -136,6 +146,7 @@ def bench_moe(preset: str, batch: int, seq: int, warmup: int, iters: int,
         "n_active_params": int(active),
         "num_experts": cfg.num_experts,
         "top_k": cfg.top_k,
+        "dispatch": dispatch,
         "backend": dev0.platform,
     }
     peak = peak_tflops(dev0)
@@ -157,6 +168,9 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
+    p.add_argument("--dispatch", default="dense", choices=["dense", "gmm"],
+                   help="expert compute: GShard dense-dispatch einsums or "
+                        "megablox grouped-matmul dropless routing")
     p.add_argument("--force-hbm", action="store_true")
     args = p.parse_args(argv)
     if args.platform:
@@ -179,11 +193,15 @@ def main(argv=None) -> int:
         with cm:
             rec = bench_moe(args.preset, args.batch_per_chip, args.seq,
                             args.warmup, args.iters,
-                            force_hbm=args.force_hbm)
+                            force_hbm=args.force_hbm,
+                            dispatch=args.dispatch)
     except Exception as e:  # machine-readable failure, bench.py lesson
+        name = (args.preset if args.dispatch == "dense"
+                else f"{args.preset}_{args.dispatch}")
         print(json.dumps({
-            "metric": f"{args.preset}_train_tokens_per_sec_per_chip",
+            "metric": f"{name}_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/sec/chip",
+            "dispatch": args.dispatch,
             "error": f"{type(e).__name__}: {e}"}), flush=True)
         return 1
     print(json.dumps(rec), flush=True)

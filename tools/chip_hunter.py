@@ -153,6 +153,13 @@ STEPS = [
     ("moe", 700,
      [sys.executable, "tools/bench_moe.py", "--preset", "moe_370m",
       "--batch-per-chip", "8", "--seq", "1024", "--iters", "10"]),
+    # Dropless megablox grouped-matmul dispatch A/B against the dense
+    # GShard einsums (same params, same router — only data movement
+    # differs; models/moe.py MoeConfig.dispatch).
+    ("moe_gmm", 700,
+     [sys.executable, "tools/bench_moe.py", "--preset", "moe_370m",
+      "--batch-per-chip", "8", "--seq", "1024", "--iters", "10",
+      "--dispatch", "gmm"]),
     # Decoder step-time breakdown: the committed trace feeding the next
     # MFU push (where do the 502 ms go at 125m/no_ffn?).
     ("lm_profile", 700,
