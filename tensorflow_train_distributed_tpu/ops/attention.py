@@ -205,14 +205,23 @@ def _pallas_friendly(q, k, v) -> bool:
 
 
 def _splash_window_friendly(q, k, sinks, mask, force_reference) -> bool:
-    """Whether the splash local-attention kernel can take this call."""
+    """Whether the splash local-attention kernel takes this call.
+
+    OPT-IN (``TTD_SPLASH=1``), not the default: on silicon the chunked
+    jnp path beat splash at the measured shape — llama_125m b8×s2048
+    w512: chunked 58.1k tok/s (full remat) vs splash 43.8k (full remat)
+    / 53.7k (+no_ffn, which splash alone enables) — PROFILE.md round-4.
+    Splash's remat freedom did not make up the kernel gap there; until a
+    shape is measured where it wins, the measured winner stays default.
+    """
     from tensorflow_train_distributed_tpu.ops.pallas_kernels import (
         env_flag,
     )
 
-    # A/B kill switch (chip playbook); env_flag is the one shared
-    # parser ("0"/"false"/empty mean OFF — the TTD_NO_PALLAS lesson).
-    if env_flag("TTD_NO_SPLASH"):
+    # env_flag is the one shared parser ("0"/"false"/empty mean OFF —
+    # the TTD_NO_PALLAS lesson).  TTD_NO_SPLASH still forces it off even
+    # if TTD_SPLASH is set (kill switch wins).
+    if env_flag("TTD_NO_SPLASH") or not env_flag("TTD_SPLASH"):
         return False
     if force_reference or mask is not None or sinks:
         return False

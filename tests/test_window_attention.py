@@ -608,25 +608,30 @@ class TestSplashWindow:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=5e-3, atol=5e-3)
 
-    def test_kill_switch_and_cpu_route_to_chunked(self, monkeypatch):
-        """On CPU the splash route never fires; the TTD_NO_SPLASH kill
-        switch must disable it even when the backend would allow it
-        (checked by faking a TPU backend), and 0/false/empty mean OFF
-        (the TTD_NO_PALLAS lesson)."""
+    def test_splash_opt_in_and_kill_switch(self, monkeypatch):
+        """Splash is OPT-IN (TTD_SPLASH=1): chunked beat it on silicon
+        at the measured shape (PROFILE.md round-4), so the measured
+        winner is the default.  On CPU the splash route never fires;
+        TTD_NO_SPLASH still wins over TTD_SPLASH (kill switch); and
+        0/false/empty mean OFF for both flags (the TTD_NO_PALLAS
+        lesson)."""
         from tensorflow_train_distributed_tpu.ops import attention
 
         monkeypatch.delenv("TTD_NO_SPLASH", raising=False)  # dev shells
+        monkeypatch.delenv("TTD_SPLASH", raising=False)
         q = jnp.zeros((1, 2, 256, 64))
         args = dict(sinks=0, mask=None, force_reference=False)
         assert not attention._splash_window_friendly(q, q, **args)  # cpu
-        # Fake a TPU backend: the shape/dtype gates now pass...
+        # Fake a TPU backend: the shape/dtype gates pass, so the env
+        # flags are what the next assertions exercise.
         monkeypatch.setattr(attention.jax, "default_backend",
                             lambda: "tpu")
+        assert not attention._splash_window_friendly(q, q, **args)  # opt-in
+        monkeypatch.setenv("TTD_SPLASH", "1")
         assert attention._splash_window_friendly(q, q, **args)
-        # ...so the env check is what the next assertions exercise.
-        monkeypatch.setenv("TTD_NO_SPLASH", "1")
+        monkeypatch.setenv("TTD_NO_SPLASH", "1")  # kill switch wins
         assert not attention._splash_window_friendly(q, q, **args)
         monkeypatch.setenv("TTD_NO_SPLASH", "0")
         assert attention._splash_window_friendly(q, q, **args)
-        monkeypatch.setenv("TTD_NO_SPLASH", "false")
-        assert attention._splash_window_friendly(q, q, **args)
+        monkeypatch.setenv("TTD_SPLASH", "false")
+        assert not attention._splash_window_friendly(q, q, **args)

@@ -113,26 +113,41 @@ STEPS = [
     # ([L,B,H,chunks,c,c+w]) OOM the chip if saved (measured 25 GB under
     # no-remat AND under no_ffn, whose outer scan saves attention
     # internals) — full remat keeps them per-layer transients.
-    # Pinned to the CHUNKED path (TTD_NO_SPLASH): splash became the TPU
-    # default mid-round, and this step's historical record (58.1k tok/s)
-    # is the chunked measurement — future re-runs must stay comparable.
+    # Pinned to the CHUNKED path (TTD_NO_SPLASH): explicit so the step
+    # stays comparable to its historical record (58.1k tok/s) no matter
+    # what the library default is.
     ("lm_window", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "8", "--seq", "2048", "--remat",
       "--sliding-window", "512"],
      {"TTD_NO_SPLASH": "1"}),
-    # Splash-kernel window A/B (splash is now the TPU default for
-    # sliding windows; TTD_NO_SPLASH=1 recovers the jnp chunked path,
-    # the 58.1k tok/s round-4 measurement).  Splash also removes the
-    # full-remat pairing constraint, so window+no_ffn becomes viable.
+    # Splash-kernel window A/B.  MEASURED 2026-07-31: splash 43.8k
+    # (full remat) / 53.7k (+no_ffn) vs chunked 58.1k → splash LOST at
+    # this shape and became opt-in (TTD_SPLASH=1, ops/attention.py);
+    # these steps pin the flag so re-runs still measure splash.
     ("lm_window_splash", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "8", "--seq", "2048", "--remat",
-      "--sliding-window", "512"]),
+      "--sliding-window", "512"],
+     {"TTD_SPLASH": "1"}),
     ("lm_window_noffn_splash", 600,
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "8", "--seq", "2048", "--remat",
-      "--remat-policy", "no_ffn", "--sliding-window", "512"]),
+      "--remat-policy", "no_ffn", "--sliding-window", "512"],
+     {"TTD_SPLASH": "1"}),
+    # Crossover hunt: does splash win at longer sequence?  Same window,
+    # s=4096 (b4 keeps the chunked f32 score stacks inside HBM with
+    # margin; the bench pre-flight still guards).
+    ("lm_window_s4096", 700,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
+      "--batch-per-chip", "4", "--seq", "4096", "--remat",
+      "--sliding-window", "512"],
+     {"TTD_NO_SPLASH": "1"}),
+    ("lm_window_splash_s4096", 700,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
+      "--batch-per-chip", "4", "--seq", "4096", "--remat",
+      "--sliding-window", "512"],
+     {"TTD_SPLASH": "1"}),
     # Serve leg: window MUST be < prompt+max_new (384) or the rolling
     # cache never engages and the A/B measures full attention twice.
     ("gen_window", 600,

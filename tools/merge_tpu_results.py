@@ -56,6 +56,9 @@ STEP_KEYS = {
     "moe": "moe_370m",
     "lm_window_splash": "llama_125m_window512_splash",
     "lm_window_noffn_splash": "llama_125m_window512_noffn_splash",
+    "lm_window_s4096": "llama_125m_window512_s4096",
+    "lm_window_splash_s4096": "llama_125m_window512_splash_s4096",
+    "moe_gmm": "moe_370m_gmm",
 }
 
 
