@@ -167,6 +167,14 @@ class MultiHeadAttention(nn.Module):
     # the rolling window cache (roll/concat would need scale plumbing;
     # the window already bounds cache memory).
     kv_cache_int8: bool = False
+    # Per-slot decode (continuous-batching serving, models.serving): the
+    # cache index is a VECTOR [B] — each batch row ("slot") sits at its
+    # own position, so requests of different lengths decode together and
+    # a finished slot can be refilled mid-flight.  Writes become
+    # per-row scatters and the causal mask goes per-slot; RoPE reads
+    # each slot's own position.  Linear full-precision cache only
+    # (window/sinks/int8-KV keep the shared-index fast path).
+    slot_decode: bool = False
     # Projection biases (BERT-style encoders; Llama-family stays False).
     use_bias: bool = False
 
@@ -208,6 +216,9 @@ class MultiHeadAttention(nn.Module):
                     "segment ids and explicit positions are not supported "
                     "in decode mode (the cache index supplies positions)")
             return self._decode_step(x_q)
+        if self.slot_decode:
+            raise ValueError("slot_decode requires decode=True (it is a "
+                             "KV-cache mode)")
         if segment_ids is not None and x_kv is not None:
             raise ValueError(
                 "segment_ids (sequence packing) applies to self-attention "
@@ -298,6 +309,14 @@ class MultiHeadAttention(nn.Module):
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True needs cache_len > 0")
+        if self.slot_decode:
+            if (self.window is not None or self.sinks
+                    or self.kv_cache_int8):
+                raise ValueError(
+                    "slot_decode (per-slot cache positions) supports the "
+                    "LINEAR full-precision cache only — window/sinks/"
+                    "kv_cache_int8 keep the shared-index path")
+            return self._slot_decode_step(x)
         if self.sinks and (self.window is None
                            or self.sinks > self.window):
             raise ValueError(
@@ -451,6 +470,55 @@ class MultiHeadAttention(nn.Module):
             sel, jnp.take(k, row, axis=1).astype(kdt), sink_k.value)
         sink_v.value = jnp.where(
             sel, jnp.take(v, row, axis=1).astype(kdt), sink_v.value)
+
+    def _slot_decode_step(self, x):
+        """Per-slot KV-cache decode: every batch row has its own index.
+
+        The continuous-batching engine (``models.serving``) keeps B
+        independent requests in flight; this is the same append-and-
+        attend contract as ``_decode_step`` with three per-slot changes:
+        the "index" cache variable is [B]; rows write via a per-row
+        scatter at each slot's own position (out-of-range positions are
+        DROPPED by jax scatter semantics — an overrun slot goes silently
+        inert, the engine's budget accounting keeps that unobservable);
+        and the causal mask compares against per-slot positions.  A
+        refilled slot's stale rows are harmless: position p's row is
+        always rewritten before any query can attend it (mask is
+        kv_pos <= position and writes happen first).
+        """
+        kv_heads = self.num_kv_heads or self.num_heads
+        b, q_len, _ = x.shape
+
+        q = self._proj(x, self.num_heads, "query")
+        k = self._proj(x, kv_heads, "key")
+        v = self._proj(x, kv_heads, "value")
+
+        cache_k = self.variable(
+            "cache", "key_cache", jnp.zeros,
+            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+        cache_v = self.variable(
+            "cache", "value_cache", jnp.zeros,
+            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
+        cur = index.value                                   # [B]
+        positions = cur[:, None] + jnp.arange(q_len)        # [B, q]
+        if self.use_rope:
+            q = apply_rope(q, positions, base=self.rope_base)
+            k = apply_rope(k, positions, base=self.rope_base)
+        index.value = cur + q_len
+
+        kdt = cache_k.value.dtype
+        bidx = jnp.arange(b)[:, None]
+        cache_k.value = cache_k.value.at[bidx, positions].set(
+            k.astype(kdt))
+        cache_v.value = cache_v.value.at[bidx, positions].set(
+            v.astype(kdt))
+        kv_pos = jnp.arange(self.cache_len)
+        mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B,q,C]
+        return self._cache_attend(q, cache_k.value, cache_v.value,
+                                  mask[:, None], kv_heads, b, q_len,
+                                  x.shape[-1])
 
     def _cache_attend(self, q, kc, vc, mask, kv_heads, b, q_len, features):
         """Masked einsum attention of q over the cache buffers."""

@@ -169,6 +169,7 @@ class DecoderBlock(nn.Module):
     config: LlamaConfig
     decode: bool = False
     cache_len: int = 0
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -185,6 +186,7 @@ class DecoderBlock(nn.Module):
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
             kv_cache_int8=cfg.kv_cache_int8,
+            slot_decode=self.slot_decode,
             name="attention",
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
@@ -232,12 +234,14 @@ class _BlockStep(nn.Module):
     config: LlamaConfig
     decode: bool = False
     cache_len: int = 0
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, carry, aux):
         segment_ids, positions = aux if aux is not None else (None, None)
         return DecoderBlock(self.config, decode=self.decode,
                             cache_len=self.cache_len,
+                            slot_decode=self.slot_decode,
                             name="block")(carry, segment_ids,
                                           positions), None
 
@@ -249,14 +253,20 @@ class _ScannedBlock(nn.Module):
     config: LlamaConfig
     decode: bool = False
     cache_len: int = 0
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
         from functools import partial as _partial
 
+        # slot_decode threads through BOTH branches so the layer guard
+        # ("slot_decode requires decode=True") fires under scan_layers
+        # exactly as it does on the unscanned path.
         step = (_partial(_BlockStep, decode=True,
-                         cache_len=self.cache_len) if self.decode
-                else _BlockStep)
+                         cache_len=self.cache_len,
+                         slot_decode=self.slot_decode) if self.decode
+                else _partial(_BlockStep,
+                              slot_decode=self.slot_decode))
         # No remat in decode mode: there is no backward pass to save memory
         # for, and the KV-cache writes must not replay under a checkpoint.
         if wants_outer_remat(self.config) and not self.decode:
@@ -340,6 +350,11 @@ class LlamaModel(nn.Module):
     # generations from a long-context config don't allocate (and attend
     # over) the full max_positions cache.
     cache_len: int = 0
+    # Per-slot cache positions (continuous-batching serving,
+    # models.serving): the cache "index" is a [B] vector, one position
+    # per slot.  Linear full-precision cache only — see
+    # layers.MultiHeadAttention.slot_decode.
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None):
@@ -368,7 +383,8 @@ class LlamaModel(nn.Module):
                                   segment_ids, positions)
         elif cfg.scan_layers:
             x = _ScannedBlock(cfg, decode=self.decode,
-                              cache_len=self.cache_len, name="layers")(
+                              cache_len=self.cache_len,
+                              slot_decode=self.slot_decode, name="layers")(
                 x, segment_ids, positions)
         else:
             for i in range(cfg.num_layers):
@@ -377,7 +393,8 @@ class LlamaModel(nn.Module):
                     blk = nn.remat(blk, prevent_cse=False,
                                    policy=_checkpoint_policy(cfg))
                 x = blk(cfg, decode=self.decode,
-                        cache_len=self.cache_len, name=f"layer_{i}")(
+                        cache_len=self.cache_len,
+                        slot_decode=self.slot_decode, name=f"layer_{i}")(
                     x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)

@@ -1,0 +1,265 @@
+"""Continuous-batching serving engine (slot-refill decode).
+
+Beyond the reference (a training harness — SURVEY.md §2.1: its SFT
+config produces a model users sample from elsewhere): an online serving
+loop in the JetStream/Orca style, TPU-first throughout.  ``generate()``
+(models/generate.py) serves one static batch: every request waits for
+the slowest.  This engine keeps ``slots`` requests in flight over ONE
+static-shaped decode program:
+
+- **prefill** runs each arriving prompt alone (batch 1, bucketed
+  lengths so a handful of compiles cover every prompt), producing that
+  request's per-layer KV rows and first token;
+- **insert** copies those rows into a free slot of the big [slots,
+  cache_len] cache and pins the slot's per-slot position (the
+  ``slot_decode`` cache keeps a VECTOR index — each slot advances from
+  its own length; ``layers.MultiHeadAttention._slot_decode_step``);
+- **decode chunks** step all slots together ``chunk`` tokens at a time
+  (one fetch per chunk, not per token — the tunnel round-trip lesson
+  from bench_generate); the host harvests finished requests (EOS or
+  budget) between chunks and refills their slots from the queue.
+
+Shapes are static everywhere (slot count, cache rows, chunk length,
+prompt buckets) — only cache *contents* and the per-slot index vector
+change, so XLA compiles three programs total and reuses them for the
+whole serving session.
+
+Scope: the Llama decoder family, full-precision linear cache, greedy
+decoding (the parity-testable core).  int8 weights/KV, LoRA-unmerged
+params and sliding windows keep the shared-index ``generate()`` path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu.models.generate import (
+    cast_floating,
+    has_lora_leaves,
+)
+from tensorflow_train_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModel,
+)
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request_id: int
+    remaining: int                 # generated tokens still allowed
+    tokens: list                   # prompt + generated so far
+    last_token: int                # feeds the next decode step
+    done: bool = False
+
+
+def _bucket_len(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest prefill "
+                     f"bucket {buckets[-1]}")
+
+
+class ServingEngine:
+    """Continuous-batching greedy decoder over a fixed slot grid.
+
+    ``submit()`` requests, then ``run()`` to completion: each request's
+    output is token-identical to ``generate(config, params, prompt,
+    max_new)`` greedy (pinned by tests/test_serving.py) — slots only
+    change *when* work happens, never the math: per-slot positions give
+    every request the same RoPE/mask view it would have alone.
+    """
+
+    def __init__(self, config: LlamaConfig, params, *, slots: int = 8,
+                 cache_len: Optional[int] = None, eos_id: Optional[int] = None,
+                 chunk: int = 8, cast_params: bool = True,
+                 prompt_buckets=(32, 64, 128, 256, 512, 1024)):
+        if config.sliding_window is not None or config.kv_cache_int8:
+            raise ValueError(
+                "the serving engine uses the per-slot linear cache; "
+                "sliding_window / kv_cache_int8 configs serve through "
+                "models.generate")
+        if has_lora_leaves(params):
+            raise ValueError(
+                "merge LoRA adapters before engine serving: params = "
+                "models.lora.merge_lora(params, spec)")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.config = config
+        self.slots = slots
+        self.cache_len = cache_len or config.max_positions
+        if self.cache_len > config.max_positions:
+            raise ValueError(
+                f"cache_len {self.cache_len} exceeds max_positions "
+                f"{config.max_positions}")
+        self.eos_id = eos_id
+        self.chunk = chunk
+        self.prompt_buckets = tuple(
+            b for b in sorted(prompt_buckets) if b <= self.cache_len)
+        if not self.prompt_buckets:
+            raise ValueError("no prompt bucket fits cache_len")
+        if cast_params:
+            params = cast_floating(params, config.dtype)
+        self._params = params
+        self._model = LlamaModel(config, decode=True,
+                                 cache_len=self.cache_len,
+                                 slot_decode=True)
+        self._queue: deque = deque()
+        self._outputs: dict = {}
+        self._next_id = 0
+        self._slot_states: list[Optional[_SlotState]] = [None] * slots
+        self._cache = None  # built lazily on first insert (needs params)
+
+    # -- jitted programs ---------------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _prefill(self, params, prompt_1xl, true_len):
+        """Batch-1 prefill of a right-padded prompt.
+
+        Pad rows are harmless: causal masking keeps them invisible to
+        the real rows (they sit AFTER every real position), the first
+        token reads the logit at ``true_len - 1``, and insert() pins the
+        slot's index to ``true_len`` so decode overwrites each pad row
+        before any query can attend it (writes precede reads at every
+        position).
+        """
+        logits, vs = self._model.apply(
+            {"params": params}, prompt_1xl, mutable=["cache"])
+        first = jnp.argmax(
+            logits[0, true_len - 1].astype(jnp.float32), -1)
+        return vs["cache"], first.astype(prompt_1xl.dtype)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _insert(self, cache_b, cache_1, slot, true_len):
+        """Copy a prefilled request's cache rows into ``slot`` and pin
+        the slot's per-slot index to the TRUE prompt length.  Leaves are
+        [..., B, C, kv_heads, head_dim] (a leading layer axis under
+        scan_layers) and the index [..., B]."""
+        def ins(path, pb, p1):
+            if any(getattr(k, "key", "") == "index" for k in path):
+                return pb.at[..., slot].set(true_len)
+            return jax.lax.dynamic_update_slice_in_dim(
+                pb, p1, slot, axis=pb.ndim - 4)
+
+        return jax.tree_util.tree_map_with_path(ins, cache_b, cache_1)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _decode_chunk(self, params, cache, tok):
+        """``chunk`` greedy steps for all slots; one device round-trip."""
+        def step(carry, _):
+            cache, tok = carry
+            logits, upd = self._model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"])
+            nxt = jnp.argmax(
+                logits[:, -1].astype(jnp.float32), -1).astype(tok.dtype)
+            return (upd["cache"], nxt), nxt
+
+        (cache, _), toks = jax.lax.scan(
+            step, (cache, tok), None, length=self.chunk)
+        return cache, jnp.moveaxis(toks, 0, 1)      # [slots, chunk]
+
+    # -- host-side loop ----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Enqueue a request; returns its id (resolved by ``run()``)."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got "
+                             f"{max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + {max_new_tokens} new exceeds "
+                f"cache_len={self.cache_len}")
+        if len(prompt) > self.prompt_buckets[-1]:
+            # Catch at submit time: failing later inside run() would
+            # drop this request silently and abort others mid-flight.
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prefill bucket {self.prompt_buckets[-1]}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, max_new_tokens))
+        return rid
+
+    def _fresh_cache(self):
+        shapes = jax.eval_shape(
+            lambda p: self._model.apply(
+                {"params": p}, jnp.zeros((self.slots, 1), jnp.int32),
+                mutable=["cache"])[1]["cache"],
+            self._params)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def _fill_free_slots(self):
+        for slot in range(self.slots):
+            if self._slot_states[slot] is not None or not self._queue:
+                continue
+            rid, prompt, max_new = self._queue.popleft()
+            if max_new == 0:
+                self._outputs[rid] = list(prompt)
+                continue
+            blen = _bucket_len(len(prompt), self.prompt_buckets)
+            padded = np.zeros((1, blen), np.int32)
+            padded[0, :len(prompt)] = prompt
+            cache_1, first = self._prefill(
+                self._params, jnp.asarray(padded),
+                jnp.int32(len(prompt)))
+            first = int(first)
+            state = _SlotState(request_id=rid, remaining=max_new - 1,
+                               tokens=list(prompt) + [first],
+                               last_token=first)
+            if (max_new == 1
+                    or (self.eos_id is not None and first == self.eos_id)):
+                self._outputs[rid] = state.tokens
+                continue  # slot stays free for the next request
+            if self._cache is None:
+                self._cache = self._fresh_cache()
+            self._cache = self._insert(
+                self._cache, cache_1, jnp.int32(slot),
+                jnp.int32(len(prompt)))
+            self._slot_states[slot] = state
+
+    def _harvest(self, toks: np.ndarray):
+        for slot, state in enumerate(self._slot_states):
+            if state is None:
+                continue
+            for t in toks[slot]:
+                t = int(t)
+                state.tokens.append(t)
+                state.last_token = t
+                state.remaining -= 1
+                if (state.remaining <= 0
+                        or (self.eos_id is not None and t == self.eos_id)):
+                    state.done = True
+                    break
+            if state.done:
+                self._outputs[state.request_id] = state.tokens
+                self._slot_states[slot] = None
+
+    def run(self) -> dict:
+        """Serve every submitted request to completion; returns
+        ``{request_id: [prompt + generated tokens]}``."""
+        while self._queue or any(s is not None for s in self._slot_states):
+            self._fill_free_slots()
+            if not any(s is not None for s in self._slot_states):
+                continue  # everything resolved at prefill time
+            tok = np.zeros((self.slots,), np.int32)
+            for slot, state in enumerate(self._slot_states):
+                if state is not None:
+                    tok[slot] = state.last_token
+            self._cache, toks = self._decode_chunk(
+                self._params, self._cache, jnp.asarray(tok))
+            self._harvest(np.asarray(toks))
+        out, self._outputs = self._outputs, {}
+        return out

@@ -1,0 +1,168 @@
+"""Continuous-batching serving engine tests.
+
+North star: engine output is TOKEN-IDENTICAL to ``generate()`` greedy
+for every request, regardless of slot contention, arrival order, prompt
+bucketing, or mid-flight refills — the engine changes *when* work
+happens, never the math (per-slot cache positions give each request the
+same RoPE/mask view it would have alone).
+"""
+
+import dataclasses
+
+import pytest
+
+pytestmark = pytest.mark.slow  # decode-scan compiles: full-suite tier
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu.models.generate import generate
+from tensorflow_train_distributed_tpu.models.llama import (
+    LLAMA_PRESETS,
+    LlamaModel,
+)
+from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+CFG = LLAMA_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaModel(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ref(params, prompt, max_new):
+    return np.asarray(generate(
+        CFG, params, jnp.asarray([prompt], jnp.int32), max_new))[0].tolist()
+
+
+def test_engine_matches_generate_with_refills(params):
+    """Six requests through two slots: every slot refills at least once,
+    prompt lengths span two buckets, one request finishes at prefill
+    (max_new=1) and one is a no-op (max_new=0)."""
+    rng = np.random.default_rng(0)
+    eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=4,
+                        prompt_buckets=(8, 16))
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 6), (3, 9), (7, 4), (4, 12), (6, 1), (2, 0)]]
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    for rid, (p, m) in zip(ids, reqs):
+        assert out[rid] == _ref(params, p, m), f"request {rid}"
+
+
+def test_engine_single_slot_serializes_correctly(params):
+    rng = np.random.default_rng(1)
+    eng = ServingEngine(CFG, params, slots=1, cache_len=32, chunk=3,
+                        prompt_buckets=(8,))
+    reqs = [(list(rng.integers(1, 200, 4)), 5),
+            (list(rng.integers(1, 200, 6)), 7)]
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    for rid, (p, m) in zip(ids, reqs):
+        assert out[rid] == _ref(params, p, m)
+
+
+def test_eos_stops_early(params):
+    """eos_id cut: the engine's output is generate()'s, truncated right
+    after the first EOS occurrence in the continuation."""
+    rng = np.random.default_rng(2)
+    prompt = list(rng.integers(1, 200, 5))
+    full = _ref(params, prompt, 12)
+    continuation = full[len(prompt):]
+    eos = continuation[3]  # stop after the 4th generated token (or
+    #                        earlier if it repeats before index 3)
+    cut = continuation.index(eos) + 1
+    eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=4,
+                        prompt_buckets=(8,), eos_id=eos)
+    rid = eng.submit(prompt, 12)
+    out = eng.run()
+    assert out[rid] == full[:len(prompt) + cut]
+
+
+def test_run_is_reentrant(params):
+    """A second submit/run cycle on the same engine reuses the compiled
+    programs and stale slot caches without contamination."""
+    rng = np.random.default_rng(3)
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32, chunk=4,
+                        prompt_buckets=(8,))
+    p1 = list(rng.integers(1, 200, 5))
+    rid1 = eng.submit(p1, 6)
+    assert eng.run()[rid1] == _ref(params, p1, 6)
+    p2 = list(rng.integers(1, 200, 7))
+    rid2 = eng.submit(p2, 5)
+    assert eng.run()[rid2] == _ref(params, p2, 5)
+
+
+def test_validation_errors(params):
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32,
+                        prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit([1] * 30, 10)           # prompt+new > cache_len
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit([1] * 20, 2)            # no bucket >= 20
+        eng.run()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], -1)
+    wcfg = dataclasses.replace(CFG, sliding_window=8)
+    with pytest.raises(ValueError, match="sliding_window"):
+        ServingEngine(wcfg, params)
+    icfg = dataclasses.replace(CFG, kv_cache_int8=True)
+    with pytest.raises(ValueError, match="kv_cache_int8|linear"):
+        ServingEngine(icfg, params)
+
+
+def test_slot_decode_layer_guards():
+    from tensorflow_train_distributed_tpu.models import layers as L
+
+    x = jnp.zeros((2, 4, 16))
+    attn = L.MultiHeadAttention(num_heads=2, head_dim=8, slot_decode=True)
+    with pytest.raises(ValueError, match="decode=True"):
+        attn.init(jax.random.PRNGKey(0), x)
+    attn = L.MultiHeadAttention(num_heads=2, head_dim=8, decode=True,
+                                cache_len=8, slot_decode=True, window=4)
+    with pytest.raises(ValueError, match="LINEAR"):
+        attn.init(jax.random.PRNGKey(0), x)
+
+
+def test_slot_decode_without_decode_raises_under_scan_layers():
+    """The guard must fire on the depth-scanned path too (slot_decode
+    threads through both _ScannedBlock branches)."""
+    cfg = dataclasses.replace(CFG, scan_layers=True)
+    model = LlamaModel(cfg, slot_decode=True)  # decode left False
+    with pytest.raises(ValueError, match="decode=True"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_submit_rejects_over_bucket_prompt(params):
+    """Over-bucket prompts fail at submit() — failing inside run()
+    would silently drop the request and abort others mid-flight."""
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32,
+                        prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit([1] * 12, 2)
+
+
+def test_slot_decode_matches_shared_index_when_uniform():
+    """With every slot at the same position, the per-slot path must
+    reproduce the shared-index decode numerics exactly."""
+    cfg = CFG
+    tok = jnp.asarray(
+        np.random.default_rng(4).integers(1, 200, (2, 12)), jnp.int32)
+    m_reg = LlamaModel(cfg, decode=True, cache_len=16)
+    m_slot = LlamaModel(cfg, decode=True, cache_len=16, slot_decode=True)
+    v = m_reg.init(jax.random.PRNGKey(0), tok[:, :1])
+    params = {"params": v["params"]}
+    lr, cr = m_reg.apply(params, tok, mutable=["cache"])
+    ls, cs = m_slot.apply(params, tok, mutable=["cache"])
+    np.testing.assert_array_equal(np.asarray(lr), np.asarray(ls))
+    nt = jnp.argmax(lr[:, -1], -1)[:, None].astype(jnp.int32)
+    lr2, _ = m_reg.apply(dict(params, cache=cr["cache"]), nt,
+                         mutable=["cache"])
+    ls2, _ = m_slot.apply(dict(params, cache=cs["cache"]), nt,
+                          mutable=["cache"])
+    np.testing.assert_array_equal(np.asarray(lr2), np.asarray(ls2))

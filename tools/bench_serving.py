@@ -1,0 +1,163 @@
+"""Continuous-batching engine throughput vs static-batch generate.
+
+Serves a mixed-length synthetic request stream through
+``serving.ServingEngine`` (slot-refill decode) and reports GENERATED
+tokens/sec.  ``--baseline`` also times the static-batch path the engine
+replaces — same requests grouped into arrival-order batches of
+``--slots``, each batch padded to its longest prompt and decoded for its
+largest max_new (what ``generate()`` forces) — so the engine's win IS
+the padding/straggler waste it removes.
+
+Prints one JSON line per run (bench_lm.py conventions).
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _requests(n, plo, phi, glo, ghi, vocab, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [(list(rng.integers(1, vocab, int(rng.integers(plo, phi + 1)))),
+             int(rng.integers(glo, ghi + 1))) for _ in range(n)]
+
+
+def bench_serving(preset, slots, chunk, n_requests, prompt_range,
+                  new_range, cache_len, baseline, seed):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.models.generate import generate
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    reqs = _requests(n_requests, *prompt_range, *new_range,
+                     min(cfg.vocab_size, 30_000), seed)
+    gen_tokens = sum(m for _, m in reqs)
+
+    # ONE engine for warmup + timed runs: the jitted programs are keyed
+    # on the engine instance (static self), so a fresh engine would pay
+    # every compile again inside the timed region.  run() is reentrant
+    # (tests/test_serving.py) — stale slot caches cannot contaminate.
+    eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                        cache_len=cache_len)
+
+    def run_engine():
+        for p, m in reqs:
+            eng.submit(p, m)
+        out = eng.run()
+        # Materialize (run() already fetched host-side token lists).
+        return sum(len(v) for v in out.values())
+
+    run_engine()                                   # warmup: compiles
+    t0 = time.perf_counter()
+    total_len = run_engine()
+    dt = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"{preset}_serving_engine_tokens_per_sec",
+        "value": round(gen_tokens / dt, 1),
+        "unit": "generated tokens/sec",
+        "wall_s": round(dt, 3),
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n_requests,
+        "gen_tokens": gen_tokens,
+        "total_tokens_out": total_len,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+    if baseline:
+        def run_static():
+            done = 0
+            for i in range(0, len(reqs), slots):
+                grp = reqs[i:i + slots]
+                plen = max(len(p) for p, _ in grp)
+                mnew = max(m for _, m in grp)
+                if mnew == 0:
+                    continue
+                batch = np.zeros((len(grp), plen), np.int32)
+                for j, (p, _) in enumerate(grp):
+                    batch[j, plen - len(p):] = p  # left-pad: keeps the
+                    # last prompt token at the shared final position so
+                    # one batched generate covers the group
+                out = generate(cfg, params, jnp.asarray(batch), mnew)
+                done += int(np.asarray(out).shape[1]) * len(grp)
+            return done
+
+        run_static()                               # warmup
+        t0 = time.perf_counter()
+        run_static()
+        dt_static = time.perf_counter() - t0
+        rec["static_batch_wall_s"] = round(dt_static, 3)
+        rec["static_batch_tokens_per_sec"] = round(gen_tokens / dt_static, 1)
+        rec["engine_speedup"] = round(dt_static / dt, 3)
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="llama_125m")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt-range", default="16,120",
+                   help="lo,hi inclusive prompt lengths")
+    p.add_argument("--new-range", default="16,128",
+                   help="lo,hi inclusive max_new_tokens")
+    p.add_argument("--cache-len", type=int, default=0,
+                   help="0 -> config.max_positions")
+    p.add_argument("--baseline", action="store_true",
+                   help="also time the static-batch generate path")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform ('cpu' for smoke runs)")
+    args = p.parse_args(argv)
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+    if args.platform and args.platform != "tpu":
+        cm = contextlib.nullcontext()
+    else:
+        from tensorflow_train_distributed_tpu.runtime.chip_lock import (
+            chip_lock,
+        )
+
+        cm = chip_lock()
+    prompt_range = tuple(int(x) for x in args.prompt_range.split(","))
+    new_range = tuple(int(x) for x in args.new_range.split(","))
+    try:
+        with cm:
+            rec = bench_serving(args.preset, args.slots, args.chunk,
+                                args.requests, prompt_range, new_range,
+                                args.cache_len or None, args.baseline,
+                                args.seed)
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"{args.preset}_serving_engine_tokens_per_sec",
+            "value": 0.0, "unit": "generated tokens/sec",
+            "error": f"{type(e).__name__}: {e}"}), flush=True)
+        return 1
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

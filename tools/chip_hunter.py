@@ -175,6 +175,14 @@ STEPS = [
      [sys.executable, "tools/bench_moe.py", "--preset", "moe_370m",
       "--batch-per-chip", "8", "--seq", "1024", "--iters", "10",
       "--dispatch", "gmm"]),
+    # Continuous-batching engine vs static-batch generate: mixed-length
+    # request stream; the speedup IS the padding/straggler waste removed
+    # (models/serving.py).
+    ("serve_engine", 900,
+     [sys.executable, "tools/bench_serving.py", "--preset", "llama_125m",
+      "--slots", "8", "--chunk", "8", "--requests", "32",
+      "--prompt-range", "16,120", "--new-range", "16,128",
+      "--baseline"]),
     # Decoder step-time breakdown: the committed trace feeding the next
     # MFU push (where do the 502 ms go at 125m/no_ffn?).
     ("lm_profile", 700,
