@@ -33,18 +33,20 @@ from tensorflow_train_distributed_tpu.models.quant import (
 )
 
 
-def _decode_model(config, cache_len: int):
+def _decode_model(config, cache_len: int, slot_decode: bool = False):
     """The decode-mode model for a decoder-family config: LlamaModel for
     LlamaConfig, MoeLmModel for MoeConfig (Mixtral-style) — one generate
-    path serves every decoder family."""
+    path serves every decoder family.  ``slot_decode`` selects the
+    per-slot cache-index mode (serving.ServingEngine); this is the ONE
+    family-dispatch point, shared by generate and the engine."""
     from tensorflow_train_distributed_tpu.models.moe import (
         MoeConfig,
         MoeLmModel,
     )
 
-    if isinstance(config, MoeConfig):
-        return MoeLmModel(config, decode=True, cache_len=cache_len)
-    return LlamaModel(config, decode=True, cache_len=cache_len)
+    cls = MoeLmModel if isinstance(config, MoeConfig) else LlamaModel
+    return cls(config, decode=True, cache_len=cache_len,
+               slot_decode=slot_decode)
 
 
 def cast_floating(params, dtype):

@@ -167,7 +167,7 @@ class MultiHeadAttention(nn.Module):
     # the rolling window cache (roll/concat would need scale plumbing;
     # the window already bounds cache memory).
     kv_cache_int8: bool = False
-    # Per-slot decode (continuous-batching serving, models.serving): the
+    # Per-slot decode (continuous-batching serving, serving.ServingEngine): the
     # cache index is a VECTOR [B] — each batch row ("slot") sits at its
     # own position, so requests of different lengths decode together and
     # a finished slot can be refilled mid-flight.  Writes become
@@ -474,7 +474,7 @@ class MultiHeadAttention(nn.Module):
     def _slot_decode_step(self, x):
         """Per-slot KV-cache decode: every batch row has its own index.
 
-        The continuous-batching engine (``models.serving``) keeps B
+        The continuous-batching engine (``serving.ServingEngine``) keeps B
         independent requests in flight; this is the same append-and-
         attend contract as ``_decode_step`` with three per-slot changes:
         the "index" cache variable is [B]; rows write via a per-row

@@ -351,7 +351,7 @@ class LlamaModel(nn.Module):
     # over) the full max_positions cache.
     cache_len: int = 0
     # Per-slot cache positions (continuous-batching serving,
-    # models.serving): the cache "index" is a [B] vector, one position
+    # serving.ServingEngine): the cache "index" is a [B] vector, one position
     # per slot.  Linear full-precision cache only — see
     # layers.MultiHeadAttention.slot_decode.
     slot_decode: bool = False

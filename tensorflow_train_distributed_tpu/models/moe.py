@@ -381,6 +381,7 @@ class MoeDecoderBlock(nn.Module):
     # one token, capacity is >= 1 per expert, so routing never drops.
     decode: bool = False
     cache_len: int = 0
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -395,6 +396,7 @@ class MoeDecoderBlock(nn.Module):
             rope_base=cfg.rope_base, name="attention",
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
+            slot_decode=self.slot_decode,
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
@@ -425,6 +427,9 @@ class MoeLmModel(nn.Module):
     # caveat as packed segments above).
     decode: bool = False
     cache_len: int = 0
+    # Per-slot cache positions (continuous-batching serving,
+    # serving.ServingEngine) — see layers.MultiHeadAttention.slot_decode.
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None):
@@ -457,6 +462,7 @@ class MoeLmModel(nn.Module):
                 blk = nn.remat(blk, prevent_cse=False)
             x = blk(cfg, use_moe=(i % cfg.moe_every == 0),
                     decode=self.decode, cache_len=self.cache_len,
+                    slot_decode=self.slot_decode,
                     name=f"layer_{i}")(x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)

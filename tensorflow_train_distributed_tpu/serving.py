@@ -24,7 +24,8 @@ prompt buckets) — only cache *contents* and the per-slot index vector
 change, so XLA compiles three programs total and reuses them for the
 whole serving session.
 
-Scope: the Llama decoder family, full-precision linear cache, greedy
+Scope: the decoder families ``generate()`` serves (Llama AND
+Mixtral-style MoE — one engine), full-precision linear cache, greedy
 decoding (the parity-testable core).  int8 weights/KV, LoRA-unmerged
 params and sliding windows keep the shared-index ``generate()`` path.
 """
@@ -41,12 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorflow_train_distributed_tpu.models.generate import (
+    _decode_model,
     cast_floating,
     has_lora_leaves,
-)
-from tensorflow_train_distributed_tpu.models.llama import (
-    LlamaConfig,
-    LlamaModel,
 )
 
 
@@ -77,15 +75,19 @@ class ServingEngine:
     every request the same RoPE/mask view it would have alone.
     """
 
-    def __init__(self, config: LlamaConfig, params, *, slots: int = 8,
+    def __init__(self, config, params, *, slots: int = 8,
                  cache_len: Optional[int] = None, eos_id: Optional[int] = None,
                  chunk: int = 8, cast_params: bool = True,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
-        if config.sliding_window is not None or config.kv_cache_int8:
+        # MoeConfig has no window/int8-KV knobs; getattr keeps one check
+        # covering both decoder families.
+        if (getattr(config, "sliding_window", None) is not None
+                or getattr(config, "kv_cache_int8", False)
+                or getattr(config, "attention_sinks", 0)):
             raise ValueError(
                 "the serving engine uses the per-slot linear cache; "
-                "sliding_window / kv_cache_int8 configs serve through "
-                "models.generate")
+                "sliding_window / attention_sinks / kv_cache_int8 "
+                "configs serve through models.generate")
         if has_lora_leaves(params):
             raise ValueError(
                 "merge LoRA adapters before engine serving: params = "
@@ -103,16 +105,24 @@ class ServingEngine:
                 f"{config.max_positions}")
         self.eos_id = eos_id
         self.chunk = chunk
+        # MoE prefill must run at the EXACT prompt length: the router's
+        # per-group capacity is ⌈cf·k·S/E⌉ — a bucket-padded S changes
+        # the capacity constant, so drop behavior (and therefore tokens)
+        # would diverge from generate()'s unpadded prefill.  Exact
+        # lengths cost one prefill compile per distinct length instead
+        # of per bucket (and the buckets are never consulted).
+        from tensorflow_train_distributed_tpu.models.moe import MoeConfig
+
+        self._exact_prefill = isinstance(config, MoeConfig)
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.cache_len)
-        if not self.prompt_buckets:
+        if not self.prompt_buckets and not self._exact_prefill:
             raise ValueError("no prompt bucket fits cache_len")
         if cast_params:
             params = cast_floating(params, config.dtype)
         self._params = params
-        self._model = LlamaModel(config, decode=True,
-                                 cache_len=self.cache_len,
-                                 slot_decode=True)
+        self._model = _decode_model(config, self.cache_len,
+                                    slot_decode=True)
         self._queue: deque = deque()
         self._outputs: dict = {}
         self._next_id = 0
@@ -182,7 +192,8 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} new exceeds "
                 f"cache_len={self.cache_len}")
-        if len(prompt) > self.prompt_buckets[-1]:
+        if (not self._exact_prefill
+                and len(prompt) > self.prompt_buckets[-1]):
             # Catch at submit time: failing later inside run() would
             # drop this request silently and abort others mid-flight.
             raise ValueError(
@@ -203,32 +214,36 @@ class ServingEngine:
 
     def _fill_free_slots(self):
         for slot in range(self.slots):
-            if self._slot_states[slot] is not None or not self._queue:
-                continue
-            rid, prompt, max_new = self._queue.popleft()
-            if max_new == 0:
-                self._outputs[rid] = list(prompt)
-                continue
-            blen = _bucket_len(len(prompt), self.prompt_buckets)
-            padded = np.zeros((1, blen), np.int32)
-            padded[0, :len(prompt)] = prompt
-            cache_1, first = self._prefill(
-                self._params, jnp.asarray(padded),
-                jnp.int32(len(prompt)))
-            first = int(first)
-            state = _SlotState(request_id=rid, remaining=max_new - 1,
-                               tokens=list(prompt) + [first],
-                               last_token=first)
-            if (max_new == 1
-                    or (self.eos_id is not None and first == self.eos_id)):
-                self._outputs[rid] = state.tokens
-                continue  # slot stays free for the next request
-            if self._cache is None:
-                self._cache = self._fresh_cache()
-            self._cache = self._insert(
-                self._cache, cache_1, jnp.int32(slot),
-                jnp.int32(len(prompt)))
-            self._slot_states[slot] = state
+            # Keep popping until this slot is OCCUPIED or the queue is
+            # dry: a request that resolves at prefill time (max_new<=1
+            # or first-token EOS) must not leave the slot idle for a
+            # whole decode chunk while runnable work waits.
+            while self._slot_states[slot] is None and self._queue:
+                rid, prompt, max_new = self._queue.popleft()
+                if max_new == 0:
+                    self._outputs[rid] = list(prompt)
+                    continue
+                blen = (len(prompt) if self._exact_prefill
+                        else _bucket_len(len(prompt), self.prompt_buckets))
+                padded = np.zeros((1, blen), np.int32)
+                padded[0, :len(prompt)] = prompt
+                cache_1, first = self._prefill(
+                    self._params, jnp.asarray(padded),
+                    jnp.int32(len(prompt)))
+                first = int(first)
+                state = _SlotState(request_id=rid, remaining=max_new - 1,
+                                   tokens=list(prompt) + [first],
+                                   last_token=first)
+                if (max_new == 1 or (self.eos_id is not None
+                                     and first == self.eos_id)):
+                    self._outputs[rid] = state.tokens
+                    continue  # slot still free: try the next request
+                if self._cache is None:
+                    self._cache = self._fresh_cache()
+                self._cache = self._insert(
+                    self._cache, cache_1, jnp.int32(slot),
+                    jnp.int32(len(prompt)))
+                self._slot_states[slot] = state
 
     def _harvest(self, toks: np.ndarray):
         for slot, state in enumerate(self._slot_states):

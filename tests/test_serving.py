@@ -105,7 +105,6 @@ def test_validation_errors(params):
         eng.submit([1] * 30, 10)           # prompt+new > cache_len
     with pytest.raises(ValueError, match="bucket"):
         eng.submit([1] * 20, 2)            # no bucket >= 20
-        eng.run()
     with pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit([1, 2], -1)
     wcfg = dataclasses.replace(CFG, sliding_window=8)
@@ -136,6 +135,28 @@ def test_slot_decode_without_decode_raises_under_scan_layers():
     model = LlamaModel(cfg, slot_decode=True)  # decode left False
     with pytest.raises(ValueError, match="decode=True"):
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_moe_family_matches_generate():
+    """One engine serves the MoE decoder family too (same dispatch rule
+    as generate): token-identical under contention and refill."""
+    from tensorflow_train_distributed_tpu.models import moe
+
+    cfg = moe.MOE_PRESETS["moe_tiny"]
+    rng = np.random.default_rng(5)
+    params = moe.MoeLmModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ServingEngine(cfg, params, slots=2, cache_len=32, chunk=3,
+                        prompt_buckets=(8,))
+    reqs = [(list(rng.integers(1, cfg.vocab_size, n)), m)
+            for n, m in [(4, 6), (6, 5), (3, 8)]]
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    for rid, (p, m) in zip(ids, reqs):
+        ref = np.asarray(generate(
+            cfg, params, jnp.asarray([p], jnp.int32), m))[0].tolist()
+        assert out[rid] == ref, f"moe request {rid}"
 
 
 def test_submit_rejects_over_bucket_prompt(params):

@@ -177,7 +177,7 @@ STEPS = [
       "--dispatch", "gmm"]),
     # Continuous-batching engine vs static-batch generate: mixed-length
     # request stream; the speedup IS the padding/straggler waste removed
-    # (models/serving.py).
+    # (serving.py).
     ("serve_engine", 900,
      [sys.executable, "tools/bench_serving.py", "--preset", "llama_125m",
       "--slots", "8", "--chunk", "8", "--requests", "32",
