@@ -193,6 +193,18 @@ def _setup():
              dataset="lm",
              dataset_kwargs=dict(vocab_size=256, seq_len=32),
              strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
+    # Dropless (megablox grouped-matmul) dispatch variant: same params/
+    # data/seed as moe_tiny_lm, only the expert data movement differs —
+    # the convergence-certification pair for MoeConfig.dispatch="gmm"
+    # (profiles/convergence/).  dp strategy: gmm is the single-shard
+    # formulation; expert-sharded meshes keep the dense dispatch.
+    register("moe_tiny_lm_gmm",
+             task_factory=lambda: moe.make_task(
+                 dataclasses.replace(moe.MOE_PRESETS["moe_tiny"],
+                                     dispatch="gmm")),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp", global_batch_size=16, learning_rate=1e-3)
     register("llama_tiny_sft",
              task_factory=lambda: llama.make_task(
                  llama.LLAMA_PRESETS["llama_tiny"]),

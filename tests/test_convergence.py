@@ -76,6 +76,28 @@ class TestMiniConvergence:
             f"{s_last:.4f} (drop {drop:.4f})")
 
 
+    def test_moe_gmm_tracks_dense_dispatch(self):
+        """Convergence certification for MoeConfig.dispatch='gmm': the
+        dropless grouped-matmul formulation must train as well as the
+        dense GShard dispatch over several epochs (same data/LR; init
+        differs only in rng consumption order — exact forward/grad
+        parity under shared params is pinned by tests/test_moe_gmm.py,
+        so this guards the TRAJECTORY, not the math)."""
+        argv_tail = [
+            "--steps", "80", "--global-batch-size", "16",
+            "--log-every", "1", "--dataset-kwarg", "num_examples=256"]
+        dense = _losses(["--config", "moe_tiny_lm"] + argv_tail)
+        gmm = _losses(["--config", "moe_tiny_lm_gmm"] + argv_tail)
+        d_first, d_last = _quarter_means(dense)
+        g_first, g_last = _quarter_means(gmm)
+        assert d_last < 0.95 * d_first, (d_first, d_last)
+        assert g_last < 0.95 * g_first, (g_first, g_last)
+        drop = d_first - d_last
+        assert abs(d_last - g_last) < 0.5 * drop, (
+            f"gmm trajectory diverged: dense {d_last:.4f} vs gmm "
+            f"{g_last:.4f} (drop {drop:.4f})")
+
+
 class TestDatasetKwargOverride:
     def test_values_parse_as_json(self):
         entry = {"dataset_kwargs": {"image_size": 224}}

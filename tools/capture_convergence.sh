@@ -31,4 +31,13 @@ timeout 3000 python -m tensorflow_train_distributed_tpu \
     --platform cpu --log-every 1 --dataset-kwarg num_examples=1024 \
     --jsonl-log $OUT/llama_tiny_sft.jsonl >/dev/null 2>&1
 echo "done: llama_tiny_sft"
+# gmm certification pair: dense vs dropless expert dispatch, same data/LR.
+for cfg in moe_tiny_lm moe_tiny_lm_gmm; do
+  rm -f $OUT/${cfg}.jsonl
+  timeout 2500 python -m tensorflow_train_distributed_tpu \
+      --config $cfg --steps 300 --global-batch-size 16 --platform cpu \
+      --log-every 1 --dataset-kwarg num_examples=1024 \
+      --jsonl-log $OUT/${cfg}.jsonl >/dev/null 2>&1
+  echo "done: $cfg"
+done
 echo ALL_DONE
