@@ -148,18 +148,11 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
             "params carry unmerged LoRA adapters but config.lora is not "
             "set: either serve with the training config (lora=LoraSpec) "
             "or fold them in first via models.lora.merge_lora")
-    has_int8 = any(
-        getattr(x, "dtype", None) == jnp.int8
-        for x in jax.tree.leaves(params))
-    if has_int8 != (quant_scales is not None):
-        # Either pairing mistake yields plausibly-shaped garbage tokens
-        # (unscaled int8 matmuls, or scales applied to full-precision
-        # kernels) — fail loudly instead.
-        raise ValueError(
-            "int8 params and quant_scales must be passed together: got "
-            f"int8 kernels={has_int8}, quant_scales="
-            f"{'set' if quant_scales is not None else 'None'} "
-            "(both come from models.quant.quantize_params)")
+    from tensorflow_train_distributed_tpu.models.quant import (
+        check_quant_pairing,
+    )
+
+    check_quant_pairing(params, quant_scales)
     if cast_params:
         params = cast_floating(params, config.dtype)
     # top_k is static (it sets the lax.top_k shape); top_p is a TRACED

@@ -166,6 +166,28 @@ def quantized_inference():
     return nn.intercept_methods(_quant_dense_interceptor)
 
 
+def check_quant_pairing(params, quant_scales: Optional[Any]) -> None:
+    """int8 kernels and their scale tree must travel together.
+
+    Either pairing mistake yields plausibly-shaped garbage tokens
+    (unscaled int8 matmuls, or scales applied to full-precision
+    kernels) — fail loudly instead.  Shared by ``models.generate`` and
+    ``serving.ServingEngine`` so the contract cannot drift.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    has_int8 = any(
+        getattr(x, "dtype", None) == jnp.int8
+        for x in jax.tree.leaves(params))
+    if has_int8 != (quant_scales is not None):
+        raise ValueError(
+            "int8 params and quant_scales must be passed together: got "
+            f"int8 kernels={has_int8}, quant_scales="
+            f"{'set' if quant_scales is not None else 'None'} "
+            "(both come from models.quant.quantize_params)")
+
+
 def maybe_quant_variables(params, quant_scales: Optional[Any]) -> dict:
     """Assemble the apply-variables dict, attaching ``quant`` if given."""
     variables = {"params": params}

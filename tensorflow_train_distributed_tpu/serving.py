@@ -25,8 +25,9 @@ change, so XLA compiles three programs total and reuses them for the
 whole serving session.
 
 Scope: the decoder families ``generate()`` serves (Llama AND
-Mixtral-style MoE — one engine), full-precision linear cache, greedy
-decoding (the parity-testable core).  int8 weights/KV, LoRA-unmerged
+Mixtral-style MoE — one engine), linear cache, greedy decoding (the
+parity-testable core), with int8 weight-only serving via the same
+``quant_scales`` contract as generate.  int8 KV cache, LoRA-unmerged
 params and sliding windows keep the shared-index ``generate()`` path.
 """
 
@@ -45,6 +46,11 @@ from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
     cast_floating,
     has_lora_leaves,
+)
+from tensorflow_train_distributed_tpu.models.quant import (
+    check_quant_pairing,
+    maybe_quant_variables,
+    quantized_inference,
 )
 
 
@@ -78,6 +84,7 @@ class ServingEngine:
     def __init__(self, config, params, *, slots: int = 8,
                  cache_len: Optional[int] = None, eos_id: Optional[int] = None,
                  chunk: int = 8, cast_params: bool = True,
+                 quant_scales=None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
@@ -118,9 +125,14 @@ class ServingEngine:
             b for b in sorted(prompt_buckets) if b <= self.cache_len)
         if not self.prompt_buckets and not self._exact_prefill:
             raise ValueError("no prompt bucket fits cache_len")
+        # int8 weight-only serving: same pairing contract as generate()
+        # (one shared check), and every Dense runs the fused dequant
+        # path via the (free when inactive) quantized_inference
+        # interceptor.
+        check_quant_pairing(params, quant_scales)
         if cast_params:
             params = cast_floating(params, config.dtype)
-        self._params = params
+        self._variables = maybe_quant_variables(params, quant_scales)
         self._model = _decode_model(config, self.cache_len,
                                     slot_decode=True)
         self._queue: deque = deque()
@@ -132,7 +144,7 @@ class ServingEngine:
     # -- jitted programs ---------------------------------------------------
 
     @partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, params, prompt_1xl, true_len):
+    def _prefill(self, variables, prompt_1xl, true_len):
         """Batch-1 prefill of a right-padded prompt.
 
         Pad rows are harmless: causal masking keeps them invisible to
@@ -142,8 +154,9 @@ class ServingEngine:
         before any query can attend it (writes precede reads at every
         position).
         """
-        logits, vs = self._model.apply(
-            {"params": params}, prompt_1xl, mutable=["cache"])
+        with quantized_inference():
+            logits, vs = self._model.apply(
+                variables, prompt_1xl, mutable=["cache"])
         first = jnp.argmax(
             logits[0, true_len - 1].astype(jnp.float32), -1)
         return vs["cache"], first.astype(prompt_1xl.dtype)
@@ -163,13 +176,14 @@ class ServingEngine:
         return jax.tree_util.tree_map_with_path(ins, cache_b, cache_1)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-    def _decode_chunk(self, params, cache, tok):
+    def _decode_chunk(self, variables, cache, tok):
         """``chunk`` greedy steps for all slots; one device round-trip."""
         def step(carry, _):
             cache, tok = carry
-            logits, upd = self._model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                mutable=["cache"])
+            with quantized_inference():
+                logits, upd = self._model.apply(
+                    dict(variables, cache=cache), tok[:, None],
+                    mutable=["cache"])
             nxt = jnp.argmax(
                 logits[:, -1].astype(jnp.float32), -1).astype(tok.dtype)
             return (upd["cache"], nxt), nxt
@@ -205,11 +219,13 @@ class ServingEngine:
         return rid
 
     def _fresh_cache(self):
-        shapes = jax.eval_shape(
-            lambda p: self._model.apply(
-                {"params": p}, jnp.zeros((self.slots, 1), jnp.int32),
-                mutable=["cache"])[1]["cache"],
-            self._params)
+        def shape_fn(variables):
+            with quantized_inference():
+                return self._model.apply(
+                    variables, jnp.zeros((self.slots, 1), jnp.int32),
+                    mutable=["cache"])[1]["cache"]
+
+        shapes = jax.eval_shape(shape_fn, self._variables)
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     def _fill_free_slots(self):
@@ -228,7 +244,7 @@ class ServingEngine:
                 padded = np.zeros((1, blen), np.int32)
                 padded[0, :len(prompt)] = prompt
                 cache_1, first = self._prefill(
-                    self._params, jnp.asarray(padded),
+                    self._variables, jnp.asarray(padded),
                     jnp.int32(len(prompt)))
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
@@ -274,7 +290,7 @@ class ServingEngine:
                 if state is not None:
                     tok[slot] = state.last_token
             self._cache, toks = self._decode_chunk(
-                self._params, self._cache, jnp.asarray(tok))
+                self._variables, self._cache, jnp.asarray(tok))
             self._harvest(np.asarray(toks))
         out, self._outputs = self._outputs, {}
         return out

@@ -159,6 +159,31 @@ def test_moe_family_matches_generate():
         assert out[rid] == ref, f"moe request {rid}"
 
 
+def test_int8_engine_matches_int8_generate(params):
+    """int8 weight-only serving through the engine: token-identical to
+    generate(quant_scales=...) — the quant interceptor rewrites the
+    same Dense call sites in both paths."""
+    from tensorflow_train_distributed_tpu.models import quant
+
+    qparams, scales = quant.quantize_params(params)
+    rng = np.random.default_rng(6)
+    eng = ServingEngine(CFG, qparams, slots=2, cache_len=32, chunk=3,
+                        prompt_buckets=(8,), quant_scales=scales)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(4, 6), (6, 5), (3, 7)]]
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    for rid, (p, m) in zip(ids, reqs):
+        ref = np.asarray(generate(
+            CFG, qparams, jnp.asarray([p], jnp.int32), m,
+            quant_scales=scales))[0].tolist()
+        assert out[rid] == ref, f"int8 request {rid}"
+    # Pairing contract: int8 params without scales fail loudly.
+    with pytest.raises(ValueError, match="quant_scales"):
+        ServingEngine(CFG, qparams, slots=2, cache_len=32,
+                      prompt_buckets=(8,))
+
+
 def test_submit_rejects_over_bucket_prompt(params):
     """Over-bucket prompts fail at submit() — failing inside run()
     would silently drop the request and abort others mid-flight."""
