@@ -27,8 +27,11 @@ whole serving session.
 Scope: the decoder families ``generate()`` serves (Llama AND
 Mixtral-style MoE — one engine), linear cache, greedy decoding (the
 parity-testable core), with int8 weight-only serving via the same
-``quant_scales`` contract as generate.  int8 KV cache, LoRA-unmerged
-params and sliding windows keep the shared-index ``generate()`` path.
+``quant_scales`` contract as generate and sharded (tensor-parallel)
+serving via ``mesh=`` — the models' logical constraints shard weights
+and cache over the mesh, GSPMD inserts the collectives, and outputs
+stay token-identical.  int8 KV cache, LoRA-unmerged params and sliding
+windows keep the shared-index ``generate()`` path.
 """
 
 from __future__ import annotations
@@ -37,6 +40,8 @@ import dataclasses
 from collections import deque
 from functools import partial
 from typing import Optional
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
@@ -84,7 +89,7 @@ class ServingEngine:
     def __init__(self, config, params, *, slots: int = 8,
                  cache_len: Optional[int] = None, eos_id: Optional[int] = None,
                  chunk: int = 8, cast_params: bool = True,
-                 quant_scales=None,
+                 quant_scales=None, mesh=None, rules=None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
@@ -135,11 +140,37 @@ class ServingEngine:
         self._variables = maybe_quant_variables(params, quant_scales)
         self._model = _decode_model(config, self.cache_len,
                                     slot_decode=True)
+        # Sharded serving: with a mesh, every device call runs under
+        # jax.set_mesh + the logical-axis rules, so the models' logical
+        # constraints shard weights/cache/activations (e.g. heads over
+        # ``tensor``) exactly as in training — GSPMD inserts the
+        # collectives; the engine's host logic is unchanged.  ``rules``
+        # mirrors Trainer(..., rules=): pass the training-time rules so
+        # serving shards the way the model trained (None = defaults).
+        self._mesh = mesh
+        self._rules = rules
         self._queue: deque = deque()
         self._outputs: dict = {}
         self._next_id = 0
         self._slot_states: list[Optional[_SlotState]] = [None] * slots
         self._cache = None  # built lazily on first insert (needs params)
+
+    def _ctx(self):
+        """Mesh + logical-rules context for device calls (no-op unsharded).
+
+        ``jax.set_mesh`` must wrap the jitted CALL, not sit inside the
+        traced function (trainer.py:432 lesson)."""
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        from tensorflow_train_distributed_tpu.parallel import (
+            sharding as sharding_lib,
+        )
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(sharding_lib.with_logical_rules(
+            self._mesh, *(() if self._rules is None else (self._rules,))))
+        stack.enter_context(jax.set_mesh(self._mesh))
+        return stack
 
     # -- jitted programs ---------------------------------------------------
 
@@ -243,9 +274,10 @@ class ServingEngine:
                         else _bucket_len(len(prompt), self.prompt_buckets))
                 padded = np.zeros((1, blen), np.int32)
                 padded[0, :len(prompt)] = prompt
-                cache_1, first = self._prefill(
-                    self._variables, jnp.asarray(padded),
-                    jnp.int32(len(prompt)))
+                with self._ctx():
+                    cache_1, first = self._prefill(
+                        self._variables, jnp.asarray(padded),
+                        jnp.int32(len(prompt)))
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
@@ -254,11 +286,12 @@ class ServingEngine:
                                      and first == self.eos_id)):
                     self._outputs[rid] = state.tokens
                     continue  # slot still free: try the next request
-                if self._cache is None:
-                    self._cache = self._fresh_cache()
-                self._cache = self._insert(
-                    self._cache, cache_1, jnp.int32(slot),
-                    jnp.int32(len(prompt)))
+                with self._ctx():
+                    if self._cache is None:
+                        self._cache = self._fresh_cache()
+                    self._cache = self._insert(
+                        self._cache, cache_1, jnp.int32(slot),
+                        jnp.int32(len(prompt)))
                 self._slot_states[slot] = state
 
     def _harvest(self, toks: np.ndarray):
@@ -289,8 +322,9 @@ class ServingEngine:
             for slot, state in enumerate(self._slot_states):
                 if state is not None:
                     tok[slot] = state.last_token
-            self._cache, toks = self._decode_chunk(
-                self._variables, self._cache, jnp.asarray(tok))
+            with self._ctx():
+                self._cache, toks = self._decode_chunk(
+                    self._variables, self._cache, jnp.asarray(tok))
             self._harvest(np.asarray(toks))
         out, self._outputs = self._outputs, {}
         return out

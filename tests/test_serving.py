@@ -159,6 +159,22 @@ def test_moe_family_matches_generate():
         assert out[rid] == ref, f"moe request {rid}"
 
 
+def test_sharded_engine_matches_unsharded(params, mesh_2d):
+    """Tensor-parallel serving: under a data×tensor mesh the engine's
+    logical constraints shard weights/cache over ``tensor`` (GSPMD
+    inserts the collectives) and the outputs stay token-identical."""
+    reqs = [([3, 1, 4, 1, 5], 6), ([2, 7, 1], 8)]
+
+    def serve(mesh):
+        eng = ServingEngine(CFG, params, slots=2, cache_len=32, chunk=4,
+                            prompt_buckets=(8,), mesh=mesh)
+        ids = [eng.submit(p, n) for p, n in reqs]
+        out = eng.run()
+        return [out[i] for i in ids]
+
+    assert serve(None) == serve(mesh_2d)
+
+
 def test_int8_engine_matches_int8_generate(params):
     """int8 weight-only serving through the engine: token-identical to
     generate(quant_scales=...) — the quant interceptor rewrites the
