@@ -70,8 +70,10 @@ class MoeConfig:
     # skipping the dispatch-einsum FLOPs and the capacity padding
     # entirely (capacity_factor is ignored; nothing is ever dropped).
     # Same parameter tree either way, so checkpoints transfer between
-    # formulations.  "gmm" is the single-shard throughput path; keep
-    # "dense" for expert-sharded meshes.
+    # formulations.  Under an ``expert``-sharded mesh the gmm path runs
+    # the shard_map expert-parallel formulation (local sort +
+    # group_offset gmm + one psum); unsharded it is the single-chip
+    # throughput path.
     dispatch: str = "dense"
 
 
@@ -187,28 +189,86 @@ class _StackedKernel(nn.Module):
             self.shape)
 
 
-def _gmm(lhs, rhs, group_sizes, interpret):
+def _gmm(lhs, rhs, group_sizes, interpret, group_offset=None):
     """Megablox grouped matmul: rows of ``lhs`` hit the ``rhs`` slice of
     their group (``group_sizes`` [E] row counts, summing to lhs rows).
 
     ``ops.gmm`` is the differentiable (custom-VJP) wrapper — the
     backward pass runs as grouped matmuls too.  ``interpret`` runs the
-    kernel in pallas interpret mode for CPU tests.
+    kernel in pallas interpret mode for CPU tests.  ``group_offset``
+    (expert parallelism): ``rhs`` holds only groups
+    [offset, offset + rhs.shape[0]) and rows outside them come back
+    ZERO — verified: per-shard outputs sum exactly to the full gmm, and
+    grads flow only through the shard's own rows.
     """
     from jax.experimental.pallas.ops.tpu.megablox import ops as _mb
 
     return _mb.gmm(lhs, rhs, group_sizes,
-                   preferred_element_type=jnp.float32, interpret=interpret)
+                   preferred_element_type=jnp.float32, interpret=interpret,
+                   group_offset=None if group_offset is None
+                   else jnp.asarray(group_offset, jnp.int32))
+
+
+def _routed_ffn_rows(flat, top_e, gate_w, num_experts, wi_gate, wi_up,
+                     wo, *, dtype, interpret, group_offset=None,
+                     psum_axis=None):
+    """The dropless routed FFN over a block of tokens.
+
+    ``flat`` [T, D] tokens; ``top_e``/``gate_w`` [T, k] the router's
+    expert choices and normalized gates (computed ONCE by the caller —
+    under EP they ride into the shard_map rather than being recomputed
+    per expert shard).  Sort token copies by expert, run the SwiGLU as
+    grouped matmuls, unsort and gate-combine.  With
+    ``group_offset``/``psum_axis`` set this is the per-shard body of
+    the expert-parallel formulation: each expert shard computes ONLY
+    its experts' rows (zeros elsewhere) and the psum over the expert
+    axis assembles the full row set — every row is computed by exactly
+    one shard, so the sum is exact, not averaged.
+    """
+    t, d = flat.shape
+    top_k = top_e.shape[-1]
+    e_total = num_experts
+    e_flat = top_e.reshape(-1)                          # [T*k] token-major
+    order = jnp.argsort(e_flat)                         # stable
+    xs = jnp.take(flat, order // top_k, axis=0).astype(dtype)
+    sizes = jnp.bincount(e_flat, length=e_total).astype(jnp.int32)
+    m = t * top_k
+    m_pad = -(-m // 128) * 128                          # kernel row tile
+    if m_pad != m:
+        # Zero rows appended to the LAST expert's range: zero inputs
+        # produce zero outputs (silu(0)*0 = 0), then sliced off before
+        # the combine — never observable, under EP included (the last
+        # shard computes them as zeros; psum adds zeros).
+        xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
+        sizes = sizes.at[e_total - 1].add(m_pad - m)
+    gate = _gmm(xs, wi_gate, sizes, interpret, group_offset)
+    up = _gmm(xs, wi_up, sizes, interpret, group_offset)
+    h = (nn.silu(gate) * up).astype(dtype)
+    out = _gmm(h, wo, sizes, interpret, group_offset)   # [m_pad, D] f32
+    if psum_axis is not None:
+        out = jax.lax.psum(out, psum_axis)
+    inv = jnp.zeros((m,), jnp.int32).at[order].set(
+        jnp.arange(m, dtype=jnp.int32))
+    y = jnp.take(out[:m], inv, axis=0).reshape(t, top_k, d)
+    return jnp.sum(y * gate_w[..., None], axis=1).astype(dtype)
 
 
 class _GmmExperts(nn.Module):
     """Dropless expert FFN: grouped matmuls over expert-sorted rows.
 
-    ``xs`` [M, d_model] holds token copies sorted by assigned expert and
-    ``group_sizes`` [E] the per-expert row counts.  Same SwiGLU math as
-    ``_ExpertFfn``; the three matmuls run as ``megablox.gmm`` so each
-    expert's rows hit its own kernel slice without materializing
-    ``[E, capacity]`` buffers or dispatch one-hots.
+    ``flat`` [T, d_model] tokens, ``p2`` [T, E] router probs; same
+    SwiGLU math as ``_ExpertFfn``, with the three matmuls as
+    ``megablox.gmm`` so each expert's rows hit its own kernel slice
+    without ``[E, capacity]`` buffers or dispatch one-hots.
+
+    With ``ep_mesh`` (an ambient mesh whose ``expert`` axis > 1) the
+    compute runs as a ``shard_map``: tokens stay sharded over the data
+    axes (each data shard sorts ITS tokens locally), expert kernels
+    shard over ``expert``, each expert shard computes only its experts'
+    rows via ``group_offset``, and one psum over ``expert`` assembles
+    the rows — dropless expert parallelism with exactly one collective
+    pair (tokens broadcast over the expert axis on the way in, psum on
+    the way out).
     """
 
     num_experts: int
@@ -216,19 +276,45 @@ class _GmmExperts(nn.Module):
     dtype: object
 
     @nn.compact
-    def __call__(self, xs, group_sizes, *, interpret):
-        d = xs.shape[-1]
+    def __call__(self, flat, top_e, gate_w, *, interpret, ep_mesh=None):
+        d = flat.shape[-1]
         e, f = self.num_experts, self.hidden
         wi_gate = _StackedKernel((e, d, f), ("expert", "embed", "mlp"),
-                                 name="wi_gate")()
+                                 name="wi_gate")().astype(self.dtype)
         wi_up = _StackedKernel((e, d, f), ("expert", "embed", "mlp"),
-                               name="wi_up")()
+                               name="wi_up")().astype(self.dtype)
         wo = _StackedKernel((e, f, d), ("expert", "mlp", "embed"),
-                            name="wo")()
-        gate = _gmm(xs, wi_gate.astype(self.dtype), group_sizes, interpret)
-        up = _gmm(xs, wi_up.astype(self.dtype), group_sizes, interpret)
-        h = (nn.silu(gate) * up).astype(self.dtype)
-        return _gmm(h, wo.astype(self.dtype), group_sizes, interpret)
+                            name="wo")().astype(self.dtype)
+        if ep_mesh is None:
+            return _routed_ffn_rows(
+                flat, top_e, gate_w, e, wi_gate, wi_up, wo,
+                dtype=self.dtype, interpret=interpret)
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            batch_axes,
+        )
+
+        local_e = e // ep_mesh.shape["expert"]
+        bspec = batch_axes(ep_mesh)
+        dtype_, interp_ = self.dtype, interpret
+
+        def body(flat_b, te_b, gw_b, wg_b, wu_b, wo_b):
+            e0 = jax.lax.axis_index("expert") * local_e
+            return _routed_ffn_rows(
+                flat_b, te_b, gw_b, e, wg_b, wu_b, wo_b,
+                dtype=dtype_, interpret=interp_, group_offset=e0,
+                psum_axis="expert")
+
+        return shard_map(
+            body, mesh=ep_mesh,
+            in_specs=(P(bspec, None), P(bspec, None), P(bspec, None),
+                      P("expert", None, None), P("expert", None, None),
+                      P("expert", None, None)),
+            out_specs=P(bspec, None), check_vma=False,
+        )(flat, top_e, gate_w, wi_gate, wi_up, wo)
 
 
 class MoEMlpBlock(nn.Module):
@@ -326,7 +412,8 @@ class MoEMlpBlock(nn.Module):
         top_p, top_e = jax.lax.top_k(p2, k)              # [T, k]
         # GShard top-k gate rule: normalize over the chosen experts.
         # (The dense path normalizes over *kept* gates — identical here
-        # because nothing is ever dropped.)
+        # because nothing is ever dropped.)  Computed ONCE; under EP it
+        # rides into the shard_map instead of re-running per shard.
         gate_w = top_p / jnp.maximum(
             jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
 
@@ -343,31 +430,33 @@ class MoEMlpBlock(nn.Module):
         self.sow("router_stats", "expert_load",
                  jnp.sum(routed, axis=0) / float(n_tokens * k))
 
-        # Sort token copies by expert; grouped-matmul group sizes are the
-        # per-expert assignment counts.  Static shapes throughout — only
-        # the *contents* of ``sizes`` are data-dependent, which is
-        # exactly what megablox's group_sizes operand is for.
-        e_flat = top_e.reshape(-1)                       # [T*k] token-major
-        order = jnp.argsort(e_flat)                      # stable
-        xs = jnp.take(flat, order // k, axis=0).astype(cfg.dtype)
-        sizes = jnp.bincount(e_flat, length=cfg.num_experts).astype(
-            jnp.int32)
-        m = n_tokens * k
-        m_pad = -(-m // 128) * 128                       # kernel row tile
-        if m_pad != m:
-            # Zero rows appended to the LAST expert's range: computed,
-            # then sliced off before the combine — never observable.
-            xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
-            sizes = sizes.at[cfg.num_experts - 1].add(m_pad - m)
-
-        out = _GmmExperts(num_experts=cfg.num_experts, hidden=cfg.ffn_size,
-                          dtype=cfg.dtype, name="experts")(
-            xs, sizes,
-            interpret=jax.default_backend() != "tpu")    # [m_pad, D] f32
-        inv = jnp.zeros((m,), jnp.int32).at[order].set(
-            jnp.arange(m, dtype=jnp.int32))
-        y = jnp.take(out[:m], inv, axis=0).reshape(n_tokens, k, d_model)
-        y = jnp.sum(y * gate_w[..., None], axis=1).astype(cfg.dtype)
+        # Expert parallelism: an ambient mesh with an ``expert`` axis
+        # routes the compute through the shard_map formulation (each
+        # data shard sorts locally, each expert shard computes its own
+        # experts via group_offset, one psum assembles).
+        mesh = jax.sharding.get_abstract_mesh()
+        ep_mesh = None
+        if (mesh is not None and not mesh.empty
+                and mesh.shape.get("expert", 1) > 1):
+            if cfg.num_experts % mesh.shape["expert"]:
+                raise ValueError(
+                    f"num_experts={cfg.num_experts} not divisible by the "
+                    f"expert mesh axis ({mesh.shape['expert']})")
+            if mesh.shape.get("tensor", 1) > 1:
+                # The shard_map body replicates expert kernels over the
+                # tensor axis (its in_specs only mention expert/data) —
+                # silently undoing TP would blow per-device memory and
+                # duplicate FLOPs.  The dense dispatch keeps full
+                # expert×tensor GSPMD sharding; refuse loudly here.
+                raise ValueError(
+                    "dispatch='gmm' supports data×fsdp×expert meshes; "
+                    "an expert×tensor mesh keeps dispatch='dense' "
+                    "(GSPMD shards both axes there)")
+            ep_mesh = mesh
+        y = _GmmExperts(num_experts=cfg.num_experts, hidden=cfg.ffn_size,
+                        dtype=cfg.dtype, name="experts")(
+            flat, top_e, gate_w,
+            interpret=jax.default_backend() != "tpu", ep_mesh=ep_mesh)
         return nn.with_logical_constraint(
             y.reshape(groups, group_size, d_model),
             ("batch", "length", "embed"))
