@@ -70,6 +70,45 @@ def has_lora_leaves(params) -> bool:
         for p, _ in jax.tree_util.tree_flatten_with_path(params)[0])
 
 
+def validate_sampling(temperature, top_k, top_p) -> None:
+    """Shared sampling-knob validation (generate + serving engine)."""
+    if temperature < 0:
+        raise ValueError(
+            f"temperature must be >= 0, got {temperature} (negative "
+            "values invert the distribution)")
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p filter a sampling distribution; set "
+            "temperature > 0 (greedy argmax is unaffected by them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def filter_logits(logits, *, temperature, top_k=None, top_p=None):
+    """Temperature scale + top-k + nucleus filters over f32 ``logits``
+    [..., V] — the sampling-distribution shaping shared by ``generate``
+    and the serving engine (``top_k`` static: it sets the lax.top_k
+    shape; ``temperature``/``top_p`` traced).  Filters compose k first,
+    then p (the HF convention)."""
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # Nucleus: keep the smallest prefix (by descending prob)
+        # whose mass reaches p; the first token always survives.
+        sorted_desc = -jnp.sort(-logits, axis=-1)
+        cum = jnp.cumsum(jax.nn.softmax(sorted_desc), axis=-1)
+        keep = cum - jax.nn.softmax(sorted_desc) <= top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(config: LlamaConfig, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -107,21 +146,10 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
         raise ValueError(
             f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
             f"max_positions={config.max_positions} (the KV cache size)")
-    if temperature < 0:
-        raise ValueError(
-            f"temperature must be >= 0, got {temperature} (negative "
-            "values invert the distribution)")
+    validate_sampling(temperature, top_k, top_p)
     greedy = temperature == 0.0
     if not greedy and rng is None:
         raise ValueError("temperature sampling needs rng=")
-    if greedy and (top_k is not None or top_p is not None):
-        raise ValueError(
-            "top_k/top_p filter a sampling distribution; set "
-            "temperature > 0 (greedy argmax is unaffected by them)")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.key(0)  # unused under greedy; keeps shapes static
     from tensorflow_train_distributed_tpu.models.lora import spec_of
@@ -179,20 +207,9 @@ def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,
         logits = logits.astype(jnp.float32)
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        logits = logits / temperature
-        if top_k is not None and top_k < logits.shape[-1]:
-            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if use_top_p:
-            # Nucleus: keep the smallest prefix (by descending prob)
-            # whose mass reaches p; the first token always survives.
-            sorted_desc = -jnp.sort(-logits, axis=-1)
-            cum = jnp.cumsum(jax.nn.softmax(sorted_desc), axis=-1)
-            keep = cum - jax.nn.softmax(sorted_desc) <= top_p
-            cutoff = jnp.min(
-                jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
-                keepdims=True)
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        logits = filter_logits(logits, temperature=temperature,
+                               top_k=top_k,
+                               top_p=top_p if use_top_p else None)
         return jax.random.categorical(
             step_rng, logits, axis=-1).astype(prompt.dtype)
 

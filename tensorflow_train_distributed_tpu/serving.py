@@ -25,8 +25,8 @@ change, so XLA compiles three programs total and reuses them for the
 whole serving session.
 
 Scope: the decoder families ``generate()`` serves (Llama AND
-Mixtral-style MoE — one engine), linear cache, greedy decoding (the
-parity-testable core), with int8 weight-only serving via the same
+Mixtral-style MoE — one engine), linear cache, greedy or sampled
+decoding (per-request rng streams), with int8 weight-only serving via the same
 ``quant_scales`` contract as generate and sharded (tensor-parallel)
 serving via ``mesh=`` — the models' logical constraints shard weights
 and cache over the mesh, GSPMD inserts the collectives, and outputs
@@ -50,7 +50,9 @@ import numpy as np
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
     cast_floating,
+    filter_logits,
     has_lora_leaves,
+    validate_sampling,
 )
 from tensorflow_train_distributed_tpu.models.quant import (
     check_quant_pairing,
@@ -65,6 +67,8 @@ class _SlotState:
     remaining: int                 # generated tokens still allowed
     tokens: list                   # prompt + generated so far
     last_token: int                # feeds the next decode step
+    seed: int = 0                  # per-request sampling stream
+    count: int = 1                 # tokens sampled so far (rng counter)
     done: bool = False
 
 
@@ -77,19 +81,24 @@ def _bucket_len(n: int, buckets) -> int:
 
 
 class ServingEngine:
-    """Continuous-batching greedy decoder over a fixed slot grid.
+    """Continuous-batching decoder over a fixed slot grid.
 
-    ``submit()`` requests, then ``run()`` to completion: each request's
-    output is token-identical to ``generate(config, params, prompt,
-    max_new)`` greedy (pinned by tests/test_serving.py) — slots only
-    change *when* work happens, never the math: per-slot positions give
-    every request the same RoPE/mask view it would have alone.
+    ``submit()`` requests, then ``run()`` to completion.  Greedy by
+    default — output token-identical to ``generate(config, params,
+    prompt, max_new)`` greedy (pinned by tests/test_serving.py); with
+    ``temperature``/``top_k``/``top_p`` set, each request samples from
+    its OWN rng stream (seeded at submit), so sampled outputs are
+    reproducible and independent of slot placement.  Either way slots
+    only change *when* work happens, never the math: per-slot positions
+    give every request the same RoPE/mask view it would have alone.
     """
 
     def __init__(self, config, params, *, slots: int = 8,
                  cache_len: Optional[int] = None, eos_id: Optional[int] = None,
                  chunk: int = 8, cast_params: bool = True,
                  quant_scales=None, mesh=None, rules=None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
@@ -108,6 +117,16 @@ class ServingEngine:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Sampling config is engine-level (a deployment knob, static in
+        # the compiled programs); the rng stream is PER REQUEST (seeded
+        # at submit) so outputs are reproducible regardless of slot
+        # placement or chunk boundaries.  One shared validator with
+        # generate().
+        validate_sampling(temperature, top_k, top_p)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self._greedy = temperature == 0.0
         self.config = config
         self.slots = slots
         self.cache_len = cache_len or config.max_positions
@@ -174,8 +193,27 @@ class ServingEngine:
 
     # -- jitted programs ---------------------------------------------------
 
+    def _pick(self, logits, seeds, counts):
+        """Next token per slot from [slots, V] logits.
+
+        Greedy: argmax.  Sampling: each slot draws from ITS OWN stream
+        — key = fold_in(key(seed), tokens_drawn_so_far) — so a
+        request's tokens do not depend on slot placement, neighbors, or
+        chunk boundaries (reproducible under any contention).
+        """
+        logits = logits.astype(jnp.float32)
+        if self._greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = filter_logits(logits, temperature=self.temperature,
+                               top_k=self.top_k, top_p=self.top_p)
+        keys = jax.vmap(jax.random.fold_in)(
+            jax.vmap(jax.random.key)(seeds.astype(jnp.uint32)), counts)
+        return jax.vmap(
+            lambda k, l: jax.random.categorical(k, l)
+        )(keys, logits).astype(jnp.int32)
+
     @partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, variables, prompt_1xl, true_len):
+    def _prefill(self, variables, prompt_1xl, true_len, seed):
         """Batch-1 prefill of a right-padded prompt.
 
         Pad rows are harmless: causal masking keeps them invisible to
@@ -188,8 +226,8 @@ class ServingEngine:
         with quantized_inference():
             logits, vs = self._model.apply(
                 variables, prompt_1xl, mutable=["cache"])
-        first = jnp.argmax(
-            logits[0, true_len - 1].astype(jnp.float32), -1)
+        first = self._pick(logits[:, true_len - 1],
+                           seed[None], jnp.zeros((1,), jnp.int32))[0]
         return vs["cache"], first.astype(prompt_1xl.dtype)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -207,27 +245,38 @@ class ServingEngine:
         return jax.tree_util.tree_map_with_path(ins, cache_b, cache_1)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-    def _decode_chunk(self, variables, cache, tok):
-        """``chunk`` greedy steps for all slots; one device round-trip."""
-        def step(carry, _):
+    def _decode_chunk(self, variables, cache, tok, seeds, counts):
+        """``chunk`` decode steps for all slots; one device round-trip.
+        ``seeds``/``counts`` [slots]: each slot's sampling stream and
+        how many tokens it has already drawn (greedy ignores both)."""
+        def step(carry, j):
             cache, tok = carry
             with quantized_inference():
                 logits, upd = self._model.apply(
                     dict(variables, cache=cache), tok[:, None],
                     mutable=["cache"])
-            nxt = jnp.argmax(
-                logits[:, -1].astype(jnp.float32), -1).astype(tok.dtype)
+            nxt = self._pick(logits[:, -1], seeds, counts + j).astype(
+                tok.dtype)
             return (upd["cache"], nxt), nxt
 
         (cache, _), toks = jax.lax.scan(
-            step, (cache, tok), None, length=self.chunk)
+            step, (cache, tok), jnp.arange(self.chunk))
         return cache, jnp.moveaxis(toks, 0, 1)      # [slots, chunk]
 
     # -- host-side loop ----------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Enqueue a request; returns its id (resolved by ``run()``)."""
+    def submit(self, prompt, max_new_tokens: int,
+               seed: Optional[int] = None) -> int:
+        """Enqueue a request; returns its id (resolved by ``run()``).
+
+        ``seed`` names the request's sampling stream (ignored under
+        greedy); default: the request id — distinct per request,
+        reproducible across identical engine sessions."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if seed is not None and not 0 <= seed < 2 ** 32:
+            # Catch at submit: an out-of-range seed would OverflowError
+            # inside run(), aborting every in-flight request.
+            raise ValueError(f"seed must be a uint32, got {seed}")
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 0:
@@ -246,7 +295,8 @@ class ServingEngine:
                 f"prefill bucket {self.prompt_buckets[-1]}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, max_new_tokens))
+        self._queue.append(
+            (rid, prompt, max_new_tokens, rid if seed is None else seed))
         return rid
 
     def _fresh_cache(self):
@@ -266,7 +316,7 @@ class ServingEngine:
             # or first-token EOS) must not leave the slot idle for a
             # whole decode chunk while runnable work waits.
             while self._slot_states[slot] is None and self._queue:
-                rid, prompt, max_new = self._queue.popleft()
+                rid, prompt, max_new, seed = self._queue.popleft()
                 if max_new == 0:
                     self._outputs[rid] = list(prompt)
                     continue
@@ -277,11 +327,11 @@ class ServingEngine:
                 with self._ctx():
                     cache_1, first = self._prefill(
                         self._variables, jnp.asarray(padded),
-                        jnp.int32(len(prompt)))
+                        jnp.int32(len(prompt)), jnp.uint32(seed))
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
-                                   last_token=first)
+                                   last_token=first, seed=seed, count=1)
                 if (max_new == 1 or (self.eos_id is not None
                                      and first == self.eos_id)):
                     self._outputs[rid] = state.tokens
@@ -302,6 +352,7 @@ class ServingEngine:
                 t = int(t)
                 state.tokens.append(t)
                 state.last_token = t
+                state.count += 1
                 state.remaining -= 1
                 if (state.remaining <= 0
                         or (self.eos_id is not None and t == self.eos_id)):
@@ -319,12 +370,17 @@ class ServingEngine:
             if not any(s is not None for s in self._slot_states):
                 continue  # everything resolved at prefill time
             tok = np.zeros((self.slots,), np.int32)
+            seeds = np.zeros((self.slots,), np.uint32)
+            counts = np.zeros((self.slots,), np.int32)
             for slot, state in enumerate(self._slot_states):
                 if state is not None:
                     tok[slot] = state.last_token
+                    seeds[slot] = state.seed
+                    counts[slot] = state.count
             with self._ctx():
                 self._cache, toks = self._decode_chunk(
-                    self._variables, self._cache, jnp.asarray(tok))
+                    self._variables, self._cache, jnp.asarray(tok),
+                    jnp.asarray(seeds), jnp.asarray(counts))
             self._harvest(np.asarray(toks))
         out, self._outputs = self._outputs, {}
         return out

@@ -200,6 +200,68 @@ def test_int8_engine_matches_int8_generate(params):
                       prompt_buckets=(8,))
 
 
+class TestSampling:
+    def test_topk1_equals_greedy(self, params):
+        """temperature with top_k=1 collapses to argmax — an EXACT pin
+        on the sampling path without needing to match any rng stream."""
+        rng = np.random.default_rng(8)
+        reqs = [(list(rng.integers(1, 200, n)), m)
+                for n, m in [(4, 6), (6, 5)]]
+        outs = {}
+        for name, kw in (("greedy", {}),
+                         ("topk1", dict(temperature=5.0, top_k=1))):
+            eng = ServingEngine(CFG, params, slots=2, cache_len=32,
+                                chunk=3, prompt_buckets=(8,), **kw)
+            ids = [eng.submit(p, m) for p, m in reqs]
+            out = eng.run()
+            outs[name] = [out[i] for i in ids]
+        assert outs["greedy"] == outs["topk1"]
+
+    def test_sampled_stream_is_placement_independent(self, params):
+        """A request's sampled tokens depend only on (params, prompt,
+        seed) — not on slot placement, neighbors, or chunk boundaries:
+        the rng key is fold_in(key(seed), tokens_drawn)."""
+        rng = np.random.default_rng(9)
+        prompt = list(rng.integers(1, 200, 5))
+        other = list(rng.integers(1, 200, 7))
+
+        def serve_alone():
+            eng = ServingEngine(CFG, params, slots=1, cache_len=32,
+                                chunk=5, prompt_buckets=(8,),
+                                temperature=0.8, top_k=20)
+            rid = eng.submit(prompt, 8, seed=123)
+            return eng.run()[rid]
+
+        def serve_contended():
+            eng = ServingEngine(CFG, params, slots=2, cache_len=32,
+                                chunk=3, prompt_buckets=(8,),
+                                temperature=0.8, top_k=20)
+            rid = eng.submit(prompt, 8, seed=123)
+            eng.submit(other, 10, seed=7)
+            return eng.run()[rid]
+
+        alone = serve_alone()
+        contended = serve_contended()
+        assert alone == contended
+        assert serve_contended() == contended  # reproducible
+
+    def test_sampling_validation(self, params):
+        with pytest.raises(ValueError, match="temperature"):
+            ServingEngine(CFG, params, temperature=-0.1)
+        with pytest.raises(ValueError, match="top_k/top_p"):
+            ServingEngine(CFG, params, top_k=5)  # greedy + filter
+        with pytest.raises(ValueError, match="top_p"):
+            ServingEngine(CFG, params, temperature=1.0, top_p=1.5)
+        eng = ServingEngine(CFG, params, slots=1, cache_len=32,
+                            prompt_buckets=(8,))
+        # Out-of-range seeds fail at submit, not mid-run (an
+        # OverflowError inside run() would abort in-flight requests).
+        with pytest.raises(ValueError, match="seed"):
+            eng.submit([1, 2], 3, seed=-1)
+        with pytest.raises(ValueError, match="seed"):
+            eng.submit([1, 2], 3, seed=2 ** 32)
+
+
 def test_submit_rejects_over_bucket_prompt(params):
     """Over-bucket prompts fail at submit() — failing inside run()
     would silently drop the request and abort others mid-flight."""
