@@ -262,6 +262,46 @@ class TestSampling:
             eng.submit([1, 2], 3, seed=2 ** 32)
 
 
+def test_serve_cli_roundtrip(tmp_path):
+    """tools/serve.py: train a tiny checkpoint, then batch-serve
+    MIXED-LENGTH prompts through the engine CLI — one JSONL line per
+    request, each prefixed with its own prompt."""
+    import importlib.util
+    import json
+    import os
+
+    from tensorflow_train_distributed_tpu import launch
+
+    ckpt = str(tmp_path / "ck")
+    launch.run(launch.build_parser().parse_args([
+        "--config", "llama_tiny_sft", "--steps", "3",
+        "--global-batch-size", "8", "--checkpoint-dir", ckpt,
+        "--checkpoint-every", "3", "--log-every", "3"]))
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(json.dumps({"prompt": [9, 8, 7, 6], "max_new": 3,
+                                "seed": 5}) + "\n")
+    out_path = str(tmp_path / "out.jsonl")
+    spec = importlib.util.spec_from_file_location(
+        "serve_under_test", os.path.join(tools, "serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--config", "llama_tiny_sft", "--checkpoint-dir", ckpt,
+                   "--prompt", "1,2,3", "--prompt", "4,5,6,7,8",
+                   "--max-new", "5", "--requests", str(reqs),
+                   "--slots", "2", "--chunk", "3",
+                   "--output", out_path])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in open(out_path)]
+    assert len(lines) == 3
+    assert lines[0]["tokens"][:3] == [1, 2, 3]
+    assert len(lines[0]["tokens"]) == 3 + 5
+    assert lines[1]["tokens"][:5] == [4, 5, 6, 7, 8]
+    assert lines[2]["tokens"][:4] == [9, 8, 7, 6]
+    assert len(lines[2]["tokens"]) == 4 + 3
+
+
 def test_submit_rejects_over_bucket_prompt(params):
     """Over-bucket prompts fail at submit() — failing inside run()
     would silently drop the request and abort others mid-flight."""

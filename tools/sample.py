@@ -42,6 +42,40 @@ def _restore_params(checkpoint_dir: str):
     return params
 
 
+def resolve_decoder_task(config_name: str, verb: str):
+    """Registry lookup + decoder-family guard (shared with serve.py).
+
+    Returns ``(task, config, is_moe)`` or SystemExits with the CLI
+    convention."""
+    from tensorflow_train_distributed_tpu.models import registry
+    from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
+    from tensorflow_train_distributed_tpu.models.moe import MoeLmTask
+
+    task = registry.get_entry(config_name)["task_factory"]()
+    if not isinstance(task, (CausalLmTask, MoeLmTask)):
+        raise SystemExit(
+            f"--config {config_name} is not a decoder LM; {verb} needs "
+            "a llama- or moe-family config")
+    return task, task.config, isinstance(task, MoeLmTask)
+
+
+def parse_prompt_spec(spec: str):
+    """One --prompt value -> list of token ids (shared with serve.py)."""
+    try:
+        return [int(t) for t in spec.split(",") if t]
+    except ValueError:
+        raise SystemExit(f"--prompt must be comma-separated ints, got "
+                         f"{spec!r}")
+
+
+def check_vocab_ids(rows, vocab_size: int) -> None:
+    """Reject out-of-vocab prompt ids (shared with serve.py)."""
+    bad = [t for r in rows for t in r if not 0 <= t < vocab_size]
+    if bad:
+        raise SystemExit(f"prompt ids outside vocab [0, {vocab_size}): "
+                         f"{sorted(set(bad))[:8]}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--config", required=True,
@@ -100,26 +134,11 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from tensorflow_train_distributed_tpu.models import registry
     from tensorflow_train_distributed_tpu.models.generate import generate
-    from tensorflow_train_distributed_tpu.models.llama import CausalLmTask
-    from tensorflow_train_distributed_tpu.models.moe import MoeLmTask
 
-    task = registry.get_entry(args.config)["task_factory"]()
-    is_moe = isinstance(task, MoeLmTask)
-    if not isinstance(task, (CausalLmTask, MoeLmTask)):
-        raise SystemExit(
-            f"--config {args.config} is not a decoder LM; sampling needs "
-            "a llama- or moe-family config")
-    cfg = task.config
+    task, cfg, is_moe = resolve_decoder_task(args.config, "sampling")
 
-    rows = []
-    for spec in args.prompt:
-        try:
-            rows.append([int(t) for t in spec.split(",") if t])
-        except ValueError:
-            raise SystemExit(f"--prompt must be comma-separated ints, got "
-                             f"{spec!r}")
+    rows = [parse_prompt_spec(spec) for spec in args.prompt]
     if not rows or any(not r for r in rows):
         raise SystemExit("--prompt rows must be non-empty")
     if len({len(r) for r in rows}) != 1:
@@ -133,10 +152,7 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--top-k/--top-p filter a sampling distribution; add "
             "--temperature > 0 (they have no effect on greedy argmax)")
-    bad = [t for r in rows for t in r if not 0 <= t < cfg.vocab_size]
-    if bad:
-        raise SystemExit(f"prompt ids outside vocab [0, {cfg.vocab_size}): "
-                         f"{sorted(set(bad))[:8]}")
+    check_vocab_ids(rows, cfg.vocab_size)
     if args.max_new < 1:
         raise SystemExit(f"--max-new must be >= 1, got {args.max_new}")
     if len(rows[0]) + args.max_new > cfg.max_positions:
@@ -213,7 +229,7 @@ def main(argv=None) -> int:
             raise SystemExit(
                 "--speculative-draft-config is greedy-only and does not "
                 "compose with --quant or LoRA serving (merge first)")
-        if not isinstance(task, CausalLmTask):
+        if is_moe:
             raise SystemExit("speculative decoding needs a llama-family "
                              "TARGET --config")
         if prompt.shape[0] != 1:
@@ -222,9 +238,16 @@ def main(argv=None) -> int:
         if not args.speculative_draft_checkpoint:
             raise SystemExit("--speculative-draft-checkpoint is required "
                              "with --speculative-draft-config")
+        from tensorflow_train_distributed_tpu.models import registry
+        from tensorflow_train_distributed_tpu.models.llama import (
+            CausalLmTask,
+        )
+
         draft_task = registry.get_entry(
             args.speculative_draft_config)["task_factory"]()
         if not isinstance(draft_task, CausalLmTask):
+            # One accurate message (moe drafts are NOT accepted, so the
+            # generic llama-or-moe wording would mislead).
             raise SystemExit("the draft config must be a llama-family "
                              "decoder")
 

@@ -1,0 +1,161 @@
+"""Batch-serve mixed-length requests through the continuous-batching engine.
+
+The CLI face of ``serving.ServingEngine`` (slot-refill decode): unlike
+``tools/sample.py`` (one static batch, equal-length prompts), requests
+here may have DIFFERENT prompt lengths and budgets — the engine keeps
+``--slots`` of them in flight and refills as they finish, emitting each
+result as one JSONL line ``{"id", "prompt", "tokens"}`` (tokens =
+prompt + continuation, exactly generate()'s convention).
+
+Requests come from repeated ``--prompt`` flags or ``--requests FILE``
+(JSONL: ``{"prompt": [ids...], "max_new": N, "seed": S?}``).  No
+tokenizer ships in this environment, so prompts are token ids.
+
+Examples:
+  python tools/serve.py --config llama_tiny_sft --checkpoint-dir /ck \\
+      --prompt 1,2,3 --prompt 4,5,6,7,8 --max-new 32
+  python tools/serve.py --config llama_tiny_sft --checkpoint-dir /ck \\
+      --requests reqs.jsonl --slots 8 --temperature 0.8 --top-k 20
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
+sys.path.insert(0, _HERE)                   # tools/ (sample.py helper)
+
+from sample import (  # noqa: E402 (tools/ sibling)
+    _restore_params,
+    check_vocab_ids,
+    parse_prompt_spec,
+    resolve_decoder_task,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--config", required=True,
+                   help="registry config name (a decoder-family preset)")
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="orbax checkpoint dir (params-only restore)")
+    p.add_argument("--prompt", action="append", default=[],
+                   metavar="IDS", help="comma-separated token ids; repeat "
+                   "per request (lengths may differ — that is the point)")
+    p.add_argument("--requests", default="",
+                   help="JSONL file: {'prompt': [ids], 'max_new': N, "
+                        "'seed': S?} per line")
+    p.add_argument("--max-new", type=int, default=32,
+                   help="budget for --prompt requests (JSONL carries "
+                        "its own)")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--cache-len", type=int, default=0,
+                   help="0 -> config.max_positions")
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--quant", default="", choices=["", "int8"])
+    p.add_argument("--output", default="-",
+                   help="output JSONL path ('-' = stdout)")
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (e.g. 'cpu')")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    _, cfg, _ = resolve_decoder_task(args.config, "serving")
+
+    reqs = [{"prompt": parse_prompt_spec(spec), "max_new": args.max_new}
+            for spec in args.prompt]
+    if args.requests:
+        if not os.path.isfile(args.requests):
+            raise SystemExit(f"no requests file at {args.requests}")
+        with open(args.requests) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if not isinstance(rec.get("prompt"), list):
+                        # A string would silently iterate characters.
+                        raise ValueError("'prompt' must be a list of ids")
+                    if not rec["prompt"]:
+                        raise ValueError("empty prompt")
+                    if not all(isinstance(t, int) and not isinstance(
+                            t, bool) for t in rec["prompt"]):
+                        # int() would silently truncate 1.9 -> 1.
+                        raise ValueError("token ids must be integers")
+                    rec = {"prompt": [int(t) for t in rec["prompt"]],
+                           "max_new": int(rec.get("max_new",
+                                                  args.max_new)),
+                           **({"seed": int(rec["seed"])}
+                              if "seed" in rec else {})}
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError, AttributeError) as e:
+                    raise SystemExit(
+                        f"{args.requests}:{i + 1}: bad request line "
+                        f"({e})")
+                reqs.append(rec)
+    if not reqs:
+        raise SystemExit("no requests (--prompt or --requests)")
+    check_vocab_ids([r["prompt"] for r in reqs], cfg.vocab_size)
+
+    # Probe --output writability BEFORE serving (an unwritable path
+    # must fail in milliseconds, not after minutes of decode) — append
+    # mode, so an early failure later (bad checkpoint, OOM) does NOT
+    # truncate a pre-existing results file.
+    if args.output != "-":
+        try:
+            open(args.output, "a").close()
+        except OSError as e:
+            raise SystemExit(f"cannot write --output {args.output}: {e}")
+
+    params = _restore_params(args.checkpoint_dir)
+    quant_scales = None
+    if args.quant == "int8":
+        from tensorflow_train_distributed_tpu.models.quant import (
+            quantize_params,
+        )
+
+        params, quant_scales = quantize_params(params)
+
+    sink = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        # Engine/submit validation errors (oversized prompts, bad
+        # sampling combos, budget vs cache) exit with the same clean
+        # SystemExit convention as every other serve.py input error.
+        try:
+            eng = ServingEngine(
+                cfg, params, slots=args.slots, chunk=args.chunk,
+                cache_len=args.cache_len or None, eos_id=args.eos_id,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, quant_scales=quant_scales)
+            ids = [eng.submit(r["prompt"], r["max_new"],
+                              seed=r.get("seed")) for r in reqs]
+        except ValueError as e:
+            raise SystemExit(str(e))
+        out = eng.run()
+        for rid, r in zip(ids, reqs):
+            sink.write(json.dumps({
+                "id": rid, "prompt": r["prompt"],
+                "tokens": out[rid]}) + "\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
