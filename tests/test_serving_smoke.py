@@ -1,0 +1,34 @@
+"""Fast-tier smoke for the continuous-batching engine.
+
+The full parity/contention/sampling matrix lives in test_serving.py
+(slow tier); this keeps ONE end-to-end engine run in the fast CI tier
+so a broken import, cache-shape regression, or host-loop bug is caught
+within minutes, not only on the full run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu.models.llama import (
+    LLAMA_PRESETS,
+    LlamaModel,
+)
+from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+
+def test_engine_smoke():
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    eng = ServingEngine(cfg, params, slots=2, cache_len=16, chunk=2,
+                        prompt_buckets=(8,))
+    rid_a = eng.submit([1, 2, 3], 4)
+    rid_b = eng.submit([4, 5], 3)
+    out = eng.run()
+    assert out[rid_a][:3] == [1, 2, 3] and len(out[rid_a]) == 7
+    assert out[rid_b][:2] == [4, 5] and len(out[rid_b]) == 5
+    vocab = cfg.vocab_size
+    assert all(0 <= t < vocab for r in out.values() for t in r)
+    assert all(np.issubdtype(type(t), np.integer) or isinstance(t, int)
+               for r in out.values() for t in r)

@@ -93,14 +93,19 @@ def main(argv=None) -> int:
                         raise ValueError("'prompt' must be a list of ids")
                     if not rec["prompt"]:
                         raise ValueError("empty prompt")
-                    if not all(isinstance(t, int) and not isinstance(
-                            t, bool) for t in rec["prompt"]):
-                        # int() would silently truncate 1.9 -> 1.
-                        raise ValueError("token ids must be integers")
-                    rec = {"prompt": [int(t) for t in rec["prompt"]],
-                           "max_new": int(rec.get("max_new",
-                                                  args.max_new)),
-                           **({"seed": int(rec["seed"])}
+                    def _int(v, what):
+                        # int() would silently truncate 1.9 -> 1 (and
+                        # accept bools); demand real integers.
+                        if not isinstance(v, int) or isinstance(v, bool):
+                            raise ValueError(f"{what} must be an integer")
+                        return v
+
+                    rec = {"prompt": [_int(t, "token ids")
+                                      for t in rec["prompt"]],
+                           "max_new": _int(rec.get("max_new",
+                                                   args.max_new),
+                                           "max_new"),
+                           **({"seed": _int(rec["seed"], "seed")}
                               if "seed" in rec else {})}
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError, AttributeError) as e:
@@ -131,21 +136,23 @@ def main(argv=None) -> int:
 
         params, quant_scales = quantize_params(params)
 
+    # Engine/submit validation errors (oversized prompts, bad
+    # sampling combos, budget vs cache) exit with the same clean
+    # SystemExit convention as every other serve.py input error — and
+    # they happen BEFORE the truncating open below, so a failed rerun
+    # never destroys a previous results file.
+    try:
+        eng = ServingEngine(
+            cfg, params, slots=args.slots, chunk=args.chunk,
+            cache_len=args.cache_len or None, eos_id=args.eos_id,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, quant_scales=quant_scales)
+        ids = [eng.submit(r["prompt"], r["max_new"],
+                          seed=r.get("seed")) for r in reqs]
+    except ValueError as e:
+        raise SystemExit(str(e))
     sink = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
-        # Engine/submit validation errors (oversized prompts, bad
-        # sampling combos, budget vs cache) exit with the same clean
-        # SystemExit convention as every other serve.py input error.
-        try:
-            eng = ServingEngine(
-                cfg, params, slots=args.slots, chunk=args.chunk,
-                cache_len=args.cache_len or None, eos_id=args.eos_id,
-                temperature=args.temperature, top_k=args.top_k,
-                top_p=args.top_p, quant_scales=quant_scales)
-            ids = [eng.submit(r["prompt"], r["max_new"],
-                              seed=r.get("seed")) for r in reqs]
-        except ValueError as e:
-            raise SystemExit(str(e))
         out = eng.run()
         for rid, r in zip(ids, reqs):
             sink.write(json.dumps({
