@@ -362,13 +362,22 @@ class ServingEngine:
                 self._outputs[state.request_id] = state.tokens
                 self._slot_states[slot] = None
 
-    def run(self) -> dict:
-        """Serve every submitted request to completion; returns
-        ``{request_id: [prompt + generated tokens]}``."""
-        while self._queue or any(s is not None for s in self._slot_states):
-            self._fill_free_slots()
-            if not any(s is not None for s in self._slot_states):
-                continue  # everything resolved at prefill time
+    def pending(self) -> int:
+        """Requests not yet finished (queued + in flight)."""
+        return (len(self._queue)
+                + sum(s is not None for s in self._slot_states))
+
+    def serve_step(self) -> dict:
+        """ONE service iteration: refill free slots from the queue, run
+        one decode chunk, harvest — then hand control back, so callers
+        can ``submit()`` new requests between steps (online serving: the
+        queue never has to be complete up front).  Returns the requests
+        that FINISHED this step, ``{request_id: tokens}`` (possibly
+        empty); poll ``pending()`` for completion."""
+        self._fill_free_slots()
+        # (No active slots == everything resolved at prefill time or
+        # nothing queued: skip the decode, just drain what finished.)
+        if any(s is not None for s in self._slot_states):
             tok = np.zeros((self.slots,), np.int32)
             seeds = np.zeros((self.slots,), np.uint32)
             counts = np.zeros((self.slots,), np.int32)
@@ -383,4 +392,13 @@ class ServingEngine:
                     jnp.asarray(seeds), jnp.asarray(counts))
             self._harvest(np.asarray(toks))
         out, self._outputs = self._outputs, {}
+        return out
+
+    def run(self) -> dict:
+        """Serve every submitted request to completion; returns
+        ``{request_id: [prompt + generated tokens]}``.  (A loop over
+        ``serve_step()`` — use that directly for online serving.)"""
+        out: dict = {}
+        while self.pending():
+            out.update(self.serve_step())
         return out

@@ -262,6 +262,26 @@ class TestSampling:
             eng.submit([1, 2], 3, seed=2 ** 32)
 
 
+def test_online_submission_mid_flight(params):
+    """serve_step(): requests submitted WHILE others decode still come
+    out token-identical — online serving never changes the math."""
+    rng = np.random.default_rng(11)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 9), (3, 7), (6, 5)]]
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32, chunk=3,
+                        prompt_buckets=(8,))
+    out = {}
+    ids = [eng.submit(*reqs[0])]
+    out.update(eng.serve_step())          # request 0 starts decoding
+    ids.append(eng.submit(*reqs[1]))      # arrives mid-flight
+    out.update(eng.serve_step())
+    ids.append(eng.submit(*reqs[2]))      # and another
+    while eng.pending():
+        out.update(eng.serve_step())
+    for rid, (p, m) in zip(ids, reqs):
+        assert out[rid] == _ref(params, p, m), f"request {rid}"
+
+
 def test_serve_cli_roundtrip(tmp_path):
     """tools/serve.py: train a tiny checkpoint, then batch-serve
     MIXED-LENGTH prompts through the engine CLI — one JSONL line per
