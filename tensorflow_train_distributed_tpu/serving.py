@@ -99,6 +99,7 @@ class ServingEngine:
                  quant_scales=None, mesh=None, rules=None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
+                 prefill_chunk: Optional[int] = None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
@@ -145,9 +146,27 @@ class ServingEngine:
         from tensorflow_train_distributed_tpu.models.moe import MoeConfig
 
         self._exact_prefill = isinstance(config, MoeConfig)
+        # Chunked prefill: long prompts run through the SAME per-piece
+        # program in ``prefill_chunk``-token pieces (the decode cache
+        # appends multi-token blocks at any position), bounding prefill
+        # memory/compile variety to one chunk shape.  MoE must prefill
+        # whole (per-chunk routing capacity would diverge from
+        # generate()'s full-prompt prefill — the exact-length rule).
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            if self._exact_prefill:
+                raise ValueError(
+                    "prefill_chunk is unsupported for MoE configs: the "
+                    "router's per-group capacity depends on the prefill "
+                    "length, so chunking would change routing vs "
+                    "generate() (MoE prefills at the exact length)")
+        self.prefill_chunk = prefill_chunk
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.cache_len)
-        if not self.prompt_buckets and not self._exact_prefill:
+        if (not self.prompt_buckets and not self._exact_prefill
+                and prefill_chunk is None):
             raise ValueError("no prompt bucket fits cache_len")
         # int8 weight-only serving: same pairing contract as generate()
         # (one shared check), and every Dense runs the fused dequant
@@ -173,6 +192,7 @@ class ServingEngine:
         self._next_id = 0
         self._slot_states: list[Optional[_SlotState]] = [None] * slots
         self._cache = None  # built lazily on first insert (needs params)
+        self._cache_shapes: dict = {}  # batch -> eval_shape result
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -212,23 +232,27 @@ class ServingEngine:
             lambda k, l: jax.random.categorical(k, l)
         )(keys, logits).astype(jnp.int32)
 
-    @partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, variables, prompt_1xl, true_len, seed):
-        """Batch-1 prefill of a right-padded prompt.
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _prefill_piece(self, variables, cache, tokens_1xl, local_idx,
+                       seed):
+        """One batch-1 prefill piece appended to ``cache`` (a zeroed
+        cache == fresh, so the whole-prompt case is a single piece).
 
-        Pad rows are harmless: causal masking keeps them invisible to
-        the real rows (they sit AFTER every real position), the first
-        token reads the logit at ``true_len - 1``, and insert() pins the
-        slot's index to ``true_len`` so decode overwrites each pad row
-        before any query can attend it (writes precede reads at every
-        position).
+        Pad rows in the final piece are harmless: causal masking keeps
+        them invisible to the real rows (they sit AFTER every real
+        position), the first token reads the logit at ``local_idx``
+        (the last REAL row of this piece), and insert() pins the slot's
+        index to the true prompt length so decode overwrites each pad
+        row before any query can attend it (writes precede reads at
+        every position).
         """
         with quantized_inference():
             logits, vs = self._model.apply(
-                variables, prompt_1xl, mutable=["cache"])
-        first = self._pick(logits[:, true_len - 1],
+                dict(variables, cache=cache), tokens_1xl,
+                mutable=["cache"])
+        first = self._pick(logits[:, local_idx],
                            seed[None], jnp.zeros((1,), jnp.int32))[0]
-        return vs["cache"], first.astype(prompt_1xl.dtype)
+        return vs["cache"], first.astype(tokens_1xl.dtype)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, cache_b, cache_1, slot, true_len):
@@ -286,7 +310,7 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} new exceeds "
                 f"cache_len={self.cache_len}")
-        if (not self._exact_prefill
+        if (not self._exact_prefill and self.prefill_chunk is None
                 and len(prompt) > self.prompt_buckets[-1]):
             # Catch at submit time: failing later inside run() would
             # drop this request silently and abort others mid-flight.
@@ -299,14 +323,22 @@ class ServingEngine:
             (rid, prompt, max_new_tokens, rid if seed is None else seed))
         return rid
 
-    def _fresh_cache(self):
-        def shape_fn(variables):
-            with quantized_inference():
-                return self._model.apply(
-                    variables, jnp.zeros((self.slots, 1), jnp.int32),
-                    mutable=["cache"])[1]["cache"]
+    def _fresh_cache(self, batch: int):
+        """Zeroed cache tree for ``batch`` rows.  The eval_shape trace
+        runs ONCE per batch size (memoized): prefill asks for a fresh
+        batch-1 cache per request (donation consumes the buffers), and
+        re-tracing the model per request would put host latency in the
+        serving loop."""
+        shapes = self._cache_shapes.get(batch)
+        if shapes is None:
+            def shape_fn(variables):
+                with quantized_inference():
+                    return self._model.apply(
+                        variables, jnp.zeros((batch, 1), jnp.int32),
+                        mutable=["cache"])[1]["cache"]
 
-        shapes = jax.eval_shape(shape_fn, self._variables)
+            shapes = jax.eval_shape(shape_fn, self._variables)
+            self._cache_shapes[batch] = shapes
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     def _fill_free_slots(self):
@@ -320,14 +352,28 @@ class ServingEngine:
                 if max_new == 0:
                     self._outputs[rid] = list(prompt)
                     continue
-                blen = (len(prompt) if self._exact_prefill
-                        else _bucket_len(len(prompt), self.prompt_buckets))
-                padded = np.zeros((1, blen), np.int32)
-                padded[0, :len(prompt)] = prompt
+                n = len(prompt)
+                if self.prefill_chunk is not None:
+                    piece = self.prefill_chunk
+                    n_pieces = -(-n // piece)
+                elif self._exact_prefill:
+                    piece, n_pieces = n, 1
+                else:
+                    piece = _bucket_len(n, self.prompt_buckets)
+                    n_pieces = 1
+                padded = np.zeros((1, piece * n_pieces), np.int32)
+                padded[0, :n] = prompt
                 with self._ctx():
-                    cache_1, first = self._prefill(
-                        self._variables, jnp.asarray(padded),
-                        jnp.int32(len(prompt)), jnp.uint32(seed))
+                    cache_1 = self._fresh_cache(1)
+                    for i in range(n_pieces):
+                        # local_idx only matters on the piece holding
+                        # the last real token (the final one).
+                        local = min(n - 1 - i * piece, piece - 1)
+                        cache_1, first = self._prefill_piece(
+                            self._variables, cache_1,
+                            jnp.asarray(padded[:, i * piece:
+                                               (i + 1) * piece]),
+                            jnp.int32(max(local, 0)), jnp.uint32(seed))
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
@@ -338,7 +384,7 @@ class ServingEngine:
                     continue  # slot still free: try the next request
                 with self._ctx():
                     if self._cache is None:
-                        self._cache = self._fresh_cache()
+                        self._cache = self._fresh_cache(self.slots)
                     self._cache = self._insert(
                         self._cache, cache_1, jnp.int32(slot),
                         jnp.int32(len(prompt)))
