@@ -7,9 +7,10 @@ loop in the JetStream/Orca style, TPU-first throughout.  ``generate()``
 the slowest.  This engine keeps ``slots`` requests in flight over ONE
 static-shaped decode program:
 
-- **prefill** runs each arriving prompt alone (batch 1, bucketed
-  lengths so a handful of compiles cover every prompt), producing that
-  request's per-layer KV rows and first token;
+- **prefill** runs each arriving prompt alone (batch 1; bucketed
+  lengths so a handful of compiles cover every prompt, or
+  ``prefill_chunk`` for ONE per-piece program at any prompt length),
+  producing that request's per-layer KV rows and first token;
 - **insert** copies those rows into a free slot of the big [slots,
   cache_len] cache and pins the slot's per-slot position (the
   ``slot_decode`` cache keeps a VECTOR index — each slot advances from
@@ -20,9 +21,9 @@ static-shaped decode program:
   budget) between chunks and refills their slots from the queue.
 
 Shapes are static everywhere (slot count, cache rows, chunk length,
-prompt buckets) — only cache *contents* and the per-slot index vector
-change, so XLA compiles three programs total and reuses them for the
-whole serving session.
+prompt buckets / prefill pieces) — only cache *contents* and the
+per-slot index vector change, so XLA compiles a handful of programs
+and reuses them for the whole serving session.
 
 Scope: the decoder families ``generate()`` serves (Llama AND
 Mixtral-style MoE — one engine), linear cache, greedy or sampled
