@@ -175,6 +175,31 @@ def test_sharded_engine_matches_unsharded(params, mesh_2d):
     assert serve(None) == serve(mesh_2d)
 
 
+def test_expert_sharded_moe_serving_matches_unsharded():
+    """MoE engine serving under a data×expert mesh: the dense dispatch
+    einsums shard over experts via GSPMD during decode too — outputs
+    token-identical to unsharded serving."""
+    from tensorflow_train_distributed_tpu.models import moe
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        MeshConfig, build_mesh,
+    )
+
+    cfg = moe.MOE_PRESETS["moe_tiny"]
+    params = moe.MoeLmModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    reqs = [([5, 6, 7], 5), ([9, 8, 7, 6], 4)]
+
+    def serve(mesh):
+        eng = ServingEngine(cfg, params, slots=2, cache_len=32, chunk=3,
+                            mesh=mesh)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        return [out[i] for i in ids]
+
+    mesh = build_mesh(MeshConfig(data=2, expert=4))
+    assert serve(None) == serve(mesh)
+
+
 def test_int8_engine_matches_int8_generate(params):
     """int8 weight-only serving through the engine: token-identical to
     generate(quant_scales=...) — the quant interceptor rewrites the

@@ -1,6 +1,6 @@
 #!/bin/bash
 # Mini-convergence capture: the EXACT recipes behind the committed
-# profiles/convergence/*.jsonl artifacts (300 steps each through the
+# profiles/convergence/*.jsonl artifacts (300 or 1000 steps each through the
 # real CLI on the host CPU; ~25 min on a 1-core box).  Re-render the
 # report afterwards: python tools/render_convergence.py --write
 # CI pins 80-step versions of the same runs (tests/test_convergence.py).
@@ -31,6 +31,16 @@ timeout 3000 python -m tensorflow_train_distributed_tpu \
     --platform cpu --log-every 1 --dataset-kwarg num_examples=1024 \
     --jsonl-log $OUT/llama_tiny_sft.jsonl >/dev/null 2>&1
 echo "done: llama_tiny_sft"
+# Long-horizon artifacts: 1000 steps (~15.6 epochs at 1024/16) for the
+# bert/decoder families — the strongest sustained-training baselines.
+for cfg in bert_tiny_mlm llama_tiny_sft; do
+  rm -f $OUT/${cfg}_1k.jsonl
+  timeout 5000 python -m tensorflow_train_distributed_tpu \
+      --config $cfg --steps 1000 --global-batch-size 16 --platform cpu \
+      --log-every 1 --dataset-kwarg num_examples=1024 \
+      --jsonl-log $OUT/${cfg}_1k.jsonl >/dev/null 2>&1
+  echo "done: ${cfg}_1k"
+done
 # gmm certification pair: dense vs dropless expert dispatch, same data/LR.
 for cfg in moe_tiny_lm moe_tiny_lm_gmm; do
   rm -f $OUT/${cfg}.jsonl
