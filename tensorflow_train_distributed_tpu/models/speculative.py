@@ -61,6 +61,32 @@ def _reject_config(name: str, cfg: LlamaConfig):
             "base trees")
 
 
+def accept_block(d_block, preds):
+    """Batched accept-prefix computation (Leviathan greedy rule).
+
+    ``d_block`` [B, k] draft proposals, ``preds`` [B, k+1] the target's
+    greedy choices over the verify block.  Returns ``(emit [B, k+1],
+    emitted [B], accepted [B], next_tok [B])``: per row, the leading
+    ``a`` drafts that match the target are emitted followed by the
+    target's own pick at the first disagreement (the "bonus"); rows
+    beyond ``emitted`` are zero-padding.  Shared by the batch-1 library
+    path and the serving engine's all-slots rounds so the subtle
+    argmin-with-appended-zero trick lives in ONE place.
+    """
+    b, k = d_block.shape
+    match = (d_block == preds[:, :k]).astype(jnp.int32)
+    a = jnp.argmin(jnp.concatenate(
+        [match, jnp.zeros((b, 1), jnp.int32)], axis=1), axis=1)  # [B]
+    emitted = a + 1
+    idx = jnp.arange(k + 1)[None, :]
+    bonus = jnp.take_along_axis(preds, a[:, None], axis=1)       # [B,1]
+    d_pad = jnp.concatenate(
+        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
+    emit = jnp.where(idx < a[:, None], d_pad,
+                     jnp.where(idx == a[:, None], bonus, 0))
+    return emit.astype(d_block.dtype), emitted, a, bonus[:, 0]
+
+
 def _set_cache_index(cache, value):
     """Roll every layer's cache index to ``value`` (scan-stacked index
     leaves broadcast the scalar)."""
@@ -190,21 +216,18 @@ def _speculate(target_config, draft_config, max_new, k,
         preds = jnp.argmax(logits[0].astype(jnp.float32),
                            axis=-1).astype(tok.dtype)  # [k+1]: n0..nk
 
-        # a = leading i with d_i == n_i; emit d0..d_{a-1} then n_a.
-        match = (d_block == preds[:k]).astype(jnp.int32)
-        a = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), jnp.int32)]))
-        emitted = a + 1
-        idx = jnp.arange(k + 1)
-        d_padded = jnp.concatenate([d_block, jnp.zeros((1,), tok.dtype)])
-        emit = jnp.where(idx < a, d_padded,
-                         jnp.where(idx == a, preds[a], 0)).astype(tok.dtype)
-        out = jax.lax.dynamic_update_slice(out, emit[None, :], (0, done))
+        # a = leading i with d_i == n_i; emit d0..d_{a-1} then n_a
+        # (shared batched rule; batch of 1 here).
+        emit_b, emitted_b, a_b, next_b = accept_block(
+            d_block[None, :], preds[None, :])
+        a, emitted = a_b[0], emitted_b[0]
+        out = jax.lax.dynamic_update_slice(out, emit_b, (0, done))
 
         # Roll both caches back to the accepted context.
         new_index = ctx + emitted
         d_cache = _set_cache_index(d_cache, new_index)
         t_cache = _set_cache_index(t_cache, new_index)
-        return (d_cache, t_cache, preds[a][None], done + emitted, out,
+        return (d_cache, t_cache, next_b, done + emitted, out,
                 rounds + 1, acc_total + a)
 
     def cond(carry):

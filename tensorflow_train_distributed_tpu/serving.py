@@ -101,6 +101,8 @@ class ServingEngine:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  prefill_chunk: Optional[int] = None,
+                 draft_config=None, draft_params=None,
+                 speculative_k: int = 0,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
@@ -179,6 +181,57 @@ class ServingEngine:
         self._variables = maybe_quant_variables(params, quant_scales)
         self._model = _decode_model(config, self.cache_len,
                                     slot_decode=True)
+        # Speculative decoding across ALL slots: each round the draft
+        # proposes k tokens per slot, the target verifies the k+1 block
+        # in one call, and each slot accepts its own prefix — the
+        # per-slot cache index makes the rollback a per-slot index
+        # decrement (the library path, models/speculative.py, is batch-1
+        # precisely because the shared-index cache cannot do this).
+        self._spec_k = int(speculative_k)
+        self._draft_model = None
+        if (draft_config is None) != (draft_params is None):
+            raise ValueError("draft_config and draft_params come together")
+        if self._spec_k and draft_config is None:
+            raise ValueError("speculative_k needs draft_config/params")
+        if draft_config is not None:
+            if self._spec_k < 1:
+                raise ValueError(
+                    f"draft_config needs speculative_k >= 1, got "
+                    f"{self._spec_k}")
+            if (getattr(draft_config, "kv_cache_int8", False)
+                    or getattr(draft_config, "attention_sinks", 0)):
+                # Same screen as the target's: a bad draft config would
+                # otherwise crash inside run(), aborting in-flight work.
+                raise ValueError(
+                    "the draft uses the per-slot linear cache too; "
+                    "attention_sinks / kv_cache_int8 draft configs are "
+                    "unsupported")
+            from tensorflow_train_distributed_tpu.models.speculative import (
+                _reject_config,
+            )
+
+            if not self._greedy:
+                raise ValueError(
+                    "speculative serving is greedy-only (acceptance is "
+                    "defined against the target's argmax)")
+            if quant_scales is not None:
+                raise ValueError(
+                    "speculative serving has no dequant path; pass "
+                    "full-precision trees")
+            _reject_config("target", config)
+            _reject_config("draft", draft_config)
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {config.vocab_size}")
+            if has_lora_leaves(draft_params):
+                raise ValueError("merge the draft's LoRA adapters first")
+            if cast_params:
+                draft_params = cast_floating(draft_params,
+                                             draft_config.dtype)
+            self._draft_variables = {"params": draft_params}
+            self._draft_model = _decode_model(
+                draft_config, self.cache_len, slot_decode=True)
         # Sharded serving: with a mesh, every device call runs under
         # jax.set_mesh + the logical-axis rules, so the models' logical
         # constraints shard weights/cache/activations (e.g. heads over
@@ -193,7 +246,10 @@ class ServingEngine:
         self._next_id = 0
         self._slot_states: list[Optional[_SlotState]] = [None] * slots
         self._cache = None  # built lazily on first insert (needs params)
-        self._cache_shapes: dict = {}  # batch -> eval_shape result
+        self._d_cache = None               # draft slots (speculative)
+        self.spec_stats = {"rounds": 0, "drafted_accepted": 0,
+                           "emitted": 0}
+        self._cache_shapes: dict = {}  # (model, batch) -> eval_shape
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -254,6 +310,78 @@ class ServingEngine:
         first = self._pick(logits[:, local_idx],
                            seed[None], jnp.zeros((1,), jnp.int32))[0]
         return vs["cache"], first.astype(tokens_1xl.dtype)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _draft_prefill_piece(self, variables, cache, tokens_1xl):
+        """Draft-model prefill piece (no token pick — the draft only
+        needs its KV rows; pad rows are harmless by the same
+        write-before-read rule as the target's)."""
+        with quantized_inference():
+            _, vs = self._draft_model.apply(
+                dict(variables, cache=cache), tokens_1xl,
+                mutable=["cache"])
+        return vs["cache"]
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
+    def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok):
+        """One speculative round for ALL slots: the draft proposes k
+        tokens per slot (k+1 steps — the last append-only so both
+        caches hold identical row sets), the target verifies each
+        slot's k+1 block in one call, each slot accepts its own
+        longest matching prefix, and both cache indices rewind
+        PER SLOT by k+1-emitted (rows beyond stay stale-but-invisible:
+        masks are position-based and writes precede reads).
+
+        Returns (t_cache, d_cache, emit [B, k+1], emitted [B],
+        next_tok [B], accepted [B]).  Emitted tokens are exactly the
+        target's greedy choices — slot outputs are token-identical to
+        non-speculative serving (pinned in tests).
+        """
+        k = self._spec_k
+
+        def draft_step(c, t):
+            cache, tk = c
+            with quantized_inference():
+                logits, upd = self._draft_model.apply(
+                    dict(d_vars, cache=cache), tk[:, None],
+                    mutable=["cache"])
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             -1).astype(tk.dtype)
+            return (upd["cache"], nxt), nxt
+
+        (d_cache, _), drafts = jax.lax.scan(
+            draft_step, (d_cache, tok), None, length=k + 1)
+        drafts = jnp.moveaxis(drafts, 0, 1)        # [B, k+1]; d0..dk
+        d_block = drafts[:, :k]                    # [B, k]
+
+        block = jnp.concatenate([tok[:, None], d_block], axis=1)
+        with quantized_inference():
+            logits, upd = self._model.apply(
+                dict(t_vars, cache=t_cache), block, mutable=["cache"])
+        t_cache = upd["cache"]
+        preds = jnp.argmax(logits.astype(jnp.float32),
+                           -1).astype(tok.dtype)   # [B, k+1]
+
+        # Per slot: emit the longest matching prefix then the target's
+        # own pick (one shared rule with the batch-1 library path).
+        from tensorflow_train_distributed_tpu.models.speculative import (
+            accept_block,
+        )
+
+        emit, emitted, a, next_tok = accept_block(d_block, preds)
+
+        # Per-slot rewind: both caches advanced k+1 this round; the
+        # accepted context is old + emitted, i.e. index -= k+1-emitted.
+        back = (k + 1) - emitted                   # [B]
+
+        def rewind(path, leaf):
+            if any(getattr(p, "key", "") == "index" for p in path):
+                return leaf - back.astype(leaf.dtype)
+            return leaf
+
+        t_cache = jax.tree_util.tree_map_with_path(rewind, t_cache)
+        d_cache = jax.tree_util.tree_map_with_path(rewind, d_cache)
+        return t_cache, d_cache, emit, emitted, next_tok, a
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, cache_b, cache_1, slot, true_len):
@@ -324,22 +452,27 @@ class ServingEngine:
             (rid, prompt, max_new_tokens, rid if seed is None else seed))
         return rid
 
-    def _fresh_cache(self, batch: int):
-        """Zeroed cache tree for ``batch`` rows.  The eval_shape trace
-        runs ONCE per batch size (memoized): prefill asks for a fresh
-        batch-1 cache per request (donation consumes the buffers), and
-        re-tracing the model per request would put host latency in the
-        serving loop."""
-        shapes = self._cache_shapes.get(batch)
+    def _fresh_cache(self, batch: int, draft: bool = False):
+        """Zeroed cache tree for ``batch`` rows (target or draft model).
+        The eval_shape trace runs ONCE per (model, batch) (memoized):
+        prefill asks for a fresh batch-1 cache per request (donation
+        consumes the buffers), and re-tracing the model per request
+        would put host latency in the serving loop."""
+        key = (draft, batch)
+        shapes = self._cache_shapes.get(key)
         if shapes is None:
+            model = self._draft_model if draft else self._model
+            variables = (self._draft_variables if draft
+                         else self._variables)
+
             def shape_fn(variables):
                 with quantized_inference():
-                    return self._model.apply(
+                    return model.apply(
                         variables, jnp.zeros((batch, 1), jnp.int32),
                         mutable=["cache"])[1]["cache"]
 
-            shapes = jax.eval_shape(shape_fn, self._variables)
-            self._cache_shapes[batch] = shapes
+            shapes = jax.eval_shape(shape_fn, variables)
+            self._cache_shapes[key] = shapes
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     def _fill_free_slots(self):
@@ -375,6 +508,13 @@ class ServingEngine:
                             jnp.asarray(padded[:, i * piece:
                                                (i + 1) * piece]),
                             jnp.int32(max(local, 0)), jnp.uint32(seed))
+                    if self._draft_model is not None:
+                        d_cache_1 = self._fresh_cache(1, draft=True)
+                        for i in range(n_pieces):
+                            d_cache_1 = self._draft_prefill_piece(
+                                self._draft_variables, d_cache_1,
+                                jnp.asarray(padded[:, i * piece:
+                                                   (i + 1) * piece]))
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
@@ -389,25 +529,58 @@ class ServingEngine:
                     self._cache = self._insert(
                         self._cache, cache_1, jnp.int32(slot),
                         jnp.int32(len(prompt)))
+                    if self._draft_model is not None:
+                        if self._d_cache is None:
+                            self._d_cache = self._fresh_cache(
+                                self.slots, draft=True)
+                        self._d_cache = self._insert(
+                            self._d_cache, d_cache_1, jnp.int32(slot),
+                            jnp.int32(len(prompt)))
                 self._slot_states[slot] = state
+
+    def _consume(self, state, tokens) -> None:
+        """Append generated tokens to a slot's request, enforcing the
+        budget and EOS — the ONE termination rule for chunked and
+        speculative harvests alike."""
+        for t in tokens:
+            t = int(t)
+            state.tokens.append(t)
+            state.last_token = t
+            state.count += 1
+            state.remaining -= 1
+            if (state.remaining <= 0
+                    or (self.eos_id is not None and t == self.eos_id)):
+                state.done = True
+                break
+
+    def _retire_if_done(self, slot, state):
+        if state.done:
+            self._outputs[state.request_id] = state.tokens
+            self._slot_states[slot] = None
 
     def _harvest(self, toks: np.ndarray):
         for slot, state in enumerate(self._slot_states):
             if state is None:
                 continue
-            for t in toks[slot]:
-                t = int(t)
-                state.tokens.append(t)
-                state.last_token = t
-                state.count += 1
-                state.remaining -= 1
-                if (state.remaining <= 0
-                        or (self.eos_id is not None and t == self.eos_id)):
-                    state.done = True
-                    break
-            if state.done:
-                self._outputs[state.request_id] = state.tokens
-                self._slot_states[slot] = None
+            self._consume(state, toks[slot])
+            self._retire_if_done(slot, state)
+
+    def _harvest_spec(self, emit, emitted, next_tok, accepted):
+        """Consume each slot's emitted prefix from a speculative round
+        (variable per slot; budget/EOS via the shared consume rule),
+        tracking acceptance stats.  The round's bonus token is the last
+        emitted one, so a surviving slot's ``last_token`` already holds
+        ``next_tok`` after consuming."""
+        del next_tok  # == emit[slot, emitted-1], consumed above
+        for slot, state in enumerate(self._slot_states):
+            if state is None:
+                continue
+            before = len(state.tokens)
+            self.spec_stats["rounds"] += 1
+            self.spec_stats["drafted_accepted"] += int(accepted[slot])
+            self._consume(state, emit[slot, :int(emitted[slot])])
+            self.spec_stats["emitted"] += len(state.tokens) - before
+            self._retire_if_done(slot, state)
 
     def pending(self) -> int:
         """Requests not yet finished (queued + in flight)."""
@@ -433,11 +606,22 @@ class ServingEngine:
                     tok[slot] = state.last_token
                     seeds[slot] = state.seed
                     counts[slot] = state.count
-            with self._ctx():
-                self._cache, toks = self._decode_chunk(
-                    self._variables, self._cache, jnp.asarray(tok),
-                    jnp.asarray(seeds), jnp.asarray(counts))
-            self._harvest(np.asarray(toks))
+            if self._draft_model is not None:
+                with self._ctx():
+                    (self._cache, self._d_cache, emit, emitted,
+                     next_tok, acc) = self._spec_round(
+                        self._variables, self._draft_variables,
+                        self._cache, self._d_cache, jnp.asarray(tok))
+                self._harvest_spec(np.asarray(emit),
+                                   np.asarray(emitted),
+                                   np.asarray(next_tok),
+                                   np.asarray(acc))
+            else:
+                with self._ctx():
+                    self._cache, toks = self._decode_chunk(
+                        self._variables, self._cache, jnp.asarray(tok),
+                        jnp.asarray(seeds), jnp.asarray(counts))
+                self._harvest(np.asarray(toks))
         out, self._outputs = self._outputs, {}
         return out
 

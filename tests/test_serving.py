@@ -349,6 +349,67 @@ def test_online_submission_mid_flight(params):
         assert out[rid] == _ref(params, p, m), f"request {rid}"
 
 
+class TestSpeculativeServing:
+    """Speculative decoding across slots: per-slot acceptance lengths
+    with per-slot cache rewinds (the library path is batch-1 precisely
+    because the shared-index cache cannot do this)."""
+
+    def _reqs(self, seed):
+        rng = np.random.default_rng(seed)
+        return [(list(rng.integers(1, 200, n)), m)
+                for n, m in [(5, 9), (3, 7), (6, 11), (4, 5)]]
+
+    def _serve(self, params, draft_cfg, draft_params, reqs, k=3):
+        eng = ServingEngine(CFG, params, slots=2, cache_len=48, chunk=3,
+                            prompt_buckets=(8,), draft_config=draft_cfg,
+                            draft_params=draft_params, speculative_k=k)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        return [out[i] for i in ids], eng.spec_stats
+
+    def test_self_draft_matches_generate(self, params):
+        """Draft == target: every draft accepted, outputs exactly the
+        target's greedy decode under contention and refill."""
+        reqs = self._reqs(20)
+        outs, stats = self._serve(params, CFG, params, reqs)
+        for got, (p, m) in zip(outs, reqs):
+            assert got == _ref(params, p, m)
+        # Perfect draft: near-total acceptance (>= rounds*k - k hedges a
+        # potential last-bit argmax tie flip between matmul widths, the
+        # same hedge as tests/test_speculative.py).
+        assert stats["drafted_accepted"] >= 3 * stats["rounds"] - 3
+
+    def test_disagreeing_draft_still_exact(self, params):
+        """A randomly-initialized draft (near-zero acceptance) must not
+        change a single output token — speculation is a latency lever,
+        never a correctness knob."""
+        dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+        dparams = LlamaModel(dcfg).init(
+            jax.random.PRNGKey(99), jnp.zeros((1, 4), jnp.int32))["params"]
+        reqs = self._reqs(21)
+        outs, stats = self._serve(params, dcfg, dparams, reqs)
+        for got, (p, m) in zip(outs, reqs):
+            assert got == _ref(params, p, m)
+        # Each request's token 1 comes from prefill; spec rounds emit
+        # the remaining m-1.
+        assert stats["emitted"] == sum(m - 1 for _, m in reqs)
+
+    def test_validation(self, params):
+        with pytest.raises(ValueError, match="speculative_k"):
+            ServingEngine(CFG, params, draft_config=CFG,
+                          draft_params=params)
+        with pytest.raises(ValueError, match="draft_config"):
+            ServingEngine(CFG, params, speculative_k=3)
+        with pytest.raises(ValueError, match="greedy"):
+            ServingEngine(CFG, params, draft_config=CFG,
+                          draft_params=params, speculative_k=3,
+                          temperature=0.5)
+        dcfg = dataclasses.replace(CFG, vocab_size=128)
+        with pytest.raises(ValueError, match="vocab"):
+            ServingEngine(CFG, params, draft_config=dcfg,
+                          draft_params=params, speculative_k=3)
+
+
 def test_serve_cli_roundtrip(tmp_path):
     """tools/serve.py: train a tiny checkpoint, then batch-serve
     MIXED-LENGTH prompts through the engine CLI — one JSONL line per
