@@ -450,6 +450,49 @@ def test_serve_cli_roundtrip(tmp_path):
     assert len(lines[2]["tokens"]) == 4 + 3
 
 
+def test_serve_cli_speculative(tmp_path, capsys):
+    """tools/serve.py --speculative-*: the engine must actually run
+    speculative rounds (stderr stats prove it — a silent fall-through
+    to plain decoding once shipped unnoticed) and emit byte-identical
+    output to plain serving."""
+    import importlib.util
+    import os
+
+    from tensorflow_train_distributed_tpu import launch
+
+    ckpt = str(tmp_path / "ck")
+    draft = str(tmp_path / "dk")
+    for d, steps in ((ckpt, "3"), (draft, "2")):
+        launch.run(launch.build_parser().parse_args([
+            "--config", "llama_tiny_sft", "--steps", steps,
+            "--global-batch-size", "8", "--checkpoint-dir", d,
+            "--checkpoint-every", steps, "--log-every", "3"]))
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        "serve_spec_under_test", os.path.join(tools, "serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    base = ["--config", "llama_tiny_sft", "--checkpoint-dir", ckpt,
+            "--prompt", "1,2,3", "--prompt", "4,5,6,7",
+            "--max-new", "6", "--slots", "2"]
+    assert mod.main(base + ["--speculative-draft-config",
+                            "llama_tiny_sft",
+                            "--speculative-draft-checkpoint", draft,
+                            "--speculative-k", "3"]) == 0
+    cap = capsys.readouterr()
+    spec_lines = [ln for ln in cap.out.splitlines() if ln.startswith("{")]
+    assert "speculative: rounds=" in cap.err
+    rounds = int(cap.err.split("rounds=")[1].split()[0])
+    assert rounds >= 1
+    assert mod.main(base) == 0
+    plain_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                   if ln.startswith("{")]
+    assert spec_lines == plain_lines
+    with pytest.raises(SystemExit, match="draft-config"):
+        mod.main(base + ["--speculative-draft-checkpoint", draft])
+
+
 def test_submit_rejects_over_bucket_prompt(params):
     """Over-bucket prompts fail at submit() — failing inside run()
     would silently drop the request and abort others mid-flight."""

@@ -59,6 +59,15 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--quant", default="", choices=["", "int8"])
+    p.add_argument("--speculative-draft-config", default=None,
+                   help="enable speculative serving: registry config of "
+                        "the DRAFT model (same vocab; greedy only). "
+                        "Every slot keeps its own acceptance length; "
+                        "outputs stay token-identical to plain serving")
+    p.add_argument("--speculative-draft-checkpoint", default=None,
+                   help="orbax checkpoint dir for the draft's weights")
+    p.add_argument("--speculative-k", type=int, default=4,
+                   help="draft block length per round")
     p.add_argument("--output", default="-",
                    help="output JSONL path ('-' = stdout)")
     p.add_argument("--platform", default="",
@@ -127,6 +136,25 @@ def main(argv=None) -> int:
         except OSError as e:
             raise SystemExit(f"cannot write --output {args.output}: {e}")
 
+    draft_cfg = draft_params = None
+    if (args.speculative_draft_checkpoint
+            and not args.speculative_draft_config):
+        raise SystemExit("--speculative-draft-checkpoint needs "
+                         "--speculative-draft-config")
+    if args.speculative_draft_config:
+        if not args.speculative_draft_checkpoint:
+            raise SystemExit("--speculative-draft-checkpoint is required "
+                             "with --speculative-draft-config")
+        if args.quant:
+            raise SystemExit("speculative serving has no dequant path; "
+                             "drop --quant")
+        _, draft_cfg, draft_moe = resolve_decoder_task(
+            args.speculative_draft_config, "speculative serving")
+        if draft_moe:
+            raise SystemExit("the draft config must be a llama-family "
+                             "decoder")
+        draft_params = _restore_params(args.speculative_draft_checkpoint)
+
     params = _restore_params(args.checkpoint_dir)
     quant_scales = None
     if args.quant == "int8":
@@ -146,7 +174,10 @@ def main(argv=None) -> int:
             cfg, params, slots=args.slots, chunk=args.chunk,
             cache_len=args.cache_len or None, eos_id=args.eos_id,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, quant_scales=quant_scales)
+            top_p=args.top_p, quant_scales=quant_scales,
+            draft_config=draft_cfg, draft_params=draft_params,
+            speculative_k=(args.speculative_k
+                           if draft_cfg is not None else 0))
         ids = [eng.submit(r["prompt"], r["max_new"],
                           seed=r.get("seed")) for r in reqs]
     except ValueError as e:
@@ -154,6 +185,13 @@ def main(argv=None) -> int:
     sink = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         out = eng.run()
+        if draft_cfg is not None:
+            # Observable proof the speculative path actually engaged
+            # (and the acceptance rate the draft is buying).
+            s = eng.spec_stats
+            print(f"speculative: rounds={s['rounds']} "
+                  f"accepted={s['drafted_accepted']} "
+                  f"emitted={s['emitted']}", file=sys.stderr)
         for rid, r in zip(ids, reqs):
             sink.write(json.dumps({
                 "id": rid, "prompt": r["prompt"],
