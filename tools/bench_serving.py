@@ -31,7 +31,8 @@ def _requests(n, plo, phi, glo, ghi, vocab, seed):
 
 
 def bench_serving(preset, slots, chunk, n_requests, prompt_range,
-                  new_range, cache_len, baseline, seed):
+                  new_range, cache_len, baseline, seed,
+                  draft_preset="", speculative_k=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -49,12 +50,19 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
                      min(cfg.vocab_size, 30_000), seed)
     gen_tokens = sum(m for _, m in reqs)
 
+    draft_cfg = draft_params = None
+    if draft_preset:
+        draft_cfg = LLAMA_PRESETS[draft_preset]
+        draft_params = LlamaModel(draft_cfg).init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
     # ONE engine for warmup + timed runs: the jitted programs are keyed
     # on the engine instance (static self), so a fresh engine would pay
     # every compile again inside the timed region.  run() is reentrant
     # (tests/test_serving.py) — stale slot caches cannot contaminate.
     eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
-                        cache_len=cache_len)
+                        cache_len=cache_len, draft_config=draft_cfg,
+                        draft_params=draft_params,
+                        speculative_k=speculative_k if draft_cfg else 0)
 
     def run_engine():
         for p, m in reqs:
@@ -68,8 +76,10 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     total_len = run_engine()
     dt = time.perf_counter() - t0
     dev = jax.devices()[0]
+    name = (f"{preset}_serving_engine_spec" if draft_preset
+            else f"{preset}_serving_engine")
     rec = {
-        "metric": f"{preset}_serving_engine_tokens_per_sec",
+        "metric": f"{name}_tokens_per_sec",
         "value": round(gen_tokens / dt, 1),
         "unit": "generated tokens/sec",
         "wall_s": round(dt, 3),
@@ -81,6 +91,13 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         "backend": dev.platform,
         "device_kind": dev.device_kind,
     }
+    if draft_preset:
+        rec["draft_preset"] = draft_preset
+        rec["speculative_k"] = speculative_k
+        s = eng.spec_stats
+        if s["rounds"]:
+            rec["acceptance_rate"] = round(
+                s["drafted_accepted"] / (s["rounds"] * speculative_k), 3)
     if baseline:
         def run_static():
             done = 0
@@ -123,6 +140,11 @@ def main(argv=None) -> int:
                    help="0 -> config.max_positions")
     p.add_argument("--baseline", action="store_true",
                    help="also time the static-batch generate path")
+    p.add_argument("--speculative-draft", default="",
+                   help="llama preset for a draft model: speculative "
+                        "serving A/B (random-init draft, so acceptance "
+                        "is the floor — real drafts only do better)")
+    p.add_argument("--speculative-k", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
@@ -148,10 +170,15 @@ def main(argv=None) -> int:
             rec = bench_serving(args.preset, args.slots, args.chunk,
                                 args.requests, prompt_range, new_range,
                                 args.cache_len or None, args.baseline,
-                                args.seed)
+                                args.seed,
+                                draft_preset=args.speculative_draft,
+                                speculative_k=args.speculative_k)
     except Exception as e:
+        name = (f"{args.preset}_serving_engine_spec"
+                if args.speculative_draft
+                else f"{args.preset}_serving_engine")
         print(json.dumps({
-            "metric": f"{args.preset}_serving_engine_tokens_per_sec",
+            "metric": f"{name}_tokens_per_sec",
             "value": 0.0, "unit": "generated tokens/sec",
             "error": f"{type(e).__name__}: {e}"}), flush=True)
         return 1
