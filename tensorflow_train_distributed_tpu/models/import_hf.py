@@ -114,6 +114,11 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
     Honors ``config.scan_layers`` (stacks per-layer trees along a leading
     axis, the nn.scan layout) vs per-layer ``layer_{i}`` modules.
     """
+    if getattr(config, "fused_qkv", False):
+        raise ValueError(
+            "fused_qkv configs use one 'qkv' kernel; HF checkpoints ship "
+            "split q/k/v projections — import with fused_qkv=False (the "
+            "layouts are not interchangeable)")
     sd = state_dict
     embed = _np(sd["model.embed_tokens.weight"])
     if embed.shape != (config.vocab_size, config.d_model):

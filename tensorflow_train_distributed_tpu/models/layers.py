@@ -196,6 +196,14 @@ class MultiHeadAttention(nn.Module):
     slot_decode: bool = False
     # Projection biases (BERT-style encoders; Llama-family stays False).
     use_bias: bool = False
+    # Fuse q/k/v into ONE gemm ("qkv" kernel, [embed, (H+2·KV)·D]).
+    # MFU lever for small decoders where three launch-bound projections
+    # under-fill the MXU; self-attention only, and the param tree
+    # differs from the split layout (checkpoints are not interchangeable
+    # — pick per config, before training).  Under a tensor mesh the
+    # post-gemm q/k/v slices cut across the fused dim's shards, so keep
+    # it for single-chip/dp serving and training runs.
+    fused_qkv: bool = False
 
     def _proj(self, x, heads, name):
         # Plain 2-D kernel (embed, heads*head_dim) + reshape: maps onto
@@ -214,7 +222,43 @@ class MultiHeadAttention(nn.Module):
         )(x)
         y = y.reshape(*x.shape[:-1], heads, self.head_dim)
         return nn.with_logical_constraint(
-            y, ("batch", "length", "heads", "kv"))
+            y, ("batch", "length", self._head_ax(heads), "kv"))
+
+    def _qkv(self, x):
+        """Self-attention q/k/v: three gemms, or one fused gemm
+        (``fused_qkv``) split head-wise after the reshape."""
+        kv_heads = self.num_kv_heads or self.num_heads
+        if not self.fused_qkv:
+            return (self._proj(x, self.num_heads, "query"),
+                    self._proj(x, kv_heads, "key"),
+                    self._proj(x, kv_heads, "value"))
+        tot = self.num_heads + 2 * kv_heads
+        y = nn.Dense(
+            tot * self.head_dim, use_bias=self.use_bias,
+            dtype=self.dtype, name="qkv",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads")),
+        )(x)
+        y = y.reshape(*x.shape[:-1], tot, self.head_dim)
+        y = nn.with_logical_constraint(
+            y, ("batch", "length", self._head_ax(tot), "kv"))
+        return (y[..., :self.num_heads, :],
+                y[..., self.num_heads:self.num_heads + kv_heads, :],
+                y[..., self.num_heads + kv_heads:, :])
+
+    def _head_ax(self, heads):
+        """Logical axis for a ``heads``-sized activation dim.
+
+        GQA with fewer kv heads than the tensor degree ("heads" maps to
+        the tensor axis in DEFAULT_RULES): replicate the head axis
+        instead of letting GSPMD pad-shard a 2-head dim over 4 ways and
+        relayout it inside the decode while-loop by involuntary full
+        rematerialization (caught by the driver dryrun's sharded-serving
+        step, which asserts on the warning)."""
+        mesh = _active_mesh("tensor")
+        if mesh is not None and heads % mesh.shape["tensor"]:
+            return None
+        return "heads"
 
     def _out_proj(self, x, features):
         return nn.Dense(
@@ -245,9 +289,15 @@ class MultiHeadAttention(nn.Module):
         x_kv = x_q if x_kv is None else x_kv
         kv_heads = self.num_kv_heads or self.num_heads
 
-        q = self._proj(x_q, self.num_heads, "query")
-        k = self._proj(x_kv, kv_heads, "key")
-        v = self._proj(x_kv, kv_heads, "value")
+        if x_kv is x_q:
+            q, k, v = self._qkv(x_q)
+        else:
+            if self.fused_qkv:
+                raise ValueError("fused_qkv is self-attention only "
+                                 "(q and kv read different inputs)")
+            q = self._proj(x_q, self.num_heads, "query")
+            k = self._proj(x_kv, kv_heads, "key")
+            v = self._proj(x_kv, kv_heads, "value")
 
         if self.use_rope:
             if positions is None:
@@ -299,7 +349,7 @@ class MultiHeadAttention(nn.Module):
                 sinks=self.sinks,
             ).transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
-            out, ("batch", "length", "heads", "kv"))
+            out, ("batch", "length", self._head_ax(self.num_heads), "kv"))
         if self.dropout_rate > 0 and not deterministic:
             out = nn.Dropout(self.dropout_rate)(out,
                                                 deterministic=deterministic)
@@ -358,9 +408,7 @@ class MultiHeadAttention(nn.Module):
         # a tracer (inside jit even the fresh-init zero is one).
         fresh_cache = not self.has_variable("cache", "index")
 
-        q = self._proj(x, self.num_heads, "query")
-        k = self._proj(x, kv_heads, "key")
-        v = self._proj(x, kv_heads, "value")
+        q, k, v = self._qkv(x)
 
         cache_dtype = jnp.int8 if self.kv_cache_int8 else self.dtype
         cache_k = self.variable(
@@ -508,9 +556,7 @@ class MultiHeadAttention(nn.Module):
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
 
-        q = self._proj(x, self.num_heads, "query")
-        k = self._proj(x, kv_heads, "key")
-        v = self._proj(x, kv_heads, "value")
+        q, k, v = self._qkv(x)
 
         cache_k = self.variable(
             "cache", "key_cache", jnp.zeros,
@@ -544,10 +590,11 @@ class MultiHeadAttention(nn.Module):
         # Same logical sharding as the training path: under a tensor/fsdp
         # mesh the cache reads and attention activations shard over heads
         # rather than replicating (B, cache_len, H, D) per device.
+        kv_ax = self._head_ax(kv_heads)
         kh = nn.with_logical_constraint(
-            kc, ("batch", "length", "heads", "kv"))
+            kc, ("batch", "length", kv_ax, "kv"))
         vh = nn.with_logical_constraint(
-            vc, ("batch", "length", "heads", "kv"))
+            vc, ("batch", "length", kv_ax, "kv"))
         if kv_heads != self.num_heads:
             rep = self.num_heads // kv_heads
             kh = jnp.repeat(kh, rep, axis=2)
@@ -563,7 +610,7 @@ class MultiHeadAttention(nn.Module):
         out = dot_product_attention(qh, kh, vh, mask=mask)
         out = out.transpose(0, 2, 1, 3)
         out = nn.with_logical_constraint(
-            out, ("batch", "length", "heads", "kv"))
+            out, ("batch", "length", self._head_ax(self.num_heads), "kv"))
         out = out.reshape(b, q_len, self.num_heads * self.head_dim)
         y = self._out_proj(out, features)
         return nn.with_logical_constraint(y, ("batch", "length", "embed"))

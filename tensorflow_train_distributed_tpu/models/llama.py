@@ -90,6 +90,28 @@ class LlamaConfig:
     # per-(position, kv_head) scales, dequant fused into the attention
     # read.  Training is unaffected (no cache there).
     kv_cache_int8: bool = False
+    # One fused qkv gemm instead of three (layers.MultiHeadAttention
+    # fused_qkv): an MFU lever for small decoders where three
+    # launch-bound projections under-fill the MXU.  The param tree
+    # differs from the split layout — pick before training; single-chip
+    # / dp meshes (the fused-dim slices fight a tensor axis).
+    fused_qkv: bool = False
+
+    def __post_init__(self):
+        if self.fused_qkv and self.lora is not None:
+            attn = ({"query", "key", "value"}
+                    & set(getattr(self.lora, "targets", ())))
+            if attn:
+                # The q/k/v Dense modules become one "qkv" module, so
+                # name-based LoRA targeting of them matches NOTHING —
+                # and if any non-attention target still matches, the
+                # n_lora==0 structural guard passes and a frozen-base
+                # run silently trains without attention adapters.
+                raise ValueError(
+                    f"fused_qkv replaces the q/k/v projections with one "
+                    f"'qkv' module; LoRA targets {sorted(attn)} would "
+                    "match nothing — fine-tune attention with "
+                    "fused_qkv=False")
 
 
 LLAMA_PRESETS = {
@@ -187,6 +209,7 @@ class DecoderBlock(nn.Module):
             cache_len=self.cache_len or cfg.max_positions,
             kv_cache_int8=cfg.kv_cache_int8,
             slot_decode=self.slot_decode,
+            fused_qkv=cfg.fused_qkv,
             name="attention",
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,

@@ -38,6 +38,7 @@ windows keep the shared-index ``generate()`` path.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from collections import deque
 from functools import partial
 from typing import Optional
@@ -47,6 +48,8 @@ import contextlib
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
@@ -140,15 +143,21 @@ class ServingEngine:
                 f"{config.max_positions}")
         self.eos_id = eos_id
         self.chunk = chunk
-        # MoE prefill must run at the EXACT prompt length: the router's
-        # per-group capacity is ⌈cf·k·S/E⌉ — a bucket-padded S changes
-        # the capacity constant, so drop behavior (and therefore tokens)
-        # would diverge from generate()'s unpadded prefill.  Exact
-        # lengths cost one prefill compile per distinct length instead
-        # of per bucket (and the buckets are never consulted).
+        # Dense-dispatch MoE prefill must run at the EXACT prompt
+        # length: the router's per-group capacity is ⌈cf·k·S/E⌉ — a
+        # bucket-padded S changes the capacity constant, so drop
+        # behavior (and therefore tokens) would diverge from
+        # generate()'s unpadded prefill.  Exact lengths cost one prefill
+        # compile per distinct length instead of per bucket (and the
+        # buckets are never consulted) — the engine warns per new
+        # length.  dispatch="gmm" (dropless) routes every token
+        # independently with no capacity competition, so pad tokens
+        # cannot perturb real ones — bucketed AND chunked prefill stay
+        # exact there (parity-pinned in tests/test_serving.py).
         from tensorflow_train_distributed_tpu.models.moe import MoeConfig
 
-        self._exact_prefill = isinstance(config, MoeConfig)
+        self._exact_prefill = (isinstance(config, MoeConfig)
+                               and config.dispatch != "gmm")
         # Chunked prefill: long prompts run through the SAME per-piece
         # program in ``prefill_chunk``-token pieces (the decode cache
         # appends multi-token blocks at any position), bounding prefill
@@ -161,10 +170,12 @@ class ServingEngine:
                     f"prefill_chunk must be >= 1, got {prefill_chunk}")
             if self._exact_prefill:
                 raise ValueError(
-                    "prefill_chunk is unsupported for MoE configs: the "
-                    "router's per-group capacity depends on the prefill "
-                    "length, so chunking would change routing vs "
-                    "generate() (MoE prefills at the exact length)")
+                    "prefill_chunk is unsupported for dense-dispatch "
+                    "MoE configs: the router's per-group capacity "
+                    "depends on the prefill length, so chunking would "
+                    "change routing vs generate() (dense MoE prefills "
+                    "at the exact length; dispatch='gmm' is dropless "
+                    "and supports chunked/bucketed prefill)")
         self.prefill_chunk = prefill_chunk
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.cache_len)
@@ -210,10 +221,6 @@ class ServingEngine:
                 _reject_config,
             )
 
-            if not self._greedy:
-                raise ValueError(
-                    "speculative serving is greedy-only (acceptance is "
-                    "defined against the target's argmax)")
             if quant_scales is not None:
                 raise ValueError(
                     "speculative serving has no dequant path; pass "
@@ -247,9 +254,13 @@ class ServingEngine:
         self._slot_states: list[Optional[_SlotState]] = [None] * slots
         self._cache = None  # built lazily on first insert (needs params)
         self._d_cache = None               # draft slots (speculative)
-        self.spec_stats = {"rounds": 0, "drafted_accepted": 0,
-                           "emitted": 0}
+        # "rounds" counts ENGINE rounds (one _spec_round call);
+        # "slot_rounds" counts active slots across them — the
+        # denominator for acceptance rates (accepted/(slot_rounds·k)).
+        self.spec_stats = {"rounds": 0, "slot_rounds": 0,
+                           "drafted_accepted": 0, "emitted": 0}
         self._cache_shapes: dict = {}  # (model, batch) -> eval_shape
+        self._moe_prefill_lens: set = set()  # distinct exact-prefill lens
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -322,8 +333,61 @@ class ServingEngine:
                 mutable=["cache"])
         return vs["cache"]
 
+    def _accept_block_sampled(self, d_block, q, logits, round_keys,
+                              dtype):
+        """Rejection-sampling acceptance (Leviathan et al. generalized
+        from the greedy rule): accept draft ``x_i`` with probability
+        min(1, p_i(x_i)/q_i(x_i)); at the first rejection draw from the
+        residual norm(max(p_i - q_i, 0)); if all k survive, draw the
+        bonus from the target's (k+1)-th filtered distribution.  The
+        emitted tokens are distributed EXACTLY as plain sampled decoding
+        from the target — speculation changes latency, not the law.
+
+        ``q`` [B, k, V] are the draft's filtered/softmaxed proposal
+        distributions; ``logits`` [B, k+1, V] the target's raw logits.
+        Returns (emit [B, k+1], emitted [B], accepted [B], final [B]).
+        """
+        k = self._spec_k
+        b = d_block.shape[0]
+        p = jax.nn.softmax(filter_logits(
+            logits, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p), axis=-1)            # [B, k+1, V]
+        gather = lambda dist, ids: jnp.take_along_axis(
+            dist, ids[..., None].astype(jnp.int32), axis=2)[..., 0]
+        px = gather(p[:, :k], d_block)             # [B, k]
+        qx = gather(q, d_block)                    # [B, k]
+        us = jax.vmap(lambda kk: jax.random.uniform(
+            jax.random.fold_in(kk, k + 1), (k,)))(round_keys)
+        ok = us * qx < px                # u < p/q without dividing
+        a = jnp.argmin(jnp.concatenate(
+            [ok.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+            axis=1), axis=1)                       # [B] accepted count
+        emitted = a + 1
+        # The final token's distribution at position a: the residual
+        # for a < k, the target's own p for a == k (q padded with a
+        # zero row makes that one formula — residual of p-0 is p).
+        q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
+        p_at = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
+        q_at = jnp.take_along_axis(q_pad, a[:, None, None], axis=1)[:, 0]
+        res = jnp.clip(p_at - q_at, 0.0)
+        tot = res.sum(-1, keepdims=True)
+        # tot == 0 only when p == q at the rejected position — a
+        # measure-zero event under exact arithmetic; fall back to p.
+        safe = jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0),
+                         p_at)
+        final = jax.vmap(lambda kk, pr: jax.random.categorical(
+            jax.random.fold_in(kk, k + 2), jnp.log(pr + 1e-38))
+        )(round_keys, safe).astype(dtype)
+        idx = jnp.arange(k + 1)[None, :]
+        d_pad = jnp.concatenate(
+            [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
+        emit = jnp.where(idx < a[:, None], d_pad,
+                         jnp.where(idx == a[:, None], final[:, None], 0))
+        return emit.astype(dtype), emitted, a, final
+
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
-    def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok):
+    def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok, seeds,
+                    counts):
         """One speculative round for ALL slots: the draft proposes k
         tokens per slot (k+1 steps — the last append-only so both
         caches hold identical row sets), the target verifies each
@@ -333,24 +397,40 @@ class ServingEngine:
         masks are position-based and writes precede reads).
 
         Returns (t_cache, d_cache, emit [B, k+1], emitted [B],
-        next_tok [B], accepted [B]).  Emitted tokens are exactly the
-        target's greedy choices — slot outputs are token-identical to
-        non-speculative serving (pinned in tests).
+        next_tok [B], accepted [B]).  Greedy: emitted tokens are
+        exactly the target's greedy choices — token-identical to
+        non-speculative serving (pinned in tests).  Sampled: draft
+        proposals are accepted by the rejection rule
+        (``_accept_block_sampled``), so outputs are distributed as
+        plain sampled serving — same law, fewer target steps; the
+        per-slot stream (``seeds``/``counts``) keys every draw, so a
+        round is reproducible independent of slot placement.
         """
         k = self._spec_k
+        round_keys = jax.vmap(jax.random.fold_in)(
+            jax.vmap(jax.random.key)(seeds.astype(jnp.uint32)), counts)
 
-        def draft_step(c, t):
+        def draft_step(c, j):
             cache, tk = c
             with quantized_inference():
                 logits, upd = self._draft_model.apply(
                     dict(d_vars, cache=cache), tk[:, None],
                     mutable=["cache"])
-            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
-                             -1).astype(tk.dtype)
-            return (upd["cache"], nxt), nxt
+            logits = logits[:, -1].astype(jnp.float32)
+            if self._greedy:
+                nxt = jnp.argmax(logits, -1).astype(tk.dtype)
+                return (upd["cache"], nxt), nxt
+            filt = filter_logits(logits, temperature=self.temperature,
+                                 top_k=self.top_k, top_p=self.top_p)
+            keys = jax.vmap(lambda kk: jax.random.fold_in(kk, j))(
+                round_keys)
+            nxt = jax.vmap(jax.random.categorical)(keys, filt).astype(
+                tk.dtype)
+            return (upd["cache"], nxt), (nxt, jax.nn.softmax(filt, -1))
 
-        (d_cache, _), drafts = jax.lax.scan(
-            draft_step, (d_cache, tok), None, length=k + 1)
+        (d_cache, _), scanned = jax.lax.scan(
+            draft_step, (d_cache, tok), jnp.arange(k + 1))
+        drafts = scanned if self._greedy else scanned[0]
         drafts = jnp.moveaxis(drafts, 0, 1)        # [B, k+1]; d0..dk
         d_block = drafts[:, :k]                    # [B, k]
 
@@ -359,16 +439,22 @@ class ServingEngine:
             logits, upd = self._model.apply(
                 dict(t_vars, cache=t_cache), block, mutable=["cache"])
         t_cache = upd["cache"]
-        preds = jnp.argmax(logits.astype(jnp.float32),
-                           -1).astype(tok.dtype)   # [B, k+1]
+        logits = logits.astype(jnp.float32)        # [B, k+1, V]
 
-        # Per slot: emit the longest matching prefix then the target's
-        # own pick (one shared rule with the batch-1 library path).
-        from tensorflow_train_distributed_tpu.models.speculative import (
-            accept_block,
-        )
+        if self._greedy:
+            # Per slot: emit the longest matching prefix then the
+            # target's own pick (one shared rule with the batch-1
+            # library path).
+            from tensorflow_train_distributed_tpu.models.speculative import (
+                accept_block,
+            )
 
-        emit, emitted, a, next_tok = accept_block(d_block, preds)
+            preds = jnp.argmax(logits, -1).astype(tok.dtype)
+            emit, emitted, a, next_tok = accept_block(d_block, preds)
+        else:
+            q = jnp.moveaxis(scanned[1], 0, 1)[:, :k]   # [B, k, V]
+            emit, emitted, a, next_tok = self._accept_block_sampled(
+                d_block, q, logits, round_keys, tok.dtype)
 
         # Per-slot rewind: both caches advanced k+1 this round; the
         # accepted context is old + emitted, i.e. index -= k+1-emitted.
@@ -492,6 +578,21 @@ class ServingEngine:
                     n_pieces = -(-n // piece)
                 elif self._exact_prefill:
                     piece, n_pieces = n, 1
+                    if n not in self._moe_prefill_lens:
+                        self._moe_prefill_lens.add(n)
+                        if len(self._moe_prefill_lens) > 1:
+                            # Compile-storm hazard: MoE prefills at the
+                            # EXACT length (router capacity depends on
+                            # it), so every distinct prompt length is a
+                            # new XLA program.  Warn once per length;
+                            # mitigation: pad/truncate prompts to a few
+                            # lengths host-side (MIGRATION.md §8).
+                            logger.warning(
+                                "MoE engine prefill compiling for new "
+                                "prompt length %d (%d distinct lengths "
+                                "so far — one program each; consider "
+                                "padding prompts to a few fixed lengths)",
+                                n, len(self._moe_prefill_lens))
                 else:
                     piece = _bucket_len(n, self.prompt_buckets)
                     n_pieces = 1
@@ -508,6 +609,17 @@ class ServingEngine:
                             jnp.asarray(padded[:, i * piece:
                                                (i + 1) * piece]),
                             jnp.int32(max(local, 0)), jnp.uint32(seed))
+                first = int(first)
+                state = _SlotState(request_id=rid, remaining=max_new - 1,
+                                   tokens=list(prompt) + [first],
+                                   last_token=first, seed=seed, count=1)
+                if (max_new == 1 or (self.eos_id is not None
+                                     and first == self.eos_id)):
+                    # Resolved at prefill — and checked BEFORE the draft
+                    # prefill, which such a request would waste.
+                    self._outputs[rid] = state.tokens
+                    continue  # slot still free: try the next request
+                with self._ctx():
                     if self._draft_model is not None:
                         d_cache_1 = self._fresh_cache(1, draft=True)
                         for i in range(n_pieces):
@@ -515,15 +627,6 @@ class ServingEngine:
                                 self._draft_variables, d_cache_1,
                                 jnp.asarray(padded[:, i * piece:
                                                    (i + 1) * piece]))
-                first = int(first)
-                state = _SlotState(request_id=rid, remaining=max_new - 1,
-                                   tokens=list(prompt) + [first],
-                                   last_token=first, seed=seed, count=1)
-                if (max_new == 1 or (self.eos_id is not None
-                                     and first == self.eos_id)):
-                    self._outputs[rid] = state.tokens
-                    continue  # slot still free: try the next request
-                with self._ctx():
                     if self._cache is None:
                         self._cache = self._fresh_cache(self.slots)
                     self._cache = self._insert(
@@ -572,11 +675,12 @@ class ServingEngine:
         emitted one, so a surviving slot's ``last_token`` already holds
         ``next_tok`` after consuming."""
         del next_tok  # == emit[slot, emitted-1], consumed above
+        self.spec_stats["rounds"] += 1     # engine rounds, not slot-rounds
         for slot, state in enumerate(self._slot_states):
             if state is None:
                 continue
             before = len(state.tokens)
-            self.spec_stats["rounds"] += 1
+            self.spec_stats["slot_rounds"] += 1
             self.spec_stats["drafted_accepted"] += int(accepted[slot])
             self._consume(state, emit[slot, :int(emitted[slot])])
             self.spec_stats["emitted"] += len(state.tokens) - before
@@ -611,7 +715,8 @@ class ServingEngine:
                     (self._cache, self._d_cache, emit, emitted,
                      next_tok, acc) = self._spec_round(
                         self._variables, self._draft_variables,
-                        self._cache, self._d_cache, jnp.asarray(tok))
+                        self._cache, self._d_cache, jnp.asarray(tok),
+                        jnp.asarray(seeds), jnp.asarray(counts))
                 self._harvest_spec(np.asarray(emit),
                                    np.asarray(emitted),
                                    np.asarray(next_tok),

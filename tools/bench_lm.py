@@ -150,7 +150,8 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
 
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
              remat=None, remat_policy=None, force_hbm: bool = False,
-             sliding_window: int = 0, profile_dir: str = ""):
+             sliding_window: int = 0, fused_qkv: bool = False,
+             profile_dir: str = ""):
     import jax
     import numpy as np
     import optax
@@ -175,6 +176,10 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         cfg = dataclasses.replace(cfg, remat=remat)
     if remat_policy is not None:
         cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
+    if fused_qkv:
+        # MFU lever A/B (fresh init both arms -- loss values differ from
+        # split-projection runs, throughput is the comparison).
+        cfg = dataclasses.replace(cfg, fused_qkv=True)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
     task = llama.CausalLmTask(cfg)
@@ -241,6 +246,8 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
     }
     if cfg.sliding_window:
         rec["sliding_window"] = cfg.sliding_window
+    if cfg.fused_qkv:
+        rec["fused_qkv"] = True
     peak = peak_tflops(dev0)
     if peak is not None:
         mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
@@ -272,6 +279,9 @@ def main(argv=None) -> int:
                     default=None, help="force activation remat on")
     rm.add_argument("--no-remat", dest="remat", action="store_false",
                     help="disable remat (faster when memory allows)")
+    p.add_argument("--fused-qkv", action="store_true",
+                   help="fuse q/k/v into one gemm (MFU lever A/B; "
+                        "param layout differs from split projections)")
     p.add_argument("--remat-policy", default=None,
                    choices=("full", "dots", "no_ffn"),
                    help="what remat saves (see LlamaConfig.remat_policy)")
@@ -307,6 +317,7 @@ def main(argv=None) -> int:
                            remat_policy=args.remat_policy,
                            force_hbm=args.force_hbm,
                            sliding_window=args.sliding_window,
+                           fused_qkv=args.fused_qkv,
                            profile_dir=args.profile_dir)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"

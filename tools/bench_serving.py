@@ -95,9 +95,12 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         rec["draft_preset"] = draft_preset
         rec["speculative_k"] = speculative_k
         s = eng.spec_stats
-        if s["rounds"]:
+        if s["slot_rounds"]:
+            # Fraction of drafted tokens accepted: each ACTIVE slot in a
+            # round drafts k tokens (slot_rounds, not engine rounds).
             rec["acceptance_rate"] = round(
-                s["drafted_accepted"] / (s["rounds"] * speculative_k), 3)
+                s["drafted_accepted"] / (s["slot_rounds"]
+                                         * speculative_k), 3)
     if baseline:
         def run_static():
             done = 0
@@ -111,7 +114,11 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
                 for j, (p, _) in enumerate(grp):
                     batch[j, plen - len(p):] = p  # left-pad: keeps the
                     # last prompt token at the shared final position so
-                    # one batched generate covers the group
+                    # one batched generate covers the group.  The pad
+                    # zeros are treated as real context (positions start
+                    # at 0), so baseline OUTPUTS are not valid
+                    # generations — the baseline is FLOP/timing-
+                    # equivalent only, which is all the A/B compares.
                 out = generate(cfg, params, jnp.asarray(batch), mnew)
                 done += int(np.asarray(out).shape[1]) * len(grp)
             return done

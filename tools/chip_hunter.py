@@ -110,6 +110,12 @@ STEPS = [
       "--batch-per-chip", "4", "--seq", "4096", "--remat",
       "--sliding-window", "512"],
      {"TTD_SPLASH": "1"}),
+    # Fused-QKV MFU lever (VERDICT r4 item 4): one qkv gemm vs three —
+    # A/B against lm_noffn_b8's 32.6k tok/s record, same shape/remat.
+    ("lm_fused_qkv", 700,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
+      "--batch-per-chip", "8", "--seq", "2048",
+      "--remat", "--remat-policy", "no_ffn", "--fused-qkv"]),
     # ── Re-confirmation block: already measured this week; refresh for
     # the round-5 record when the priority block has drained.
     ("resnet_s2d", 560,
@@ -274,7 +280,21 @@ def last_json_line(text: str):
     return fallback
 
 
-FULL_EMIT = os.path.join(REPO, "profiles", "bench", "last_emit.json")
+def _bench_full_emit_path() -> str:
+    """bench.py's FULL_EMIT_PATH, imported (not re-derived) so a move
+    of the persisted-record location cannot silently strand the merge
+    on a stale literal.  bench.py's module level is side-effect-free
+    (stdlib imports and constants only)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_emit_path", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.FULL_EMIT_PATH
+
+
+FULL_EMIT = _bench_full_emit_path()
 
 
 def _prefer_full_emit(rec, t0: float):

@@ -60,6 +60,7 @@ STEP_KEYS = {
     "lm_window_splash_s4096": "llama_125m_window512_splash_s4096",
     "moe_gmm": "moe_370m_gmm",
     "serve_engine": "llama_125m_serving_engine",
+    "lm_fused_qkv": "llama_125m_noffn_b8_fused_qkv",
 }
 
 

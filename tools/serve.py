@@ -61,9 +61,10 @@ def main(argv=None) -> int:
     p.add_argument("--quant", default="", choices=["", "int8"])
     p.add_argument("--speculative-draft-config", default=None,
                    help="enable speculative serving: registry config of "
-                        "the DRAFT model (same vocab; greedy only). "
-                        "Every slot keeps its own acceptance length; "
-                        "outputs stay token-identical to plain serving")
+                        "the DRAFT model (same vocab). Every slot keeps "
+                        "its own acceptance length; greedy outputs stay "
+                        "token-identical to plain serving, sampled ones "
+                        "follow the same distribution (rejection rule)")
     p.add_argument("--speculative-draft-checkpoint", default=None,
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
@@ -182,23 +183,28 @@ def main(argv=None) -> int:
                           seed=r.get("seed")) for r in reqs]
     except ValueError as e:
         raise SystemExit(str(e))
-    sink = sys.stdout if args.output == "-" else open(args.output, "w")
-    try:
-        out = eng.run()
-        if draft_cfg is not None:
-            # Observable proof the speculative path actually engaged
-            # (and the acceptance rate the draft is buying).
-            s = eng.spec_stats
-            print(f"speculative: rounds={s['rounds']} "
-                  f"accepted={s['drafted_accepted']} "
-                  f"emitted={s['emitted']}", file=sys.stderr)
-        for rid, r in zip(ids, reqs):
-            sink.write(json.dumps({
-                "id": rid, "prompt": r["prompt"],
-                "tokens": out[rid]}) + "\n")
-    finally:
-        if sink is not sys.stdout:
-            sink.close()
+    out = eng.run()
+    if draft_cfg is not None:
+        # Observable proof the speculative path actually engaged
+        # (and the acceptance rate the draft is buying).
+        s = eng.spec_stats
+        print(f"speculative: rounds={s['rounds']} "
+              f"accepted={s['drafted_accepted']} "
+              f"emitted={s['emitted']}", file=sys.stderr)
+    lines = [json.dumps({"id": rid, "prompt": r["prompt"],
+                         "tokens": out[rid]}) + "\n"
+             for rid, r in zip(ids, reqs)]
+    if args.output == "-":
+        sys.stdout.writelines(lines)
+    else:
+        # Results in hand before the sink is touched: a failure during
+        # serving (OOM, interrupt) must never destroy a pre-existing
+        # results file.  Write-temp-then-rename keeps the replacement
+        # atomic too.
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as sink:
+            sink.writelines(lines)
+        os.replace(tmp, args.output)
     return 0
 
 
