@@ -87,6 +87,53 @@ def accept_block(d_block, preds):
     return emit.astype(d_block.dtype), emitted, a, bonus[:, 0]
 
 
+def sampled_accept(d_block, q, p, us, final_keys):
+    """Rejection-sampling acceptance (Leviathan et al. generalized from
+    the greedy prefix-match rule), batched — THE shared law for the
+    serving engine's all-slot rounds and the batch-1 library path.
+
+    ``d_block`` [B, k] draft samples drawn from ``q`` [B, k, V] (the
+    draft's filtered/softmaxed proposal distributions); ``p``
+    [B, k+1, V] the target's filtered/softmaxed distributions over the
+    verify block; ``us`` [B, k] acceptance uniforms; ``final_keys``
+    [B] rng keys for the residual/bonus draw.  Accept draft ``x_i``
+    with probability min(1, p_i(x_i)/q_i(x_i)); at the first rejection
+    draw from the residual norm(max(p_i − q_i, 0)); if all k survive,
+    draw the bonus from ``p_k`` (q zero-padded makes that one formula —
+    the residual of p−0 is p).  Emitted tokens are distributed EXACTLY
+    as sampling from ``p`` — speculation changes latency, not the law.
+
+    Returns ``(emit [B, k+1], emitted [B], accepted [B], final [B])``
+    with the same emit layout as ``accept_block``.
+    """
+    b, k = d_block.shape
+    gather = lambda dist, ids: jnp.take_along_axis(
+        dist, ids[..., None].astype(jnp.int32), axis=2)[..., 0]
+    px = gather(p[:, :k], d_block)             # [B, k]
+    qx = gather(q, d_block)                    # [B, k]
+    ok = us * qx < px                # u < p/q without dividing
+    a = jnp.argmin(jnp.concatenate(
+        [ok.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+        axis=1), axis=1)                       # [B] accepted count
+    emitted = a + 1
+    q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
+    p_at = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
+    q_at = jnp.take_along_axis(q_pad, a[:, None, None], axis=1)[:, 0]
+    res = jnp.clip(p_at - q_at, 0.0)
+    tot = res.sum(-1, keepdims=True)
+    # tot == 0 only when p == q at the rejected position — a
+    # measure-zero event under exact arithmetic; fall back to p.
+    safe = jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0), p_at)
+    final = jax.vmap(lambda fk, pr: jax.random.categorical(
+        fk, jnp.log(pr + 1e-38)))(final_keys, safe).astype(d_block.dtype)
+    idx = jnp.arange(k + 1)[None, :]
+    d_pad = jnp.concatenate(
+        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
+    emit = jnp.where(idx < a[:, None], d_pad,
+                     jnp.where(idx == a[:, None], final[:, None], 0))
+    return emit.astype(d_block.dtype), emitted, a, final
+
+
 def _set_cache_index(cache, value):
     """Roll every layer's cache index to ``value`` (scan-stacked index
     leaves broadcast the scalar)."""
@@ -101,14 +148,27 @@ def _set_cache_index(cache, value):
 def generate_speculative(target_config: LlamaConfig, target_params,
                          draft_config: LlamaConfig, draft_params,
                          prompt: jax.Array, max_new_tokens: int, *,
-                         k: int = 4, cast_params: bool = True):
-    """Greedy decode of ``max_new_tokens`` via draft speculation.
+                         k: int = 4, cast_params: bool = True,
+                         temperature: float = 0.0, top_k=None,
+                         top_p=None, seed: int = 0):
+    """Decode of ``max_new_tokens`` via draft speculation.
 
     Returns ``(tokens [1, S+max_new], accepted_rounds_stats)`` where the
     stats dict carries ``rounds`` and ``drafted_accepted`` (host ints,
-    for measuring acceptance rate).  Output tokens are identical to
+    for measuring acceptance rate).  ``temperature`` 0 (default):
+    greedy — output tokens are identical to
     ``generate(target_config, target_params, prompt, max_new_tokens)``.
+    ``temperature`` > 0: the draft samples its proposals (same
+    temperature/top_k/top_p filters as the target) and acceptance uses
+    the rejection rule (``sampled_accept``), so outputs follow the SAME
+    distribution as plain sampled decoding from the target; ``seed``
+    names the rng stream (deterministic per seed).
     """
+    from tensorflow_train_distributed_tpu.models.generate import (
+        validate_sampling,
+    )
+
+    validate_sampling(temperature, top_k, top_p)
     if prompt.ndim != 2 or prompt.shape[0] != 1:
         raise ValueError(
             f"speculative decode is batch-1 (per-row acceptance lengths "
@@ -154,7 +214,9 @@ def generate_speculative(target_config: LlamaConfig, target_params,
         draft_params = cast_floating(draft_params, draft_config.dtype)
     out, rounds, accepted = _speculate(
         target_config, draft_config, int(max_new_tokens), int(k),
-        target_params, draft_params, prompt)
+        float(temperature), top_k, top_p,
+        target_params, draft_params, prompt,
+        jnp.uint32(seed))
     stats = {"rounds": int(rounds),
              "drafted_accepted": int(accepted),
              "tokens": int(max_new_tokens)}
@@ -162,50 +224,76 @@ def generate_speculative(target_config: LlamaConfig, target_params,
 
 
 @partial(jax.jit, static_argnames=("target_config", "draft_config",
-                                   "max_new", "k"))
+                                   "max_new", "k", "temperature",
+                                   "top_k", "top_p"))
 def _speculate(target_config, draft_config, max_new, k,
-               target_params, draft_params, prompt):
+               temperature, top_k, top_p,
+               target_params, draft_params, prompt, seed):
+    from tensorflow_train_distributed_tpu.models.generate import (
+        filter_logits,
+    )
+
+    greedy = temperature == 0.0
+    stream = jax.random.key(seed)
+
+    def _filter(lg):
+        return filter_logits(lg, temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+
     prompt_len = prompt.shape[1]
     cache_len = prompt_len + max_new + k + 1
     target = LlamaModel(target_config, decode=True, cache_len=cache_len)
     draft = LlamaModel(draft_config, decode=True, cache_len=cache_len)
 
-    # Prefill both on the prompt; the target's last logit emits token 1.
+    # Prefill both on the prompt; the target's last logit emits token 1
+    # (draw index 0 of the stream when sampling).
     t_logits, t_vars = target.apply({"params": target_params}, prompt,
                                     mutable=["cache"])
     _, d_vars = draft.apply({"params": draft_params}, prompt,
                             mutable=["cache"])
-    tok0 = jnp.argmax(t_logits[:, -1].astype(jnp.float32),
-                      axis=-1).astype(prompt.dtype)  # [1]
+    last = t_logits[:, -1].astype(jnp.float32)       # [1, V]
+    if greedy:
+        tok0 = jnp.argmax(last, axis=-1).astype(prompt.dtype)  # [1]
+    else:
+        tok0 = jax.random.categorical(
+            jax.random.fold_in(stream, 0), _filter(last)[0]
+        )[None].astype(prompt.dtype)
 
     out0 = jnp.zeros((1, max_new + k + 1), prompt.dtype)
     out0 = out0.at[:, 0].set(tok0)
 
-    def draft_step(cache, tok):
-        logits, upd = draft.apply(
-            {"params": draft_params, "cache": cache}, tok[:, None],
-            mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
-                         axis=-1).astype(tok.dtype)
-        return upd["cache"], nxt
-
     def body(carry):
         d_cache, t_cache, tok, done, out, rounds, acc_total = carry
         ctx = prompt_len + done - 1  # non-prompt rows both caches hold
+        # Per-round key: ``done`` strictly increases (every round emits
+        # >= 1 token), so no round reuses a key; draw indices within
+        # the round are 0..k (draft), k+1 (uniforms), k+2 (final) —
+        # the same layout as the serving engine's per-slot streams.
+        round_key = jax.random.fold_in(stream, done)
 
         # Draft k+1 steps: inputs [tok, d0..d_{k-1}] -> emits d0..dk.
         # The k+1-th step is append-only (dk discarded) so the draft
         # cache finishes holding the SAME row set as the target's, and
         # both roll back by one rule below.
-        def scan_step(c, _):
+        def scan_step(c, j):
             cache, t = c
-            cache, nxt = draft_step(cache, t)
-            return (cache, nxt), nxt  # collect OUTPUT tokens d0..dk
+            logits_d, upd = draft.apply(
+                {"params": draft_params, "cache": cache}, t[:, None],
+                mutable=["cache"])
+            lg = logits_d[:, -1].astype(jnp.float32)    # [1, V]
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1).astype(t.dtype)
+                return (upd["cache"], nxt), nxt
+            filt = _filter(lg)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(round_key, j), filt[0]
+            )[None].astype(t.dtype)
+            return (upd["cache"], nxt), (nxt, jax.nn.softmax(filt, -1))
 
-        (d_cache, _), drafts = jax.lax.scan(
-            scan_step, (d_cache, tok), None, length=k + 1)
-        drafts = drafts[:, 0]            # [k+1]; last entry unused (dk)
-        d_block = drafts[:k]             # d0..d_{k-1}
+        (d_cache, _), scanned = jax.lax.scan(
+            scan_step, (d_cache, tok), jnp.arange(k + 1))
+        drafts = (scanned if greedy else scanned[0])[:, 0]
+        d_block = drafts[:k]             # d0..d_{k-1}; dk unused
 
         # Target verifies [tok, d0..d_{k-1}] in one k+1-token call.
         block = jnp.concatenate([tok, d_block], axis=0)[None, :]  # [1,k+1]
@@ -213,13 +301,25 @@ def _speculate(target_config, draft_config, max_new, k,
             {"params": target_params, "cache": t_cache}, block,
             mutable=["cache"])
         t_cache = t_upd["cache"]
-        preds = jnp.argmax(logits[0].astype(jnp.float32),
-                           axis=-1).astype(tok.dtype)  # [k+1]: n0..nk
 
-        # a = leading i with d_i == n_i; emit d0..d_{a-1} then n_a
-        # (shared batched rule; batch of 1 here).
-        emit_b, emitted_b, a_b, next_b = accept_block(
-            d_block[None, :], preds[None, :])
+        if greedy:
+            preds = jnp.argmax(logits[0].astype(jnp.float32),
+                               axis=-1).astype(tok.dtype)  # [k+1]
+            # a = leading i with d_i == n_i; emit d0..d_{a-1} then n_a
+            # (shared batched rule; batch of 1 here).
+            emit_b, emitted_b, a_b, next_b = accept_block(
+                d_block[None, :], preds[None, :])
+        else:
+            q = jnp.moveaxis(scanned[1][:k], 0, 1)       # [1, k, V]
+            p = jax.nn.softmax(
+                _filter(logits.astype(jnp.float32)), axis=-1)
+            us = jax.random.uniform(
+                jax.random.fold_in(round_key, k + 1), (1, k))
+            emit_b, emitted_b, a_b, next_b = sampled_accept(
+                d_block[None, :].astype(jnp.int32), q, p, us,
+                jax.random.fold_in(round_key, k + 2)[None])
+            emit_b = emit_b.astype(tok.dtype)
+            next_b = next_b.astype(tok.dtype)
         a, emitted = a_b[0], emitted_b[0]
         out = jax.lax.dynamic_update_slice(out, emit_b, (0, done))
 

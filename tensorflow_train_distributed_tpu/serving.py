@@ -335,55 +335,26 @@ class ServingEngine:
 
     def _accept_block_sampled(self, d_block, q, logits, round_keys,
                               dtype):
-        """Rejection-sampling acceptance (Leviathan et al. generalized
-        from the greedy rule): accept draft ``x_i`` with probability
-        min(1, p_i(x_i)/q_i(x_i)); at the first rejection draw from the
-        residual norm(max(p_i - q_i, 0)); if all k survive, draw the
-        bonus from the target's (k+1)-th filtered distribution.  The
-        emitted tokens are distributed EXACTLY as plain sampled decoding
-        from the target — speculation changes latency, not the law.
+        """Engine face of the shared rejection rule
+        (``models.speculative.sampled_accept``): filter/softmax the
+        target's raw ``logits`` [B, k+1, V] with the engine's sampling
+        knobs and derive the per-slot acceptance uniforms (draw index
+        k+1) and residual/bonus keys (k+2) from ``round_keys``."""
+        from tensorflow_train_distributed_tpu.models.speculative import (
+            sampled_accept,
+        )
 
-        ``q`` [B, k, V] are the draft's filtered/softmaxed proposal
-        distributions; ``logits`` [B, k+1, V] the target's raw logits.
-        Returns (emit [B, k+1], emitted [B], accepted [B], final [B]).
-        """
         k = self._spec_k
-        b = d_block.shape[0]
         p = jax.nn.softmax(filter_logits(
             logits, temperature=self.temperature, top_k=self.top_k,
             top_p=self.top_p), axis=-1)            # [B, k+1, V]
-        gather = lambda dist, ids: jnp.take_along_axis(
-            dist, ids[..., None].astype(jnp.int32), axis=2)[..., 0]
-        px = gather(p[:, :k], d_block)             # [B, k]
-        qx = gather(q, d_block)                    # [B, k]
         us = jax.vmap(lambda kk: jax.random.uniform(
             jax.random.fold_in(kk, k + 1), (k,)))(round_keys)
-        ok = us * qx < px                # u < p/q without dividing
-        a = jnp.argmin(jnp.concatenate(
-            [ok.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
-            axis=1), axis=1)                       # [B] accepted count
-        emitted = a + 1
-        # The final token's distribution at position a: the residual
-        # for a < k, the target's own p for a == k (q padded with a
-        # zero row makes that one formula — residual of p-0 is p).
-        q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
-        p_at = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
-        q_at = jnp.take_along_axis(q_pad, a[:, None, None], axis=1)[:, 0]
-        res = jnp.clip(p_at - q_at, 0.0)
-        tot = res.sum(-1, keepdims=True)
-        # tot == 0 only when p == q at the rejected position — a
-        # measure-zero event under exact arithmetic; fall back to p.
-        safe = jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0),
-                         p_at)
-        final = jax.vmap(lambda kk, pr: jax.random.categorical(
-            jax.random.fold_in(kk, k + 2), jnp.log(pr + 1e-38))
-        )(round_keys, safe).astype(dtype)
-        idx = jnp.arange(k + 1)[None, :]
-        d_pad = jnp.concatenate(
-            [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
-        emit = jnp.where(idx < a[:, None], d_pad,
-                         jnp.where(idx == a[:, None], final[:, None], 0))
-        return emit.astype(dtype), emitted, a, final
+        final_keys = jax.vmap(
+            lambda kk: jax.random.fold_in(kk, k + 2))(round_keys)
+        emit, emitted, a, final = sampled_accept(
+            d_block, q, p, us, final_keys)
+        return (emit.astype(dtype), emitted, a, final.astype(dtype))
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
     def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok, seeds,

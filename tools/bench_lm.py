@@ -151,7 +151,7 @@ def timed_step_seconds(step, state, dev_batch, warmup: int,
 def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
              remat=None, remat_policy=None, force_hbm: bool = False,
              sliding_window: int = 0, fused_qkv: bool = False,
-             profile_dir: str = ""):
+             scan_layers=None, profile_dir: str = ""):
     import jax
     import numpy as np
     import optax
@@ -180,6 +180,13 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         # MFU lever A/B (fresh init both arms -- loss values differ from
         # split-projection runs, throughput is the comparison).
         cfg = dataclasses.replace(cfg, fused_qkv=True)
+    if scan_layers is not None:
+        # Unrolled-vs-scanned A/B: nn.scan keeps ONE compiled layer body
+        # (fast compiles, the multi-chip default), but blocks XLA fusion
+        # across layer boundaries -- a plausible MFU thief at 125m scale
+        # where per-layer work is small.  Unrolling trades compile time
+        # for whatever cross-layer fusion buys.
+        cfg = dataclasses.replace(cfg, scan_layers=scan_layers)
     if seq > cfg.max_positions:
         raise SystemExit(f"--seq {seq} > max_positions {cfg.max_positions}")
     task = llama.CausalLmTask(cfg)
@@ -248,6 +255,7 @@ def bench_lm(preset: str, batch: int, seq: int, warmup: int, iters: int,
         rec["sliding_window"] = cfg.sliding_window
     if cfg.fused_qkv:
         rec["fused_qkv"] = True
+    rec["scan_layers"] = cfg.scan_layers
     peak = peak_tflops(dev0)
     if peak is not None:
         mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
@@ -279,6 +287,13 @@ def main(argv=None) -> int:
                     default=None, help="force activation remat on")
     rm.add_argument("--no-remat", dest="remat", action="store_false",
                     help="disable remat (faster when memory allows)")
+    sc = p.add_mutually_exclusive_group()
+    sc.add_argument("--scan-layers", dest="scan_layers",
+                    action="store_true", default=None)
+    sc.add_argument("--no-scan-layers", dest="scan_layers",
+                    action="store_false", default=None,
+                    help="unroll the depth loop (A/B vs nn.scan: trades "
+                         "compile time for cross-layer fusion)")
     p.add_argument("--fused-qkv", action="store_true",
                    help="fuse q/k/v into one gemm (MFU lever A/B; "
                         "param layout differs from split projections)")
@@ -318,6 +333,7 @@ def main(argv=None) -> int:
                            force_hbm=args.force_hbm,
                            sliding_window=args.sliding_window,
                            fused_qkv=args.fused_qkv,
+                           scan_layers=args.scan_layers,
                            profile_dir=args.profile_dir)
     except Exception as e:  # machine-readable failure, bench.py lesson
         print(json.dumps({"metric": f"{args.preset}_train_tokens_per_sec"

@@ -116,6 +116,14 @@ STEPS = [
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "8", "--seq", "2048",
       "--remat", "--remat-policy", "no_ffn", "--fused-qkv"]),
+    # Unrolled-vs-scanned depth loop: nn.scan compiles one layer body
+    # but blocks cross-layer fusion; at 125m the per-layer work is
+    # small enough that unrolling may buy real MFU.  Longer timeout:
+    # unrolled compiles 12 layer bodies.
+    ("lm_noscan", 900,
+     [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
+      "--batch-per-chip", "8", "--seq", "2048",
+      "--remat", "--remat-policy", "no_ffn", "--no-scan-layers"]),
     # ── Re-confirmation block: already measured this week; refresh for
     # the round-5 record when the priority block has drained.
     ("resnet_s2d", 560,

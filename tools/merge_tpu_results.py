@@ -61,6 +61,7 @@ STEP_KEYS = {
     "moe_gmm": "moe_370m_gmm",
     "serve_engine": "llama_125m_serving_engine",
     "lm_fused_qkv": "llama_125m_noffn_b8_fused_qkv",
+    "lm_noscan": "llama_125m_noffn_b8_noscan",
 }
 
 

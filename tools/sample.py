@@ -112,9 +112,10 @@ def main(argv=None) -> int:
                    help="default: the sidecar's, else query,value")
     p.add_argument("--speculative-draft-config", default=None,
                    help="enable speculative decoding: registry config of "
-                        "the DRAFT model (same vocab; greedy only, "
-                        "batch-1). Output is provably identical to the "
-                        "target's own greedy decode")
+                        "the DRAFT model (same vocab; batch-1). Greedy "
+                        "output is provably identical to the target's "
+                        "own greedy decode; with --temperature the "
+                        "rejection rule keeps the plain sampled law")
     p.add_argument("--speculative-draft-checkpoint", default=None,
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
@@ -225,10 +226,13 @@ def main(argv=None) -> int:
     # full-tree quantize first.
     draft_task = None
     if args.speculative_draft_config:
-        if args.temperature > 0 or args.quant or spec is not None:
+        if args.quant or spec is not None:
             raise SystemExit(
-                "--speculative-draft-config is greedy-only and does not "
-                "compose with --quant or LoRA serving (merge first)")
+                "--speculative-draft-config does not compose with "
+                "--quant or LoRA serving (merge first).  Sampling DOES "
+                "compose: with --temperature the draft samples its "
+                "proposals and acceptance uses the rejection rule, so "
+                "outputs follow the same law as plain sampled decoding")
         if is_moe:
             raise SystemExit("speculative decoding needs a llama-family "
                              "TARGET --config")
@@ -269,7 +273,8 @@ def main(argv=None) -> int:
             toks, stats = generate_speculative(
                 cfg, params, draft_task.config, draft_params,
                 jnp.asarray(prompt), args.max_new,
-                k=args.speculative_k)
+                k=args.speculative_k, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, seed=args.seed)
         except ValueError as e:
             # The library's guards (vocab match, k >= 1, the
             # prompt+max_new+k+1 cache budget on BOTH models, LoRA

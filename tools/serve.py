@@ -185,12 +185,19 @@ def main(argv=None) -> int:
         raise SystemExit(str(e))
     out = eng.run()
     if draft_cfg is not None:
-        # Observable proof the speculative path actually engaged
-        # (and the acceptance rate the draft is buying).
+        # Observable proof the speculative path actually engaged (and
+        # the acceptance rate the draft is buying).  The rate divides
+        # by SLOT-rounds × k (each active slot drafts k per round) —
+        # engine rounds alone would inflate it by the slot count.
         s = eng.spec_stats
+        rate = (s["drafted_accepted"] / (s["slot_rounds"]
+                                         * args.speculative_k)
+                if s["slot_rounds"] else 0.0)
         print(f"speculative: rounds={s['rounds']} "
+              f"slot_rounds={s['slot_rounds']} "
               f"accepted={s['drafted_accepted']} "
-              f"emitted={s['emitted']}", file=sys.stderr)
+              f"emitted={s['emitted']} "
+              f"acceptance={rate:.3f}", file=sys.stderr)
     lines = [json.dumps({"id": rid, "prompt": r["prompt"],
                          "tokens": out[rid]}) + "\n"
              for rid, r in zip(ids, reqs)]
