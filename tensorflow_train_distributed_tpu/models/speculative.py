@@ -61,6 +61,27 @@ def _reject_config(name: str, cfg: LlamaConfig):
             "base trees")
 
 
+def _accept_count(ok):
+    """Leading-True count per row of ``ok`` [B, k] — the appended zero
+    column makes argmin return k when every flag is True.  THE shared
+    accepted-count rule for greedy and sampled acceptance."""
+    b = ok.shape[0]
+    return jnp.argmin(jnp.concatenate(
+        [ok.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+        axis=1), axis=1)                                         # [B]
+
+
+def _assemble_emit(d_block, a, final):
+    """Emit layout shared by both acceptance rules: row i carries
+    d_0..d_{a-1}, then ``final`` at position a, zero-padding beyond."""
+    k = d_block.shape[1]
+    idx = jnp.arange(k + 1)[None, :]
+    d_pad = jnp.concatenate(
+        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
+    return jnp.where(idx < a[:, None], d_pad,
+                     jnp.where(idx == a[:, None], final[:, None], 0))
+
+
 def accept_block(d_block, preds):
     """Batched accept-prefix computation (Leviathan greedy rule).
 
@@ -70,21 +91,16 @@ def accept_block(d_block, preds):
     ``a`` drafts that match the target are emitted followed by the
     target's own pick at the first disagreement (the "bonus"); rows
     beyond ``emitted`` are zero-padding.  Shared by the batch-1 library
-    path and the serving engine's all-slots rounds so the subtle
-    argmin-with-appended-zero trick lives in ONE place.
+    path and the serving engine's all-slots rounds; the accepted-count
+    and emit-assembly tricks live in ``_accept_count``/``_assemble_emit``
+    so greedy and sampled acceptance cannot desynchronize.
     """
-    b, k = d_block.shape
-    match = (d_block == preds[:, :k]).astype(jnp.int32)
-    a = jnp.argmin(jnp.concatenate(
-        [match, jnp.zeros((b, 1), jnp.int32)], axis=1), axis=1)  # [B]
+    k = d_block.shape[1]
+    a = _accept_count(d_block == preds[:, :k])
     emitted = a + 1
-    idx = jnp.arange(k + 1)[None, :]
-    bonus = jnp.take_along_axis(preds, a[:, None], axis=1)       # [B,1]
-    d_pad = jnp.concatenate(
-        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
-    emit = jnp.where(idx < a[:, None], d_pad,
-                     jnp.where(idx == a[:, None], bonus, 0))
-    return emit.astype(d_block.dtype), emitted, a, bonus[:, 0]
+    bonus = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]  # [B]
+    emit = _assemble_emit(d_block, a, bonus)
+    return emit.astype(d_block.dtype), emitted, a, bonus
 
 
 def sampled_accept(d_block, q, p, us, final_keys):
@@ -106,15 +122,12 @@ def sampled_accept(d_block, q, p, us, final_keys):
     Returns ``(emit [B, k+1], emitted [B], accepted [B], final [B])``
     with the same emit layout as ``accept_block``.
     """
-    b, k = d_block.shape
+    k = d_block.shape[1]
     gather = lambda dist, ids: jnp.take_along_axis(
         dist, ids[..., None].astype(jnp.int32), axis=2)[..., 0]
     px = gather(p[:, :k], d_block)             # [B, k]
     qx = gather(q, d_block)                    # [B, k]
-    ok = us * qx < px                # u < p/q without dividing
-    a = jnp.argmin(jnp.concatenate(
-        [ok.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
-        axis=1), axis=1)                       # [B] accepted count
+    a = _accept_count(us * qx < px)  # u < p/q without dividing
     emitted = a + 1
     q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
     p_at = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
@@ -126,11 +139,7 @@ def sampled_accept(d_block, q, p, us, final_keys):
     safe = jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0), p_at)
     final = jax.vmap(lambda fk, pr: jax.random.categorical(
         fk, jnp.log(pr + 1e-38)))(final_keys, safe).astype(d_block.dtype)
-    idx = jnp.arange(k + 1)[None, :]
-    d_pad = jnp.concatenate(
-        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
-    emit = jnp.where(idx < a[:, None], d_pad,
-                     jnp.where(idx == a[:, None], final[:, None], 0))
+    emit = _assemble_emit(d_block, a, final)
     return emit.astype(d_block.dtype), emitted, a, final
 
 

@@ -105,6 +105,7 @@ class ServingEngine:
                  top_p: Optional[float] = None,
                  prefill_chunk: Optional[int] = None,
                  draft_config=None, draft_params=None,
+                 draft_quant_scales=None,
                  speculative_k: int = 0,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024)):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
@@ -202,6 +203,8 @@ class ServingEngine:
         self._draft_model = None
         if (draft_config is None) != (draft_params is None):
             raise ValueError("draft_config and draft_params come together")
+        if draft_quant_scales is not None and draft_config is None:
+            raise ValueError("draft_quant_scales needs draft_config/params")
         if self._spec_k and draft_config is None:
             raise ValueError("speculative_k needs draft_config/params")
         if draft_config is not None:
@@ -221,10 +224,6 @@ class ServingEngine:
                 _reject_config,
             )
 
-            if quant_scales is not None:
-                raise ValueError(
-                    "speculative serving has no dequant path; pass "
-                    "full-precision trees")
             _reject_config("target", config)
             _reject_config("draft", draft_config)
             if draft_config.vocab_size != config.vocab_size:
@@ -233,10 +232,19 @@ class ServingEngine:
                     f"vocab {config.vocab_size}")
             if has_lora_leaves(draft_params):
                 raise ValueError("merge the draft's LoRA adapters first")
+            # int8 weight-only serving composes with speculation (the
+            # production pairing: decode is weight-HBM-bound on BOTH
+            # models) — each tree carries its own scales, same pairing
+            # contract as the target's.  Acceptance is defined against
+            # the quantized target's own distribution, so greedy stays
+            # token-identical to int8 generate() and sampled keeps the
+            # int8 target's law.
+            check_quant_pairing(draft_params, draft_quant_scales)
             if cast_params:
                 draft_params = cast_floating(draft_params,
                                              draft_config.dtype)
-            self._draft_variables = {"params": draft_params}
+            self._draft_variables = maybe_quant_variables(
+                draft_params, draft_quant_scales)
             self._draft_model = _decode_model(
                 draft_config, self.cache_len, slot_decode=True)
         # Sharded serving: with a mesh, every device call runs under

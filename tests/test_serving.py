@@ -652,3 +652,48 @@ def test_moe_gmm_bucketed_and_chunked_prefill_match_generate():
     assert serve(prompt_buckets=(8,)) == refs
     # Chunked: 4-token pieces (rejected for dense MoE, sound for gmm).
     assert serve(prefill_chunk=4) == refs
+
+
+def test_int8_speculative_engine_matches_int8_generate(params):
+    """int8 weight-only serving composes with speculative decoding (the
+    production pairing — decode is weight-HBM-bound on BOTH models):
+    greedy outputs must be token-identical to int8 generate(), with a
+    disagreeing draft and with a perfect self-draft."""
+    from tensorflow_train_distributed_tpu.models import quant
+
+    qparams, scales = quant.quantize_params(params)
+    dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+    dparams = LlamaModel(dcfg).init(
+        jax.random.PRNGKey(99), jnp.zeros((1, 4), jnp.int32))["params"]
+    dq, dscales = quant.quantize_params(dparams)
+    rng = np.random.default_rng(8)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(4, 6), (6, 5), (3, 7)]]
+    refs = [np.asarray(generate(
+        CFG, qparams, jnp.asarray([p], jnp.int32), m,
+        quant_scales=scales))[0].tolist() for p, m in reqs]
+
+    def serve(drc, drp, drs):
+        eng = ServingEngine(CFG, qparams, slots=2, cache_len=48,
+                            chunk=3, prompt_buckets=(8,),
+                            quant_scales=scales, draft_config=drc,
+                            draft_params=drp, draft_quant_scales=drs,
+                            speculative_k=3)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        return [out[i] for i in ids], eng.spec_stats
+
+    outs, stats = serve(dcfg, dq, dscales)      # disagreeing int8 draft
+    assert outs == refs
+    assert stats["rounds"] >= 1
+    outs, _ = serve(CFG, qparams, scales)       # perfect int8 self-draft
+    assert outs == refs
+    # Pairing contract holds per-tree: an int8 draft without its scales
+    # fails loudly, as do orphan draft scales.
+    with pytest.raises(ValueError, match="quant_scales"):
+        ServingEngine(CFG, qparams, quant_scales=scales,
+                      draft_config=dcfg, draft_params=dq,
+                      speculative_k=3, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="draft_quant_scales"):
+        ServingEngine(CFG, qparams, quant_scales=scales,
+                      draft_quant_scales=dscales, prompt_buckets=(8,))

@@ -146,9 +146,6 @@ def main(argv=None) -> int:
         if not args.speculative_draft_checkpoint:
             raise SystemExit("--speculative-draft-checkpoint is required "
                              "with --speculative-draft-config")
-        if args.quant:
-            raise SystemExit("speculative serving has no dequant path; "
-                             "drop --quant")
         _, draft_cfg, draft_moe = resolve_decoder_task(
             args.speculative_draft_config, "speculative serving")
         if draft_moe:
@@ -157,13 +154,18 @@ def main(argv=None) -> int:
         draft_params = _restore_params(args.speculative_draft_checkpoint)
 
     params = _restore_params(args.checkpoint_dir)
-    quant_scales = None
+    quant_scales = draft_quant_scales = None
     if args.quant == "int8":
         from tensorflow_train_distributed_tpu.models.quant import (
             quantize_params,
         )
 
         params, quant_scales = quantize_params(params)
+        if draft_params is not None:
+            # --quant quantizes BOTH models (decode is weight-HBM-bound
+            # on both); each tree carries its own scales.
+            draft_params, draft_quant_scales = quantize_params(
+                draft_params)
 
     # Engine/submit validation errors (oversized prompts, bad
     # sampling combos, budget vs cache) exit with the same clean
@@ -177,6 +179,7 @@ def main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, quant_scales=quant_scales,
             draft_config=draft_cfg, draft_params=draft_params,
+            draft_quant_scales=draft_quant_scales,
             speculative_k=(args.speculative_k
                            if draft_cfg is not None else 0))
         ids = [eng.submit(r["prompt"], r["max_new"],
