@@ -31,7 +31,9 @@ decoding (per-request rng streams), with int8 weight-only serving via the same
 ``quant_scales`` contract as generate and sharded (tensor-parallel)
 serving via ``mesh=`` — the models' logical constraints shard weights
 and cache over the mesh, GSPMD inserts the collectives, and outputs
-stay token-identical.  int8 KV cache, LoRA-unmerged params and sliding
+stay token-identical.  Shared prompt prefixes prefill once
+(``preload_prefix``); later requests prefill only their suffix on a
+copied cache.  int8 KV cache, LoRA-unmerged params and sliding
 windows keep the shared-index ``generate()`` path.
 """
 
@@ -269,6 +271,7 @@ class ServingEngine:
                            "drafted_accepted": 0, "emitted": 0}
         self._cache_shapes: dict = {}  # (model, batch) -> eval_shape
         self._moe_prefill_lens: set = set()  # distinct exact-prefill lens
+        self._prefix_caches: dict = {}  # tuple(tokens) -> batch-1 cache
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -504,13 +507,20 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} new exceeds "
                 f"cache_len={self.cache_len}")
-        if (not self._exact_prefill and self.prefill_chunk is None
-                and len(prompt) > self.prompt_buckets[-1]):
+        if not self._exact_prefill and self.prefill_chunk is None:
             # Catch at submit time: failing later inside run() would
             # drop this request silently and abort others mid-flight.
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest "
-                f"prefill bucket {self.prompt_buckets[-1]}")
+            # Only the SUFFIX after the longest preloaded prefix needs
+            # a bucket — a long shared system prompt plus a short tail
+            # is the feature's primary use (preload before submit: a
+            # prefix loaded later cannot rescue an already-rejected
+            # request).
+            work = len(prompt) - self._match_prefix(prompt)[0]
+            if work > self.prompt_buckets[-1]:
+                raise ValueError(
+                    f"prompt length {len(prompt)} (suffix {work} after "
+                    f"the longest preloaded prefix) exceeds the largest "
+                    f"prefill bucket {self.prompt_buckets[-1]}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(
@@ -540,6 +550,76 @@ class ServingEngine:
             self._cache_shapes[key] = shapes
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
+    def preload_prefix(self, tokens) -> None:
+        """Prefill a shared prompt prefix ONCE; every later request
+        whose prompt strictly extends it prefills only the suffix.
+
+        The production lever for shared system prompts / few-shot
+        preambles: the stored batch-1 cache is copied per request
+        (donation-safe) and the suffix pieces append at the prefix's
+        true position — causal masks and RoPE read positions from the
+        per-slot index, so outputs are token-identical to a full
+        prefill (pinned in tests/test_serving.py).  Restrictions:
+        dense-dispatch MoE prefills at the exact full length (routing
+        capacity is length-dependent), and speculative serving drafts
+        the whole prompt — both serve without prefix reuse.
+        """
+        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        if not tokens:
+            raise ValueError("empty prefix")
+        if self._exact_prefill:
+            raise ValueError(
+                "prefix caching needs length-independent routing; "
+                "dense-dispatch MoE prefills at the exact prompt length "
+                "(dispatch='gmm' supports prefix caching)")
+        if self._draft_model is not None:
+            raise ValueError(
+                "prefix caching does not compose with speculative "
+                "serving yet (the draft model prefills the whole "
+                "prompt); serve without a draft to use prefixes")
+        n = len(tokens)
+        if n >= self.cache_len:
+            raise ValueError(
+                f"prefix length {n} must leave cache room "
+                f"(cache_len={self.cache_len})")
+        if self.prefill_chunk is not None:
+            piece = self.prefill_chunk
+            n_pieces = -(-n // piece)
+        else:
+            piece = _bucket_len(n, self.prompt_buckets)
+            n_pieces = 1
+        padded = np.zeros((1, piece * n_pieces), np.int32)
+        padded[0, :n] = tokens
+        with self._ctx():
+            cache_1 = self._fresh_cache(1)
+            for i in range(n_pieces):
+                cache_1, _ = self._prefill_piece(
+                    self._variables, cache_1,
+                    jnp.asarray(padded[:, i * piece:(i + 1) * piece]),
+                    jnp.int32(0), jnp.uint32(0))
+            # Pin the stored index to the TRUE prefix length: suffix
+            # pieces must append at position n, not after the pad rows
+            # (which stay harmless — overwritten before any read).
+            def pin(path, leaf):
+                if any(getattr(k, "key", "") == "index" for k in path):
+                    return jnp.full_like(leaf, n)
+                return leaf
+
+            cache_1 = jax.tree_util.tree_map_with_path(pin, cache_1)
+        self._prefix_caches[tuple(tokens)] = cache_1
+
+    def _match_prefix(self, prompt):
+        """Longest stored prefix the prompt strictly extends →
+        (prefix_len, stored_cache); (0, None) when none applies."""
+        if not self._prefix_caches or self._draft_model is not None:
+            return 0, None
+        best, best_cache = 0, None
+        for toks, cache in self._prefix_caches.items():
+            m = len(toks)
+            if best < m < len(prompt) and prompt[:m] == list(toks):
+                best, best_cache = m, cache
+        return best, best_cache
+
     def _fill_free_slots(self):
         for slot in range(self.slots):
             # Keep popping until this slot is OCCUPIED or the queue is
@@ -552,9 +632,14 @@ class ServingEngine:
                     self._outputs[rid] = list(prompt)
                     continue
                 n = len(prompt)
+                # Prefix reuse: prefill only the suffix on a copy of
+                # the stored cache (piece sizing follows the suffix).
+                pre_len, pre_cache = self._match_prefix(prompt)
+                work = prompt[pre_len:]
+                m = len(work)
                 if self.prefill_chunk is not None:
                     piece = self.prefill_chunk
-                    n_pieces = -(-n // piece)
+                    n_pieces = -(-m // piece)
                 elif self._exact_prefill:
                     piece, n_pieces = n, 1
                     if n not in self._moe_prefill_lens:
@@ -573,16 +658,17 @@ class ServingEngine:
                                 "padding prompts to a few fixed lengths)",
                                 n, len(self._moe_prefill_lens))
                 else:
-                    piece = _bucket_len(n, self.prompt_buckets)
+                    piece = _bucket_len(m, self.prompt_buckets)
                     n_pieces = 1
                 padded = np.zeros((1, piece * n_pieces), np.int32)
-                padded[0, :n] = prompt
+                padded[0, :m] = work
                 with self._ctx():
-                    cache_1 = self._fresh_cache(1)
+                    cache_1 = (self._fresh_cache(1) if pre_cache is None
+                               else jax.tree.map(jnp.copy, pre_cache))
                     for i in range(n_pieces):
                         # local_idx only matters on the piece holding
                         # the last real token (the final one).
-                        local = min(n - 1 - i * piece, piece - 1)
+                        local = min(m - 1 - i * piece, piece - 1)
                         cache_1, first = self._prefill_piece(
                             self._variables, cache_1,
                             jnp.asarray(padded[:, i * piece:

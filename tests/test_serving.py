@@ -697,3 +697,111 @@ def test_int8_speculative_engine_matches_int8_generate(params):
     with pytest.raises(ValueError, match="draft_quant_scales"):
         ServingEngine(CFG, qparams, quant_scales=scales,
                       draft_quant_scales=dscales, prompt_buckets=(8,))
+
+
+class TestPrefixCaching:
+    """preload_prefix(): shared prompt prefixes prefill once; suffix
+    prefill on a copied cache must be token-identical to full prefill."""
+
+    def test_prefix_reuse_matches_full_prefill(self, params):
+        rng = np.random.default_rng(9)
+        system = list(rng.integers(1, 200, 6))
+        reqs = [(system + list(rng.integers(1, 200, d)), m)
+                for d, m in [(3, 6), (5, 5), (1, 7)]]
+        reqs.append((list(rng.integers(1, 200, 4)), 5))  # no prefix match
+        eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=4,
+                            prompt_buckets=(8, 16))
+        eng.preload_prefix(system)
+        # Count device prefill calls: suffixes of 3/5/1 tokens hit the
+        # 8-bucket once each, the non-matching 4-prompt once, and the
+        # preload itself paid one — full prompts would have needed the
+        # 16-bucket for the 6+3 and 6+5 cases.
+        calls = []
+        orig = eng._prefill_piece
+
+        def counting(variables, cache, toks, local, seed):
+            calls.append(int(toks.shape[1]))
+            return orig(variables, cache, toks, local, seed)
+
+        eng._prefill_piece = counting
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        for rid, (p, m) in zip(ids, reqs):
+            assert out[rid] == _ref(params, p, m), f"request {rid}"
+        assert calls == [8, 8, 8, 8]   # suffix-sized pieces only
+
+    def test_longest_prefix_wins_and_exact_prompt_is_excluded(self,
+                                                              params):
+        eng = ServingEngine(CFG, params, slots=1, cache_len=64, chunk=4,
+                            prompt_buckets=(8, 16))
+        eng.preload_prefix([7, 7])
+        eng.preload_prefix([7, 7, 7, 7])
+        assert eng._match_prefix([7, 7, 7, 7, 9])[0] == 4
+        assert eng._match_prefix([7, 7, 9])[0] == 2
+        # A prompt EQUAL to a stored prefix still needs one real token
+        # prefilled to produce its first logits — the shorter store wins.
+        assert eng._match_prefix([7, 7, 7, 7])[0] == 2
+        assert eng._match_prefix([8, 7])[0] == 0
+        rid = eng.submit([7, 7, 7, 7, 9], 5)
+        assert eng.run()[rid] == _ref(params, [7, 7, 7, 7, 9], 5)
+
+    def test_prefix_guards(self, params):
+        from tensorflow_train_distributed_tpu.models import moe
+
+        eng = ServingEngine(CFG, params, slots=1, cache_len=16,
+                            prompt_buckets=(8,))
+        with pytest.raises(ValueError, match="empty"):
+            eng.preload_prefix([])
+        with pytest.raises(ValueError, match="cache room"):
+            eng.preload_prefix([1] * 16)
+        mcfg = moe.MOE_PRESETS["moe_tiny"]
+        mparams = moe.MoeLmModel(mcfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+        meng = ServingEngine(mcfg, mparams, slots=1, cache_len=16)
+        with pytest.raises(ValueError, match="dispatch='gmm'"):
+            meng.preload_prefix([1, 2])
+        seng = ServingEngine(CFG, params, slots=1, cache_len=32,
+                             prompt_buckets=(8,), draft_config=CFG,
+                             draft_params=params, speculative_k=2)
+        with pytest.raises(ValueError, match="speculative"):
+            seng.preload_prefix([1, 2])
+
+
+def test_moe_gmm_prefix_caching_matches_generate():
+    """Prefix caching composes with dropless MoE (per-token routing —
+    the reason gmm escapes the exact-length rule covers this too)."""
+    from tensorflow_train_distributed_tpu.models import moe
+
+    cfg = dataclasses.replace(moe.MOE_PRESETS["moe_tiny"],
+                              dispatch="gmm")
+    rng = np.random.default_rng(10)
+    params = moe.MoeLmModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    system = list(rng.integers(1, cfg.vocab_size, 5))
+    reqs = [(system + list(rng.integers(1, cfg.vocab_size, d)), m)
+            for d, m in [(2, 4), (3, 3)]]
+    eng = ServingEngine(cfg, params, slots=2, cache_len=32, chunk=3,
+                        prompt_buckets=(8,))
+    eng.preload_prefix(system)
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    for rid, (p, m) in zip(ids, reqs):
+        ref = np.asarray(generate(
+            cfg, params, jnp.asarray([p], jnp.int32), m))[0].tolist()
+        assert out[rid] == ref, f"gmm prefix request {rid}"
+
+
+def test_prefix_allows_prompts_beyond_largest_bucket(params):
+    """A long shared system prompt + short tail is the feature's
+    primary use: submit() must size its bucket check on the SUFFIX
+    after the longest preloaded prefix, not the full prompt."""
+    rng = np.random.default_rng(11)
+    system = list(rng.integers(1, 200, 12))
+    tail = list(rng.integers(1, 200, 5))
+    eng = ServingEngine(CFG, params, slots=1, cache_len=64, chunk=4,
+                        prompt_buckets=(8, 16))
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit(system + tail, 4)       # 17 > 16, no prefix yet
+    eng.preload_prefix(system)
+    rid = eng.submit(system + tail, 4)     # suffix 5 fits the 8-bucket
+    assert eng.run()[rid] == _ref(params, system + tail, 4)

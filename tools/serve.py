@@ -50,6 +50,11 @@ def main(argv=None) -> int:
     p.add_argument("--max-new", type=int, default=32,
                    help="budget for --prompt requests (JSONL carries "
                         "its own)")
+    p.add_argument("--prefix", default="",
+                   metavar="IDS", help="comma-separated token ids of a "
+                   "shared prompt prefix (system prompt): prefilled "
+                   "ONCE, reused by every request whose prompt extends "
+                   "it (engine.preload_prefix)")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--chunk", type=int, default=8)
     p.add_argument("--cache-len", type=int, default=0,
@@ -126,6 +131,12 @@ def main(argv=None) -> int:
     if not reqs:
         raise SystemExit("no requests (--prompt or --requests)")
     check_vocab_ids([r["prompt"] for r in reqs], cfg.vocab_size)
+    # The prefix becomes real context for every matching request — an
+    # out-of-vocab id here would silently clamp in the embedding gather
+    # and corrupt every continuation; same screens as --prompt.
+    prefix_ids = parse_prompt_spec(args.prefix) if args.prefix else []
+    if prefix_ids:
+        check_vocab_ids([prefix_ids], cfg.vocab_size)
 
     # Probe --output writability BEFORE serving (an unwritable path
     # must fail in milliseconds, not after minutes of decode) — append
@@ -182,6 +193,8 @@ def main(argv=None) -> int:
             draft_quant_scales=draft_quant_scales,
             speculative_k=(args.speculative_k
                            if draft_cfg is not None else 0))
+        if prefix_ids:
+            eng.preload_prefix(prefix_ids)
         ids = [eng.submit(r["prompt"], r["max_new"],
                           seed=r.get("seed")) for r in reqs]
     except ValueError as e:
