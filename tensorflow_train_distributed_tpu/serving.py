@@ -550,6 +550,46 @@ class ServingEngine:
             self._cache_shapes[key] = shapes
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
+    def _pieces_for(self, m: int):
+        """(piece_len, n_pieces) for prefilling an m-token span — THE
+        piece-sizing rule for request suffixes and preloaded prefixes
+        alike.  Bucket mode runs spans longer than the largest bucket
+        as largest-bucket-sized pieces (appends at the running index,
+        the same mechanics as chunked prefill), so long shared system
+        prompts preload without a dedicated chunk setting."""
+        if self._exact_prefill:
+            return m, 1
+        if self.prefill_chunk is not None:
+            return self.prefill_chunk, -(-m // self.prefill_chunk)
+        piece = _bucket_len(min(m, self.prompt_buckets[-1]),
+                            self.prompt_buckets)
+        return piece, -(-m // piece)
+
+    def _prefill_tokens(self, work, *, seed: int, cache_1, draft: bool):
+        """Append ``work`` to a batch-1 cache in compile-bounded pieces
+        (shared by request prefill and prefix preload, target and
+        draft).  Returns (cache, first_token) — ``first`` is the pick
+        at the last REAL row (None for the draft, which only needs its
+        KV rows)."""
+        m = len(work)
+        piece, n_pieces = self._pieces_for(m)
+        padded = np.zeros((1, piece * n_pieces), np.int32)
+        padded[0, :m] = work
+        first = None
+        for i in range(n_pieces):
+            toks = jnp.asarray(padded[:, i * piece:(i + 1) * piece])
+            if draft:
+                cache_1 = self._draft_prefill_piece(
+                    self._draft_variables, cache_1, toks)
+            else:
+                # local_idx only matters on the piece holding the last
+                # real token (the final one).
+                local = min(m - 1 - i * piece, piece - 1)
+                cache_1, first = self._prefill_piece(
+                    self._variables, cache_1, toks,
+                    jnp.int32(max(local, 0)), jnp.uint32(seed))
+        return cache_1, first
+
     def preload_prefix(self, tokens) -> None:
         """Prefill a shared prompt prefix ONCE; every later request
         whose prompt strictly extends it prefills only the suffix.
@@ -559,10 +599,11 @@ class ServingEngine:
         (donation-safe) and the suffix pieces append at the prefix's
         true position — causal masks and RoPE read positions from the
         per-slot index, so outputs are token-identical to a full
-        prefill (pinned in tests/test_serving.py).  Restrictions:
+        prefill (pinned in tests/test_serving.py).  Under speculative
+        serving the DRAFT model's prefix cache is stored alongside the
+        target's (both prefill once, both reuse).  Restriction:
         dense-dispatch MoE prefills at the exact full length (routing
-        capacity is length-dependent), and speculative serving drafts
-        the whole prompt — both serve without prefix reuse.
+        capacity is length-dependent) and serves without prefix reuse.
         """
         tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
         if not tokens:
@@ -572,53 +613,45 @@ class ServingEngine:
                 "prefix caching needs length-independent routing; "
                 "dense-dispatch MoE prefills at the exact prompt length "
                 "(dispatch='gmm' supports prefix caching)")
-        if self._draft_model is not None:
-            raise ValueError(
-                "prefix caching does not compose with speculative "
-                "serving yet (the draft model prefills the whole "
-                "prompt); serve without a draft to use prefixes")
         n = len(tokens)
         if n >= self.cache_len:
             raise ValueError(
                 f"prefix length {n} must leave cache room "
                 f"(cache_len={self.cache_len})")
-        if self.prefill_chunk is not None:
-            piece = self.prefill_chunk
-            n_pieces = -(-n // piece)
-        else:
-            piece = _bucket_len(n, self.prompt_buckets)
-            n_pieces = 1
-        padded = np.zeros((1, piece * n_pieces), np.int32)
-        padded[0, :n] = tokens
-        with self._ctx():
-            cache_1 = self._fresh_cache(1)
-            for i in range(n_pieces):
-                cache_1, _ = self._prefill_piece(
-                    self._variables, cache_1,
-                    jnp.asarray(padded[:, i * piece:(i + 1) * piece]),
-                    jnp.int32(0), jnp.uint32(0))
-            # Pin the stored index to the TRUE prefix length: suffix
-            # pieces must append at position n, not after the pad rows
-            # (which stay harmless — overwritten before any read).
-            def pin(path, leaf):
-                if any(getattr(k, "key", "") == "index" for k in path):
-                    return jnp.full_like(leaf, n)
-                return leaf
+        # Pin the stored index to the TRUE prefix length: suffix
+        # pieces must append at position n, not after the pad rows
+        # (which stay harmless — overwritten before any read).
+        def pin(path, leaf):
+            if any(getattr(k, "key", "") == "index" for k in path):
+                return jnp.full_like(leaf, n)
+            return leaf
 
+        with self._ctx():
+            cache_1, _ = self._prefill_tokens(
+                tokens, seed=0, cache_1=self._fresh_cache(1),
+                draft=False)
             cache_1 = jax.tree_util.tree_map_with_path(pin, cache_1)
-        self._prefix_caches[tuple(tokens)] = cache_1
+            d_cache_1 = None
+            if self._draft_model is not None:
+                d_cache_1, _ = self._prefill_tokens(
+                    tokens, seed=0,
+                    cache_1=self._fresh_cache(1, draft=True), draft=True)
+                d_cache_1 = jax.tree_util.tree_map_with_path(
+                    pin, d_cache_1)
+        self._prefix_caches[tuple(tokens)] = (cache_1, d_cache_1)
 
     def _match_prefix(self, prompt):
         """Longest stored prefix the prompt strictly extends →
-        (prefix_len, stored_cache); (0, None) when none applies."""
-        if not self._prefix_caches or self._draft_model is not None:
+        (prefix_len, (target_cache, draft_cache_or_None));
+        (0, None) when none applies."""
+        if not self._prefix_caches:
             return 0, None
-        best, best_cache = 0, None
-        for toks, cache in self._prefix_caches.items():
+        best, best_pair = 0, None
+        for toks, pair in self._prefix_caches.items():
             m = len(toks)
             if best < m < len(prompt) and prompt[:m] == list(toks):
-                best, best_cache = m, cache
-        return best, best_cache
+                best, best_pair = m, pair
+        return best, best_pair
 
     def _fill_free_slots(self):
         for slot in range(self.slots):
@@ -633,47 +666,30 @@ class ServingEngine:
                     continue
                 n = len(prompt)
                 # Prefix reuse: prefill only the suffix on a copy of
-                # the stored cache (piece sizing follows the suffix).
-                pre_len, pre_cache = self._match_prefix(prompt)
+                # the stored cache(s) (piece sizing follows the suffix).
+                pre_len, pre_pair = self._match_prefix(prompt)
                 work = prompt[pre_len:]
-                m = len(work)
-                if self.prefill_chunk is not None:
-                    piece = self.prefill_chunk
-                    n_pieces = -(-m // piece)
-                elif self._exact_prefill:
-                    piece, n_pieces = n, 1
-                    if n not in self._moe_prefill_lens:
-                        self._moe_prefill_lens.add(n)
-                        if len(self._moe_prefill_lens) > 1:
-                            # Compile-storm hazard: MoE prefills at the
-                            # EXACT length (router capacity depends on
-                            # it), so every distinct prompt length is a
-                            # new XLA program.  Warn once per length;
-                            # mitigation: pad/truncate prompts to a few
-                            # lengths host-side (MIGRATION.md §8).
-                            logger.warning(
-                                "MoE engine prefill compiling for new "
-                                "prompt length %d (%d distinct lengths "
-                                "so far — one program each; consider "
-                                "padding prompts to a few fixed lengths)",
-                                n, len(self._moe_prefill_lens))
-                else:
-                    piece = _bucket_len(m, self.prompt_buckets)
-                    n_pieces = 1
-                padded = np.zeros((1, piece * n_pieces), np.int32)
-                padded[0, :m] = work
+                if (self._exact_prefill
+                        and n not in self._moe_prefill_lens):
+                    self._moe_prefill_lens.add(n)
+                    if len(self._moe_prefill_lens) > 1:
+                        # Compile-storm hazard: MoE prefills at the
+                        # EXACT length (router capacity depends on it),
+                        # so every distinct prompt length is a new XLA
+                        # program.  Warn once per length; mitigation:
+                        # pad/truncate prompts to a few lengths
+                        # host-side (MIGRATION.md §8).
+                        logger.warning(
+                            "MoE engine prefill compiling for new "
+                            "prompt length %d (%d distinct lengths "
+                            "so far — one program each; consider "
+                            "padding prompts to a few fixed lengths)",
+                            n, len(self._moe_prefill_lens))
                 with self._ctx():
-                    cache_1 = (self._fresh_cache(1) if pre_cache is None
-                               else jax.tree.map(jnp.copy, pre_cache))
-                    for i in range(n_pieces):
-                        # local_idx only matters on the piece holding
-                        # the last real token (the final one).
-                        local = min(m - 1 - i * piece, piece - 1)
-                        cache_1, first = self._prefill_piece(
-                            self._variables, cache_1,
-                            jnp.asarray(padded[:, i * piece:
-                                               (i + 1) * piece]),
-                            jnp.int32(max(local, 0)), jnp.uint32(seed))
+                    cache_1 = (self._fresh_cache(1) if pre_pair is None
+                               else jax.tree.map(jnp.copy, pre_pair[0]))
+                    cache_1, first = self._prefill_tokens(
+                        work, seed=seed, cache_1=cache_1, draft=False)
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
@@ -686,12 +702,13 @@ class ServingEngine:
                     continue  # slot still free: try the next request
                 with self._ctx():
                     if self._draft_model is not None:
-                        d_cache_1 = self._fresh_cache(1, draft=True)
-                        for i in range(n_pieces):
-                            d_cache_1 = self._draft_prefill_piece(
-                                self._draft_variables, d_cache_1,
-                                jnp.asarray(padded[:, i * piece:
-                                                   (i + 1) * piece]))
+                        d_cache_1 = (
+                            self._fresh_cache(1, draft=True)
+                            if pre_pair is None
+                            else jax.tree.map(jnp.copy, pre_pair[1]))
+                        d_cache_1, _ = self._prefill_tokens(
+                            work, seed=seed, cache_1=d_cache_1,
+                            draft=True)
                     if self._cache is None:
                         self._cache = self._fresh_cache(self.slots)
                     self._cache = self._insert(

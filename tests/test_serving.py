@@ -760,11 +760,29 @@ class TestPrefixCaching:
         meng = ServingEngine(mcfg, mparams, slots=1, cache_len=16)
         with pytest.raises(ValueError, match="dispatch='gmm'"):
             meng.preload_prefix([1, 2])
-        seng = ServingEngine(CFG, params, slots=1, cache_len=32,
-                             prompt_buckets=(8,), draft_config=CFG,
-                             draft_params=params, speculative_k=2)
-        with pytest.raises(ValueError, match="speculative"):
-            seng.preload_prefix([1, 2])
+
+    def test_prefix_composes_with_speculative(self, params):
+        """Speculative + prefix caching: the DRAFT model's prefix cache
+        is stored alongside the target's, and greedy outputs stay
+        token-identical to plain generate() — the full composition
+        (continuous batching × speculation × prefix reuse)."""
+        dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+        dparams = LlamaModel(dcfg).init(
+            jax.random.PRNGKey(99), jnp.zeros((1, 4), jnp.int32))["params"]
+        rng = np.random.default_rng(12)
+        system = list(rng.integers(1, 200, 6))
+        reqs = [(system + list(rng.integers(1, 200, d)), m)
+                for d, m in [(3, 6), (2, 5)]]
+        eng = ServingEngine(CFG, params, slots=2, cache_len=48, chunk=3,
+                            prompt_buckets=(8,), draft_config=dcfg,
+                            draft_params=dparams, speculative_k=3)
+        eng.preload_prefix(system)
+        assert eng._match_prefix(reqs[0][0])[0] == len(system)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        for rid, (p, m) in zip(ids, reqs):
+            assert out[rid] == _ref(params, p, m), f"request {rid}"
+        assert eng.spec_stats["rounds"] >= 1
 
 
 def test_moe_gmm_prefix_caching_matches_generate():
@@ -804,4 +822,18 @@ def test_prefix_allows_prompts_beyond_largest_bucket(params):
         eng.submit(system + tail, 4)       # 17 > 16, no prefix yet
     eng.preload_prefix(system)
     rid = eng.submit(system + tail, 4)     # suffix 5 fits the 8-bucket
+    assert eng.run()[rid] == _ref(params, system + tail, 4)
+
+
+def test_long_prefix_preloads_in_bucket_mode(params):
+    """A prefix LONGER than the largest bucket preloads as
+    largest-bucket-sized pieces (the shared _pieces_for rule) — the
+    long-system-prompt case needs no prefill_chunk setting."""
+    rng = np.random.default_rng(13)
+    system = list(rng.integers(1, 200, 21))     # > largest bucket (16)
+    tail = list(rng.integers(1, 200, 3))
+    eng = ServingEngine(CFG, params, slots=1, cache_len=64, chunk=4,
+                        prompt_buckets=(8, 16))
+    eng.preload_prefix(system)
+    rid = eng.submit(system + tail, 4)
     assert eng.run()[rid] == _ref(params, system + tail, 4)

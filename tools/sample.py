@@ -59,12 +59,13 @@ def resolve_decoder_task(config_name: str, verb: str):
     return task, task.config, isinstance(task, MoeLmTask)
 
 
-def parse_prompt_spec(spec: str):
-    """One --prompt value -> list of token ids (shared with serve.py)."""
+def parse_prompt_spec(spec: str, flag: str = "--prompt"):
+    """One token-id list flag value -> list of ints (shared with
+    serve.py, which also parses --prefix through it)."""
     try:
         return [int(t) for t in spec.split(",") if t]
     except ValueError:
-        raise SystemExit(f"--prompt must be comma-separated ints, got "
+        raise SystemExit(f"{flag} must be comma-separated ints, got "
                          f"{spec!r}")
 
 

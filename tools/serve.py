@@ -134,7 +134,8 @@ def main(argv=None) -> int:
     # The prefix becomes real context for every matching request — an
     # out-of-vocab id here would silently clamp in the embedding gather
     # and corrupt every continuation; same screens as --prompt.
-    prefix_ids = parse_prompt_spec(args.prefix) if args.prefix else []
+    prefix_ids = (parse_prompt_spec(args.prefix, flag="--prefix")
+                  if args.prefix else [])
     if prefix_ids:
         check_vocab_ids([prefix_ids], cfg.vocab_size)
 
