@@ -773,6 +773,15 @@ class ServingEngine:
         return (len(self._queue)
                 + sum(s is not None for s in self._slot_states))
 
+    def snapshot(self) -> dict:
+        """Tokens generated SO FAR for every in-flight request,
+        ``{request_id: [prompt + generated]}`` — the streaming view
+        between ``serve_step()`` calls (tokens arrive chunk-wise; a
+        finished request leaves the snapshot and is returned by the
+        step that completed it).  Copies, so callers may mutate."""
+        return {s.request_id: list(s.tokens)
+                for s in self._slot_states if s is not None}
+
     def serve_step(self) -> dict:
         """ONE service iteration: refill free slots from the queue, run
         one decode chunk, harvest — then hand control back, so callers

@@ -837,3 +837,27 @@ def test_long_prefix_preloads_in_bucket_mode(params):
     eng.preload_prefix(system)
     rid = eng.submit(system + tail, 4)
     assert eng.run()[rid] == _ref(params, system + tail, 4)
+
+
+def test_snapshot_streams_inflight_tokens(params):
+    """snapshot(): between serve_step calls the in-flight view grows
+    monotonically as a prefix of the final output (streaming UIs poll
+    this); finished requests leave the snapshot."""
+    prompt = [3, 1, 4, 1, 5]
+    eng = ServingEngine(CFG, params, slots=1, cache_len=32, chunk=2,
+                        prompt_buckets=(8,))
+    rid = eng.submit(prompt, 8)
+    assert eng.snapshot() == {}            # nothing in flight yet
+    seen = []
+    final = {}
+    while eng.pending():
+        final.update(eng.serve_step())
+        snap = eng.snapshot()
+        if rid in snap:
+            seen.append(snap[rid])
+    full = final[rid]
+    assert full == _ref(params, prompt, 8)
+    for partial in seen:                   # each snapshot is a prefix
+        assert partial == full[:len(partial)]
+    assert rid not in eng.snapshot()       # finished → left the view
+    assert len(seen) >= 2                  # chunk=2 over 8 tokens: grew
