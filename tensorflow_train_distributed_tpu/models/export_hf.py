@@ -160,6 +160,11 @@ def export_mixtral_state_dict(params, config) -> dict:
         raise ValueError(
             "HF Mixtral has MoE on EVERY layer; this config's "
             f"moe_every={config.moe_every} is not representable")
+    if getattr(config, "shared_expert_size", None):
+        raise ValueError(
+            "HF Mixtral has no shared expert; exporting would silently "
+            f"drop the shared_mlp weights (shared_expert_size="
+            f"{config.shared_expert_size}) — not representable")
     params = nn.unbox(params)
     sd = {
         "model.embed_tokens.weight": _t(params["token_embed"]["embedding"]),

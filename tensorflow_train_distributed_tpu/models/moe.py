@@ -75,6 +75,14 @@ class MoeConfig:
     # group_offset gmm + one psum); unsharded it is the single-chip
     # throughput path.
     dispatch: str = "dense"
+    # DeepSeek/Qwen-MoE-style shared expert: a dense SwiGLU FFN of this
+    # hidden size runs on EVERY token beside the routed experts, outputs
+    # summed.  Routing pressure drops (common knowledge lives in the
+    # shared path; routed experts specialize) at a fixed dense-FLOP
+    # cost.  Orthogonal to dispatch ("dense"/"gmm"), decode, serving and
+    # EP sharding — the branch is an ordinary tensor-shardable MLP.
+    # None = plain Mixtral-style (no shared expert).
+    shared_expert_size: Optional[int] = None
 
 
 MOE_PRESETS = {
@@ -91,6 +99,14 @@ MOE_PRESETS = {
                           num_heads=4, num_kv_heads=2, ffn_size=128,
                           num_experts=4, top_k=2, max_positions=128,
                           dtype=jnp.float32, remat=False),
+    # DeepSeek/Qwen-MoE-style: always-on shared expert beside the
+    # routed ones (tiny test shape).
+    "moe_tiny_shared": MoeConfig(vocab_size=256, d_model=64,
+                                 num_layers=2, num_heads=4,
+                                 num_kv_heads=2, ffn_size=128,
+                                 num_experts=4, top_k=2,
+                                 max_positions=128, dtype=jnp.float32,
+                                 remat=False, shared_expert_size=96),
 }
 
 
@@ -340,7 +356,7 @@ class MoEMlpBlock(nn.Module):
                          name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)          # [G, S, E]
         if cfg.dispatch == "gmm":
-            return self._gmm_moe(x, logits, probs)
+            return self._add_shared(x, self._gmm_moe(x, logits, probs))
         if cfg.dispatch != "dense":
             raise ValueError(
                 f"unknown MoeConfig.dispatch {cfg.dispatch!r} "
@@ -391,7 +407,23 @@ class MoEMlpBlock(nn.Module):
             expert_out, ("expert", "batch", None, "embed"))
         y = jnp.einsum("gsec,egcd->gsd", combine.astype(cfg.dtype),
                        expert_out)
-        return nn.with_logical_constraint(y, ("batch", "length", "embed"))
+        y = nn.with_logical_constraint(y, ("batch", "length", "embed"))
+        return self._add_shared(x, y)
+
+    def _add_shared(self, x, routed):
+        """Shared-expert branch (``shared_expert_size``): an always-on
+        SwiGLU over every token, summed with the routed output.  A
+        plain ``layers.MlpBlock``, so it tensor-shards/quantizes/decodes
+        like any dense FFN; identity when the config leaves it None."""
+        cfg = self.config
+        if not cfg.shared_expert_size:
+            return routed
+        shared = L.MlpBlock(hidden=cfg.shared_expert_size,
+                            dtype=cfg.dtype, gated=True,
+                            activation=nn.silu,  # SwiGLU, like every
+                            name="shared_mlp")(x)   # gated FFN here
+        return nn.with_logical_constraint(
+            routed + shared, ("batch", "length", "embed"))
 
     def _gmm_moe(self, x, logits, probs):
         """Dropless dispatch (MegaBlocks, arXiv:2211.15841): sort token

@@ -193,6 +193,15 @@ def _setup():
              dataset="lm",
              dataset_kwargs=dict(vocab_size=256, seq_len=32),
              strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
+    # DeepSeek/Qwen-MoE-style shared expert beside the routed ones
+    # (MoeConfig.shared_expert_size) — trains/serves through every MoE
+    # path; the shared branch is an ordinary dense FFN.
+    register("moe_tiny_shared_lm",
+             task_factory=lambda: moe.make_task(
+                 moe.MOE_PRESETS["moe_tiny_shared"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
     # Dropless (megablox grouped-matmul) dispatch variant: same params/
     # data/seed as moe_tiny_lm, only the expert data movement differs —
     # the convergence-certification pair for MoeConfig.dispatch="gmm"
