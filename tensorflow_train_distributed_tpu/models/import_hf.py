@@ -403,6 +403,15 @@ def import_mixtral_state_dict(state_dict, config) -> dict:
     """HF ``MixtralForCausalLM`` state dict → native ``MoeLmModel``
     params (per-layer ``layer_{i}`` modules — the MoE stack is a Python
     loop, not a depth scan)."""
+    if getattr(config, "shared_expert_size", None):
+        # Symmetric with export_hf's guard: Mixtral checkpoints carry no
+        # shared expert, so the mapped tree would be missing shared_mlp
+        # and the first apply() would die with an opaque flax scope
+        # error instead of this boundary message.
+        raise ValueError(
+            "HF Mixtral has no shared expert; import with "
+            "shared_expert_size=None (the checkpoint cannot populate "
+            f"shared_mlp, config asks for {config.shared_expert_size})")
     sd = state_dict
     embed = _np(sd["model.embed_tokens.weight"])
     if embed.shape != (config.vocab_size, config.d_model):

@@ -97,6 +97,22 @@ class TestMiniConvergence:
             f"gmm trajectory diverged: dense {d_last:.4f} vs gmm "
             f"{g_last:.4f} (drop {drop:.4f})")
 
+    def test_shared_expert_converges(self):
+        """CI pin for the moe_tiny_shared_lm convergence artifact: the
+        always-on shared SwiGLU must train at least as well as it did
+        at capture time (a gradient-scale bug in the summed branch
+        would stall the curve while every parity test still passed).
+        300-step committed artifact: final-quarter 3.54 vs plain
+        dense's 3.70 — shared matches-or-beats the plain router."""
+        argv_tail = [
+            "--steps", "80", "--global-batch-size", "16",
+            "--log-every", "1", "--dataset-kwarg", "num_examples=256"]
+        shared = _losses(["--config", "moe_tiny_shared_lm"] + argv_tail)
+        s_first, s_last = _quarter_means(shared)
+        assert s_last < 0.9 * s_first, (
+            f"shared-expert MoE failed to converge: first-quarter "
+            f"{s_first:.4f} -> last-quarter {s_last:.4f}")
+
 
 class TestDatasetKwargOverride:
     def test_values_parse_as_json(self):

@@ -39,6 +39,9 @@ MATRIX = [
     ("transformer_tiny_wmt", ["dp", "dp_tp"]),
     ("llama_tiny_sft", ["dp", "dp_tp", "fsdp", "dtensor"]),
     ("moe_tiny_lm", ["dp", "dp_ep"]),
+    # Shared-expert variant: the always-on SwiGLU branch must ride the
+    # same strategies (it is an ordinary tensor-shardable dense FFN).
+    ("moe_tiny_shared_lm", ["dp", "dp_ep"]),
 ]
 
 

@@ -41,8 +41,10 @@ for cfg in bert_tiny_mlm llama_tiny_sft; do
       --jsonl-log $OUT/${cfg}_1k.jsonl >/dev/null 2>&1
   echo "done: ${cfg}_1k"
 done
-# gmm certification pair: dense vs dropless expert dispatch, same data/LR.
-for cfg in moe_tiny_lm moe_tiny_lm_gmm; do
+# gmm certification pair: dense vs dropless expert dispatch, same data/LR
+# — plus the shared-expert variant (same data/LR; the always-on SwiGLU
+# should match-or-beat the plain router curve).
+for cfg in moe_tiny_lm moe_tiny_lm_gmm moe_tiny_shared_lm; do
   rm -f $OUT/${cfg}.jsonl
   timeout 2500 python -m tensorflow_train_distributed_tpu \
       --config $cfg --steps 300 --global-batch-size 16 --platform cpu \
