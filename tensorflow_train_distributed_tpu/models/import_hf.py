@@ -315,6 +315,30 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
     return config, params
 
 
+
+def _probe_count(sd, key_fmt: str, expected: int, what: str) -> None:
+    """Two-sided presence check for indexed checkpoint entries: index
+    ``expected`` must be absent and ``expected - 1`` present, else count
+    the real number and fail at the boundary (not with a KeyError
+    mid-mapping / silent truncation).  Shared by every family importer."""
+    def _has(i):
+        return key_fmt.format(i) in sd
+
+    if _has(expected) or not _has(expected - 1):
+        n = 0
+        while _has(n):
+            n += 1
+        raise ValueError(
+            f"checkpoint has {n} {what}, config expects {expected}")
+
+
+def _lm_head_or_tied(sd, embed: np.ndarray) -> np.ndarray:
+    """``lm_head.weight`` transposed, or the tied-embedding fallback."""
+    if "lm_head.weight" in sd:
+        return _np(sd["lm_head.weight"]).T
+    return embed.T.copy()
+
+
 def _validate_hf_mixtral(hf_config) -> None:
     """Exact-or-rejected guards — run on EVERY import path, including
     the CLI's config=task_cfg route (which skips config derivation)."""
@@ -418,38 +442,11 @@ def import_mixtral_state_dict(state_dict, config) -> dict:
         raise ValueError(
             f"checkpoint embed is {embed.shape}, config expects "
             f"{(config.vocab_size, config.d_model)}")
-    # Two-sided layer-count check (the llama importer's lesson): a
-    # deeper checkpoint must not silently truncate, a shallower one must
-    # fail HERE, not with an opaque KeyError mid-mapping.
-    def _has_layer(i):
-        return f"model.layers.{i}.input_layernorm.weight" in sd
-
-    if _has_layer(config.num_layers) or not _has_layer(
-            config.num_layers - 1):
-        n = 0
-        while _has_layer(n):
-            n += 1
-        raise ValueError(
-            f"checkpoint has {n} decoder layers, config expects "
-            f"{config.num_layers}")
-
-    def _has_expert(e):
-        return (f"model.layers.0.block_sparse_moe.experts.{e}.w1.weight"
-                in sd)
-
-    if _has_expert(config.num_experts) or not _has_expert(
-            config.num_experts - 1):
-        n = 0
-        while _has_expert(n):
-            n += 1
-        raise ValueError(
-            f"checkpoint has {n} experts per layer, config expects "
-            f"{config.num_experts} (a mismatch would truncate experts "
-            "or KeyError mid-mapping)")
-    if "lm_head.weight" in sd:
-        lm_head = _np(sd["lm_head.weight"]).T
-    else:
-        lm_head = embed.T.copy()
+    _probe_count(sd, "model.layers.{}.input_layernorm.weight",
+                 config.num_layers, "decoder layers")
+    _probe_count(sd, "model.layers.0.block_sparse_moe.experts.{}.w1.weight",
+                 config.num_experts, "experts per layer")
+    lm_head = _lm_head_or_tied(sd, embed)
     params = {
         "token_embed": {"embedding": embed},
         "final_norm": {"scale": _np(sd["model.norm.weight"])},
@@ -483,4 +480,182 @@ def import_mixtral(model_or_path, config=None, **config_overrides):
     if config_overrides:
         config = dataclasses.replace(config, **config_overrides)
     params = import_mixtral_state_dict(model_or_path.state_dict(), config)
+    return config, params
+
+
+# ── Qwen2-MoE (shared expert + gate, qkv biases, raw top-k gates) ──────
+
+
+def _validate_hf_qwen2_moe(hf_config) -> None:
+    """Exact-or-rejected guards for ``Qwen2MoeForCausalLM`` imports."""
+    if getattr(hf_config, "model_type", "") != "qwen2_moe":
+        raise ValueError(
+            f"expected model_type='qwen2_moe', got "
+            f"{getattr(hf_config, 'model_type', None)!r}")
+    if getattr(hf_config, "decoder_sparse_step", 1) != 1:
+        raise ValueError(
+            "decoder_sparse_step != 1 (MoE on every layer) is not "
+            "representable (native moe_every covers alternation, but "
+            "Qwen's dense layers use intermediate_size, a THIRD ffn "
+            "width the native config does not carry)")
+    if getattr(hf_config, "mlp_only_layers", None):
+        raise ValueError("mlp_only_layers is not representable natively")
+    if (getattr(hf_config, "use_sliding_window", False)
+            and getattr(hf_config, "sliding_window", None)):
+        raise ValueError(
+            "checkpoint enables sliding_window; the native MoE "
+            "attention is full-causal — importing would silently "
+            "change logits")
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("rope_scaling is not implemented natively")
+    if getattr(hf_config, "hidden_act", "silu") != "silu":
+        raise ValueError(
+            f"hidden_act={hf_config.hidden_act!r}; the native experts "
+            "are SwiGLU (silu) only")
+
+
+def config_from_hf_qwen2_moe(hf_config) -> "MoeConfig":
+    """Native ``MoeConfig`` from a HF ``Qwen2MoeConfig``.
+
+    Architectural deltas vs Mixtral, all carried by config knobs:
+    shared expert (+ sigmoid scalar gate), q/k/v biases, and
+    ``norm_topk_prob`` (Qwen defaults to RAW softmax gates).
+    ``capacity_factor`` = E/k — the no-drop parity setting, as for
+    Mixtral.
+    """
+    from tensorflow_train_distributed_tpu.models.moe import MoeConfig
+
+    _validate_hf_qwen2_moe(hf_config)
+    e = hf_config.num_experts
+    k = hf_config.num_experts_per_tok
+    return MoeConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=hf_config.num_key_value_heads,
+        ffn_size=hf_config.moe_intermediate_size,
+        num_experts=e,
+        top_k=k,
+        capacity_factor=float(e) / float(k),
+        moe_every=1,
+        max_positions=hf_config.max_position_embeddings,
+        rope_base=hf_config.rope_theta,
+        rms_epsilon=hf_config.rms_norm_eps,
+        shared_expert_size=hf_config.shared_expert_intermediate_size,
+        shared_expert_gate=True,
+        norm_topk_prob=bool(getattr(hf_config, "norm_topk_prob", False)),
+        qkv_bias=True,
+    )
+
+
+def _qwen2_moe_layer_tree(sd, i: int, num_experts: int) -> dict:
+    """One Qwen2-MoE decoder layer → native MoeDecoderBlock tree."""
+    p = f"model.layers.{i}."
+    mlp = p + "mlp."
+
+    def expert(e, w):
+        return _np(sd[mlp + f"experts.{e}.{w}.weight"]).T
+
+    def biased(name):
+        return {"kernel": _np(sd[p + f"self_attn.{name}.weight"]).T,
+                "bias": _np(sd[p + f"self_attn.{name}.bias"])}
+
+    return {
+        "attn_norm": {"scale": _np(sd[p + "input_layernorm.weight"])},
+        "attention": {
+            "query": biased("q_proj"),
+            "key": biased("k_proj"),
+            "value": biased("v_proj"),
+            "out": {"kernel": _np(sd[p + "self_attn.o_proj.weight"]).T},
+        },
+        "mlp_norm": {"scale": _np(sd[p + "post_attention_layernorm.weight"])},
+        "moe": {
+            "router": {"kernel": _np(sd[mlp + "gate.weight"]).T},
+            "experts": {
+                "wi_gate": {"kernel": np.stack(
+                    [expert(e, "gate_proj") for e in range(num_experts)])},
+                "wi_up": {"kernel": np.stack(
+                    [expert(e, "up_proj") for e in range(num_experts)])},
+                "wo": {"kernel": np.stack(
+                    [expert(e, "down_proj") for e in range(num_experts)])},
+            },
+            "shared_mlp": {
+                "wi_gate": {"kernel": _np(
+                    sd[mlp + "shared_expert.gate_proj.weight"]).T},
+                "wi_up": {"kernel": _np(
+                    sd[mlp + "shared_expert.up_proj.weight"]).T},
+                "wo": {"kernel": _np(
+                    sd[mlp + "shared_expert.down_proj.weight"]).T},
+            },
+            "shared_gate": {"kernel": _np(
+                sd[mlp + "shared_expert_gate.weight"]).T},
+        },
+    }
+
+
+def import_qwen2_moe_state_dict(state_dict, config) -> dict:
+    """HF ``Qwen2MoeForCausalLM`` state dict → native ``MoeLmModel``
+    params."""
+    if not getattr(config, "shared_expert_size", None) or \
+            not getattr(config, "shared_expert_gate", False):
+        raise ValueError(
+            "Qwen2-MoE checkpoints carry a gated shared expert; import "
+            "with shared_expert_size set and shared_expert_gate=True "
+            "(config_from_hf_qwen2_moe derives both)")
+    if not getattr(config, "qkv_bias", False):
+        raise ValueError(
+            "Qwen2-MoE checkpoints carry q/k/v projection biases; "
+            "import with qkv_bias=True (the mapped tree would carry "
+            "bias entries a bias-free attention never creates)")
+    sd = state_dict
+    embed = _np(sd["model.embed_tokens.weight"])
+    if embed.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"checkpoint embed is {embed.shape}, config expects "
+            f"{(config.vocab_size, config.d_model)}")
+
+    _probe_count(sd, "model.layers.{}.input_layernorm.weight",
+                 config.num_layers, "decoder layers")
+    _probe_count(sd, "model.layers.0.mlp.experts.{}.gate_proj.weight",
+                 config.num_experts, "experts per layer")
+    params = {
+        "token_embed": {"embedding": embed},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+        "lm_head": {"kernel": _lm_head_or_tied(sd, embed)},
+    }
+    for i in range(config.num_layers):
+        params[f"layer_{i}"] = _qwen2_moe_layer_tree(
+            sd, i, config.num_experts)
+    return params
+
+
+def import_qwen2_moe(model_or_path, config=None, **config_overrides):
+    """(native MoeConfig, params) from an HF Qwen2-MoE model or path."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
+    _validate_hf_qwen2_moe(model_or_path.config)
+    if config is None:
+        config = config_from_hf_qwen2_moe(model_or_path.config)
+    else:
+        hf = model_or_path.config
+        if "capacity_factor" not in config_overrides:
+            # Parity holds only at the no-drop capacity E/k (the
+            # Mixtral importer's rule).
+            config = dataclasses.replace(
+                config, capacity_factor=(
+                    float(hf.num_experts) / hf.num_experts_per_tok))
+        if "norm_topk_prob" not in config_overrides:
+            # The gate convention is the CHECKPOINT's, not the
+            # preset's: a mismatch silently changes every forward
+            # (raw vs renormalized top-k gates).
+            config = dataclasses.replace(
+                config, norm_topk_prob=bool(
+                    getattr(hf, "norm_topk_prob", False)))
+    if config_overrides:
+        config = dataclasses.replace(config, **config_overrides)
+    params = import_qwen2_moe_state_dict(model_or_path.state_dict(),
+                                         config)
     return config, params

@@ -196,6 +196,9 @@ class MultiHeadAttention(nn.Module):
     slot_decode: bool = False
     # Projection biases (BERT-style encoders; Llama-family stays False).
     use_bias: bool = False
+    # q/k/v biases ONLY, out-proj unbiased (the Qwen-family convention;
+    # ``use_bias`` keeps the all-projection BERT meaning).
+    qkv_bias: bool = False
     # Fuse q/k/v into ONE gemm ("qkv" kernel, [embed, (H+2·KV)·D]).
     # MFU lever for small decoders where three launch-bound projections
     # under-fill the MXU; self-attention only, and the param tree
@@ -215,7 +218,8 @@ class MultiHeadAttention(nn.Module):
         # paths — the submodule name/init/partitioning contract between
         # them lives here and only here.
         y = nn.Dense(
-            heads * self.head_dim, use_bias=self.use_bias, dtype=self.dtype,
+            heads * self.head_dim,
+            use_bias=self.use_bias or self.qkv_bias, dtype=self.dtype,
             name=name,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "heads")),
@@ -234,7 +238,7 @@ class MultiHeadAttention(nn.Module):
                     self._proj(x, kv_heads, "value"))
         tot = self.num_heads + 2 * kv_heads
         y = nn.Dense(
-            tot * self.head_dim, use_bias=self.use_bias,
+            tot * self.head_dim, use_bias=self.use_bias or self.qkv_bias,
             dtype=self.dtype, name="qkv",
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "heads")),

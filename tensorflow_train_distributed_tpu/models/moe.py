@@ -83,6 +83,17 @@ class MoeConfig:
     # EP sharding — the branch is an ordinary tensor-shardable MLP.
     # None = plain Mixtral-style (no shared expert).
     shared_expert_size: Optional[int] = None
+    # Qwen-MoE-style scalar gate on the shared branch:
+    # sigmoid(x @ w_gate) per token multiplies the shared output
+    # (needs shared_expert_size).
+    shared_expert_gate: bool = False
+    # Renormalize the top-k gates over the chosen experts (GShard /
+    # Mixtral rule).  False = raw softmax probabilities as gates —
+    # the Qwen2-MoE default (norm_topk_prob=False).
+    norm_topk_prob: bool = True
+    # q/k/v projection biases (Qwen attention convention; out stays
+    # unbiased) — layers.MultiHeadAttention.qkv_bias.
+    qkv_bias: bool = False
 
 
 MOE_PRESETS = {
@@ -99,6 +110,16 @@ MOE_PRESETS = {
                           num_heads=4, num_kv_heads=2, ffn_size=128,
                           num_experts=4, top_k=2, max_positions=128,
                           dtype=jnp.float32, remat=False),
+    # Qwen1.5-MoE-A2.7B shape (14.3B total / 2.7B active): the gated-
+    # shared-expert flagship — fine-grained 60-expert top-4 routing,
+    # raw softmax gates, qkv biases; --init-from-hf a local checkpoint.
+    "qwen15_moe_a27b": MoeConfig(
+        vocab_size=151_936, d_model=2048, num_layers=24, num_heads=16,
+        num_kv_heads=16, ffn_size=1408, num_experts=60, top_k=4,
+        capacity_factor=15.0,  # E/k — the no-drop HF-parity setting
+        max_positions=8192, rope_base=1_000_000.0,
+        shared_expert_size=5632, shared_expert_gate=True,
+        norm_topk_prob=False, qkv_bias=True),
     # DeepSeek/Qwen-MoE-style: always-on shared expert beside the
     # routed ones (tiny test shape).
     "moe_tiny_shared": MoeConfig(vocab_size=256, d_model=64,
@@ -107,16 +128,32 @@ MOE_PRESETS = {
                                  num_experts=4, top_k=2,
                                  max_positions=128, dtype=jnp.float32,
                                  remat=False, shared_expert_size=96),
+    # Full Qwen-convention tiny shape (gated shared expert, qkv biases,
+    # raw top-k gates) — matches the test HF fixture for the CLI
+    # --init-from-hf path.
+    "qwen_moe_tiny": MoeConfig(vocab_size=256, d_model=64,
+                               num_layers=2, num_heads=4,
+                               num_kv_heads=2, ffn_size=96,
+                               num_experts=4, top_k=2,
+                               capacity_factor=2.0,
+                               max_positions=128, dtype=jnp.float32,
+                               remat=False, shared_expert_size=112,
+                               shared_expert_gate=True,
+                               norm_topk_prob=False, qkv_bias=True),
 }
 
 
-def _router_one_hot(probs: jax.Array, top_k: int, capacity: int):
+def _router_one_hot(probs: jax.Array, top_k: int, capacity: int,
+                    normalize: bool = True):
     """Top-k dispatch/combine tensors with per-expert capacity.
 
     ``probs`` [T, E] float32.  Returns ``dispatch`` [T, E, C] one-hot and
     ``combine`` [T, E, C] gate-weighted, plus the [T, E] routed mask for
     the load-balance loss.  Tokens beyond an expert's capacity are dropped
     (their combine weight is zero → they ride the residual path).
+    ``normalize=False`` keeps raw softmax probabilities as gates (the
+    Qwen2-MoE ``norm_topk_prob=False`` convention) instead of the GShard
+    renormalize-over-chosen rule.
     """
     tokens, num_experts = probs.shape
     remaining = probs
@@ -143,8 +180,9 @@ def _router_one_hot(probs: jax.Array, top_k: int, capacity: int):
         fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(
             jnp.int32)
         remaining = remaining * (1.0 - onehot)
-    # Normalize combine weights over the chosen experts (GShard top-2 rule).
-    combine = combine / jnp.maximum(gate_sum[:, :, None], 1e-9)
+    if normalize:
+        # Over the chosen experts (GShard top-2 rule).
+        combine = combine / jnp.maximum(gate_sum[:, :, None], 1e-9)
     return dispatch, combine, routed
 
 
@@ -365,7 +403,8 @@ class MoEMlpBlock(nn.Module):
             1, int(cfg.capacity_factor * cfg.top_k * group_size
                    / cfg.num_experts))
         dispatch, combine, routed = jax.vmap(
-            lambda p: _router_one_hot(p, cfg.top_k, capacity))(probs)
+            lambda p: _router_one_hot(p, cfg.top_k, capacity,
+                                      cfg.norm_topk_prob))(probs)
 
         # Aux losses (Switch §4 / ST-MoE): sown, folded in by the task.
         frac_routed = jnp.mean(routed, axis=(0, 1))      # [E] token fraction
@@ -422,6 +461,13 @@ class MoEMlpBlock(nn.Module):
                             dtype=cfg.dtype, gated=True,
                             activation=nn.silu,  # SwiGLU, like every
                             name="shared_mlp")(x)   # gated FFN here
+        if cfg.shared_expert_gate:
+            # Qwen-MoE: one sigmoid scalar per token scales the shared
+            # branch (f32 like the router — small and load-bearing).
+            g = jax.nn.sigmoid(L.dense(
+                1, ("embed", None), use_bias=False, dtype=jnp.float32,
+                name="shared_gate")(x.astype(jnp.float32)))
+            shared = shared * g.astype(shared.dtype)
         return nn.with_logical_constraint(
             routed + shared, ("batch", "length", "embed"))
 
@@ -446,8 +492,11 @@ class MoEMlpBlock(nn.Module):
         # (The dense path normalizes over *kept* gates — identical here
         # because nothing is ever dropped.)  Computed ONCE; under EP it
         # rides into the shard_map instead of re-running per shard.
-        gate_w = top_p / jnp.maximum(
-            jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
+        if cfg.norm_topk_prob:
+            gate_w = top_p / jnp.maximum(
+                jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
+        else:
+            gate_w = top_p    # raw softmax gates (Qwen2-MoE rule)
 
         # Aux losses — same definitions as the dense path, with
         # routed = all top-k assignments (dropless).
@@ -510,6 +559,7 @@ class MoeDecoderBlock(nn.Module):
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="attn_norm")(x)
         x = x + L.MultiHeadAttention(
+            qkv_bias=cfg.qkv_bias,
             num_heads=cfg.num_heads,
             head_dim=cfg.d_model // cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,

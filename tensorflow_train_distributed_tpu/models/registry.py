@@ -193,6 +193,20 @@ def _setup():
              dataset="lm",
              dataset_kwargs=dict(vocab_size=256, seq_len=32),
              strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
+    # Qwen1.5-MoE-A2.7B flagship (gated shared expert + 60-expert
+    # fine-grained routing): --init-from-hf a local checkpoint.
+    register("qwen15_moe_a27b",
+             task_factory=lambda: moe.make_task(
+                 moe.MOE_PRESETS["qwen15_moe_a27b"]),
+             dataset="lm", strategy="dp_ep", global_batch_size=64,
+             learning_rate=1e-4)
+    # Tiny full-Qwen-convention shape (the CLI import test fixture).
+    register("qwen_moe_tiny_lm",
+             task_factory=lambda: moe.make_task(
+                 moe.MOE_PRESETS["qwen_moe_tiny"]),
+             dataset="lm",
+             dataset_kwargs=dict(vocab_size=256, seq_len=32),
+             strategy="dp_ep", global_batch_size=16, learning_rate=1e-3)
     # DeepSeek/Qwen-MoE-style shared expert beside the routed ones
     # (MoeConfig.shared_expert_size) — trains/serves through every MoE
     # path; the shared branch is an ordinary dense FFN.

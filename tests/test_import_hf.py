@@ -597,3 +597,92 @@ class TestMixtralExport:
             np.testing.assert_allclose(
                 sd2[k].float().numpy(), sd1[k].float().numpy(),
                 rtol=1e-6, atol=1e-6, err_msg=k)
+
+
+class TestQwen2MoeImport:
+    """Qwen2-MoE → native MoeLmModel: gated shared expert, q/k/v
+    biases, RAW top-k gates (norm_topk_prob=False) — forward-parity vs
+    torch at the no-drop capacity E/k."""
+
+    def _hf(self, norm_topk_prob=False):
+        cfg = transformers.Qwen2MoeConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=96,
+            shared_expert_intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=128,
+            rms_norm_eps=1e-5, rope_theta=10_000.0,
+            decoder_sparse_step=1, mlp_only_layers=[],
+            norm_topk_prob=norm_topk_prob, tie_word_embeddings=False,
+        )
+        torch.manual_seed(7)
+        model = transformers.Qwen2MoeForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def test_config_derivation(self):
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            config_from_hf_qwen2_moe,
+        )
+
+        cfg = config_from_hf_qwen2_moe(self._hf().config)
+        assert cfg.num_experts == 4 and cfg.top_k == 2
+        assert cfg.capacity_factor == 2.0
+        assert cfg.ffn_size == 96                    # moe_intermediate
+        assert cfg.shared_expert_size == 112
+        assert cfg.shared_expert_gate and cfg.qkv_bias
+        assert cfg.norm_topk_prob is False           # the Qwen default
+
+    @pytest.mark.parametrize("norm", [False, True])
+    def test_forward_parity(self, norm):
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_qwen2_moe,
+        )
+        from tensorflow_train_distributed_tpu.models.moe import MoeLmModel
+
+        hf = self._hf(norm_topk_prob=norm)
+        cfg, params = import_qwen2_moe(hf, remat=False,
+                                       dtype=jnp.float32)
+        assert cfg.norm_topk_prob is norm
+        rng = np.random.default_rng(13)
+        tokens = rng.integers(0, 256, (2, 24)).astype(np.int32)
+        with torch.no_grad():
+            want = hf(torch.asarray(tokens)).logits.float().numpy()
+        got = np.asarray(MoeLmModel(cfg).apply(
+            {"params": params}, tokens).astype(np.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+    def test_rejections(self):
+        import copy
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            config_from_hf_qwen2_moe,
+        )
+
+        hf = self._hf().config
+        sparse = copy.deepcopy(hf)
+        sparse.decoder_sparse_step = 2
+        with pytest.raises(ValueError, match="decoder_sparse_step"):
+            config_from_hf_qwen2_moe(sparse)
+        dense_layers = copy.deepcopy(hf)
+        dense_layers.mlp_only_layers = [0]
+        with pytest.raises(ValueError, match="mlp_only_layers"):
+            config_from_hf_qwen2_moe(dense_layers)
+
+    def test_cli_init_from_hf_qwen2_moe(self, tmp_path):
+        """--init-from-hf auto-dispatches on the checkpoint's
+        model_type: a Qwen2-MoE checkpoint loads through
+        import_qwen2_moe and fine-tunes through the launcher."""
+        from tensorflow_train_distributed_tpu import launch
+
+        ckpt_dir = tmp_path / "hf_qwen_moe"
+        self._hf().save_pretrained(ckpt_dir)
+        result = launch.run(launch.build_parser().parse_args([
+            "--config", "qwen_moe_tiny_lm", "--strategy", "dp",
+            "--steps", "3", "--platform", "cpu",
+            "--init-from-hf", str(ckpt_dir),
+        ]))
+        assert np.isfinite(result.history["loss"][-1])
