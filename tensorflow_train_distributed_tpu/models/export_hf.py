@@ -165,6 +165,16 @@ def export_mixtral_state_dict(params, config) -> dict:
             "HF Mixtral has no shared expert; exporting would silently "
             f"drop the shared_mlp weights (shared_expert_size="
             f"{config.shared_expert_size}) — not representable")
+    if getattr(config, "qkv_bias", False):
+        raise ValueError(
+            "HF Mixtral attention is bias-free; exporting would "
+            "silently drop the q/k/v bias params — use the Qwen2-MoE "
+            "format (export_qwen2_moe) for the full Qwen convention")
+    if not getattr(config, "norm_topk_prob", True):
+        raise ValueError(
+            "HF Mixtral renormalizes top-k gates; this config's "
+            "norm_topk_prob=False (raw softmax gates) is not "
+            "representable — export_qwen2_moe carries the flag")
     params = nn.unbox(params)
     sd = {
         "model.embed_tokens.weight": _t(params["token_embed"]["embedding"]),
@@ -306,5 +316,110 @@ def export_hf_from_registry(config_name: str, checkpoint_dir,
         check_spec_matches(params, spec)
         params = merge_lora(params, spec)
     if is_moe:
+        if getattr(config, "shared_expert_size", None):
+            # Gated shared expert + qkv biases = the Qwen2-MoE format
+            # (Mixtral cannot represent the shared weights).
+            return export_qwen2_moe(config, params, out_dir)
         return export_mixtral(config, params, out_dir)
     return export_llama(config, params, out_dir)
+
+
+def hf_config_dict_qwen2_moe(config) -> dict:
+    """``config.json`` for a Qwen2-MoE (gated-shared-expert) export."""
+    return {
+        "model_type": "qwen2_moe",
+        "architectures": ["Qwen2MoeForCausalLM"],
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.d_model,
+        # HF's dense-layer width; unused at decoder_sparse_step=1 but
+        # required by the config class — mirror the routed width.
+        "intermediate_size": config.ffn_size,
+        "moe_intermediate_size": config.ffn_size,
+        "shared_expert_intermediate_size": config.shared_expert_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads or config.num_heads,
+        "num_experts": config.num_experts,
+        "num_experts_per_tok": config.top_k,
+        "norm_topk_prob": bool(config.norm_topk_prob),
+        "decoder_sparse_step": 1,
+        "mlp_only_layers": [],
+        "max_position_embeddings": config.max_positions,
+        "rms_norm_eps": config.rms_epsilon,
+        "rope_theta": config.rope_base,
+        "hidden_act": "silu",
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+        "use_sliding_window": False,
+    }
+
+
+def export_qwen2_moe_state_dict(params, config) -> dict:
+    """Native shared-expert ``MoeLmModel`` params → HF
+    ``Qwen2MoeForCausalLM`` state dict (inverse of
+    ``import_hf.import_qwen2_moe_state_dict``)."""
+    import flax.linen as nn
+
+    if config.moe_every != 1:
+        raise ValueError(
+            "HF Qwen2-MoE (as exported here) has MoE on every layer; "
+            f"moe_every={config.moe_every} is not representable")
+    if (not getattr(config, "shared_expert_size", None)
+            or not getattr(config, "shared_expert_gate", False)
+            or not getattr(config, "qkv_bias", False)):
+        raise ValueError(
+            "Qwen2-MoE format needs the full Qwen convention: "
+            "shared_expert_size set, shared_expert_gate=True and "
+            "qkv_bias=True (plain Mixtral-style configs export via "
+            "export_mixtral)")
+    params = nn.unbox(params)
+    sd = {
+        "model.embed_tokens.weight": _t(params["token_embed"]["embedding"]),
+        "model.norm.weight": _t(params["final_norm"]["scale"]),
+        "lm_head.weight": _t(np.asarray(params["lm_head"]["kernel"]).T),
+    }
+    for i in range(config.num_layers):
+        lt = params[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _t(lt["attn_norm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _t(
+            lt["mlp_norm"]["scale"])
+        attn = lt["attention"]
+        for hf, ours in (("q_proj", "query"), ("k_proj", "key"),
+                         ("v_proj", "value")):
+            sd[p + f"self_attn.{hf}.weight"] = _t(
+                np.asarray(attn[ours]["kernel"]).T)
+            sd[p + f"self_attn.{hf}.bias"] = _t(attn[ours]["bias"])
+        sd[p + "self_attn.o_proj.weight"] = _t(
+            np.asarray(attn["out"]["kernel"]).T)
+        moe_p = lt["moe"]
+        sd[p + "mlp.gate.weight"] = _t(
+            np.asarray(moe_p["router"]["kernel"]).T)
+        experts = moe_p["experts"]
+        for e in range(config.num_experts):
+            ep = p + f"mlp.experts.{e}."
+            for hf, ours in (("gate_proj", "wi_gate"),
+                             ("up_proj", "wi_up"), ("down_proj", "wo")):
+                sd[ep + f"{hf}.weight"] = _t(
+                    np.asarray(experts[ours]["kernel"][e]).T)
+        shared = moe_p["shared_mlp"]
+        for hf, ours in (("gate_proj", "wi_gate"), ("up_proj", "wi_up"),
+                         ("down_proj", "wo")):
+            sd[p + f"mlp.shared_expert.{hf}.weight"] = _t(
+                np.asarray(shared[ours]["kernel"]).T)
+        sd[p + "mlp.shared_expert_gate.weight"] = _t(
+            np.asarray(moe_p["shared_gate"]["kernel"]).T)
+    return sd
+
+
+def export_qwen2_moe(config, params, out_dir) -> Path:
+    """Write an HF-loadable Qwen2-MoE checkpoint directory."""
+    import torch
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "config.json").write_text(
+        json.dumps(hf_config_dict_qwen2_moe(config), indent=2))
+    torch.save(export_qwen2_moe_state_dict(params, config),
+               out / "pytorch_model.bin")
+    return out

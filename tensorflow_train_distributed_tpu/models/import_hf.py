@@ -125,34 +125,18 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
         raise ValueError(
             f"checkpoint embed is {embed.shape}, config expects "
             f"{(config.vocab_size, config.d_model)}")
-    # Exact layer-count match: a deeper checkpoint must not be silently
-    # truncated (training would proceed on a corrupted model), a shallower
-    # one fails here instead of with an opaque KeyError mid-mapping.
-    def _has_layer(i):
-        return f"model.layers.{i}.input_layernorm.weight" in sd
-
-    if _has_layer(config.num_layers) or not _has_layer(
-            config.num_layers - 1):
-        n = 0
-        while _has_layer(n):
-            n += 1
-        raise ValueError(
-            f"checkpoint has {n} decoder layers, config expects "
-            f"{config.num_layers}")
+    _probe_count(sd, "model.layers.{}.input_layernorm.weight",
+                 config.num_layers, "decoder layers")
     biases = [k for k in sd if k.endswith("proj.bias")]
     if biases:
         raise ValueError(
             f"checkpoint has projection biases ({biases[0]}, ...); the "
             "native attention/MLP are bias-free — not exactly "
             "representable")
-    if "lm_head.weight" in sd:
-        lm_head = _np(sd["lm_head.weight"]).T
-    else:  # tied-embedding checkpoints omit the head
-        lm_head = embed.T.copy()
     params = {
         "token_embed": {"embedding": embed},
         "final_norm": {"scale": _np(sd["model.norm.weight"])},
-        "lm_head": {"kernel": lm_head},
+        "lm_head": {"kernel": _lm_head_or_tied(sd, embed)},
     }
     layers = [_layer_tree(sd, i) for i in range(config.num_layers)]
     if config.scan_layers:
