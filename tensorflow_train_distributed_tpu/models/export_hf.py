@@ -34,11 +34,21 @@ def hf_config_dict(config: LlamaConfig) -> dict:
             "without sinks (they are a decode-time technique; the "
             "weights are identical)")
     mistral = config.sliding_window is not None
+    qwen2 = getattr(config, "qkv_bias", False)
+    if qwen2 and mistral:
+        raise ValueError(
+            "qkv_bias + sliding_window exports are not supported (HF "
+            "Qwen2 windows need max_window_layers plumbing) — export "
+            "without the window (a decode-time technique)")
     head_dim = config.d_model // config.num_heads
+    model_type = ("qwen2" if qwen2
+                  else "mistral" if mistral else "llama")
+    arch = {"qwen2": "Qwen2ForCausalLM",
+            "mistral": "MistralForCausalLM",
+            "llama": "LlamaForCausalLM"}[model_type]
     out = {
-        "model_type": "mistral" if mistral else "llama",
-        "architectures": ["MistralForCausalLM" if mistral
-                          else "LlamaForCausalLM"],
+        "model_type": model_type,
+        "architectures": [arch],
         "vocab_size": config.vocab_size,
         "hidden_size": config.d_model,
         "intermediate_size": config.ffn_size,
@@ -102,6 +112,8 @@ def export_llama_state_dict(params, config: LlamaConfig) -> dict:
                          ("v_proj", "value"), ("o_proj", "out")):
             sd[p + f"self_attn.{hf}.weight"] = _t(
                 np.asarray(attn[ours]["kernel"]).T)
+            if getattr(config, "qkv_bias", False) and ours != "out":
+                sd[p + f"self_attn.{hf}.bias"] = _t(attn[ours]["bias"])
         mlp = lt["mlp"]
         for hf, ours in (("gate_proj", "wi_gate"), ("up_proj", "wi_up"),
                          ("down_proj", "wo")):

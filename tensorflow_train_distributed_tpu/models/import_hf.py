@@ -34,11 +34,13 @@ from tensorflow_train_distributed_tpu.models.llama import LlamaConfig
 
 def config_from_hf(hf_config) -> LlamaConfig:
     """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
-    if getattr(hf_config, "model_type", "llama") not in ("llama", "mistral"):
+    if getattr(hf_config, "model_type", "llama") not in (
+            "llama", "mistral", "qwen2"):
         raise ValueError(
-            f"import_hf supports Llama-family checkpoints, got model_type="
-            f"{hf_config.model_type!r} (BERT-style models are not exactly "
-            "representable here — see module docstring)")
+            f"import_hf supports Llama-family checkpoints (llama, "
+            f"mistral, qwen2), got model_type={hf_config.model_type!r} "
+            "(BERT-style models are not exactly representable here — "
+            "see module docstring)")
     # Exact-or-rejected: attention-affecting options the native model does
     # not implement must fail loudly, not import into silently-different
     # logits.
@@ -47,10 +49,19 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "checkpoint uses rope_scaling (Llama-3-style scaled RoPE), "
             "which the native model does not implement — importing would "
             "silently change logits at every position")
-    if getattr(hf_config, "attention_bias", False):
+    qwen2 = getattr(hf_config, "model_type", "") == "qwen2"
+    if getattr(hf_config, "attention_bias", False) and not qwen2:
         raise ValueError(
             "checkpoint has q/k/v/o projection biases; the native "
-            "attention is bias-free — not exactly representable")
+            "attention is bias-free for this family — qwen2 (qkv-bias "
+            "convention) imports via the same path, others are not "
+            "exactly representable")
+    if qwen2 and getattr(hf_config, "use_sliding_window", False):
+        raise ValueError(
+            "qwen2 use_sliding_window=True windows only layers past "
+            "max_window_layers — a per-layer mix the native uniform "
+            "window cannot represent; re-export the checkpoint with "
+            "use_sliding_window=false (full attention)")
     hd = getattr(hf_config, "head_dim", None)
     if hd and hd != hf_config.hidden_size // hf_config.num_attention_heads:
         raise ValueError(
@@ -76,8 +87,15 @@ def config_from_hf(hf_config) -> LlamaConfig:
         # (last `window` keys including self), torch-parity-tested.
         # `or None`: a checkpoint carrying sliding_window=0 means
         # disabled, and must import as full attention, not crash at the
-        # first forward (exact-or-rejected happens HERE).
-        sliding_window=getattr(hf_config, "sliding_window", None) or None,
+        # first forward (exact-or-rejected happens HERE).  Qwen2 ships
+        # use_sliding_window=False with a non-null sliding_window field
+        # — honor the switch; True is rejected above (HF windows only
+        # layers past max_window_layers, a per-layer mix the native
+        # uniform window cannot represent).
+        sliding_window=(
+            None if qwen2
+            else getattr(hf_config, "sliding_window", None) or None),
+        qkv_bias=qwen2,
     )
 
 
@@ -88,15 +106,22 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
-def _layer_tree(sd, i: int) -> dict:
+def _layer_tree(sd, i: int, qkv_bias: bool = False) -> dict:
     """One decoder layer's flax param tree from an HF state dict."""
     p = f"model.layers.{i}."
+
+    def proj(name):
+        t = {"kernel": _np(sd[p + f"self_attn.{name}.weight"]).T}
+        if qkv_bias:
+            t["bias"] = _np(sd[p + f"self_attn.{name}.bias"])
+        return t
+
     return {
         "attn_norm": {"scale": _np(sd[p + "input_layernorm.weight"])},
         "attention": {
-            "query": {"kernel": _np(sd[p + "self_attn.q_proj.weight"]).T},
-            "key": {"kernel": _np(sd[p + "self_attn.k_proj.weight"]).T},
-            "value": {"kernel": _np(sd[p + "self_attn.v_proj.weight"]).T},
+            "query": proj("q_proj"),
+            "key": proj("k_proj"),
+            "value": proj("v_proj"),
             "out": {"kernel": _np(sd[p + "self_attn.o_proj.weight"]).T},
         },
         "mlp_norm": {"scale": _np(sd[p + "post_attention_layernorm.weight"])},
@@ -127,18 +152,23 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
             f"{(config.vocab_size, config.d_model)}")
     _probe_count(sd, "model.layers.{}.input_layernorm.weight",
                  config.num_layers, "decoder layers")
-    biases = [k for k in sd if k.endswith("proj.bias")]
+    allowed = (("q_proj.bias", "k_proj.bias", "v_proj.bias")
+               if getattr(config, "qkv_bias", False) else ())
+    biases = [k for k in sd
+              if k.endswith("proj.bias") and not k.endswith(allowed)]
     if biases:
         raise ValueError(
-            f"checkpoint has projection biases ({biases[0]}, ...); the "
-            "native attention/MLP are bias-free — not exactly "
-            "representable")
+            f"checkpoint has projection biases the config cannot "
+            f"represent ({biases[0]}, ...); qkv_bias=True covers "
+            "q/k/v biases only (the Qwen2 convention) — anything else "
+            "would be silently dropped")
     params = {
         "token_embed": {"embedding": embed},
         "final_norm": {"scale": _np(sd["model.norm.weight"])},
         "lm_head": {"kernel": _lm_head_or_tied(sd, embed)},
     }
-    layers = [_layer_tree(sd, i) for i in range(config.num_layers)]
+    layers = [_layer_tree(sd, i, getattr(config, 'qkv_bias', False))
+              for i in range(config.num_layers)]
     if config.scan_layers:
         import jax
 

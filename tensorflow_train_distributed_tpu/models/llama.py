@@ -96,6 +96,10 @@ class LlamaConfig:
     # differs from the split layout — pick before training; single-chip
     # / dp meshes (the fused-dim slices fight a tensor axis).
     fused_qkv: bool = False
+    # q/k/v projection biases, out-proj unbiased (the Qwen2/Qwen2.5
+    # dense-family convention — layers.MultiHeadAttention.qkv_bias);
+    # Llama/Mistral stay bias-free.
+    qkv_bias: bool = False
 
     def __post_init__(self):
         if self.fused_qkv and self.lora is not None:
@@ -122,6 +126,13 @@ LLAMA_PRESETS = {
     "mistral_7b": LlamaConfig(num_kv_heads=8, ffn_size=14_336,
                               max_positions=32_768, rope_base=1e6,
                               sliding_window=4096),
+    # Qwen2.5-7B shape (qkv-bias convention; --init-from-hf a local
+    # checkpoint imports it exactly).
+    "qwen25_7b": LlamaConfig(vocab_size=152_064, d_model=3584,
+                             num_layers=28, num_heads=28,
+                             num_kv_heads=4, ffn_size=18_944,
+                             max_positions=32_768, rope_base=1e6,
+                             qkv_bias=True),
     "llama2_13b": LlamaConfig(d_model=5120, num_layers=40, num_heads=40,
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
@@ -210,6 +221,7 @@ class DecoderBlock(nn.Module):
             kv_cache_int8=cfg.kv_cache_int8,
             slot_decode=self.slot_decode,
             fused_qkv=cfg.fused_qkv,
+            qkv_bias=cfg.qkv_bias,
             name="attention",
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,

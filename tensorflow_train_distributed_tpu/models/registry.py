@@ -140,6 +140,14 @@ def _setup():
              warmup_ratio=0.03,
              # Llama-2 training convention: global-norm clip 1.0.
              grad_clip_norm=1.0)
+    # Qwen2.5-7B SFT (qkv-bias dense family; import_hf maps the
+    # checkpoints exactly — model_type "qwen2").
+    register("qwen25_7b_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["qwen25_7b"]),
+             dataset="lm", strategy="fsdp_tp", global_batch_size=64,
+             learning_rate=2e-5, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03, grad_clip_norm=1.0)
     # The single-chip benchmark flagship (bench_lm / __graft_entry__):
     # GPT-2-small-class decoder, trainable through the CLI on one chip.
     register("llama_125m_lm",

@@ -722,3 +722,71 @@ class TestQwen2MoeImport:
         got = np.asarray(moe.MoeLmModel(cfg2).apply(
             {"params": params2}, tokens).astype(np.float32))
         np.testing.assert_array_equal(native, got)
+
+
+class TestQwen2DenseImport:
+    """Qwen2/Qwen2.5 dense family: Llama + q/k/v biases
+    (LlamaConfig.qkv_bias) — forward parity vs torch and a bit-exact
+    export round trip through model_type 'qwen2'."""
+
+    def _hf(self):
+        cfg = transformers.Qwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            rms_norm_eps=1e-5, rope_theta=10_000.0,
+            use_sliding_window=False, tie_word_embeddings=False,
+        )
+        torch.manual_seed(21)
+        model = transformers.Qwen2ForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def test_forward_parity_and_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models.export_hf import (
+            export_llama,
+        )
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_llama,
+        )
+        from tensorflow_train_distributed_tpu.models.llama import (
+            LlamaModel,
+        )
+
+        hf = self._hf()
+        cfg, params = import_llama(hf, remat=False, dtype=jnp.float32,
+                                   scan_layers=False)
+        assert cfg.qkv_bias
+        rng = np.random.default_rng(23)
+        tokens = rng.integers(0, 256, (2, 20)).astype(np.int32)
+        with torch.no_grad():
+            want = hf(torch.asarray(tokens)).logits.float().numpy()
+        got = np.asarray(LlamaModel(cfg).apply(
+            {"params": params}, tokens).astype(np.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+        # Export re-loads as Qwen2ForCausalLM and reimports bit-exactly.
+        out = export_llama(cfg, params, tmp_path / "qwen2_out")
+        hf2 = transformers.AutoModelForCausalLM.from_pretrained(out)
+        assert type(hf2).__name__ == "Qwen2ForCausalLM"
+        cfg2, params2 = import_llama(hf2, remat=False,
+                                     dtype=jnp.float32,
+                                     scan_layers=False)
+        back = np.asarray(LlamaModel(cfg2).apply(
+            {"params": params2}, tokens).astype(np.float32))
+        np.testing.assert_array_equal(got, back)
+
+    def test_biased_checkpoint_needs_qkv_bias_config(self):
+        import dataclasses
+
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            config_from_hf, import_llama_state_dict,
+        )
+
+        hf = self._hf()
+        cfg = dataclasses.replace(config_from_hf(hf.config),
+                                  qkv_bias=False, scan_layers=False,
+                                  remat=False)
+        with pytest.raises(ValueError, match="qkv_bias"):
+            import_llama_state_dict(hf.state_dict(), cfg)
