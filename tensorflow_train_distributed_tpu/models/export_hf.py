@@ -33,6 +33,15 @@ def hf_config_dict(config: LlamaConfig) -> dict:
             "attention_sinks have no HF config field — export the model "
             "without sinks (they are a decode-time technique; the "
             "weights are identical)")
+    if (getattr(config, "embed_scale", False)
+            or getattr(config, "norm_zero_centered", False)
+            or getattr(config, "head_dim", None)
+            or getattr(config, "mlp_activation", "silu") != "silu"):
+        raise ValueError(
+            "Gemma-convention configs (embed_scale / zero-centered "
+            "norms / decoupled head_dim / GeGLU) have no HF exporter "
+            "yet — the llama/mistral/qwen2 formats would silently "
+            "change semantics; keep native (orbax) checkpoints")
     mistral = config.sliding_window is not None
     qwen2 = getattr(config, "qkv_bias", False)
     if qwen2 and mistral:

@@ -35,12 +35,14 @@ from tensorflow_train_distributed_tpu.models.llama import LlamaConfig
 def config_from_hf(hf_config) -> LlamaConfig:
     """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
     if getattr(hf_config, "model_type", "llama") not in (
-            "llama", "mistral", "qwen2"):
+            "llama", "mistral", "qwen2", "gemma"):
         raise ValueError(
             f"import_hf supports Llama-family checkpoints (llama, "
-            f"mistral, qwen2), got model_type={hf_config.model_type!r} "
-            "(BERT-style models are not exactly representable here — "
-            "see module docstring)")
+            f"mistral, qwen2, gemma), got model_type="
+            f"{hf_config.model_type!r} (gemma2/gemma3 add logit "
+            "softcapping / alternating windows the native model does "
+            "not implement; BERT-style models are not representable "
+            "here — see module docstring)")
     # Exact-or-rejected: attention-affecting options the native model does
     # not implement must fail loudly, not import into silently-different
     # logits.
@@ -62,14 +64,31 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "max_window_layers — a per-layer mix the native uniform "
             "window cannot represent; re-export the checkpoint with "
             "use_sliding_window=false (full attention)")
+    gemma = getattr(hf_config, "model_type", "") == "gemma"
     hd = getattr(hf_config, "head_dim", None)
-    if hd and hd != hf_config.hidden_size // hf_config.num_attention_heads:
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if hd and hd != derived and not gemma:
         raise ValueError(
             f"checkpoint uses an explicit head_dim={hd} != hidden_size/"
             f"num_heads ({hf_config.hidden_size}/"
-            f"{hf_config.num_attention_heads}) — Mistral-Nemo-style "
-            "decoupled head width is not representable (the native "
-            "model derives head_dim = d_model // num_heads)")
+            f"{hf_config.num_attention_heads}) — only the Gemma family "
+            "imports with a decoupled head width "
+            "(LlamaConfig.head_dim)")
+    if gemma:
+        act = (getattr(hf_config, "hidden_activation", None)
+               or getattr(hf_config, "hidden_act", None)
+               or "gelu_pytorch_tanh")
+        if act != "gelu_pytorch_tanh":
+            # Exact-or-rejected: plain "gelu" is HF's exact erf GELU,
+            # while the native GeGLU is the tanh approximation — the
+            # ~3e-3 per-activation gap compounds across layers.  (Real
+            # Gemma checkpoints use gelu_pytorch_tanh; HF itself warns
+            # when a config says "gelu".)
+            raise ValueError(
+                f"gemma hidden_activation={act!r}; only "
+                "'gelu_pytorch_tanh' (the tanh approximation every "
+                "released Gemma uses) maps exactly onto the native "
+                "GeGLU")
     kv = getattr(hf_config, "num_key_value_heads",
                  hf_config.num_attention_heads)
     return LlamaConfig(
@@ -93,9 +112,14 @@ def config_from_hf(hf_config) -> LlamaConfig:
         # layers past max_window_layers, a per-layer mix the native
         # uniform window cannot represent).
         sliding_window=(
-            None if qwen2
+            None if (qwen2 or gemma)
             else getattr(hf_config, "sliding_window", None) or None),
         qkv_bias=qwen2,
+        # Gemma conventions (all no-ops for the other families).
+        head_dim=(hd if gemma and hd and hd != derived else None),
+        embed_scale=gemma,
+        mlp_activation="gelu" if gemma else "silu",
+        norm_zero_centered=gemma,
     )
 
 

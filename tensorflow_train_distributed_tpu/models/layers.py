@@ -121,10 +121,16 @@ def apply_rope(x, positions, *, base: float = 10000.0):
 
 
 class RMSNorm(nn.Module):
-    """Llama-family norm; scale is replicated ("norm" logical axis)."""
+    """Llama-family norm; scale is replicated ("norm" logical axis).
+
+    ``zero_centered`` (the Gemma convention): output = x̂ · (1 + scale)
+    with zeros-init — the parameter stores the DEVIATION from identity,
+    so weight decay pulls toward identity and HF Gemma checkpoints map
+    verbatim."""
 
     epsilon: float = 1e-5
     dtype: Dtype = jnp.float32
+    zero_centered: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -132,11 +138,15 @@ class RMSNorm(nn.Module):
             rms_norm,
         )
 
+        init = (nn.initializers.zeros if self.zero_centered
+                else nn.initializers.ones)
         scale = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            nn.with_logical_partitioning(init, ("norm",)),
             (x.shape[-1],),
         )
+        if self.zero_centered:
+            scale = scale + 1.0
         # Fused pallas kernel on TPU (one VMEM pass, custom VJP); the
         # reference jnp path elsewhere — identical numerics (f32 accum).
         return rms_norm(x, scale, epsilon=self.epsilon).astype(self.dtype)

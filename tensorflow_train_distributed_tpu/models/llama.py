@@ -100,8 +100,26 @@ class LlamaConfig:
     # dense-family convention — layers.MultiHeadAttention.qkv_bias);
     # Llama/Mistral stay bias-free.
     qkv_bias: bool = False
+    # Gemma-family knobs.  head_dim decouples the attention width from
+    # d_model/num_heads (gemma-2b: d=2048, 8 heads, head_dim 256);
+    # None = the Llama derivation.  embed_scale multiplies token
+    # embeddings by sqrt(d_model) at input.  mlp_activation "gelu"
+    # makes the gated MLP GeGLU (tanh-approx, HF gelu_pytorch_tanh);
+    # "silu" is SwiGLU.  norm_zero_centered stores RMSNorm scales as
+    # deviations from identity (output x̂·(1+w)) so Gemma checkpoints
+    # map verbatim.
+    head_dim: Optional[int] = None
+    embed_scale: bool = False
+    mlp_activation: str = "silu"
+    norm_zero_centered: bool = False
 
     def __post_init__(self):
+        if self.mlp_activation not in ("silu", "gelu"):
+            # Config-time, not a KeyError deep inside the first trace.
+            raise ValueError(
+                f"mlp_activation must be 'silu' (SwiGLU) or 'gelu' "
+                f"(GeGLU, tanh approximation), got "
+                f"{self.mlp_activation!r}")
         if self.fused_qkv and self.lora is not None:
             attn = ({"query", "key", "value"}
                     & set(getattr(self.lora, "targets", ())))
@@ -133,6 +151,22 @@ LLAMA_PRESETS = {
                              num_kv_heads=4, ffn_size=18_944,
                              max_positions=32_768, rope_base=1e6,
                              qkv_bias=True),
+    # Gemma-1 shapes: decoupled 256-wide heads, sqrt(d) embed scale,
+    # GeGLU, zero-centered norms, tied embeddings (import maps the tied
+    # head automatically).  2b is MQA (kv=1).
+    "gemma_2b": LlamaConfig(vocab_size=256_000, d_model=2048,
+                            num_layers=18, num_heads=8, num_kv_heads=1,
+                            head_dim=256, ffn_size=16_384,
+                            max_positions=8192, rms_epsilon=1e-6,
+                            embed_scale=True, mlp_activation="gelu",
+                            norm_zero_centered=True),
+    "gemma_7b": LlamaConfig(vocab_size=256_000, d_model=3072,
+                            num_layers=28, num_heads=16,
+                            num_kv_heads=16, head_dim=256,
+                            ffn_size=24_576, max_positions=8192,
+                            rms_epsilon=1e-6, embed_scale=True,
+                            mlp_activation="gelu",
+                            norm_zero_centered=True),
     "llama2_13b": LlamaConfig(d_model=5120, num_layers=40, num_heads=40,
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
@@ -208,10 +242,11 @@ class DecoderBlock(nn.Module):
     def __call__(self, x, segment_ids=None, positions=None):
         cfg = self.config
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      zero_centered=cfg.norm_zero_centered,
                       name="attn_norm")(x)
         x = x + L.MultiHeadAttention(
             num_heads=cfg.num_heads,
-            head_dim=cfg.d_model // cfg.num_heads,
+            head_dim=cfg.head_dim or cfg.d_model // cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
             rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
@@ -225,6 +260,7 @@ class DecoderBlock(nn.Module):
             name="attention",
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      zero_centered=cfg.norm_zero_centered,
                       name="mlp_norm")(x)
         mlp_cls = L.MlpBlock
         if cfg.remat and cfg.remat_policy == "no_ffn" and not self.decode:
@@ -240,7 +276,9 @@ class DecoderBlock(nn.Module):
                 L.MlpBlock, prevent_cse=False,
                 policy=jax.checkpoint_policies.nothing_saveable)
         x = x + mlp_cls(
-            hidden=cfg.ffn_size, dtype=cfg.dtype, activation=nn.silu,
+            hidden=cfg.ffn_size, dtype=cfg.dtype,
+            activation={"silu": nn.silu, "gelu": nn.gelu}[
+                cfg.mlp_activation],
             gated=True, name="mlp")(h)
         return x
 
@@ -403,6 +441,11 @@ class LlamaModel(nn.Module):
             positions = segment_relative_positions(segment_ids)
         x = L.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                     name="token_embed")(tokens)
+        if cfg.embed_scale:
+            # Gemma input normalizer; the cast mirrors HF (the constant
+            # is materialized in the activation dtype).
+            x = x * jnp.asarray(
+                cfg.d_model ** 0.5, x.dtype)
         pp_mesh = None if self.is_initializing() else _pipeline_mesh(cfg)
         if pp_mesh is not None and self.decode:
             raise ValueError(
@@ -432,6 +475,7 @@ class LlamaModel(nn.Module):
                         slot_decode=self.slot_decode, name=f"layer_{i}")(
                     x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
+                      zero_centered=cfg.norm_zero_centered,
                       name="final_norm")(x)
         logits = L.dense(cfg.vocab_size, ("embed", "vocab"), use_bias=False,
                          dtype=cfg.dtype, name="lm_head")(x)

@@ -140,6 +140,20 @@ def _setup():
              warmup_ratio=0.03,
              # Llama-2 training convention: global-norm clip 1.0.
              grad_clip_norm=1.0)
+    # Gemma-1 SFT entries (decoupled head_dim, embed scaling, GeGLU,
+    # zero-centered norms — import_hf maps checkpoints exactly).
+    register("gemma_2b_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["gemma_2b"]),
+             dataset="lm", strategy="dp", global_batch_size=64,
+             learning_rate=2e-5, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03, grad_clip_norm=1.0)
+    register("gemma_7b_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["gemma_7b"]),
+             dataset="lm", strategy="fsdp_tp", global_batch_size=64,
+             learning_rate=2e-5, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03, grad_clip_norm=1.0)
     # Qwen2.5-7B SFT (qkv-bias dense family; import_hf maps the
     # checkpoints exactly — model_type "qwen2").
     register("qwen25_7b_sft",
