@@ -32,8 +32,11 @@ import numpy as np
 from tensorflow_train_distributed_tpu.models.llama import LlamaConfig
 
 
-def config_from_hf(hf_config) -> LlamaConfig:
-    """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
+def _validate_hf_llama_family(hf_config) -> None:
+    """Exact-or-rejected guards — run on EVERY import path, including
+    the CLI's ``config=task_cfg`` route (which skips config
+    derivation); the Mixtral/Qwen2-MoE importers follow the same
+    rule."""
     if getattr(hf_config, "model_type", "llama") not in (
             "llama", "mistral", "qwen2", "gemma"):
         raise ValueError(
@@ -43,9 +46,8 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "softcapping / alternating windows the native model does "
             "not implement; BERT-style models are not representable "
             "here — see module docstring)")
-    # Exact-or-rejected: attention-affecting options the native model does
-    # not implement must fail loudly, not import into silently-different
-    # logits.
+    # Attention-affecting options the native model does not implement
+    # must fail loudly, not import into silently-different logits.
     if getattr(hf_config, "rope_scaling", None):
         raise ValueError(
             "checkpoint uses rope_scaling (Llama-3-style scaled RoPE), "
@@ -75,20 +77,27 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "imports with a decoupled head width "
             "(LlamaConfig.head_dim)")
     if gemma:
-        act = (getattr(hf_config, "hidden_activation", None)
-               or getattr(hf_config, "hidden_act", None)
-               or "gelu_pytorch_tanh")
-        if act != "gelu_pytorch_tanh":
-            # Exact-or-rejected: plain "gelu" is HF's exact erf GELU,
-            # while the native GeGLU is the tanh approximation — the
-            # ~3e-3 per-activation gap compounds across layers.  (Real
-            # Gemma checkpoints use gelu_pytorch_tanh; HF itself warns
-            # when a config says "gelu".)
+        # HF's GemmaMLP runs gelu_pytorch_tanh whenever
+        # hidden_activation is None, IGNORING legacy hidden_act — so
+        # original gemma configs (hidden_act="gelu", no
+        # hidden_activation) map exactly onto the native tanh GeGLU
+        # and import fine; only an EXPLICIT different hidden_activation
+        # (exact erf gelu, honored by HF when set) is rejected.
+        act = getattr(hf_config, "hidden_activation", None)
+        if act is not None and act != "gelu_pytorch_tanh":
             raise ValueError(
-                f"gemma hidden_activation={act!r}; only "
-                "'gelu_pytorch_tanh' (the tanh approximation every "
-                "released Gemma uses) maps exactly onto the native "
-                "GeGLU")
+                f"gemma hidden_activation={act!r} is honored by HF "
+                "as-is; only 'gelu_pytorch_tanh' (or None, HF's "
+                "default) maps exactly onto the native GeGLU")
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
+    _validate_hf_llama_family(hf_config)
+    qwen2 = getattr(hf_config, "model_type", "") == "qwen2"
+    gemma = getattr(hf_config, "model_type", "") == "gemma"
+    hd = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
     kv = getattr(hf_config, "num_key_value_heads",
                  hf_config.num_attention_heads)
     return LlamaConfig(
@@ -345,6 +354,7 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
         from transformers import AutoModelForCausalLM
 
         model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
+    _validate_hf_llama_family(model_or_path.config)  # every path
     if config is None:
         config = config_from_hf(model_or_path.config)
     if config_overrides:

@@ -51,7 +51,13 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     gen_tokens = sum(m for _, m in reqs)
 
     draft_cfg = draft_params = None
-    if draft_preset:
+    if draft_preset == "self":
+        # Acceptance CEILING: the target drafts for itself (p == q, all
+        # drafts accepted) — measures the speculative machinery's best
+        # case and its mechanical overhead; pair with a random-init
+        # draft (the floor) to bracket real trained drafts.
+        draft_cfg, draft_params = cfg, params
+    elif draft_preset:
         draft_cfg = LLAMA_PRESETS[draft_preset]
         draft_params = LlamaModel(draft_cfg).init(
             jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
@@ -149,8 +155,10 @@ def main(argv=None) -> int:
                    help="also time the static-batch generate path")
     p.add_argument("--speculative-draft", default="",
                    help="llama preset for a draft model: speculative "
-                        "serving A/B (random-init draft, so acceptance "
-                        "is the floor — real drafts only do better)")
+                        "serving A/B (random-init draft = the "
+                        "acceptance FLOOR; 'self' = the target drafts "
+                        "for itself, the acceptance CEILING — the pair "
+                        "brackets real trained drafts)")
     p.add_argument("--speculative-k", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",

@@ -124,6 +124,22 @@ STEPS = [
      [sys.executable, "tools/bench_lm.py", "--preset", "llama_125m",
       "--batch-per-chip", "8", "--seq", "2048",
       "--remat", "--remat-policy", "no_ffn", "--no-scan-layers"]),
+    # Speculative serving bracket: 'self' draft = acceptance CEILING
+    # (target drafts for itself — best case + mechanical overhead),
+    # random tiny draft = FLOOR; real trained drafts land between.
+    ("serve_spec_self", 900,
+     [sys.executable, "tools/bench_serving.py", "--preset", "llama_125m",
+      "--slots", "8", "--chunk", "8", "--requests", "32",
+      "--prompt-range", "16,120", "--new-range", "16,128",
+      "--speculative-draft", "self", "--speculative-k", "4"]),
+    # Floor draft = a DIFFERENTLY-SEEDED llama_125m (same vocab — the
+    # engine rejects vocab mismatches — and full draft cost at ~zero
+    # acceptance: the worst possible case for the machinery).
+    ("serve_spec_floor", 900,
+     [sys.executable, "tools/bench_serving.py", "--preset", "llama_125m",
+      "--slots", "8", "--chunk", "8", "--requests", "32",
+      "--prompt-range", "16,120", "--new-range", "16,128",
+      "--speculative-draft", "llama_125m", "--speculative-k", "4"]),
     # ── Re-confirmation block: already measured this week; refresh for
     # the round-5 record when the priority block has drained.
     ("resnet_s2d", 560,

@@ -62,6 +62,8 @@ STEP_KEYS = {
     "serve_engine": "llama_125m_serving_engine",
     "lm_fused_qkv": "llama_125m_noffn_b8_fused_qkv",
     "lm_noscan": "llama_125m_noffn_b8_noscan",
+    "serve_spec_self": "llama_125m_serving_spec_self",
+    "serve_spec_floor": "llama_125m_serving_spec_floor",
 }
 
 
