@@ -74,6 +74,12 @@ def hf_config_dict(config: LlamaConfig) -> dict:
     }
     if mistral:
         out["sliding_window"] = config.sliding_window
+    if getattr(config, "rope_scaling", None):
+        f, lo, hi, old = config.rope_scaling
+        out["rope_scaling"] = {
+            "rope_type": "llama3", "factor": f, "low_freq_factor": lo,
+            "high_freq_factor": hi,
+            "original_max_position_embeddings": old}
     return out
 
 

@@ -48,11 +48,13 @@ def _validate_hf_llama_family(hf_config) -> None:
             "here — see module docstring)")
     # Attention-affecting options the native model does not implement
     # must fail loudly, not import into silently-different logits.
-    if getattr(hf_config, "rope_scaling", None):
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs and rs.get("rope_type", rs.get("type")) != "llama3":
         raise ValueError(
-            "checkpoint uses rope_scaling (Llama-3-style scaled RoPE), "
-            "which the native model does not implement — importing would "
-            "silently change logits at every position")
+            f"rope_scaling type {rs.get('rope_type', rs.get('type'))!r} "
+            "is not implemented natively (only the llama3 "
+            "frequency-dependent rule) — importing would silently "
+            "change logits at every position")
     qwen2 = getattr(hf_config, "model_type", "") == "qwen2"
     if getattr(hf_config, "attention_bias", False) and not qwen2:
         raise ValueError(
@@ -94,6 +96,7 @@ def _validate_hf_llama_family(hf_config) -> None:
 def config_from_hf(hf_config) -> LlamaConfig:
     """Derive a native ``LlamaConfig`` from a HF ``LlamaConfig``."""
     _validate_hf_llama_family(hf_config)
+    rs = getattr(hf_config, "rope_scaling", None)  # llama3-validated
     qwen2 = getattr(hf_config, "model_type", "") == "qwen2"
     gemma = getattr(hf_config, "model_type", "") == "gemma"
     hd = getattr(hf_config, "head_dim", None)
@@ -124,6 +127,11 @@ def config_from_hf(hf_config) -> LlamaConfig:
             None if (qwen2 or gemma)
             else getattr(hf_config, "sliding_window", None) or None),
         qkv_bias=qwen2,
+        rope_scaling=(
+            (float(rs["factor"]), float(rs["low_freq_factor"]),
+             float(rs["high_freq_factor"]),
+             int(rs["original_max_position_embeddings"]))
+            if rs else None),
         # Gemma conventions (all no-ops for the other families).
         head_dim=(hd if gemma and hd and hd != derived else None),
         embed_scale=gemma,
@@ -355,6 +363,23 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
 
         model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
     _validate_hf_llama_family(model_or_path.config)  # every path
+    if config is not None:
+        # The rope-scaling rule is the CHECKPOINT's, not the preset's:
+        # base Llama-3 weights under a llama31 preset (or 3.1 weights
+        # under a scaling-less config — identical shapes either way)
+        # would apply frequencies the weights were never trained with,
+        # silently changing logits at every position.
+        rs = getattr(model_or_path.config, "rope_scaling", None)
+        want = ((float(rs["factor"]), float(rs["low_freq_factor"]),
+                 float(rs["high_freq_factor"]),
+                 int(rs["original_max_position_embeddings"]))
+                if rs else None)
+        have = getattr(config, "rope_scaling", None)
+        if want != have:
+            raise ValueError(
+                f"config rope_scaling={have} but the checkpoint says "
+                f"{want} — the checkpoint's convention wins; use a "
+                "matching config/preset")
     if config is None:
         config = config_from_hf(model_or_path.config)
     if config_overrides:

@@ -102,15 +102,36 @@ def sinusoidal_positions(seq_len: int, features: int) -> np.ndarray:
     return table
 
 
-def apply_rope(x, positions, *, base: float = 10000.0):
+def llama3_scaled_freqs(freqs, scaling):
+    """Llama-3.x frequency-dependent RoPE scaling (HF
+    ``_compute_llama3_parameters``): long wavelengths divide by
+    ``factor``, short ones stay, the middle band interpolates smoothly.
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_positions)."""
+    factor, low, high, old_len = scaling
+    wavelen = 2.0 * np.pi / freqs
+    low_wl = old_len / low
+    high_wl = old_len / high
+    scaled = jnp.where(wavelen > low_wl, freqs / factor, freqs)
+    smooth = (old_len / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) / factor * freqs + smooth * freqs
+    medium = (wavelen >= high_wl) & (wavelen <= low_wl)
+    return jnp.where(medium, smoothed, scaled)
+
+
+def apply_rope(x, positions, *, base: float = 10000.0, scaling=None):
     """RoPE applied to [B, S, H, D] at integer ``positions`` [B, S].
 
     Applied separately to q and k so each uses its own positions (KV-cache
     decode and cross-length attention need different q/k position vectors).
+    ``scaling``: optional llama3 rope-scaling tuple (see
+    ``llama3_scaled_freqs``).
     """
     head_dim = x.shape[-1]
     freqs = 1.0 / base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                            / head_dim)
+    if scaling is not None:
+        freqs = llama3_scaled_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
     sin = jnp.sin(angles)[:, :, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
@@ -167,6 +188,9 @@ class MultiHeadAttention(nn.Module):
     causal: bool = False
     use_rope: bool = False
     rope_base: float = 10000.0
+    # Llama-3.x rope scaling tuple (factor, low_freq_factor,
+    # high_freq_factor, original_max_positions); None = plain RoPE.
+    rope_scaling: Optional[tuple] = None
     dropout_rate: float = 0.0
     # Sequence/context parallelism: "ring" | "ulysses" | None.  Takes
     # effect when the ambient mesh (jax.set_mesh, as the Trainer binds)
@@ -328,8 +352,10 @@ class MultiHeadAttention(nn.Module):
             kv_positions = (positions if x_kv is x_q
                             else jnp.broadcast_to(
                                 jnp.arange(x_kv.shape[1]), x_kv.shape[:2]))
-            q = apply_rope(q, positions, base=self.rope_base)
-            k = apply_rope(k, kv_positions, base=self.rope_base)
+            q = apply_rope(q, positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
+            k = apply_rope(k, kv_positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
 
         # [B, S, H, D] → [B, H, S, D] for the kernel.
         qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
@@ -444,8 +470,10 @@ class MultiHeadAttention(nn.Module):
         positions = cur + jnp.arange(q_len)
         if self.use_rope:
             pos_b = jnp.broadcast_to(positions, (b, q_len))
-            q = apply_rope(q, pos_b, base=self.rope_base)
-            k = apply_rope(k, pos_b, base=self.rope_base)
+            q = apply_rope(q, pos_b, base=self.rope_base,
+                           scaling=self.rope_scaling)
+            k = apply_rope(k, pos_b, base=self.rope_base,
+                           scaling=self.rope_scaling)
         index.value = cur + q_len
 
         if rolling and q_len > 1:
@@ -583,8 +611,10 @@ class MultiHeadAttention(nn.Module):
         cur = index.value                                   # [B]
         positions = cur[:, None] + jnp.arange(q_len)        # [B, q]
         if self.use_rope:
-            q = apply_rope(q, positions, base=self.rope_base)
-            k = apply_rope(k, positions, base=self.rope_base)
+            q = apply_rope(q, positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
+            k = apply_rope(k, positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
         index.value = cur + q_len
 
         kdt = cache_k.value.dtype

@@ -112,6 +112,11 @@ class LlamaConfig:
     embed_scale: bool = False
     mlp_activation: str = "silu"
     norm_zero_centered: bool = False
+    # Llama-3.x frequency-dependent RoPE scaling: (factor,
+    # low_freq_factor, high_freq_factor, original_max_positions) —
+    # layers.llama3_scaled_freqs; None = plain RoPE.  A tuple (not a
+    # dict) so the frozen config stays hashable for jit static args.
+    rope_scaling: Optional[tuple] = None
 
     def __post_init__(self):
         if self.mlp_activation not in ("silu", "gelu"):
@@ -167,6 +172,14 @@ LLAMA_PRESETS = {
                             rms_epsilon=1e-6, embed_scale=True,
                             mlp_activation="gelu",
                             norm_zero_centered=True),
+    # Llama-3.1-8B shape: GQA(8), 128k vocab, 500k rope base with the
+    # llama3 frequency-scaling tuple (factor 8, low 1, high 4, original
+    # context 8192) — --init-from-hf maps checkpoints exactly.
+    "llama31_8b": LlamaConfig(vocab_size=128_256, num_layers=32,
+                              num_heads=32, num_kv_heads=8,
+                              ffn_size=14_336, max_positions=131_072,
+                              rope_base=500_000.0,
+                              rope_scaling=(8.0, 1.0, 4.0, 8192)),
     "llama2_13b": LlamaConfig(d_model=5120, num_layers=40, num_heads=40,
                               ffn_size=13_824),
     "llama_1b": LlamaConfig(d_model=2048, num_layers=16, num_heads=16,
@@ -249,7 +262,8 @@ class DecoderBlock(nn.Module):
             head_dim=cfg.head_dim or cfg.d_model // cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
             dtype=cfg.dtype, causal=True, use_rope=True,
-            rope_base=cfg.rope_base, seq_parallel=cfg.seq_parallel,
+            rope_base=cfg.rope_base, rope_scaling=cfg.rope_scaling,
+            seq_parallel=cfg.seq_parallel,
             window=cfg.sliding_window, sinks=cfg.attention_sinks,
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,

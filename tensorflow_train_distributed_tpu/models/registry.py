@@ -140,6 +140,13 @@ def _setup():
              warmup_ratio=0.03,
              # Llama-2 training convention: global-norm clip 1.0.
              grad_clip_norm=1.0)
+    # Llama-3.1-8B SFT (GQA + llama3 rope scaling; --init-from-hf).
+    register("llama31_8b_sft",
+             task_factory=lambda: llama.make_task(
+                 llama.LLAMA_PRESETS["llama31_8b"]),
+             dataset="lm", strategy="fsdp_tp", global_batch_size=64,
+             learning_rate=2e-5, lr_schedule="warmup_cosine",
+             warmup_ratio=0.03, grad_clip_norm=1.0)
     # Gemma-1 SFT entries (decoupled head_dim, embed scaling, GeGLU,
     # zero-centered norms — import_hf maps checkpoints exactly).
     register("gemma_2b_sft",

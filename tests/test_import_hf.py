@@ -852,3 +852,71 @@ class TestGemmaImport:
 
         with pytest.raises(ValueError, match="gemma2"):
             config_from_hf(FakeCfg())
+
+
+class TestLlama3RopeScaling:
+    """Llama-3.x frequency-dependent RoPE scaling: torch parity, decode
+    identity, and an export round trip carrying the scaling tuple."""
+
+    def _hf(self):
+        cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            rms_norm_eps=1e-5, rope_theta=10_000.0,
+            attention_bias=False, tie_word_embeddings=False,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0,
+                          "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 64},
+        )
+        torch.manual_seed(41)
+        model = transformers.LlamaForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def test_parity_decode_and_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models.export_hf import (
+            export_llama,
+        )
+        from tensorflow_train_distributed_tpu.models.generate import (
+            generate,
+        )
+
+        hf = self._hf()
+        cfg, params = import_llama(hf, remat=False, dtype=jnp.float32,
+                                   scan_layers=False)
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64)
+        rng = np.random.default_rng(43)
+        # Positions PAST original_max_position_embeddings exercise the
+        # scaled low-frequency band, not just the pass-through region.
+        tokens = rng.integers(0, 256, (2, 96)).astype(np.int32)
+        with torch.no_grad():
+            want = hf(torch.asarray(tokens)).logits.float().numpy()
+        got = np.asarray(LlamaModel(cfg).apply(
+            {"params": params}, tokens).astype(np.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+        prompt = np.asarray([[5, 1, 4]], np.int32)
+        with torch.no_grad():
+            ref = hf.generate(torch.asarray(prompt), max_new_tokens=6,
+                              do_sample=False).numpy()[0].tolist()
+        dec = np.asarray(generate(cfg, params,
+                                  jnp.asarray(prompt), 6))[0].tolist()
+        assert dec == ref
+        out = export_llama(cfg, params, tmp_path / "llama3_out")
+        hf2 = transformers.AutoModelForCausalLM.from_pretrained(out)
+        cfg2, params2 = import_llama(hf2, remat=False,
+                                     dtype=jnp.float32,
+                                     scan_layers=False)
+        assert cfg2.rope_scaling == cfg.rope_scaling
+        back = np.asarray(LlamaModel(cfg2).apply(
+            {"params": params2}, tokens).astype(np.float32))
+        np.testing.assert_array_equal(got, back)
+
+    def test_other_scaling_types_rejected(self):
+        cfg = self._hf().config
+        cfg.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+        with pytest.raises(ValueError, match="yarn"):
+            config_from_hf(cfg)

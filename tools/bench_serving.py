@@ -82,8 +82,11 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     total_len = run_engine()
     dt = time.perf_counter() - t0
     dev = jax.devices()[0]
-    name = (f"{preset}_serving_engine_spec" if draft_preset
-            else f"{preset}_serving_engine")
+    # Ceiling ('self') and floor (random-init) runs must be
+    # distinguishable by metric name alone, not just the draft_preset
+    # field.
+    name = (f"{preset}_serving_engine_spec_{draft_preset}"
+            if draft_preset else f"{preset}_serving_engine")
     rec = {
         "metric": f"{name}_tokens_per_sec",
         "value": round(gen_tokens / dt, 1),
