@@ -861,3 +861,40 @@ def test_snapshot_streams_inflight_tokens(params):
         assert partial == full[:len(partial)]
     assert rid not in eng.snapshot()       # finished → left the view
     assert len(seen) >= 2                  # chunk=2 over 8 tokens: grew
+
+
+def test_prefix_caching_composes_with_tp_mesh(params, mesh_2d):
+    """Prefix caching under tensor-parallel serving: the stored prefix
+    cache is sharded like every other engine buffer (the copy preserves
+    shardings), and outputs stay token-identical to the unsharded
+    prefix-cached engine."""
+    system = [3, 1, 4, 1, 5, 9]
+    reqs = [(system + [9, 2, 7], 6), (system + [8, 2, 6, 4, 1], 5)]
+
+    def serve(mesh):
+        eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=4,
+                            prompt_buckets=(8, 16), mesh=mesh)
+        eng.preload_prefix(system)
+        # Prove the prefix ENGAGES under the mesh (a silent
+        # full-prefill fallback would still be token-identical): after
+        # the preload's own piece, request prefills must be
+        # suffix-sized only.
+        pieces = []
+        orig = eng._prefill_piece
+
+        def counting(variables, cache, toks, local, seed):
+            pieces.append(int(toks.shape[1]))
+            return orig(variables, cache, toks, local, seed)
+
+        eng._prefill_piece = counting
+        ids = [eng.submit(p, n) for p, n in reqs]
+        out = eng.run()
+        assert pieces == [8, 8], pieces  # 3/5-token suffixes → the
+        #    8-bucket; a full 9/11-token prompt would need the 16-bucket
+        return [out[i] for i in ids]
+
+    plain = serve(None)
+    assert serve(mesh_2d) == plain
+    # And the unsharded prefix outputs equal full-prefill generate().
+    for got, (p, m) in zip(plain, reqs):
+        assert got == _ref(params, p, m)
