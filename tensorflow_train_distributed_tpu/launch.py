@@ -871,25 +871,14 @@ def run(args: argparse.Namespace) -> RunResult:
             task_cfg = getattr(task, "config", None)
             sample = None
             if isinstance(task_cfg, MoeConfig):
-                # Sparse-MoE checkpoints: Mixtral, or Qwen2-MoE when the
-                # checkpoint says so (gated shared expert, qkv biases,
-                # raw top-k gates — all validated by the importer);
+                # Sparse-MoE checkpoints: Mixtral, or Qwen2-MoE when
+                # the checkpoint says so — import_moe dispatches on the
+                # checkpoint's model_type (AutoConfig: local dirs AND
+                # hub ids, no weights downloaded before the decision);
                 # capacity_factor E/k on import makes routing exactly
-                # HF's (import_hf).  AutoConfig resolves model_type for
-                # local dirs AND hub ids without downloading weights —
-                # a json peek at a local path would mis-dispatch hub ids
-                # to the Mixtral importer, which rejects only AFTER
-                # from_pretrained pulled the full checkpoint.
-                from transformers import AutoConfig
-
-                hf_model_type = getattr(AutoConfig.from_pretrained(
-                    args.init_from_hf), "model_type", "")
-                if hf_model_type == "qwen2_moe":
-                    hf_cfg, hf_params = import_hf.import_qwen2_moe(
-                        args.init_from_hf, config=task_cfg)
-                else:
-                    hf_cfg, hf_params = import_hf.import_mixtral(
-                        args.init_from_hf, config=task_cfg)
+                # HF's (import_hf).
+                hf_cfg, hf_params = import_hf.import_moe(
+                    args.init_from_hf, config=task_cfg)
             elif isinstance(task_cfg, LlamaConfig):
                 # The task's config decides the param-tree layout (scan
                 # vs per-layer) and validates dims vs the checkpoint.

@@ -732,3 +732,28 @@ def import_qwen2_moe(model_or_path, config=None, **config_overrides):
     params = import_qwen2_moe_state_dict(model_or_path.state_dict(),
                                          config)
     return config, params
+
+
+def import_moe(model_or_path, config=None, **config_overrides):
+    """Sparse-MoE import dispatch on the checkpoint's ``model_type``
+    (Mixtral vs Qwen2-MoE) — local dir or hub id alike, resolved via
+    ``AutoConfig`` so no weights download before the decision.  The
+    single entry point launch.py / sample.py / serve.py share."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoConfig
+
+        mt = getattr(AutoConfig.from_pretrained(model_or_path),
+                     "model_type", "")
+    else:
+        mt = getattr(model_or_path.config, "model_type", "")
+    if mt == "qwen2_moe":
+        return import_qwen2_moe(model_or_path, config,
+                                **config_overrides)
+    if mt != "mixtral":
+        # Fail fast while only the CONFIG is in hand — falling through
+        # to import_mixtral would download the full checkpoint before
+        # its validator rejects the model_type.
+        raise ValueError(
+            f"sparse-MoE import supports mixtral and qwen2_moe, got "
+            f"model_type={mt!r}")
+    return import_mixtral(model_or_path, config, **config_overrides)

@@ -42,6 +42,23 @@ def _restore_params(checkpoint_dir: str):
     return params
 
 
+def load_decoder_params(args, cfg, is_moe):
+    """Weights from --checkpoint-dir (orbax) or --init-from-hf (HF
+    import with the registry config's layout) — shared by sample.py
+    and serve.py.  Import validators exit with the clean CLI
+    convention, not a traceback."""
+    if getattr(args, "init_from_hf", None):
+        from tensorflow_train_distributed_tpu.models import import_hf
+
+        importer = (import_hf.import_moe if is_moe
+                    else import_hf.import_llama)
+        try:
+            return importer(args.init_from_hf, cfg)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    return cfg, _restore_params(args.checkpoint_dir)
+
+
 def resolve_decoder_task(config_name: str, verb: str):
     """Registry lookup + decoder-family guard (shared with serve.py).
 
@@ -163,21 +180,7 @@ def main(argv=None) -> int:
             f"config's max_positions={cfg.max_positions} (the KV cache)")
     prompt = np.asarray(rows, np.int32)
 
-    if args.init_from_hf:
-        if is_moe:
-            from tensorflow_train_distributed_tpu.models.import_hf import (
-                import_mixtral,
-            )
-
-            cfg, params = import_mixtral(args.init_from_hf, cfg)
-        else:
-            from tensorflow_train_distributed_tpu.models.import_hf import (
-                import_llama,
-            )
-
-            cfg, params = import_llama(args.init_from_hf, cfg)
-    else:
-        params = _restore_params(args.checkpoint_dir)
+    cfg, params = load_decoder_params(args, cfg, is_moe)
 
     import dataclasses as _dc
 

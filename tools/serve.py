@@ -30,6 +30,7 @@ sys.path.insert(0, _HERE)                   # tools/ (sample.py helper)
 from sample import (  # noqa: E402 (tools/ sibling)
     _restore_params,
     check_vocab_ids,
+    load_decoder_params,
     parse_prompt_spec,
     resolve_decoder_task,
 )
@@ -39,8 +40,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--config", required=True,
                    help="registry config name (a decoder-family preset)")
-    p.add_argument("--checkpoint-dir", required=True,
-                   help="orbax checkpoint dir (params-only restore)")
+    src_grp = p.add_mutually_exclusive_group(required=True)
+    src_grp.add_argument("--checkpoint-dir",
+                         help="orbax checkpoint dir (params-only restore)")
+    src_grp.add_argument("--init-from-hf",
+                         help="local HuggingFace checkpoint (llama-family "
+                              "or sparse-MoE) to serve directly")
     p.add_argument("--prompt", action="append", default=[],
                    metavar="IDS", help="comma-separated token ids; repeat "
                    "per request (lengths may differ — that is the point)")
@@ -89,7 +94,7 @@ def main(argv=None) -> int:
 
     from tensorflow_train_distributed_tpu.serving import ServingEngine
 
-    _, cfg, _ = resolve_decoder_task(args.config, "serving")
+    _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
 
     reqs = [{"prompt": parse_prompt_spec(spec), "max_new": args.max_new}
             for spec in args.prompt]
@@ -165,7 +170,7 @@ def main(argv=None) -> int:
                              "decoder")
         draft_params = _restore_params(args.speculative_draft_checkpoint)
 
-    params = _restore_params(args.checkpoint_dir)
+    cfg, params = load_decoder_params(args, cfg, is_moe)
     quant_scales = draft_quant_scales = None
     if args.quant == "int8":
         from tensorflow_train_distributed_tpu.models.quant import (
