@@ -203,6 +203,15 @@ def import_llama_state_dict(state_dict, config: LlamaConfig) -> dict:
             f"represent ({biases[0]}, ...); qkv_bias=True covers "
             "q/k/v biases only (the Qwen2 convention) — anything else "
             "would be silently dropped")
+    if allowed and "model.layers.0.self_attn.q_proj.bias" not in sd:
+        # The symmetric boundary check: a bias-free checkpoint (e.g.
+        # plain Llama weights under the qwen25_7b preset) would
+        # otherwise die with an opaque KeyError mid-mapping.
+        raise ValueError(
+            "config sets qkv_bias=True (the Qwen2 convention) but the "
+            "checkpoint has no q/k/v projection biases "
+            "(model.layers.0.self_attn.q_proj.bias is absent) — import "
+            "with qkv_bias=False or use a matching config/preset")
     params = {
         "token_embed": {"embedding": embed},
         "final_norm": {"scale": _np(sd["model.norm.weight"])},
@@ -363,27 +372,39 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
 
         model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
     _validate_hf_llama_family(model_or_path.config)  # every path
-    if config is not None:
-        # The rope-scaling rule is the CHECKPOINT's, not the preset's:
-        # base Llama-3 weights under a llama31 preset (or 3.1 weights
-        # under a scaling-less config — identical shapes either way)
-        # would apply frequencies the weights were never trained with,
-        # silently changing logits at every position.
-        rs = getattr(model_or_path.config, "rope_scaling", None)
-        want = ((float(rs["factor"]), float(rs["low_freq_factor"]),
-                 float(rs["high_freq_factor"]),
-                 int(rs["original_max_position_embeddings"]))
-                if rs else None)
-        have = getattr(config, "rope_scaling", None)
-        if want != have:
-            raise ValueError(
-                f"config rope_scaling={have} but the checkpoint says "
-                f"{want} — the checkpoint's convention wins; use a "
-                "matching config/preset")
     if config is None:
         config = config_from_hf(model_or_path.config)
     if config_overrides:
         config = dataclasses.replace(config, **config_overrides)
+    # Checkpoint-vs-config guards run on the FINAL config — after
+    # ``config_overrides`` — so an override can neither bypass them
+    # (e.g. ``rope_scaling=None`` on a matching preset) nor trip them
+    # when it brings the config INTO agreement with the checkpoint.
+    # The rope-scaling rule is the CHECKPOINT's, not the preset's:
+    # base Llama-3 weights under a llama31 preset (or 3.1 weights
+    # under a scaling-less config — identical shapes either way)
+    # would apply frequencies the weights were never trained with,
+    # silently changing logits at every position.
+    rs = getattr(model_or_path.config, "rope_scaling", None)
+    want = ((float(rs["factor"]), float(rs["low_freq_factor"]),
+             float(rs["high_freq_factor"]),
+             int(rs["original_max_position_embeddings"]))
+            if rs else None)
+    have = getattr(config, "rope_scaling", None)
+    if want != have:
+        raise ValueError(
+            f"config rope_scaling={have} but the checkpoint says "
+            f"{want} — the checkpoint's convention wins; use a "
+            "matching config/preset")
+    # Same rule for the norm epsilon: shape-invisible, so a preset
+    # left at the family default (1e-5 vs Qwen2.5's 1e-6) would import
+    # into silently-different logits.
+    hf_eps = getattr(model_or_path.config, "rms_norm_eps", None)
+    if hf_eps is not None and float(hf_eps) != float(config.rms_epsilon):
+        raise ValueError(
+            f"config rms_epsilon={config.rms_epsilon} but the "
+            f"checkpoint says rms_norm_eps={hf_eps} — the checkpoint's "
+            "convention wins; use a matching config/preset")
     params = import_llama_state_dict(model_or_path.state_dict(), config)
     return config, params
 
@@ -727,6 +748,13 @@ def import_qwen2_moe(model_or_path, config=None, **config_overrides):
             config = dataclasses.replace(
                 config, norm_topk_prob=bool(
                     getattr(hf, "norm_topk_prob", False)))
+        if ("rms_epsilon" not in config_overrides
+                and getattr(hf, "rms_norm_eps", None) is not None):
+            # The norm epsilon is the checkpoint's too — shape-
+            # invisible, so a preset left at the family default (1e-5
+            # vs Qwen's 1e-6) would silently change every forward.
+            config = dataclasses.replace(
+                config, rms_epsilon=float(hf.rms_norm_eps))
     if config_overrides:
         config = dataclasses.replace(config, **config_overrides)
     params = import_qwen2_moe_state_dict(model_or_path.state_dict(),

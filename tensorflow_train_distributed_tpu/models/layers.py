@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.ops.attention import (
     multihead_attention_kernel,
 )
@@ -32,7 +33,7 @@ Dtype = Any
 
 def _active_mesh(axis: str):
     """The ambient (abstract) mesh if it shards ``axis``, else None."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or mesh.empty or mesh.shape.get(axis, 1) <= 1:
         return None
     return mesh

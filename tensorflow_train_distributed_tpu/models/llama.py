@@ -26,6 +26,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.models import layers as L
 from tensorflow_train_distributed_tpu.ops.losses import (
     fold_sample_weight, softmax_cross_entropy,
@@ -155,7 +156,7 @@ LLAMA_PRESETS = {
                              num_layers=28, num_heads=28,
                              num_kv_heads=4, ffn_size=18_944,
                              max_positions=32_768, rope_base=1e6,
-                             qkv_bias=True),
+                             rms_epsilon=1e-6, qkv_bias=True),
     # Gemma-1 shapes: decoupled 256-wide heads, sqrt(d) embed scale,
     # GeGLU, zero-centered norms, tied embeddings (import maps the tied
     # head automatically).  2b is MQA (kv=1).
@@ -379,7 +380,7 @@ def _pipeline_mesh(cfg: LlamaConfig):
     """The ambient mesh when the gpipe path is requested and usable."""
     if not (cfg.pipeline_microbatches and cfg.scan_layers):
         return None
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or mesh.empty or mesh.shape.get("pipeline", 1) <= 1:
         return None
     return mesh

@@ -36,6 +36,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.models import layers as L
 from tensorflow_train_distributed_tpu.ops.losses import (
     fold_sample_weight, softmax_cross_entropy,
@@ -118,6 +119,7 @@ MOE_PRESETS = {
         num_kv_heads=16, ffn_size=1408, num_experts=60, top_k=4,
         capacity_factor=15.0,  # E/k — the no-drop HF-parity setting
         max_positions=8192, rope_base=1_000_000.0,
+        rms_epsilon=1e-6,
         shared_expert_size=5632, shared_expert_gate=True,
         norm_topk_prob=False, qkv_bias=True),
     # DeepSeek/Qwen-MoE-style: always-on shared expert beside the
@@ -344,7 +346,9 @@ class _GmmExperts(nn.Module):
                 flat, top_e, gate_w, e, wi_gate, wi_up, wo,
                 dtype=self.dtype, interpret=interpret)
 
-        from jax import shard_map
+        from tensorflow_train_distributed_tpu.runtime.compat import (
+            shard_map,
+        )
         from jax.sharding import PartitionSpec as P
 
         from tensorflow_train_distributed_tpu.runtime.mesh import (
@@ -515,7 +519,7 @@ class MoEMlpBlock(nn.Module):
         # routes the compute through the shard_map formulation (each
         # data shard sorts locally, each expert shard computes its own
         # experts via group_offset, one psum assembles).
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = compat.get_abstract_mesh()
         ep_mesh = None
         if (mesh is not None and not mesh.empty
                 and mesh.shape.get("expert", 1) > 1):

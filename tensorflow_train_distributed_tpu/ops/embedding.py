@@ -33,7 +33,8 @@ from typing import Any, Mapping, Optional, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tensorflow_train_distributed_tpu.runtime import compat
+from tensorflow_train_distributed_tpu.runtime.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -130,7 +131,7 @@ def sharded_lookup(
 
 
 def _ambient_mesh(table_axis: str):
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or mesh.empty or mesh.shape.get(table_axis, 1) <= 1:
         return None
     return mesh

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+from tensorflow_train_distributed_tpu.runtime import compat
 import jax.numpy as jnp
 import optax
 
@@ -45,7 +46,7 @@ def _fused_ce_usable() -> bool:
     sharded (one pallas_call would gather full logits per device)."""
     if jax.default_backend() != "tpu":
         return False
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is not None and not mesh.empty and mesh.shape.get("tensor", 1) > 1:
         return False
     return True

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from tensorflow_train_distributed_tpu.runtime.compat import axis_size, shard_map
 
 AxisNames = str | Sequence[str]
 
@@ -87,7 +87,7 @@ def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     its block to the next neighbour over ICI while computing on the current
     one.
     """
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 

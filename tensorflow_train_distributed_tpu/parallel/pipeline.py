@@ -31,7 +31,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tensorflow_train_distributed_tpu.runtime.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -70,7 +70,7 @@ def pipeline_stages(
     params = (jax.tree.map(lambda x: x[0], stage_params)
               if unstack_params else stage_params)
     stage = jax.lax.axis_index(axis)
-    num_stages = jax.lax.axis_size(axis)
+    num_stages = axis_size(axis)
     leaves = jax.tree.leaves(microbatches)
     num_micro = leaves[0].shape[0]
     ticks = num_pipeline_ticks(num_micro, num_stages)

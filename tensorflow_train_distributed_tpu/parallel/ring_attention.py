@@ -32,7 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tensorflow_train_distributed_tpu.runtime.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tensorflow_train_distributed_tpu.parallel.collectives import (
@@ -76,7 +76,7 @@ def ring_attention(
     hops instead of n-1 — at 32k over 8 shards with a 4k window, 1 hop
     instead of 7 (7× less ICI for attention).
     """
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -208,7 +208,7 @@ def ulysses_attention(
         multihead_attention_kernel,
     )
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     h = q.shape[1]
     if h % n:
         raise ValueError(

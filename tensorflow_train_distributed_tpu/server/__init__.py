@@ -1,0 +1,29 @@
+"""Online serving gateway: HTTP frontend over the continuous-batching
+engine (the scheduling/frontend layer ``serving.ServingEngine`` is the
+compute layer of).
+
+- ``server.driver`` — the engine-owning background thread + thread-safe
+  submission bridge (futures, bounded admission, deadlines, streaming);
+- ``server.gateway`` — stdlib threaded HTTP frontend
+  (``/v1/generate``, ``/healthz``, ``/metrics``) and drain lifecycle;
+- ``server.metrics`` — stdlib Prometheus text-format registry.
+
+Launcher: ``tools/serve_http.py``; load generator:
+``tools/bench_gateway.py``.
+"""
+
+from tensorflow_train_distributed_tpu.server.driver import (  # noqa: F401
+    AdmissionFull,
+    DeadlineExceeded,
+    Draining,
+    EngineDriver,
+    RequestError,
+    RequestHandle,
+)
+from tensorflow_train_distributed_tpu.server.gateway import (  # noqa: F401
+    ServingGateway,
+)
+from tensorflow_train_distributed_tpu.server.metrics import (  # noqa: F401
+    GatewayMetrics,
+    Registry,
+)

@@ -1,0 +1,322 @@
+"""Engine driver: the thread-safe submission bridge over ServingEngine.
+
+The engine is a single-threaded object (host loop + jitted programs
+keyed on the instance); the gateway is many handler threads.  This
+module is the ONE place they meet: a background driver thread owns
+every mutating engine call, handler threads hand it work through a
+bounded admission deque and get a ``RequestHandle`` (a future) back.
+Between decode chunks — ``ServingEngine.serve_step()`` hands control
+back exactly for this — the driver refills the engine's queue from
+admissions, resolves finished requests, streams newly committed tokens,
+and enforces per-request deadlines (``engine.cancel`` frees the slot).
+No device code runs anywhere else, so the bridge composes with every
+engine configuration (sampling, int8, speculative, TP meshes) untouched.
+
+Load shedding happens at ``submit()``: requests waiting for a slot
+(admitted here + queued inside the engine) are capped at ``max_queue``;
+beyond it ``AdmissionFull`` tells the frontend to answer 429 with a
+Retry-After.  Draining flips one flag: new submissions get
+``Draining`` (503) while in-flight work finishes normally.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()          # stream sentinel: request finished cleanly
+
+
+class RequestError(ValueError):
+    """Bad request payload (HTTP 400)."""
+
+
+class AdmissionFull(RuntimeError):
+    """Admission queue at capacity — shed (HTTP 429)."""
+
+    def __init__(self, waiting: int, retry_after_s: float):
+        super().__init__(f"admission queue full ({waiting} waiting); "
+                         f"retry after {retry_after_s:g}s")
+        self.retry_after_s = retry_after_s
+
+
+class Draining(RuntimeError):
+    """Gateway is draining — not admitting (HTTP 503)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before completion (HTTP 504)."""
+
+
+class RequestHandle:
+    """Caller's future for one submitted request.
+
+    ``result()`` blocks for the full token list (prompt + generated,
+    the serve.py convention).  With ``stream=True``, ``iter_tokens()``
+    yields lists of GENERATED tokens as the driver commits them
+    (chunk-granular) and raises the terminal error, if any, at the end
+    — exactly one of the two accessors should be used per request.
+    """
+
+    def __init__(self, req_id: int, prompt: list, max_new: int,
+                 seed: Optional[int], stream: bool,
+                 deadline: Optional[float]):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.seed = seed
+        self.stream = stream
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self._streamed = len(prompt)    # tokens already pushed/known
+        self._queue: Optional[queue.Queue] = (
+            queue.Queue() if stream else None)
+        self._done = threading.Event()
+        self._tokens: Optional[list] = None
+        self._error: Optional[BaseException] = None
+
+    # -- driver side -----------------------------------------------------
+
+    def _push_new(self, tokens: list) -> int:
+        """Stream tokens beyond what was already pushed; returns how
+        many were new (the driver's token-counter feed)."""
+        new = tokens[self._streamed:]
+        if new and self._queue is not None:
+            self._queue.put(list(new))
+        self._streamed = len(tokens)
+        return len(new)
+
+    def _resolve(self, tokens: Optional[list],
+                 error: Optional[BaseException]) -> None:
+        self._tokens, self._error = tokens, error
+        if self._queue is not None:
+            self._queue.put(error if error is not None else _DONE)
+        self._done.set()
+
+    # -- caller side -----------------------------------------------------
+
+    def result(self, timeout: Optional[float] = None) -> list:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def iter_tokens(self):
+        """Yield lists of generated tokens until the request finishes."""
+        if self._queue is None:
+            raise RuntimeError("request was not submitted with stream=True")
+        while True:
+            item = self._queue.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class EngineDriver:
+    """Background thread owning a ``ServingEngine``; concurrent-safe
+    ``submit()`` for everyone else.
+
+    ``validate``: optional callable ``(prompt, max_new, seed) -> None``
+    raising ``RequestError`` — the CLI hangs vocab screening
+    (``check_vocab_ids``) here so the library stays tokenizer-agnostic.
+    ``metrics``: a ``GatewayMetrics`` (optional — the driver works bare
+    for library use/tests).
+    """
+
+    def __init__(self, engine, *, max_queue: int = 64,
+                 validate: Optional[Callable] = None,
+                 metrics=None, default_timeout_s: Optional[float] = None,
+                 retry_after_s: float = 1.0):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._engine = engine
+        self._validate = validate
+        self._metrics = metrics
+        self._max_queue = max_queue
+        self._default_timeout_s = default_timeout_s
+        self._retry_after_s = retry_after_s
+        self._cv = threading.Condition()
+        self._admit: deque = deque()       # RequestHandles not yet in engine
+        self._inflight: dict = {}          # engine rid -> RequestHandle
+        self._next_id = 0
+        self._draining = False
+        self._failed: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="engine-driver", daemon=True)
+
+    # -- public api ------------------------------------------------------
+
+    def start(self) -> "EngineDriver":
+        self._thread.start()
+        return self
+
+    def set_metrics(self, metrics) -> None:
+        """Late wiring: the gateway builds GatewayMetrics from THIS
+        driver's occupancy callables, so the driver exists first."""
+        self._metrics = metrics
+
+    def waiting(self) -> int:
+        """Requests admitted but not yet decoding (the shed gauge):
+        driver-side admissions plus the engine's own queue."""
+        return len(self._admit) + self._engine.queue_depth()
+
+    def active_slots(self) -> int:
+        return self._engine.active_slots()
+
+    def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
+               stream: bool = False,
+               timeout_s: Optional[float] = None) -> RequestHandle:
+        """Admit one request; raises ``RequestError`` (bad payload),
+        ``AdmissionFull`` (shed), or ``Draining``.  Safe from any
+        thread: only read-only engine calls happen here."""
+        if self._validate is not None:
+            self._validate(prompt, max_new, seed)
+        try:
+            prompt = self._engine.validate_request(prompt, max_new, seed)
+        except ValueError as e:
+            raise RequestError(str(e))
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
+        if timeout_s is not None and timeout_s <= 0:
+            raise RequestError(f"timeout_s must be > 0, got {timeout_s}")
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        with self._cv:
+            if self._failed is not None:
+                raise RuntimeError(
+                    f"engine driver failed: {self._failed!r}")
+            if self._draining:
+                raise Draining("gateway is draining; not admitting")
+            if self.waiting() >= self._max_queue:
+                raise AdmissionFull(self.waiting(), self._retry_after_s)
+            handle = RequestHandle(self._next_id, prompt, max_new, seed,
+                                   stream, deadline)
+            self._next_id += 1
+            self._admit.append(handle)
+            self._cv.notify()
+        return handle
+
+    def abandon(self, handle: RequestHandle) -> None:
+        """Give up on a live request (streaming client disconnected):
+        collapse its deadline to now, so the driver's next sweep cancels
+        it and frees the slot instead of decoding to ``max_new`` for
+        nobody.  A plain attribute write — atomic, and the driver only
+        ever compares it against the clock — so no lock is needed."""
+        handle.deadline = time.monotonic()
+
+    def is_draining(self) -> bool:
+        with self._cv:
+            return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight and already-admitted requests run
+        to completion.  Idempotent."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Drain and wait for the driver thread to finish its backlog."""
+        self.drain()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # -- driver loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while not (self._admit or self._inflight
+                               or self._draining):
+                        self._cv.wait()
+                    if (self._draining and not self._admit
+                            and not self._inflight):
+                        return
+                    self._admit_pending()
+                    if not self._inflight:
+                        continue      # everything expired at admission
+                done = self._engine.serve_step()
+                self._harvest(done)
+        except BaseException as e:      # noqa: BLE001 — fail loudly
+            logger.exception("engine driver loop died")
+            with self._cv:
+                self._failed = e
+                pending = list(self._admit) + list(self._inflight.values())
+                self._admit.clear()
+                self._inflight.clear()
+            for handle in pending:
+                self._count("error")
+                handle._resolve(None, RuntimeError(
+                    f"engine driver failed: {e!r}"))
+
+    def _admit_pending(self) -> None:
+        """Move admitted requests into the engine (driver thread only,
+        under the lock — the ONE place engine.submit is called)."""
+        now = time.monotonic()
+        while self._admit:
+            handle = self._admit.popleft()
+            if handle.deadline is not None and now >= handle.deadline:
+                self._expire(handle)
+                continue
+            try:
+                rid = self._engine.submit(handle.prompt, handle.max_new,
+                                          seed=handle.seed)
+            except ValueError as e:
+                # validate_request screened already; a late preload
+                # could still shift the bucket rule — report, don't die.
+                self._count("invalid")
+                handle._resolve(None, RequestError(str(e)))
+                continue
+            self._inflight[rid] = handle
+
+    def _harvest(self, done: dict) -> None:
+        """Resolve finished requests, stream fresh tokens, sweep
+        deadlines (driver thread only)."""
+        now = time.monotonic()
+        snapshot = self._engine.snapshot()
+        for rid, handle in list(self._inflight.items()):
+            tokens = done.get(rid)
+            finished = tokens is not None
+            if not finished:
+                tokens = snapshot.get(rid)
+            if tokens is not None and len(tokens) > len(handle.prompt):
+                if handle.first_token_at is None:
+                    handle.first_token_at = now
+                    if self._metrics is not None:
+                        self._metrics.ttft.observe(now - handle.t_submit)
+                fresh = handle._push_new(tokens)
+                if fresh and self._metrics is not None:
+                    self._metrics.tokens.inc(fresh)
+            if finished:
+                del self._inflight[rid]
+                self._count("ok")
+                if self._metrics is not None:
+                    self._metrics.latency.observe(now - handle.t_submit)
+                handle._resolve(tokens, None)
+            elif handle.deadline is not None and now >= handle.deadline:
+                self._engine.cancel(rid)
+                del self._inflight[rid]
+                self._expire(handle)
+
+    def _expire(self, handle: RequestHandle) -> None:
+        self._count("expired")
+        handle._resolve(None, DeadlineExceeded(
+            f"request {handle.id} exceeded its deadline"))
+
+    def _count(self, status: str) -> None:
+        if self._metrics is not None:
+            self._metrics.requests.inc(label_value=status)

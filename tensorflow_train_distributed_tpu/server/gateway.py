@@ -1,0 +1,316 @@
+"""Threaded HTTP gateway over the continuous-batching engine.
+
+The online frontend the offline ``tools/serve.py`` is not: a stdlib
+``ThreadingHTTPServer`` where every connection's handler thread hands
+work to the single engine-owning driver (``server.driver``) and blocks
+on its future — requests are accepted WHILE the engine decodes, and
+responses carry exactly serve.py's token convention, so the same
+request set answers byte-identically online and offline.
+
+Endpoints:
+- ``POST /v1/generate`` — body ``{"prompt": [ids], "max_new": N,
+  "seed": S?, "stream": bool?, "timeout_s": F?}``; reply ``{"id",
+  "prompt", "tokens"}`` (tokens = prompt + generated).  With
+  ``stream`` true the reply is chunked NDJSON: ``{"id"}`` first, then
+  ``{"tokens": [...]}`` per committed decode chunk, then
+  ``{"done": true}`` (or ``{"error", "status"}`` terminally).
+- ``GET /healthz`` — ``{"status": "ok"|"draining", ...occupancy}``;
+  503 while draining (load balancers stop routing before shutdown).
+- ``GET /metrics`` — Prometheus text (``server.metrics`` names).
+
+Robustness shell: bounded admission (429 + Retry-After via
+``AdmissionFull``), per-request deadlines (504; the driver frees the
+slot), 400 on malformed payloads, and graceful drain on SIGTERM —
+stop admitting, finish in-flight, flush a final metrics snapshot to the
+log, stop the listener.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tensorflow_train_distributed_tpu.server.driver import (
+    AdmissionFull,
+    DeadlineExceeded,
+    Draining,
+    EngineDriver,
+    RequestError,
+)
+from tensorflow_train_distributed_tpu.server.metrics import GatewayMetrics
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 1 << 20          # requests are token-id lists; 1 MiB
+#                                   bounds hostile/bogus payloads
+
+
+class _GatewayHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # Restarts must not wait out TIME_WAIT on the drained port.
+    allow_reuse_address = True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Keep-alive + chunked streaming need 1.1 framing.
+    protocol_version = "HTTP/1.1"
+    server: socketserver.BaseServer   # set by http.server
+
+    @property
+    def gateway(self) -> "ServingGateway":
+        return self.server.gateway    # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):          # noqa: A003
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    # -- plumbing --------------------------------------------------------
+
+    def _reply_json(self, code: int, obj: dict,
+                    headers: Optional[dict] = None) -> None:
+        body = (json.dumps(obj) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            self.send_header("Connection", "close")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _chunk(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+    # -- routes ----------------------------------------------------------
+
+    def do_GET(self):                           # noqa: N802
+        if self.path == "/healthz":
+            gw = self.gateway
+            draining = gw.draining
+            self._reply_json(503 if draining else 200, {
+                "status": "draining" if draining else "ok",
+                "queue_depth": gw.driver.waiting(),
+                "slots_in_use": gw.driver.active_slots(),
+                "slots_total": gw.engine.slots,
+            })
+        elif self.path == "/metrics":
+            body = self.gateway.metrics.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):                          # noqa: N802
+        if self.path != "/v1/generate":
+            # Body never read: close, or its bytes would be parsed as
+            # the keep-alive connection's next request line.
+            self.close_connection = True
+            self._reply_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            req = self._parse_body()
+        except RequestError as e:
+            self.gateway.metrics.requests.inc(label_value="invalid")
+            self._reply_json(400, {"error": str(e)})
+            return
+        try:
+            handle = self.gateway.driver.submit(
+                req["prompt"], req["max_new"], seed=req.get("seed"),
+                stream=req["stream"], timeout_s=req.get("timeout_s"))
+        except RequestError as e:
+            # submit() counted nothing yet for payload rejections —
+            # they never reach the driver's terminal accounting.
+            self.gateway.metrics.requests.inc(label_value="invalid")
+            self._reply_json(400, {"error": str(e)})
+            return
+        except AdmissionFull as e:
+            self.gateway.metrics.requests.inc(label_value="shed")
+            self._reply_json(
+                429, {"error": str(e)},
+                headers={"Retry-After":
+                         f"{max(1, round(e.retry_after_s))}"})
+            return
+        except Draining as e:
+            self._reply_json(503, {"error": str(e)},
+                             headers={"Retry-After": "5"})
+            return
+        except RuntimeError as e:
+            # Driver thread died: answer 500 instead of dropping the
+            # socket (submit() refuses everything once failed).
+            self.gateway.metrics.requests.inc(label_value="error")
+            self._reply_json(500, {"error": str(e)})
+            return
+        if req["stream"]:
+            self._stream_response(handle)
+        else:
+            self._block_response(handle)
+
+    def _parse_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > MAX_BODY_BYTES:
+            # Rejecting WITHOUT reading the body leaves its bytes in
+            # the keep-alive buffer to be misparsed as the next request
+            # line — close instead of draining an unbounded body.
+            self.close_connection = True
+        if length <= 0:
+            raise RequestError("missing request body")
+        if length > MAX_BODY_BYTES:
+            raise RequestError(
+                f"body of {length} bytes exceeds {MAX_BODY_BYTES}")
+        raw = self.rfile.read(length)
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise RequestError(f"body is not JSON: {e}")
+        if not isinstance(obj, dict):
+            raise RequestError("body must be a JSON object")
+
+        def _int(v, what):
+            # Mirror serve.py's request-file rule: bools and floats
+            # must not silently pass for token counts.
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise RequestError(f"{what} must be an integer")
+            return v
+
+        prompt = obj.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            raise RequestError("'prompt' must be a non-empty list of ids")
+        prompt = [_int(t, "token ids") for t in prompt]
+        max_new = _int(obj.get("max_new",
+                               self.gateway.default_max_new), "max_new")
+        out = {"prompt": prompt, "max_new": max_new,
+               "stream": bool(obj.get("stream", False))}
+        if "seed" in obj:
+            out["seed"] = _int(obj["seed"], "seed")
+        if "timeout_s" in obj:
+            t = obj["timeout_s"]
+            if not isinstance(t, (int, float)) or isinstance(t, bool) \
+                    or not t > 0:
+                raise RequestError("timeout_s must be a positive number")
+            out["timeout_s"] = float(t)
+        return out
+
+    def _block_response(self, handle) -> None:
+        try:
+            tokens = handle.result()
+        except DeadlineExceeded as e:
+            self._reply_json(504, {"error": str(e)})
+            return
+        except Exception as e:          # noqa: BLE001 — driver failure
+            self._reply_json(500, {"error": str(e)})
+            return
+        self._reply_json(200, {"id": handle.id, "prompt": handle.prompt,
+                               "tokens": tokens})
+
+    def _stream_response(self, handle) -> None:
+        self.close_connection = True
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self._chunk({"id": handle.id})
+            try:
+                for tokens in handle.iter_tokens():
+                    self._chunk({"tokens": tokens})
+                self._chunk({"done": True})
+            except DeadlineExceeded as e:
+                self._chunk({"error": str(e), "status": 504})
+            except Exception as e:      # noqa: BLE001
+                self._chunk({"error": str(e), "status": 500})
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            # Client went away mid-stream: stop writing and free the
+            # request's slot instead of decoding to max_new for nobody.
+            self.gateway.driver.abandon(handle)
+
+
+class ServingGateway:
+    """Engine + driver + HTTP listener, one lifecycle.
+
+    ``validate`` is threaded through to the driver (the CLI's
+    ``check_vocab_ids`` hook); ``port=0`` binds an ephemeral port
+    (tests), readable from ``.port`` after construction.
+    """
+
+    def __init__(self, engine, *, host: str = "127.0.0.1",
+                 port: int = 8000, max_queue: int = 64,
+                 default_timeout_s: Optional[float] = None,
+                 default_max_new: int = 32, validate=None,
+                 retry_after_s: float = 1.0):
+        self.engine = engine
+        self.default_max_new = default_max_new
+        self.driver = EngineDriver(
+            engine, max_queue=max_queue, validate=validate,
+            default_timeout_s=default_timeout_s,
+            retry_after_s=retry_after_s)
+        self.metrics = GatewayMetrics(
+            queue_depth_fn=self.driver.waiting,
+            slots_in_use_fn=self.driver.active_slots,
+            slots_total=engine.slots)
+        self.driver.set_metrics(self.metrics)
+        self._httpd = _GatewayHTTPServer((host, port), _Handler)
+        self._httpd.gateway = self    # type: ignore[attr-defined]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gateway-http",
+            daemon=True)
+        self._stopped = threading.Event()
+
+    @property
+    def draining(self) -> bool:
+        """Single source of truth is the driver's flag, so /healthz
+        flips to 503 even when library code calls ``driver.drain()``
+        directly instead of ``ServingGateway.drain()``."""
+        return self.driver.is_draining()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ServingGateway":
+        self.driver.start()
+        self._http_thread.start()
+        logger.info("gateway listening on %s:%d",
+                    self._httpd.server_address[0], self.port)
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: flip /healthz to draining, stop admitting
+        (503/429 paths stay answerable), finish in-flight requests,
+        flush a final metrics snapshot to the log, stop the listener.
+        Returns True when the backlog fully drained."""
+        self.driver.drain()
+        drained = self.driver.join(timeout)
+        logger.info("gateway drained=%s; final metrics:\n%s",
+                    drained, self.metrics.render())
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._stopped.set()
+        return drained
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)) -> None:
+        """SIGTERM/SIGINT → drain (from a helper thread: handlers must
+        return fast, and drain() waits on in-flight decode)."""
+        def _on_signal(signum, frame):
+            logger.info("signal %d: draining", signum)
+            threading.Thread(target=self.drain, name="gateway-drain",
+                             daemon=True).start()
+
+        for s in signals:
+            signal.signal(s, _on_signal)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the gateway is stopped (the CLI's main thread)."""
+        return self._stopped.wait(timeout)

@@ -1,0 +1,220 @@
+"""Stdlib Prometheus metrics for the serving gateway.
+
+The scrape surface of ``server.gateway`` (``GET /metrics``): counters,
+gauges, and cumulative-bucket histograms rendered in the Prometheus
+text exposition format (0.0.4) — no client library in this image, and
+the needed subset is small enough that baking one in would be pure
+dependency weight.  Everything is threading.Lock-guarded: the HTTP
+frontend observes from handler threads while the engine driver observes
+from its own loop, and a scrape may land mid-update.
+
+Conventions (the names README documents):
+- counters end in ``_total``;
+- histograms expose ``_bucket{le=...}`` (cumulative, ``+Inf`` last),
+  ``_sum`` and ``_count`` — quantiles are the scraper's job (PromQL
+  ``histogram_quantile``), keeping the server side O(buckets);
+- gauges may be backed by a callable, sampled AT SCRAPE TIME, so queue
+  depth / slot occupancy never need a writer to stay fresh.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Optional, Sequence
+
+# Prometheus's default latency ladder, extended to 60 s: a serving
+# deadline default lives in seconds-to-a-minute territory and a bucket
+# past it keeps the histogram's tail observable.
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare (no exponent)."""
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels(pairs: dict) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(pairs.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter, optionally split by ONE label (``status``)."""
+
+    def __init__(self, name: str, help_: str, label: Optional[str] = None):
+        self.name, self.help, self.label = name, help_, label
+        self._lock = threading.Lock()
+        self._values: dict = {}          # label value (or None) -> float
+
+    def inc(self, n: float = 1, label_value: Optional[str] = None) -> None:
+        if n < 0:
+            raise ValueError(f"counters only go up, got {n}")
+        if (label_value is None) != (self.label is None):
+            raise ValueError(f"{self.name}: label mismatch "
+                             f"(declared {self.label!r})")
+        with self._lock:
+            self._values[label_value] = self._values.get(label_value, 0) + n
+
+    def value(self, label_value: Optional[str] = None) -> float:
+        with self._lock:
+            return self._values.get(label_value, 0)
+
+    def render(self) -> list:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items(),
+                           key=lambda kv: kv[0] or "")
+            if not items:
+                items = [(None, 0)]
+            for lv, v in items:
+                lab = _labels({self.label: lv} if lv is not None else {})
+                lines.append(f"{self.name}{lab} {_fmt(v)}")
+        return lines
+
+
+class Gauge:
+    """Set-anytime value, or a callable sampled at scrape time."""
+
+    def __init__(self, name: str, help_: str,
+                 fn: Optional[Callable[[], float]] = None):
+        self.name, self.help, self._fn = name, help_, fn
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        with self._lock:
+            return self._value
+
+    def render(self) -> list:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(self.value())}"]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (observe in seconds)."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != len(
+                tuple(buckets)):
+            raise ValueError(f"{name}: buckets must be sorted and unique")
+        self.name, self.help = name, help_
+        self._uppers = tuple(buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self._uppers) + 1)   # last = +Inf
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            for i, u in enumerate(self._uppers):
+                if v <= u:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def render(self) -> list:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            acc = 0
+            for u, c in zip(self._uppers + (math.inf,), self._counts):
+                acc += c
+                lines.append(
+                    f'{self.name}_bucket{{le="{_fmt(u)}"}} {acc}')
+            lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+            lines.append(f"{self.name}_count {acc}")
+        return lines
+
+
+class Registry:
+    """Ordered metric collection → one scrape body."""
+
+    def __init__(self):
+        self._metrics: list = []
+
+    def counter(self, name, help_, label=None) -> Counter:
+        return self._add(Counter(name, help_, label))
+
+    def gauge(self, name, help_, fn=None) -> Gauge:
+        return self._add(Gauge(name, help_, fn))
+
+    def histogram(self, name, help_, buckets=LATENCY_BUCKETS) -> Histogram:
+        return self._add(Histogram(name, help_, buckets))
+
+    def _add(self, m):
+        if any(x.name == m.name for x in self._metrics):
+            raise ValueError(f"duplicate metric {m.name}")
+        self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class GatewayMetrics:
+    """The gateway's full scrape surface, wired in one place so the
+    driver and the HTTP frontend share instances (and README's metric
+    list has a single source of truth).
+
+    ``ttd_gateway_requests_total{status=...}`` statuses: ``ok``
+    (served), ``shed`` (admission queue full → 429), ``invalid``
+    (rejected body/ids → 400), ``expired`` (deadline freed the slot →
+    504), ``error`` (internal failure → 500).
+    """
+
+    def __init__(self, queue_depth_fn: Callable[[], int],
+                 slots_in_use_fn: Callable[[], int], slots_total: int):
+        self.registry = Registry()
+        r = self.registry
+        self.requests = r.counter(
+            "ttd_gateway_requests_total",
+            "Requests by terminal status (ok|shed|invalid|expired|error).",
+            label="status")
+        self.tokens = r.counter(
+            "ttd_gateway_tokens_generated_total",
+            "Generated (non-prompt) tokens committed to responses.")
+        self.queue_depth = r.gauge(
+            "ttd_gateway_queue_depth",
+            "Admitted requests waiting for a slot.", fn=queue_depth_fn)
+        self.slots_in_use = r.gauge(
+            "ttd_gateway_slots_in_use",
+            "Engine slots currently decoding.", fn=slots_in_use_fn)
+        self.slots_total = r.gauge(
+            "ttd_gateway_slots_total", "Engine slot capacity.")
+        self.slots_total.set(slots_total)
+        self.ttft = r.histogram(
+            "ttd_gateway_ttft_seconds",
+            "Submit-to-first-generated-token latency (chunk-granular: "
+            "tokens commit per decode chunk).")
+        self.latency = r.histogram(
+            "ttd_gateway_request_latency_seconds",
+            "Submit-to-completion latency per served request.")
+
+    def render(self) -> str:
+        return self.registry.render()

@@ -53,6 +53,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
     cast_floating,
@@ -287,7 +288,7 @@ class ServingEngine:
         stack = contextlib.ExitStack()
         stack.enter_context(sharding_lib.with_logical_rules(
             self._mesh, *(() if self._rules is None else (self._rules,))))
-        stack.enter_context(jax.set_mesh(self._mesh))
+        stack.enter_context(compat.set_mesh(self._mesh))
         return stack
 
     # -- jitted programs ---------------------------------------------------
@@ -486,13 +487,13 @@ class ServingEngine:
 
     # -- host-side loop ----------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int,
-               seed: Optional[int] = None) -> int:
-        """Enqueue a request; returns its id (resolved by ``run()``).
-
-        ``seed`` names the request's sampling stream (ignored under
-        greedy); default: the request id — distinct per request,
-        reproducible across identical engine sessions."""
+    def validate_request(self, prompt, max_new_tokens: int,
+                         seed: Optional[int] = None) -> list:
+        """All of ``submit()``'s checks WITHOUT enqueuing; returns the
+        normalized prompt (a list of ints).  Read-only, so the HTTP
+        gateway's handler threads can reject bad requests (400) before
+        handing admission to the single engine-owning driver thread —
+        the engine's mutating calls stay single-threaded."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if seed is not None and not 0 <= seed < 2 ** 32:
             # Catch at submit: an out-of-range seed would OverflowError
@@ -521,11 +522,47 @@ class ServingEngine:
                     f"prompt length {len(prompt)} (suffix {work} after "
                     f"the longest preloaded prefix) exceeds the largest "
                     f"prefill bucket {self.prompt_buckets[-1]}")
+        return prompt
+
+    def submit(self, prompt, max_new_tokens: int,
+               seed: Optional[int] = None) -> int:
+        """Enqueue a request; returns its id (resolved by ``run()``).
+
+        ``seed`` names the request's sampling stream (ignored under
+        greedy); default: the request id — distinct per request,
+        reproducible across identical engine sessions."""
+        prompt = self.validate_request(prompt, max_new_tokens, seed)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(
             (rid, prompt, max_new_tokens, rid if seed is None else seed))
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Abandon a live request: drop it from the queue, or free its
+        slot so the next refill reuses it (the gateway's deadline
+        lever).  A freed slot's cache rows go stale-but-invisible —
+        position masks hide them and the next ``_insert`` re-pins the
+        slot index, the same rule stale rows already obey between
+        ``run()`` cycles.  Returns False when the id is unknown or
+        already finished (its output, if any, stays harvestable)."""
+        for i, item in enumerate(self._queue):
+            if item[0] == request_id:
+                del self._queue[i]
+                return True
+        for slot, state in enumerate(self._slot_states):
+            if state is not None and state.request_id == request_id:
+                self._slot_states[slot] = None
+                return True
+        return False
+
+    def active_slots(self) -> int:
+        """Slots currently decoding a request (occupancy gauge)."""
+        return sum(s is not None for s in self._slot_states)
+
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet in a slot."""
+        return len(self._queue)
 
     def _fresh_cache(self, batch: int, draft: bool = False):
         """Zeroed cache tree for ``batch`` rows (target or draft model).

@@ -98,7 +98,10 @@ class CheckpointManager:
         # Metadata straight from the item dir: the manager's
         # ``item_metadata`` comes back None on a freshly opened manager
         # (handler registry only populates after a save/restore call).
-        meta = ocp.StandardCheckpointer().metadata(item_dir).item_metadata
+        # Old orbax returns the tree dict directly; new orbax wraps it
+        # in a CheckpointMetadata whose ``item_metadata`` is the tree.
+        meta = ocp.StandardCheckpointer().metadata(item_dir)
+        meta = getattr(meta, "item_metadata", meta)
         item = {}
         for name in names:
             try:

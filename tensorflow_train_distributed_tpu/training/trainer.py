@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.parallel import collectives
 from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
 from tensorflow_train_distributed_tpu.parallel.sharding import (
@@ -173,7 +174,7 @@ class Trainer:
             )
 
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
-                jax.set_mesh(self.mesh):
+                compat.set_mesh(self.mesh):
             abstract = jax.eval_shape(_create)
             shardings = sharding_lib.make_state_shardings(
                 self.mesh, abstract, self.rules
@@ -201,7 +202,7 @@ class Trainer:
         _create, abstract, shardings = self._abstract_state_and_shardings(
             sample_batch)
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
-                jax.set_mesh(self.mesh):
+                compat.set_mesh(self.mesh):
             self.state_shardings = shardings
             state = jax.jit(_create, out_shardings=self.state_shardings)()
         state = nn.unbox(state)
@@ -266,7 +267,7 @@ class Trainer:
         _, abstract, shardings = self._abstract_state_and_shardings(
             sample_batch)
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
-                jax.set_mesh(self.mesh):
+                compat.set_mesh(self.mesh):
             # Strip metadata boxes WITHOUT nn.unbox: unbox() applies
             # sharding constraints, which is illegal on abstract values.
             is_boxed = (lambda x:  # noqa: E731
@@ -441,7 +442,7 @@ class Trainer:
         jitted = jax.jit(step, donate_argnums=donate)
 
         def call(state, batch):
-            with jax.set_mesh(self.mesh):
+            with compat.set_mesh(self.mesh):
                 return jitted(state, batch)
 
         return call

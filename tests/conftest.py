@@ -22,7 +22,14 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; the XLA flag is read when
+    # the CPU backend initializes (lazily, after this line), so setting
+    # it here — even though jax is already imported — still applies.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 # Persistent XLA compilation cache: the suite is compile-bound on this
 # 1-core box (measured: an 11 s MoE create+compile+step re-runs in 2 s
 # warm), and test jit signatures are stable across runs — so repeat runs

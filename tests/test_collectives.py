@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tensorflow_train_distributed_tpu.runtime.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tensorflow_train_distributed_tpu.parallel import collectives as coll

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.ops.embedding import (
     EmbeddingCollection, FeatureSpec, TableSpec, sharded_lookup,
 )
@@ -87,7 +88,7 @@ class TestEmbeddingCollection:
     def test_shapes_and_table_sharing(self, mesh_2d):
         module = EmbeddingCollection(tables=TABLES, features=FEATURES)
         batch = self._batch()
-        with jax.set_mesh(mesh_2d):
+        with compat.set_mesh(mesh_2d):
             params = module.init(jax.random.key(0), batch)
             out = module.apply(params, batch)
         assert out["user"].shape == (4, 8)
@@ -102,7 +103,7 @@ class TestEmbeddingCollection:
         module = EmbeddingCollection(tables=TABLES, features=FEATURES)
         batch = self._batch()
         import flax.linen as nn
-        with jax.set_mesh(mesh_2d):
+        with compat.set_mesh(mesh_2d):
             params = nn.unbox(module.init(jax.random.key(0), batch))
             out = module.apply(params, batch)
         table = np.asarray(params["params"]["cats"])
@@ -122,7 +123,7 @@ class TestEmbeddingCollection:
         batch = self._batch()
         params = nn.unbox(module.init(jax.random.key(0), batch))
         plain = module.apply(params, batch)
-        with jax.set_mesh(mesh_2d):
+        with compat.set_mesh(mesh_2d):
             sharded = module.apply(params, batch)
         for k in plain:
             np.testing.assert_allclose(np.asarray(plain[k]),

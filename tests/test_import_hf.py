@@ -159,6 +159,63 @@ class TestImport:
         assert int(state.step) == 1
 
 
+class TestImportGuards:
+    """Checkpoint-vs-config guards on the config-passed route: they run
+    on the FINAL config (after ``config_overrides``) so an override can
+    neither bypass them nor trip them when it fixes the mismatch."""
+
+    def test_rms_epsilon_mismatch_rejected(self, hf_model):
+        import dataclasses
+
+        cfg = dataclasses.replace(config_from_hf(hf_model.config),
+                                  rms_epsilon=1e-6)   # checkpoint: 1e-5
+        with pytest.raises(ValueError, match="rms_norm_eps"):
+            import_llama(hf_model, config=cfg)
+
+    def test_rms_epsilon_override_brings_config_into_agreement(
+            self, hf_model):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        cfg = dataclasses.replace(config_from_hf(hf_model.config),
+                                  rms_epsilon=1e-6)
+        got, _ = import_llama(hf_model, config=cfg, rms_epsilon=1e-5,
+                              dtype=jnp.float32)
+        assert got.rms_epsilon == 1e-5
+
+    def test_rope_scaling_override_cannot_bypass_guard(self):
+        """import_llama(…, config=matching_cfg, rope_scaling=None) used
+        to pass the guard (which ran pre-override) and then silently
+        drop the checkpoint's llama3 frequency scaling."""
+        cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0,
+                          "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 64},
+        )
+        torch.manual_seed(1)
+        model = transformers.LlamaForCausalLM(cfg)
+        good = config_from_hf(model.config)
+        assert good.rope_scaling == (8.0, 1.0, 4.0, 64)
+        with pytest.raises(ValueError, match="rope_scaling"):
+            import_llama(model, config=good, rope_scaling=None)
+
+    def test_qkv_bias_config_on_biasfree_checkpoint_rejected(
+            self, hf_model):
+        """Descriptive boundary error, not a KeyError mid-mapping."""
+        import dataclasses
+
+        cfg = dataclasses.replace(config_from_hf(hf_model.config),
+                                  qkv_bias=True)
+        with pytest.raises(ValueError,
+                           match="no q/k/v projection biases"):
+            import_llama_state_dict(hf_model.state_dict(), cfg)
+
+
 class TestBertImport:
     """HF BertForMaskedLM → native BertEncoder, forward-parity vs torch."""
 
@@ -671,6 +728,23 @@ class TestQwen2MoeImport:
         dense_layers.mlp_only_layers = [0]
         with pytest.raises(ValueError, match="mlp_only_layers"):
             config_from_hf_qwen2_moe(dense_layers)
+
+    def test_config_passed_adopts_checkpoint_epsilon(self):
+        """The config-passed branch fixes up rms_epsilon from the
+        checkpoint like norm_topk_prob/capacity_factor — a preset left
+        at the family default would silently change every forward."""
+        from tensorflow_train_distributed_tpu.models import moe
+        from tensorflow_train_distributed_tpu.models.import_hf import (
+            import_qwen2_moe,
+        )
+
+        hf = self._hf()
+        hf.config.rms_norm_eps = 2e-6
+        preset = moe.MOE_PRESETS["qwen_moe_tiny"]   # default 1e-5 eps
+        cfg, _ = import_qwen2_moe(hf, config=preset)
+        assert cfg.rms_epsilon == 2e-6
+        cfg, _ = import_qwen2_moe(hf, config=preset, rms_epsilon=3e-6)
+        assert cfg.rms_epsilon == 3e-6              # explicit override
 
     def test_cli_init_from_hf_qwen2_moe(self, tmp_path):
         """--init-from-hf auto-dispatches on the checkpoint's

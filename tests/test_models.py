@@ -63,6 +63,17 @@ class TestArchitectures:
                          jnp.zeros((1, 8), jnp.int32))
         assert abs(n - 6.74e9) < 0.1e9, n  # Llama-2-7B: 6.74B
 
+    def test_qwen_presets_carry_checkpoint_norm_epsilon(self):
+        """Qwen checkpoints use rms_norm_eps=1e-6; a preset left at the
+        family default 1e-5 imports into silently-different logits on
+        the config=task_cfg CLI route (ADVICE round 5)."""
+        from tensorflow_train_distributed_tpu.models.moe import (
+            MOE_PRESETS,
+        )
+
+        assert LLAMA_PRESETS["qwen25_7b"].rms_epsilon == 1e-6
+        assert MOE_PRESETS["qwen15_moe_a27b"].rms_epsilon == 1e-6
+
     def test_llama_scan_matches_loop_params(self):
         loop_cfg = LLAMA_PRESETS["llama_tiny"]
         scan_cfg = LLAMA_PRESETS["llama_tiny_scan"]
@@ -312,7 +323,9 @@ class TestLlama7bMemoryBudget:
         return plan_state_memory(task, batch, optax.adamw(1e-5), mesh)
 
     def test_fsdp_tp_fits_v5e8_and_v5e16(self):
-        from jax.sharding import AbstractMesh
+        from tensorflow_train_distributed_tpu.runtime.compat import (
+            abstract_mesh,
+        )
 
         from tensorflow_train_distributed_tpu.runtime.mesh import (
             AXES, MeshConfig, build_mesh,
@@ -327,7 +340,7 @@ class TestLlama7bMemoryBudget:
         # v5e-16 (fsdp=4 × tensor=4) — AbstractMesh: no 16 devices needed.
         sizes = dict.fromkeys(AXES, 1)
         sizes.update(fsdp=4, tensor=4)
-        mesh16 = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        mesh16 = abstract_mesh(tuple(sizes[a] for a in AXES), AXES)
         plan16 = self._plan(mesh16)
         assert plan16["per_device_bytes"] < self.V5E_HBM / 2
         assert plan16["per_device_bytes"] < plan8["per_device_bytes"]
@@ -409,7 +422,9 @@ class TestActivationMemoryModel:
         import numpy as np
         import optax
 
-        from jax.sharding import AbstractMesh
+        from tensorflow_train_distributed_tpu.runtime.compat import (
+            abstract_mesh,
+        )
 
         from tensorflow_train_distributed_tpu.models import llama
         from tensorflow_train_distributed_tpu.runtime.mesh import AXES
@@ -419,7 +434,7 @@ class TestActivationMemoryModel:
 
         sizes = dict.fromkeys(AXES, 1)
         sizes.update(fsdp=4, tensor=4)
-        mesh16 = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        mesh16 = abstract_mesh(tuple(sizes[a] for a in AXES), AXES)
         task = llama.make_task(llama.LLAMA_PRESETS["llama2_7b"])
 
         def plan(batch):
@@ -514,9 +529,12 @@ class TestEncoderRemat:
         g = lambda cfg: jax.grad(  # noqa: E731
             lambda p: bert.BertEncoder(cfg).apply(
                 {"params": p}, ids).sum())(p0)
+        # rtol, not just atol: remat recompute reorders float32 sums, so
+        # gradients of magnitude ~1e2 carry ~1e-4 absolute noise on some
+        # XLA versions; a real parity break would be O(1) relative.
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), atol=1e-5),
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4),
             g(cfg0), g(cfg1))
 
     def test_transformer_remat_parity(self):
@@ -617,7 +635,9 @@ def test_plan_train_memory_refuses_moe():
     silent underestimate would green-light tunnel-killing compiles."""
     import optax
 
-    from jax.sharding import AbstractMesh
+    from tensorflow_train_distributed_tpu.runtime.compat import (
+        abstract_mesh,
+    )
 
     from tensorflow_train_distributed_tpu.models import moe
     from tensorflow_train_distributed_tpu.runtime.mesh import AXES
@@ -625,7 +645,7 @@ def test_plan_train_memory_refuses_moe():
 
     sizes = dict.fromkeys(AXES, 1)
     sizes.update(expert=4)
-    mesh = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+    mesh = abstract_mesh(tuple(sizes[a] for a in AXES), AXES)
     b = {"tokens": np.zeros((4, 128), np.int32),
          "targets": np.zeros((4, 128), np.int32)}
     with pytest.raises(ValueError, match="MoE"):
@@ -758,7 +778,9 @@ class TestLlama13bScale:
     shrink seq or grow the slice; that refusal is the feature)."""
 
     def _plan(self, seq, axes):
-        from jax.sharding import AbstractMesh
+        from tensorflow_train_distributed_tpu.runtime.compat import (
+            abstract_mesh,
+        )
 
         from tensorflow_train_distributed_tpu.models import llama
         from tensorflow_train_distributed_tpu.runtime.mesh import AXES
@@ -768,7 +790,7 @@ class TestLlama13bScale:
 
         sizes = dict.fromkeys(AXES, 1)
         sizes.update(axes)
-        mesh = AbstractMesh(tuple(sizes[a] for a in AXES), AXES)
+        mesh = abstract_mesh(tuple(sizes[a] for a in AXES), AXES)
         task = llama.make_task(llama.LLAMA_PRESETS["llama2_13b"])
         b = {"tokens": np.zeros((4, seq), np.int32),
              "targets": np.zeros((4, seq), np.int32)}

@@ -17,6 +17,7 @@ import numpy as np
 import optax
 import pytest
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.models import moe
 from tensorflow_train_distributed_tpu.runtime.mesh import (
     MeshConfig, build_mesh,
@@ -171,7 +172,7 @@ def test_sharded_matches_unsharded(tiny):
     want = task.model.apply({"params": variables["params"]}, tokens)
 
     mesh = build_mesh(MeshConfig(data=2, expert=4))
-    with sharding_lib.with_logical_rules(mesh), jax.set_mesh(mesh):
+    with sharding_lib.with_logical_rules(mesh), compat.set_mesh(mesh):
         got = jax.jit(
             lambda p, t: task.model.apply({"params": p}, t)
         )(variables["params"], tokens)

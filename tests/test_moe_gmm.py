@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tensorflow_train_distributed_tpu.runtime import compat
 from tensorflow_train_distributed_tpu.models import moe
 
 
@@ -144,7 +145,7 @@ def test_gmm_expert_sharded_matches_unsharded(tiny_pair):
                           jnp.float32)
     want, _ = _apply(cfg_g, params, x)
     mesh = build_mesh(MeshConfig(data=2, expert=4))
-    with sharding_lib.with_logical_rules(mesh), jax.set_mesh(mesh):
+    with sharding_lib.with_logical_rules(mesh), compat.set_mesh(mesh):
         got = jax.jit(lambda p, t: moe.MoEMlpBlock(cfg_g).apply(
             {"params": p}, t,
             mutable=["aux_loss", "router_stats"])[0])(params, x)
@@ -156,7 +157,7 @@ def test_gmm_expert_sharded_matches_unsharded(tiny_pair):
             {"params": p}, x, mutable=["aux_loss", "router_stats"])[0]
         return jnp.sum(y ** 2)
 
-    with sharding_lib.with_logical_rules(mesh), jax.set_mesh(mesh):
+    with sharding_lib.with_logical_rules(mesh), compat.set_mesh(mesh):
         g_sharded = jax.jit(jax.grad(loss))(params)
     g_unsharded = jax.grad(loss)(params)
     jax.tree.map(
@@ -209,7 +210,7 @@ def test_gmm_rejects_expert_tensor_mesh(tiny_pair):
     _, cfg_g, params, _ = tiny_pair
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 16, cfg_g.d_model))
     mesh = build_mesh(MeshConfig(data=2, expert=2, tensor=2))
-    with sharding_lib.with_logical_rules(mesh), jax.set_mesh(mesh):
+    with sharding_lib.with_logical_rules(mesh), compat.set_mesh(mesh):
         with pytest.raises(ValueError, match="dense"):
             jax.jit(lambda p, t: moe.MoEMlpBlock(cfg_g).apply(
                 {"params": p}, t,
@@ -229,7 +230,7 @@ def test_gmm_rejects_indivisible_expert_axis(tiny_pair):
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 16, cfg_g.d_model))
     params6 = moe.MoEMlpBlock(bad).init(jax.random.PRNGKey(1), x)["params"]
     mesh = build_mesh(MeshConfig(data=2, expert=4))
-    with sharding_lib.with_logical_rules(mesh), jax.set_mesh(mesh):
+    with sharding_lib.with_logical_rules(mesh), compat.set_mesh(mesh):
         with pytest.raises(ValueError, match="divisible"):
             jax.jit(lambda p, t: moe.MoEMlpBlock(bad).apply(
                 {"params": p}, t,

@@ -1,0 +1,240 @@
+"""Closed-loop load generator for the HTTP serving gateway.
+
+Drives ``tools/serve_http.py``'s gateway with ``--clients`` concurrent
+closed-loop clients (each sends its next request only after the
+previous one answers — the canonical serving-latency harness shape) and
+reports the bench trajectory's first serving-latency datapoints: p50 /
+p99 request latency, generated tokens/sec, and the shed rate (429s per
+attempt; a shed client honors Retry-After and retries, so the loop
+stays closed under overload).
+
+Self-contained by default — builds a random-init ``--preset`` engine
+and an in-process gateway on an ephemeral port, so the bench needs no
+checkpoint and runs on the CPU mesh (``--platform cpu``) or a real
+chip alike.  ``--base-url`` points it at an externally launched
+gateway instead (then engine flags here are ignored).
+
+Prints one driver-parsable JSON line (bench_lm.py conventions).
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _requests_for(client: int, n: int, plo, phi, glo, ghi, vocab, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed + 1000 * client)
+    return [([int(t) for t in
+              rng.integers(1, vocab, int(rng.integers(plo, phi + 1)))],
+             int(rng.integers(glo, ghi + 1))) for _ in range(n)]
+
+
+def _post(base_url: str, body: dict, timeout: float):
+    """(status, parsed_json, retry_after_s) — errors surface as status;
+    network-level failures (timeout, refused, reset) as status 0, so a
+    client thread never dies and every request lands in exactly one of
+    n_ok / n_shed / n_failed."""
+    req = urllib.request.Request(
+        base_url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), 0.0
+    except urllib.error.HTTPError as e:
+        retry = float(e.headers.get("Retry-After") or 1.0)
+        with contextlib.suppress(Exception):
+            e.read()
+        return e.code, None, retry
+    except OSError:       # URLError, socket timeout, connection reset
+        return 0, None, 0.0
+
+
+class _Client(threading.Thread):
+    """One closed-loop client: request → wait for answer → next."""
+
+    def __init__(self, base_url, reqs, timeout, max_retries):
+        super().__init__(daemon=True)
+        self.base_url, self.reqs = base_url, reqs
+        self.timeout, self.max_retries = timeout, max_retries
+        self.latencies, self.gen_tokens = [], 0
+        self.sheds = self.failures = 0
+
+    def run(self):
+        for prompt, max_new in self.reqs:
+            body = {"prompt": prompt, "max_new": max_new}
+            for _ in range(self.max_retries):
+                t0 = time.perf_counter()
+                status, obj, retry_after = _post(
+                    self.base_url, body, self.timeout)
+                if status == 200:
+                    self.latencies.append(time.perf_counter() - t0)
+                    self.gen_tokens += len(obj["tokens"]) - len(prompt)
+                    break
+                if status == 429:
+                    self.sheds += 1
+                    time.sleep(retry_after)
+                    continue
+                self.failures += 1
+                break
+            else:
+                self.failures += 1
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * (len(sorted_vals) - 1) + 0.5))]
+
+
+def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
+                  requests_per_client, prompt_range, new_range,
+                  cache_len, seed, timeout):
+    gw = None
+    if base_url:
+        vocab = 30_000       # external gateway: conservative id ceiling
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.models.llama import (
+            LLAMA_PRESETS, LlamaModel,
+        )
+        from tensorflow_train_distributed_tpu.server import ServingGateway
+        from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+        cfg = LLAMA_PRESETS[preset]
+        vocab = min(cfg.vocab_size, 30_000)
+        params = LlamaModel(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                            cache_len=cache_len)
+        gw = ServingGateway(eng, host="127.0.0.1", port=0,
+                            max_queue=max_queue).start()
+        base_url = f"http://127.0.0.1:{gw.port}"
+
+    # Warmup: ONE request through the full path compiles every program
+    # (prefill bucket + decode chunk) before the timed window.
+    status, obj, _ = _post(base_url,
+                           {"prompt": [1, 2, 3], "max_new": 4}, timeout)
+    if status != 200:
+        raise RuntimeError(f"warmup request failed with HTTP {status}")
+
+    workers = [
+        _Client(base_url,
+                _requests_for(c, requests_per_client, *prompt_range,
+                              *new_range, vocab, seed), timeout,
+                max_retries=100)
+        for c in range(clients)]
+    t0 = time.perf_counter()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    dt = time.perf_counter() - t0
+
+    lats = sorted(l for w in workers for l in w.latencies)
+    gen = sum(w.gen_tokens for w in workers)
+    sheds = sum(w.sheds for w in workers)
+    failures = sum(w.failures for w in workers)
+    attempts = len(lats) + sheds + failures
+    rec = {
+        "metric": f"{preset}_gateway_tokens_per_sec",
+        "value": round(gen / dt, 1) if dt else 0.0,
+        "unit": "generated tokens/sec",
+        "wall_s": round(dt, 3),
+        "p50_latency_ms": round(1e3 * _percentile(lats, 0.50), 1),
+        "p99_latency_ms": round(1e3 * _percentile(lats, 0.99), 1),
+        "shed_rate": round(sheds / attempts, 4) if attempts else 0.0,
+        "n_ok": len(lats),
+        "n_shed": sheds,
+        "n_failed": failures,
+        "gen_tokens": gen,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "slots": slots,
+        "chunk": chunk,
+        "max_queue": max_queue,
+    }
+    if gw is not None:
+        import jax
+
+        dev = jax.devices()[0]
+        rec["backend"] = dev.platform
+        rec["device_kind"] = dev.device_kind
+        gw.drain(timeout=30)
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--base-url", default="",
+                   help="target an externally launched gateway instead "
+                        "of building one in-process")
+    p.add_argument("--preset", default="llama_tiny",
+                   help="llama preset for the in-process gateway "
+                        "(random-init weights — a THROUGHPUT/latency "
+                        "harness, not a quality one)")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--max-queue", type=int, default=16)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--requests-per-client", type=int, default=8)
+    p.add_argument("--prompt-range", default="4,24",
+                   help="lo,hi inclusive prompt lengths")
+    p.add_argument("--new-range", default="8,32",
+                   help="lo,hi inclusive max_new_tokens")
+    p.add_argument("--cache-len", type=int, default=0,
+                   help="0 -> config.max_positions")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="client-side HTTP timeout per request")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform ('cpu' for smoke runs)")
+    args = p.parse_args(argv)
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+    if args.base_url or (args.platform and args.platform != "tpu"):
+        cm = contextlib.nullcontext()
+    else:
+        from tensorflow_train_distributed_tpu.runtime.chip_lock import (
+            chip_lock,
+        )
+
+        cm = chip_lock()
+    prompt_range = tuple(int(x) for x in args.prompt_range.split(","))
+    new_range = tuple(int(x) for x in args.new_range.split(","))
+    try:
+        with cm:
+            rec = bench_gateway(
+                args.base_url, args.preset, args.slots, args.chunk,
+                args.max_queue, args.clients, args.requests_per_client,
+                prompt_range, new_range, args.cache_len or None,
+                args.seed, args.timeout)
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"{args.preset}_gateway_tokens_per_sec",
+            "value": 0.0, "unit": "generated tokens/sec",
+            "error": f"{type(e).__name__}: {e}"}), flush=True)
+        return 1
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,18 @@
 """Batch-serve mixed-length requests through the continuous-batching engine.
 
-The CLI face of ``serving.ServingEngine`` (slot-refill decode): unlike
-``tools/sample.py`` (one static batch, equal-length prompts), requests
-here may have DIFFERENT prompt lengths and budgets — the engine keeps
-``--slots`` of them in flight and refills as they finish, emitting each
-result as one JSONL line ``{"id", "prompt", "tokens"}`` (tokens =
-prompt + continuation, exactly generate()'s convention).
+The OFFLINE CLI face of ``serving.ServingEngine`` (slot-refill decode):
+every request is collected up front, the engine runs to completion, the
+process exits.  For ONLINE serving — accepting HTTP requests while the
+engine decodes, with admission control, deadlines, streaming, and a
+/metrics surface — use ``tools/serve_http.py`` (the
+``tensorflow_train_distributed_tpu.server`` gateway); token output is
+identical for the same requests.
+
+Unlike ``tools/sample.py`` (one static batch, equal-length prompts),
+requests here may have DIFFERENT prompt lengths and budgets — the
+engine keeps ``--slots`` of them in flight and refills as they finish,
+emitting each result as one JSONL line ``{"id", "prompt", "tokens"}``
+(tokens = prompt + continuation, exactly generate()'s convention).
 
 Requests come from repeated ``--prompt`` flags or ``--requests FILE``
 (JSONL: ``{"prompt": [ids...], "max_new": N, "seed": S?}``).  No
@@ -36,8 +43,10 @@ from sample import (  # noqa: E402 (tools/ sibling)
 )
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+def add_engine_args(p) -> None:
+    """Engine/model flag surface SHARED with tools/serve_http.py: one
+    definition, so the offline CLI and the online gateway always load
+    and configure the engine identically (the parity contract)."""
     p.add_argument("--config", required=True,
                    help="registry config name (a decoder-family preset)")
     src_grp = p.add_mutually_exclusive_group(required=True)
@@ -46,15 +55,9 @@ def main(argv=None) -> int:
     src_grp.add_argument("--init-from-hf",
                          help="local HuggingFace checkpoint (llama-family "
                               "or sparse-MoE) to serve directly")
-    p.add_argument("--prompt", action="append", default=[],
-                   metavar="IDS", help="comma-separated token ids; repeat "
-                   "per request (lengths may differ — that is the point)")
-    p.add_argument("--requests", default="",
-                   help="JSONL file: {'prompt': [ids], 'max_new': N, "
-                        "'seed': S?} per line")
     p.add_argument("--max-new", type=int, default=32,
-                   help="budget for --prompt requests (JSONL carries "
-                        "its own)")
+                   help="default generation budget (per-request values "
+                        "in JSONL / HTTP bodies override it)")
     p.add_argument("--prefix", default="",
                    metavar="IDS", help="comma-separated token ids of a "
                    "shared prompt prefix (system prompt): prefilled "
@@ -79,10 +82,86 @@ def main(argv=None) -> int:
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
                    help="draft block length per round")
-    p.add_argument("--output", default="-",
-                   help="output JSONL path ('-' = stdout)")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
+
+
+def parse_prefix_arg(args, cfg):
+    """--prefix ids, vocab-screened BEFORE any checkpoint load: the
+    prefix becomes real context for every matching request — an
+    out-of-vocab id would silently clamp in the embedding gather and
+    corrupt every continuation; same screens as --prompt."""
+    prefix_ids = (parse_prompt_spec(args.prefix, flag="--prefix")
+                  if args.prefix else [])
+    if prefix_ids:
+        check_vocab_ids([prefix_ids], cfg.vocab_size)
+    return prefix_ids
+
+
+def build_engine(args, cfg, is_moe, prefix_ids):
+    """Load weights (+ optional draft), quantize, construct the engine,
+    preload the prefix — shared by serve.py and serve_http.py.
+    ValueErrors surface as the clean SystemExit CLI convention."""
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    draft_cfg = draft_params = None
+    if (args.speculative_draft_checkpoint
+            and not args.speculative_draft_config):
+        raise SystemExit("--speculative-draft-checkpoint needs "
+                         "--speculative-draft-config")
+    if args.speculative_draft_config:
+        if not args.speculative_draft_checkpoint:
+            raise SystemExit("--speculative-draft-checkpoint is required "
+                             "with --speculative-draft-config")
+        _, draft_cfg, draft_moe = resolve_decoder_task(
+            args.speculative_draft_config, "speculative serving")
+        if draft_moe:
+            raise SystemExit("the draft config must be a llama-family "
+                             "decoder")
+        draft_params = _restore_params(args.speculative_draft_checkpoint)
+
+    cfg, params = load_decoder_params(args, cfg, is_moe)
+    quant_scales = draft_quant_scales = None
+    if args.quant == "int8":
+        from tensorflow_train_distributed_tpu.models.quant import (
+            quantize_params,
+        )
+
+        params, quant_scales = quantize_params(params)
+        if draft_params is not None:
+            # --quant quantizes BOTH models (decode is weight-HBM-bound
+            # on both); each tree carries its own scales.
+            draft_params, draft_quant_scales = quantize_params(
+                draft_params)
+
+    try:
+        eng = ServingEngine(
+            cfg, params, slots=args.slots, chunk=args.chunk,
+            cache_len=args.cache_len or None, eos_id=args.eos_id,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, quant_scales=quant_scales,
+            draft_config=draft_cfg, draft_params=draft_params,
+            draft_quant_scales=draft_quant_scales,
+            speculative_k=(args.speculative_k
+                           if draft_cfg is not None else 0))
+        if prefix_ids:
+            eng.preload_prefix(prefix_ids)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    return eng
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_engine_args(p)
+    p.add_argument("--prompt", action="append", default=[],
+                   metavar="IDS", help="comma-separated token ids; repeat "
+                   "per request (lengths may differ — that is the point)")
+    p.add_argument("--requests", default="",
+                   help="JSONL file: {'prompt': [ids], 'max_new': N, "
+                        "'seed': S?} per line")
+    p.add_argument("--output", default="-",
+                   help="output JSONL path ('-' = stdout)")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -91,8 +170,6 @@ def main(argv=None) -> int:
         )
 
         force_platform(args.platform)
-
-    from tensorflow_train_distributed_tpu.serving import ServingEngine
 
     _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
 
@@ -136,13 +213,7 @@ def main(argv=None) -> int:
     if not reqs:
         raise SystemExit("no requests (--prompt or --requests)")
     check_vocab_ids([r["prompt"] for r in reqs], cfg.vocab_size)
-    # The prefix becomes real context for every matching request — an
-    # out-of-vocab id here would silently clamp in the embedding gather
-    # and corrupt every continuation; same screens as --prompt.
-    prefix_ids = (parse_prompt_spec(args.prefix, flag="--prefix")
-                  if args.prefix else [])
-    if prefix_ids:
-        check_vocab_ids([prefix_ids], cfg.vocab_size)
+    prefix_ids = parse_prefix_arg(args, cfg)
 
     # Probe --output writability BEFORE serving (an unwritable path
     # must fail in milliseconds, not after minutes of decode) — append
@@ -154,59 +225,19 @@ def main(argv=None) -> int:
         except OSError as e:
             raise SystemExit(f"cannot write --output {args.output}: {e}")
 
-    draft_cfg = draft_params = None
-    if (args.speculative_draft_checkpoint
-            and not args.speculative_draft_config):
-        raise SystemExit("--speculative-draft-checkpoint needs "
-                         "--speculative-draft-config")
-    if args.speculative_draft_config:
-        if not args.speculative_draft_checkpoint:
-            raise SystemExit("--speculative-draft-checkpoint is required "
-                             "with --speculative-draft-config")
-        _, draft_cfg, draft_moe = resolve_decoder_task(
-            args.speculative_draft_config, "speculative serving")
-        if draft_moe:
-            raise SystemExit("the draft config must be a llama-family "
-                             "decoder")
-        draft_params = _restore_params(args.speculative_draft_checkpoint)
-
-    cfg, params = load_decoder_params(args, cfg, is_moe)
-    quant_scales = draft_quant_scales = None
-    if args.quant == "int8":
-        from tensorflow_train_distributed_tpu.models.quant import (
-            quantize_params,
-        )
-
-        params, quant_scales = quantize_params(params)
-        if draft_params is not None:
-            # --quant quantizes BOTH models (decode is weight-HBM-bound
-            # on both); each tree carries its own scales.
-            draft_params, draft_quant_scales = quantize_params(
-                draft_params)
-
-    # Engine/submit validation errors (oversized prompts, bad
-    # sampling combos, budget vs cache) exit with the same clean
-    # SystemExit convention as every other serve.py input error — and
-    # they happen BEFORE the truncating open below, so a failed rerun
-    # never destroys a previous results file.
+    eng = build_engine(args, cfg, is_moe, prefix_ids)
+    # Submit validation errors (oversized prompts, budget vs cache)
+    # exit with the same clean SystemExit convention as every other
+    # serve.py input error — and they happen BEFORE the truncating
+    # open below, so a failed rerun never destroys a previous results
+    # file.
     try:
-        eng = ServingEngine(
-            cfg, params, slots=args.slots, chunk=args.chunk,
-            cache_len=args.cache_len or None, eos_id=args.eos_id,
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, quant_scales=quant_scales,
-            draft_config=draft_cfg, draft_params=draft_params,
-            draft_quant_scales=draft_quant_scales,
-            speculative_k=(args.speculative_k
-                           if draft_cfg is not None else 0))
-        if prefix_ids:
-            eng.preload_prefix(prefix_ids)
         ids = [eng.submit(r["prompt"], r["max_new"],
                           seed=r.get("seed")) for r in reqs]
     except ValueError as e:
         raise SystemExit(str(e))
     out = eng.run()
-    if draft_cfg is not None:
+    if args.speculative_draft_config:
         # Observable proof the speculative path actually engaged (and
         # the acceptance rate the draft is buying).  The rate divides
         # by SLOT-rounds × k (each active slot drafts k per round) —

@@ -1,0 +1,117 @@
+"""Online HTTP serving gateway over the continuous-batching engine.
+
+The ONLINE face of ``serving.ServingEngine`` — where ``tools/serve.py``
+collects every request up front and exits when the batch finishes, this
+launcher keeps the engine decoding while a threaded HTTP frontend
+(``tensorflow_train_distributed_tpu.server``) accepts, sheds, streams,
+and times out requests concurrently:
+
+- ``POST /v1/generate``  {"prompt": [ids], "max_new": N, "seed": S?,
+  "stream": bool?, "timeout_s": F?} → {"id", "prompt", "tokens"}
+  (tokens = prompt + continuation, byte-identical to serve.py on the
+  same requests); ``stream`` chunks tokens as they commit (NDJSON).
+- ``GET /healthz``  liveness + occupancy (503 while draining).
+- ``GET /metrics``  Prometheus text: request/token counters, queue
+  depth, slot occupancy, TTFT + latency histograms.
+
+Robustness: admission queue bounded at ``--max-queue`` (beyond it: 429
+with Retry-After), per-request deadlines (``--default-timeout`` /
+per-request ``timeout_s`` → 504, slot freed), request-size and vocab
+validation (``check_vocab_ids`` — same screens as serve.py), graceful
+drain on SIGTERM/SIGINT (stop admitting, finish in-flight, flush
+metrics).  Model/engine flags are shared with serve.py
+(``add_engine_args``), so both CLIs configure the engine identically.
+
+Examples:
+  python tools/serve_http.py --config llama_tiny_sft \\
+      --checkpoint-dir /ck --port 8000 --slots 8
+  curl -s localhost:8000/v1/generate -d '{"prompt": [1,2,3], "max_new": 16}'
+  curl -s localhost:8000/metrics | grep ttd_gateway
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
+sys.path.insert(0, _HERE)                   # tools/ siblings
+
+from sample import (  # noqa: E402 (tools/ sibling)
+    check_vocab_ids,
+    resolve_decoder_task,
+)
+from serve import (  # noqa: E402 (tools/ sibling)
+    add_engine_args,
+    build_engine,
+    parse_prefix_arg,
+)
+
+
+def make_vocab_validator(vocab_size: int):
+    """check_vocab_ids wears SystemExit (the CLI convention); the
+    gateway needs a 400, so rewrap — one shared screen either way."""
+    from tensorflow_train_distributed_tpu.server import RequestError
+
+    def _validate(prompt, max_new, seed):
+        try:
+            check_vocab_ids([[int(t) for t in prompt]], vocab_size)
+        except SystemExit as e:
+            raise RequestError(str(e))
+
+    return _validate
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_engine_args(p)
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address (default: all interfaces)")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 = ephemeral (printed at startup)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission bound: requests WAITING for a slot "
+                        "beyond this are shed with 429 + Retry-After")
+    p.add_argument("--default-timeout", type=float, default=0.0,
+                   help="per-request deadline in seconds when the body "
+                        "carries no timeout_s (0 = none); an expired "
+                        "request answers 504 and frees its slot")
+    p.add_argument("--retry-after", type=float, default=1.0,
+                   help="Retry-After seconds on shed (429) responses")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.platform:
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+
+    from tensorflow_train_distributed_tpu.server import ServingGateway
+
+    _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
+    prefix_ids = parse_prefix_arg(args, cfg)
+    eng = build_engine(args, cfg, is_moe, prefix_ids)
+
+    gw = ServingGateway(
+        eng, host=args.host, port=args.port, max_queue=args.max_queue,
+        default_timeout_s=args.default_timeout or None,
+        default_max_new=args.max_new,
+        validate=make_vocab_validator(cfg.vocab_size),
+        retry_after_s=args.retry_after)
+    gw.install_signal_handlers()
+    gw.start()
+    print(f"gateway listening on {args.host}:{gw.port} "
+          f"(config={args.config}, slots={args.slots}, "
+          f"max_queue={args.max_queue})", flush=True)
+    gw.wait()           # until SIGTERM/SIGINT drains
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
