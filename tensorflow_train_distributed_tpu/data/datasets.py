@@ -260,13 +260,14 @@ def _array_dir(root: str, transform=None):
     return open_sharded(root, transform=transform)
 
 
-def _tfrecord_dir(root: str, transform=None):
+def _tfrecord_dir(root: str, transform=None, on_corrupt: str = "raise"):
     """Directory of ``*.tfrecord`` files + ``features.json`` sidecar."""
     from tensorflow_train_distributed_tpu.data.tfrecord import (
         open_tfrecord_dir,
     )
 
-    return open_tfrecord_dir(root, transform=transform)
+    return open_tfrecord_dir(root, transform=transform,
+                             on_corrupt=on_corrupt)
 
 
 _REGISTRY = {

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import inspect
 import json
+import logging
+import time
 from pathlib import Path
 from typing import Callable, Optional, Union
 
@@ -34,8 +36,44 @@ from tensorflow_train_distributed_tpu.data.pipeline import (
     ConcatSource,
     fetch_record,  # noqa: F401  (re-export: the record-fetch protocol)
 )
+from tensorflow_train_distributed_tpu.runtime import faults
+
+logger = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
+
+# Bounded retry for transient record-read IO (flaky NFS/GCS-fuse mounts,
+# injected faults): N attempts with doubling backoff, then the error
+# propagates — a *persistently* failing disk must kill the job loudly,
+# not spin forever feeding the trainer nothing.
+IO_RETRY_ATTEMPTS = 3
+IO_RETRY_BACKOFF_S = 0.05
+
+
+def read_with_retries(fn: Callable[[], dict], what: str,
+                      *, attempts: int = None, backoff_s: float = None,
+                      sleep=time.sleep) -> dict:
+    """Run a record-read thunk with bounded retry on ``OSError``.
+
+    Only ``OSError`` (the transient-IO family, including
+    ``faults.InjectedTransientIO``) retries; decode/shape errors are
+    data corruption, not weather, and propagate immediately.
+    """
+    attempts = IO_RETRY_ATTEMPTS if attempts is None else attempts
+    backoff_s = IO_RETRY_BACKOFF_S if backoff_s is None else backoff_s
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt + 1 >= attempts:
+                raise
+            delay = backoff_s * 2 ** attempt
+            logger.warning(
+                "transient IO reading %s (%s); retry %d/%d in %.2fs",
+                what, e, attempt + 1, attempts - 1, delay)
+            sleep(delay)
 
 # Named record transforms, so configs/CLI can reference them as strings
 # (e.g. storage-efficient uint8 images decoded to the model's f32 input).
@@ -141,7 +179,15 @@ class MmapArraySource(TransformedRecordMixin):
     def _raw(self, idx: int) -> dict[str, np.ndarray]:
         if idx < 0 or idx >= self._n:
             raise IndexError(idx)
-        return {k: np.asarray(v[idx]) for k, v in self.columns.items()}
+
+        def _read():
+            if faults.ARMED:
+                faults.on_data_read(idx)
+            # np.asarray materializes the mmap'd row — the page-fault
+            # read that a flaky mount turns into an OSError.
+            return {k: np.asarray(v[idx]) for k, v in self.columns.items()}
+
+        return read_with_retries(_read, f"{self.path} record {idx}")
 
 
 def write_shards(root: Union[str, Path], source, num_shards: int) -> Path:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import gzip
 import io
 import json
+import logging
 import struct
 from pathlib import Path
 from typing import Optional, Sequence, Union
@@ -36,7 +37,11 @@ import numpy as np
 
 from tensorflow_train_distributed_tpu.data.filesource import (
     TransformedRecordMixin,
+    read_with_retries,
 )
+from tensorflow_train_distributed_tpu.runtime import faults
+
+logger = logging.getLogger(__name__)
 
 # id1+id2+deflate method: 3 bytes, not 2 — a plain TFRecord whose first
 # record is exactly 0x8B1F bytes long starts with 1f 8b too, but its third
@@ -328,8 +333,35 @@ class TFRecordWriter:
         self.close()
 
 
-def read_records(path: Union[str, Path], *, verify_crc: bool = True):
-    """Yield raw record payloads from one TFRecord file (gzip-aware)."""
+def read_records(path: Union[str, Path], *, verify_crc: bool = True,
+                 on_corrupt: str = "raise",
+                 stats: Optional[dict] = None):
+    """Yield raw record payloads from one TFRecord file (gzip-aware).
+
+    ``on_corrupt`` (with ``verify_crc``): ``"raise"`` keeps the
+    historical fail-mid-stream behavior; ``"skip"`` drops records whose
+    *payload* crc fails (the framing is intact, so the stream resyncs
+    cleanly at the next record) and counts them in
+    ``stats["skipped_records"]``.  A corrupt *length* crc leaves no
+    trustworthy framing to resync on — skip mode abandons the rest of
+    the file loudly instead of misparsing garbage as records.
+    """
+    if on_corrupt not in ("raise", "skip"):
+        raise ValueError(
+            f"on_corrupt must be 'raise' or 'skip', got {on_corrupt!r}")
+
+    def _skip_tail(what: str) -> bool:
+        # Truncation mid-record = crashed-writer tail: in skip mode it
+        # is dropped (counted + logged) instead of raised — nothing
+        # after it is parseable either way.
+        if on_corrupt != "skip":
+            return False
+        if stats is not None:
+            stats["skipped_records"] = stats.get("skipped_records", 0) + 1
+        logger.error("%s: %s; dropping the file tail (crashed writer)",
+                     path, what)
+        return True
+
     opener = gzip.open if _is_gzip(path) else open
     with opener(path, "rb") as f:
         while True:
@@ -337,26 +369,60 @@ def read_records(path: Union[str, Path], *, verify_crc: bool = True):
             if not header:
                 return
             if len(header) != 8:
+                if _skip_tail("truncated length header"):
+                    return
                 raise ValueError(f"{path}: truncated length header")
             (length,) = struct.unpack("<Q", header)
-            (len_crc,) = struct.unpack("<I", f.read(4))
+            crc_bytes = f.read(4)
+            if len(crc_bytes) != 4:
+                if _skip_tail("truncated length crc"):
+                    return
+                raise ValueError(f"{path}: truncated length crc")
+            (len_crc,) = struct.unpack("<I", crc_bytes)
             if verify_crc and _masked_crc(header) != len_crc:
+                if on_corrupt == "skip":
+                    if stats is not None:
+                        stats["skipped_records"] = (
+                            stats.get("skipped_records", 0) + 1)
+                    logger.error(
+                        "%s: corrupt length crc — framing lost, "
+                        "abandoning the rest of the file", path)
+                    return
                 raise ValueError(f"{path}: corrupt length crc")
             payload = f.read(length)
             if len(payload) != length:
+                if _skip_tail("truncated record"):
+                    return
                 raise ValueError(f"{path}: truncated record")
-            (crc,) = struct.unpack("<I", f.read(4))
+            crc_bytes = f.read(4)
+            if len(crc_bytes) != 4:
+                if _skip_tail("truncated record crc"):
+                    return
+                raise ValueError(f"{path}: truncated record crc")
+            (crc,) = struct.unpack("<I", crc_bytes)
             if verify_crc and _masked_crc(payload) != crc:
+                if on_corrupt == "skip":
+                    if stats is not None:
+                        stats["skipped_records"] = (
+                            stats.get("skipped_records", 0) + 1)
+                    continue
                 raise ValueError(f"{path}: corrupt record crc")
             yield payload
 
 
-def _index_stream(f, size: int, name: str) -> list[tuple[int, int]]:
+def _index_stream(f, size: int, name: str, *, on_corrupt: str = "raise",
+                  stats: Optional[dict] = None) -> list[tuple[int, int]]:
     """One sequential pass → [(payload_offset, payload_length)].
 
     Bounds-checks every record against the stream size so a file
     truncated mid-record (crashed writer) fails loudly at open time, not
     as an opaque decode error mid-training.
+
+    ``on_corrupt="skip"`` additionally verifies both crcs (reading every
+    payload — the price of screening) and LEAVES OUT corrupt records,
+    counting them in ``stats["skipped_records"]``: training then never
+    meets them mid-epoch.  The default ``"raise"`` pass stays seek-only
+    (no payload reads, no crc cost).
     """
     index = []
     pos = 0
@@ -365,22 +431,60 @@ def _index_stream(f, size: int, name: str) -> list[tuple[int, int]]:
         if not header:
             return index
         if len(header) != 8:
+            if on_corrupt == "skip":
+                if stats is not None:
+                    stats["skipped_records"] = (
+                        stats.get("skipped_records", 0) + 1)
+                logger.error(
+                    "%s: truncated length header at offset %d; dropping "
+                    "it (crashed writer tail)", name, pos)
+                return index
             raise ValueError(f"{name}: truncated length header")
         (length,) = struct.unpack("<Q", header)
         end = pos + 12 + length + 4
         if end > size:
+            if on_corrupt == "skip":
+                if stats is not None:
+                    stats["skipped_records"] = (
+                        stats.get("skipped_records", 0) + 1)
+                logger.error(
+                    "%s: truncated record at offset %d; dropping it "
+                    "(crashed writer tail)", name, pos)
+                return index
             raise ValueError(
                 f"{name}: truncated record at offset {pos} "
                 f"(needs {end} bytes, stream has {size})")
+        if on_corrupt == "skip":
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            payload = f.read(length)
+            (crc,) = struct.unpack("<I", f.read(4))
+            if (_masked_crc(header) != len_crc
+                    or _masked_crc(payload) != crc):
+                if stats is not None:
+                    stats["skipped_records"] = (
+                        stats.get("skipped_records", 0) + 1)
+                if _masked_crc(header) != len_crc:
+                    # Framing itself is untrustworthy: the next "record"
+                    # boundary came from a corrupt length. Stop here
+                    # rather than index garbage offsets.
+                    logger.error(
+                        "%s: corrupt length crc at offset %d — framing "
+                        "lost, abandoning the rest of the file",
+                        name, pos)
+                    return index
+                pos = end
+                continue
         index.append((pos + 12, length))
         pos = end
         f.seek(pos)
 
 
-def _index_file(path: Union[str, Path]) -> list[tuple[int, int]]:
+def _index_file(path: Union[str, Path], *, on_corrupt: str = "raise",
+                stats: Optional[dict] = None) -> list[tuple[int, int]]:
     size = Path(path).stat().st_size
     with open(path, "rb") as f:
-        return _index_stream(f, size, str(path))
+        return _index_stream(f, size, str(path), on_corrupt=on_corrupt,
+                             stats=stats)
 
 
 class TFRecordSource:
@@ -395,13 +499,21 @@ class TFRecordSource:
 
     def __init__(self, paths: Union[str, Path, Sequence[Union[str, Path]]],
                  features: Optional[dict[str, tuple]] = None,
-                 max_gz_cached: int = 4):
+                 max_gz_cached: int = 4, on_corrupt: str = "raise"):
         if isinstance(paths, (str, Path)):
             paths = [paths]
+        if on_corrupt not in ("raise", "skip"):
+            raise ValueError(
+                f"on_corrupt must be 'raise' or 'skip', got {on_corrupt!r}")
         self.paths = [Path(p) for p in paths]
         if not self.paths:
             raise ValueError("TFRecordSource needs at least one path")
         self.features = features
+        self.on_corrupt = on_corrupt
+        # Pipeline-stats surface (``stats()``): corrupt-crc records the
+        # "skip" policy screened out at open — loud, countable, and
+        # never met mid-epoch.
+        self._stats = {"skipped_records": 0}
         self._index: list[tuple[int, int, int]] = []  # (file, offset, len)
         self._file_counts: list[int] = []
         # Gzip TFRecords are one stream (no per-record seek): serve random
@@ -419,12 +531,20 @@ class TFRecordSource:
             if _is_gzip(p):
                 self._gz_files.add(fi)
                 data = self._gz_bytes(fi)
-                entries = _index_stream(io.BytesIO(data), len(data), str(p))
+                entries = _index_stream(io.BytesIO(data), len(data),
+                                        str(p), on_corrupt=on_corrupt,
+                                        stats=self._stats)
             else:
-                entries = _index_file(p)
+                entries = _index_file(p, on_corrupt=on_corrupt,
+                                      stats=self._stats)
             self._file_counts.append(len(entries))
             for off, length in entries:
                 self._index.append((fi, off, length))
+        if self._stats["skipped_records"]:
+            logger.warning(
+                "TFRecordSource: skipped %d corrupt record(s) across %d "
+                "file(s) (on_corrupt='skip'); stats() has the count",
+                self._stats["skipped_records"], len(self.paths))
         # Indexing above decompressed every gzip shard once — that's
         # construction cost, not read-pattern thrash.  Reads start fresh.
         self._gz_decompressed.clear()
@@ -476,13 +596,34 @@ class TFRecordSource:
         self._handles[fi] = f  # re-insert → most recently used
         return f
 
+    def stats(self) -> dict:
+        """Pipeline stats: record counts + corrupt records screened out
+        by ``on_corrupt='skip'`` (0 under the default policy, which
+        raises instead)."""
+        return {"records": len(self._index), "files": len(self.paths),
+                "skipped_records": self._stats["skipped_records"]}
+
     def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
         if idx < 0 or idx >= len(self._index):
             raise IndexError(idx)
         fi, off, length = self._index[idx]
-        f = self._handle(fi)
-        f.seek(off)
-        rec = decode_example(f.read(length))
+
+        def _read():
+            if faults.ARMED:
+                faults.on_data_read(idx)
+            f = self._handle(fi)
+            f.seek(off)
+            return f.read(length)
+
+        raw = read_with_retries(
+            _read, f"{self.paths[fi]} record {idx}")
+        try:
+            rec = decode_example(raw)
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                f"{self.paths[fi]}: record {idx} failed to decode "
+                f"({e}) — corrupt payload; re-open with "
+                "on_corrupt='skip' to screen such records out") from e
         if self.features is None:
             return rec
         out = {}
@@ -570,7 +711,7 @@ def read_features_sidecar(root: Union[str, Path]
 
 def open_tfrecord_dir(root: Union[str, Path],
                       features: Optional[dict[str, tuple]] = None,
-                      transform=None):
+                      transform=None, on_corrupt: str = "raise"):
     """Open a directory of ``*.tfrecord``(.gz) files as a ``ConcatSource``.
 
     Each file is one FILE-autoshard part (``DataConfig(shard_policy=
@@ -608,7 +749,7 @@ def open_tfrecord_dir(root: Union[str, Path],
     # ONE source over all files (shared index + LRU handle cache), exposed
     # as per-file views so FILE autoshard still hands whole files out —
     # per-file sources would each cache fds and defeat the LRU bound.
-    source = TFRecordSource(paths, features)
+    source = TFRecordSource(paths, features, on_corrupt=on_corrupt)
     parts = source.as_parts()
     if transform is not None:
         parts = [_TransformedSource(p, transform) for p in parts]

@@ -194,6 +194,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-preemption-handler", action="store_true",
                    help="disable the SIGTERM-coordinated save-and-exit "
                         "(on by default when --checkpoint-dir is set)")
+    p.add_argument("--watch-sigint", action="store_true",
+                   help="treat SIGINT (Ctrl-C) like a preemption: "
+                        "checkpoint, stop, exit with the preemption "
+                        "code instead of a stack trace")
+    # Self-healing supervision (runtime.supervisor): run training as a
+    # child process, classify its exit (clean / preemption / crash),
+    # relaunch with exponential backoff under a restart budget.  The
+    # relaunch recovers through the normal auto-resume path, incl. the
+    # crash-consistent restore fallback in training.checkpoint.
+    p.add_argument("--supervise", action="store_true",
+                   help="run training under the self-healing supervisor "
+                        "(relaunch on crash/preemption; see MIGRATION "
+                        "§fault tolerance)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="crash restart budget under --supervise "
+                        "(preemption exits never consume it)")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   help="base crash-relaunch delay; doubles per "
+                        "consecutive crash")
+    p.add_argument("--restart-backoff-max", type=float, default=60.0,
+                   help="cap on the crash-relaunch delay")
+    p.add_argument("--no-restart-on-preemption", action="store_true",
+                   help="hand the preemption exit code to the caller "
+                        "instead of relaunching (external scheduler "
+                        "owns the restart)")
+    p.add_argument("--supervisor-journal", default=None,
+                   help="JSON-lines attempt journal (default: "
+                        "<checkpoint-dir>/supervisor.jsonl)")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="ARM deterministic fault injection "
+                        "(runtime.faults grammar, e.g. "
+                        "'step:200:kill9;ckpt:save:partial:step=40'); "
+                        "also via TTD_FAULT_PLAN — chaos testing only")
     # Observability.
     p.add_argument("--tensorboard-dir", default=None)
     p.add_argument("--jsonl-log", default=None,
@@ -432,6 +465,17 @@ def _dataset_kwargs(entry: dict, args: argparse.Namespace) -> dict:
 def run(args: argparse.Namespace) -> RunResult:
     """Build the full stack from parsed flags and train."""
     import jax
+
+    from tensorflow_train_distributed_tpu.runtime import faults
+
+    # Chaos testing: arm the fault plan (flag wins over TTD_FAULT_PLAN)
+    # before anything expensive so a typo'd spec dies immediately.
+    if getattr(args, "fault_plan", None):
+        faults.arm(args.fault_plan, seed=args.seed)
+    elif faults.arm_from_env(seed=args.seed) is None:
+        # No plan for THIS run: clear any plan a previous in-process
+        # run() armed, or its stale entries would fire into this one.
+        faults.disarm()
 
     # Flag-vs-flag errors are decidable before the expensive setup
     # (checkpoint restore, HF import, mesh build) — fail now.
@@ -812,7 +856,9 @@ def run(args: argparse.Namespace) -> RunResult:
             )
 
             try:
-                watcher = PreemptionWatcher().install()
+                watcher = PreemptionWatcher(
+                    watch_sigint=getattr(args, "watch_sigint", False),
+                ).install()
             except RuntimeError:  # not on the main thread (embedded use)
                 watcher = None
             if watcher is not None:
@@ -849,9 +895,19 @@ def run(args: argparse.Namespace) -> RunResult:
         if (ckpt is not None and not args.no_resume
                 and ckpt.latest_step() is not None):
             sample = next(iter(loader))
-            state = trainer.create_state(sample)
-            state = ckpt.restore(state)
-            logger.info("resumed from step %d", int(state.step))
+            template = trainer.create_state(sample)
+            # restore() may fall back past quarantined torn saves — or
+            # come back empty when EVERY retained step was corrupt; the
+            # relaunch then starts fresh from the init rather than
+            # crash-looping (the supervisor contract).
+            state = ckpt.restore(template)
+            if state is None:
+                logger.error(
+                    "no restorable checkpoint in %s (all retained steps "
+                    "quarantined); starting fresh", args.checkpoint_dir)
+                state = template
+            else:
+                logger.info("resumed from step %d", int(state.step))
         elif args.init_from_hf:
             # SFT entry point: start from a local HF Llama checkpoint
             # (models.import_hf) instead of random init; a later resume
@@ -1050,12 +1106,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{name}: dataset={e['dataset']} strategy={e['strategy']} "
                   f"batch={e['global_batch_size']} lr={e['learning_rate']}")
         return 0
+    if args.supervise:
+        # Re-exec this CLI (minus the supervisor flags) as a supervised
+        # child; this process becomes the relaunch loop.
+        import sys as _sys
+
+        from tensorflow_train_distributed_tpu.runtime.supervisor import (
+            supervise_cli,
+        )
+
+        return supervise_cli(
+            list(argv) if argv is not None else _sys.argv[1:], args)
+    from tensorflow_train_distributed_tpu.runtime.preemption import (
+        PREEMPTION_EXIT_CODE,
+    )
+
     result = run(args)
     if result.preempted:
-        # Non-zero so supervisors reschedule the job; 143 = SIGTERM'd by
+        # The shared exit-code contract (runtime.preemption): non-zero so
+        # schedulers reschedule, and distinct so supervisors know this
+        # was a coordinated save-and-stop, not a crash (it must not
+        # consume the crash restart budget).  143 = SIGTERM'd by
         # convention, which is what happened semantically.
         logger.warning("exiting after preemption-coordinated checkpoint")
-        return 143
+        return PREEMPTION_EXIT_CODE
     return 0
 
 

@@ -1,0 +1,335 @@
+"""Deterministic fault injection for chaos/recovery testing.
+
+The reference validates its fault-tolerance stack by killing workers
+under ``MultiProcessRunner`` (SURVEY.md §4.5) — coarse, external, and
+only reachable from tests.  This module puts the faults *inside* the
+trainer's own seams so recovery machinery (supervisor relaunch,
+crash-consistent restore, data-read retry) can be exercised
+deterministically from a CLI flag, in CI, against the real code paths.
+
+A **fault plan** is a ``;``-separated list of entries
+(``--fault-plan`` / ``TTD_FAULT_PLAN``)::
+
+    step:120:raise              # raise InjectedFault at step 120
+    step:200:kill9              # SIGKILL the process at step 200
+    step:80:sigterm             # deliver SIGTERM (preemption sim)
+    ckpt:save:partial           # corrupt the next finished save
+    ckpt:save:partial:step=40   # corrupt the step-40 save specifically
+    data:read:transient_io:p=0.01   # fail ~1% of record reads (seeded)
+    data:read:transient_io:n=2      # fail the first 2 read ATTEMPTS
+
+Data-read faults count *attempts*, and the retry loop's attempts count
+too: ``n`` below ``filesource.IO_RETRY_ATTEMPTS`` (3) is absorbed by
+retry-with-backoff; ``n`` at or above it makes one record's read fail
+through its whole budget — the persistent-outage simulation — and the
+error propagates.
+
+Every entry accepts ``attempt=K``: it is live only on supervisor
+attempt K (``TTD_SUPERVISE_ATTEMPT``, exported by
+``runtime.supervisor``) — the knob that makes a kill-at-step-N plan
+fire on the first launch and stay quiet after the relaunch, instead of
+crash-looping the restart budget away.  Non-probabilistic entries fire
+``times`` times (default once) within an attempt.
+
+Injection points are **zero-cost when no plan is armed**: call sites
+guard on the module-level ``ARMED`` flag (one attribute read — no
+function call, no dict lookup) and only enter this module when a plan
+is live.  The armed sites are the trainer step boundary
+(``training.trainer``), ``CheckpointManager.save``
+(``training.checkpoint``) and the record-level reads of the file
+sources (``data.filesource`` / ``data.tfrecord``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+ENV_PLAN = "TTD_FAULT_PLAN"
+ENV_ATTEMPT = "TTD_SUPERVISE_ATTEMPT"
+
+# The one flag injection sites check (module attribute: reading it is a
+# single LOAD_ATTR, measured ~40 ns — noise against a >1 ms train step,
+# and the read only happens once per host-loop iteration, never inside
+# jitted code).
+ARMED = False
+
+_PLAN: "Optional[FaultPlan]" = None
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised on purpose by the armed plan (``raise`` action)."""
+
+
+class InjectedTransientIO(OSError):
+    """A transient IO error injected into a record read — the retryable
+    kind (``data.filesource.read_with_retries`` absorbs it)."""
+
+
+_STEP_ACTIONS = ("raise", "kill9", "sigterm", "exit")
+_CKPT_ACTIONS = ("partial",)
+_DATA_ACTIONS = ("transient_io",)
+
+
+@dataclasses.dataclass
+class FaultEntry:
+    site: str                     # "step" | "ckpt:save" | "data:read"
+    action: str
+    trigger_step: Optional[int] = None   # step entries: fire at/after it
+    params: dict = dataclasses.field(default_factory=dict)
+    fired: int = 0
+
+    @property
+    def times(self) -> int:
+        # step/ckpt entries fire `times` times; count-based data entries
+        # spell the budget `n` (``data:read:transient_io:n=3``).
+        return int(self.params.get("times", self.params.get("n", 1)))
+
+    @property
+    def attempt(self) -> Optional[int]:
+        a = self.params.get("attempt")
+        return None if a is None else int(a)
+
+    def live(self, attempt: int) -> bool:
+        if self.attempt is not None and attempt != self.attempt:
+            return False
+        if self.action == "transient_io" and "p" in self.params:
+            return True                  # probabilistic: no fire budget
+        return self.fired < self.times
+
+
+class FaultPlan:
+    """Parsed plan + the per-process RNG for probabilistic entries."""
+
+    def __init__(self, entries: list, *, seed: int = 0,
+                 attempt: Optional[int] = None):
+        self.entries = list(entries)
+        self.attempt = (int(os.environ.get(ENV_ATTEMPT, "0"))
+                        if attempt is None else int(attempt))
+        self._rng = np.random.default_rng(
+            np.random.SeedSequence([seed, self.attempt]))
+        self._reads = 0
+
+    def __repr__(self) -> str:
+        return (f"FaultPlan(attempt={self.attempt}, "
+                f"entries={self.entries!r})")
+
+
+def _parse_params(parts: list) -> dict:
+    params = {}
+    for p in parts:
+        key, sep, val = p.partition("=")
+        if not sep or not key:
+            raise ValueError(
+                f"fault param {p!r} is not key=value")
+        try:
+            params[key] = float(val) if "." in val else int(val)
+        except ValueError:
+            raise ValueError(
+                f"fault param {p!r}: value must be numeric") from None
+    return params
+
+
+def parse_plan(spec: str, *, seed: int = 0,
+               attempt: Optional[int] = None) -> FaultPlan:
+    """Parse the plan grammar (module docstring) into a ``FaultPlan``.
+
+    Unknown sites/actions fail here — arming happens at launch time, so
+    a typo'd plan dies before any training compute is spent.
+    """
+    entries = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = [p.strip() for p in raw.split(":")]
+        site = parts[0]
+        if site == "step":
+            if len(parts) < 3:
+                raise ValueError(
+                    f"fault entry {raw!r}: want step:<N>:<action>")
+            try:
+                trigger = int(parts[1])
+            except ValueError:
+                raise ValueError(
+                    f"fault entry {raw!r}: step trigger {parts[1]!r} is "
+                    "not an integer") from None
+            action, rest = parts[2], parts[3:]
+            if action == "exit" and rest and "=" not in rest[0]:
+                # tolerate step:N:exit:7 for the exit code
+                rest = [f"code={rest[0]}"] + rest[1:]
+            if action not in _STEP_ACTIONS:
+                raise ValueError(
+                    f"fault entry {raw!r}: unknown step action "
+                    f"{action!r}; have {_STEP_ACTIONS}")
+            entries.append(FaultEntry("step", action, trigger,
+                                      _parse_params(rest)))
+        elif site == "ckpt":
+            if len(parts) < 3 or parts[1] != "save":
+                raise ValueError(
+                    f"fault entry {raw!r}: want ckpt:save:<action>")
+            action, rest = parts[2], parts[3:]
+            if action not in _CKPT_ACTIONS:
+                raise ValueError(
+                    f"fault entry {raw!r}: unknown ckpt action "
+                    f"{action!r}; have {_CKPT_ACTIONS}")
+            entries.append(FaultEntry("ckpt:save", action,
+                                      params=_parse_params(rest)))
+        elif site == "data":
+            if len(parts) < 3 or parts[1] != "read":
+                raise ValueError(
+                    f"fault entry {raw!r}: want data:read:<action>")
+            action, rest = parts[2], parts[3:]
+            if action not in _DATA_ACTIONS:
+                raise ValueError(
+                    f"fault entry {raw!r}: unknown data action "
+                    f"{action!r}; have {_DATA_ACTIONS}")
+            params = _parse_params(rest)
+            if "p" in params and not 0.0 < float(params["p"]) <= 1.0:
+                raise ValueError(
+                    f"fault entry {raw!r}: p must be in (0, 1]")
+            entries.append(FaultEntry("data:read", action, params=params))
+        else:
+            raise ValueError(
+                f"fault entry {raw!r}: unknown site {site!r}; have "
+                "step | ckpt:save | data:read")
+    if not entries:
+        raise ValueError(f"fault plan {spec!r} has no entries")
+    return FaultPlan(entries, seed=seed, attempt=attempt)
+
+
+def arm(plan, *, seed: int = 0) -> FaultPlan:
+    """Arm a plan (spec string or ``FaultPlan``) process-wide."""
+    global _PLAN, ARMED
+    if isinstance(plan, str):
+        plan = parse_plan(plan, seed=seed)
+    _PLAN = plan
+    ARMED = True
+    logger.warning("fault plan ARMED: %r", plan)
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN, ARMED
+    _PLAN = None
+    ARMED = False
+
+
+def arm_from_env(*, seed: int = 0) -> Optional[FaultPlan]:
+    """Arm from ``TTD_FAULT_PLAN`` if set (launch calls this once,
+    passing the run seed so env- and flag-armed plans produce the same
+    probabilistic fault trace)."""
+    spec = os.environ.get(ENV_PLAN)
+    if not spec:
+        return None
+    return arm(spec, seed=seed)
+
+
+def plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def _execute_step_action(entry: FaultEntry, step: int) -> None:
+    entry.fired += 1
+    if entry.action == "raise":
+        raise InjectedFault(f"injected fault at step {step}")
+    if entry.action == "kill9":
+        logger.warning("fault injection: SIGKILL at step %d", step)
+        os.kill(os.getpid(), signal.SIGKILL)
+    if entry.action == "sigterm":
+        logger.warning("fault injection: SIGTERM at step %d", step)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if entry.action == "exit":
+        code = int(entry.params.get("code", 1))
+        logger.warning("fault injection: exit(%d) at step %d", code, step)
+        # os._exit: a crash, not an orderly shutdown — no atexit, no
+        # checkpoint flush, exactly what a segfault looks like to the
+        # supervisor (minus the signal).
+        os._exit(code)
+
+
+def step_boundary(step: int) -> None:
+    """Trainer step-boundary injection point.
+
+    Fires entries whose trigger has been reached (``trigger <= step`` —
+    with ``steps_per_execution`` k>1 the loop only observes every k-th
+    boundary, and a trigger between two boundaries fires at the next
+    one rather than never).
+    """
+    p = _PLAN
+    if p is None:
+        return
+    for entry in p.entries:
+        if entry.site != "step" or not entry.live(p.attempt):
+            continue
+        if step >= entry.trigger_step:
+            _execute_step_action(entry, step)
+
+
+def on_checkpoint_save(step: int, step_dir: str,
+                       manager=None) -> None:
+    """Checkpoint-save injection point (called AFTER the manager
+    reports the save; ``manager`` lets the partial action wait out an
+    async save before mutilating the committed dir)."""
+    p = _PLAN
+    if p is None:
+        return
+    for entry in p.entries:
+        if entry.site != "ckpt:save" or not entry.live(p.attempt):
+            continue
+        want = entry.params.get("step")
+        if want is not None and int(want) != step:
+            continue
+        entry.fired += 1
+        if manager is not None:
+            manager.wait_until_finished()
+        _make_partial(step_dir)
+        logger.warning(
+            "fault injection: checkpoint step %d made PARTIAL (%s)",
+            step, step_dir)
+
+
+def _make_partial(step_dir: str) -> None:
+    """Turn a committed checkpoint step dir into a crashed-writer one:
+    drop the commit marker and truncate the array data so any restore
+    attempt fails (not just the marker pre-check)."""
+    marker = os.path.join(step_dir, "_CHECKPOINT_METADATA")
+    if os.path.exists(marker):
+        os.remove(marker)
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                with open(path, "r+b") as f:
+                    f.truncate(max(0, os.path.getsize(path) // 2))
+            except OSError:
+                pass
+
+
+def on_data_read(index: int) -> None:
+    """Record-read injection point (leaf data sources)."""
+    p = _PLAN
+    if p is None:
+        return
+    p._reads += 1
+    for entry in p.entries:
+        if entry.site != "data:read" or not entry.live(p.attempt):
+            continue
+        if "p" in entry.params:
+            if p._rng.random() < float(entry.params["p"]):
+                entry.fired += 1
+                raise InjectedTransientIO(
+                    f"injected transient IO on record {index}")
+        else:
+            entry.fired += 1
+            raise InjectedTransientIO(
+                f"injected transient IO on record {index} "
+                f"(fault {entry.fired}/{entry.times})")

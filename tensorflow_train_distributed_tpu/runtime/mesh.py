@@ -135,7 +135,29 @@ def force_platform(platform: Optional[str] = None,
     if platform:
         jax.config.update("jax_platforms", platform)
     if num_cpu_devices:
-        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        set_cpu_device_count(num_cpu_devices)
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Set the CPU backend's device count, portably across jax versions.
+
+    jax >= 0.5 has the ``jax_num_cpu_devices`` config; on jax < 0.5 the
+    count is an XLA flag, read when the CPU backend (re-)initializes —
+    so this must run before the backend is (re)built (``force_platform``
+    clears backends first; fresh child processes call it before any
+    device API).  Replaces any pre-existing count flag rather than
+    appending a duplicate.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        import os
+
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
 
 
 def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:

@@ -42,6 +42,14 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+# The preemption exit-code contract, shared with ``runtime.supervisor``:
+# a process exiting with THIS code checkpointed and stopped on purpose
+# (SIGTERM'd by convention: 128 + 15).  Supervisors relaunch it WITHOUT
+# consuming the crash restart budget — any other nonzero exit is a
+# crash.  Keep launch.py, the supervisor, and external schedulers
+# agreeing on the one constant.
+PREEMPTION_EXIT_CODE = 143
+
 
 class PreemptionWatcher:
     """Flags termination signals without doing work in signal context.
@@ -49,9 +57,15 @@ class PreemptionWatcher:
     ``install()`` chains any pre-existing handler (so test harnesses and
     outer supervisors keep working).  ``preempted`` may also be set
     programmatically (maintenance-event pollers, tests).
+    ``watch_sigint=True`` adds SIGINT — Ctrl-C on an interactive run
+    then means "checkpoint and stop" instead of a stack-trace death
+    (the reference's ``CheckpointManagerV2`` keyboard-interrupt save).
     """
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    def __init__(self, signals=(signal.SIGTERM,), *,
+                 watch_sigint: bool = False):
+        if watch_sigint and signal.SIGINT not in signals:
+            signals = tuple(signals) + (signal.SIGINT,)
         self.signals = tuple(signals)
         self._event = threading.Event()
         self._prev = {}

@@ -1,0 +1,318 @@
+"""Self-healing training supervisor: run, classify the exit, relaunch.
+
+The reference's fault-tolerance story assumes an external cluster
+manager relaunches a preempted/crashed job after
+``PreemptionCheckpointHandler`` saves (SURVEY.md §5.3) — the
+save-and-stop half lives in ``runtime.preemption``; this module is the
+bring-it-back half, so a single command survives a ``kill -9``, a
+poisoned step, or a reclaimed VM without a Borg/K8s controller above
+it.
+
+Contract:
+
+- the child is launched as a fresh process (``sys.executable -m
+  tensorflow_train_distributed_tpu ...`` via the CLI, or any argv) with
+  ``TTD_SUPERVISE_ATTEMPT=<n>`` exported — fault plans
+  (``runtime.faults``) key one-shot faults off it, and tooling can log
+  it;
+- exit 0 → done;
+- exit ``PREEMPTION_EXIT_CODE`` (143, ``runtime.preemption``) →
+  *preemption*: the job checkpointed and stopped on purpose; relaunch
+  immediately and do NOT consume the crash restart budget (a
+  maintenance event is not a bug, and budgeting it would let routine
+  preemptions exhaust the real crash protection);
+- anything else (including death by signal: Popen returncode ``-N``) →
+  *crash*: relaunch under exponential backoff until ``max_restarts``
+  crashes have been spent, then give up with the last exit code.
+
+Recovery on relaunch is the CLI's existing auto-resume
+(``--checkpoint-dir`` restores the latest step; crash-consistent
+fallback in ``training.checkpoint`` quarantines a torn latest save and
+falls back to the previous good one) — the supervisor deliberately
+knows nothing about checkpoints.
+
+Every attempt appends one JSON line to the journal (audit trail +
+test surface): ``{"event": "exit", "attempt", "rc", "class",
+"duration_s", "backoff_s"}`` and a final ``{"event": "done"|"giveup"}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+from tensorflow_train_distributed_tpu.runtime.preemption import (
+    PREEMPTION_EXIT_CODE,
+)
+
+logger = logging.getLogger(__name__)
+
+ENV_ATTEMPT = "TTD_SUPERVISE_ATTEMPT"
+
+
+def classify_exit(returncode: int) -> str:
+    """``clean`` | ``preemption`` | ``crash`` from a child returncode."""
+    if returncode == 0:
+        return "clean"
+    if returncode == PREEMPTION_EXIT_CODE:
+        return "preemption"
+    return "crash"
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    returncode: int
+    attempts: int
+    crashes: int
+    preemptions: int
+    gave_up: bool
+
+
+class TrainSupervisor:
+    """Run ``argv`` as a child process until it exits clean, the crash
+    budget is spent, or (optionally) preemptions stop being restartable.
+
+    ``backoff_s`` doubles per *consecutive* crash (a clean stretch of
+    preemptions resets nothing — only a successful exit ends the loop —
+    but the exponent counts crashes, so preemption churn never inflates
+    crash delays), capped at ``backoff_max_s``.  Preemption relaunches
+    wait a flat ``backoff_s`` (no exponent — a maintenance event is not
+    a bug, but zero delay would let a child that exits 143 at startup
+    spin the loop unboundedly).
+
+    The supervisor itself forwards SIGTERM/SIGINT to the live child and
+    then stops relaunching (``handle_signals=True``, main thread only):
+    a scheduler terminating the *supervisor* means the whole job should
+    checkpoint and stop, not lose the relaunch loop out from under a
+    training child mid-save.
+    """
+
+    def __init__(self, argv: Sequence[str], *,
+                 max_restarts: int = 3,
+                 backoff_s: float = 1.0,
+                 backoff_max_s: float = 60.0,
+                 restart_on_preemption: bool = True,
+                 journal_path: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 handle_signals: bool = True,
+                 sleep=time.sleep):
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}")
+        if backoff_s < 0:
+            raise ValueError(f"backoff_s must be >= 0, got {backoff_s}")
+        self.argv = list(argv)
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.restart_on_preemption = restart_on_preemption
+        self.journal_path = journal_path
+        self.env = env
+        self.handle_signals = handle_signals
+        self._sleep = sleep
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop_signal: Optional[int] = None
+
+    def _journal(self, record: dict) -> None:
+        if not self.journal_path:
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(self.journal_path)),
+                    exist_ok=True)
+        with open(self.journal_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def _launch(self, attempt: int) -> int:
+        env = dict(os.environ if self.env is None else self.env)
+        env[ENV_ATTEMPT] = str(attempt)
+        logger.info("supervisor attempt %d: %s", attempt,
+                    " ".join(self.argv))
+        # No stdout/stderr capture: the child IS the training job; its
+        # logs stream to the operator exactly as an unsupervised run's
+        # would.
+        self._proc = subprocess.Popen(self.argv, env=env)
+        try:
+            # PEP 475: a forwarded signal interrupts this wait, runs the
+            # handler, and the wait resumes until the child exits.
+            return self._proc.wait()
+        finally:
+            self._proc = None
+
+    def _forward_signal(self, signum, frame) -> None:
+        self._stop_signal = signum
+        logger.warning(
+            "supervisor: got signal %d; forwarding to the child and "
+            "stopping the relaunch loop after it exits", signum)
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signum)
+            except OSError:      # child raced to exit
+                pass
+
+    def run(self) -> SupervisorResult:
+        prev_handlers = {}
+        if self.handle_signals:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev_handlers[sig] = signal.signal(
+                        sig, self._forward_signal)
+                except ValueError:      # not on the main thread
+                    prev_handlers.clear()
+                    break
+        try:
+            return self._run()
+        finally:
+            for sig, handler in prev_handlers.items():
+                signal.signal(sig, handler)
+
+    def _run(self) -> SupervisorResult:
+        attempt = crashes = preemptions = 0
+        while True:
+            if self._stop_signal is not None:
+                # The stop signal landed while NO child was live (during
+                # a backoff sleep, or between exit and relaunch): there
+                # was nothing to forward it to, so stop here — launching
+                # a fresh child against the scheduler's kill would run
+                # the whole remaining job.
+                logger.warning(
+                    "supervisor: stop signal %d pending before relaunch; "
+                    "not launching attempt %d", self._stop_signal, attempt)
+                self._journal({"event": "stopped",
+                               "signal": self._stop_signal,
+                               "attempts": attempt, "crashes": crashes,
+                               "preemptions": preemptions,
+                               "rc": 128 + self._stop_signal})
+                return SupervisorResult(128 + self._stop_signal, attempt,
+                                        crashes, preemptions,
+                                        gave_up=False)
+            t0 = time.monotonic()
+            rc = self._launch(attempt)
+            duration = time.monotonic() - t0
+            klass = classify_exit(rc)
+            backoff = 0.0
+            if klass == "crash":
+                crashes += 1
+                backoff = min(self.backoff_max_s,
+                              self.backoff_s * 2 ** (crashes - 1))
+            elif klass == "preemption":
+                preemptions += 1
+                # Flat base delay, no exponent: preemption relaunches
+                # are free of the crash budget, so without it a child
+                # exiting 143 right at startup would spin unboundedly.
+                if self.restart_on_preemption:
+                    backoff = self.backoff_s
+            self._journal({"event": "exit", "attempt": attempt,
+                           "rc": rc, "class": klass,
+                           "duration_s": round(duration, 3),
+                           "backoff_s": backoff, "time": time.time()})
+            attempt += 1
+            if klass != "clean" and self._stop_signal is not None:
+                # The supervisor itself was told to stop: the child got
+                # the forwarded signal (its 143 here means it saved and
+                # stopped on purpose) — hand its code up, never relaunch
+                # against the scheduler's will.
+                self._journal({"event": "stopped",
+                               "signal": self._stop_signal,
+                               "attempts": attempt, "crashes": crashes,
+                               "preemptions": preemptions, "rc": rc})
+                return SupervisorResult(rc, attempt, crashes, preemptions,
+                                        gave_up=False)
+            if klass == "clean":
+                logger.info("supervisor: clean exit after %d attempt(s)",
+                            attempt)
+                self._journal({"event": "done", "attempts": attempt,
+                               "crashes": crashes,
+                               "preemptions": preemptions})
+                return SupervisorResult(0, attempt, crashes, preemptions,
+                                        gave_up=False)
+            if klass == "preemption":
+                if not self.restart_on_preemption:
+                    logger.warning(
+                        "supervisor: preemption exit %d; restart "
+                        "disabled — handing rc to the caller", rc)
+                    self._journal({"event": "done", "attempts": attempt,
+                                   "crashes": crashes,
+                                   "preemptions": preemptions})
+                    return SupervisorResult(rc, attempt, crashes,
+                                            preemptions, gave_up=False)
+                logger.warning(
+                    "supervisor: preemption exit (rc=%d); relaunching "
+                    "in %.2fs (crash budget untouched: %d/%d)", rc,
+                    backoff, crashes, self.max_restarts)
+                if backoff:
+                    self._sleep(backoff)
+                continue
+            # crash
+            if crashes > self.max_restarts:
+                logger.error(
+                    "supervisor: crash rc=%d exhausted the restart "
+                    "budget (%d crashes > %d restarts); giving up",
+                    rc, crashes, self.max_restarts)
+                self._journal({"event": "giveup", "attempts": attempt,
+                               "crashes": crashes,
+                               "preemptions": preemptions, "rc": rc})
+                return SupervisorResult(rc, attempt, crashes, preemptions,
+                                        gave_up=True)
+            logger.warning(
+                "supervisor: crash rc=%d (%s); relaunching in %.2fs "
+                "(crash %d/%d)", rc,
+                f"signal {-rc}" if rc < 0 else "exit",
+                backoff, crashes, self.max_restarts)
+            if backoff:
+                self._sleep(backoff)
+
+
+SUPERVISOR_FLAGS = {
+    # flag -> takes a value?  (the strip list for child argv rebuild)
+    "--supervise": False,
+    "--max-restarts": True,
+    "--restart-backoff": True,
+    "--restart-backoff-max": True,
+    "--no-restart-on-preemption": False,
+    "--supervisor-journal": True,
+}
+
+
+def strip_supervisor_flags(argv: Sequence[str]) -> list:
+    """Remove supervisor-only flags from a CLI argv, producing the
+    child's argv tail (the supervisor must not recurse)."""
+    out = []
+    i = 0
+    args = list(argv)
+    while i < len(args):
+        a = args[i]
+        flag = a.split("=", 1)[0]
+        if flag in SUPERVISOR_FLAGS:
+            if SUPERVISOR_FLAGS[flag] and "=" not in a:
+                i += 1              # consume the separate value
+            i += 1
+            continue
+        out.append(a)
+        i += 1
+    return out
+
+
+def supervise_cli(argv: Sequence[str], args) -> int:
+    """``launch.py --supervise`` entry: re-run this CLI (minus the
+    supervisor flags) under a ``TrainSupervisor`` built from ``args``."""
+    child = [sys.executable, "-m", "tensorflow_train_distributed_tpu",
+             *strip_supervisor_flags(argv)]
+    journal = args.supervisor_journal
+    if journal is None and args.checkpoint_dir:
+        journal = os.path.join(args.checkpoint_dir, "supervisor.jsonl")
+    sup = TrainSupervisor(
+        child,
+        max_restarts=args.max_restarts,
+        backoff_s=args.restart_backoff,
+        backoff_max_s=args.restart_backoff_max,
+        restart_on_preemption=not args.no_restart_on_preemption,
+        journal_path=journal,
+    )
+    return sup.run().returncode

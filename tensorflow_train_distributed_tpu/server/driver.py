@@ -173,6 +173,19 @@ class EngineDriver:
         driver-side admissions plus the engine's own queue."""
         return len(self._admit) + self._engine.queue_depth()
 
+    def alive(self) -> bool:
+        """Is the driver loop able to make progress?  False once the
+        loop died (``failure()`` has the corpse) or after a drain
+        finished — the signal /healthz and the ``driver_alive`` gauge
+        expose so load balancers stop routing to a zombie gateway
+        whose listener still accepts sockets."""
+        return self._failed is None and self._thread.is_alive()
+
+    def failure(self) -> Optional[BaseException]:
+        """The exception that killed the driver loop, if any."""
+        with self._cv:
+            return self._failed
+
     def active_slots(self) -> int:
         return self._engine.active_slots()
 

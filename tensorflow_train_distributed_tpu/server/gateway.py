@@ -93,8 +93,16 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             gw = self.gateway
             draining = gw.draining
-            self._reply_json(503 if draining else 200, {
-                "status": "draining" if draining else "ok",
+            # Driver death outranks everything but an orderly drain
+            # (drain stops the loop too — that is not a failure): a
+            # dead engine loop means every accepted request 500s, so
+            # the health check must pull this instance out of rotation
+            # even though the listener socket still answers.
+            dead = not draining and not gw.driver.alive()
+            status = ("draining" if draining
+                      else "driver_dead" if dead else "ok")
+            self._reply_json(200 if status == "ok" else 503, {
+                "status": status,
                 "queue_depth": gw.driver.waiting(),
                 "slots_in_use": gw.driver.active_slots(),
                 "slots_total": gw.engine.slots,
@@ -258,7 +266,8 @@ class ServingGateway:
         self.metrics = GatewayMetrics(
             queue_depth_fn=self.driver.waiting,
             slots_in_use_fn=self.driver.active_slots,
-            slots_total=engine.slots)
+            slots_total=engine.slots,
+            driver_alive_fn=self.driver.alive)
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

@@ -189,7 +189,8 @@ class GatewayMetrics:
     """
 
     def __init__(self, queue_depth_fn: Callable[[], int],
-                 slots_in_use_fn: Callable[[], int], slots_total: int):
+                 slots_in_use_fn: Callable[[], int], slots_total: int,
+                 driver_alive_fn: Optional[Callable[[], bool]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -208,6 +209,16 @@ class GatewayMetrics:
         self.slots_total = r.gauge(
             "ttd_gateway_slots_total", "Engine slot capacity.")
         self.slots_total.set(slots_total)
+        # Sampled at scrape time like the occupancy gauges: 1 while the
+        # engine-driver thread can make progress, 0 once it died or
+        # drained — the alert line for "listener up, engine dead".
+        self.driver_alive = r.gauge(
+            "ttd_gateway_driver_alive",
+            "1 if the engine driver loop is running, else 0.",
+            fn=(None if driver_alive_fn is None
+                else (lambda: 1.0 if driver_alive_fn() else 0.0)))
+        if driver_alive_fn is None:
+            self.driver_alive.set(1.0)
         self.ttft = r.histogram(
             "ttd_gateway_ttft_seconds",
             "Submit-to-first-generated-token latency (chunk-granular: "

@@ -21,9 +21,11 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_num_cpu_devices",
-        int(os.environ.get("TTD_TEST_LOCAL_DEVICES", "2")))
+    from tensorflow_train_distributed_tpu.runtime.mesh import (
+        set_cpu_device_count,
+    )
+
+    set_cpu_device_count(int(os.environ.get("TTD_TEST_LOCAL_DEVICES", "2")))
 
     if os.environ.get("TTD_TEST_INIT_DISTRIBUTED") == "1":
         from tensorflow_train_distributed_tpu.runtime.distributed import (

@@ -15,13 +15,22 @@ atomicity via commit markers, async so the TPU never waits on GCS/disk.
 from __future__ import annotations
 
 import logging
+import os
+import shutil
 from typing import Any, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tensorflow_train_distributed_tpu.runtime import faults
+
 logger = logging.getLogger(__name__)
+
+# Orbax's per-step commit marker: written last, so a step dir missing it
+# is a torn save from a crashed/killed writer, never a complete one.
+COMMIT_MARKER = "_CHECKPOINT_METADATA"
+QUARANTINE_DIR = "corrupt"
 
 
 class CheckpointManager:
@@ -53,10 +62,46 @@ class CheckpointManager:
         )
         if saved:
             logger.info("checkpoint saved at step %d", step)
+            if faults.ARMED:
+                faults.on_checkpoint_save(
+                    step, self._step_dir(step), manager=self)
         return saved
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(str(self._mgr.directory), str(step))
+
+    def _quarantine(self, step: int) -> str:
+        """Move a bad step dir to ``<dir>/corrupt/<step>`` (keeping the
+        evidence for post-mortem instead of deleting it) and refresh the
+        manager's cached step list so keep-N GC and ``latest_step`` stop
+        seeing the step."""
+        src = self._step_dir(step)
+        qroot = os.path.join(str(self._mgr.directory), QUARANTINE_DIR)
+        os.makedirs(qroot, exist_ok=True)
+        dst = os.path.join(qroot, str(step))
+        n = 0
+        while os.path.exists(dst):      # re-corrupted resave of a step
+            n += 1
+            dst = os.path.join(qroot, f"{step}.{n}")
+        shutil.move(src, dst)
+        self._mgr.reload()
+        return dst
+
+    def _restore_step(self, step: int, abstract):
+        """One restore attempt, validated: a missing commit marker is a
+        torn save (crashed writer) and fails *before* orbax walks the
+        tree — the cheap pre-check; everything else surfaces as
+        whatever tensorstore/orbax raises on torn array data."""
+        if not os.path.exists(os.path.join(self._step_dir(step),
+                                           COMMIT_MARKER)):
+            raise ValueError(
+                f"checkpoint step {step} has no {COMMIT_MARKER} commit "
+                "marker (torn save)")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
 
     def restore(self, abstract_state: Any, step: Optional[int] = None):
         """Restore into the shardings/dtypes of ``abstract_state``.
@@ -65,21 +110,87 @@ class CheckpointManager:
         reused — the mid-run ``BackupAndRestore`` path) or a tree of
         ShapeDtypeStructs with shardings attached.  Returns None when no
         checkpoint exists (caller starts fresh).
+
+        Crash-consistent fallback (``step=None`` — the relaunch path): a
+        step that fails to restore (torn save from a kill -9, truncated
+        arrays from a flaky disk) is moved to ``<dir>/corrupt/<step>``
+        and the previous retained step is tried, oldest-good wins —
+        a supervisor relaunch must never crash-loop on a bad latest
+        step when an older good one exists.  A missing commit marker is
+        *definitive* corruption (the marker is written last) and
+        quarantines immediately; a step whose marker is intact but
+        whose restore raises is only quarantined once an OLDER step
+        restores successfully — proof the failure is per-step
+        corruption.  If NO retained step restores and any failed with
+        an intact marker, the error re-raises with every step dir left
+        in place: that shape of failure is systemic (changed model
+        config, unreadable mount), and quarantining good checkpoints to
+        silently restart from init would destroy the run's resume
+        state.  An explicitly requested ``step`` still fails hard: the
+        caller asked for *that* state, and silently handing back a
+        different one would corrupt anything keyed on it (eval-only,
+        export).
         """
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
-            return None
-        abstract = jax.tree.map(
+        if step is not None:
+            restored = self._restore_step(step, self._abstract(
+                abstract_state))
+            logger.info("restored checkpoint step %d", step)
+            return restored
+        abstract = self._abstract(abstract_state)
+        deferred = []        # (step, error): marker-intact failures
+        while True:
+            skip = {s for s, _ in deferred}
+            steps = [s for s in self._mgr.all_steps() if s not in skip]
+            if not steps:
+                if deferred:
+                    bad_step, err = deferred[0]      # the newest failure
+                    logger.error(
+                        "no retained checkpoint restores, and step %d "
+                        "failed with an INTACT commit marker (%s: %s) — "
+                        "refusing to quarantine or fall back to fresh "
+                        "init: this looks systemic (changed model "
+                        "config, unreadable mount), not per-step "
+                        "corruption", bad_step, type(err).__name__, err)
+                    raise err
+                return None
+            step = max(steps)
+            if not os.path.exists(os.path.join(self._step_dir(step),
+                                               COMMIT_MARKER)):
+                quarantined = self._quarantine(step)
+                logger.error(
+                    "checkpoint step %d has no %s commit marker (torn "
+                    "save); quarantined to %s and falling back to the "
+                    "previous retained step", step, COMMIT_MARKER,
+                    quarantined)
+                continue
+            try:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract))
+            except Exception as e:      # noqa: BLE001 — any torn read
+                deferred.append((step, e))
+                logger.error(
+                    "checkpoint step %d failed to restore (%s: %s); "
+                    "trying the previous retained step", step,
+                    type(e).__name__, e)
+                continue
+            for bad_step, err in deferred:
+                quarantined = self._quarantine(bad_step)
+                logger.error(
+                    "checkpoint step %d failed to restore (%s: %s) "
+                    "while step %d restored cleanly — per-step "
+                    "corruption; quarantined to %s", bad_step,
+                    type(err).__name__, err, step, quarantined)
+            logger.info("restored checkpoint step %d", step)
+            return restored
+
+    @staticmethod
+    def _abstract(abstract_state: Any):
+        return jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=getattr(x, "sharding", None))
             if hasattr(x, "shape") else x,
             abstract_state,
         )
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
-        )
-        logger.info("restored checkpoint step %d", step)
-        return restored
 
     def _restore_subtrees(self, step: int, names: tuple,
                           required: tuple):

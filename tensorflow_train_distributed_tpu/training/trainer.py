@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from tensorflow_train_distributed_tpu.runtime import compat
+from tensorflow_train_distributed_tpu.runtime import compat, faults
 from tensorflow_train_distributed_tpu.parallel import collectives
 from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
 from tensorflow_train_distributed_tpu.parallel.sharding import (
@@ -596,6 +596,8 @@ class Trainer:
                 self._live_state = state
                 done += k
                 cur = start_step + done
+                if faults.ARMED:    # zero-cost seam: one attr read when off
+                    faults.step_boundary(cur)
                 pending.append((cur, metrics))
                 if done >= steps:
                     stop = True

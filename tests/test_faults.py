@@ -1,0 +1,175 @@
+"""Fault-injection layer tests: plan grammar, trigger semantics,
+inert-by-default, and the data-read retry that absorbs transient IO.
+
+The chaos *recovery* proofs (supervisor relaunch, restore fallback,
+kill-9 parity) live in test_supervisor.py / test_restore_fallback.py —
+here the injection machinery itself is pinned.
+"""
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.data.filesource import (
+    MmapArraySource,
+    read_with_retries,
+    write_shards,
+)
+from tensorflow_train_distributed_tpu.runtime import faults
+
+
+class _Src:
+    def __init__(self, n=8):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.full((4,), float(i), np.float32),
+                "y": np.asarray(i, np.int64)}
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+class TestPlanGrammar:
+    def test_issue_examples_parse(self):
+        plan = faults.parse_plan(
+            "step:120:raise;step:200:kill9;ckpt:save:partial;"
+            "data:read:transient_io:p=0.01")
+        sites = [(e.site, e.action) for e in plan.entries]
+        assert sites == [("step", "raise"), ("step", "kill9"),
+                         ("ckpt:save", "partial"),
+                         ("data:read", "transient_io")]
+        assert plan.entries[0].trigger_step == 120
+        assert plan.entries[3].params["p"] == pytest.approx(0.01)
+
+    @pytest.mark.parametrize("bad", [
+        "", "step:x:raise", "step:10:explode", "foo:1:raise",
+        "ckpt:restore:partial", "data:read:boom",
+        "data:read:transient_io:p=1.5", "step:10:raise:oops",
+    ])
+    def test_bad_specs_fail_at_parse(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_plan(bad)
+
+    def test_attempt_param(self):
+        plan = faults.parse_plan("step:5:raise:attempt=1", attempt=0)
+        assert plan.entries[0].attempt == 1
+        assert not plan.entries[0].live(plan.attempt)
+
+
+class TestStepTriggers:
+    def test_inert_by_default(self):
+        # The acceptance gate: with no plan armed the trainer-side seam
+        # is ONE module attribute read — it must be False and the
+        # module must hold no live plan.
+        assert faults.ARMED is False
+        assert faults.plan() is None
+
+    def test_raise_at_or_after_trigger_once(self):
+        faults.arm("step:5:raise")
+        assert faults.ARMED
+        faults.step_boundary(4)           # below: nothing
+        with pytest.raises(faults.InjectedFault):
+            faults.step_boundary(6)       # k>1 loop skipped 5: still fires
+        faults.step_boundary(7)           # fired once: quiet now
+
+    def test_attempt_filter_silences_entry(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+        faults.arm("step:3:raise:attempt=0")
+        faults.step_boundary(10)          # attempt 1 != 0: no fire
+        faults.disarm()
+        monkeypatch.setenv(faults.ENV_ATTEMPT, "0")
+        faults.arm("step:3:raise:attempt=0")
+        with pytest.raises(faults.InjectedFault):
+            faults.step_boundary(10)
+
+    def test_disarm_restores_inert(self):
+        faults.arm("step:1:raise")
+        faults.disarm()
+        assert faults.ARMED is False
+        faults.step_boundary(100)         # no-op
+
+
+class TestDataFaultsAndRetry:
+    def test_retry_absorbs_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return {"ok": True}
+
+        out = read_with_retries(flaky, "probe", attempts=3,
+                                sleep=lambda s: None)
+        assert out == {"ok": True} and len(calls) == 3
+
+    def test_retry_budget_exhausts(self):
+        def always():
+            raise OSError("down for good")
+
+        with pytest.raises(OSError, match="down for good"):
+            read_with_retries(always, "probe", attempts=3,
+                              sleep=lambda s: None)
+
+    def test_non_os_errors_propagate_immediately(self):
+        calls = []
+
+        def corrupt():
+            calls.append(1)
+            raise ValueError("bad bytes")
+
+        with pytest.raises(ValueError):
+            read_with_retries(corrupt, "probe", attempts=3,
+                              sleep=lambda s: None)
+        assert len(calls) == 1            # corruption is not weather
+
+    def test_mmap_source_survives_injected_transients(self, tmp_path,
+                                                      monkeypatch):
+        # n=2 injected failures < the 3-attempt retry budget: reads
+        # succeed, values untouched.
+        monkeypatch.setattr(
+            "tensorflow_train_distributed_tpu.data.filesource."
+            "IO_RETRY_BACKOFF_S", 0.0)
+        root = write_shards(tmp_path / "c", _Src(), num_shards=2)
+        src = MmapArraySource(root / "part-00000")
+        faults.arm("data:read:transient_io:n=2")
+        rec = src[0]
+        np.testing.assert_array_equal(rec["x"], np.zeros(4, np.float32))
+        assert faults.plan().entries[0].fired == 2
+
+    def test_mmap_source_raises_past_retry_budget(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setattr(
+            "tensorflow_train_distributed_tpu.data.filesource."
+            "IO_RETRY_BACKOFF_S", 0.0)
+        root = write_shards(tmp_path / "c", _Src(), num_shards=2)
+        src = MmapArraySource(root / "part-00000")
+        faults.arm("data:read:transient_io:n=99")   # persistent outage
+        with pytest.raises(OSError):
+            src[0]
+
+    def test_probabilistic_faults_are_seeded(self):
+        def sample(seed):
+            faults.disarm()
+            plan = faults.parse_plan("data:read:transient_io:p=0.5",
+                                     seed=seed, attempt=0)
+            faults.arm(plan)
+            hits = []
+            for i in range(64):
+                try:
+                    faults.on_data_read(i)
+                    hits.append(0)
+                except faults.InjectedTransientIO:
+                    hits.append(1)
+            return hits
+
+        a, b, c = sample(7), sample(7), sample(8)
+        assert a == b                     # same seed → same fault trace
+        assert a != c                     # seed moves the trace
+        assert 0 < sum(a) < 64            # actually probabilistic

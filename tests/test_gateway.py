@@ -388,13 +388,31 @@ def test_unread_body_rejections_close_the_connection():
                     + f"Content-Length: {len(body)}\r\n\r\n".encode()
                     + body)
 
+        def _read_response(s):
+            # Headers + Content-Length body: one recv may return a
+            # partial response (the server flushes headers and body in
+            # separate writes), so read to the framed end.
+            data = b""
+            while b"\r\n\r\n" not in data:
+                data += s.recv(65536)
+            head, _, body = data.partition(b"\r\n\r\n")
+            for line in head.decode().lower().splitlines():
+                if line.startswith("content-length:"):
+                    n = int(line.split(":", 1)[1])
+                    break
+            else:
+                n = 0
+            while len(body) < n:
+                body += s.recv(65536)
+            return head.decode()
+
         with socket.create_connection(("127.0.0.1", gw.port),
                                       timeout=10) as s:
             s.sendall(_req(b"not json"))
-            assert s.recv(65536).decode().startswith("HTTP/1.1 400")
+            assert _read_response(s).startswith("HTTP/1.1 400")
             s.sendall(_req(json.dumps({"prompt": [3],
                                        "max_new": 1}).encode()))
-            assert s.recv(65536).decode().startswith("HTTP/1.1 200")
+            assert _read_response(s).startswith("HTTP/1.1 200")
     finally:
         gw.drain(timeout=10)
 
@@ -675,3 +693,78 @@ def test_gateway_real_engine_smoke(llama_tiny):
         assert "vocab" in obj["error"]     # admission, as serve_http's
     finally:
         gw.drain(timeout=30)
+
+
+# ── driver-death detection ─────────────────────────────────────────────
+
+
+def test_driver_death_flips_healthz_and_gauge():
+    """When the driver loop dies, /healthz must pull the instance out
+    of rotation (503 driver_dead) and /metrics must expose
+    ttd_gateway_driver_alive 0 — the listener socket alone staying up
+    is exactly the zombie state a load balancer cannot see."""
+    class ExplodingEngine(StubEngine):
+        def serve_step(self):
+            raise RuntimeError("device exploded")
+
+    gw = _make_gateway(ExplodingEngine())
+    try:
+        assert gw.driver.alive()
+        status, body, _ = _get(gw.port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        s = _parse_prom(_get(gw.port, "/metrics")[1])
+        assert s["ttd_gateway_driver_alive"] == 1
+
+        # First request detonates the loop; the submitter gets 500.
+        status, obj, _ = _post(gw.port, {"prompt": [1], "max_new": 2})
+        assert status == 500
+
+        deadline = time.monotonic() + 5
+        while gw.driver.alive():
+            assert time.monotonic() < deadline, "driver never died"
+            time.sleep(0.005)
+        assert "device exploded" in repr(gw.driver.failure())
+        status, body, _ = _get(gw.port, "/healthz")
+        assert status == 503
+        assert json.loads(body)["status"] == "driver_dead"
+        s = _parse_prom(_get(gw.port, "/metrics")[1])
+        assert s["ttd_gateway_driver_alive"] == 0
+    finally:
+        gw._httpd.shutdown()
+        gw._httpd.server_close()
+
+
+def test_driver_death_fails_pending_handles_fast():
+    """Requests already admitted (queued behind a busy slot) when the
+    loop dies must resolve with the failure immediately — not hang
+    until their deadline."""
+    class DiesOnSecondStep(StubEngine):
+        def __init__(self):
+            super().__init__(slots=1, step_delay=0.02)
+            self.steps = 0
+
+        def serve_step(self):
+            self.steps += 1
+            if self.steps >= 2:
+                raise RuntimeError("mid-flight death")
+            return super().serve_step()
+
+    drv = EngineDriver(DiesOnSecondStep(), max_queue=8).start()
+    # Long deadlines: only fail-fast (not expiry) can finish these soon.
+    handles = [drv.submit([1], 50, timeout_s=60.0) for _ in range(3)]
+    t0 = time.monotonic()
+    for h in handles:
+        with pytest.raises(RuntimeError, match="driver failed"):
+            h.result(timeout=10)
+    assert time.monotonic() - t0 < 5     # nowhere near the 60 s deadline
+    with pytest.raises(RuntimeError, match="driver failed"):
+        drv.submit([1], 1)
+    assert not drv.alive()
+
+
+def test_driver_alive_false_after_drain():
+    gw = _make_gateway(StubEngine())
+    assert gw.driver.alive()
+    gw.drain(timeout=10)
+    assert not gw.driver.alive()
+    assert gw.driver.failure() is None   # orderly stop, not a corpse

@@ -1,0 +1,126 @@
+"""Crash-consistent restore: torn/corrupt latest step → quarantine +
+fall back to the previous good step, never a crash-loop.
+
+Pure checkpoint-layer tests on tiny dict states (no Trainer, no jit) so
+they stay tier-1 fast; the end-to-end kill-9 proof that drives this
+machinery through the CLI lives in test_supervisor.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.training.checkpoint import (
+    COMMIT_MARKER,
+    CheckpointManager,
+    QUARANTINE_DIR,
+)
+
+
+def _state(v: float) -> dict:
+    return {"params": {"w": np.full((8,), v, np.float32),
+                       "b": np.full((3,), -v, np.float32)},
+            "step": np.asarray(int(v))}
+
+
+@pytest.fixture()
+def mgr3(tmp_path):
+    """A manager with steps 1..3 saved (values = step number)."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    for s in (1, 2, 3):
+        assert mgr.save(s, _state(s))
+    mgr.wait_until_finished()
+    yield mgr, tmp_path / "ck"
+    mgr.close()
+
+
+def _drop_marker(ck, step):
+    os.remove(ck / str(step) / COMMIT_MARKER)
+
+
+def _truncate_arrays(ck, step):
+    """Torn array data under an INTACT commit marker (flaky disk, not a
+    crashed writer): every file below default/ is cut in half."""
+    for root, _, files in os.walk(ck / str(step) / "default"):
+        for name in files:
+            path = os.path.join(root, name)
+            with open(path, "r+b") as f:
+                f.truncate(max(0, os.path.getsize(path) // 2))
+
+
+class TestRestoreFallback:
+    def test_missing_commit_marker_falls_back(self, mgr3):
+        mgr, ck = mgr3
+        _drop_marker(ck, 3)
+        restored = mgr.restore(_state(0))
+        assert int(np.asarray(restored["step"])) == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.full((8,), 2.0, np.float32))
+        # Bad dir quarantined (evidence kept), gone from the step list.
+        assert (ck / QUARANTINE_DIR / "3").is_dir()
+        assert not (ck / "3").exists()
+        assert mgr.latest_step() == 2
+
+    def test_truncated_arrays_fall_back(self, mgr3):
+        mgr, ck = mgr3
+        _truncate_arrays(ck, 3)
+        restored = mgr.restore(_state(0))
+        assert int(np.asarray(restored["step"])) == 2
+        assert (ck / QUARANTINE_DIR / "3").is_dir()
+
+    def test_cascading_corruption_reaches_oldest_good(self, mgr3):
+        mgr, ck = mgr3
+        _drop_marker(ck, 3)
+        _truncate_arrays(ck, 2)
+        restored = mgr.restore(_state(0))
+        assert int(np.asarray(restored["step"])) == 1
+        assert (ck / QUARANTINE_DIR / "3").is_dir()
+        assert (ck / QUARANTINE_DIR / "2").is_dir()
+
+    def test_all_corrupt_returns_none(self, mgr3):
+        mgr, ck = mgr3
+        for s in (1, 2, 3):
+            _drop_marker(ck, s)
+        assert mgr.restore(_state(0)) is None
+        assert mgr.latest_step() is None
+
+    def test_explicit_step_fails_hard(self, mgr3):
+        # The caller asked for THAT state (eval-only, export): silently
+        # serving a different step would corrupt anything keyed on it.
+        mgr, ck = mgr3
+        _drop_marker(ck, 3)
+        with pytest.raises(ValueError, match="commit marker"):
+            mgr.restore(_state(0), step=3)
+        assert (ck / "3").exists()        # no quarantine on explicit asks
+
+    def test_save_continues_after_quarantine(self, mgr3):
+        mgr, ck = mgr3
+        _drop_marker(ck, 3)
+        assert int(np.asarray(mgr.restore(_state(0))["step"])) == 2
+        assert mgr.save(4, _state(4))     # keep-N bookkeeping survived
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 4
+        assert int(np.asarray(mgr.restore(_state(0))["step"])) == 4
+
+    def test_systemic_failure_raises_and_quarantines_nothing(self, mgr3):
+        # EVERY step fails with an intact commit marker: that is not
+        # per-step corruption (shape-mismatched config, dead mount) —
+        # restore must fail loudly with all step dirs left in place,
+        # never displace good checkpoints and restart from init.
+        mgr, ck = mgr3
+        for s in (1, 2, 3):
+            _truncate_arrays(ck, s)
+        with pytest.raises(Exception):
+            mgr.restore(_state(0))
+        assert not (ck / QUARANTINE_DIR).exists()
+        for s in (1, 2, 3):
+            assert (ck / str(s)).is_dir()
+        assert mgr.latest_step() == 3
+
+    def test_clean_restore_untouched(self, mgr3):
+        mgr, ck = mgr3
+        restored = mgr.restore(_state(0))
+        assert int(np.asarray(restored["step"])) == 3
+        assert not (ck / QUARANTINE_DIR).exists()

@@ -362,3 +362,95 @@ def test_gzip_dir_open_and_autodetect(tmp_path):
     src = open_tfrecord_dir(tmp_path)
     assert len(src) == 6
     np.testing.assert_array_equal(src[4]["v"], [4])
+
+
+class TestOnCorruptPolicy:
+    """on_corrupt='skip': corrupt-crc records are screened out at open
+    (never met mid-epoch) and counted in the pipeline stats; the
+    default 'raise' keeps the historical fail-loudly behavior."""
+
+    @staticmethod
+    def _flip_payload_byte(path, record_index, payloads):
+        """Flip one payload byte of record ``record_index`` (framing =
+        8-byte len + 4 len-crc + payload + 4 payload-crc per record)."""
+        off = sum(16 + len(p) for p in payloads[:record_index]) + 12
+        raw = bytearray(path.read_bytes())
+        raw[off] ^= 0xFF
+        path.write_bytes(bytes(raw))
+
+    def _write_examples(self, path, n=4):
+        payloads = []
+        with TFRecordWriter(path) as w:
+            for i in range(n):
+                pl = encode_example(
+                    {"x": np.full((4,), i, np.int64)})
+                w.write(pl)
+                payloads.append(pl)
+        return payloads
+
+    def test_skip_drops_corrupt_record_and_counts_it(self, tmp_path):
+        p = tmp_path / "x.tfrecord"
+        payloads = self._write_examples(p, n=4)
+        self._flip_payload_byte(p, 1, payloads)
+        src = TFRecordSource(p, {"x": ((4,), np.int64)},
+                             on_corrupt="skip")
+        assert len(src) == 3
+        # Surviving records decode to their original values: 0, 2, 3.
+        vals = [int(src[i]["x"][0]) for i in range(3)]
+        assert vals == [0, 2, 3]
+        assert src.stats() == {"records": 3, "files": 1,
+                               "skipped_records": 1}
+
+    def test_default_raise_keeps_corruption_loud(self, tmp_path):
+        p = tmp_path / "x.tfrecord"
+        payloads = self._write_examples(p, n=3)
+        self._flip_payload_byte(p, 1, payloads)
+        # Default policy: the corrupt record is still indexed (cheap
+        # seek-only pass) and reading it raises mid-epoch.
+        src = TFRecordSource(p, {"x": ((4,), np.int64)})
+        assert len(src) == 3
+        assert src.stats()["skipped_records"] == 0
+        with pytest.raises(ValueError):
+            src[1]
+        # Intact neighbors still read clean around the bad record.
+        assert int(src[0]["x"][0]) == 0
+        assert int(src[2]["x"][0]) == 2
+
+    def test_skip_handles_truncated_tail(self, tmp_path):
+        # A crashed writer's short last record: skip mode drops the
+        # tail and serves the intact prefix (raise mode fails at open —
+        # test_truncated_file_fails_at_open above).
+        p = tmp_path / "x.tfrecord"
+        self._write_examples(p, n=4)
+        p.write_bytes(p.read_bytes()[:-10])
+        src = TFRecordSource(p, {"x": ((4,), np.int64)},
+                             on_corrupt="skip")
+        assert len(src) == 3
+        assert src.stats()["skipped_records"] == 1
+
+    def test_read_records_skip_policy(self, tmp_path):
+        p = tmp_path / "x.tfrecord"
+        payloads = []
+        with TFRecordWriter(p) as w:
+            for i in range(4):
+                pl = f"payload-{i}".encode()
+                w.write(pl)
+                payloads.append(pl)
+        self._flip_payload_byte(p, 2, payloads)
+        stats = {}
+        out = list(read_records(p, on_corrupt="skip", stats=stats))
+        assert out == [b"payload-0", b"payload-1", b"payload-3"]
+        assert stats["skipped_records"] == 1
+
+    def test_invalid_policy_rejected(self, tmp_path):
+        p = tmp_path / "x.tfrecord"
+        self._write_examples(p, n=1)
+        with pytest.raises(ValueError, match="on_corrupt"):
+            TFRecordSource(p, on_corrupt="ignore")
+
+    def test_dir_open_passes_policy_through(self, tmp_path):
+        payloads = self._write_examples(tmp_path / "a.tfrecord", n=4)
+        self._flip_payload_byte(tmp_path / "a.tfrecord", 0, payloads)
+        write_features_sidecar(tmp_path, {"x": ((4,), np.int64)})
+        src = open_tfrecord_dir(tmp_path, on_corrupt="skip")
+        assert len(src) == 3

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""One-command chaos smoke: kill -9 + torn checkpoint → full recovery.
+
+Runs a tiny LeNet/MNIST job twice on the CPU backend:
+
+1. **reference** — uninterrupted ``--steps N``;
+2. **chaos** — the same config under ``--supervise`` with a canned
+   fault plan: the step-4 checkpoint save is made PARTIAL (commit
+   marker dropped, arrays truncated — a crashed writer) and the
+   process is SIGKILLed at step 5, both only on supervisor attempt 0.
+
+The supervisor must classify the kill as a crash, relaunch, and the
+relaunch must quarantine the torn step-4 save, fall back to step 2,
+resume the data stream mid-epoch, and finish — with final params
+**bitwise identical** to the reference run.  Verdict is a JSON line on
+stdout; exit 0 iff every check passed.  Usable locally and as a CI
+gate; the tier-1 chaos parity test drives this same entry point.
+
+Usage::
+
+    python tools/chaos_check.py [--workdir DIR] [--steps 8]
+"""
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:    # runnable as `python tools/chaos_check.py`
+    sys.path.insert(0, REPO_ROOT)
+
+KILL_STEP = 5
+CORRUPT_STEP = 4
+CKPT_EVERY = 2
+
+
+def _cli(steps, ckpt_dir, *extra):
+    return [
+        sys.executable, "-m", "tensorflow_train_distributed_tpu",
+        "--config", "mnist", "--steps", str(steps),
+        "--platform", "cpu", "--cpu-devices", "2",
+        "--strategy", "dp", "--global-batch-size", "16",
+        "--log-every", "1", "--seed", "0",
+        "--checkpoint-dir", ckpt_dir,
+        "--checkpoint-every", str(CKPT_EVERY),
+        *extra,
+    ]
+
+
+def run_chaos_check(workdir: str, *, steps: int = 8,
+                    timeout_s: float = 600.0) -> dict:
+    """Run the scenario; return ``{"ok", "checks", ...}``."""
+    import numpy as np
+
+    ref_dir = os.path.join(workdir, "ref")
+    chaos_dir = os.path.join(workdir, "chaos")
+    journal = os.path.join(workdir, "supervisor.jsonl")
+    checks = {}
+
+    ref = subprocess.run(_cli(steps, ref_dir), capture_output=True,
+                         text=True, timeout=timeout_s, cwd=REPO_ROOT)
+    checks["reference_rc0"] = ref.returncode == 0
+    if not checks["reference_rc0"]:
+        return {"ok": False, "checks": checks,
+                "stderr": ref.stderr[-2000:]}
+
+    plan = (f"ckpt:save:partial:step={CORRUPT_STEP}:attempt=0;"
+            f"step:{KILL_STEP}:kill9:attempt=0")
+    chaos = subprocess.run(
+        _cli(steps, chaos_dir,
+             "--supervise", "--max-restarts", "2",
+             "--restart-backoff", "0.05",
+             "--supervisor-journal", journal,
+             "--fault-plan", plan),
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT)
+    checks["chaos_rc0"] = chaos.returncode == 0
+
+    # Supervisor journal: exactly one crash (the SIGKILL, rc=-9), then
+    # a clean exit — the preemption/crash classification surface.
+    events = []
+    if os.path.exists(journal):
+        with open(journal) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    exits = [e for e in events if e.get("event") == "exit"]
+    checks["killed_then_clean"] = (
+        len(exits) == 2
+        and exits[0]["class"] == "crash" and exits[0]["rc"] == -9
+        and exits[1]["class"] == "clean")
+
+    # The torn step-4 save was quarantined, not deleted and not served.
+    quarantined = os.path.join(chaos_dir, "corrupt", str(CORRUPT_STEP))
+    checks["bad_step_quarantined"] = os.path.isdir(quarantined)
+    checks["fell_back_to_previous"] = (
+        f"restored checkpoint step {CORRUPT_STEP - CKPT_EVERY}"
+        in chaos.stderr + chaos.stdout)
+
+    # Headline: final params bitwise-equal to the uninterrupted run.
+    bitwise = False
+    if checks["chaos_rc0"]:
+        # The parity check reads checkpoints in-process: force the same
+        # CPU topology the child CLIs trained on (orbax rebuilds each
+        # array's sharding from the checkpoint's sharding file, which
+        # names those devices; env vars are too late under launchers
+        # whose sitecustomize imports jax — see tests/conftest.py).
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform("cpu", 2)
+        from tensorflow_train_distributed_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        mr = CheckpointManager(ref_dir, async_save=False)
+        mc = CheckpointManager(chaos_dir, async_save=False)
+        try:
+            pr = mr.restore_params(steps)
+            pc = mc.restore_params(steps)
+            import jax
+
+            leaves_r = jax.tree.leaves(pr)
+            leaves_c = jax.tree.leaves(pc)
+            bitwise = len(leaves_r) == len(leaves_c) and all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(leaves_r, leaves_c))
+        finally:
+            mr.close()
+            mc.close()
+    checks["params_bitwise_equal"] = bitwise
+
+    return {"ok": all(checks.values()), "checks": checks,
+            "journal": exits,
+            "chaos_tail": (chaos.stderr[-1500:]
+                           if not all(checks.values()) else "")}
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(
+        prog="chaos_check",
+        description="kill-9 + torn-checkpoint recovery smoke test")
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir (default: a fresh temp dir)")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the scratch dir for inspection")
+    args = p.parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_check_")
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        verdict = run_chaos_check(workdir, steps=args.steps)
+    finally:
+        if not args.keep and args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Run any command under the self-healing training supervisor.
+
+``launch.py --supervise`` covers the common case (supervising this
+repo's own CLI); this tool supervises an ARBITRARY training command —
+a shell script, a different entry point, a container runner — with the
+same exit-code contract (``runtime.preemption.PREEMPTION_EXIT_CODE``
+relaunches without consuming the crash budget) and the same JSON-lines
+attempt journal::
+
+    tools/train_supervisor.py --max-restarts 5 \
+        --journal /ckpt/supervisor.jsonl -- \
+        python -m tensorflow_train_distributed_tpu \
+        --config mnist --steps 2000 --checkpoint-dir /ckpt
+
+Everything after ``--`` is the child argv, launched verbatim with
+``TTD_SUPERVISE_ATTEMPT`` exported per attempt.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    p = argparse.ArgumentParser(
+        prog="train_supervisor",
+        description="self-healing relaunch loop for a training command",
+    )
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="crash restart budget (preemption exits are "
+                        "free)")
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="base crash-relaunch delay; doubles per "
+                        "consecutive crash")
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--no-restart-on-preemption", action="store_true",
+                   help="return the preemption exit code instead of "
+                        "relaunching")
+    p.add_argument("--journal", default=None,
+                   help="append one JSON line per attempt to this file")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   metavar="-- COMMAND ...",
+                   help="child argv (prefix with --)")
+    args = p.parse_args(argv)
+    child = args.command
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        p.error("no child command given (put it after --)")
+
+    from tensorflow_train_distributed_tpu.runtime.supervisor import (
+        TrainSupervisor,
+    )
+
+    result = TrainSupervisor(
+        child,
+        max_restarts=args.max_restarts,
+        backoff_s=args.backoff,
+        backoff_max_s=args.backoff_max,
+        restart_on_preemption=not args.no_restart_on_preemption,
+        journal_path=args.journal,
+    ).run()
+    logging.getLogger("train_supervisor").info(
+        "attempts=%d crashes=%d preemptions=%d gave_up=%s rc=%d",
+        result.attempts, result.crashes, result.preemptions,
+        result.gave_up, result.returncode)
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
