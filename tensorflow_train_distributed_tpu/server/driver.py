@@ -9,8 +9,13 @@ Between decode chunks — ``ServingEngine.serve_step()`` hands control
 back exactly for this — the driver refills the engine's queue from
 admissions, resolves finished requests, streams newly committed tokens,
 and enforces per-request deadlines (``engine.cancel`` frees the slot).
-No device code runs anywhere else, so the bridge composes with every
-engine configuration (sampling, int8, speculative, TP meshes) untouched.
+With the engine's async decode pipelining (the default), ``serve_step``
+returns WITH A CHUNK STILL IN FLIGHT, so every one of those host passes
+— harvest/stream/deadline after the step, admission refill before the
+next — runs inside the overlap window while the device computes; the
+loop body needs no special casing, only this ordering.  No device code
+runs anywhere else, so the bridge composes with every engine
+configuration (sampling, int8, speculative, TP meshes) untouched.
 
 Load shedding happens at ``submit()``: requests waiting for a slot
 (admitted here + queued inside the engine) are capped at ``max_queue``;
@@ -75,6 +80,7 @@ class RequestHandle:
         self.deadline = deadline
         self.t_submit = time.monotonic()
         self.first_token_at: Optional[float] = None
+        self.last_commit_at: Optional[float] = None  # inter-token feed
         self._streamed = len(prompt)    # tokens already pushed/known
         self._queue: Optional[queue.Queue] = (
             queue.Queue() if stream else None)
@@ -312,8 +318,16 @@ class EngineDriver:
                     if self._metrics is not None:
                         self._metrics.ttft.observe(now - handle.t_submit)
                 fresh = handle._push_new(tokens)
-                if fresh and self._metrics is not None:
-                    self._metrics.tokens.inc(fresh)
+                if fresh:
+                    if self._metrics is not None:
+                        self._metrics.tokens.inc(fresh)
+                        if handle.last_commit_at is not None:
+                            # Commit-to-commit gap amortized over the
+                            # tokens it delivered: the stream's
+                            # per-token pace, chunk-granular.
+                            self._metrics.inter_token.observe(
+                                (now - handle.last_commit_at) / fresh)
+                    handle.last_commit_at = now
             if finished:
                 del self._inflight[rid]
                 self._count("ok")

@@ -267,7 +267,10 @@ class ServingGateway:
             queue_depth_fn=self.driver.waiting,
             slots_in_use_fn=self.driver.active_slots,
             slots_total=engine.slots,
-            driver_alive_fn=self.driver.alive)
+            driver_alive_fn=self.driver.alive,
+            # getattr: test stubs (and any engine without the decode
+            # lookahead) scrape a truthful constant 0.
+            overlap_ratio_fn=getattr(engine, "overlap_ratio", None))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

@@ -29,6 +29,11 @@ from typing import Callable, Optional, Sequence
 LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Inter-token latency lives well below the request ladder (sub-ms on a
+# warm accelerator): extend downward so the histogram resolves it.
+INTER_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                       0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
 
 def _fmt(v: float) -> str:
     """Prometheus sample value: integers render bare (no exponent)."""
@@ -190,7 +195,8 @@ class GatewayMetrics:
 
     def __init__(self, queue_depth_fn: Callable[[], int],
                  slots_in_use_fn: Callable[[], int], slots_total: int,
-                 driver_alive_fn: Optional[Callable[[], bool]] = None):
+                 driver_alive_fn: Optional[Callable[[], bool]] = None,
+                 overlap_ratio_fn: Optional[Callable[[], float]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -219,10 +225,26 @@ class GatewayMetrics:
                 else (lambda: 1.0 if driver_alive_fn() else 0.0)))
         if driver_alive_fn is None:
             self.driver_alive.set(1.0)
+        # Fraction of the engine's host harvest/refill time hidden
+        # under device compute by async decode pipelining — the
+        # driver-visible proof the overlap path engages (0 under the
+        # TTD_NO_OVERLAP kill switch, or for engines without the
+        # lookahead, e.g. test stubs).
+        self.engine_overlap_ratio = r.gauge(
+            "ttd_engine_overlap_ratio",
+            "Host harvest time overlapped with device decode, as a "
+            "fraction of total harvest time (0 = synchronous path).",
+            fn=overlap_ratio_fn)
         self.ttft = r.histogram(
             "ttd_gateway_ttft_seconds",
             "Submit-to-first-generated-token latency (chunk-granular: "
             "tokens commit per decode chunk).")
+        self.inter_token = r.histogram(
+            "ttd_gateway_inter_token_seconds",
+            "Per-token generation latency: commit-to-commit gap "
+            "divided by the tokens it delivered (observed per "
+            "committed chunk after a request's first).",
+            buckets=INTER_TOKEN_BUCKETS)
         self.latency = r.histogram(
             "ttd_gateway_request_latency_seconds",
             "Submit-to-completion latency per served request.")

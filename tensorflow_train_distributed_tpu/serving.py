@@ -20,6 +20,22 @@ static-shaped decode program:
   from bench_generate); the host harvests finished requests (EOS or
   budget) between chunks and refills their slots from the queue.
 
+**Async decode pipelining** (PROFILE.md measured the decode step
+host-bound: llama_125m 2.13 ms/step vs a 0.38 ms weight-streaming
+roofline): by default ``serve_step`` runs with ONE-CHUNK LOOKAHEAD —
+the per-slot carry (next token, rng counters) stays device-resident,
+chunk N+1 is dispatched from those device arrays the moment chunk N is
+in flight (JAX async dispatch: enqueueing needs no sync), and chunk N's
+host copy is harvested — stop detection, streaming, refills — while the
+device computes N+1.  Stop/refill decisions therefore LAG ONE CHUNK: a
+slot whose request finished in chunk N keeps decoding garbage through
+chunk N+1; the harvest records which request occupied each slot at
+dispatch time and trims anything stale, so outputs are bitwise-identical
+to the synchronous path (greedy, seeded sampling, and speculative —
+per-slot seed/count streams are deterministic under trimming).
+``TTD_NO_OVERLAP=1`` (or ``overlap=False`` / the CLIs' ``--no-overlap``)
+is the kill switch back to the synchronous path.
+
 Shapes are static everywhere (slot count, cache rows, chunk length,
 prompt buckets / prefill pieces) — only cache *contents* and the
 per-slot index vector change, so XLA compiles a handful of programs
@@ -41,6 +57,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
+import time
 from collections import deque
 from functools import partial
 from typing import Optional
@@ -79,6 +97,13 @@ class _SlotState:
     done: bool = False
 
 
+def _overlap_killed() -> bool:
+    """The production kill switch: ``TTD_NO_OVERLAP=1`` forces the
+    synchronous decode path regardless of how the engine was
+    constructed (an env flip needs no redeploy of callers)."""
+    return os.environ.get("TTD_NO_OVERLAP", "0") not in ("", "0")
+
+
 def _bucket_len(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
@@ -110,7 +135,8 @@ class ServingEngine:
                  draft_config=None, draft_params=None,
                  draft_quant_scales=None,
                  speculative_k: int = 0,
-                 prompt_buckets=(32, 64, 128, 256, 512, 1024)):
+                 prompt_buckets=(32, 64, 128, 256, 512, 1024),
+                 overlap: Optional[bool] = None):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
         if (getattr(config, "sliding_window", None) is not None
@@ -273,6 +299,25 @@ class ServingEngine:
         self._cache_shapes: dict = {}  # (model, batch) -> eval_shape
         self._moe_prefill_lens: set = set()  # distinct exact-prefill lens
         self._prefix_caches: dict = {}  # tuple(tokens) -> batch-1 cache
+        # Async decode pipelining (one-chunk lookahead).  ``overlap``
+        # None/True enables it; TTD_NO_OVERLAP=1 kills it either way.
+        self.overlap = ((True if overlap is None else bool(overlap))
+                        and not _overlap_killed())
+        # The chunk in flight: rids pins which request occupied each
+        # slot AT DISPATCH — harvest trims anything that retired or was
+        # refilled since (the one-chunk decision lag made safe).
+        self._inflight: Optional[dict] = None
+        # Device-resident carry feeding the NEXT dispatch: (tok [slots],
+        # counts [slots]) — never materialized on the host, so a chunk
+        # can be enqueued while its predecessor still computes.
+        self._carry = None
+        self._refills: set = set()     # slots refilled since last dispatch
+        # overlapped_harvests counts harvest passes that ran with a
+        # successor chunk already in flight; the _s pair feeds
+        # overlap_ratio() (the host-stall share the lookahead hides).
+        self.overlap_stats = {"chunks": 0, "overlapped_harvests": 0,
+                              "harvest_s": 0.0,
+                              "overlapped_harvest_s": 0.0}
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -450,7 +495,11 @@ class ServingEngine:
 
         t_cache = jax.tree_util.tree_map_with_path(rewind, t_cache)
         d_cache = jax.tree_util.tree_map_with_path(rewind, d_cache)
-        return t_cache, d_cache, emit, emitted, next_tok, a
+        # counts + emitted: the NEXT round's rng counters, computed in
+        # the same program so the overlap scheduler's device-resident
+        # carry costs zero extra dispatches (the sync path ignores it).
+        return (t_cache, d_cache, emit, emitted, next_tok, a,
+                counts + emitted)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, cache_b, cache_1, slot, true_len):
@@ -470,7 +519,11 @@ class ServingEngine:
     def _decode_chunk(self, variables, cache, tok, seeds, counts):
         """``chunk`` decode steps for all slots; one device round-trip.
         ``seeds``/``counts`` [slots]: each slot's sampling stream and
-        how many tokens it has already drawn (greedy ignores both)."""
+        how many tokens it has already drawn (greedy ignores both).
+        Also returns the NEXT chunk's (tok, counts) carry — computed
+        inside the same program so the overlap scheduler can chain
+        chunks with zero extra dispatches (the sync path ignores
+        them)."""
         def step(carry, j):
             cache, tok = carry
             with quantized_inference():
@@ -481,9 +534,10 @@ class ServingEngine:
                 tok.dtype)
             return (upd["cache"], nxt), nxt
 
-        (cache, _), toks = jax.lax.scan(
+        (cache, last), toks = jax.lax.scan(
             step, (cache, tok), jnp.arange(self.chunk))
-        return cache, jnp.moveaxis(toks, 0, 1)      # [slots, chunk]
+        return (cache, jnp.moveaxis(toks, 0, 1),    # [slots, chunk]
+                last, counts + self.chunk)
 
     # -- host-side loop ----------------------------------------------------
 
@@ -759,6 +813,10 @@ class ServingEngine:
                             self._d_cache, d_cache_1, jnp.int32(slot),
                             jnp.int32(len(prompt)))
                 self._slot_states[slot] = state
+                # Overlap bookkeeping: the next dispatch must splice
+                # this slot's host-known token/count over the device
+                # carry (which still holds the previous tenant's).
+                self._refills.add(slot)
 
     def _consume(self, state, tokens) -> None:
         """Append generated tokens to a slot's request, enforcing the
@@ -780,23 +838,33 @@ class ServingEngine:
             self._outputs[state.request_id] = state.tokens
             self._slot_states[slot] = None
 
-    def _harvest(self, toks: np.ndarray):
+    def _harvest(self, toks: np.ndarray, rids=None):
+        """``rids`` (overlap mode): the slot->request map captured at
+        dispatch — a slot whose occupant changed since (retired and
+        refilled, or cancelled) must NOT consume this chunk's tokens;
+        they belong to the previous tenant and are trimmed here."""
         for slot, state in enumerate(self._slot_states):
             if state is None:
+                continue
+            if rids is not None and state.request_id != rids[slot]:
                 continue
             self._consume(state, toks[slot])
             self._retire_if_done(slot, state)
 
-    def _harvest_spec(self, emit, emitted, next_tok, accepted):
+    def _harvest_spec(self, emit, emitted, next_tok, accepted,
+                      rids=None):
         """Consume each slot's emitted prefix from a speculative round
         (variable per slot; budget/EOS via the shared consume rule),
         tracking acceptance stats.  The round's bonus token is the last
         emitted one, so a surviving slot's ``last_token`` already holds
-        ``next_tok`` after consuming."""
+        ``next_tok`` after consuming.  ``rids``: the overlap trim
+        guard, same rule as ``_harvest``."""
         del next_tok  # == emit[slot, emitted-1], consumed above
         self.spec_stats["rounds"] += 1     # engine rounds, not slot-rounds
         for slot, state in enumerate(self._slot_states):
             if state is None:
+                continue
+            if rids is not None and state.request_id != rids[slot]:
                 continue
             before = len(state.tokens)
             self.spec_stats["slot_rounds"] += 1
@@ -810,6 +878,14 @@ class ServingEngine:
         return (len(self._queue)
                 + sum(s is not None for s in self._slot_states))
 
+    def progress(self) -> dict:
+        """Token COUNTS so far per in-flight request, ``{request_id:
+        len(prompt + generated)}`` — the O(slots) poll for TTFT/pace
+        tracking (``snapshot()`` copies whole token lists; benches
+        polling every step want this instead)."""
+        return {s.request_id: len(s.tokens)
+                for s in self._slot_states if s is not None}
+
     def snapshot(self) -> dict:
         """Tokens generated SO FAR for every in-flight request,
         ``{request_id: [prompt + generated]}`` — the streaming view
@@ -819,13 +895,200 @@ class ServingEngine:
         return {s.request_id: list(s.tokens)
                 for s in self._slot_states if s is not None}
 
+    # -- async decode pipelining (one-chunk lookahead) ---------------------
+
+    def _carry_arrays(self):
+        """The next dispatch's (tok, counts): the device-resident carry
+        from the previous chunk, with host values spliced in for slots
+        refilled since (``jnp.where`` only ENQUEUES — still no sync).
+        Retired-but-unrefilled slots keep garbage carry and decode
+        garbage, exactly as idle slots already do on the sync path."""
+        if self._carry is None:
+            # First dispatch of the session: everything is host-known.
+            tok = np.zeros((self.slots,), np.int32)
+            counts = np.zeros((self.slots,), np.int32)
+            for slot, state in enumerate(self._slot_states):
+                if state is not None:
+                    tok[slot] = state.last_token
+                    counts[slot] = state.count
+            self._refills.clear()
+            return jnp.asarray(tok), jnp.asarray(counts)
+        tok, counts = self._carry
+        if self._refills:
+            mask = np.zeros((self.slots,), bool)
+            tok_h = np.zeros((self.slots,), np.int32)
+            cnt_h = np.zeros((self.slots,), np.int32)
+            for slot in self._refills:
+                state = self._slot_states[slot]
+                if state is None:      # refilled then cancelled
+                    continue
+                mask[slot] = True
+                tok_h[slot] = state.last_token
+                cnt_h[slot] = state.count
+            jmask = jnp.asarray(mask)
+            tok = jnp.where(jmask, jnp.asarray(tok_h), tok)
+            counts = jnp.where(jmask, jnp.asarray(cnt_h), counts)
+            self._refills.clear()
+        return tok, counts
+
+    def _dispatch_chunk(self) -> None:
+        """Enqueue one decode chunk (or speculative round) for ALL
+        slots from the device-resident carry.  No host sync: the call
+        returns while the device may still be computing the PREVIOUS
+        chunk — the successor simply queues behind it.  Captures the
+        dispatch-time slot->request map the harvest's trim guard
+        needs."""
+        seeds = np.zeros((self.slots,), np.uint32)
+        rids: list = [None] * self.slots
+        for slot, state in enumerate(self._slot_states):
+            if state is not None:
+                seeds[slot] = state.seed
+                rids[slot] = state.request_id
+        with self._ctx():
+            tok, counts = self._carry_arrays()
+            jseeds = jnp.asarray(seeds)
+            if self._draft_model is not None:
+                (self._cache, self._d_cache, emit, emitted, next_tok,
+                 acc, counts_next) = self._spec_round(
+                    self._variables, self._draft_variables, self._cache,
+                    self._d_cache, tok, jseeds, counts)
+                # Continuing slots consumed exactly ``emitted`` tokens,
+                # so the device advances their rng counters itself —
+                # the property that lets round N+1 enqueue before round
+                # N's host copy exists.
+                self._carry = (next_tok, counts_next)
+                self._inflight = {"spec": True, "rids": rids,
+                                  "emit": emit, "emitted": emitted,
+                                  "next_tok": next_tok, "acc": acc}
+            else:
+                (self._cache, toks, last,
+                 counts_next) = self._decode_chunk(
+                    self._variables, self._cache, tok, jseeds, counts)
+                self._carry = (last, counts_next)
+                self._inflight = {"spec": False, "rids": rids,
+                                  "toks": toks}
+        self.overlap_stats["chunks"] += 1
+
+    def _skip_eager_dispatch(self) -> bool:
+        """Whether to fall back to harvest-first for this one step:
+        when EVERY active slot certainly retires in the in-flight chunk
+        (budget exhaustion is host-predictable — ``remaining`` is
+        known; EOS is not), an eager successor would be garbage end to
+        end — the tail chunk of a session, or a mass-retirement
+        boundary where the whole next chunk should decode refills
+        instead.
+
+        A SINGLE retiring lane keeps eager dispatch: its garbage costs
+        one lane-chunk (~chunk/slots of device work, often zero when
+        the queue is empty — the chunk is lockstep across slots), which
+        measures cheaper than surrendering the overlapped host pass
+        (policy A/B'd on the CPU mesh; revisit on silicon).
+
+        Horizons: a plain chunk emits exactly ``chunk`` tokens per
+        lane, so ``remaining <= chunk`` is certain retirement; a
+        speculative round GUARANTEES only one emitted token (every
+        draft rejected), so only ``remaining <= 1`` is certain —
+        anything looser would surrender the overlap for up to k+1
+        rounds at every batch tail."""
+        horizon = (1 if self._draft_model is not None else self.chunk)
+        certain = [s.remaining <= horizon
+                   for s in self._slot_states if s is not None]
+        return bool(certain) and all(certain)
+
+    def _harvest_prev(self, inf: dict, overlapped: bool) -> None:
+        """Materialize the previous chunk's host copy (this blocks
+        until THAT chunk finishes — when ``overlapped``, the successor
+        is already enqueued and keeps the device busy through the wait
+        and the host passes that follow) and consume it under the
+        dispatch-time rid guard.  Only the post-materialization host
+        pass is timed into ``overlap_stats``: the block inside
+        ``np.asarray`` is device time, not host-harvest time, and would
+        drown the ratio."""
+        rids = inf["rids"]
+        if inf["spec"]:
+            args = (np.asarray(inf["emit"]), np.asarray(inf["emitted"]),
+                    np.asarray(inf["next_tok"]), np.asarray(inf["acc"]))
+        else:
+            toks = np.asarray(inf["toks"])
+        t0 = time.perf_counter()
+        if inf["spec"]:
+            self._harvest_spec(*args, rids=rids)
+        else:
+            self._harvest(toks, rids=rids)
+        dt = time.perf_counter() - t0
+        self.overlap_stats["harvest_s"] += dt
+        if overlapped:
+            self.overlap_stats["overlapped_harvests"] += 1
+            self.overlap_stats["overlapped_harvest_s"] += dt
+
+    def overlap_ratio(self) -> float:
+        """Fraction of host harvest wall time spent with a successor
+        chunk concurrently in flight — the host-stall share the
+        lookahead hides (0.0 under TTD_NO_OVERLAP/overlap=False).
+        The gateway exposes it as ``ttd_engine_overlap_ratio``.
+
+        Scraped from the gateway's metrics thread while the driver
+        harvests: ``_harvest_prev`` bumps the denominator BEFORE the
+        numerator, so reading numerator first (plus the clamp) keeps a
+        torn read inside the documented [0, 1]."""
+        num = self.overlap_stats["overlapped_harvest_s"]
+        total = self.overlap_stats["harvest_s"]
+        if total <= 0.0:
+            return 0.0
+        return min(1.0, num / total)
+
     def serve_step(self) -> dict:
         """ONE service iteration: refill free slots from the queue, run
         one decode chunk, harvest — then hand control back, so callers
         can ``submit()`` new requests between steps (online serving: the
         queue never has to be complete up front).  Returns the requests
         that FINISHED this step, ``{request_id: tokens}`` (possibly
-        empty); poll ``pending()`` for completion."""
+        empty); poll ``pending()`` for completion.
+
+        With ``overlap`` on (the default), the step is PIPELINED: the
+        successor chunk is dispatched from the device-resident carry
+        BEFORE the in-flight chunk's host copy is touched, so stop
+        detection, refills, and the caller's streaming/deadline passes
+        (which run between ``serve_step`` calls — a chunk stays in
+        flight across the return) all hide under device compute.  Stop
+        decisions lag one chunk; the harvest trims the overshoot, so
+        outputs are bitwise-identical to the synchronous path.  Note a
+        finished session leaves one garbage chunk in flight — harmless,
+        discarded by the next cycle's trim guard."""
+        if not self.overlap:
+            return self._serve_step_sync()
+        prev, self._inflight = self._inflight, None
+        if self._queue and any(s is None for s in self._slot_states):
+            # Requests that arrived since the last harvest (the online
+            # pattern: callers submit between steps) take their free
+            # lanes BEFORE the eager dispatch, so they ride the very
+            # next chunk — their prefills enqueue behind the in-flight
+            # chunk, still overlapped.  Without this, a freed lane
+            # would idle one extra chunk per turnaround.
+            self._fill_free_slots()
+        dispatched = False
+        if (any(s is not None for s in self._slot_states)
+                and not self._skip_eager_dispatch()):
+            self._dispatch_chunk()          # device busy through the
+            dispatched = True               # host passes below
+        if prev is not None:
+            self._harvest_prev(prev, overlapped=dispatched)
+        self._fill_free_slots()
+        if not dispatched and any(s is not None
+                                  for s in self._slot_states):
+            # Nothing was in flight to hide this pass behind (first
+            # step of a session / a harvest-first fallback step /
+            # post-idle restart): dispatch now so the NEXT step's
+            # harvest overlaps.
+            self._dispatch_chunk()
+        out, self._outputs = self._outputs, {}
+        return out
+
+    def _serve_step_sync(self) -> dict:
+        """The synchronous path ``TTD_NO_OVERLAP``/``overlap=False``
+        restores: dispatch one chunk, block on its host copy, harvest —
+        the device idles through every host pass (the PROFILE.md
+        host-stall), but scheduling decisions never lag."""
         self._fill_free_slots()
         # (No active slots == everything resolved at prefill time or
         # nothing queued: skip the decode, just drain what finished.)
@@ -841,7 +1104,7 @@ class ServingEngine:
             if self._draft_model is not None:
                 with self._ctx():
                     (self._cache, self._d_cache, emit, emitted,
-                     next_tok, acc) = self._spec_round(
+                     next_tok, acc, _) = self._spec_round(
                         self._variables, self._draft_variables,
                         self._cache, self._d_cache, jnp.asarray(tok),
                         jnp.asarray(seeds), jnp.asarray(counts))
@@ -851,7 +1114,7 @@ class ServingEngine:
                                    np.asarray(acc))
             else:
                 with self._ctx():
-                    self._cache, toks = self._decode_chunk(
+                    self._cache, toks, _, _ = self._decode_chunk(
                         self._variables, self._cache, jnp.asarray(tok),
                         jnp.asarray(seeds), jnp.asarray(counts))
                 self._harvest(np.asarray(toks))

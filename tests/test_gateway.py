@@ -254,6 +254,14 @@ def test_metrics_scrape_parses_and_counters_move():
         assert s["ttd_gateway_tokens_generated_total"] == gen
         assert s["ttd_gateway_request_latency_seconds_count"] == n
         assert s["ttd_gateway_ttft_seconds_count"] == n
+        # Inter-token observations: one per commit after a request's
+        # first — the stub commits one token per step, so max_new - 1
+        # observations per request.
+        assert s["ttd_gateway_inter_token_seconds_count"] == gen - n
+        # The stub engine has no decode lookahead: the overlap gauge
+        # must render a truthful constant 0 (a real-engine gateway's
+        # value is pinned in tests/test_serving_overlap.py).
+        assert s["ttd_engine_overlap_ratio"] == 0
         assert s["ttd_gateway_slots_total"] == 2
         assert s["ttd_gateway_queue_depth"] == 0
         assert s["ttd_gateway_slots_in_use"] == 0

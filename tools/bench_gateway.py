@@ -4,9 +4,14 @@ Drives ``tools/serve_http.py``'s gateway with ``--clients`` concurrent
 closed-loop clients (each sends its next request only after the
 previous one answers — the canonical serving-latency harness shape) and
 reports the bench trajectory's first serving-latency datapoints: p50 /
-p99 request latency, generated tokens/sec, and the shed rate (429s per
-attempt; a shed client honors Retry-After and retries, so the loop
-stays closed under overload).
+p99 request latency, generated tokens/sec, mean TTFT and inter-token
+latency (scraped from the gateway's own /metrics histograms), and the
+shed rate (429s per attempt; a shed client honors Retry-After and
+retries, so the loop stays closed under overload).  In-process runs
+A/B the engine's async decode pipelining by default — overlap ON is
+the headline, OFF lands in a ``no_overlap`` sub-record with the
+``ttd_engine_overlap_ratio`` the driver would scrape; ``--no-ab``
+skips the OFF leg.
 
 Self-contained by default — builds a random-init ``--preset`` engine
 and an in-process gateway on an ephemeral port, so the bench needs no
@@ -98,38 +103,46 @@ def _percentile(sorted_vals, q):
                            int(q * (len(sorted_vals) - 1) + 0.5))]
 
 
-def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
-                  requests_per_client, prompt_range, new_range,
-                  cache_len, seed, timeout):
-    gw = None
-    if base_url:
-        vocab = 30_000       # external gateway: conservative id ceiling
-    else:
-        import jax
-        import jax.numpy as jnp
+def _prom_sample(text: str, name: str) -> float:
+    """One unlabeled sample value from a Prometheus text body (0.0
+    when absent — external gateways may run older builds)."""
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
 
-        from tensorflow_train_distributed_tpu.models.llama import (
-            LLAMA_PRESETS, LlamaModel,
-        )
-        from tensorflow_train_distributed_tpu.server import ServingGateway
-        from tensorflow_train_distributed_tpu.serving import ServingEngine
 
-        cfg = LLAMA_PRESETS[preset]
-        vocab = min(cfg.vocab_size, 30_000)
-        params = LlamaModel(cfg).init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
-        eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
-                            cache_len=cache_len)
-        gw = ServingGateway(eng, host="127.0.0.1", port=0,
-                            max_queue=max_queue).start()
-        base_url = f"http://127.0.0.1:{gw.port}"
+def _scrape(base_url: str) -> str:
+    try:
+        with urllib.request.urlopen(base_url + "/metrics",
+                                    timeout=10) as r:
+            return r.read().decode()
+    except OSError:
+        return ""
 
+
+def _histogram_mean_ms(text: str, name: str, base: str = "") -> float:
+    """Mean in ms of a cumulative histogram, optionally net of an
+    earlier scrape ``base`` (isolates the timed window)."""
+    count = (_prom_sample(text, f"{name}_count")
+             - _prom_sample(base, f"{name}_count"))
+    total = (_prom_sample(text, f"{name}_sum")
+             - _prom_sample(base, f"{name}_sum"))
+    return round(1e3 * total / count, 3) if count > 0 else 0.0
+
+
+def _run_closed_loop(base_url, clients, requests_per_client,
+                     prompt_range, new_range, vocab, seed, timeout):
+    """Warmup + the closed-loop client fleet against ``base_url``;
+    returns the latency/throughput record fields plus the gateway's own
+    /metrics-derived TTFT / inter-token means and overlap ratio."""
     # Warmup: ONE request through the full path compiles every program
     # (prefill bucket + decode chunk) before the timed window.
     status, obj, _ = _post(base_url,
                            {"prompt": [1, 2, 3], "max_new": 4}, timeout)
     if status != 200:
         raise RuntimeError(f"warmup request failed with HTTP {status}")
+    prom_base = _scrape(base_url)
 
     workers = [
         _Client(base_url,
@@ -149,31 +162,91 @@ def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
     sheds = sum(w.sheds for w in workers)
     failures = sum(w.failures for w in workers)
     attempts = len(lats) + sheds + failures
-    rec = {
-        "metric": f"{preset}_gateway_tokens_per_sec",
-        "value": round(gen / dt, 1) if dt else 0.0,
-        "unit": "generated tokens/sec",
+    # TTFT / inter-token come from the gateway's own histograms (the
+    # driver observes them chunk-granularly; a closed-loop client
+    # cannot see first-token timing without streaming every request).
+    # Histograms are cumulative since gateway start, so the means diff
+    # the scrape taken before the fleet — the warmup request's
+    # compile-laden TTFT never pollutes the numbers.
+    prom = _scrape(base_url)
+    return {
+        "tokens_per_sec": round(gen / dt, 1) if dt else 0.0,
         "wall_s": round(dt, 3),
         "p50_latency_ms": round(1e3 * _percentile(lats, 0.50), 1),
         "p99_latency_ms": round(1e3 * _percentile(lats, 0.99), 1),
+        "ttft_ms_mean": _histogram_mean_ms(
+            prom, "ttd_gateway_ttft_seconds", prom_base),
+        "inter_token_ms_mean": _histogram_mean_ms(
+            prom, "ttd_gateway_inter_token_seconds", prom_base),
+        "overlap_ratio": _prom_sample(prom, "ttd_engine_overlap_ratio"),
         "shed_rate": round(sheds / attempts, 4) if attempts else 0.0,
         "n_ok": len(lats),
         "n_shed": sheds,
         "n_failed": failures,
         "gen_tokens": gen,
-        "clients": clients,
-        "requests_per_client": requests_per_client,
-        "slots": slots,
-        "chunk": chunk,
-        "max_queue": max_queue,
     }
-    if gw is not None:
-        import jax
 
-        dev = jax.devices()[0]
-        rec["backend"] = dev.platform
-        rec["device_kind"] = dev.device_kind
-        gw.drain(timeout=30)
+
+def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
+                  requests_per_client, prompt_range, new_range,
+                  cache_len, seed, timeout, overlap_ab=True):
+    loop_args = (clients, requests_per_client, prompt_range, new_range)
+
+    def finish(rec):
+        rec.update({
+            "metric": f"{preset}_gateway_tokens_per_sec",
+            "value": rec.pop("tokens_per_sec"),
+            "unit": "generated tokens/sec",
+            "clients": clients,
+            "requests_per_client": requests_per_client,
+            "slots": slots,
+            "chunk": chunk,
+            "max_queue": max_queue,
+        })
+        return rec
+
+    if base_url:
+        # External gateway: its engine is whatever it was launched
+        # with — no overlap A/B possible from here.
+        vocab = 30_000       # conservative id ceiling
+        return finish(_run_closed_loop(base_url, *loop_args, vocab,
+                                       seed, timeout))
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.server import ServingGateway
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    vocab = min(cfg.vocab_size, 30_000)
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def one_mode(overlap):
+        eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                            cache_len=cache_len, overlap=overlap)
+        gw = ServingGateway(eng, host="127.0.0.1", port=0,
+                            max_queue=max_queue).start()
+        try:
+            return _run_closed_loop(f"http://127.0.0.1:{gw.port}",
+                                    *loop_args, vocab, seed, timeout)
+        finally:
+            gw.drain(timeout=30)
+
+    rec = finish(one_mode(overlap=True))
+    dev = jax.devices()[0]
+    rec["backend"] = dev.platform
+    rec["device_kind"] = dev.device_kind
+    if overlap_ab:
+        off = one_mode(overlap=False)
+        rec["no_overlap"] = off
+        if rec["value"] and off["tokens_per_sec"]:
+            rec["overlap_speedup"] = round(
+                rec["value"] / off["tokens_per_sec"], 3)
     return rec
 
 
@@ -199,6 +272,9 @@ def main(argv=None) -> int:
                    help="0 -> config.max_positions")
     p.add_argument("--timeout", type=float, default=300.0,
                    help="client-side HTTP timeout per request")
+    p.add_argument("--no-ab", action="store_true",
+                   help="skip the overlap-OFF leg of the async-decode "
+                        "pipelining A/B (in-process runs only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
@@ -225,7 +301,7 @@ def main(argv=None) -> int:
                 args.base_url, args.preset, args.slots, args.chunk,
                 args.max_queue, args.clients, args.requests_per_client,
                 prompt_range, new_range, args.cache_len or None,
-                args.seed, args.timeout)
+                args.seed, args.timeout, overlap_ab=not args.no_ab)
     except Exception as e:
         print(json.dumps({
             "metric": f"{args.preset}_gateway_tokens_per_sec",

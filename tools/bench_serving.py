@@ -2,11 +2,16 @@
 
 Serves a mixed-length synthetic request stream through
 ``serving.ServingEngine`` (slot-refill decode) and reports GENERATED
-tokens/sec.  ``--baseline`` also times the static-batch path the engine
-replaces — same requests grouped into arrival-order batches of
-``--slots``, each batch padded to its longest prompt and decoded for its
-largest max_new (what ``generate()`` forces) — so the engine's win IS
-the padding/straggler waste it removes.
+tokens/sec plus p50 TTFT and mean inter-token latency.  By default the
+run is an A/B over async decode pipelining — overlap ON (the headline
+numbers) vs OFF (``no_overlap`` sub-record) — with the engine's
+``overlap_ratio`` (host-harvest share hidden under device compute)
+committed alongside; ``--no-ab`` skips the OFF leg.  ``--baseline``
+also times the static-batch path the engine replaces — same requests
+grouped into arrival-order batches of ``--slots``, each batch padded to
+its longest prompt and decoded for its largest max_new (what
+``generate()`` forces) — so the engine's win IS the padding/straggler
+waste it removes.
 
 Prints one JSON line per run (bench_lm.py conventions).
 """
@@ -19,7 +24,10 @@ import sys
 import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
+sys.path.insert(0, _HERE)                   # tools/ siblings
+
+from bench_gateway import _percentile  # noqa: E402 (shared helper)
 
 
 def _requests(n, plo, phi, glo, ghi, vocab, seed):
@@ -30,9 +38,42 @@ def _requests(n, plo, phi, glo, ghi, vocab, seed):
              int(rng.integers(glo, ghi + 1))) for _ in range(n)]
 
 
+def _run_engine_timed(eng, reqs):
+    """One timed pass: submit everything, drive ``serve_step``, record
+    per-request first-token and completion times (the serving-latency
+    view ``run()`` cannot give).  Returns ``(wall_s, ttfts, itls,
+    total_tokens_out)`` — ``itls`` are per-request mean inter-token
+    gaps (completion-first)/(generated-1), requests with >1 generated
+    token only."""
+    ids = [eng.submit(p, m) for p, m in reqs]
+    plens = {rid: len(p) for rid, (p, _) in zip(ids, reqs)}
+    first, done_at, out = {}, {}, {}
+    t0 = time.perf_counter()
+    while eng.pending():
+        done = eng.serve_step()
+        now = time.perf_counter()
+        for rid, toks in done.items():
+            out[rid] = toks
+            done_at[rid] = now
+            if rid not in first and len(toks) > plens[rid]:
+                first[rid] = now
+        for rid, n in eng.progress().items():
+            if rid not in first and n > plens[rid]:
+                first[rid] = now
+    wall = time.perf_counter() - t0
+    ttfts = sorted(first[r] - t0 for r in ids if r in first)
+    itls = []
+    for rid in ids:
+        gen = len(out[rid]) - plens[rid]
+        if rid in first and rid in done_at and gen > 1:
+            itls.append((done_at[rid] - first[rid]) / (gen - 1))
+    return wall, ttfts, itls, sum(len(v) for v in out.values())
+
+
 def bench_serving(preset, slots, chunk, n_requests, prompt_range,
                   new_range, cache_len, baseline, seed,
-                  draft_preset="", speculative_k=0):
+                  draft_preset="", speculative_k=0, overlap_ab=True,
+                  reps=3):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -61,26 +102,65 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         draft_cfg = LLAMA_PRESETS[draft_preset]
         draft_params = LlamaModel(draft_cfg).init(
             jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
-    # ONE engine for warmup + timed runs: the jitted programs are keyed
-    # on the engine instance (static self), so a fresh engine would pay
-    # every compile again inside the timed region.  run() is reentrant
-    # (tests/test_serving.py) — stale slot caches cannot contaminate.
-    eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
-                        cache_len=cache_len, draft_config=draft_cfg,
-                        draft_params=draft_params,
-                        speculative_k=speculative_k if draft_cfg else 0)
 
-    def run_engine():
-        for p, m in reqs:
-            eng.submit(p, m)
-        out = eng.run()
-        # Materialize (run() already fetched host-side token lists).
-        return sum(len(v) for v in out.values())
+    def make_engine(overlap):
+        return ServingEngine(
+            cfg, params, slots=slots, chunk=chunk, cache_len=cache_len,
+            draft_config=draft_cfg, draft_params=draft_params,
+            speculative_k=speculative_k if draft_cfg else 0,
+            overlap=overlap)
 
-    run_engine()                                   # warmup: compiles
-    t0 = time.perf_counter()
-    total_len = run_engine()
-    dt = time.perf_counter() - t0
+    def warm(overlap):
+        # ONE engine for warmup + timed runs: the jitted programs are
+        # keyed on the engine instance (static self), so a fresh engine
+        # would pay every compile again inside the timed region.
+        # run()/serve_step are reentrant (tests/test_serving.py) —
+        # stale slot caches cannot contaminate.
+        e = make_engine(overlap)
+        for p, m in reqs:                          # warmup: compiles
+            e.submit(p, m)
+        e.run()
+        return e
+
+    def one_pass(e):
+        # Zero the accounting per pass so the committed ratio
+        # describes the best pass's window only.
+        for k in e.overlap_stats:
+            e.overlap_stats[k] = 0 if isinstance(
+                e.overlap_stats[k], int) else 0.0
+        rec = _run_engine_timed(e, reqs)
+        return rec + (dict(e.overlap_stats), e.overlap_ratio())
+
+    def summarize(best):
+        wall, ttfts, itls, total, stats, ratio = best
+        return {
+            "tokens_per_sec": round(gen_tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_ms_p50": round(1e3 * _percentile(ttfts, 0.5), 2),
+            "inter_token_ms_mean": round(
+                1e3 * sum(itls) / len(itls), 3) if itls else 0.0,
+            "overlap_ratio": round(ratio, 3),
+            "overlapped_harvests": stats["overlapped_harvests"],
+        }, total
+
+    # Best-of-``reps``, with the A/B legs INTERLEAVED (on, off, on,
+    # off, ...): single-pass walls on a shared/loaded host are noisy at
+    # these scales, min-wall reads through scheduler noise, and
+    # alternating the legs keeps slow drift in background load from
+    # biasing whichever leg runs later.
+    eng = warm(overlap=True)
+    eng_off = warm(overlap=False) if overlap_ab else None
+    best_on = best_off = None
+    for _ in range(max(1, reps)):
+        rec = one_pass(eng)
+        if best_on is None or rec[0] < best_on[0]:
+            best_on = rec
+        if eng_off is not None:
+            rec = one_pass(eng_off)
+            if best_off is None or rec[0] < best_off[0]:
+                best_off = rec
+    on_rec, total_len = summarize(best_on)
+    dt = on_rec["wall_s"]
     dev = jax.devices()[0]
     # Ceiling ('self') and floor (random-init) runs must be
     # distinguishable by metric name alone, not just the draft_preset
@@ -89,9 +169,13 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
             if draft_preset else f"{preset}_serving_engine")
     rec = {
         "metric": f"{name}_tokens_per_sec",
-        "value": round(gen_tokens / dt, 1),
+        "value": on_rec["tokens_per_sec"],
         "unit": "generated tokens/sec",
-        "wall_s": round(dt, 3),
+        "wall_s": dt,
+        "ttft_ms_p50": on_rec["ttft_ms_p50"],
+        "inter_token_ms_mean": on_rec["inter_token_ms_mean"],
+        "overlap_ratio": on_rec["overlap_ratio"],
+        "overlapped_harvests": on_rec["overlapped_harvests"],
         "slots": slots,
         "chunk": chunk,
         "n_requests": n_requests,
@@ -100,6 +184,14 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         "backend": dev.platform,
         "device_kind": dev.device_kind,
     }
+    if overlap_ab:
+        # The OFF leg: the synchronous path the TTD_NO_OVERLAP kill
+        # switch restores — the host-stall A/B the headline claims.
+        off_rec, _ = summarize(best_off)
+        rec["no_overlap"] = off_rec
+        if off_rec["wall_s"]:
+            rec["overlap_speedup"] = round(
+                off_rec["wall_s"] / dt, 3) if dt else 0.0
     if draft_preset:
         rec["draft_preset"] = draft_preset
         rec["speculative_k"] = speculative_k
@@ -163,6 +255,12 @@ def main(argv=None) -> int:
                         "for itself, the acceptance CEILING — the pair "
                         "brackets real trained drafts)")
     p.add_argument("--speculative-k", type=int, default=4)
+    p.add_argument("--no-ab", action="store_true",
+                   help="skip the overlap-OFF leg of the async-decode "
+                        "pipelining A/B (halves the timed work)")
+    p.add_argument("--reps", type=int, default=3,
+                   help="timed passes per leg; min wall is reported "
+                        "(reads through host scheduler noise)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
@@ -190,7 +288,9 @@ def main(argv=None) -> int:
                                 args.cache_len or None, args.baseline,
                                 args.seed,
                                 draft_preset=args.speculative_draft,
-                                speculative_k=args.speculative_k)
+                                speculative_k=args.speculative_k,
+                                overlap_ab=not args.no_ab,
+                                reps=args.reps)
     except Exception as e:
         name = (f"{args.preset}_serving_engine_spec"
                 if args.speculative_draft

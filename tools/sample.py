@@ -5,8 +5,11 @@ checkpoint dir (params-only partial restore — no optimizer state
 materialized) or a local HuggingFace checkpoint, then run the KV-cache
 ``generate`` path (greedy / temperature / top-k / top-p).
 
-No tokenizer ships in this environment, so prompts are token ids:
-``--prompt 1,15043,29892`` (comma-separated), repeatable for a batch.
+Prompts are token ids: ``--prompt 1,15043,29892`` (comma-separated),
+repeatable for a batch.  This CLI does no text tokenization itself —
+transformers+tokenizers ARE installed in this image, so turn text into
+ids with the checkpoint's own tokenizer (e.g.
+``AutoTokenizer.from_pretrained(hf_dir).encode(text)``).
 
 Examples:
   python tools/sample.py --config llama_tiny_sft --checkpoint-dir /ck \\
@@ -94,6 +97,21 @@ def check_vocab_ids(rows, vocab_size: int) -> None:
                          f"{sorted(set(bad))[:8]}")
 
 
+def apply_dispatch_arg(args, cfg, is_moe):
+    """--dispatch override, applied to the config BEFORE weights load
+    (dense and gmm share one parameter tree, so the override never
+    invalidates a checkpoint) — shared with serve.py/serve_http.py."""
+    if not getattr(args, "dispatch", ""):
+        return cfg
+    if not is_moe:
+        raise SystemExit("--dispatch selects the MoE expert-dispatch "
+                         "formulation; it does not apply to dense "
+                         "decoder configs")
+    import dataclasses
+
+    return dataclasses.replace(cfg, dispatch=args.dispatch)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--config", required=True,
@@ -138,6 +156,12 @@ def main(argv=None) -> int:
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
                    help="draft block length per round")
+    p.add_argument("--dispatch", default="", choices=["", "dense", "gmm"],
+                   help="MoE expert-dispatch override (MoE configs "
+                        "only). 'gmm' is DROPLESS — routing, and "
+                        "therefore outputs, legitimately differ from "
+                        "capacity-dropped 'dense'. Default: the "
+                        "config's own setting")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
     args = p.parse_args(argv)
@@ -156,6 +180,7 @@ def main(argv=None) -> int:
     from tensorflow_train_distributed_tpu.models.generate import generate
 
     task, cfg, is_moe = resolve_decoder_task(args.config, "sampling")
+    cfg = apply_dispatch_arg(args, cfg, is_moe)
 
     rows = [parse_prompt_spec(spec) for spec in args.prompt]
     if not rows or any(not r for r in rows):

@@ -15,8 +15,11 @@ emitting each result as one JSONL line ``{"id", "prompt", "tokens"}``
 (tokens = prompt + continuation, exactly generate()'s convention).
 
 Requests come from repeated ``--prompt`` flags or ``--requests FILE``
-(JSONL: ``{"prompt": [ids...], "max_new": N, "seed": S?}``).  No
-tokenizer ships in this environment, so prompts are token ids.
+(JSONL: ``{"prompt": [ids...], "max_new": N, "seed": S?}``).  Prompts
+are token ids; this CLI does no text tokenization itself (transformers
++ tokenizers ARE installed in this image — load the checkpoint's
+``tokenizer.json`` with ``tokenizers``/``transformers`` to turn text
+into ids, e.g. ``AutoTokenizer.from_pretrained(hf_dir).encode(text)``).
 
 Examples:
   python tools/serve.py --config llama_tiny_sft --checkpoint-dir /ck \\
@@ -36,6 +39,7 @@ sys.path.insert(0, _HERE)                   # tools/ (sample.py helper)
 
 from sample import (  # noqa: E402 (tools/ sibling)
     _restore_params,
+    apply_dispatch_arg,
     check_vocab_ids,
     load_decoder_params,
     parse_prompt_spec,
@@ -82,6 +86,21 @@ def add_engine_args(p) -> None:
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
                    help="draft block length per round")
+    p.add_argument("--dispatch", default="", choices=["", "dense", "gmm"],
+                   help="MoE expert-dispatch override (MoE configs "
+                        "only). 'gmm' is DROPLESS: routing — and "
+                        "therefore outputs — legitimately differs from "
+                        "capacity-dropped 'dense', but serving regains "
+                        "bucketed/chunked prefill and prefix caching "
+                        "(dense compiles one prefill program per "
+                        "distinct prompt length and refuses "
+                        "--prefix). Default: the config's own setting")
+    p.add_argument("--no-overlap", action="store_true",
+                   help="disable async decode pipelining (the engine's "
+                        "one-chunk-lookahead host/device overlap); "
+                        "TTD_NO_OVERLAP=1 is the no-redeploy "
+                        "equivalent. Outputs are bitwise-identical "
+                        "either way — this is a perf kill switch")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
 
@@ -98,12 +117,33 @@ def parse_prefix_arg(args, cfg):
     return prefix_ids
 
 
+def maybe_dense_moe_hint(eng, lengths=None) -> None:
+    """Startup hint for the dense-dispatch MoE compile storm: exact-
+    length prefill compiles one XLA program per DISTINCT prompt length
+    and disables prefix caching.  ``lengths``: the request lengths when
+    known up front (serve.py) — the hint only fires when they vary;
+    None (the online gateway: lengths unknowable at startup) always
+    hints."""
+    if not getattr(eng, "_exact_prefill", False):
+        return
+    if lengths is not None and len(set(lengths)) <= 1:
+        return
+    print("hint: serving a dense-dispatch MoE with varied prompt "
+          "lengths compiles one prefill program PER DISTINCT length "
+          "and cannot reuse prompt prefixes; pass --dispatch gmm "
+          "(dropless — no capacity competition, so outputs "
+          "legitimately differ from dense) to regain bucketed prefill "
+          "and prefix caching, or pad prompts to a few fixed lengths "
+          "host-side (MIGRATION.md §8)", file=sys.stderr)
+
+
 def build_engine(args, cfg, is_moe, prefix_ids):
     """Load weights (+ optional draft), quantize, construct the engine,
     preload the prefix — shared by serve.py and serve_http.py.
     ValueErrors surface as the clean SystemExit CLI convention."""
     from tensorflow_train_distributed_tpu.serving import ServingEngine
 
+    cfg = apply_dispatch_arg(args, cfg, is_moe)
     draft_cfg = draft_params = None
     if (args.speculative_draft_checkpoint
             and not args.speculative_draft_config):
@@ -143,7 +183,8 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             draft_config=draft_cfg, draft_params=draft_params,
             draft_quant_scales=draft_quant_scales,
             speculative_k=(args.speculative_k
-                           if draft_cfg is not None else 0))
+                           if draft_cfg is not None else 0),
+            overlap=not getattr(args, "no_overlap", False))
         if prefix_ids:
             eng.preload_prefix(prefix_ids)
     except ValueError as e:
@@ -226,6 +267,7 @@ def main(argv=None) -> int:
             raise SystemExit(f"cannot write --output {args.output}: {e}")
 
     eng = build_engine(args, cfg, is_moe, prefix_ids)
+    maybe_dense_moe_hint(eng, [len(r["prompt"]) for r in reqs])
     # Submit validation errors (oversized prompts, budget vs cache)
     # exit with the same clean SystemExit convention as every other
     # serve.py input error — and they happen BEFORE the truncating

@@ -45,6 +45,7 @@ from sample import (  # noqa: E402 (tools/ sibling)
 from serve import (  # noqa: E402 (tools/ sibling)
     add_engine_args,
     build_engine,
+    maybe_dense_moe_hint,
     parse_prefix_arg,
 )
 
@@ -97,6 +98,9 @@ def main(argv=None) -> int:
     _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
     prefix_ids = parse_prefix_arg(args, cfg)
     eng = build_engine(args, cfg, is_moe, prefix_ids)
+    # Online: request lengths are unknowable at startup, so a dense-
+    # dispatch MoE always gets the compile-storm warning.
+    maybe_dense_moe_hint(eng)
 
     gw = ServingGateway(
         eng, host=args.host, port=args.port, max_queue=args.max_queue,
