@@ -62,6 +62,21 @@ def _validate_hf_llama_family(hf_config) -> None:
             "attention is bias-free for this family — qwen2 (qkv-bias "
             "convention) imports via the same path, others are not "
             "exactly representable")
+    gemma_family = getattr(hf_config, "model_type", "") == "gemma"
+    if not gemma_family:
+        # The native MLP for llama/mistral/qwen2 is SwiGLU (silu)
+        # only; HF honors ACT2FN[hidden_act] as-is, so a checkpoint
+        # carrying any other activation would import into
+        # silently-different logits at every position (the same
+        # exact-or-rejected rule the MoE importers apply).  Gemma's
+        # activation convention is screened separately below.
+        act = getattr(hf_config, "hidden_act", "silu") or "silu"
+        if act != "silu":
+            raise ValueError(
+                f"hidden_act={act!r}: the native MLP for this family "
+                "is SwiGLU (silu) only — importing would silently "
+                "change every forward (Gemma's tanh-GeGLU is the one "
+                "supported alternative, model_type='gemma')")
     if qwen2 and getattr(hf_config, "use_sliding_window", False):
         raise ValueError(
             "qwen2 use_sliding_window=True windows only layers past "
@@ -405,6 +420,25 @@ def import_llama(model_or_path, config: Optional[LlamaConfig] = None,
             f"config rms_epsilon={config.rms_epsilon} but the "
             f"checkpoint says rms_norm_eps={hf_eps} — the checkpoint's "
             "convention wins; use a matching config/preset")
+    # And for the Gemma-convention knobs: all three are shape-invisible
+    # (a sqrt(d_model) embedding multiply, the +1 zero-centered norm
+    # scale, the MLP activation), so a mismatched config — a Gemma
+    # checkpoint under a Llama preset or vice versa — would import
+    # cleanly and silently change every forward.  The checkpoint's
+    # model_type decides, exactly like the rope_scaling rule above.
+    gemma = getattr(model_or_path.config, "model_type", "") == "gemma"
+    want_knobs = (gemma, gemma, "gelu" if gemma else "silu")
+    have_knobs = (bool(getattr(config, "embed_scale", False)),
+                  bool(getattr(config, "norm_zero_centered", False)),
+                  getattr(config, "mlp_activation", "silu"))
+    if want_knobs != have_knobs:
+        mt = getattr(model_or_path.config, "model_type", "llama")
+        raise ValueError(
+            f"config (embed_scale, norm_zero_centered, mlp_activation)"
+            f"={have_knobs} but the checkpoint's model_type={mt!r} "
+            f"requires {want_knobs} (the Gemma conventions come as a "
+            "set) — the checkpoint's convention wins; use a matching "
+            "config/preset")
     params = import_llama_state_dict(model_or_path.state_dict(), config)
     return config, params
 

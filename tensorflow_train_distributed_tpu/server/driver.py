@@ -8,7 +8,11 @@ bounded admission deque and get a ``RequestHandle`` (a future) back.
 Between decode chunks — ``ServingEngine.serve_step()`` hands control
 back exactly for this — the driver refills the engine's queue from
 admissions, resolves finished requests, streams newly committed tokens,
-and enforces per-request deadlines (``engine.cancel`` frees the slot).
+and enforces per-request deadlines (``engine.cancel`` frees the slot —
+including a slot still STAGED mid-prefill under the engine's
+interleaved prefill scheduler: the deadline sweep below covers
+requests that have produced no tokens yet, and a cancelled staged
+prefill frees its lane immediately).
 With the engine's async decode pipelining (the default), ``serve_step``
 returns WITH A CHUNK STILL IN FLIGHT, so every one of those host passes
 — harvest/stream/deadline after the step, admission refill before the
@@ -175,8 +179,10 @@ class EngineDriver:
         self._metrics = metrics
 
     def waiting(self) -> int:
-        """Requests admitted but not yet decoding (the shed gauge):
-        driver-side admissions plus the engine's own queue."""
+        """Requests admitted but not yet in a lane (the shed gauge):
+        driver-side admissions plus the engine's own queue.  A request
+        staged mid-prefill holds a lane already — it counts toward
+        ``active_slots()``, not here."""
         return len(self._admit) + self._engine.queue_depth()
 
     def alive(self) -> bool:
@@ -304,7 +310,11 @@ class EngineDriver:
 
     def _harvest(self, done: dict) -> None:
         """Resolve finished requests, stream fresh tokens, sweep
-        deadlines (driver thread only)."""
+        deadlines (driver thread only).  A request whose prefill is
+        still staged inside the engine appears in neither ``done`` nor
+        the snapshot — it falls through to the deadline check below,
+        so an expired prefilling request is cancelled (lane freed,
+        partial cache discarded) exactly like a decoding one."""
         now = time.monotonic()
         snapshot = self._engine.snapshot()
         for rid, handle in list(self._inflight.items()):

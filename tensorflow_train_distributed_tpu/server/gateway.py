@@ -269,8 +269,10 @@ class ServingGateway:
             slots_total=engine.slots,
             driver_alive_fn=self.driver.alive,
             # getattr: test stubs (and any engine without the decode
-            # lookahead) scrape a truthful constant 0.
-            overlap_ratio_fn=getattr(engine, "overlap_ratio", None))
+            # lookahead / prefill scheduler) scrape a truthful
+            # constant 0.
+            overlap_ratio_fn=getattr(engine, "overlap_ratio", None),
+            prefill_stall_fn=getattr(engine, "prefill_stall_s", None))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

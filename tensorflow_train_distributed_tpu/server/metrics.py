@@ -196,7 +196,8 @@ class GatewayMetrics:
     def __init__(self, queue_depth_fn: Callable[[], int],
                  slots_in_use_fn: Callable[[], int], slots_total: int,
                  driver_alive_fn: Optional[Callable[[], bool]] = None,
-                 overlap_ratio_fn: Optional[Callable[[], float]] = None):
+                 overlap_ratio_fn: Optional[Callable[[], float]] = None,
+                 prefill_stall_fn: Optional[Callable[[], float]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -235,6 +236,17 @@ class GatewayMetrics:
             "Host harvest time overlapped with device decode, as a "
             "fraction of total harvest time (0 = synchronous path).",
             fn=overlap_ratio_fn)
+        # Cumulative head-of-line admission time: seconds decode lanes
+        # spent blocked behind a new prompt's prefill.  Grows with
+        # every long admission under atomic admission
+        # (prefill_budget=0 / TTD_NO_INTERLEAVE=1); collapses to ~0
+        # with the engine's interleaved prefill scheduler on — the
+        # driver-visible proof the scheduler engages.
+        self.engine_prefill_stall = r.gauge(
+            "ttd_engine_prefill_stall_seconds",
+            "Cumulative seconds decode lanes spent stalled behind "
+            "admission prefill (~0 with interleaved prefill on).",
+            fn=prefill_stall_fn)
         self.ttft = r.histogram(
             "ttd_gateway_ttft_seconds",
             "Submit-to-first-generated-token latency (chunk-granular: "

@@ -36,6 +36,25 @@ per-slot seed/count streams are deterministic under trimming).
 ``TTD_NO_OVERLAP=1`` (or ``overlap=False`` / the CLIs' ``--no-overlap``)
 is the kill switch back to the synchronous path.
 
+**Decode-priority chunked-prefill scheduling**: admission is NOT
+atomic.  A newly admitted request's prefill is a per-slot STAGED
+activity (``_PrefillTask``: batch-1 cache under construction + piece
+cursor) advanced at most ``prefill_budget`` tokens per ``serve_step``
+(default: one piece), enqueued BEHIND the in-flight decode chunk — so
+decode chunks for occupied lanes keep flowing every step and a long
+prompt can no longer freeze active lanes for its full length.  The
+prefill MATH is untouched: the same batch-1 piece programs run in the
+same order per request (bucketed, ``prefill_chunk``, prefix-suffix
+alike), only their scheduling relative to other lanes' decode changes,
+so per-request outputs stay bitwise-identical to atomic admission for
+greedy, seeded sampling, and speculative serving (the draft's prefill
+stages alongside the target's).  Dense-dispatch MoE keeps its
+exact-length single-piece prefill (router capacity is
+length-dependent) — one installment regardless of budget — but still
+yields to decode between requests.  ``prefill_budget=0`` /
+``TTD_NO_INTERLEAVE=1`` (or the CLIs' ``--no-interleave``) is the kill
+switch restoring atomic admission byte-for-byte.
+
 Shapes are static everywhere (slot count, cache rows, chunk length,
 prompt buckets / prefill pieces) — only cache *contents* and the
 per-slot index vector change, so XLA compiles a handful of programs
@@ -97,11 +116,45 @@ class _SlotState:
     done: bool = False
 
 
+@dataclasses.dataclass
+class _PrefillTask:
+    """A request whose prefill is staged across ``serve_step``
+    iterations: the slot is RESERVED (no other request can claim it)
+    while the batch-1 cache is built piece by piece under the prefill
+    budget.  ``cursor``/``d_cursor`` count completed target/draft
+    pieces; the caches start ``None`` so staging itself does zero
+    device work (pure host bookkeeping)."""
+
+    request_id: int
+    prompt: list
+    max_new: int
+    seed: int
+    work: list                     # suffix after any matched prefix
+    padded: np.ndarray             # [1, piece * n_pieces] token ids
+    piece: int
+    n_pieces: int
+    pre_pair: Optional[tuple] = None   # matched prefix caches
+    cursor: int = 0                # target pieces completed
+    cache_1: object = None         # target batch-1 cache in progress
+    first: object = None           # device pick after the last piece
+    first_host: Optional[int] = None
+    d_cursor: int = 0              # draft pieces completed
+    d_cache_1: object = None
+
+
 def _overlap_killed() -> bool:
     """The production kill switch: ``TTD_NO_OVERLAP=1`` forces the
     synchronous decode path regardless of how the engine was
     constructed (an env flip needs no redeploy of callers)."""
     return os.environ.get("TTD_NO_OVERLAP", "0") not in ("", "0")
+
+
+def _interleave_killed() -> bool:
+    """``TTD_NO_INTERLEAVE=1`` restores atomic admission (a request's
+    whole prefill runs inline on the dispatch path) regardless of the
+    engine's ``prefill_budget`` — the same no-redeploy contract as
+    ``TTD_NO_OVERLAP``."""
+    return os.environ.get("TTD_NO_INTERLEAVE", "0") not in ("", "0")
 
 
 def _bucket_len(n: int, buckets) -> int:
@@ -136,7 +189,8 @@ class ServingEngine:
                  draft_quant_scales=None,
                  speculative_k: int = 0,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024),
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 prefill_budget: Optional[int] = None):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
         if (getattr(config, "sliding_window", None) is not None
@@ -303,6 +357,26 @@ class ServingEngine:
         # None/True enables it; TTD_NO_OVERLAP=1 kills it either way.
         self.overlap = ((True if overlap is None else bool(overlap))
                         and not _overlap_killed())
+        # Decode-priority chunked-prefill scheduling: prefill_budget
+        # tokens of staged prefill advance per serve_step (None = one
+        # piece — the default installment); 0 (or TTD_NO_INTERLEAVE=1)
+        # is the kill switch back to atomic admission.
+        if prefill_budget is not None and prefill_budget < 0:
+            raise ValueError(
+                f"prefill_budget must be >= 0 (0 = atomic admission), "
+                f"got {prefill_budget}")
+        self.prefill_budget = prefill_budget
+        self.interleave = (prefill_budget != 0
+                           and not _interleave_killed())
+        self._staging: dict = {}       # slot -> _PrefillTask (FIFO)
+        # stall_s: wall time spent prefilling while >= 1 lane was
+        # decoding with NO successor decode chunk in flight to hide it
+        # (the head-of-line blocking this scheduler removes — the
+        # gateway exposes it as ttd_engine_prefill_stall_seconds);
+        # installments: budget installments run; staged_requests:
+        # requests that went through the staged path.
+        self.prefill_stats = {"installments": 0, "staged_requests": 0,
+                              "stall_s": 0.0}
         # The chunk in flight: rids pins which request occupied each
         # slot AT DISPATCH — harvest trims anything that retired or was
         # refilled since (the one-chunk decision lag made safe).
@@ -593,16 +667,23 @@ class ServingEngine:
         return rid
 
     def cancel(self, request_id: int) -> bool:
-        """Abandon a live request: drop it from the queue, or free its
-        slot so the next refill reuses it (the gateway's deadline
-        lever).  A freed slot's cache rows go stale-but-invisible —
-        position masks hide them and the next ``_insert`` re-pins the
-        slot index, the same rule stale rows already obey between
-        ``run()`` cycles.  Returns False when the id is unknown or
-        already finished (its output, if any, stays harvestable)."""
+        """Abandon a live request: drop it from the queue, discard its
+        staged partial prefill, or free its slot so the next refill
+        reuses it (the gateway's deadline lever).  A freed slot's cache
+        rows go stale-but-invisible — position masks hide them and the
+        next ``_insert`` re-pins the slot index, the same rule stale
+        rows already obey between ``run()`` cycles; a cancelled staged
+        prefill frees its lane IMMEDIATELY (the partial batch-1 cache
+        is simply dropped — it never touched the slot grid).  Returns
+        False when the id is unknown or already finished (its output,
+        if any, stays harvestable)."""
         for i, item in enumerate(self._queue):
             if item[0] == request_id:
                 del self._queue[i]
+                return True
+        for slot, task in self._staging.items():
+            if task.request_id == request_id:
+                del self._staging[slot]
                 return True
         for slot, state in enumerate(self._slot_states):
             if state is not None and state.request_id == request_id:
@@ -611,8 +692,10 @@ class ServingEngine:
         return False
 
     def active_slots(self) -> int:
-        """Slots currently decoding a request (occupancy gauge)."""
-        return sum(s is not None for s in self._slot_states)
+        """Slots currently occupied by a request — decoding or staged
+        mid-prefill (occupancy gauge: a prefilling lane is reserved)."""
+        return (sum(s is not None for s in self._slot_states)
+                + len(self._staging))
 
     def queue_depth(self) -> int:
         """Requests accepted but not yet in a slot."""
@@ -656,6 +739,27 @@ class ServingEngine:
                             self.prompt_buckets)
         return piece, -(-m // piece)
 
+    def _run_target_piece(self, cache_1, padded, piece: int, i: int,
+                          m: int, seed: int):
+        """Piece ``i`` of a target prefill — THE single source of the
+        per-piece layout/local-idx rule, shared by atomic admission
+        (``_prefill_tokens``) and the staged scheduler
+        (``_advance_piece``) so the two paths stay byte-for-byte."""
+        toks = jnp.asarray(padded[:, i * piece:(i + 1) * piece])
+        # local_idx only matters on the piece holding the last real
+        # token (the final one).
+        local = min(m - 1 - i * piece, piece - 1)
+        return self._prefill_piece(self._variables, cache_1, toks,
+                                   jnp.int32(max(local, 0)),
+                                   jnp.uint32(seed))
+
+    def _run_draft_piece(self, d_cache_1, padded, piece: int, i: int):
+        """Piece ``i`` of a draft prefill (same piece grid as the
+        target's — both caches must hold identical row sets)."""
+        toks = jnp.asarray(padded[:, i * piece:(i + 1) * piece])
+        return self._draft_prefill_piece(self._draft_variables,
+                                         d_cache_1, toks)
+
     def _prefill_tokens(self, work, *, seed: int, cache_1, draft: bool):
         """Append ``work`` to a batch-1 cache in compile-bounded pieces
         (shared by request prefill and prefix preload, target and
@@ -668,17 +772,12 @@ class ServingEngine:
         padded[0, :m] = work
         first = None
         for i in range(n_pieces):
-            toks = jnp.asarray(padded[:, i * piece:(i + 1) * piece])
             if draft:
-                cache_1 = self._draft_prefill_piece(
-                    self._draft_variables, cache_1, toks)
+                cache_1 = self._run_draft_piece(cache_1, padded,
+                                                piece, i)
             else:
-                # local_idx only matters on the piece holding the last
-                # real token (the final one).
-                local = min(m - 1 - i * piece, piece - 1)
-                cache_1, first = self._prefill_piece(
-                    self._variables, cache_1, toks,
-                    jnp.int32(max(local, 0)), jnp.uint32(seed))
+                cache_1, first = self._run_target_piece(
+                    cache_1, padded, piece, i, m, seed)
         return cache_1, first
 
     def preload_prefix(self, tokens) -> None:
@@ -744,7 +843,31 @@ class ServingEngine:
                 best, best_pair = m, pair
         return best, best_pair
 
+    def _note_moe_prefill_len(self, n: int) -> None:
+        if not self._exact_prefill or n in self._moe_prefill_lens:
+            return
+        self._moe_prefill_lens.add(n)
+        if len(self._moe_prefill_lens) > 1:
+            # Compile-storm hazard: MoE prefills at the EXACT length
+            # (router capacity depends on it), so every distinct
+            # prompt length is a new XLA program.  Warn once per
+            # length; mitigation: pad/truncate prompts to a few
+            # lengths host-side (MIGRATION.md §8).
+            logger.warning(
+                "MoE engine prefill compiling for new prompt length "
+                "%d (%d distinct lengths so far — one program each; "
+                "consider padding prompts to a few fixed lengths)",
+                n, len(self._moe_prefill_lens))
+
     def _fill_free_slots(self):
+        """ATOMIC admission — the ``prefill_budget=0`` /
+        ``TTD_NO_INTERLEAVE`` path: a popped request's entire prefill
+        runs inline before control returns, so active decode lanes
+        wait it out (``prefill_stats['stall_s']`` measures that
+        head-of-line time; the staged path keeps it ~0)."""
+        stalled = any(s is not None for s in self._slot_states)
+        prefilled = False
+        t0 = time.perf_counter()
         for slot in range(self.slots):
             # Keep popping until this slot is OCCUPIED or the queue is
             # dry: a request that resolves at prefill time (max_new<=1
@@ -760,22 +883,8 @@ class ServingEngine:
                 # the stored cache(s) (piece sizing follows the suffix).
                 pre_len, pre_pair = self._match_prefix(prompt)
                 work = prompt[pre_len:]
-                if (self._exact_prefill
-                        and n not in self._moe_prefill_lens):
-                    self._moe_prefill_lens.add(n)
-                    if len(self._moe_prefill_lens) > 1:
-                        # Compile-storm hazard: MoE prefills at the
-                        # EXACT length (router capacity depends on it),
-                        # so every distinct prompt length is a new XLA
-                        # program.  Warn once per length; mitigation:
-                        # pad/truncate prompts to a few lengths
-                        # host-side (MIGRATION.md §8).
-                        logger.warning(
-                            "MoE engine prefill compiling for new "
-                            "prompt length %d (%d distinct lengths "
-                            "so far — one program each; consider "
-                            "padding prompts to a few fixed lengths)",
-                            n, len(self._moe_prefill_lens))
+                self._note_moe_prefill_len(n)
+                prefilled = True
                 with self._ctx():
                     cache_1 = (self._fresh_cache(1) if pre_pair is None
                                else jax.tree.map(jnp.copy, pre_pair[0]))
@@ -817,6 +926,157 @@ class ServingEngine:
                 # this slot's host-known token/count over the device
                 # carry (which still holds the previous tenant's).
                 self._refills.add(slot)
+        if prefilled and stalled:
+            self.prefill_stats["stall_s"] += time.perf_counter() - t0
+
+    # -- staged prefill (decode-priority chunked-prefill scheduling) -------
+
+    def _stage_from_queue(self) -> None:
+        """Claim free lanes for queued requests as staged-prefill
+        tasks.  Host-only bookkeeping — no device work happens until a
+        budget installment advances the task — so this is safe to call
+        anywhere in the step (it is the staged path's analog of the
+        slot-claiming half of ``_fill_free_slots``)."""
+        for slot in range(self.slots):
+            if not self._queue:
+                return
+            if (self._slot_states[slot] is not None
+                    or slot in self._staging):
+                continue
+            while self._queue:
+                rid, prompt, max_new, seed = self._queue.popleft()
+                if max_new == 0:
+                    self._outputs[rid] = list(prompt)
+                    continue
+                pre_len, pre_pair = self._match_prefix(prompt)
+                work = prompt[pre_len:]
+                self._note_moe_prefill_len(len(prompt))
+                m = len(work)
+                piece, n_pieces = self._pieces_for(m)
+                padded = np.zeros((1, piece * n_pieces), np.int32)
+                padded[0, :m] = work
+                self._staging[slot] = _PrefillTask(
+                    request_id=rid, prompt=list(prompt),
+                    max_new=max_new, seed=seed, work=work,
+                    padded=padded, piece=piece, n_pieces=n_pieces,
+                    pre_pair=pre_pair)
+                self.prefill_stats["staged_requests"] += 1
+                break
+
+    def _finalize_prefill(self, slot: int, task: _PrefillTask) -> None:
+        """Both caches complete: insert into the slot grid and flip the
+        lane to decoding (caller holds ``self._ctx()``)."""
+        first = task.first_host
+        state = _SlotState(request_id=task.request_id,
+                           remaining=task.max_new - 1,
+                           tokens=list(task.prompt) + [first],
+                           last_token=first, seed=task.seed, count=1)
+        if self._cache is None:
+            self._cache = self._fresh_cache(self.slots)
+        self._cache = self._insert(self._cache, task.cache_1,
+                                   jnp.int32(slot),
+                                   jnp.int32(len(task.prompt)))
+        if self._draft_model is not None:
+            if self._d_cache is None:
+                self._d_cache = self._fresh_cache(self.slots, draft=True)
+            self._d_cache = self._insert(self._d_cache, task.d_cache_1,
+                                         jnp.int32(slot),
+                                         jnp.int32(len(task.prompt)))
+        # Staging is cleared BEFORE the slot state is set: the gateway's
+        # metrics thread reads active_slots() (= decoding + staged)
+        # concurrently, and this order keeps a torn read at or below
+        # the true occupancy instead of reporting slots_in_use >
+        # slots_total (the overlap_ratio() torn-read rule).
+        del self._staging[slot]
+        self._slot_states[slot] = state
+        self._refills.add(slot)        # next dispatch splices host carry
+
+    def _advance_piece(self, slot: int, task: _PrefillTask) -> int:
+        """Run ONE installment of ``task`` — the next target (then
+        draft) prefill piece, exactly the program ``_prefill_tokens``
+        would have run at this position, plus the finalize/insert when
+        it was the last — and return its token cost.  The per-request
+        piece programs, their order, and the rng inputs are identical
+        to atomic admission, so outputs are bitwise-identical; only the
+        scheduling between OTHER lanes' decode chunks differs."""
+        with self._ctx():
+            if task.cursor < task.n_pieces:
+                if task.cache_1 is None:
+                    task.cache_1 = (
+                        self._fresh_cache(1) if task.pre_pair is None
+                        else jax.tree.map(jnp.copy, task.pre_pair[0]))
+                task.cache_1, task.first = self._run_target_piece(
+                    task.cache_1, task.padded, task.piece, task.cursor,
+                    len(task.work), task.seed)
+                task.cursor += 1
+                if task.cursor == task.n_pieces:
+                    # Materializing the first token blocks the host on
+                    # this piece — the in-flight decode chunk (enqueued
+                    # AHEAD of it) keeps the device busy through the
+                    # wait.
+                    first = int(task.first)
+                    task.first_host = first
+                    if (task.max_new == 1
+                            or (self.eos_id is not None
+                                and first == self.eos_id)):
+                        # Resolved at prefill — before the draft
+                        # prefill, which such a request would waste
+                        # (the atomic path's rule).
+                        self._outputs[task.request_id] = (
+                            list(task.prompt) + [first])
+                        del self._staging[slot]
+                    elif self._draft_model is None:
+                        self._finalize_prefill(slot, task)
+                return task.piece
+            # Target done, request unresolved: draft pieces.
+            if task.d_cache_1 is None:
+                task.d_cache_1 = (
+                    self._fresh_cache(1, draft=True)
+                    if task.pre_pair is None
+                    else jax.tree.map(jnp.copy, task.pre_pair[1]))
+            task.d_cache_1 = self._run_draft_piece(
+                task.d_cache_1, task.padded, task.piece, task.d_cursor)
+            task.d_cursor += 1
+            if task.d_cursor == task.n_pieces:
+                self._finalize_prefill(slot, task)
+            return task.piece
+
+    def _advance_prefills(self, hidden: bool) -> None:
+        """Advance staged prefills by at most ``prefill_budget`` tokens
+        (default: one piece) in request-arrival order.  ``hidden``: a
+        decode chunk is already in flight AHEAD of this work on the
+        device queue, so decoding lanes lose no cadence to it and no
+        stall is charged.  With no lane decoding there is nobody to
+        stall, so the budget is waived and admission runs at full
+        speed (TTFT at session start matches atomic admission)."""
+        self._stage_from_queue()
+        if not self._staging:
+            return
+        decoding = any(s is not None for s in self._slot_states)
+        t0 = time.perf_counter()
+        spent = 0
+        while self._staging:
+            slot = next(iter(self._staging))
+            spent += self._advance_piece(slot, self._staging[slot])
+            self.prefill_stats["installments"] += 1
+            if slot not in self._staging:
+                # Resolved or inserted: restage so a freed lane keeps
+                # the budget flowing to the next queued request.
+                self._stage_from_queue()
+            if decoding and (self.prefill_budget is None
+                             or spent >= self.prefill_budget):
+                break
+        if decoding and not hidden:
+            self.prefill_stats["stall_s"] += time.perf_counter() - t0
+
+    def prefill_stall_s(self) -> float:
+        """Cumulative seconds decode lanes spent blocked behind
+        admission prefill (wall time of prefill work run while >= 1
+        lane was decoding with no successor chunk in flight to hide
+        it).  Grows with every long admission on the atomic path;
+        collapses to ~0 with interleaving on.  The gateway exposes it
+        as ``ttd_engine_prefill_stall_seconds``."""
+        return self.prefill_stats["stall_s"]
 
     def _consume(self, state, tokens) -> None:
         """Append generated tokens to a slot's request, enforcing the
@@ -874,8 +1134,9 @@ class ServingEngine:
             self._retire_if_done(slot, state)
 
     def pending(self) -> int:
-        """Requests not yet finished (queued + in flight)."""
-        return (len(self._queue)
+        """Requests not yet finished (queued + staged mid-prefill +
+        decoding)."""
+        return (len(self._queue) + len(self._staging)
                 + sum(s is not None for s in self._slot_states))
 
     def progress(self) -> dict:
@@ -1054,9 +1315,52 @@ class ServingEngine:
         decisions lag one chunk; the harvest trims the overshoot, so
         outputs are bitwise-identical to the synchronous path.  Note a
         finished session leaves one garbage chunk in flight — harmless,
-        discarded by the next cycle's trim guard."""
+        discarded by the next cycle's trim guard.
+
+        With ``interleave`` on (the default), admission is STAGED:
+        after the eager dispatch, at most ``prefill_budget`` tokens of
+        staged prefill advance (enqueued behind the in-flight chunk),
+        so a long prompt's admission spreads across steps while decode
+        chunks for occupied lanes keep flowing every step.  The kill
+        switch (``prefill_budget=0`` / ``TTD_NO_INTERLEAVE=1``)
+        restores atomic admission byte-for-byte."""
         if not self.overlap:
             return self._serve_step_sync()
+        if not self.interleave:
+            return self._serve_step_overlap_atomic()
+        prev, self._inflight = self._inflight, None
+        # DECODE PRIORITY: the successor chunk for occupied lanes goes
+        # onto the device queue before any admission work, so active
+        # lanes never wait behind a new prompt's prefill.
+        dispatched = False
+        if (any(s is not None for s in self._slot_states)
+                and not self._skip_eager_dispatch()):
+            self._dispatch_chunk()          # device busy through the
+            dispatched = True               # host passes below
+        # One budget installment of admission, queued BEHIND the chunk
+        # just dispatched (or behind ``prev``, still in flight) — the
+        # gap it can add to an active lane is bounded by the budget.
+        self._advance_prefills(hidden=dispatched or prev is not None)
+        if prev is not None:
+            self._harvest_prev(prev, overlapped=dispatched)
+        # Lanes the harvest freed stage immediately (host-only) so
+        # their first installment rides the next step's budget.
+        self._stage_from_queue()
+        if not dispatched and any(s is not None
+                                  for s in self._slot_states):
+            # Nothing was in flight to hide this pass behind (first
+            # step of a session / a harvest-first fallback step /
+            # post-idle restart): dispatch now so the NEXT step's
+            # harvest overlaps.
+            self._dispatch_chunk()
+        out, self._outputs = self._outputs, {}
+        return out
+
+    def _serve_step_overlap_atomic(self) -> dict:
+        """The pipelined step with ATOMIC admission — the path
+        ``prefill_budget=0`` / ``TTD_NO_INTERLEAVE=1`` restores,
+        byte-for-byte the pre-staged-prefill scheduling (pinned by
+        tests/test_serving_overlap.py)."""
         prev, self._inflight = self._inflight, None
         if self._queue and any(s is None for s in self._slot_states):
             # Requests that arrived since the last harvest (the online
@@ -1088,8 +1392,15 @@ class ServingEngine:
         """The synchronous path ``TTD_NO_OVERLAP``/``overlap=False``
         restores: dispatch one chunk, block on its host copy, harvest —
         the device idles through every host pass (the PROFILE.md
-        host-stall), but scheduling decisions never lag."""
-        self._fill_free_slots()
+        host-stall), but scheduling decisions never lag.  Staged
+        admission still applies here unless ITS kill switch is also
+        thrown: prefill advances at most ``prefill_budget`` tokens
+        before the chunk, so active lanes' inter-chunk gap stays
+        budget-bounded even without the lookahead."""
+        if self.interleave:
+            self._advance_prefills(hidden=False)
+        else:
+            self._fill_free_slots()
         # (No active slots == everything resolved at prefill time or
         # nothing queued: skip the decode, just drain what finished.)
         if any(s is not None for s in self._slot_states):

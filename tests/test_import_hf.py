@@ -215,6 +215,61 @@ class TestImportGuards:
                            match="no q/k/v projection biases"):
             import_llama_state_dict(hf_model.state_dict(), cfg)
 
+    def test_gemma_knobs_mismatch_rejected(self, hf_model):
+        """The Gemma-convention knobs (embed_scale, norm_zero_centered,
+        mlp_activation) are shape-invisible, so a llama checkpoint
+        under a Gemma-flavored config would import cleanly and
+        silently change every forward — the config-passed branch must
+        reject the mismatch like it does rope_scaling."""
+        import dataclasses
+
+        base = config_from_hf(hf_model.config)
+        for bad in (dict(embed_scale=True),
+                    dict(norm_zero_centered=True),
+                    dict(mlp_activation="gelu")):
+            cfg = dataclasses.replace(base, **bad)
+            with pytest.raises(ValueError, match="embed_scale"):
+                import_llama(hf_model, config=cfg)
+
+    def test_non_silu_hidden_act_rejected_up_front(self, hf_model):
+        """The guard's premise (non-gemma checkpoints are silu) is
+        itself enforced: a llama checkpoint carrying hidden_act='gelu'
+        is rejected at validation, not imported as silent silu."""
+        hf_model.config.hidden_act = "gelu"
+        try:
+            with pytest.raises(ValueError, match="hidden_act"):
+                config_from_hf(hf_model.config)
+        finally:
+            hf_model.config.hidden_act = "silu"
+
+    def test_gemma_knobs_on_gemma_checkpoint_enforced_both_ways(self):
+        """The symmetric direction: a Gemma checkpoint under a config
+        missing any Gemma knob is rejected, and an override that
+        brings the config INTO agreement imports fine (the guard runs
+        on the FINAL config)."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        cfg = transformers.GemmaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=1, head_dim=32,
+            max_position_embeddings=128, rms_norm_eps=1e-6,
+            hidden_activation="gelu_pytorch_tanh",
+            tie_word_embeddings=True,
+        )
+        torch.manual_seed(7)
+        model = transformers.GemmaForCausalLM(cfg)
+        good = config_from_hf(model.config)
+        bad = dataclasses.replace(good, norm_zero_centered=False)
+        with pytest.raises(ValueError, match="model_type='gemma'"):
+            import_llama(model, config=bad)
+        got, _ = import_llama(model, config=bad,
+                              norm_zero_centered=True,
+                              dtype=jnp.float32)
+        assert got.norm_zero_centered
+
 
 class TestBertImport:
     """HF BertForMaskedLM → native BertEncoder, forward-parity vs torch."""

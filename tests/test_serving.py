@@ -931,6 +931,61 @@ def test_long_prefix_preloads_in_bucket_mode(params):
     assert eng.run()[rid] == _ref(params, system + tail, 4)
 
 
+class TestCancel:
+    """cancel() across a request's whole lifecycle: queued, staged
+    mid-prefill (the interleaved scheduler's new state — the lane must
+    free IMMEDIATELY and the partial cache be discarded), and decoding
+    — survivors always finish token-identical to generate()."""
+
+    def test_cancel_while_queued(self, params):
+        rng = np.random.default_rng(40)
+        eng = ServingEngine(CFG, params, slots=1, cache_len=32,
+                            chunk=3, prompt_buckets=(8,))
+        pa = list(rng.integers(1, 200, 4))
+        a = eng.submit(pa, 8)
+        eng.serve_step()                   # a decoding; the lane is busy
+        b = eng.submit(list(rng.integers(1, 200, 5)), 5)
+        assert eng.queue_depth() == 1
+        assert eng.cancel(b)
+        assert eng.queue_depth() == 0
+        assert not eng.cancel(b)           # already gone
+        out = {}
+        while eng.pending():
+            out.update(eng.serve_step())
+        assert b not in out
+        assert out[a] == _ref(params, pa, 8)
+
+    def test_cancel_mid_staged_prefill_frees_lane(self, params):
+        """Cancelling a request whose prefill is STAGED (some budget
+        installments done, not yet inserted) frees its lane at once:
+        occupancy drops immediately, a later request reuses the lane,
+        and the in-flight lanes are untouched."""
+        rng = np.random.default_rng(41)
+        eng = ServingEngine(CFG, params, slots=2, cache_len=64,
+                            chunk=2, prefill_chunk=4)
+        pa = list(rng.integers(1, 200, 4))
+        a = eng.submit(pa, 16)
+        eng.serve_step()
+        eng.serve_step()
+        victim = eng.submit(list(rng.integers(1, 200, 12)), 5)
+        eng.serve_step()                   # one installment of 3 done
+        assert eng.prefill_stats["staged_requests"] >= 1
+        assert eng.active_slots() == 2     # decoding + staged lane
+        assert eng.pending() == 2
+        assert eng.cancel(victim)
+        assert eng.active_slots() == 1     # staged lane freed NOW
+        assert eng.pending() == 1
+        assert not eng.cancel(victim)
+        pc = list(rng.integers(1, 200, 3))
+        c = eng.submit(pc, 6)              # reuses the freed lane
+        out = {}
+        while eng.pending():
+            out.update(eng.serve_step())
+        assert victim not in out
+        assert out[a] == _ref(params, pa, 16)
+        assert out[c] == _ref(params, pc, 6)
+
+
 def test_snapshot_streams_inflight_tokens(params):
     """snapshot(): between serve_step calls the in-flight view grows
     monotonically as a prefix of the final output (streaming UIs poll

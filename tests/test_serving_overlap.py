@@ -32,10 +32,12 @@ CFG = LLAMA_PRESETS["llama_tiny"]
 
 @pytest.fixture(autouse=True)
 def _clean_overlap_env(monkeypatch):
-    """These tests A/B the overlap path themselves (``overlap=`` at
-    construction); an ambient TTD_NO_OVERLAP from the shell would kill
-    the ON legs and fail their engagement asserts — clear it."""
+    """These tests A/B the overlap and interleave paths themselves
+    (``overlap=`` / ``prefill_budget=`` at construction); an ambient
+    TTD_NO_OVERLAP / TTD_NO_INTERLEAVE from the shell would kill the
+    ON legs and fail their engagement asserts — clear them."""
     monkeypatch.delenv("TTD_NO_OVERLAP", raising=False)
+    monkeypatch.delenv("TTD_NO_INTERLEAVE", raising=False)
 
 
 @pytest.fixture(scope="module")
@@ -90,6 +92,85 @@ def test_overlap_smoke_and_kill_switch(params, monkeypatch):
     assert not eng_env.overlap
     assert eng_env.overlap_stats["overlapped_harvests"] == 0
     assert env_off == base
+
+
+# ── tier-1 smoke: interleaved prefill engages; its kill switch ─────────
+
+
+def _instrument(eng):
+    """Record the engine's device-dispatch order: 'p' per prefill
+    piece, 'd' per decode chunk (instance attributes shadow the jitted
+    methods — the established idiom from tests/test_serving.py)."""
+    events = []
+    orig_p, orig_d = eng._prefill_piece, eng._decode_chunk
+
+    def p(variables, cache, toks, local, seed):
+        events.append("p")
+        return orig_p(variables, cache, toks, local, seed)
+
+    def d(variables, cache, tok, seeds, counts):
+        events.append("d")
+        return orig_d(variables, cache, tok, seeds, counts)
+
+    eng._prefill_piece, eng._decode_chunk = p, d
+    return events
+
+
+def test_interleave_smoke_and_kill_switch(params, monkeypatch):
+    """Decode-priority scheduling engages: a long admission (3 budget
+    installments) no longer runs its prefill pieces back-to-back —
+    decode chunks for the active lane are dispatched BETWEEN them, so
+    the lane's inter-token gap is bounded by one installment instead
+    of the whole prompt.  ``prefill_budget=0`` / ``TTD_NO_INTERLEAVE=1``
+    restores the atomic schedule (pieces consecutive) byte-for-byte,
+    and outputs are identical everywhere."""
+    rng = np.random.default_rng(17)
+    active = list(rng.integers(1, 200, 3))
+    long_prompt = list(rng.integers(1, 200, 12))   # 3 pieces of 4
+    kw = dict(slots=2, cache_len=64, chunk=2, prefill_chunk=4)
+
+    def scenario(**ekw):
+        eng = ServingEngine(CFG, params, **kw, **ekw)
+        events = _instrument(eng)
+        out = {}
+        a = eng.submit(active, 16)
+        out.update(eng.serve_step())
+        out.update(eng.serve_step())
+        mark = len(events)
+        b = eng.submit(long_prompt, 4)             # arrives mid-stream
+        while eng.pending():
+            out.update(eng.serve_step())
+        return eng, events[mark:], out, (a, b)
+
+    eng, tail, out, (a, b) = scenario()
+    assert eng.interleave
+    assert eng.prefill_stats["staged_requests"] >= 1
+    assert eng.prefill_stats["installments"] >= 3
+    pieces = [i for i, e in enumerate(tail) if e == "p"]
+    assert len(pieces) == 3                        # 12 tokens / 4-chunk
+    between = tail[pieces[0] + 1:pieces[-1]]
+    # The tentpole property: decode kept flowing through the admission.
+    assert between.count("d") >= 2, tail
+    assert out[a] == _ref(params, active, 16)
+    assert out[b] == _ref(params, long_prompt, 4)
+
+    # Constructor kill switch: atomic admission — pieces back-to-back.
+    eng0, tail0, out0, _ = scenario(prefill_budget=0)
+    assert not eng0.interleave
+    assert eng0.prefill_stats["staged_requests"] == 0
+    pieces0 = [i for i, e in enumerate(tail0) if e == "p"]
+    assert len(pieces0) == 3
+    assert tail0[pieces0[0]:pieces0[-1] + 1] == ["p", "p", "p"], tail0
+    assert out0 == out                     # fresh engines: same rids
+
+    # Env kill switch — and it WINS over the constructor (a production
+    # flip must not require a redeploy of callers).
+    monkeypatch.setenv("TTD_NO_INTERLEAVE", "1")
+    eng_env, tail_env, out_env, _ = scenario(prefill_budget=None)
+    assert not eng_env.interleave
+    pieces_env = [i for i, e in enumerate(tail_env) if e == "p"]
+    assert tail_env[pieces_env[0]:pieces_env[-1] + 1] == ["p", "p", "p"]
+    assert out_env == out
 
 
 # ── slow tier: the full parity matrix ──────────────────────────────────
@@ -206,6 +287,156 @@ def test_overlap_online_submission_and_cancel(params):
         final.update(eng.serve_step())
     assert long_rid not in final
     assert final[short_rid] == _ref(params, short, 5)
+
+
+def _serve_mid_stream(params, reqs_active, long_req, tail_req,
+                      **kw):
+    """The interleave scenario: active lanes decoding, then a long
+    prompt (several budget installments) plus a trailing short arrive
+    mid-stream; everything runs to completion.  Returns outputs in
+    submission order."""
+    eng = ServingEngine(CFG, params, **kw)
+    out = {}
+    ids = [eng.submit(p, m) for p, m in reqs_active]
+    out.update(eng.serve_step())
+    out.update(eng.serve_step())
+    ids.append(eng.submit(*long_req))
+    ids.append(eng.submit(*tail_req))
+    while eng.pending():
+        out.update(eng.serve_step())
+    return [out[i] for i in ids], eng
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sampling", [False, True],
+                         ids=["greedy", "seeded-sampling"])
+def test_interleave_parity_mid_stream_long_admission(params, sampling):
+    """A prompt spanning 3 budget installments admitted while other
+    lanes are mid-stream: interleave ON must be bitwise-identical to
+    the atomic-admission kill switch (and, greedy, to generate())."""
+    rng = np.random.default_rng(23)
+    kw = dict(slots=2, cache_len=64, chunk=3, prefill_chunk=4)
+    if sampling:
+        kw.update(temperature=0.8, top_k=20)
+    active = [(list(rng.integers(1, 200, 4)), 14)]
+    long_req = (list(rng.integers(1, 200, 12)), 6)   # 3 installments
+    tail_req = (list(rng.integers(1, 200, 3)), 5)
+    on, eng = _serve_mid_stream(params, active, long_req, tail_req,
+                                prefill_budget=None, **kw)
+    off, eng_off = _serve_mid_stream(params, active, long_req, tail_req,
+                                     prefill_budget=0, **kw)
+    assert on == off
+    assert eng.prefill_stats["staged_requests"] >= 2
+    assert eng_off.prefill_stats["staged_requests"] == 0
+    if not sampling:
+        for got, (p, m) in zip(on, active + [long_req, tail_req]):
+            assert got == _ref(params, p, m)
+
+
+@pytest.mark.slow
+def test_interleave_parity_speculative(params):
+    """Speculative serving: the DRAFT's prefill stages alongside the
+    target's (same piece grid, budget-metered too) — outputs and
+    emitted-token accounting must match the atomic path exactly."""
+    dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+    dparams = LlamaModel(dcfg).init(
+        jax.random.PRNGKey(99), jnp.zeros((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(27)
+    kw = dict(slots=2, cache_len=64, chunk=3, prefill_chunk=4,
+              draft_config=dcfg, draft_params=dparams, speculative_k=3)
+    active = [(list(rng.integers(1, 200, 4)), 9)]
+    long_req = (list(rng.integers(1, 200, 12)), 6)
+    tail_req = (list(rng.integers(1, 200, 3)), 5)
+    on, eng = _serve_mid_stream(params, active, long_req, tail_req,
+                                prefill_budget=None, **kw)
+    off, eng_off = _serve_mid_stream(params, active, long_req, tail_req,
+                                     prefill_budget=0, **kw)
+    assert on == off
+    assert eng.spec_stats["emitted"] == eng_off.spec_stats["emitted"]
+    assert eng.prefill_stats["staged_requests"] >= 2
+    for got, (p, m) in zip(on, active + [long_req, tail_req]):
+        assert got == _ref(params, p, m)
+
+
+@pytest.mark.slow
+def test_interleave_budget_groups_installments(params):
+    """An explicit ``prefill_budget`` spanning two pieces advances two
+    pieces per step: the 12-token admission takes 2 installments (and
+    at most one decode chunk lands between the piece pairs) — the knob
+    actually meters tokens, not just pieces."""
+    rng = np.random.default_rng(29)
+    active = list(rng.integers(1, 200, 3))
+    long_prompt = list(rng.integers(1, 200, 12))
+    eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=2,
+                        prefill_chunk=4, prefill_budget=8)
+    events = _instrument(eng)
+    out = {}
+    a = eng.submit(active, 12)
+    out.update(eng.serve_step())
+    out.update(eng.serve_step())
+    mark = len(events)
+    b = eng.submit(long_prompt, 4)
+    while eng.pending():
+        out.update(eng.serve_step())
+    tail = events[mark:]
+    pieces = [i for i, e in enumerate(tail) if e == "p"]
+    assert len(pieces) == 3
+    # Budget 8 = two 4-token pieces per step: pieces 1+2 run together,
+    # piece 3 next step — exactly one decode dispatch in between.
+    assert tail[pieces[0]:pieces[0] + 2] == ["p", "p"]
+    assert tail[pieces[1] + 1:pieces[2]].count("d") == 1, tail
+    assert out[a] == _ref(params, active, 12)
+    assert out[b] == _ref(params, long_prompt, 4)
+
+
+@pytest.mark.slow
+def test_prefix_reuse_under_overlap_with_midstream_refill(params):
+    """VERDICT gap: preload_prefix + suffix-only prefill through the
+    overlapped (and now interleaved) path, including a refill that
+    hits the prefix cache MID-STREAM (submitted while chunks are in
+    flight) — token-identical to the no-prefix path and to generate(),
+    and the prefix must actually ENGAGE (suffix-sized pieces only)."""
+    rng = np.random.default_rng(31)
+    system = list(rng.integers(1, 200, 6))
+    reqs = [(system + list(rng.integers(1, 200, 3)), 6),
+            (system + list(rng.integers(1, 200, 5)), 5),
+            (list(rng.integers(1, 200, 4)), 5),        # no prefix match
+            (system + list(rng.integers(1, 200, 2)), 7)]
+
+    def serve(preload):
+        eng = ServingEngine(CFG, params, slots=2, cache_len=64,
+                            chunk=4, prompt_buckets=(8, 16),
+                            overlap=True)
+        if preload:
+            eng.preload_prefix(system)
+        pieces = []
+        orig = eng._prefill_piece
+
+        def counting(variables, cache, toks, local, seed):
+            pieces.append(int(toks.shape[1]))
+            return orig(variables, cache, toks, local, seed)
+
+        eng._prefill_piece = counting
+        out = {}
+        ids = [eng.submit(p, m) for p, m in reqs[:2]]
+        out.update(eng.serve_step())
+        out.update(eng.serve_step())
+        # Mid-stream arrivals: their refills hit the prefix cache
+        # while a decode chunk is in flight.
+        ids += [eng.submit(p, m) for p, m in reqs[2:]]
+        while eng.pending():
+            out.update(eng.serve_step())
+        assert eng.overlap_stats["overlapped_harvests"] > 0
+        return [out[i] for i in ids], pieces
+
+    with_prefix, pieces = serve(True)
+    no_prefix, _ = serve(False)
+    assert with_prefix == no_prefix
+    # Suffixes of 3/5/2 tokens and the 4-token non-match all fit the
+    # 8-bucket; full prompts would have needed the 16-bucket twice.
+    assert pieces == [8, 8, 8, 8], pieces
+    for got, (p, m) in zip(with_prefix, reqs):
+        assert got == _ref(params, p, m)
 
 
 @pytest.mark.slow

@@ -13,6 +13,14 @@ its longest prompt and decoded for its largest max_new (what
 ``generate()`` forces) — so the engine's win IS the padding/straggler
 waste it removes.
 
+``--mixed`` instead runs the tail-latency workload the interleaved
+prefill scheduler exists for: short requests decode on most lanes while
+one LONG prompt (spanning several ``--prefill-chunk`` budget
+installments) is injected mid-stream, A/B'ing interleave ON vs the
+atomic-admission kill switch (``prefill_budget=0``) — reported are the
+active lanes' p99 inter-token latency during the admission window, the
+long and trailing-short TTFTs, and the engine's prefill-stall seconds.
+
 Prints one JSON line per run (bench_lm.py conventions).
 """
 
@@ -68,6 +76,152 @@ def _run_engine_timed(eng, reqs):
         if rid in first and rid in done_at and gen > 1:
             itls.append((done_at[rid] - first[rid]) / (gen - 1))
     return wall, ttfts, itls, sum(len(v) for v in out.values())
+
+
+def _mixed_pass(eng, active_prompts, active_new, long_prompt, long_new,
+                tail_prompt, tail_new):
+    """One mixed-workload pass: fill ``len(active_prompts)`` lanes,
+    wait until every lane is decoding, then inject one LONG prompt
+    plus one short prompt queued behind it.  Measures the active
+    lanes' per-token gaps during the long admission window
+    (submit → long's first token) — the head-of-line stall interleaved
+    prefill removes — plus both injected requests' TTFTs and the
+    engine's prefill-stall delta."""
+    ids = [eng.submit(p, active_new) for p in active_prompts]
+    plens = {rid: len(p) for rid, p in zip(ids, active_prompts)}
+    done: dict = {}
+    while not all(rid in done
+                  or eng.progress().get(rid, 0) > plens[rid]
+                  for rid in ids):
+        done.update(eng.serve_step())
+    stall0 = eng.prefill_stall_s()
+    counts = {rid: (len(done[rid]) if rid in done
+                    else eng.progress().get(rid, plens[rid]))
+              for rid in ids}
+    t_inject = time.perf_counter()
+    long_id = eng.submit(long_prompt, long_new)
+    tail_id = eng.submit(tail_prompt, tail_new)
+    gaps: list = []        # active-lane per-token gaps while admitting
+    ttft_long = ttft_tail = None
+    last = t_inject
+    while eng.pending():
+        step_done = eng.serve_step()
+        now = time.perf_counter()
+        done.update(step_done)
+        prog = eng.progress()
+        admitting = ttft_long is None
+        for rid in ids:
+            n_now = (len(done[rid]) if rid in done
+                     else prog.get(rid, counts[rid]))
+            d = n_now - counts[rid]
+            if d > 0 and admitting:
+                gaps.extend([(now - last) / d] * d)
+            counts[rid] = n_now
+        if ttft_long is None:
+            n = (len(done[long_id]) if long_id in done
+                 else prog.get(long_id, 0))
+            if n > len(long_prompt):
+                ttft_long = now - t_inject
+        if ttft_tail is None:
+            n = (len(done[tail_id]) if tail_id in done
+                 else prog.get(tail_id, 0))
+            if n > len(tail_prompt):
+                ttft_tail = now - t_inject
+        last = now
+    gaps.sort()
+    return {
+        "p99_inter_token_ms_active": round(
+            1e3 * _percentile(gaps, 0.99), 3),
+        "max_gap_ms_active": round(1e3 * gaps[-1], 3) if gaps else 0.0,
+        "ttft_long_ms": round(1e3 * ttft_long, 2),
+        "ttft_short_behind_long_ms": round(1e3 * ttft_tail, 2),
+        "prefill_stall_s": round(eng.prefill_stall_s() - stall0, 4),
+    }
+
+
+def bench_serving_mixed(preset, slots, chunk, cache_len, seed,
+                        prefill_chunk, long_pieces, reps=3):
+    """The --mixed A/B: long prompts arriving during active decode,
+    interleaved prefill ON (the headline) vs the atomic-admission kill
+    switch (``no_interleave`` sub-record).  The long prompt spans
+    ``long_pieces`` budget installments (``prefill_chunk`` tokens
+    each), so the OFF leg's admission blocks active lanes for the
+    whole prompt while the ON leg bounds each gap by one installment."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    vocab = min(cfg.vocab_size, 30_000)
+    rng = np.random.default_rng(seed)
+    # Two lanes stay free: one for the long admission, one for the
+    # tail short — so the tail's TTFT measures queueing behind the
+    # long prefill, not waiting for an active lane to retire.
+    lanes = max(1, slots - 2)
+    active_prompts = [list(rng.integers(1, vocab, 8))
+                      for _ in range(lanes)]
+    long_len = prefill_chunk * long_pieces
+    long_prompt = list(rng.integers(1, vocab, long_len))
+    tail_prompt = list(rng.integers(1, vocab, 8))
+    # Active lanes must outlive the admission window (~long_pieces
+    # steps of chunk tokens each) with margin.
+    active_new = chunk * (long_pieces + 6)
+    cache_len = cache_len or max(long_len + 16,
+                                 8 + active_new + 8)
+    if cache_len > cfg.max_positions:
+        raise ValueError(
+            f"mixed workload needs cache_len {cache_len} but the "
+            f"preset caps max_positions at {cfg.max_positions} — "
+            f"shrink --long-pieces/--prefill-chunk/--chunk")
+
+    def one_mode(interleave):
+        eng = ServingEngine(
+            cfg, params, slots=slots, chunk=chunk, cache_len=cache_len,
+            prefill_chunk=prefill_chunk,
+            prefill_budget=None if interleave else 0)
+        args = (eng, active_prompts, active_new, long_prompt, 8,
+                tail_prompt, 8)
+        _mixed_pass(*args)                  # warmup: compiles
+        best = None
+        for _ in range(max(1, reps)):
+            rec = _mixed_pass(*args)
+            if (best is None or rec["p99_inter_token_ms_active"]
+                    < best["p99_inter_token_ms_active"]):
+                best = rec
+        return best
+
+    on = one_mode(True)
+    off = one_mode(False)
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"{preset}_serving_mixed_p99_inter_token_ms",
+        "value": on["p99_inter_token_ms_active"],
+        "unit": "ms p99 active-lane inter-token during long admission",
+        "slots": slots,
+        "chunk": chunk,
+        "prefill_chunk": prefill_chunk,
+        "long_prompt_len": long_len,
+        "long_pieces": long_pieces,
+        "interleave": on,
+        "no_interleave": off,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+    if on["p99_inter_token_ms_active"]:
+        rec["p99_improvement"] = round(
+            off["p99_inter_token_ms_active"]
+            / on["p99_inter_token_ms_active"], 3)
+    if on["max_gap_ms_active"]:
+        rec["max_gap_improvement"] = round(
+            off["max_gap_ms_active"] / on["max_gap_ms_active"], 3)
+    return rec
 
 
 def bench_serving(preset, slots, chunk, n_requests, prompt_range,
@@ -258,6 +412,21 @@ def main(argv=None) -> int:
     p.add_argument("--no-ab", action="store_true",
                    help="skip the overlap-OFF leg of the async-decode "
                         "pipelining A/B (halves the timed work)")
+    p.add_argument("--mixed", action="store_true",
+                   help="mixed long/short workload instead of the "
+                        "throughput run: fill the lanes with short "
+                        "decoders, inject one LONG prompt mid-stream, "
+                        "and A/B interleaved prefill ON vs the atomic-"
+                        "admission kill switch — reports active lanes' "
+                        "p99 inter-token latency during the admission "
+                        "plus the injected requests' TTFTs")
+    p.add_argument("--prefill-chunk", type=int, default=16,
+                   help="--mixed only: prefill piece size (one budget "
+                        "installment)")
+    p.add_argument("--long-pieces", type=int, default=6,
+                   help="--mixed only: budget installments the long "
+                        "prompt spans (its length = pieces * "
+                        "prefill_chunk)")
     p.add_argument("--reps", type=int, default=3,
                    help="timed passes per leg; min wall is reported "
                         "(reads through host scheduler noise)")
@@ -283,21 +452,34 @@ def main(argv=None) -> int:
     new_range = tuple(int(x) for x in args.new_range.split(","))
     try:
         with cm:
-            rec = bench_serving(args.preset, args.slots, args.chunk,
-                                args.requests, prompt_range, new_range,
-                                args.cache_len or None, args.baseline,
-                                args.seed,
-                                draft_preset=args.speculative_draft,
-                                speculative_k=args.speculative_k,
-                                overlap_ab=not args.no_ab,
-                                reps=args.reps)
+            if args.mixed:
+                rec = bench_serving_mixed(
+                    args.preset, args.slots, args.chunk,
+                    args.cache_len or None, args.seed,
+                    args.prefill_chunk, args.long_pieces,
+                    reps=args.reps)
+            else:
+                rec = bench_serving(args.preset, args.slots, args.chunk,
+                                    args.requests, prompt_range,
+                                    new_range,
+                                    args.cache_len or None,
+                                    args.baseline,
+                                    args.seed,
+                                    draft_preset=args.speculative_draft,
+                                    speculative_k=args.speculative_k,
+                                    overlap_ab=not args.no_ab,
+                                    reps=args.reps)
     except Exception as e:
-        name = (f"{args.preset}_serving_engine_spec"
-                if args.speculative_draft
-                else f"{args.preset}_serving_engine")
+        if args.mixed:
+            metric = f"{args.preset}_serving_mixed_p99_inter_token_ms"
+            unit = "ms p99 active-lane inter-token during long admission"
+        else:
+            name = (f"{args.preset}_serving_engine_spec"
+                    if args.speculative_draft
+                    else f"{args.preset}_serving_engine")
+            metric, unit = f"{name}_tokens_per_sec", "generated tokens/sec"
         print(json.dumps({
-            "metric": f"{name}_tokens_per_sec",
-            "value": 0.0, "unit": "generated tokens/sec",
+            "metric": metric, "value": 0.0, "unit": unit,
             "error": f"{type(e).__name__}: {e}"}), flush=True)
         return 1
     print(json.dumps(rec), flush=True)

@@ -101,6 +101,29 @@ def add_engine_args(p) -> None:
                         "TTD_NO_OVERLAP=1 is the no-redeploy "
                         "equivalent. Outputs are bitwise-identical "
                         "either way — this is a perf kill switch")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="prefill prompts in fixed-size pieces of this "
+                        "many tokens (ONE compiled program at any "
+                        "prompt length) instead of the padded prompt "
+                        "buckets; also the natural installment size "
+                        "for --prefill-budget. Rejected for "
+                        "dense-dispatch MoE (exact-length prefill)")
+    p.add_argument("--prefill-budget", type=int, default=None,
+                   help="tokens of staged prefill advanced per engine "
+                        "step (decode-priority admission: a new "
+                        "prompt's prefill interleaves with active "
+                        "lanes' decode chunks instead of blocking "
+                        "them). Default: one prefill piece per step; "
+                        "0 restores atomic admission")
+    p.add_argument("--no-interleave", action="store_true",
+                   help="disable the interleaved prefill scheduler "
+                        "(same as --prefill-budget 0: a request's "
+                        "whole prefill runs inline at admission, "
+                        "stalling active decode lanes for its "
+                        "length); TTD_NO_INTERLEAVE=1 is the "
+                        "no-redeploy equivalent. Outputs are "
+                        "bitwise-identical either way — this is a "
+                        "scheduling kill switch")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
 
@@ -184,7 +207,10 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             draft_quant_scales=draft_quant_scales,
             speculative_k=(args.speculative_k
                            if draft_cfg is not None else 0),
-            overlap=not getattr(args, "no_overlap", False))
+            overlap=not getattr(args, "no_overlap", False),
+            prefill_chunk=getattr(args, "prefill_chunk", None),
+            prefill_budget=(0 if getattr(args, "no_interleave", False)
+                            else getattr(args, "prefill_budget", None)))
         if prefix_ids:
             eng.preload_prefix(prefix_ids)
     except ValueError as e:

@@ -12,7 +12,10 @@ and times out requests concurrently:
   same requests); ``stream`` chunks tokens as they commit (NDJSON).
 - ``GET /healthz``  liveness + occupancy (503 while draining).
 - ``GET /metrics``  Prometheus text: request/token counters, queue
-  depth, slot occupancy, TTFT + latency histograms.
+  depth, slot occupancy (decoding + prefilling lanes), TTFT /
+  inter-token / latency histograms, the engine's overlap ratio and
+  ``ttd_engine_prefill_stall_seconds`` (decode time lost to atomic
+  admission — ~0 with the default interleaved prefill scheduler).
 
 Robustness: admission queue bounded at ``--max-queue`` (beyond it: 429
 with Retry-After), per-request deadlines (``--default-timeout`` /
