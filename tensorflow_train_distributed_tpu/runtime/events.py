@@ -1,0 +1,293 @@
+"""Always-on flight recorder: spans/instants in a bounded ring buffer.
+
+The forensic layer the metrics scrape surface is not: ``/metrics``
+aggregates (how many, how slow on average) — this module records the
+TIMELINE (what happened to request X, why was step N slow), so
+incidents on the overlap/interleave schedulers can be reconstructed
+after the fact instead of reproduced under a profiler.  It is ON by
+default and designed to stay on in production:
+
+- recording is one lock-guarded ``deque.append`` of a small tuple —
+  no I/O, no serialization, no allocation beyond the tuple and its
+  attrs dict (measured ≤ 2 % serving tok/s against the kill switch;
+  ``tools/bench_serving.py --trace-ab`` is the committed A/B);
+- the buffer is a bounded ring (``TTD_TRACE_CAPACITY`` events, default
+  65536): old events fall off the back, memory is O(capacity) forever;
+- ``TTD_NO_TRACE=1`` is the kill switch: ``span()`` degrades to a
+  shared no-op context manager and ``instant()`` to one dict lookup —
+  an env flip, no redeploy (the ``TTD_NO_OVERLAP`` contract).
+
+Event model (exported as Chrome trace-event JSON, loadable in Perfetto
+or ``chrome://tracing``):
+
+- ``span(name, **attrs)`` — a context manager recording ONE complete
+  event (``ph="X"``) at exit with monotonic start + duration.
+  Recording at exit means the ring never holds an unbalanced begin.
+- ``instant(name, **attrs)`` — a point event (``ph="i"``).
+- timestamps are ``time.monotonic()`` (immune to wall-clock steps;
+  the export carries a wall-clock anchor for cross-run alignment),
+  ``tid`` is the recording thread's ident, ``pid`` the process.
+
+Attrs are the correlation layer: the gateway driver tags request
+lifecycle events with the ``request_id`` it minted at admission plus
+the engine's ``rid`` once a slot is granted, the engine tags its
+prefill/decode/retire events with ``rid``, and
+``request_timeline()`` joins the two — the ``/v1/requests/<id>``
+endpoint and ``tools/trace_report.py`` are its consumers.  Keep attr
+values JSON-scalar (str/int/float/bool): the export serializes them
+verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+_KILL_ENV = "TTD_NO_TRACE"
+_CAPACITY_ENV = "TTD_TRACE_CAPACITY"
+DEFAULT_CAPACITY = 65536
+
+# Event tuple layout (kept flat — one small allocation per event):
+# (name, ph, t0_monotonic_s, dur_s, tid, attrs_dict_or_None)
+
+
+# The kill check runs per event on serving's per-chunk path, and
+# ``os.environ.get`` costs ~1 us (encode + mapping indirection) vs
+# ~0.14 us for the raw ``_data`` dict CPython keeps underneath (posix:
+# fsencoded-bytes keys, kept in sync by __setitem__/__delitem__ — so
+# monkeypatch.setenv flips it live too).  Fall back to the public API
+# where the private layout differs.
+try:
+    _ENV_DATA = os.environ._data
+    _KILL_KEY = os.fsencode(_KILL_ENV)
+    # Layout probe: the fast path needs bytes keys (posix).  A
+    # str-keyed _data (Windows) would make .get() return None forever
+    # — silently disabling the kill switch — so check the key type,
+    # not just that .get() doesn't raise.
+    if not isinstance(next(iter(_ENV_DATA)), bytes):
+        raise TypeError("os.environ._data keys are not bytes")
+
+    def trace_killed() -> bool:
+        """``TTD_NO_TRACE=1`` disables recording process-wide (re-read
+        per event, so a test or an operator shell can flip it live)."""
+        v = _ENV_DATA.get(_KILL_KEY)
+        return v is not None and v not in (b"", b"0")
+except (AttributeError, TypeError, StopIteration):  # pragma: no cover
+    def trace_killed() -> bool:
+        """``TTD_NO_TRACE=1`` disables recording process-wide (re-read
+        per event, so a test or an operator shell can flip it live)."""
+        return os.environ.get(_KILL_ENV, "0") not in ("", "0")
+
+
+class _Span:
+    """One recording span: appends a single complete event at exit."""
+
+    __slots__ = ("_rec", "_name", "_attrs", "t0")
+
+    def __init__(self, rec: "Recorder", name: str, attrs: Optional[dict]):
+        self._rec = rec
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.monotonic()
+        self._rec._append(self._name, "X", self.t0, t1 - self.t0,
+                          self._attrs)
+        return False
+
+
+class _NullSpan:
+    """The kill-switch span: no clock reads, no append, one shared
+    instance."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Recorder:
+    """Lock-cheap bounded ring buffer of trace events.
+
+    Threads append concurrently (driver loop, HTTP handlers, trainer
+    host thread); readers snapshot under the same lock.  The lock is
+    held for one ``deque.append`` / one ``list()`` copy — never across
+    user code.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.pid = os.getpid()
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        # Wall-clock anchor: wall time at monotonic ``_anchor_mono`` —
+        # lets offline tooling place the monotonic timeline in real
+        # time (e.g. against a supervisor journal's ``time.time()``).
+        self._anchor_mono = time.monotonic()
+        self._anchor_wall = time.time()
+
+    @property
+    def enabled(self) -> bool:
+        return not trace_killed()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def _append(self, name: str, ph: str, t0: float, dur: float,
+                attrs: Optional[dict]) -> None:
+        ev = (name, ph, t0, dur, threading.get_ident(), attrs or None)
+        with self._lock:
+            self._buf.append(ev)
+
+    # -- recording api ---------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Context manager timing its block into one complete event
+        (``ph="X"``); a no-op singleton under the kill switch."""
+        if trace_killed():
+            return _NULL_SPAN
+        return _Span(self, name, attrs or None)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Record a point event (``ph="i"``)."""
+        if trace_killed():
+            return
+        self._append(name, "i", time.monotonic(), 0.0, attrs or None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    # -- query / export --------------------------------------------------
+
+    def events(self, last_s: Optional[float] = None) -> list:
+        """Snapshot of the ring (oldest first), optionally only events
+        whose END falls inside the trailing ``last_s`` seconds."""
+        with self._lock:
+            items = list(self._buf)
+        if last_s is not None:
+            cutoff = time.monotonic() - last_s
+            items = [e for e in items if e[2] + e[3] >= cutoff]
+        return items
+
+    def request_timeline(self, request_id: int) -> list:
+        """Every event belonging to gateway request ``request_id``,
+        sorted by start time: driver events tagged ``request_id``
+        (from the LATEST admission of that id — ids restart per driver,
+        forensics wants the most recent life) joined with engine events
+        tagged with the ``rid`` its engine-submit recorded, scoped to
+        [engine-submit, retire] so a reused engine rid from another
+        session cannot bleed in."""
+        evs = self.events()
+        admit_t = None
+        for e in evs:               # latest admission wins
+            a = e[5]
+            if (a is not None and a.get("request_id") == request_id
+                    and e[0] == "request/admitted"):
+                admit_t = e[2]
+        out = []
+        rid = None
+        grant_t = retire_t = None
+        for e in evs:
+            a = e[5]
+            if (a is None or a.get("request_id") != request_id
+                    or (admit_t is not None and e[2] < admit_t)):
+                continue
+            out.append(e)
+            if e[0] == "request/engine_submit" and "rid" in a:
+                rid, grant_t = a["rid"], e[2]
+            if e[0] == "request/retire":
+                retire_t = e[2]
+        if rid is not None:
+            # lo is padded: the engine's own queued instant fires just
+            # BEFORE the driver records the engine-submit join anchor.
+            # hi is exact: the driver's retire follows every engine
+            # event of the request (the harvest trim guard keeps a
+            # retired rid from ever being tagged again).
+            lo = grant_t - 1e-3
+            hi = retire_t if retire_t is not None else float("inf")
+            for e in evs:
+                a = e[5]
+                if (a is not None and "request_id" not in a
+                        and a.get("rid") == rid and lo <= e[2] <= hi):
+                    out.append(e)
+        out.sort(key=lambda e: e[2])
+        return out
+
+    def export_chrome_trace(self, last_s: Optional[float] = None) -> dict:
+        """Chrome trace-event JSON (the ``traceEvents`` array form):
+        every event carries ``name``/``ph``/``ts``/``pid``/``tid``
+        (ts/dur in microseconds), spans are complete events (``X``) so
+        the trace is balanced by construction — load the dict's JSON in
+        Perfetto or ``chrome://tracing`` as-is."""
+        trace_events = []
+        for name, ph, t0, dur, tid, attrs in self.events(last_s):
+            ev = {
+                "name": name,
+                "cat": name.split("/", 1)[0],
+                "ph": ph,
+                "ts": round(t0 * 1e6, 3),
+                "pid": self.pid,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 3)
+            elif ph == "i":
+                ev["s"] = "t"          # thread-scoped instant
+            if attrs:
+                ev["args"] = dict(attrs)
+            trace_events.append(ev)
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "pid": self.pid,
+                "capacity": self.capacity,
+                "clock": "monotonic_us",
+                "wall_anchor_s": self._anchor_wall,
+                "mono_anchor_us": round(self._anchor_mono * 1e6, 3),
+                "killed": trace_killed(),
+            },
+        }
+
+    def save(self, path: str, last_s: Optional[float] = None) -> None:
+        with open(path, "w") as f:
+            json.dump(self.export_chrome_trace(last_s), f)
+
+
+# -- process-global recorder ---------------------------------------------
+
+_cap = os.environ.get(_CAPACITY_ENV, "")
+_RECORDER = Recorder(int(_cap) if _cap else DEFAULT_CAPACITY)
+del _cap
+
+
+def get_recorder() -> Recorder:
+    return _RECORDER
+
+
+def span(name: str, **attrs):
+    """``with events.span("decode/harvest", rid=3): ...`` on the
+    process-global recorder."""
+    return _RECORDER.span(name, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    _RECORDER.instant(name, **attrs)

@@ -48,6 +48,7 @@ import sys
 import time
 from typing import Optional, Sequence
 
+from tensorflow_train_distributed_tpu.runtime import events
 from tensorflow_train_distributed_tpu.runtime.preemption import (
     PREEMPTION_EXIT_CODE,
 )
@@ -121,6 +122,14 @@ class TrainSupervisor:
         self._stop_signal: Optional[int] = None
 
     def _journal(self, record: dict) -> None:
+        # Journal lines double as flight-recorder instants, so attempt
+        # boundaries/relaunches land on the same timeline as the
+        # trainer's step spans (runtime.events; tools/trace_report.py
+        # renders both).
+        events.instant(
+            "supervisor/" + str(record.get("event", "event")),
+            **{k: v for k, v in record.items()
+               if k != "event" and isinstance(v, (str, int, float, bool))})
         if not self.journal_path:
             return
         os.makedirs(os.path.dirname(os.path.abspath(self.journal_path)),

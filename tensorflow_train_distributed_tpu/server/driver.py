@@ -34,12 +34,18 @@ import logging
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Optional
+
+from tensorflow_train_distributed_tpu.runtime import events
 
 logger = logging.getLogger(__name__)
 
 _DONE = object()          # stream sentinel: request finished cleanly
+
+# Terminal statuses remembered per request id for /v1/requests/<id>
+# forensics (bounded: oldest evicted).
+_TERMINAL_KEEP = 4096
 
 
 class RequestError(ValueError):
@@ -85,6 +91,7 @@ class RequestHandle:
         self.t_submit = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.last_commit_at: Optional[float] = None  # inter-token feed
+        self.slot_granted_at: Optional[float] = None  # queue_wait feed
         self._streamed = len(prompt)    # tokens already pushed/known
         self._queue: Optional[queue.Queue] = (
             queue.Queue() if stream else None)
@@ -161,6 +168,7 @@ class EngineDriver:
         self._cv = threading.Condition()
         self._admit: deque = deque()       # RequestHandles not yet in engine
         self._inflight: dict = {}          # engine rid -> RequestHandle
+        self._terminal: OrderedDict = OrderedDict()  # id -> final status
         self._next_id = 0
         self._draining = False
         self._failed: Optional[BaseException] = None
@@ -230,9 +238,40 @@ class EngineDriver:
             handle = RequestHandle(self._next_id, prompt, max_new, seed,
                                    stream, deadline)
             self._next_id += 1
+            # The request_id minted above tags every later lifecycle
+            # event — the flight-recorder key /v1/requests/<id>
+            # resolves.  Recorded BEFORE the notify releases the driver
+            # thread: request_timeline anchors on this event's
+            # timestamp, and an engine_submit recorded earlier than its
+            # admission would fall outside the window.
+            events.instant("request/admitted", request_id=handle.id,
+                           prompt_len=len(prompt), max_new=max_new,
+                           stream=stream)
             self._admit.append(handle)
             self._cv.notify()
         return handle
+
+    def request_status(self, request_id: int) -> str:
+        """Lifecycle answer for /v1/requests/<id>: a remembered
+        terminal status (``ok|expired|invalid|error``), else
+        ``queued`` (admitted, not yet in the engine), ``active``
+        (in the engine), or ``unknown`` (never seen / evicted)."""
+        with self._cv:
+            status = self._terminal.get(request_id)
+            if status is not None:
+                return status
+            if any(h.id == request_id for h in self._admit):
+                return "queued"
+            if any(h.id == request_id
+                   for h in self._inflight.values()):
+                return "active"
+        return "unknown"
+
+    def _set_terminal(self, request_id: int, status: str) -> None:
+        with self._cv:
+            self._terminal[request_id] = status
+            while len(self._terminal) > _TERMINAL_KEEP:
+                self._terminal.popitem(last=False)
 
     def abandon(self, handle: RequestHandle) -> None:
         """Give up on a live request (streaming client disconnected):
@@ -283,8 +322,12 @@ class EngineDriver:
                 pending = list(self._admit) + list(self._inflight.values())
                 self._admit.clear()
                 self._inflight.clear()
+            events.instant("driver/died", error=repr(e))
             for handle in pending:
                 self._count("error")
+                self._set_terminal(handle.id, "error")
+                events.instant("request/retire", request_id=handle.id,
+                               status="error")
                 handle._resolve(None, RuntimeError(
                     f"engine driver failed: {e!r}"))
 
@@ -304,9 +347,17 @@ class EngineDriver:
                 # validate_request screened already; a late preload
                 # could still shift the bucket rule — report, don't die.
                 self._count("invalid")
+                self._set_terminal(handle.id, "invalid")
+                events.instant("request/retire", request_id=handle.id,
+                               status="invalid")
                 handle._resolve(None, RequestError(str(e)))
                 continue
             self._inflight[rid] = handle
+            # The rid join anchor: every engine-side event for this
+            # request (prefill pieces, insert, retire) is tagged with
+            # ``rid`` and happens after this instant.
+            events.instant("request/engine_submit",
+                           request_id=handle.id, rid=rid)
 
     def _harvest(self, done: dict) -> None:
         """Resolve finished requests, stream fresh tokens, sweep
@@ -317,11 +368,29 @@ class EngineDriver:
         partial cache discarded) exactly like a decoding one."""
         now = time.monotonic()
         snapshot = self._engine.snapshot()
+        # Lanes reserved for staged prefills count as granted — the
+        # slot is held even though the decode snapshot cannot show it
+        # yet (engines without the staged scheduler, e.g. test stubs,
+        # simply have none).
+        staged = getattr(self._engine, "staged_rids", tuple)()
         for rid, handle in list(self._inflight.items()):
             tokens = done.get(rid)
             finished = tokens is not None
             if not finished:
                 tokens = snapshot.get(rid)
+            if handle.slot_granted_at is None and (
+                    tokens is not None or rid in staged):
+                # First time the request holds a lane (decoding, done,
+                # or staged mid-prefill): the queue-depth gauge's
+                # latency companion, chunk-granular like every harvest
+                # signal.
+                handle.slot_granted_at = now
+                wait = now - handle.t_submit
+                if self._metrics is not None:
+                    self._metrics.queue_wait.observe(wait)
+                events.instant("request/slot_granted",
+                               request_id=handle.id, rid=rid,
+                               wait_ms=round(wait * 1e3, 3))
             if tokens is not None and len(tokens) > len(handle.prompt):
                 if handle.first_token_at is None:
                     handle.first_token_at = now
@@ -329,6 +398,8 @@ class EngineDriver:
                         self._metrics.ttft.observe(now - handle.t_submit)
                 fresh = handle._push_new(tokens)
                 if fresh:
+                    events.instant("request/commit",
+                                   request_id=handle.id, tokens=fresh)
                     if self._metrics is not None:
                         self._metrics.tokens.inc(fresh)
                         if handle.last_commit_at is not None:
@@ -341,6 +412,11 @@ class EngineDriver:
             if finished:
                 del self._inflight[rid]
                 self._count("ok")
+                self._set_terminal(handle.id, "ok")
+                events.instant(
+                    "request/retire", request_id=handle.id, status="ok",
+                    tokens=len(tokens) - len(handle.prompt),
+                    latency_ms=round((now - handle.t_submit) * 1e3, 3))
                 if self._metrics is not None:
                     self._metrics.latency.observe(now - handle.t_submit)
                 handle._resolve(tokens, None)
@@ -351,6 +427,9 @@ class EngineDriver:
 
     def _expire(self, handle: RequestHandle) -> None:
         self._count("expired")
+        self._set_terminal(handle.id, "expired")
+        events.instant("request/retire", request_id=handle.id,
+                       status="expired")
         handle._resolve(None, DeadlineExceeded(
             f"request {handle.id} exceeded its deadline"))
 

@@ -17,6 +17,12 @@ Endpoints:
 - ``GET /healthz`` — ``{"status": "ok"|"draining", ...occupancy}``;
   503 while draining (load balancers stop routing before shutdown).
 - ``GET /metrics`` — Prometheus text (``server.metrics`` names).
+- ``GET /debug/trace?last_s=N`` — the flight recorder's recent window
+  as Chrome trace-event JSON (``runtime.events``; load in Perfetto or
+  ``chrome://tracing``).  Omit ``last_s`` for the whole ring.
+- ``GET /v1/requests/<id>`` — one request's recorded timeline
+  (admission → prefill → decode commits → retire) plus its terminal
+  status — the "what happened to request X" forensics endpoint.
 
 Robustness shell: bounded admission (429 + Retry-After via
 ``AdmissionFull``), per-request deadlines (504; the driver frees the
@@ -32,9 +38,11 @@ import logging
 import signal
 import socketserver
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from tensorflow_train_distributed_tpu.runtime import events
 from tensorflow_train_distributed_tpu.server.driver import (
     AdmissionFull,
     DeadlineExceeded,
@@ -90,7 +98,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ----------------------------------------------------------
 
     def do_GET(self):                           # noqa: N802
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             gw = self.gateway
             draining = gw.draining
             # Driver death outranks everything but an orderly drain
@@ -107,7 +116,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "slots_in_use": gw.driver.active_slots(),
                 "slots_total": gw.engine.slots,
             })
-        elif self.path == "/metrics":
+        elif path == "/metrics":
             body = self.gateway.metrics.render().encode()
             self.send_response(200)
             self.send_header(
@@ -115,8 +124,57 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/trace":
+            self._debug_trace(query)
+        elif path.startswith("/v1/requests/"):
+            self._request_timeline(path[len("/v1/requests/"):])
         else:
             self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def _debug_trace(self, query: str) -> None:
+        """The recent flight-recorder window, Chrome-trace JSON."""
+        params = urllib.parse.parse_qs(query)
+        last_s = None
+        if "last_s" in params:
+            try:
+                last_s = float(params["last_s"][-1])
+                if not last_s > 0:
+                    raise ValueError
+            except ValueError:
+                self._reply_json(400, {
+                    "error": "last_s must be a positive number"})
+                return
+        self._reply_json(
+            200, events.get_recorder().export_chrome_trace(last_s))
+
+    def _request_timeline(self, tail: str) -> None:
+        """One request's recorded lifecycle + terminal status."""
+        try:
+            request_id = int(tail)
+        except ValueError:
+            self._reply_json(400, {
+                "error": f"request id must be an integer, got {tail!r}"})
+            return
+        timeline = []
+        t0 = None
+        for name, ph, ts, dur, tid, attrs in (
+                events.get_recorder().request_timeline(request_id)):
+            t0 = ts if t0 is None else t0
+            ev = {"name": name, "t_ms": round((ts - t0) * 1e3, 3)}
+            if ph == "X":
+                ev["dur_ms"] = round(dur * 1e3, 3)
+            if attrs:
+                ev["args"] = {k: v for k, v in attrs.items()
+                              if k != "request_id"}
+            timeline.append(ev)
+        status = self.gateway.driver.request_status(request_id)
+        if status == "unknown" and not timeline:
+            self._reply_json(404, {"id": request_id, "status": status,
+                                   "error": "request not in the "
+                                            "recorder window"})
+            return
+        self._reply_json(200, {"id": request_id, "status": status,
+                               "timeline": timeline})
 
     def do_POST(self):                          # noqa: N802
         if self.path != "/v1/generate":

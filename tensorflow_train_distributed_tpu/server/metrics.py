@@ -19,6 +19,7 @@ Conventions (the names README documents):
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Callable, Optional, Sequence
@@ -126,13 +127,12 @@ class Histogram:
         self._sum = 0.0
 
     def observe(self, v: float) -> None:
+        # bisect_left: first upper with v <= upper (== len(_uppers) →
+        # the +Inf bucket).  O(log buckets) — this sits on the driver's
+        # per-chunk commit path (inter_token observes every chunk).
+        i = bisect.bisect_left(self._uppers, v)
         with self._lock:
-            for i, u in enumerate(self._uppers):
-                if v <= u:
-                    self._counts[i] += 1
-                    break
-            else:
-                self._counts[-1] += 1
+            self._counts[i] += 1
             self._sum += v
 
     @property
@@ -247,6 +247,16 @@ class GatewayMetrics:
             "Cumulative seconds decode lanes spent stalled behind "
             "admission prefill (~0 with interleaved prefill on).",
             fn=prefill_stall_fn)
+        # The queue-depth gauge's latency companion: how long admission
+        # actually COSTS (admission → engine slot granted), observed by
+        # the driver when engine.submit succeeds — queue depth alone
+        # cannot distinguish a deep-but-fast queue from a shallow
+        # stuck one.
+        self.queue_wait = r.histogram(
+            "ttd_gateway_queue_wait_seconds",
+            "Admission-to-slot-granted wait per request (observed, "
+            "chunk-granular, when the request first holds an engine "
+            "lane — staged prefill counts, the lane is reserved).")
         self.ttft = r.histogram(
             "ttd_gateway_ttft_seconds",
             "Submit-to-first-generated-token latency (chunk-granular: "

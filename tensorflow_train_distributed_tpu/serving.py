@@ -90,7 +90,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-from tensorflow_train_distributed_tpu.runtime import compat
+from tensorflow_train_distributed_tpu.runtime import compat, events
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
     cast_floating,
@@ -664,6 +664,8 @@ class ServingEngine:
         self._next_id += 1
         self._queue.append(
             (rid, prompt, max_new_tokens, rid if seed is None else seed))
+        events.instant("engine/queued", rid=rid, prompt_len=len(prompt),
+                       max_new=max_new_tokens)
         return rid
 
     def cancel(self, request_id: int) -> bool:
@@ -680,14 +682,20 @@ class ServingEngine:
         for i, item in enumerate(self._queue):
             if item[0] == request_id:
                 del self._queue[i]
+                events.instant("engine/cancel", rid=request_id,
+                               where="queued")
                 return True
         for slot, task in self._staging.items():
             if task.request_id == request_id:
                 del self._staging[slot]
+                events.instant("engine/cancel", rid=request_id,
+                               where="staged")
                 return True
         for slot, state in enumerate(self._slot_states):
             if state is not None and state.request_id == request_id:
                 self._slot_states[slot] = None
+                events.instant("engine/cancel", rid=request_id,
+                               where="slot")
                 return True
         return False
 
@@ -696,6 +704,13 @@ class ServingEngine:
         mid-prefill (occupancy gauge: a prefilling lane is reserved)."""
         return (sum(s is not None for s in self._slot_states)
                 + len(self._staging))
+
+    def staged_rids(self) -> tuple:
+        """Request ids whose prefill is staged in a reserved lane —
+        the driver's slot-grant signal for requests the decode
+        snapshot cannot show yet (a staged lane is granted: no other
+        request can claim it)."""
+        return tuple(t.request_id for t in self._staging.values())
 
     def queue_depth(self) -> int:
         """Requests accepted but not yet in a slot."""
@@ -816,7 +831,7 @@ class ServingEngine:
                 return jnp.full_like(leaf, n)
             return leaf
 
-        with self._ctx():
+        with self._ctx(), events.span("prefill/prefix", tokens=n):
             cache_1, _ = self._prefill_tokens(
                 tokens, seed=0, cache_1=self._fresh_cache(1),
                 draft=False)
@@ -885,7 +900,8 @@ class ServingEngine:
                 work = prompt[pre_len:]
                 self._note_moe_prefill_len(n)
                 prefilled = True
-                with self._ctx():
+                with self._ctx(), events.span(
+                        "prefill/request", rid=rid, tokens=len(work)):
                     cache_1 = (self._fresh_cache(1) if pre_pair is None
                                else jax.tree.map(jnp.copy, pre_pair[0]))
                     cache_1, first = self._prefill_tokens(
@@ -900,7 +916,7 @@ class ServingEngine:
                     # prefill, which such a request would waste.
                     self._outputs[rid] = state.tokens
                     continue  # slot still free: try the next request
-                with self._ctx():
+                with self._ctx(), events.span("prefill/insert", rid=rid):
                     if self._draft_model is not None:
                         d_cache_1 = (
                             self._fresh_cache(1, draft=True)
@@ -926,6 +942,7 @@ class ServingEngine:
                 # this slot's host-known token/count over the device
                 # carry (which still holds the previous tenant's).
                 self._refills.add(slot)
+                events.instant("slot/insert", rid=rid, slot=slot)
         if prefilled and stalled:
             self.prefill_stats["stall_s"] += time.perf_counter() - t0
 
@@ -990,6 +1007,7 @@ class ServingEngine:
         del self._staging[slot]
         self._slot_states[slot] = state
         self._refills.add(slot)        # next dispatch splices host carry
+        events.instant("slot/insert", rid=task.request_id, slot=slot)
 
     def _advance_piece(self, slot: int, task: _PrefillTask) -> int:
         """Run ONE installment of ``task`` — the next target (then
@@ -999,7 +1017,10 @@ class ServingEngine:
         piece programs, their order, and the rng inputs are identical
         to atomic admission, so outputs are bitwise-identical; only the
         scheduling between OTHER lanes' decode chunks differs."""
-        with self._ctx():
+        with self._ctx(), events.span(
+                "prefill/piece", rid=task.request_id,
+                piece=task.cursor + task.d_cursor,
+                n_pieces=task.n_pieces):
             if task.cursor < task.n_pieces:
                 if task.cache_1 is None:
                     task.cache_1 = (
@@ -1097,6 +1118,8 @@ class ServingEngine:
         if state.done:
             self._outputs[state.request_id] = state.tokens
             self._slot_states[slot] = None
+            events.instant("slot/retire", rid=state.request_id,
+                           slot=slot, tokens=len(state.tokens))
 
     def _harvest(self, toks: np.ndarray, rids=None):
         """``rids`` (overlap mode): the slot->request map captured at
@@ -1205,7 +1228,9 @@ class ServingEngine:
             if state is not None:
                 seeds[slot] = state.seed
                 rids[slot] = state.request_id
-        with self._ctx():
+        with self._ctx(), events.span(
+                "decode/dispatch",
+                active=sum(r is not None for r in rids)):
             tok, counts = self._carry_arrays()
             jseeds = jnp.asarray(seeds)
             if self._draft_model is not None:
@@ -1266,16 +1291,20 @@ class ServingEngine:
         ``np.asarray`` is device time, not host-harvest time, and would
         drown the ratio."""
         rids = inf["rids"]
-        if inf["spec"]:
-            args = (np.asarray(inf["emit"]), np.asarray(inf["emitted"]),
-                    np.asarray(inf["next_tok"]), np.asarray(inf["acc"]))
-        else:
-            toks = np.asarray(inf["toks"])
+        with events.span("decode/wait", overlapped=overlapped):
+            if inf["spec"]:
+                args = (np.asarray(inf["emit"]),
+                        np.asarray(inf["emitted"]),
+                        np.asarray(inf["next_tok"]),
+                        np.asarray(inf["acc"]))
+            else:
+                toks = np.asarray(inf["toks"])
         t0 = time.perf_counter()
-        if inf["spec"]:
-            self._harvest_spec(*args, rids=rids)
-        else:
-            self._harvest(toks, rids=rids)
+        with events.span("decode/harvest", overlapped=overlapped):
+            if inf["spec"]:
+                self._harvest_spec(*args, rids=rids)
+            else:
+                self._harvest(toks, rids=rids)
         dt = time.perf_counter() - t0
         self.overlap_stats["harvest_s"] += dt
         if overlapped:
@@ -1407,28 +1436,39 @@ class ServingEngine:
             tok = np.zeros((self.slots,), np.int32)
             seeds = np.zeros((self.slots,), np.uint32)
             counts = np.zeros((self.slots,), np.int32)
+            n_active = 0
             for slot, state in enumerate(self._slot_states):
                 if state is not None:
                     tok[slot] = state.last_token
                     seeds[slot] = state.seed
                     counts[slot] = state.count
+                    n_active += 1
             if self._draft_model is not None:
-                with self._ctx():
+                with self._ctx(), events.span("decode/dispatch",
+                                              active=n_active):
                     (self._cache, self._d_cache, emit, emitted,
                      next_tok, acc, _) = self._spec_round(
                         self._variables, self._draft_variables,
                         self._cache, self._d_cache, jnp.asarray(tok),
                         jnp.asarray(seeds), jnp.asarray(counts))
-                self._harvest_spec(np.asarray(emit),
-                                   np.asarray(emitted),
-                                   np.asarray(next_tok),
-                                   np.asarray(acc))
+                # decode/wait is the device block, decode/harvest the
+                # host pass — same split as the overlap path, so the
+                # two paths' traces are comparable span for span.
+                with events.span("decode/wait", overlapped=False):
+                    args = (np.asarray(emit), np.asarray(emitted),
+                            np.asarray(next_tok), np.asarray(acc))
+                with events.span("decode/harvest", overlapped=False):
+                    self._harvest_spec(*args)
             else:
-                with self._ctx():
+                with self._ctx(), events.span("decode/dispatch",
+                                              active=n_active):
                     self._cache, toks, _, _ = self._decode_chunk(
                         self._variables, self._cache, jnp.asarray(tok),
                         jnp.asarray(seeds), jnp.asarray(counts))
-                self._harvest(np.asarray(toks))
+                with events.span("decode/wait", overlapped=False):
+                    toks = np.asarray(toks)
+                with events.span("decode/harvest", overlapped=False):
+                    self._harvest(toks)
         out, self._outputs = self._outputs, {}
         return out
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from tensorflow_train_distributed_tpu.runtime import compat, faults
+from tensorflow_train_distributed_tpu.runtime import compat, events, faults
 from tensorflow_train_distributed_tpu.parallel import collectives
 from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
 from tensorflow_train_distributed_tpu.parallel.sharding import (
@@ -587,15 +587,26 @@ class Trainer:
         last_metrics: dict[str, float] = {}
         pending: list[tuple[int, Any]] = []
         stop = False
+        batch_iter = iter(device_iter)
+        _END = object()
         try:
-            for dev_batch in device_iter:
-                state, metrics = step_fn(state, dev_batch)
+            while True:
+                # Flight-recorder step anatomy (runtime.events): data
+                # wait vs step dispatch vs host-callback flush vs
+                # checkpoint save — the "why was step N slow" timeline,
+                # exported via tools/trace_report.py.
+                with events.span("train/data_wait"):
+                    dev_batch = next(batch_iter, _END)
+                if dev_batch is _END:
+                    break
+                cur = start_step + done + k
+                with events.span("train/step_dispatch", step=cur):
+                    state, metrics = step_fn(state, dev_batch)
                 # Callbacks that checkpoint (preemption handler) read the
                 # current state from here — fit's loop variable is otherwise
                 # invisible to them.
                 self._live_state = state
                 done += k
-                cur = start_step + done
                 if faults.ARMED:    # zero-cost seam: one attr read when off
                     faults.step_boundary(cur)
                 pending.append((cur, metrics))
@@ -619,13 +630,16 @@ class Trainer:
                     # the guarded seam: a sharded metric leaf means a step
                     # skipped its in-graph reduction and must fail loudly,
                     # not flow per-shard garbage into callbacks.
-                    host = collectives.host_all_reduce_mean(
-                        [m for _, m in pending], self.mesh)
-                    for (s, _), m in zip(pending, host):
-                        host_m = {kk: float(v) for kk, v in m.items()}
-                        stop |= self.callbacks.step_end(s, host_m)
-                        last_metrics = host_m
-                    pending.clear()
+                    with events.span("train/host_callbacks", step=cur,
+                                     steps=len(pending) * k):
+                        host = collectives.host_all_reduce_mean(
+                            [m for _, m in pending], self.mesh)
+                        for (s, _), m in zip(pending, host):
+                            host_m = {kk: float(v)
+                                      for kk, v in m.items()}
+                            stop |= self.callbacks.step_end(s, host_m)
+                            last_metrics = host_m
+                        pending.clear()
                 if eval_due:
                     src = (eval_batches() if callable(eval_batches)
                            else eval_batches)
@@ -633,9 +647,11 @@ class Trainer:
                     eval_state = view(state) if view is not None else state
                     self.callbacks.eval_begin()
                     try:
-                        val = {f"val_{kk}": v for kk, v in
-                               self.evaluate(src, eval_state,
-                                             steps=eval_steps).items()}
+                        with events.span("train/eval", step=cur):
+                            val = {f"val_{kk}": v for kk, v in
+                                   self.evaluate(
+                                       src, eval_state,
+                                       steps=eval_steps).items()}
                     finally:
                         self.callbacks.eval_end()
                     last_metrics = dict(last_metrics, **val)
@@ -652,7 +668,8 @@ class Trainer:
                 # and val_* events reached the callbacks.
                 state = self.callbacks.apply_state_transforms(state)
                 if will_ckpt and not stop and not self.state_poisoned:
-                    self.checkpoint_manager.save(cur, state)
+                    with events.span("train/checkpoint_save", step=cur):
+                        self.checkpoint_manager.save(cur, state)
                 state_box[0] = state
                 if stop:
                     break
@@ -661,8 +678,10 @@ class Trainer:
             device_iter.close()
         if self.checkpoint_manager is not None:
             if not self.state_poisoned:
-                self.checkpoint_manager.save(int(state.step), state,
-                                             force=True)
+                with events.span("train/checkpoint_save",
+                                 step=int(state.step), final=True):
+                    self.checkpoint_manager.save(int(state.step), state,
+                                                 force=True)
             # Always await in-flight async saves: an earlier GOOD periodic
             # checkpoint may still be committing and must not be lost just
             # because a later step went non-finite.

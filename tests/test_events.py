@@ -1,0 +1,323 @@
+"""Flight recorder tests (runtime.events and its faces).
+
+Tier-1 pins the tentpole's contracts: the ring buffer is bounded and
+lock-safe, ``TTD_NO_TRACE=1`` kills recording cleanly, the Chrome
+trace-event export validates against the schema Perfetto needs
+(required keys per event, balanced spans), serving outputs are
+BITWISE-IDENTICAL with the recorder on vs killed (the always-on
+claim), the request-timeline join survives gateway-id reuse, and
+``tools/trace_report.py`` renders a dump.  The slow tier adds the
+trainer's per-step span anatomy over a real ``fit``.
+"""
+
+import json
+import threading
+
+import pytest
+
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.events import Recorder
+
+REQUIRED_KEYS = {"name", "ph", "ts", "pid", "tid"}
+
+
+@pytest.fixture(autouse=True)
+def _trace_on(monkeypatch):
+    """These tests A/B the kill switch themselves — an ambient
+    TTD_NO_TRACE from the shell would fail the ON legs' asserts."""
+    monkeypatch.delenv("TTD_NO_TRACE", raising=False)
+
+
+def _validate_chrome(trace: dict) -> None:
+    """The schema check Perfetto/chrome://tracing loading relies on."""
+    assert isinstance(trace["traceEvents"], list)
+    json.dumps(trace)                      # exportable as-is
+    begins = ends = 0
+    for ev in trace["traceEvents"]:
+        assert REQUIRED_KEYS <= set(ev), ev
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "i", "B", "E"), ev
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        begins += ev["ph"] == "B"
+        ends += ev["ph"] == "E"
+    assert begins == ends              # spans balanced (X needs no pair)
+
+
+# ── ring buffer unit tests ─────────────────────────────────────────────
+
+
+def test_ring_is_bounded_and_evicts_oldest():
+    rec = Recorder(capacity=8)
+    for i in range(20):
+        rec.instant("tick", i=i)
+    assert len(rec) == 8
+    kept = [e[5]["i"] for e in rec.events()]
+    assert kept == list(range(12, 20))     # oldest fell off the back
+
+
+def test_span_records_duration_and_attrs():
+    rec = Recorder(capacity=16)
+    with rec.span("work/unit", k="v"):
+        pass
+    rec.instant("mark", n=3)
+    (name, ph, t0, dur, tid, attrs), (n2, ph2, *_rest) = rec.events()
+    assert (name, ph, attrs) == ("work/unit", "X", {"k": "v"})
+    assert dur >= 0 and tid == threading.get_ident()
+    assert (n2, ph2) == ("mark", "i")
+
+
+def test_kill_switch_records_nothing(monkeypatch):
+    rec = Recorder(capacity=16)
+    monkeypatch.setenv("TTD_NO_TRACE", "1")
+    assert not rec.enabled
+    with rec.span("dead"):
+        rec.instant("dead/too")
+    assert len(rec) == 0
+    assert rec.export_chrome_trace()["otherData"]["killed"] is True
+    monkeypatch.delenv("TTD_NO_TRACE")
+    with rec.span("live"):
+        pass
+    assert [e[0] for e in rec.events()] == ["live"]   # flips back live
+
+
+def test_last_s_window_filters_old_events():
+    rec = Recorder(capacity=16)
+    old = ("old", "i", -1e9, 0.0, 1, None)   # monotonic long past
+    rec._buf.append(old)
+    rec.instant("new")
+    assert [e[0] for e in rec.events()] == ["old", "new"]
+    assert [e[0] for e in rec.events(last_s=60.0)] == ["new"]
+
+
+def test_export_schema_synthetic():
+    rec = Recorder(capacity=16)
+    with rec.span("a/b", x=1):
+        rec.instant("c/d")
+    trace = rec.export_chrome_trace()
+    _validate_chrome(trace)
+    by_name = {e["name"]: e for e in trace["traceEvents"]}
+    assert by_name["a/b"]["args"] == {"x": 1}
+    assert by_name["a/b"]["cat"] == "a"
+    assert by_name["c/d"]["s"] == "t"
+
+
+def test_request_timeline_joins_latest_life_only():
+    """Gateway request ids restart per driver: the timeline must follow
+    the LATEST admission of an id, join engine events through the rid
+    its engine-submit recorded, and not leak a previous life's rid."""
+    rec = Recorder(capacity=64)
+    # First life of request 0: engine rid 7, expired.
+    rec.instant("request/admitted", request_id=0)
+    rec.instant("request/engine_submit", request_id=0, rid=7)
+    rec.instant("prefill/old", rid=7)
+    rec.instant("request/retire", request_id=0, status="expired")
+    # Unrelated request in between.
+    rec.instant("request/admitted", request_id=1)
+    # Second life of request 0: engine rid 12, served.
+    rec.instant("request/admitted", request_id=0)
+    rec.instant("request/engine_submit", request_id=0, rid=12)
+    rec.instant("slot/insert", rid=12, slot=0)
+    rec.instant("request/commit", request_id=0, tokens=2)
+    rec.instant("request/retire", request_id=0, status="ok")
+    rec.instant("decode/later", rid=12)    # after retire: out of scope
+    names = [e[0] for e in rec.request_timeline(0)]
+    assert names == ["request/admitted", "request/engine_submit",
+                     "slot/insert", "request/commit", "request/retire"]
+
+
+def test_concurrent_appends_and_reads_are_safe():
+    rec = Recorder(capacity=1024)
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                with rec.span("w"):
+                    rec.instant("i")
+        except BaseException as e:          # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            _validate_chrome(rec.export_chrome_trace())
+            rec.events(last_s=1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errs
+    assert len(rec) == 1024
+
+
+# ── serving integration: parity + real-trace schema (tier-1) ───────────
+
+
+@pytest.fixture(scope="module")
+def llama_tiny_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+        LlamaModel,
+    )
+
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _engine_outputs(cfg, params, reqs, **kw):
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    eng = ServingEngine(cfg, params, **kw)
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    return [out[i] for i in ids]
+
+
+@pytest.mark.parametrize("sampling", [False, True],
+                         ids=["greedy", "seeded-sampling"])
+def test_serving_parity_recorder_on_vs_killed(llama_tiny_setup,
+                                              monkeypatch, sampling):
+    """The always-on claim: recording changes NOTHING about served
+    tokens — recorder on vs TTD_NO_TRACE=1 are bitwise-identical (the
+    recorder only observes host scheduling; device programs and their
+    inputs are untouched)."""
+    cfg, params = llama_tiny_setup
+    reqs = [([1, 2, 3], 6), ([4, 5], 5), ([9, 8, 7, 6], 4)]
+    kw = dict(slots=2, cache_len=32, chunk=2, prompt_buckets=(8,))
+    if sampling:
+        kw.update(temperature=0.8, top_k=20)
+
+    rec = events.get_recorder()
+    n0 = len(rec)
+    traced = _engine_outputs(cfg, params, reqs, **kw)
+    recorded = [e[0] for e in rec.events()][n0:]
+    assert any(n.startswith("prefill/") for n in recorded)
+    assert any(n.startswith("decode/") for n in recorded)  # engaged
+
+    monkeypatch.setenv("TTD_NO_TRACE", "1")
+    n1 = len(rec)
+    killed = _engine_outputs(cfg, params, reqs, **kw)
+    assert len(rec) == n1                  # kill switch: zero events
+    assert killed == traced
+
+
+def test_real_serving_trace_validates_chrome_schema(llama_tiny_setup):
+    """Acceptance: the export of a REAL serving run's events validates
+    against the Chrome trace-event schema (required keys, balanced
+    spans) and carries the request lifecycle."""
+    cfg, params = llama_tiny_setup
+    _engine_outputs(cfg, params, [([1, 2, 3], 5)], slots=2,
+                    cache_len=32, chunk=2, prompt_buckets=(8,))
+    trace = events.get_recorder().export_chrome_trace()
+    _validate_chrome(trace)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"engine/queued", "decode/dispatch", "slot/retire"} <= names
+
+
+# ── tools/trace_report.py ──────────────────────────────────────────────
+
+
+def test_trace_report_renders_tables_and_waterfall(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    rec = Recorder(capacity=64)
+    rec.instant("request/admitted", request_id=3)
+    rec.instant("request/engine_submit", request_id=3, rid=5)
+    with rec.span("prefill/piece", rid=5):
+        pass
+    rec.instant("request/commit", request_id=3, tokens=2)
+    rec.instant("request/retire", request_id=3, status="ok")
+    path = tmp_path / "trace.json"
+    rec.save(str(path))
+
+    journal = tmp_path / "supervisor.jsonl"
+    journal.write_text(json.dumps(
+        {"event": "exit", "attempt": 0, "rc": -9, "class": "crash"})
+        + "\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__),
+                                     "..", "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([str(path), "--request", "3", "--requests",
+                   "--journal", str(journal)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "prefill/piece" in out          # stage table
+    assert "request/retire" in out         # waterfall
+    assert "status=ok" in out or "ok" in out
+    assert "class=crash" in out            # journal overlay
+
+
+# ── supervisor instants ────────────────────────────────────────────────
+
+
+def test_supervisor_journal_doubles_as_instants(tmp_path):
+    import sys
+
+    from tensorflow_train_distributed_tpu.runtime.supervisor import (
+        TrainSupervisor,
+    )
+
+    rec = events.get_recorder()
+    n0 = len(rec)
+    sup = TrainSupervisor(
+        [sys.executable, "-c", "pass"],
+        journal_path=str(tmp_path / "j.jsonl"), handle_signals=False)
+    res = sup.run()
+    assert res.returncode == 0
+    names = [e[0] for e in rec.events()[n0:]]
+    assert "supervisor/exit" in names
+    assert "supervisor/done" in names
+    ex = next(e for e in rec.events()[n0:] if e[0] == "supervisor/exit")
+    assert ex[5]["class"] == "clean" and ex[5]["rc"] == 0
+
+
+# ── trainer step anatomy (slow tier: a real fit) ───────────────────────
+
+
+@pytest.mark.slow
+def test_trainer_emits_step_spans(mesh8):
+    import optax
+
+    from tensorflow_train_distributed_tpu.data import (
+        DataConfig,
+        HostDataLoader,
+    )
+    from tensorflow_train_distributed_tpu.data.datasets import (
+        SyntheticBlobs,
+    )
+    from tensorflow_train_distributed_tpu.training import (
+        Trainer,
+        TrainerConfig,
+    )
+    from tests.test_trainer import _BlobsTask
+
+    rec = events.get_recorder()
+    n0 = len(rec)
+    loader = HostDataLoader(
+        SyntheticBlobs(num_examples=64),
+        DataConfig(global_batch_size=16, seed=0))
+    trainer = Trainer(_BlobsTask(), optax.adam(1e-2), mesh8,
+                      config=TrainerConfig(log_every=2))
+    trainer.fit(loader, steps=4)
+    tail = rec.events()[n0:]
+    spans = [e[0] for e in tail if e[1] == "X"]
+    assert spans.count("train/data_wait") >= 4
+    assert spans.count("train/step_dispatch") >= 4
+    assert "train/host_callbacks" in spans
+    steps = [e[5]["step"] for e in tail
+             if e[0] == "train/step_dispatch"]
+    assert steps == [1, 2, 3, 4]

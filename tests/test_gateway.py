@@ -586,6 +586,201 @@ def test_gateway_metrics_gauges_sample_callables_at_scrape():
     assert s["ttd_gateway_slots_total"] == 4
 
 
+def test_metric_conventions_and_readme_single_source_of_truth():
+    """The lint behind the module docstring's claims: every Counter
+    ends ``_total``, every Histogram ``_seconds``, and every metric
+    GatewayMetrics registers appears in README's metric list — the
+    docstring says README documents these names; now a new metric that
+    skips the docs fails here instead of rotting silently."""
+    import os
+
+    from tensorflow_train_distributed_tpu.server.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+    )
+
+    m = GatewayMetrics(queue_depth_fn=lambda: 0,
+                       slots_in_use_fn=lambda: 0, slots_total=1)
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    metrics = m.registry._metrics
+    assert metrics, "registry is empty?"
+    for metric in metrics:
+        if isinstance(metric, Counter):
+            assert metric.name.endswith("_total"), metric.name
+        elif isinstance(metric, Histogram):
+            assert metric.name.endswith("_seconds"), metric.name
+        else:
+            assert isinstance(metric, Gauge), metric
+        assert f"`{metric.name}`" in readme, (
+            f"{metric.name} missing from README's metric list")
+
+
+def test_histogram_bucket_edges_inclusive():
+    """``observe(v)`` lands in the first bucket with v <= upper —
+    boundary values INCLUSIVE (the bisect fast path must keep the
+    linear scan's le semantics exactly)."""
+    r = Registry()
+    h = r.histogram("edges_seconds", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.1, 1.0, 10.0, 10.0001, 0.0999):
+        h.observe(v)
+    s = _parse_prom(r.render())
+    assert s['edges_seconds_bucket{le="0.1"}'] == 2     # 0.0999, 0.1
+    assert s['edges_seconds_bucket{le="1"}'] == 3       # + 1.0
+    assert s['edges_seconds_bucket{le="10"}'] == 4      # + 10.0
+    assert s['edges_seconds_bucket{le="+Inf"}'] == 5    # + 10.0001
+    assert s["edges_seconds_count"] == 5
+
+
+def test_scrape_vs_observe_hammer_monotonic_buckets():
+    """Handler-thread scrapes racing driver-loop observes: every
+    render must be internally consistent — cumulative bucket lines
+    non-decreasing within a scrape, +Inf bucket == _count, and counts
+    non-decreasing ACROSS scrapes."""
+    import re
+
+    m = GatewayMetrics(queue_depth_fn=lambda: 0,
+                       slots_in_use_fn=lambda: 0, slots_total=4)
+    stop = threading.Event()
+    errs = []
+
+    def writer(k):
+        i = 0
+        try:
+            while not stop.is_set():
+                m.ttft.observe((i % 50) * 0.01)
+                m.queue_wait.observe((i % 7) * 0.2)
+                m.inter_token.observe((i % 11) * 0.001)
+                m.requests.inc(label_value="ok")
+                m.tokens.inc(3)
+                i += 1
+        except BaseException as e:          # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    last_counts: dict = {}
+    try:
+        for _ in range(300):
+            text = m.render()
+            s = _parse_prom(text)           # every line well-formed
+            for hist in ("ttd_gateway_ttft_seconds",
+                         "ttd_gateway_queue_wait_seconds",
+                         "ttd_gateway_inter_token_seconds"):
+                # Cumulative bucket values IN RENDER ORDER (the dict
+                # from _parse_prom loses it).
+                ordered = [float(ln.rsplit(" ", 1)[1])
+                           for ln in text.splitlines()
+                           if ln.startswith(hist + "_bucket")]
+                assert ordered == sorted(ordered), (hist, ordered)
+                assert ordered[-1] == s[hist + "_count"]
+                assert s[hist + "_count"] >= last_counts.get(hist, 0)
+                last_counts[hist] = s[hist + "_count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errs
+    assert last_counts["ttd_gateway_ttft_seconds"] > 0  # writers ran
+
+
+# ── fast tier: flight-recorder endpoints ───────────────────────────────
+
+
+def test_debug_trace_endpoint_serves_chrome_json():
+    gw = _make_gateway(StubEngine(slots=2))
+    try:
+        status, obj, _ = _post(gw.port, {"prompt": [4], "max_new": 2})
+        assert status == 200
+        rid = obj["id"]
+        status, body, _ = _get(gw.port, "/debug/trace?last_s=60")
+        assert status == 200
+        trace = json.loads(body)
+        assert isinstance(trace["traceEvents"], list)
+        for ev in trace["traceEvents"]:
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "request/admitted" in names
+        admitted = [e for e in trace["traceEvents"]
+                    if e["name"] == "request/admitted"
+                    and e.get("args", {}).get("request_id") == rid]
+        assert admitted
+        status, body, _ = _get(gw.port, "/debug/trace?last_s=zero")
+        assert status == 400
+    finally:
+        gw.drain(timeout=10)
+
+
+def test_request_timeline_endpoint_stub_lifecycle_and_queue_wait():
+    """Driver-level lifecycle over the stub engine: /v1/requests/<id>
+    shows admission → slot grant → commits → retire with terminal
+    status, the queue-wait histogram observes once per served request,
+    and an unknown id answers 404."""
+    gw = _make_gateway(StubEngine(slots=2))
+    try:
+        status, obj, _ = _post(gw.port, {"prompt": [4], "max_new": 3})
+        assert status == 200
+        rid = obj["id"]
+        status, body, _ = _get(gw.port, f"/v1/requests/{rid}")
+        assert status == 200
+        tl = json.loads(body)
+        assert tl["id"] == rid and tl["status"] == "ok"
+        names = [e["name"] for e in tl["timeline"]]
+        for a, b in (("request/admitted", "request/slot_granted"),
+                     ("request/slot_granted", "request/commit"),
+                     ("request/commit", "request/retire")):
+            assert names.index(a) < names.index(b), names
+        # t_ms is relative to the first event and non-decreasing.
+        ts = [e["t_ms"] for e in tl["timeline"]]
+        assert ts[0] == 0 and ts == sorted(ts)
+        s = _parse_prom(_get(gw.port, "/metrics")[1])
+        assert s["ttd_gateway_queue_wait_seconds_count"] == 1
+        status, body, _ = _get(gw.port, "/v1/requests/999999")
+        assert status == 404
+        assert json.loads(body)["status"] == "unknown"
+        status, body, _ = _get(gw.port, "/v1/requests/not-a-number")
+        assert status == 400
+    finally:
+        gw.drain(timeout=10)
+
+
+def test_request_timeline_endpoint_real_engine_order(llama_tiny):
+    """Acceptance: a served request's /v1/requests/<id> timeline shows
+    admission → prefill → decode → retire in order (engine events
+    joined through the rid recorded at engine submit)."""
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg, params = llama_tiny
+    eng = ServingEngine(cfg, params, slots=2, cache_len=32, chunk=2,
+                        prompt_buckets=(8,))
+    gw = ServingGateway(eng, host="127.0.0.1", port=0).start()
+    try:
+        status, obj, _ = _post(gw.port, {"prompt": [1, 2, 3],
+                                         "max_new": 5})
+        assert status == 200
+        rid = obj["id"]
+        status, body, _ = _get(gw.port, f"/v1/requests/{rid}")
+        assert status == 200
+        tl = json.loads(body)
+        assert tl["status"] == "ok"
+        names = [e["name"] for e in tl["timeline"]]
+        idx = [names.index("request/admitted"),
+               min(i for i, n in enumerate(names)
+                   if n.startswith("prefill/")),
+               min(i for i, n in enumerate(names)
+                   if n == "request/commit"),
+               names.index("request/retire")]
+        assert idx == sorted(idx), names
+        retire = [e for e in tl["timeline"]
+                  if e["name"] == "request/retire"][-1]
+        assert retire["args"]["status"] == "ok"
+    finally:
+        gw.drain(timeout=30)
+
+
 # ── slow tier: real engine parity over concurrent HTTP ─────────────────
 
 

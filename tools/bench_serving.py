@@ -21,6 +21,12 @@ atomic-admission kill switch (``prefill_budget=0``) — reported are the
 active lanes' p99 inter-token latency during the admission window, the
 long and trailing-short TTFTs, and the engine's prefill-stall seconds.
 
+``--trace-ab`` instead A/Bs the always-on flight recorder
+(``runtime.events``) against its ``TTD_NO_TRACE=1`` kill switch on
+identical passes of one engine, reporting the tok/s overhead
+percentage — the committed proof the recorder is cheap enough to leave
+on (``profiles/bench/trace_overhead_ab.jsonl``).
+
 Prints one JSON line per run (bench_lm.py conventions).
 """
 
@@ -224,6 +230,94 @@ def bench_serving_mixed(preset, slots, chunk, cache_len, seed,
     return rec
 
 
+def bench_trace_ab(preset, slots, chunk, n_requests, prompt_range,
+                   new_range, cache_len, seed, reps=3):
+    """The flight-recorder overhead A/B: identical engine passes with
+    the recorder ON (the always-on default) vs ``TTD_NO_TRACE=1`` (the
+    kill switch).  ONE engine serves both legs — the jitted programs
+    are shared, so the measured delta is purely the host-side
+    span/instant recording the tentpole claims is ≤ 2 % tok/s.
+
+    Noise discipline: single-pass walls on a shared host swing far
+    more than the effect being measured, so the legs run as
+    BACK-TO-BACK PAIRS (on, off) and the headline is the MEDIAN of the
+    per-pair wall ratios — a scheduler spike inflates one pair's both
+    legs (ratio survives) or one leg of one pair (median discards it),
+    where min-wall-per-leg across minutes compares walls from
+    different load regimes."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import events
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    reqs = _requests(n_requests, *prompt_range, *new_range,
+                     min(cfg.vocab_size, 30_000), seed)
+    gen_tokens = sum(m for _, m in reqs)
+    eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                        cache_len=cache_len)
+    for p, m in reqs:                              # warmup: compiles
+        eng.submit(p, m)
+    eng.run()
+    had_kill = os.environ.get("TTD_NO_TRACE")
+    best = {True: None, False: None}
+    ratios = []
+    try:
+        for i in range(max(1, reps)):
+            walls = {}
+            # Leg order alternates per pair ((on, off), (off, on), ...):
+            # whatever systematic advantage the second-run leg of a
+            # pair has (cache warmth, allocator state) cancels in the
+            # median instead of biasing every ratio the same way.
+            for trace_on in ((True, False) if i % 2 == 0
+                             else (False, True)):
+                if trace_on:
+                    os.environ.pop("TTD_NO_TRACE", None)
+                else:
+                    os.environ["TTD_NO_TRACE"] = "1"
+                rec = _run_engine_timed(eng, reqs)
+                walls[trace_on] = rec[0]
+                if best[trace_on] is None or rec[0] < best[trace_on][0]:
+                    best[trace_on] = rec
+            ratios.append(walls[True] / walls[False])
+    finally:
+        if had_kill is None:
+            os.environ.pop("TTD_NO_TRACE", None)
+        else:
+            os.environ["TTD_NO_TRACE"] = had_kill
+    ratios.sort()
+    median_ratio = ratios[len(ratios) // 2]
+    tps_on = gen_tokens / best[True][0]
+    tps_off = gen_tokens / best[False][0]
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_serving_trace_overhead_pct",
+        "value": round(100.0 * (median_ratio - 1.0), 3),
+        "unit": "% tok/s lost, flight recorder on vs TTD_NO_TRACE=1 "
+                "(median of per-pair wall ratios)",
+        "pair_wall_ratios": [round(r, 4) for r in ratios],
+        "trace_on_tokens_per_sec": round(tps_on, 1),
+        "trace_off_tokens_per_sec": round(tps_off, 1),
+        "trace_on_wall_s": round(best[True][0], 3),
+        "trace_off_wall_s": round(best[False][0], 3),
+        "events_in_ring": len(events.get_recorder()),
+        "ring_capacity": events.get_recorder().capacity,
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n_requests,
+        "gen_tokens": gen_tokens,
+        "reps": reps,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def bench_serving(preset, slots, chunk, n_requests, prompt_range,
                   new_range, cache_len, baseline, seed,
                   draft_preset="", speculative_k=0, overlap_ab=True,
@@ -420,6 +514,13 @@ def main(argv=None) -> int:
                         "admission kill switch — reports active lanes' "
                         "p99 inter-token latency during the admission "
                         "plus the injected requests' TTFTs")
+    p.add_argument("--trace-ab", action="store_true",
+                   help="flight-recorder overhead A/B instead of the "
+                        "throughput run: identical passes with the "
+                        "recorder on (the always-on default) vs "
+                        "TTD_NO_TRACE=1, reporting the tok/s overhead "
+                        "percentage (committed record: "
+                        "profiles/bench/trace_overhead_ab.jsonl)")
     p.add_argument("--prefill-chunk", type=int, default=16,
                    help="--mixed only: prefill piece size (one budget "
                         "installment)")
@@ -458,6 +559,11 @@ def main(argv=None) -> int:
                     args.cache_len or None, args.seed,
                     args.prefill_chunk, args.long_pieces,
                     reps=args.reps)
+            elif args.trace_ab:
+                rec = bench_trace_ab(args.preset, args.slots, args.chunk,
+                                     args.requests, prompt_range,
+                                     new_range, args.cache_len or None,
+                                     args.seed, reps=args.reps)
             else:
                 rec = bench_serving(args.preset, args.slots, args.chunk,
                                     args.requests, prompt_range,
@@ -473,6 +579,9 @@ def main(argv=None) -> int:
         if args.mixed:
             metric = f"{args.preset}_serving_mixed_p99_inter_token_ms"
             unit = "ms p99 active-lane inter-token during long admission"
+        elif args.trace_ab:
+            metric = f"{args.preset}_serving_trace_overhead_pct"
+            unit = "% tok/s lost, flight recorder on vs TTD_NO_TRACE=1"
         else:
             name = (f"{args.preset}_serving_engine_spec"
                     if args.speculative_draft

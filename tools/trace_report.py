@@ -1,0 +1,197 @@
+"""Render a flight-recorder dump: stage latencies + request waterfalls.
+
+The offline face of ``runtime.events`` (the always-on span/instant ring
+buffer), in the same spirit as ``tools/profile_summary.py`` for XPlane
+captures: given a Chrome-trace JSON — fetched from a live gateway's
+``GET /debug/trace?last_s=N`` or written by ``Recorder.save()`` — it
+answers "where did the time go" (a per-stage latency table over span
+names: count, mean, p50, p99, max) and "what happened to request X"
+(``--request N``: that request's admission→prefill→decode→retire
+waterfall, the offline twin of ``GET /v1/requests/<id>``).
+``--requests`` lists every request id in the window with its terminal
+status, and ``--journal supervisor.jsonl`` appends the supervisor's
+attempt timeline so relaunches are part of the same report.
+
+Usage:
+  curl -s 'localhost:8000/debug/trace?last_s=300' > /tmp/trace.json
+  python tools/trace_report.py /tmp/trace.json
+  python tools/trace_report.py /tmp/trace.json --request 17
+  python tools/trace_report.py /tmp/trace.json --requests \
+      --journal /ckpt/supervisor.jsonl
+
+(The JSON itself also loads directly in Perfetto / chrome://tracing —
+this tool is for terminals and incident notes.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def load_events(path: str) -> list:
+    with open(path) as f:
+        obj = json.load(f)
+    evs = obj["traceEvents"] if isinstance(obj, dict) else obj
+    if not isinstance(evs, list):
+        raise SystemExit(f"{path}: not a Chrome trace (no traceEvents)")
+    return evs
+
+
+def stage_table(evs: list) -> list:
+    """(name, count, total_ms, mean_ms, p50_ms, p99_ms, max_ms) per
+    span name, busiest first."""
+    durs = collections.defaultdict(list)
+    for e in evs:
+        if e.get("ph") == "X":
+            durs[e["name"]].append(e.get("dur", 0.0) / 1e3)
+    rows = []
+    for name, ds in durs.items():
+        ds.sort()
+        total = sum(ds)
+        rows.append((name, len(ds), total, total / len(ds),
+                     _percentile(ds, 0.5), _percentile(ds, 0.99),
+                     ds[-1]))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def instant_counts(evs: list) -> list:
+    counts = collections.Counter(
+        e["name"] for e in evs if e.get("ph") == "i")
+    return counts.most_common()
+
+
+def request_ids(evs: list) -> list:
+    """(request_id, status) for every gateway request in the window
+    (status from its retire instant; 'in-window' when none recorded)."""
+    status: dict = {}
+    for e in evs:
+        args = e.get("args") or {}
+        rid = args.get("request_id")
+        if rid is None:
+            continue
+        if e["name"] == "request/retire":
+            status[rid] = args.get("status", "?")
+        else:
+            status.setdefault(rid, "in-window")
+    return sorted(status.items())
+
+
+def request_waterfall(evs: list, request_id: int) -> list:
+    """The request's events, driver + engine joined — the same
+    latest-admission / rid-window rule as
+    ``Recorder.request_timeline`` applied to exported JSON."""
+    admit_t = None
+    for e in evs:
+        if (e["name"] == "request/admitted"
+                and (e.get("args") or {}).get("request_id") == request_id):
+            admit_t = e["ts"]
+    rid = None
+    grant_t = retire_t = None
+    out = []
+    for e in evs:
+        args = e.get("args") or {}
+        if (args.get("request_id") != request_id
+                or (admit_t is not None and e["ts"] < admit_t)):
+            continue
+        out.append(e)
+        if e["name"] == "request/engine_submit" and "rid" in args:
+            rid, grant_t = args["rid"], e["ts"]
+        if e["name"] == "request/retire":
+            retire_t = e["ts"]
+    if rid is not None:
+        lo = grant_t - 1e3          # ts in microseconds; hi exact (the
+        hi = retire_t if retire_t is not None else float("inf")
+        #   retire follows every engine event of the request)
+        for e in evs:
+            args = e.get("args") or {}
+            if ("request_id" not in args and args.get("rid") == rid
+                    and lo <= e["ts"] <= hi):
+                out.append(e)
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def print_waterfall(evs: list, request_id: int) -> None:
+    wf = request_waterfall(evs, request_id)
+    if not wf:
+        print(f"request {request_id}: no events in this window")
+        return
+    t0 = wf[0]["ts"]
+    print(f"\n== request {request_id} waterfall "
+          f"({len(wf)} events, t=0 at first event)")
+    print(f"{'t_ms':>10}  {'dur_ms':>8}  event")
+    for e in wf:
+        args = dict(e.get("args") or {})
+        args.pop("request_id", None)
+        dur = f"{e['dur'] / 1e3:8.3f}" if "dur" in e else " " * 8
+        extra = ("  " + " ".join(f"{k}={v}" for k, v in args.items())
+                 if args else "")
+        print(f"{(e['ts'] - t0) / 1e3:10.3f}  {dur}  {e['name']}{extra}")
+
+
+def print_journal(path: str) -> None:
+    print(f"\n== supervisor journal: {path}")
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            ev = rec.pop("event", "?")
+            print("  " + ev.ljust(10)
+                  + " ".join(f"{k}={v}" for k, v in rec.items()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("trace", help="Chrome-trace JSON (GET /debug/trace "
+                                 "output or Recorder.save())")
+    p.add_argument("--request", type=int, default=None,
+                   help="render one request's waterfall")
+    p.add_argument("--requests", action="store_true",
+                   help="list request ids in the window with status")
+    p.add_argument("--journal", default=None,
+                   help="supervisor JSONL to append as an attempt "
+                        "timeline")
+    args = p.parse_args(argv)
+    evs = load_events(args.trace)
+    print(f"# {args.trace}: {len(evs)} events")
+
+    rows = stage_table(evs)
+    if rows:
+        print(f"\n{'count':>7}  {'total_ms':>10}  {'mean_ms':>9}  "
+              f"{'p50_ms':>8}  {'p99_ms':>8}  {'max_ms':>8}  span")
+        for name, n, total, mean, p50, p99, mx in rows:
+            print(f"{n:7d}  {total:10.2f}  {mean:9.3f}  {p50:8.3f}  "
+                  f"{p99:8.3f}  {mx:8.3f}  {name}")
+    inst = instant_counts(evs)
+    if inst:
+        print(f"\n{'count':>7}  instant")
+        for name, n in inst:
+            print(f"{n:7d}  {name}")
+
+    if args.requests:
+        ids = request_ids(evs)
+        print(f"\n== requests in window: {len(ids)}")
+        for rid, status in ids:
+            print(f"  {rid:>8}  {status}")
+    if args.request is not None:
+        print_waterfall(evs, args.request)
+    if args.journal:
+        print_journal(args.journal)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
