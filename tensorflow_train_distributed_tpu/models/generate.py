@@ -33,12 +33,15 @@ from tensorflow_train_distributed_tpu.models.quant import (
 )
 
 
-def _decode_model(config, cache_len: int, slot_decode: bool = False):
+def _decode_model(config, cache_len: int, slot_decode: bool = False,
+                  paged_kv_blocks: int = 0, kv_block_size: int = 0):
     """The decode-mode model for a decoder-family config: LlamaModel for
     LlamaConfig, MoeLmModel for MoeConfig (Mixtral-style) — one generate
     path serves every decoder family.  ``slot_decode`` selects the
-    per-slot cache-index mode (serving.ServingEngine); this is the ONE
-    family-dispatch point, shared by generate and the engine."""
+    per-slot cache-index mode (serving.ServingEngine), and
+    ``paged_kv_blocks``/``kv_block_size`` its paged-pool variant (the
+    engine's block-table cache); this is the ONE family-dispatch point,
+    shared by generate and the engine."""
     from tensorflow_train_distributed_tpu.models.moe import (
         MoeConfig,
         MoeLmModel,
@@ -46,7 +49,9 @@ def _decode_model(config, cache_len: int, slot_decode: bool = False):
 
     cls = MoeLmModel if isinstance(config, MoeConfig) else LlamaModel
     return cls(config, decode=True, cache_len=cache_len,
-               slot_decode=slot_decode)
+               slot_decode=slot_decode,
+               paged_kv_blocks=paged_kv_blocks,
+               kv_block_size=kv_block_size)
 
 
 def cast_floating(params, dtype):

@@ -229,6 +229,21 @@ class MultiHeadAttention(nn.Module):
     # each slot's own position.  Linear full-precision cache only
     # (window/sinks/int8-KV keep the shared-index fast path).
     slot_decode: bool = False
+    # Paged KV cache (serving.ServingEngine paged mode; needs
+    # slot_decode): instead of one contiguous [B, cache_len] strip per
+    # lane, KV rows live in a FIXED pool of ``paged_kv_blocks`` physical
+    # blocks of ``kv_block_size`` rows, and each lane maps its logical
+    # positions through a per-lane block table (a [B, ceil(cache_len /
+    # kv_block_size)] cache variable the engine rewrites host-side at
+    # insert/retire).  Shapes stay static — the pool never grows — so
+    # jit/sharding see the same program session-long; only table
+    # CONTENTS change, which is what lets requests share prompt-prefix
+    # blocks copy-on-write (serving_kv.RadixPrefixIndex).  Block 0 is
+    # the engine's scratch block: idle/retired lanes' garbage writes
+    # land there (their table rows are zeroed), the paged analog of the
+    # linear cache's stale-row rule.
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
     # Projection biases (BERT-style encoders; Llama-family stays False).
     use_bias: bool = False
     # q/k/v biases ONLY, out-proj unbiased (the Qwen-family convention;
@@ -321,6 +336,10 @@ class MultiHeadAttention(nn.Module):
         if self.slot_decode:
             raise ValueError("slot_decode requires decode=True (it is a "
                              "KV-cache mode)")
+        if self.paged_kv_blocks:
+            raise ValueError("paged_kv_blocks requires decode=True + "
+                             "slot_decode=True (it is a serving KV-cache "
+                             "mode)")
         if segment_ids is not None and x_kv is not None:
             raise ValueError(
                 "segment_ids (sequence packing) applies to self-attention "
@@ -419,6 +438,10 @@ class MultiHeadAttention(nn.Module):
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True needs cache_len > 0")
+        if self.paged_kv_blocks and not self.slot_decode:
+            raise ValueError(
+                "paged_kv_blocks requires slot_decode=True (the paged "
+                "pool is the serving engine's per-lane cache mode)")
         if self.slot_decode:
             if (self.window is not None or self.sinks
                     or self.kv_cache_int8):
@@ -426,6 +449,17 @@ class MultiHeadAttention(nn.Module):
                     "slot_decode (per-slot cache positions) supports the "
                     "LINEAR full-precision cache only — window/sinks/"
                     "kv_cache_int8 keep the shared-index path")
+            if self.paged_kv_blocks:
+                if self.paged_kv_blocks < 2:
+                    raise ValueError(
+                        "paged_kv_blocks must be >= 2 (block 0 is the "
+                        f"reserved scratch block), got "
+                        f"{self.paged_kv_blocks}")
+                if self.kv_block_size < 1:
+                    raise ValueError(
+                        f"kv_block_size must be >= 1, got "
+                        f"{self.kv_block_size}")
+                return self._paged_decode_step(x)
             return self._slot_decode_step(x)
         if self.sinks and (self.window is None
                            or self.sinks > self.window):
@@ -629,6 +663,89 @@ class MultiHeadAttention(nn.Module):
         return self._cache_attend(q, cache_k.value, cache_v.value,
                                   mask[:, None], kv_heads, b, q_len,
                                   x.shape[-1])
+
+    def _paged_decode_step(self, x):
+        """Per-slot decode over the PAGED pool: same append-and-attend
+        contract as ``_slot_decode_step``, with the lane's contiguous
+        cache strip replaced by a block-table indirection.
+
+        Writes scatter each token's k/v row to ``pool[table[b, p //
+        bs], p %% bs]`` (positions past the table width map to an
+        out-of-range row and are DROPPED, the linear path's overrun
+        rule; positions in table slots the engine zeroed land in the
+        scratch block — garbage nobody reads).  Reads gather the lane's
+        logical rows back into a [B, cache_len] view
+        (``ops.pallas_kernels.paged_kv_gather`` — pure-jax on CPU, a
+        scalar-prefetch block-copy kernel on TPU) and attend exactly as
+        the linear path does: same mask, same positions, same einsum
+        shapes, so outputs are bitwise-identical to the linear cache
+        whenever the gathered bytes are (which the engine's block
+        bookkeeping guarantees — pinned in tests/test_serving_paged.py).
+        """
+        from tensorflow_train_distributed_tpu.ops.pallas_kernels import (
+            paged_kv_gather,
+        )
+
+        kv_heads = self.num_kv_heads or self.num_heads
+        b, q_len, _ = x.shape
+        bs = self.kv_block_size
+        nb = self.paged_kv_blocks
+        n_blk = -(-self.cache_len // bs)
+
+        q, k, v = self._qkv(x)
+
+        cache_k = self.variable(
+            "cache", "key_pool", jnp.zeros,
+            (nb, bs, kv_heads, self.head_dim), self.dtype)
+        cache_v = self.variable(
+            "cache", "value_pool", jnp.zeros,
+            (nb, bs, kv_heads, self.head_dim), self.dtype)
+        # All-zero init: every lane starts mapped to the scratch block,
+        # so pre-insert garbage decode is self-contained by
+        # construction.
+        table = self.variable(
+            "cache", "block_table", jnp.zeros, (b, n_blk), jnp.int32)
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
+        cur = index.value                                   # [B]
+        positions = cur[:, None] + jnp.arange(q_len)        # [B, q]
+        if self.use_rope:
+            q = apply_rope(q, positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
+            k = apply_rope(k, positions, base=self.rope_base,
+                           scaling=self.rope_scaling)
+        index.value = cur + q_len
+
+        kdt = cache_k.value.dtype
+        # Physical destination row per (lane, token): the table lookup
+        # CLIPS the block index (gather semantics would otherwise wrap)
+        # and overrun positions are sent out of range so the scatter
+        # drops them — an overrun lane goes silently inert, exactly the
+        # linear path's rule.
+        blk = jnp.clip(positions // bs, 0, n_blk - 1)
+        phys = jnp.take_along_axis(table.value, blk, axis=1)  # [B, q]
+        dest = jnp.where(positions < n_blk * bs,
+                         phys * bs + positions % bs, nb * bs)
+        flat_shape = (nb * bs, kv_heads, self.head_dim)
+        cache_k.value = (
+            cache_k.value.reshape(flat_shape)
+            .at[dest.reshape(-1)]
+            .set(k.astype(kdt).reshape(-1, kv_heads, self.head_dim),
+                 mode="drop")
+            .reshape(nb, bs, kv_heads, self.head_dim))
+        cache_v.value = (
+            cache_v.value.reshape(flat_shape)
+            .at[dest.reshape(-1)]
+            .set(v.astype(kdt).reshape(-1, kv_heads, self.head_dim),
+                 mode="drop")
+            .reshape(nb, bs, kv_heads, self.head_dim))
+
+        kc = paged_kv_gather(cache_k.value, table.value, self.cache_len)
+        vc = paged_kv_gather(cache_v.value, table.value, self.cache_len)
+        kv_pos = jnp.arange(self.cache_len)
+        mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B,q,C]
+        return self._cache_attend(q, kc, vc, mask[:, None], kv_heads, b,
+                                  q_len, x.shape[-1])
 
     def _cache_attend(self, q, kc, vc, mask, kv_heads, b, q_len, features):
         """Masked einsum attention of q over the cache buffers."""

@@ -251,6 +251,10 @@ class DecoderBlock(nn.Module):
     decode: bool = False
     cache_len: int = 0
     slot_decode: bool = False
+    # Paged serving KV cache (serving.ServingEngine paged mode) — see
+    # layers.MultiHeadAttention.paged_kv_blocks.
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -270,6 +274,8 @@ class DecoderBlock(nn.Module):
             cache_len=self.cache_len or cfg.max_positions,
             kv_cache_int8=cfg.kv_cache_int8,
             slot_decode=self.slot_decode,
+            paged_kv_blocks=self.paged_kv_blocks,
+            kv_block_size=self.kv_block_size,
             fused_qkv=cfg.fused_qkv,
             qkv_bias=cfg.qkv_bias,
             name="attention",
@@ -323,6 +329,8 @@ class _BlockStep(nn.Module):
     decode: bool = False
     cache_len: int = 0
     slot_decode: bool = False
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, carry, aux):
@@ -330,6 +338,8 @@ class _BlockStep(nn.Module):
         return DecoderBlock(self.config, decode=self.decode,
                             cache_len=self.cache_len,
                             slot_decode=self.slot_decode,
+                            paged_kv_blocks=self.paged_kv_blocks,
+                            kv_block_size=self.kv_block_size,
                             name="block")(carry, segment_ids,
                                           positions), None
 
@@ -342,19 +352,27 @@ class _ScannedBlock(nn.Module):
     decode: bool = False
     cache_len: int = 0
     slot_decode: bool = False
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
         from functools import partial as _partial
 
-        # slot_decode threads through BOTH branches so the layer guard
-        # ("slot_decode requires decode=True") fires under scan_layers
-        # exactly as it does on the unscanned path.
+        # slot_decode (and the paged-pool knobs) thread through BOTH
+        # branches so the layer guards ("slot_decode requires
+        # decode=True", ditto paged_kv_blocks) fire under scan_layers
+        # exactly as they do on the unscanned path.
         step = (_partial(_BlockStep, decode=True,
                          cache_len=self.cache_len,
-                         slot_decode=self.slot_decode) if self.decode
+                         slot_decode=self.slot_decode,
+                         paged_kv_blocks=self.paged_kv_blocks,
+                         kv_block_size=self.kv_block_size)
+                if self.decode
                 else _partial(_BlockStep,
-                              slot_decode=self.slot_decode))
+                              slot_decode=self.slot_decode,
+                              paged_kv_blocks=self.paged_kv_blocks,
+                              kv_block_size=self.kv_block_size))
         # No remat in decode mode: there is no backward pass to save memory
         # for, and the KV-cache writes must not replay under a checkpoint.
         if wants_outer_remat(self.config) and not self.decode:
@@ -443,6 +461,11 @@ class LlamaModel(nn.Module):
     # per slot.  Linear full-precision cache only — see
     # layers.MultiHeadAttention.slot_decode.
     slot_decode: bool = False
+    # Paged serving KV cache: >0 turns the per-lane contiguous cache
+    # into a fixed physical block pool + per-lane block table — see
+    # layers.MultiHeadAttention.paged_kv_blocks.
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None):
@@ -477,7 +500,10 @@ class LlamaModel(nn.Module):
         elif cfg.scan_layers:
             x = _ScannedBlock(cfg, decode=self.decode,
                               cache_len=self.cache_len,
-                              slot_decode=self.slot_decode, name="layers")(
+                              slot_decode=self.slot_decode,
+                              paged_kv_blocks=self.paged_kv_blocks,
+                              kv_block_size=self.kv_block_size,
+                              name="layers")(
                 x, segment_ids, positions)
         else:
             for i in range(cfg.num_layers):
@@ -487,7 +513,10 @@ class LlamaModel(nn.Module):
                                    policy=_checkpoint_policy(cfg))
                 x = blk(cfg, decode=self.decode,
                         cache_len=self.cache_len,
-                        slot_decode=self.slot_decode, name=f"layer_{i}")(
+                        slot_decode=self.slot_decode,
+                        paged_kv_blocks=self.paged_kv_blocks,
+                        kv_block_size=self.kv_block_size,
+                        name=f"layer_{i}")(
                     x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       zero_centered=cfg.norm_zero_centered,

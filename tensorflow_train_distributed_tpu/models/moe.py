@@ -556,6 +556,9 @@ class MoeDecoderBlock(nn.Module):
     decode: bool = False
     cache_len: int = 0
     slot_decode: bool = False
+    # Paged serving KV cache — see layers.MultiHeadAttention.
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -572,6 +575,8 @@ class MoeDecoderBlock(nn.Module):
             decode=self.decode,
             cache_len=self.cache_len or cfg.max_positions,
             slot_decode=self.slot_decode,
+            paged_kv_blocks=self.paged_kv_blocks,
+            kv_block_size=self.kv_block_size,
         )(h, segment_ids=segment_ids, positions=positions)
         h = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="mlp_norm")(x)
@@ -605,6 +610,9 @@ class MoeLmModel(nn.Module):
     # Per-slot cache positions (continuous-batching serving,
     # serving.ServingEngine) — see layers.MultiHeadAttention.slot_decode.
     slot_decode: bool = False
+    # Paged serving KV cache — see layers.MultiHeadAttention.
+    paged_kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None):
@@ -638,6 +646,8 @@ class MoeLmModel(nn.Module):
             x = blk(cfg, use_moe=(i % cfg.moe_every == 0),
                     decode=self.decode, cache_len=self.cache_len,
                     slot_decode=self.slot_decode,
+                    paged_kv_blocks=self.paged_kv_blocks,
+                    kv_block_size=self.kv_block_size,
                     name=f"layer_{i}")(x, segment_ids, positions)
         x = L.RMSNorm(epsilon=cfg.rms_epsilon, dtype=cfg.dtype,
                       name="final_norm")(x)

@@ -17,6 +17,19 @@ writes kernels.  TPU-first, the two ops worth owning beyond attention are:
   softmax blockwise from the saved logsumexp, so HBM cost is the logits
   themselves and [tokens]-sized residuals.
 
+A third, serving-side kernel backs the engine's paged KV cache:
+
+- **Paged KV gather** (``paged_kv_gather``): the decode step reads each
+  lane's KV through a block table (physical blocks of ``block_size``
+  rows in one fixed pool — serving.ServingEngine's paged cache).  The
+  jnp reference materializes the gather through XLA's generic scatter/
+  gather lowering; the kernel is a block-copy loop whose source block
+  index comes from a SCALAR-PREFETCHED table (``PrefetchScalarGridSpec``
+  — the index map reads ``table[lane, slot]`` before the body runs), so
+  each grid step is one contiguous [block_size, kv_heads·head_dim] VMEM
+  copy at the natural tile shape, no per-row index math on the vector
+  units.
+
 Both have pure-jax references (the CPU path and the numerics oracle) and
 run in interpreter mode in tests (``interpret=True``); kernel layout
 follows ``/opt/skills/guides/pallas_guide.md`` (f32 accumulation, 128-lane
@@ -59,6 +72,68 @@ def _use_pallas(override: Optional[bool]) -> bool:
     if env_flag("TTD_NO_PALLAS"):
         return False
     return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Paged KV gather (serving.ServingEngine paged cache)
+# ---------------------------------------------------------------------------
+
+
+def paged_kv_gather_reference(pool, table, cache_len: int):
+    """Pure-jax oracle: gather each lane's logical KV rows.
+
+    ``pool``: [num_blocks, block_size, kv_heads, head_dim] physical
+    rows; ``table``: [lanes, n_blk] int32 physical block per logical
+    block.  Returns [lanes, cache_len, kv_heads, head_dim] — lane b's
+    logical row p is ``pool[table[b, p // bs], p % bs]``.
+    """
+    nb, bs, kvh, hd = pool.shape
+    lanes = table.shape[0]
+    # Gather whole BLOCKS (lanes * n_blk indices, contiguous
+    # [bs, kvh, hd] slices each) rather than per-row (lanes * cache_len
+    # indices): same bytes, far less index math — XLA lowers this to
+    # slice copies, which keeps the paged read from taxing decode.
+    blocks = jnp.take(pool, table, axis=0)     # [lanes, n_blk, bs, ...]
+    return blocks.reshape(lanes, -1, kvh, hd)[:, :cache_len]
+
+
+def _paged_gather_kernel(tbl_ref, pool_ref, out_ref):
+    # The index map already steered the DMA to the right physical
+    # block (scalar-prefetched table); the body is a straight copy.
+    del tbl_ref
+    out_ref[:] = pool_ref[:]
+
+
+def paged_kv_gather(pool, table, cache_len: int, *,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Block-table KV gather: [num_blocks, bs, kvh, hd] pool + [lanes,
+    n_blk] table → [lanes, cache_len, kvh, hd] per-lane linear view
+    (bit-identical to the reference: a gather moves bytes, no math)."""
+    if not _use_pallas(use_pallas) and not interpret:
+        return paged_kv_gather_reference(pool, table, cache_len)
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, bs, kvh, hd = pool.shape
+    lanes, n_blk = table.shape
+    flat = pool.reshape(nb, bs, kvh * hd)
+    out = pl.pallas_call(
+        _paged_gather_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(lanes, n_blk),
+            in_specs=[
+                pl.BlockSpec((1, bs, kvh * hd),
+                             lambda i, j, tbl: (tbl[i, j], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bs, kvh * hd),
+                                   lambda i, j, tbl: (i, j, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((lanes, n_blk * bs, kvh * hd),
+                                       pool.dtype),
+        interpret=interpret,
+    )(table, flat)
+    return out[:, :cache_len].reshape(lanes, cache_len, kvh, hd)
 
 
 # ---------------------------------------------------------------------------

@@ -21,11 +21,18 @@ loop body needs no special casing, only this ordering.  No device code
 runs anywhere else, so the bridge composes with every engine
 configuration (sampling, int8, speculative, TP meshes) untouched.
 
-Load shedding happens at ``submit()``: requests waiting for a slot
+Load shedding happens at ``submit()``: requests waiting for a lane
 (admitted here + queued inside the engine) are capped at ``max_queue``;
 beyond it ``AdmissionFull`` tells the frontend to answer 429 with a
-Retry-After.  Draining flips one flag: new submissions get
-``Draining`` (503) while in-flight work finishes normally.
+Retry-After.  With the engine's paged KV cache (the default), a lane
+grant is keyed on FREE BLOCKS, not free slots: the engine refuses a
+claim the pool cannot back and the request stays queued — so the
+waiting() gauge (and therefore the 429 threshold) reflects memory
+pressure, not just slot occupancy, and a request that could NEVER fit
+(more blocks than the whole pool) is rejected at ``submit()`` as a
+RequestError by the engine's validator.  Draining flips one flag: new
+submissions get ``Draining`` (503) while in-flight work finishes
+normally.
 """
 
 from __future__ import annotations

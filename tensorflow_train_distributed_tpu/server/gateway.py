@@ -110,12 +110,22 @@ class _Handler(BaseHTTPRequestHandler):
             dead = not draining and not gw.driver.alive()
             status = ("draining" if draining
                       else "driver_dead" if dead else "ok")
-            self._reply_json(200 if status == "ok" else 503, {
+            body = {
                 "status": status,
                 "queue_depth": gw.driver.waiting(),
                 "slots_in_use": gw.driver.active_slots(),
                 "slots_total": gw.engine.slots,
-            })
+            }
+            # Paged-KV engines: admission is keyed on free blocks, so
+            # the block occupancy IS the capacity signal load
+            # balancers should watch (absent for linear-cache engines
+            # and stubs).
+            total_fn = getattr(gw.engine, "kv_blocks_total", None)
+            total = total_fn() if total_fn is not None else 0
+            if total:
+                body["kv_blocks_total"] = total
+                body["kv_blocks_in_use"] = gw.engine.kv_blocks_in_use()
+            self._reply_json(200 if status == "ok" else 503, body)
         elif path == "/metrics":
             body = self.gateway.metrics.render().encode()
             self.send_response(200)
@@ -330,7 +340,16 @@ class ServingGateway:
             # lookahead / prefill scheduler) scrape a truthful
             # constant 0.
             overlap_ratio_fn=getattr(engine, "overlap_ratio", None),
-            prefill_stall_fn=getattr(engine, "prefill_stall_s", None))
+            prefill_stall_fn=getattr(engine, "prefill_stall_s", None),
+            # Paged-KV gauges/counters (scrape 0 for linear-cache
+            # engines and stubs — the same getattr contract).
+            kv_blocks_in_use_fn=getattr(engine, "kv_blocks_in_use",
+                                        None),
+            kv_blocks_total_fn=getattr(engine, "kv_blocks_total", None),
+            kv_prefix_hit_tokens_fn=getattr(engine,
+                                            "kv_prefix_hit_tokens",
+                                            None),
+            kv_evictions_fn=getattr(engine, "kv_evictions", None))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

@@ -87,6 +87,30 @@ class Counter:
         return lines
 
 
+class FnCounter(Counter):
+    """Counter whose value lives elsewhere (an engine's cumulative
+    stat), sampled at scrape time like a callable-backed gauge but
+    rendered with counter TYPE (and held to counter naming) — for
+    monotonic engine-side totals the driver never observes directly."""
+
+    def __init__(self, name: str, help_: str,
+                 fn: Optional[Callable[[], float]] = None):
+        super().__init__(name, help_)
+        self._fn = fn
+
+    def inc(self, n: float = 1, label_value: Optional[str] = None):
+        raise TypeError(f"{self.name} is sampled from its source "
+                        f"callable; nothing to inc")
+
+    def value(self, label_value: Optional[str] = None) -> float:
+        return 0.0 if self._fn is None else float(self._fn())
+
+    def render(self) -> list:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} counter",
+                f"{self.name} {_fmt(self.value())}"]
+
+
 class Gauge:
     """Set-anytime value, or a callable sampled at scrape time."""
 
@@ -163,6 +187,9 @@ class Registry:
     def counter(self, name, help_, label=None) -> Counter:
         return self._add(Counter(name, help_, label))
 
+    def fn_counter(self, name, help_, fn=None) -> FnCounter:
+        return self._add(FnCounter(name, help_, fn))
+
     def gauge(self, name, help_, fn=None) -> Gauge:
         return self._add(Gauge(name, help_, fn))
 
@@ -197,7 +224,12 @@ class GatewayMetrics:
                  slots_in_use_fn: Callable[[], int], slots_total: int,
                  driver_alive_fn: Optional[Callable[[], bool]] = None,
                  overlap_ratio_fn: Optional[Callable[[], float]] = None,
-                 prefill_stall_fn: Optional[Callable[[], float]] = None):
+                 prefill_stall_fn: Optional[Callable[[], float]] = None,
+                 kv_blocks_in_use_fn: Optional[Callable[[], int]] = None,
+                 kv_blocks_total_fn: Optional[Callable[[], int]] = None,
+                 kv_prefix_hit_tokens_fn: Optional[
+                     Callable[[], int]] = None,
+                 kv_evictions_fn: Optional[Callable[[], int]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -247,6 +279,33 @@ class GatewayMetrics:
             "Cumulative seconds decode lanes spent stalled behind "
             "admission prefill (~0 with interleaved prefill on).",
             fn=prefill_stall_fn)
+        # Paged-KV cache economics (serving.ServingEngine paged mode;
+        # all four scrape 0 for linear-cache engines and test stubs —
+        # the truthful constant).  Occupancy pair: admission is keyed
+        # on FREE BLOCKS, so in_use/total is the real capacity gauge
+        # where slots_in_use no longer binds; the counters are the
+        # prefix-cache win (prompt tokens whose prefill was skipped via
+        # radix hits) and its cost under memory pressure (blocks
+        # LRU-evicted from the retired-prefix cache).
+        self.kv_blocks_in_use = r.gauge(
+            "ttd_engine_kv_blocks_in_use",
+            "Paged-KV physical blocks referenced by live lanes or the "
+            "radix prefix cache (0 = linear cache).",
+            fn=kv_blocks_in_use_fn)
+        self.kv_blocks_total = r.gauge(
+            "ttd_engine_kv_blocks_total",
+            "Paged-KV pool capacity in blocks (0 = linear cache).",
+            fn=kv_blocks_total_fn)
+        self.kv_prefix_hit_tokens = r.fn_counter(
+            "ttd_engine_prefix_hit_tokens_total",
+            "Prompt tokens whose prefill was skipped via radix "
+            "prefix-cache hits.",
+            fn=kv_prefix_hit_tokens_fn)
+        self.kv_evictions = r.fn_counter(
+            "ttd_engine_kv_evictions_total",
+            "Paged-KV blocks LRU-evicted from the retired-prefix "
+            "cache under allocation pressure.",
+            fn=kv_evictions_fn)
         # The queue-depth gauge's latency companion: how long admission
         # actually COSTS (admission → engine slot granted), observed by
         # the driver when engine.submit succeeds — queue depth alone

@@ -55,10 +55,44 @@ yields to decode between requests.  ``prefill_budget=0`` /
 ``TTD_NO_INTERLEAVE=1`` (or the CLIs' ``--no-interleave``) is the kill
 switch restoring atomic admission byte-for-byte.
 
+**Paged KV cache with cross-request prefix sharing** (the default;
+``TTD_NO_PAGED_KV=1`` / ``paged=False`` / the CLIs' ``--no-paged-kv``
+restores the per-slot linear cache byte-for-byte): KV rows live in ONE
+fixed pool of ``--kv-block-size``-row physical blocks per layer, and
+each lane maps its logical positions through a per-lane block table
+(``serving_kv`` owns the host bookkeeping: block-pool allocator +
+refcounts + a radix tree over token ids at block granularity).  Two
+wins over the linear cache:
+
+- **capacity**: a lane holds ``ceil((prompt + max_new) / block_size)``
+  blocks instead of a full ``cache_len`` strip, so short requests stop
+  reserving long-request memory and admission is keyed on FREE BLOCKS,
+  not free slots — a request that cannot get its blocks waits in the
+  queue (refused admission, never a corrupted live lane);
+- **prefix sharing**: requests whose prompts share a block-aligned
+  prefix map their leading table entries to the SAME physical blocks
+  (copy-on-write at allocation — a suffix always starts at a block
+  boundary, so sharers never write shared blocks) and prefill only the
+  suffix.  The radix index is fed automatically at insert/retire, so
+  shared system prompts hit warm KV with no ``preload_prefix``
+  hand-wiring (which remains supported and now preloads into the same
+  pool); retired requests' blocks stay cached until LRU eviction under
+  pressure reclaims them.
+
+Prefill itself is UNCHANGED — the same batch-1 linear piece programs
+run in the same order (a matched prefix is gathered from the pool into
+the batch-1 cache, replacing recompute with a copy), and the decode
+grid reads/writes KV through the block table (gather/scatter —
+``ops.pallas_kernels.paged_kv_gather`` is the TPU seam), so outputs
+stay bitwise-identical to the linear engine for greedy, seeded
+sampling, and speculative serving (pinned in
+tests/test_serving_paged.py).
+
 Shapes are static everywhere (slot count, cache rows, chunk length,
-prompt buckets / prefill pieces) — only cache *contents* and the
-per-slot index vector change, so XLA compiles a handful of programs
-and reuses them for the whole serving session.
+prompt buckets / prefill pieces, and the paged pool + block tables) —
+only cache *contents* and the per-slot index vector change, so XLA
+compiles a handful of programs and reuses them for the whole serving
+session.
 
 Scope: the decoder families ``generate()`` serves (Llama AND
 Mixtral-style MoE — one engine), linear cache, greedy or sampled
@@ -91,6 +125,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from tensorflow_train_distributed_tpu.runtime import compat, events
+from tensorflow_train_distributed_tpu import serving_kv
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
     cast_floating,
@@ -133,13 +168,15 @@ class _PrefillTask:
     padded: np.ndarray             # [1, piece * n_pieces] token ids
     piece: int
     n_pieces: int
-    pre_pair: Optional[tuple] = None   # matched prefix caches
+    pre_pair: Optional[tuple] = None   # matched prefix caches (linear)
     cursor: int = 0                # target pieces completed
     cache_1: object = None         # target batch-1 cache in progress
     first: object = None           # device pick after the last piece
     first_host: Optional[int] = None
     d_cursor: int = 0              # draft pieces completed
     d_cache_1: object = None
+    kv: object = None              # serving_kv.LaneKV claim (paged mode)
+    table: object = None           # np.int32 [n_blk] physical block row
 
 
 def _overlap_killed() -> bool:
@@ -155,6 +192,14 @@ def _interleave_killed() -> bool:
     engine's ``prefill_budget`` — the same no-redeploy contract as
     ``TTD_NO_OVERLAP``."""
     return os.environ.get("TTD_NO_INTERLEAVE", "0") not in ("", "0")
+
+
+def _paged_killed() -> bool:
+    """``TTD_NO_PAGED_KV=1`` restores the per-slot LINEAR cache
+    byte-for-byte (contiguous ``cache_len`` rows per lane, manual
+    ``preload_prefix`` prefix caching) regardless of how the engine was
+    constructed — the same no-redeploy contract as ``TTD_NO_OVERLAP``."""
+    return os.environ.get("TTD_NO_PAGED_KV", "0") not in ("", "0")
 
 
 def _bucket_len(n: int, buckets) -> int:
@@ -190,7 +235,11 @@ class ServingEngine:
                  speculative_k: int = 0,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024),
                  overlap: Optional[bool] = None,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 kv_block_size: int = 16,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache_limit: int = 32):
         # MoeConfig has no window/int8-KV knobs; getattr keeps one check
         # covering both decoder families.
         if (getattr(config, "sliding_window", None) is not None
@@ -274,8 +323,47 @@ class ServingEngine:
         if cast_params:
             params = cast_floating(params, config.dtype)
         self._variables = maybe_quant_variables(params, quant_scales)
-        self._model = _decode_model(config, self.cache_len,
-                                    slot_decode=True)
+        # Paged KV cache (the default; ``paged=False`` or
+        # TTD_NO_PAGED_KV=1 restores the linear per-slot cache
+        # byte-for-byte).  The pool is sized in BLOCKS: by default
+        # slots * ceil(cache_len / block_size) — the linear cache's
+        # exact memory, so defaults change layout, never capacity;
+        # operators shrink/grow it with ``kv_pool_blocks`` (admission
+        # then keys on free blocks, not free slots).
+        if kv_block_size < 1:
+            raise ValueError(
+                f"kv_block_size must be >= 1, got {kv_block_size}")
+        self.kv_block_size = int(kv_block_size)
+        self._kv_nblk_lane = -(-self.cache_len // self.kv_block_size)
+        self.paged = ((True if paged is None else bool(paged))
+                      and not _paged_killed())
+        if kv_pool_blocks is None:
+            kv_pool_blocks = slots * self._kv_nblk_lane
+        if kv_pool_blocks < 1:
+            raise ValueError(
+                f"kv_pool_blocks must be >= 1, got {kv_pool_blocks}")
+        self._kv_pool = self._radix = None
+        if self.paged:
+            self._kv_pool = serving_kv.KVBlockPool(
+                kv_pool_blocks, self.kv_block_size)
+            self._radix = serving_kv.RadixPrefixIndex(self._kv_pool)
+        # kv_stats counts ENGINE-visible cache economics (the /metrics
+        # feed): tokens of prefill skipped via radix prefix hits,
+        # blocks LRU-evicted under allocation pressure, and admissions
+        # refused for want of blocks.
+        self.kv_stats = {"prefix_hit_tokens": 0, "prefix_hits": 0,
+                         "evictions": 0, "alloc_refusals": 0}
+        # Prefill always runs batch-1 on the LINEAR cache (the same
+        # piece programs as the linear engine — prefix reuse replaces
+        # recompute with a pool gather, never changes the math); only
+        # the slot-grid decode/verify/insert programs go paged.
+        self._prefill_model = _decode_model(config, self.cache_len,
+                                            slot_decode=True)
+        self._model = (_decode_model(
+            config, self.cache_len, slot_decode=True,
+            paged_kv_blocks=1 + kv_pool_blocks,
+            kv_block_size=self.kv_block_size)
+            if self.paged else self._prefill_model)
         # Speculative decoding across ALL slots: each round the draft
         # proposes k tokens per slot, the target verifies the k+1 block
         # in one call, and each slot accepts its own prefix — the
@@ -328,8 +416,17 @@ class ServingEngine:
                                              draft_config.dtype)
             self._draft_variables = maybe_quant_variables(
                 draft_params, draft_quant_scales)
-            self._draft_model = _decode_model(
+            # The draft shares the TARGET's block tables (its lanes'
+            # logical layouts are identical — both caches hold the same
+            # row sets by the speculative invariant), so one allocation
+            # covers both pools; only the pool row shapes differ.
+            self._draft_prefill_model = _decode_model(
                 draft_config, self.cache_len, slot_decode=True)
+            self._draft_model = (_decode_model(
+                draft_config, self.cache_len, slot_decode=True,
+                paged_kv_blocks=1 + kv_pool_blocks,
+                kv_block_size=self.kv_block_size)
+                if self.paged else self._draft_prefill_model)
         # Sharded serving: with a mesh, every device call runs under
         # jax.set_mesh + the logical-axis rules, so the models' logical
         # constraints shard weights/cache/activations (e.g. heads over
@@ -350,9 +447,42 @@ class ServingEngine:
         # denominator for acceptance rates (accepted/(slot_rounds·k)).
         self.spec_stats = {"rounds": 0, "slot_rounds": 0,
                            "drafted_accepted": 0, "emitted": 0}
-        self._cache_shapes: dict = {}  # (model, batch) -> eval_shape
+        self._cache_shapes: dict = {}  # (draft, batch, grid) -> eval_shape
         self._moe_prefill_lens: set = set()  # distinct exact-prefill lens
-        self._prefix_caches: dict = {}  # tuple(tokens) -> batch-1 cache
+        # Linear-path prefix caches (paged mode subsumes them via the
+        # radix index): LRU-BOUNDED — keyed by tuple(tokens), these
+        # hold device memory, and an unbounded dict leaks under many
+        # distinct preloaded prefixes.  ``prefix_cache_limit`` caps the
+        # entries; preload past it evicts the least recently matched.
+        if prefix_cache_limit < 1:
+            raise ValueError(f"prefix_cache_limit must be >= 1, got "
+                             f"{prefix_cache_limit}")
+        self.prefix_cache_limit = prefix_cache_limit
+        from collections import OrderedDict
+        self._prefix_caches: OrderedDict = OrderedDict()
+        # The ONE engine structure gateway handler threads READ while
+        # the driver thread writes: validate_request scans the prefix
+        # stores concurrently with admission's LRU touches / preload's
+        # eviction, and an OrderedDict mutated mid-iteration raises in
+        # the READER.  Everything touching _prefix_caches/_preloaded
+        # holds this lock (admission's hold is nanoseconds — dict
+        # walks, never device work).
+        import threading
+        self._prefix_lock = threading.Lock()
+        # Paged-mode per-lane claims and admission bookkeeping:
+        # _lane_kv[slot] holds the LaneKV while the lane decodes;
+        # _stale_slots are lanes retired/cancelled since the last
+        # dispatch — their block-table rows must be zeroed (pointed at
+        # the scratch block) BEFORE the next decode program runs, or
+        # the overlap scheduler's one garbage chunk would write into
+        # blocks already freed to (and maybe reallocated by) someone
+        # else.  _preloaded records preload_prefix token tuples for
+        # validate_request's bucket rule (the radix itself is
+        # evictable, so validation must not depend on it).
+        self._lane_kv: list = [None] * slots
+        self._stale_slots: set = set()
+        self._preloaded: dict = {}
+        self._kv_refused_rid: Optional[int] = None  # dedup refusal count
         # Async decode pipelining (one-chunk lookahead).  ``overlap``
         # None/True enables it; TTD_NO_OVERLAP=1 kills it either way.
         self.overlap = ((True if overlap is None else bool(overlap))
@@ -446,7 +576,7 @@ class ServingEngine:
         every position).
         """
         with quantized_inference():
-            logits, vs = self._model.apply(
+            logits, vs = self._prefill_model.apply(
                 dict(variables, cache=cache), tokens_1xl,
                 mutable=["cache"])
         first = self._pick(logits[:, local_idx],
@@ -459,7 +589,7 @@ class ServingEngine:
         needs its KV rows; pad rows are harmless by the same
         write-before-read rule as the target's)."""
         with quantized_inference():
-            _, vs = self._draft_model.apply(
+            _, vs = self._draft_prefill_model.apply(
                 dict(variables, cache=cache), tokens_1xl,
                 mutable=["cache"])
         return vs["cache"]
@@ -589,6 +719,130 @@ class ServingEngine:
 
         return jax.tree_util.tree_map_with_path(ins, cache_b, cache_1)
 
+    # -- paged-pool programs -----------------------------------------------
+
+    @staticmethod
+    def _path_key(path) -> tuple:
+        return tuple(getattr(k, "key", str(k)) for k in path)
+
+    def _lane_dest_rows(self, table_row, start, end):
+        """Physical pool row per logical position in [start, end);
+        positions outside map out of range (nb*bs) so scatters DROP
+        them — the shared-block copy-on-write guard (rows before
+        ``start`` belong to radix-shared blocks this lane must never
+        write)."""
+        bs = self.kv_block_size
+        nb = 1 + self._kv_pool.n_blocks
+        pos = jnp.arange(self.cache_len)
+        phys = table_row[jnp.clip(pos // bs, 0, self._kv_nblk_lane - 1)]
+        return jnp.where((pos >= start) & (pos < end),
+                         phys * bs + pos % bs, nb * bs)
+
+    def _scatter_rows_tree(self, cache, cache_1, table_row, start, end):
+        """Scatter the batch-1 LINEAR cache's rows [start, end) into the
+        paged pool at ``table_row``'s blocks (traced helper shared by
+        insert and preload; leaves pair by module path — only the leaf
+        names differ between the two cache layouts)."""
+        dest = self._lane_dest_rows(table_row, start, end)
+        rename = {"key_pool": "key_cache", "value_pool": "value_cache"}
+        flat_1 = {self._path_key(p): leaf for p, leaf
+                  in jax.tree_util.tree_flatten_with_path(cache_1)[0]}
+
+        def scatter(path, leaf):
+            name = getattr(path[-1], "key", "")
+            if name not in rename:
+                return leaf
+            src = flat_1[self._path_key(path[:-1]) + (rename[name],)]
+            src = jnp.squeeze(src, axis=-4)        # drop the batch-1 dim
+            n_lead = leaf.ndim - 4
+            flat = leaf.reshape(leaf.shape[:n_lead] + (-1,)
+                                + leaf.shape[-2:])
+            idx = (slice(None),) * n_lead + (dest,)
+            flat = flat.at[idx].set(src.astype(flat.dtype), mode="drop")
+            return flat.reshape(leaf.shape)
+
+        return jax.tree_util.tree_map_with_path(scatter, cache)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _paged_insert(self, cache, cache_1, slot, table_row, start,
+                      true_len):
+        """Paged-mode ``_insert``: scatter the prefilled rows [start,
+        true_len) into the lane's blocks, install its block-table row,
+        and pin its index to the TRUE prompt length (rows below
+        ``start`` come from radix-shared blocks and are already
+        there)."""
+        cache = self._scatter_rows_tree(cache, cache_1, table_row,
+                                        start, true_len)
+
+        def pin(path, leaf):
+            name = getattr(path[-1], "key", "")
+            if name == "block_table":
+                return leaf.at[..., slot, :].set(table_row)
+            if name == "index":
+                return leaf.at[..., slot].set(true_len)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(pin, cache)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _paged_preload(self, cache, cache_1, table_row, start, end):
+        """Scatter a preloaded prefix's rows [start, end) into
+        radix-held blocks — no lane: tables and indices are untouched
+        (``start`` skips blocks the radix already caches — shared
+        blocks are never rewritten, the COW rule)."""
+        return self._scatter_rows_tree(cache, cache_1, table_row,
+                                       start, end)
+
+    @partial(jax.jit, static_argnums=(0, 3))
+    def _gather_prefix(self, cache, table_row, draft, matched):
+        """The inverse of ``_scatter_rows_tree``: read a lane's leading
+        ``matched`` rows out of the pool into a fresh batch-1 LINEAR
+        cache (index pinned to ``matched``), so the suffix prefill runs
+        the exact piece programs the linear engine's ``preload_prefix``
+        path runs — a prefix hit replaces recompute with this copy.
+        Rows past ``matched`` gather whatever the lane's owned blocks
+        hold — garbage the write-before-read prefill rule keeps
+        invisible, exactly like the linear cache's stale rows."""
+        bs = self.kv_block_size
+        pos = jnp.arange(self.cache_len)
+        rows = (table_row[jnp.clip(pos // bs, 0, self._kv_nblk_lane - 1)]
+                * bs + pos % bs)
+        rename = {"key_cache": "key_pool", "value_cache": "value_pool"}
+        pools = {self._path_key(p): leaf for p, leaf
+                 in jax.tree_util.tree_flatten_with_path(cache)[0]}
+        struct = self._cache_struct(1, draft=draft)
+
+        def build(path, s):
+            name = getattr(path[-1], "key", "")
+            if name == "index":
+                return jnp.full(s.shape, matched, s.dtype)
+            src = pools[self._path_key(path[:-1]) + (rename[name],)]
+            n_lead = src.ndim - 4
+            flat = src.reshape(src.shape[:n_lead] + (-1,)
+                               + src.shape[-2:])
+            take = jnp.take(flat, rows, axis=n_lead)
+            return jnp.expand_dims(take, axis=n_lead).astype(s.dtype)
+
+        return jax.tree_util.tree_map_with_path(build, struct)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _reset_lanes(self, cache, stale):
+        """Point ``stale`` lanes' block tables at the scratch block and
+        zero their indices: a retired/cancelled lane's blocks go back
+        to the pool at harvest, but the overlap scheduler has one more
+        garbage chunk for it in (or headed for) the device queue — this
+        runs BEFORE that chunk, so its writes land in scratch instead
+        of blocks someone else now owns."""
+        def rst(path, leaf):
+            name = getattr(path[-1], "key", "")
+            if name == "block_table":
+                return jnp.where(stale[:, None], 0, leaf)
+            if name == "index":
+                return jnp.where(stale, 0, leaf)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(rst, cache)
+
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
     def _decode_chunk(self, variables, cache, tok, seeds, counts):
         """``chunk`` decode steps for all slots; one device round-trip.
@@ -636,6 +890,17 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} new exceeds "
                 f"cache_len={self.cache_len}")
+        if self.paged:
+            # Admission is keyed on BLOCKS: a request whose worst-case
+            # block need exceeds the whole pool could never be granted
+            # a lane — reject now instead of deadlocking the queue.
+            need = -(-(len(prompt) + max_new_tokens)
+                     // self.kv_block_size)
+            if need > self._kv_pool.n_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks "
+                    f"(block_size={self.kv_block_size}) but the pool "
+                    f"has {self._kv_pool.n_blocks}")
         if not self._exact_prefill and self.prefill_chunk is None:
             # Catch at submit time: failing later inside run() would
             # drop this request silently and abort others mid-flight.
@@ -644,7 +909,12 @@ class ServingEngine:
             # is the feature's primary use (preload before submit: a
             # prefix loaded later cannot rescue an already-rejected
             # request).
-            work = len(prompt) - self._match_prefix(prompt)[0]
+            # Paged mode anchors the rule on operator-DECLARED preloads
+            # (radix entries evict under pressure; admission chunks a
+            # grown suffix, but validation must stay deterministic).
+            work = len(prompt) - (self._longest_declared_prefix(prompt)
+                                  if self.paged
+                                  else self._match_prefix(prompt)[0])
             if work > self.prompt_buckets[-1]:
                 raise ValueError(
                     f"prompt length {len(prompt)} (suffix {work} after "
@@ -687,12 +957,21 @@ class ServingEngine:
                 return True
         for slot, task in self._staging.items():
             if task.request_id == request_id:
+                if task.kv is not None:
+                    # Partial prefill lived in the batch-1 cache only;
+                    # the claim's blocks were never read — free them.
+                    self._kv_release(task.kv)
                 del self._staging[slot]
                 events.instant("engine/cancel", rid=request_id,
                                where="staged")
                 return True
         for slot, state in enumerate(self._slot_states):
             if state is not None and state.request_id == request_id:
+                if self.paged:
+                    # Prompt blocks stay radix-cached (inserted at
+                    # finalize); the generated tail is dropped with
+                    # the lane.
+                    self._lane_release(slot)
                 self._slot_states[slot] = None
                 events.instant("engine/cancel", rid=request_id,
                                where="slot")
@@ -716,16 +995,21 @@ class ServingEngine:
         """Requests accepted but not yet in a slot."""
         return len(self._queue)
 
-    def _fresh_cache(self, batch: int, draft: bool = False):
-        """Zeroed cache tree for ``batch`` rows (target or draft model).
-        The eval_shape trace runs ONCE per (model, batch) (memoized):
-        prefill asks for a fresh batch-1 cache per request (donation
-        consumes the buffers), and re-tracing the model per request
-        would put host latency in the serving loop."""
-        key = (draft, batch)
+    def _cache_struct(self, batch: int, draft: bool = False,
+                      grid: bool = False):
+        """Memoized eval_shape of a cache tree: ``grid`` selects the
+        slot-grid decode model (the paged pool + block tables when
+        paging is on), otherwise the batch-1 LINEAR prefill model.  One
+        trace per (draft, batch, grid) — re-tracing per request would
+        put host latency in the serving loop."""
+        key = (draft, batch, grid)
         shapes = self._cache_shapes.get(key)
         if shapes is None:
-            model = self._draft_model if draft else self._model
+            if grid:
+                model = self._draft_model if draft else self._model
+            else:
+                model = (self._draft_prefill_model if draft
+                         else self._prefill_model)
             variables = (self._draft_variables if draft
                          else self._variables)
 
@@ -737,7 +1021,16 @@ class ServingEngine:
 
             shapes = jax.eval_shape(shape_fn, variables)
             self._cache_shapes[key] = shapes
-        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        return shapes
+
+    def _fresh_cache(self, batch: int, draft: bool = False,
+                     grid: bool = False):
+        """Zeroed cache tree for ``batch`` rows (target or draft model;
+        ``grid``: the slot-grid decode layout vs the batch-1 linear
+        prefill layout).  Prefill asks for a fresh batch-1 cache per
+        request — donation consumes the buffers."""
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self._cache_struct(batch, draft, grid))
 
     def _pieces_for(self, m: int):
         """(piece_len, n_pieces) for prefilling an m-token span — THE
@@ -843,20 +1136,122 @@ class ServingEngine:
                     cache_1=self._fresh_cache(1, draft=True), draft=True)
                 d_cache_1 = jax.tree_util.tree_map_with_path(
                     pin, d_cache_1)
-        self._prefix_caches[tuple(tokens)] = (cache_1, d_cache_1)
+        # LRU bound: these entries hold device memory (a batch-1 cache
+        # pair each) and used to accumulate forever — evict the least
+        # recently MATCHED prefix past the limit.  ``_preloaded`` (the
+        # paged path's validation anchor) is bounded in lockstep so the
+        # host-side record cannot outgrow the limit either.
+        with self._prefix_lock:
+            self._prefix_caches[tuple(tokens)] = (cache_1, d_cache_1)
+            self._prefix_caches.move_to_end(tuple(tokens))
+            while len(self._prefix_caches) > self.prefix_cache_limit:
+                evicted_key, _ = self._prefix_caches.popitem(last=False)
+                self._preloaded.pop(evicted_key, None)
+            if self.paged:
+                self._preloaded[tuple(tokens)] = n
+        if self.paged:
+            # Paged mode ALSO seeds the radix index with the prefix's
+            # full blocks (scattered from the just-built cache — no
+            # second prefill), so later requests share them through the
+            # pool like any other radix hit; the stored batch-1 pair
+            # keeps covering the sub-block tail (a prefix shorter than
+            # one block has no shareable blocks at all).
+            with self._ctx():
+                self._seed_radix_from_cache(tokens, cache_1, d_cache_1)
 
-    def _match_prefix(self, prompt):
+    def _seed_radix_from_cache(self, tokens, cache_1, d_cache_1) -> None:
+        """Scatter a preloaded prefix's FULL blocks from its batch-1
+        cache into freshly allocated pool blocks and hand them to the
+        radix index (tree-held: shared by every later matching request,
+        LRU-evicted only under pressure)."""
+        n = len(tokens)
+        bs = self.kv_block_size
+        m = n // bs                       # full, shareable blocks
+        if m == 0:
+            return                        # sub-block prefix: pair-only
+        matched, shared = self._radix.match(tokens[:m * bs],
+                                            allow_full=True)
+        if matched >= m * bs:
+            return                        # every full block is cached
+        # Pin the already-cached leading blocks against the eviction
+        # our own allocation below may trigger.
+        for b in shared:
+            self._kv_pool.ref(b)
+        try:
+            n_new = m - len(shared)
+            fresh = self._kv_pool.alloc(n_new)
+            if fresh is None:
+                evicted = self._radix.evict_for(n_new)
+                if evicted:
+                    self.kv_stats["evictions"] += evicted
+                    events.instant("kv/evict", blocks=evicted)
+                fresh = self._kv_pool.alloc(n_new)
+            if fresh is None:
+                logger.warning(
+                    "preload_prefix: KV pool too busy to share the "
+                    "prefix's %d blocks (%d free); the batch-1 cache "
+                    "still serves it", n_new,
+                    self._kv_pool.free_blocks())
+                return
+            row = shared + fresh
+            table_np = np.zeros((self._kv_nblk_lane,), np.int32)
+            table_np[:len(row)] = row
+            table_j = jnp.asarray(table_np)
+            start, end = jnp.int32(matched), jnp.int32(m * bs)
+            if self._cache is None:
+                self._cache = self._fresh_cache(self.slots, grid=True)
+            self._cache = self._paged_preload(self._cache, cache_1,
+                                              table_j, start, end)
+            if self._draft_model is not None:
+                if self._d_cache is None:
+                    self._d_cache = self._fresh_cache(
+                        self.slots, draft=True, grid=True)
+                self._d_cache = self._paged_preload(
+                    self._d_cache, d_cache_1, table_j, start, end)
+            self._radix.insert(tokens[:m * bs], lambda j: row[j])
+            # The tree took one reference per NEW node; release the
+            # allocation's own (a node already present keeps its
+            # canonical block, so ours frees here).
+            for b in fresh:
+                self._kv_pool.deref(b)
+        finally:
+            for b in shared:
+                self._kv_pool.deref(b)
+
+    def _match_prefix(self, prompt, touch: bool = False):
         """Longest stored prefix the prompt strictly extends →
         (prefix_len, (target_cache, draft_cache_or_None));
-        (0, None) when none applies."""
-        if not self._prefix_caches:
-            return 0, None
-        best, best_pair = 0, None
-        for toks, pair in self._prefix_caches.items():
-            m = len(toks)
-            if best < m < len(prompt) and prompt[:m] == list(toks):
-                best, best_pair = m, pair
-        return best, best_pair
+        (0, None) when none applies.  ``touch`` refreshes the winner's
+        LRU recency — admission paths (the driver loop) pass True;
+        ``validate_request`` passes False.  Either way the walk holds
+        ``_prefix_lock``: handler threads validate concurrently with
+        the driver's LRU touches, and an OrderedDict mutated
+        mid-iteration raises in the READER."""
+        with self._prefix_lock:
+            if not self._prefix_caches:
+                return 0, None
+            best, best_key, best_pair = 0, None, None
+            for toks, pair in self._prefix_caches.items():
+                m = len(toks)
+                if best < m < len(prompt) and prompt[:m] == list(toks):
+                    best, best_key, best_pair = m, toks, pair
+            if touch and best_key is not None:
+                self._prefix_caches.move_to_end(best_key)
+            return best, best_pair
+
+    def _longest_declared_prefix(self, prompt) -> int:
+        """Longest PRELOADED prefix the prompt strictly extends — the
+        paged path's validation anchor.  Validation must not consult
+        the radix index (its entries evict under pressure, and
+        admission handles a shrunk match by chunking the longer
+        suffix); preloads are operator-declared, LRU-bounded like the
+        linear pairs they parallel."""
+        best = 0
+        with self._prefix_lock:
+            for toks, m in self._preloaded.items():
+                if best < m < len(prompt) and prompt[:m] == list(toks):
+                    best = m
+        return best
 
     def _note_moe_prefill_len(self, n: int) -> None:
         if not self._exact_prefill or n in self._moe_prefill_lens:
@@ -873,6 +1268,176 @@ class ServingEngine:
                 "%d (%d distinct lengths so far — one program each; "
                 "consider padding prompts to a few fixed lengths)",
                 n, len(self._moe_prefill_lens))
+
+    # -- paged-pool admission (block claims, prefix hits, eviction) --------
+
+    def _kv_claim(self, rid: int, prompt, max_new: int):
+        """Claim a lane's physical blocks: radix-match the prompt's
+        block-aligned prefix (shared blocks, one extra ref each), then
+        allocate the rest — evicting LRU retired radix entries under
+        pressure.  Returns a ``serving_kv.LaneKV`` or None when the
+        pool cannot supply the blocks (the request is REFUSED admission
+        and keeps its queue place — blocks free as lanes retire; never
+        a corrupted live lane)."""
+        bs = self.kv_block_size
+        need = -(-min(len(prompt) + max_new, self.cache_len) // bs)
+        # A block-starved queue head retries this claim every engine
+        # step: on retries, skip the flight-recorder span and the radix
+        # hit stats (one admission must not read as thousands), same
+        # per-request rule as the refusal counter below.
+        retry = rid == self._kv_refused_rid
+        matched, shared = ((0, []) if self._exact_prefill
+                           else self._radix.match(prompt,
+                                                  record=not retry))
+        # Ref the shared blocks BEFORE allocating: eviction only takes
+        # refcount-1 leaves, so the refs pin the matched path against
+        # the very eviction the allocation below may trigger.
+        for b in shared:
+            self._kv_pool.ref(b)
+        n_owned = need - len(shared)
+        with (contextlib.nullcontext() if retry
+              else events.span("kv/alloc", rid=rid, blocks=n_owned,
+                               shared=len(shared))):
+            owned = self._kv_pool.alloc(n_owned)
+            if owned is None:
+                evicted = self._radix.evict_for(n_owned)
+                if evicted:
+                    self.kv_stats["evictions"] += evicted
+                    events.instant("kv/evict", blocks=evicted)
+                owned = self._kv_pool.alloc(n_owned)
+        if owned is None:
+            for b in shared:
+                self._kv_pool.deref(b)
+            # Count one refusal PER REQUEST, not per retry: the queue
+            # head is re-claimed every serve_step while it waits, and a
+            # per-attempt count would report thousands of "refusals"
+            # for one waiting request.
+            if self._kv_refused_rid != rid:
+                self._kv_refused_rid = rid
+                self.kv_stats["alloc_refusals"] += 1
+                events.instant("kv/refused", rid=rid, blocks=n_owned)
+            return None
+        if matched:
+            self.kv_stats["prefix_hits"] += 1
+            self.kv_stats["prefix_hit_tokens"] += matched
+            events.instant("kv/prefix_hit", rid=rid, tokens=matched)
+        return serving_kv.LaneKV(request_id=rid, matched=matched,
+                                 shared=shared, owned=owned)
+
+    def _kv_release(self, kv) -> None:
+        """Drop the lane's references; blocks nobody else (radix or a
+        sharing lane) holds return to the free list."""
+        for b in kv.blocks():
+            self._kv_pool.deref(b)
+
+    def _kv_table(self, kv):
+        """The lane's device block-table row (scratch-padded)."""
+        return jnp.asarray(
+            np.asarray(kv.table(self._kv_nblk_lane), np.int32))
+
+    def _lane_claim(self, slot: int, kv, prompt) -> None:
+        """Install a lane's claim at insert time and feed the radix
+        index with the prompt's full blocks (their rows are valid —
+        prefill wrote [0, len(prompt)) before this), so LATER requests
+        with the same prefix share them immediately."""
+        self._lane_kv[slot] = kv
+        self._stale_slots.discard(slot)
+        if not self._exact_prefill:
+            table = kv.table(self._kv_nblk_lane)
+            self._radix.insert(prompt, lambda j: table[j])
+
+    def _lane_release(self, slot: int, tokens=None) -> None:
+        """Retire/cancel a lane's claim: optionally extend the radix
+        index with the request's generated full blocks (rows are valid
+        up to ``len(tokens) - 1`` — the final token was never fed back,
+        so its row may not exist), then drop the lane's refs and mark
+        the lane stale so the next dispatch points its table at
+        scratch before any in-flight garbage chunk can land in freed
+        blocks."""
+        kv = self._lane_kv[slot]
+        if kv is None:
+            return
+        if tokens is not None and not self._exact_prefill:
+            bs = self.kv_block_size
+            keep = tokens[:((len(tokens) - 1) // bs) * bs]
+            table = kv.table(self._kv_nblk_lane)
+            self._radix.insert(keep, lambda j: table[j])
+        self._kv_release(kv)
+        self._lane_kv[slot] = None
+        self._stale_slots.add(slot)
+
+    def _flush_stale_lanes(self) -> None:
+        """Zero retired/cancelled lanes' block-table rows before the
+        next decode program (their freed blocks may already belong to
+        someone else; the overlap garbage chunk must write scratch)."""
+        if not self.paged or not self._stale_slots:
+            return
+        if self._cache is None:
+            self._stale_slots.clear()
+            return
+        mask = np.zeros((self.slots,), bool)
+        for s in self._stale_slots:
+            mask[s] = True
+        jm = jnp.asarray(mask)
+        self._cache = self._reset_lanes(self._cache, jm)
+        if self._d_cache is not None:
+            self._d_cache = self._reset_lanes(self._d_cache, jm)
+        self._stale_slots.clear()
+
+    def _admission_match(self, kv, prompt):
+        """(pre_len, pre_pair) for a paged admission: the radix match
+        (kv.matched, gather path) unless a STORED preload pair covers
+        more — sub-block prefix tails only the batch-1 pair can
+        represent (a prefix shorter than a block has no shareable
+        blocks; a 20-token prefix at block 16 shares one block and
+        copies the 4-token tail).  Suffix prefill piece sizing follows
+        ``pre_len`` exactly as on the linear path."""
+        pre_len, pre_pair = kv.matched, None
+        if not self._exact_prefill:
+            lin_len, lin_pair = self._match_prefix(prompt, touch=True)
+            if lin_len > pre_len:
+                pre_len, pre_pair = lin_len, lin_pair
+        return pre_len, pre_pair
+
+    def _admission_cache_1(self, pre_pair, kv, table_j, draft: bool):
+        """The batch-1 cache a request's suffix prefill appends to:
+        fresh when nothing matched; the stored prefix cache's copy when
+        a preloaded pair won the match; a pool gather of the
+        radix-matched rows otherwise (copy instead of recompute — same
+        downstream piece programs every way)."""
+        if pre_pair is not None:
+            return jax.tree.map(jnp.copy, pre_pair[1 if draft else 0])
+        if not self.paged or kv is None or kv.matched == 0:
+            return self._fresh_cache(1, draft=draft)
+        cache = self._d_cache if draft else self._cache
+        if cache is None:          # defensive: matched blocks imply a
+            cache = self._fresh_cache(self.slots, draft=draft,
+                                      grid=True)
+            if draft:              # built grid, so keep it
+                self._d_cache = cache
+            else:
+                self._cache = cache
+        return self._gather_prefix(cache, table_j, draft,
+                                   jnp.int32(kv.matched))
+
+    def kv_blocks_total(self) -> int:
+        """Allocatable physical blocks in the paged pool (0 when the
+        linear cache is serving — the truthful scrape)."""
+        return self._kv_pool.n_blocks if self.paged else 0
+
+    def kv_blocks_in_use(self) -> int:
+        """Blocks currently referenced (live lanes + radix cache)."""
+        return self._kv_pool.blocks_in_use() if self.paged else 0
+
+    def kv_prefix_hit_tokens(self) -> int:
+        """Cumulative prompt tokens whose prefill was skipped via
+        radix prefix hits (the prefill-compute-saved counter)."""
+        return self.kv_stats["prefix_hit_tokens"]
+
+    def kv_evictions(self) -> int:
+        """Cumulative blocks LRU-evicted from the radix cache under
+        allocation pressure."""
+        return self.kv_stats["evictions"]
 
     def _fill_free_slots(self):
         """ATOMIC admission — the ``prefill_budget=0`` /
@@ -894,16 +1459,34 @@ class ServingEngine:
                     self._outputs[rid] = list(prompt)
                     continue
                 n = len(prompt)
-                # Prefix reuse: prefill only the suffix on a copy of
-                # the stored cache(s) (piece sizing follows the suffix).
-                pre_len, pre_pair = self._match_prefix(prompt)
+                kv = table_j = None
+                if self.paged:
+                    kv = self._kv_claim(rid, prompt, max_new)
+                    if kv is None:
+                        # No blocks: refuse admission, keep FIFO order
+                        # (the request takes its place back; blocks
+                        # free as lanes retire).
+                        self._queue.appendleft(
+                            (rid, prompt, max_new, seed))
+                        if prefilled and stalled:
+                            self.prefill_stats["stall_s"] += (
+                                time.perf_counter() - t0)
+                        return
+                    table_j = self._kv_table(kv)
+                    pre_len, pre_pair = self._admission_match(kv, prompt)
+                else:
+                    # Prefix reuse: prefill only the suffix on a copy
+                    # of the stored cache(s) (piece sizing follows the
+                    # suffix).
+                    pre_len, pre_pair = self._match_prefix(prompt,
+                                                           touch=True)
                 work = prompt[pre_len:]
                 self._note_moe_prefill_len(n)
                 prefilled = True
                 with self._ctx(), events.span(
                         "prefill/request", rid=rid, tokens=len(work)):
-                    cache_1 = (self._fresh_cache(1) if pre_pair is None
-                               else jax.tree.map(jnp.copy, pre_pair[0]))
+                    cache_1 = self._admission_cache_1(
+                        pre_pair, kv, table_j, draft=False)
                     cache_1, first = self._prefill_tokens(
                         work, seed=seed, cache_1=cache_1, draft=False)
                 first = int(first)
@@ -913,30 +1496,51 @@ class ServingEngine:
                 if (max_new == 1 or (self.eos_id is not None
                                      and first == self.eos_id)):
                     # Resolved at prefill — and checked BEFORE the draft
-                    # prefill, which such a request would waste.
+                    # prefill, which such a request would waste.  Its
+                    # blocks were never written: hand them straight
+                    # back.
+                    if kv is not None:
+                        self._kv_release(kv)
                     self._outputs[rid] = state.tokens
                     continue  # slot still free: try the next request
                 with self._ctx(), events.span("prefill/insert", rid=rid):
                     if self._draft_model is not None:
-                        d_cache_1 = (
-                            self._fresh_cache(1, draft=True)
-                            if pre_pair is None
-                            else jax.tree.map(jnp.copy, pre_pair[1]))
+                        d_cache_1 = self._admission_cache_1(
+                            pre_pair, kv, table_j, draft=True)
                         d_cache_1, _ = self._prefill_tokens(
                             work, seed=seed, cache_1=d_cache_1,
                             draft=True)
                     if self._cache is None:
-                        self._cache = self._fresh_cache(self.slots)
-                    self._cache = self._insert(
-                        self._cache, cache_1, jnp.int32(slot),
-                        jnp.int32(len(prompt)))
+                        self._cache = self._fresh_cache(self.slots,
+                                                        grid=True)
+                    if self.paged:
+                        # Scatter everything past the SHARED blocks
+                        # (kv.matched, not pre_len — a preload pair's
+                        # sub-block tail lives only in cache_1 and must
+                        # land in this lane's owned blocks).
+                        self._cache = self._paged_insert(
+                            self._cache, cache_1, jnp.int32(slot),
+                            table_j, jnp.int32(kv.matched),
+                            jnp.int32(n))
+                    else:
+                        self._cache = self._insert(
+                            self._cache, cache_1, jnp.int32(slot),
+                            jnp.int32(len(prompt)))
                     if self._draft_model is not None:
                         if self._d_cache is None:
                             self._d_cache = self._fresh_cache(
-                                self.slots, draft=True)
-                        self._d_cache = self._insert(
-                            self._d_cache, d_cache_1, jnp.int32(slot),
-                            jnp.int32(len(prompt)))
+                                self.slots, draft=True, grid=True)
+                        if self.paged:
+                            self._d_cache = self._paged_insert(
+                                self._d_cache, d_cache_1,
+                                jnp.int32(slot), table_j,
+                                jnp.int32(kv.matched), jnp.int32(n))
+                        else:
+                            self._d_cache = self._insert(
+                                self._d_cache, d_cache_1,
+                                jnp.int32(slot), jnp.int32(len(prompt)))
+                if kv is not None:
+                    self._lane_claim(slot, kv, prompt)
                 self._slot_states[slot] = state
                 # Overlap bookkeeping: the next dispatch must splice
                 # this slot's host-known token/count over the device
@@ -965,7 +1569,21 @@ class ServingEngine:
                 if max_new == 0:
                     self._outputs[rid] = list(prompt)
                     continue
-                pre_len, pre_pair = self._match_prefix(prompt)
+                kv = table_j = None
+                if self.paged:
+                    kv = self._kv_claim(rid, prompt, max_new)
+                    if kv is None:
+                        # No blocks: refuse the claim and stop staging
+                        # entirely (FIFO — nothing behind may jump the
+                        # head; blocks free as lanes retire).
+                        self._queue.appendleft(
+                            (rid, prompt, max_new, seed))
+                        return
+                    table_j = self._kv_table(kv)
+                    pre_len, pre_pair = self._admission_match(kv, prompt)
+                else:
+                    pre_len, pre_pair = self._match_prefix(prompt,
+                                                           touch=True)
                 work = prompt[pre_len:]
                 self._note_moe_prefill_len(len(prompt))
                 m = len(work)
@@ -976,7 +1594,7 @@ class ServingEngine:
                     request_id=rid, prompt=list(prompt),
                     max_new=max_new, seed=seed, work=work,
                     padded=padded, piece=piece, n_pieces=n_pieces,
-                    pre_pair=pre_pair)
+                    pre_pair=pre_pair, kv=kv, table=table_j)
                 self.prefill_stats["staged_requests"] += 1
                 break
 
@@ -989,16 +1607,30 @@ class ServingEngine:
                            tokens=list(task.prompt) + [first],
                            last_token=first, seed=task.seed, count=1)
         if self._cache is None:
-            self._cache = self._fresh_cache(self.slots)
-        self._cache = self._insert(self._cache, task.cache_1,
-                                   jnp.int32(slot),
-                                   jnp.int32(len(task.prompt)))
+            self._cache = self._fresh_cache(self.slots, grid=True)
+        n = len(task.prompt)
+        if self.paged:
+            self._cache = self._paged_insert(
+                self._cache, task.cache_1, jnp.int32(slot), task.table,
+                jnp.int32(task.kv.matched), jnp.int32(n))
+        else:
+            self._cache = self._insert(self._cache, task.cache_1,
+                                       jnp.int32(slot), jnp.int32(n))
         if self._draft_model is not None:
             if self._d_cache is None:
-                self._d_cache = self._fresh_cache(self.slots, draft=True)
-            self._d_cache = self._insert(self._d_cache, task.d_cache_1,
-                                         jnp.int32(slot),
-                                         jnp.int32(len(task.prompt)))
+                self._d_cache = self._fresh_cache(self.slots, draft=True,
+                                                 grid=True)
+            if self.paged:
+                self._d_cache = self._paged_insert(
+                    self._d_cache, task.d_cache_1, jnp.int32(slot),
+                    task.table, jnp.int32(task.kv.matched), jnp.int32(n))
+            else:
+                self._d_cache = self._insert(self._d_cache,
+                                             task.d_cache_1,
+                                             jnp.int32(slot),
+                                             jnp.int32(n))
+        if task.kv is not None:
+            self._lane_claim(slot, task.kv, task.prompt)
         # Staging is cleared BEFORE the slot state is set: the gateway's
         # metrics thread reads active_slots() (= decoding + staged)
         # concurrently, and this order keeps a torn read at or below
@@ -1023,9 +1655,8 @@ class ServingEngine:
                 n_pieces=task.n_pieces):
             if task.cursor < task.n_pieces:
                 if task.cache_1 is None:
-                    task.cache_1 = (
-                        self._fresh_cache(1) if task.pre_pair is None
-                        else jax.tree.map(jnp.copy, task.pre_pair[0]))
+                    task.cache_1 = self._admission_cache_1(
+                        task.pre_pair, task.kv, task.table, draft=False)
                 task.cache_1, task.first = self._run_target_piece(
                     task.cache_1, task.padded, task.piece, task.cursor,
                     len(task.work), task.seed)
@@ -1042,7 +1673,10 @@ class ServingEngine:
                                 and first == self.eos_id)):
                         # Resolved at prefill — before the draft
                         # prefill, which such a request would waste
-                        # (the atomic path's rule).
+                        # (the atomic path's rule).  Its blocks were
+                        # never written: hand them straight back.
+                        if task.kv is not None:
+                            self._kv_release(task.kv)
                         self._outputs[task.request_id] = (
                             list(task.prompt) + [first])
                         del self._staging[slot]
@@ -1051,10 +1685,8 @@ class ServingEngine:
                 return task.piece
             # Target done, request unresolved: draft pieces.
             if task.d_cache_1 is None:
-                task.d_cache_1 = (
-                    self._fresh_cache(1, draft=True)
-                    if task.pre_pair is None
-                    else jax.tree.map(jnp.copy, task.pre_pair[1]))
+                task.d_cache_1 = self._admission_cache_1(
+                    task.pre_pair, task.kv, task.table, draft=True)
             task.d_cache_1 = self._run_draft_piece(
                 task.d_cache_1, task.padded, task.piece, task.d_cursor)
             task.d_cursor += 1
@@ -1116,6 +1748,11 @@ class ServingEngine:
 
     def _retire_if_done(self, slot, state):
         if state.done:
+            if self.paged:
+                # Feed the radix index with the finished request's
+                # generated full blocks (a follow-up turn extending
+                # this conversation hits warm KV), then free the rest.
+                self._lane_release(slot, tokens=state.tokens)
             self._outputs[state.request_id] = state.tokens
             self._slot_states[slot] = None
             events.instant("slot/retire", rid=state.request_id,
@@ -1231,6 +1868,10 @@ class ServingEngine:
         with self._ctx(), events.span(
                 "decode/dispatch",
                 active=sum(r is not None for r in rids)):
+            # Retired/cancelled lanes' tables must point at scratch
+            # BEFORE this chunk: their freed blocks may already be
+            # reallocated, and this chunk decodes them as garbage.
+            self._flush_stale_lanes()
             tok, counts = self._carry_arrays()
             jseeds = jnp.asarray(seeds)
             if self._draft_model is not None:
@@ -1446,6 +2087,7 @@ class ServingEngine:
             if self._draft_model is not None:
                 with self._ctx(), events.span("decode/dispatch",
                                               active=n_active):
+                    self._flush_stale_lanes()
                     (self._cache, self._d_cache, emit, emitted,
                      next_tok, acc, _) = self._spec_round(
                         self._variables, self._draft_variables,
@@ -1462,6 +2104,7 @@ class ServingEngine:
             else:
                 with self._ctx(), events.span("decode/dispatch",
                                               active=n_active):
+                    self._flush_stale_lanes()
                     self._cache, toks, _, _ = self._decode_chunk(
                         self._variables, self._cache, jnp.asarray(tok),
                         jnp.asarray(seeds), jnp.asarray(counts))

@@ -1,0 +1,404 @@
+"""Paged KV cache: block pool, radix sharing, and engine parity.
+
+North star (the ISSUE 6 acceptance bar): with paging ON (the default),
+engine outputs are BITWISE-IDENTICAL to the linear-cache engine for
+greedy, seeded sampling, and speculative serving — including mid-stream
+cancel and staged-prefill interleave — and ``TTD_NO_PAGED_KV=1`` /
+``paged=False`` restores the linear engine byte-for-byte.  The host
+allocator (``serving_kv``) is pinned separately: radix
+insert/match/evict invariants, copy-on-write divergence after a shared
+prefix, and eviction-under-pressure REFUSING admission rather than
+corrupting a live lane.
+
+Fast tier: the host-only allocator/radix tests (no device work) plus
+one tiny paged-vs-linear engine parity run.  The full matrix (sampling,
+speculative, cancel, interleave, pressure) is slow-tier.
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu import serving_kv
+from tensorflow_train_distributed_tpu.models.llama import (
+    LLAMA_PRESETS,
+    LlamaModel,
+)
+from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+CFG = LLAMA_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaModel(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+# ── fast tier: host-only pool + radix invariants ───────────────────────
+
+
+def test_pool_alloc_ref_free_cycle():
+    pool = serving_kv.KVBlockPool(4, 8)
+    assert pool.free_blocks() == 4 and pool.blocks_in_use() == 0
+    a = pool.alloc(3)
+    assert sorted(a) == [1, 2, 3]          # block 0 is scratch: never
+    assert pool.alloc(2) is None           # all-or-nothing
+    pool.ref(a[0])
+    pool.deref(a[0])
+    assert pool.blocks_in_use() == 3       # still lane-held
+    for b in a:
+        pool.deref(b)
+    assert pool.free_blocks() == 4
+    with pytest.raises(ValueError, match="free block"):
+        pool.deref(a[0])
+
+
+def test_radix_insert_match_evict_invariants():
+    pool = serving_kv.KVBlockPool(8, 2)
+    idx = serving_kv.RadixPrefixIndex(pool)
+    toks = [1, 2, 3, 4, 5, 6]
+    blocks = pool.alloc(3)
+    idx.insert(toks, lambda j: blocks[j])
+    idx.check_invariants()
+    # Match is block-aligned and must leave >= 1 suffix token.
+    assert idx.match(toks + [9]) == (6, blocks)
+    assert idx.match(toks) == (4, blocks[:2])       # strict-extension cap
+    assert idx.match([1, 2, 9, 9, 9])[0] == 2
+    assert idx.match([9, 9, 9])[0] == 0
+    # Lane releases its refs: blocks become tree-held (cached).
+    for b in blocks:
+        pool.deref(b)
+    assert pool.blocks_in_use() == 3
+    # A matching lane re-refs the shared blocks; they are then pinned
+    # against eviction.
+    m, shared = idx.match(toks + [7])
+    for b in shared:
+        pool.ref(b)
+    assert idx.evict_for(8) < 8            # cannot evict pinned chain
+    idx.check_invariants()
+    for b in shared:
+        pool.deref(b)
+    # Fully retired: eviction drains the whole subtree, leaves first.
+    evicted = idx.evict_for(8)
+    assert evicted == 3 and pool.free_blocks() == 8 and len(idx) == 0
+    idx.check_invariants()
+
+
+def test_radix_lru_evicts_least_recent_leaf():
+    pool = serving_kv.KVBlockPool(4, 2)
+    idx = serving_kv.RadixPrefixIndex(pool)
+    a = pool.alloc(1)
+    idx.insert([1, 1], lambda j: a[j])
+    b = pool.alloc(1)
+    idx.insert([2, 2], lambda j: b[j])
+    for blk in a + b:
+        pool.deref(blk)
+    idx.match([1, 1, 9])                   # refresh [1, 1]'s recency
+    assert idx.evict_for(pool.free_blocks() + 1) == 1
+    assert idx.match([2, 2, 9])[0] == 0    # LRU victim was [2, 2]
+    assert idx.match([1, 1, 9])[0] == 2
+    idx.check_invariants()
+
+
+def test_radix_dedup_keeps_canonical_block():
+    pool = serving_kv.KVBlockPool(4, 2)
+    idx = serving_kv.RadixPrefixIndex(pool)
+    a = pool.alloc(1)
+    assert idx.insert([5, 6], lambda j: a[j]) == 1
+    dup = pool.alloc(1)
+    # Same chunk from a second lane: existing node stays canonical,
+    # nothing new is cached, the duplicate stays lane-owned only.
+    assert idx.insert([5, 6], lambda j: dup[j]) == 0
+    assert idx.match([5, 6, 7]) == (2, a)
+    pool.deref(dup[0])
+    assert pool.free_blocks() == 3         # dup freed, a still 2-held
+    idx.check_invariants()
+
+
+def test_lane_kv_table_padding():
+    kv = serving_kv.LaneKV(request_id=1, matched=4, shared=[3, 7],
+                           owned=[5])
+    assert kv.table(5) == [3, 7, 5, 0, 0]
+    assert kv.blocks() == [3, 7, 5]
+
+
+def _ref(params, prompt, max_new, **kw):
+    from tensorflow_train_distributed_tpu.models.generate import generate
+
+    return np.asarray(generate(
+        CFG, params, jnp.asarray([prompt], jnp.int32), max_new,
+        **kw))[0].tolist()
+
+
+def _serve(params, reqs, *, seeds=None, **kw):
+    eng = ServingEngine(CFG, params, **kw)
+    seeds = seeds or [None] * len(reqs)
+    ids = [eng.submit(p, m, seed=s) for (p, m), s in zip(reqs, seeds)]
+    out = eng.run()
+    return [out[i] for i in ids], eng
+
+
+def test_paged_engine_smoke_matches_generate(params):
+    """Fast-tier canary: tiny paged engine run, token-identical to
+    generate() and to the linear engine, with the pool drained back to
+    the radix cache afterwards."""
+    rng = np.random.default_rng(0)
+    reqs = [(list(rng.integers(1, 200, 5)), 4),
+            (list(rng.integers(1, 200, 3)), 5)]
+    out, eng = _serve(params, reqs, slots=2, cache_len=32, chunk=2,
+                      prompt_buckets=(8,), kv_block_size=4)
+    assert eng.paged
+    lin, _ = _serve(params, reqs, slots=2, cache_len=32, chunk=2,
+                    prompt_buckets=(8,), kv_block_size=4, paged=False)
+    for o, l, (p, m) in zip(out, lin, reqs):
+        assert o == l == _ref(params, p, m)
+    # Lanes released; what's in use is exactly the radix-cached blocks.
+    assert eng.kv_blocks_in_use() == eng._radix.cached_blocks()
+    eng._radix.check_invariants()
+
+
+# ── slow tier: the full parity matrix ──────────────────────────────────
+
+pytestmark_slow = pytest.mark.slow
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sampling", [
+    dict(),
+    dict(temperature=0.9, top_k=16),
+    dict(temperature=0.7, top_p=0.9),
+])
+def test_paged_matches_linear_with_refills(params, sampling):
+    """Six mixed requests through two slots (every lane refills):
+    bitwise identity paged vs linear for greedy and seeded sampling."""
+    rng = np.random.default_rng(1)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 6), (3, 9), (7, 4), (4, 12), (6, 1),
+                         (2, 0)]]
+    seeds = [11, 22, 33, 44, 55, 66]
+    kw = dict(slots=2, cache_len=64, chunk=4, prompt_buckets=(8, 16),
+              kv_block_size=4, **sampling)
+    out, _ = _serve(params, reqs, seeds=seeds, **kw)
+    lin, _ = _serve(params, reqs, seeds=seeds, paged=False, **kw)
+    assert out == lin
+
+
+@pytest.mark.slow
+def test_paged_matches_linear_speculative(params):
+    """Speculative serving (self-draft, full acceptance) and a
+    DISAGREEING draft: paged == linear bitwise, greedy and sampled."""
+    dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+    dparams = LlamaModel(dcfg).init(
+        jax.random.PRNGKey(9), jnp.zeros((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(2)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 8), (7, 6), (3, 9)]]
+    for draft_cfg, draft_params in ((CFG, params), (dcfg, dparams)):
+        for sampling in (dict(), dict(temperature=0.8, top_k=20)):
+            kw = dict(slots=2, cache_len=48, chunk=3,
+                      prompt_buckets=(8,), kv_block_size=4,
+                      draft_config=draft_cfg, draft_params=draft_params,
+                      speculative_k=3, **sampling)
+            out, eng = _serve(params, reqs, seeds=[1, 2, 3], **kw)
+            lin, _ = _serve(params, reqs, seeds=[1, 2, 3], paged=False,
+                            **kw)
+            assert out == lin
+            assert eng.spec_stats["rounds"] >= 1
+
+
+@pytest.mark.slow
+def test_paged_matches_linear_mid_stream_cancel(params):
+    """Cancel mid-decode and mid-staged-prefill: the surviving
+    requests' outputs stay bitwise-identical paged vs linear, and the
+    cancelled lanes' blocks return to the pool."""
+    rng = np.random.default_rng(3)
+    long_prompt = list(rng.integers(1, 200, 24))
+    short = [list(rng.integers(1, 200, 5)) for _ in range(3)]
+
+    def run(paged):
+        eng = ServingEngine(CFG, params, slots=2, cache_len=64, chunk=3,
+                            prompt_buckets=(8,), prefill_chunk=8,
+                            kv_block_size=4, paged=paged)
+        a = eng.submit(short[0], 10)
+        b = eng.submit(short[1], 10)
+        eng.serve_step()
+        c = eng.submit(long_prompt, 8)     # stages behind the decode
+        d = eng.submit(short[2], 6)
+        eng.serve_step()
+        assert eng.cancel(c)               # mid-staged-prefill
+        eng.serve_step()
+        assert eng.cancel(a)               # mid-decode
+        out = {}
+        while eng.pending():
+            out.update(eng.serve_step())
+        return out.get(b), out.get(d), eng
+
+    b_p, d_p, eng_p = run(True)
+    b_l, d_l, _ = run(False)
+    assert b_p == b_l and d_p == d_l
+    assert all(kv is None for kv in eng_p._lane_kv)
+    eng_p._radix.check_invariants()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sampling", [dict(),
+                                      dict(temperature=0.8, top_k=12)])
+def test_paged_matches_linear_staged_interleave(params, sampling):
+    """A long prompt admitted mid-stream under the interleaved prefill
+    scheduler (several budget installments): bitwise identity paged vs
+    linear for the long request AND the active lanes around it."""
+    rng = np.random.default_rng(4)
+    active = [(list(rng.integers(1, 200, 6)), 14) for _ in range(2)]
+    long_req = (list(rng.integers(1, 200, 30)), 6)
+
+    def run(paged):
+        eng = ServingEngine(CFG, params, slots=3, cache_len=64, chunk=3,
+                            prompt_buckets=(8,), prefill_chunk=8,
+                            kv_block_size=4, paged=paged, **sampling)
+        ids = [eng.submit(p, m, seed=7 + i)
+               for i, (p, m) in enumerate(active)]
+        eng.serve_step()
+        ids.append(eng.submit(*long_req, seed=99))
+        out = {}
+        while eng.pending():
+            out.update(eng.serve_step())
+        return [out[i] for i in ids]
+
+    assert run(True) == run(False)
+
+
+@pytest.mark.slow
+def test_copy_on_write_divergence_after_shared_prefix(params):
+    """Two requests share a block-aligned prefix then diverge: each
+    decodes its own continuation (bitwise = generate()), and the
+    SHARED physical blocks' bytes are untouched by either lane — the
+    allocation-time copy-on-write contract."""
+    rng = np.random.default_rng(5)
+    pre = list(rng.integers(1, 200, 8))     # 2 full blocks at bs=4
+    a = pre + list(rng.integers(1, 200, 3))
+    b = pre + list(rng.integers(1, 200, 3))
+    eng = ServingEngine(CFG, params, slots=2, cache_len=48, chunk=3,
+                        prompt_buckets=(16,), kv_block_size=4)
+    ia = eng.submit(a, 6)
+    out1 = eng.run()
+    # The first request seeded the radix; snapshot the shared blocks'
+    # bytes before the second (sharing) request runs.
+    matched, shared = eng._radix.match(b)
+    assert matched == 8 and len(shared) == 2
+
+    def pool_rows(blocks):
+        idx = jnp.asarray(blocks)
+        rows = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                eng._cache)[0]:
+            name = getattr(path[-1], "key", "")
+            if name in ("key_pool", "value_pool"):
+                rows[ServingEngine._path_key(path)] = np.asarray(
+                    jnp.take(leaf, idx, axis=leaf.ndim - 4))
+        return rows
+
+    before = pool_rows(shared)
+    ib = eng.submit(b, 6)
+    out2 = eng.run()
+    after = pool_rows(shared)
+    assert out1[ia] == _ref(params, a, 6)
+    assert out2[ib] == _ref(params, b, 6)
+    assert eng.kv_stats["prefix_hit_tokens"] >= 8
+    for k in before:
+        assert np.array_equal(before[k], after[k]), f"shared {k} written"
+
+
+@pytest.mark.slow
+def test_eviction_under_pressure_refuses_admission(params):
+    """A pool too small for two lanes: the second request is REFUSED
+    admission (queued, counted) until the first retires — outputs stay
+    exactly the linear engine's, and no live lane is ever corrupted.
+    Retired prefixes evict LRU to make room."""
+    rng = np.random.default_rng(6)
+    reqs = [(list(rng.integers(1, 200, 6)), 8) for _ in range(3)]
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32, chunk=3,
+                        prompt_buckets=(8,), kv_block_size=4,
+                        kv_pool_blocks=4)    # one lane's worth
+    ids = [eng.submit(p, m) for p, m in reqs]
+    out = eng.run()
+    lin, _ = _serve(params, reqs, slots=2, cache_len=32, chunk=3,
+                    prompt_buckets=(8,), paged=False)
+    assert [out[i] for i in ids] == lin
+    assert eng.kv_stats["alloc_refusals"] >= 1
+    assert eng.kv_stats["evictions"] >= 1
+    assert eng.kv_blocks_in_use() <= eng.kv_blocks_total()
+    eng._radix.check_invariants()
+    # A request that could NEVER fit is rejected at submit, not queued
+    # forever.
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng.submit(list(rng.integers(1, 200, 8)), 12)
+
+
+@pytest.mark.slow
+def test_kill_switch_restores_linear_engine(params, monkeypatch):
+    """TTD_NO_PAGED_KV=1 at construction: the engine IS the linear
+    engine (no pool, no radix, byte-for-byte the old behavior)."""
+    monkeypatch.setenv("TTD_NO_PAGED_KV", "1")
+    eng = ServingEngine(CFG, params, slots=2, cache_len=32, chunk=2,
+                        prompt_buckets=(8,))
+    assert not eng.paged
+    assert eng.kv_blocks_total() == 0 and eng.kv_blocks_in_use() == 0
+    rid = eng.submit([1, 2, 3], 4)
+    assert eng.run()[rid] == _ref(params, [1, 2, 3], 4)
+
+
+@pytest.mark.slow
+def test_linear_prefix_cache_is_lru_bounded(params):
+    """The linear path's ``_prefix_caches`` no longer leaks: preloads
+    past ``prefix_cache_limit`` evict the least recently matched."""
+    eng = ServingEngine(CFG, params, slots=1, cache_len=32, chunk=2,
+                        prompt_buckets=(8,), paged=False,
+                        prefix_cache_limit=2)
+    eng.preload_prefix([1, 1])
+    eng.preload_prefix([2, 2])
+    eng._match_prefix([1, 1, 9], touch=True)   # refresh [1, 1]
+    eng.preload_prefix([3, 3])                 # evicts [2, 2]
+    assert len(eng._prefix_caches) == 2
+    assert eng._match_prefix([2, 2, 9])[0] == 0
+    assert eng._match_prefix([1, 1, 9])[0] == 2
+    assert eng._match_prefix([3, 3, 9])[0] == 2
+
+
+@pytest.mark.slow
+def test_paged_rejects_nothing_linear_accepts(params):
+    """Engine-level guards carry over: the paged engine screens the
+    same configs the linear one does, plus its own block knobs."""
+    with pytest.raises(ValueError, match="kv_block_size"):
+        ServingEngine(CFG, params, slots=1, cache_len=16,
+                      prompt_buckets=(8,), kv_block_size=0)
+    with pytest.raises(ValueError, match="kv_pool_blocks"):
+        ServingEngine(CFG, params, slots=1, cache_len=16,
+                      prompt_buckets=(8,), kv_pool_blocks=0)
+    wcfg = dataclasses.replace(CFG, sliding_window=8)
+    with pytest.raises(ValueError, match="sliding_window"):
+        ServingEngine(wcfg, params)
+
+
+@pytest.mark.slow
+def test_paged_metrics_accessors_track_pool(params):
+    """kv_blocks_in_use/total + hit/eviction counters feed /metrics;
+    check they move with real traffic."""
+    rng = np.random.default_rng(8)
+    pre = list(rng.integers(1, 200, 8))
+    eng = ServingEngine(CFG, params, slots=2, cache_len=48, chunk=3,
+                        prompt_buckets=(16,), kv_block_size=4)
+    assert eng.kv_blocks_total() == 2 * (48 // 4)
+    r1 = eng.submit(pre + [5, 6], 4)
+    eng.run()
+    hits0 = eng.kv_prefix_hit_tokens()
+    r2 = eng.submit(pre + [7, 8, 9], 4)
+    out = eng.run()
+    assert out[r2][:len(pre)] == pre
+    assert eng.kv_prefix_hit_tokens() - hits0 >= 8
+    assert 0 < eng.kv_blocks_in_use() <= eng.kv_blocks_total()
+    assert r1 != r2

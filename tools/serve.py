@@ -124,6 +124,29 @@ def add_engine_args(p) -> None:
                         "no-redeploy equivalent. Outputs are "
                         "bitwise-identical either way — this is a "
                         "scheduling kill switch")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="paged KV cache: rows per physical block. "
+                        "Smaller blocks = finer prefix sharing and "
+                        "less tail waste per lane; larger blocks = "
+                        "shorter block tables and coarser gathers. "
+                        "Prefix sharing is block-granular, so shared "
+                        "system prompts win most when their length is "
+                        "a multiple of this")
+    p.add_argument("--kv-pool-blocks", type=int, default=None,
+                   help="paged KV cache: total physical blocks in the "
+                        "pool (default: slots * ceil(cache_len / "
+                        "block_size) — the linear cache's exact "
+                        "memory). Admission is keyed on free blocks: "
+                        "shrink to trade memory for queueing, grow to "
+                        "serve more/longer shared prefixes warm")
+    p.add_argument("--no-paged-kv", action="store_true",
+                   help="serve on the per-slot LINEAR KV cache instead "
+                        "of the paged block pool (no cross-request "
+                        "prefix sharing beyond --prefix); "
+                        "TTD_NO_PAGED_KV=1 is the no-redeploy "
+                        "equivalent. Outputs are bitwise-identical "
+                        "either way — this is a memory-layout kill "
+                        "switch")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
 
@@ -210,7 +233,10 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             overlap=not getattr(args, "no_overlap", False),
             prefill_chunk=getattr(args, "prefill_chunk", None),
             prefill_budget=(0 if getattr(args, "no_interleave", False)
-                            else getattr(args, "prefill_budget", None)))
+                            else getattr(args, "prefill_budget", None)),
+            paged=not getattr(args, "no_paged_kv", False),
+            kv_block_size=getattr(args, "kv_block_size", 16),
+            kv_pool_blocks=getattr(args, "kv_pool_blocks", None))
         if prefix_ids:
             eng.preload_prefix(prefix_ids)
     except ValueError as e:

@@ -13,9 +13,14 @@ and times out requests concurrently:
 - ``GET /healthz``  liveness + occupancy (503 while draining).
 - ``GET /metrics``  Prometheus text: request/token counters, queue
   depth, slot occupancy (decoding + prefilling lanes), TTFT /
-  inter-token / latency histograms, the engine's overlap ratio and
+  inter-token / latency histograms, the engine's overlap ratio,
   ``ttd_engine_prefill_stall_seconds`` (decode time lost to atomic
-  admission — ~0 with the default interleaved prefill scheduler).
+  admission — ~0 with the default interleaved prefill scheduler), and
+  the paged-KV cache economics: ``ttd_engine_kv_blocks_in_use`` /
+  ``ttd_engine_kv_blocks_total`` (admission is block-keyed by
+  default), ``ttd_engine_prefix_hit_tokens_total`` (prefill skipped
+  via cross-request prefix sharing) and
+  ``ttd_engine_kv_evictions_total``.
 
 Robustness: admission queue bounded at ``--max-queue`` (beyond it: 429
 with Retry-After), per-request deadlines (``--default-timeout`` /

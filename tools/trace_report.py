@@ -71,6 +71,32 @@ def instant_counts(evs: list) -> list:
     return counts.most_common()
 
 
+def kv_cache_summary(evs: list) -> dict:
+    """Paged-KV cache economics from the engine's flight-recorder
+    events: ``kv/alloc`` spans land in the stage table like any other
+    stage; this folds the instants' args into totals — prefix-hit
+    count + tokens saved (prefill compute skipped), blocks evicted
+    under pressure, and admissions refused for want of blocks.
+    Empty dict when the window has no paged-KV events (linear cache)."""
+    out = {"prefix_hits": 0, "prefix_hit_tokens": 0,
+           "evicted_blocks": 0, "refused_admissions": 0}
+    seen = False
+    for e in evs:
+        name = e.get("name", "")
+        if not name.startswith("kv/"):
+            continue
+        seen = True
+        args = e.get("args") or {}
+        if name == "kv/prefix_hit":
+            out["prefix_hits"] += 1
+            out["prefix_hit_tokens"] += args.get("tokens", 0)
+        elif name == "kv/evict":
+            out["evicted_blocks"] += args.get("blocks", 0)
+        elif name == "kv/refused":
+            out["refused_admissions"] += 1
+    return out if seen else {}
+
+
 def request_ids(evs: list) -> list:
     """(request_id, status) for every gateway request in the window
     (status from its retire instant; 'in-window' when none recorded)."""
@@ -180,6 +206,14 @@ def main(argv=None) -> int:
         print(f"\n{'count':>7}  instant")
         for name, n in inst:
             print(f"{n:7d}  {name}")
+
+    kv = kv_cache_summary(evs)
+    if kv:
+        print("\n== paged KV cache")
+        print(f"  prefix hits        {kv['prefix_hits']}"
+              f"  ({kv['prefix_hit_tokens']} prompt tokens skipped)")
+        print(f"  evicted blocks     {kv['evicted_blocks']}")
+        print(f"  refused admissions {kv['refused_admissions']}")
 
     if args.requests:
         ids = request_ids(evs)
