@@ -119,6 +119,26 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+# Per-thread default attrs (module-level: one store shared by every
+# recorder).  A replica's driver thread sets {"replica": k} once and
+# every engine/driver event it records carries the id — the
+# correlation key multi-replica forensics needs, with zero per-call
+# plumbing through the engine.
+_TLS = threading.local()
+
+
+def set_thread_attrs(**attrs) -> None:
+    """Replace THIS thread's default event attrs (merged under any
+    per-event attrs at record time; call with no kwargs to clear).
+    The pool's driver and pump threads tag themselves with
+    ``replica=k`` so engine-side events — which know nothing about
+    replicas — land on the right timeline."""
+    _TLS.attrs = dict(attrs) if attrs else None
+
+
+def get_thread_attrs() -> Optional[dict]:
+    return getattr(_TLS, "attrs", None)
+
 
 class Recorder:
     """Lock-cheap bounded ring buffer of trace events.
@@ -152,6 +172,10 @@ class Recorder:
 
     def _append(self, name: str, ph: str, t0: float, dur: float,
                 attrs: Optional[dict]) -> None:
+        base = getattr(_TLS, "attrs", None)
+        if base:
+            # Per-event attrs win over the thread defaults.
+            attrs = {**base, **(attrs or {})}
         ev = (name, ph, t0, dur, threading.get_ident(), attrs or None)
         with self._lock:
             self._buf.append(ev)
@@ -192,19 +216,31 @@ class Recorder:
         sorted by start time: driver events tagged ``request_id``
         (from the LATEST admission of that id — ids restart per driver,
         forensics wants the most recent life) joined with engine events
-        tagged with the ``rid`` its engine-submit recorded, scoped to
-        [engine-submit, retire] so a reused engine rid from another
-        session cannot bleed in."""
+        tagged with the ``rid`` each engine-submit recorded, scoped to
+        [engine-submit, next engine-submit or retire] so a reused
+        engine rid from another session cannot bleed in.  A replica
+        pool's request has ONE ``request/pool_admitted`` anchor (which
+        outranks the per-life ``request/admitted`` events — failover
+        re-admits the same id on a survivor, and the timeline must
+        show both lives plus the hop) and possibly several
+        engine-submit segments, each additionally keyed on its
+        ``replica`` attr so two replicas' identical engine rids never
+        cross-join."""
         evs = self.events()
-        admit_t = None
-        for e in evs:               # latest admission wins
+        admit_t = pool_t = None
+        for e in evs:               # latest (pool) admission wins
             a = e[5]
-            if (a is not None and a.get("request_id") == request_id
-                    and e[0] == "request/admitted"):
+            if a is None or a.get("request_id") != request_id:
+                continue
+            if e[0] == "request/pool_admitted":
+                pool_t = e[2]
+            elif e[0] == "request/admitted":
                 admit_t = e[2]
+        if pool_t is not None:
+            admit_t = pool_t
         out = []
-        rid = None
-        grant_t = retire_t = None
+        segs: list = []           # [rid, replica, grant_t, hi] per life
+        retire_t = None
         for e in evs:
             a = e[5]
             if (a is None or a.get("request_id") != request_id
@@ -212,21 +248,27 @@ class Recorder:
                 continue
             out.append(e)
             if e[0] == "request/engine_submit" and "rid" in a:
-                rid, grant_t = a["rid"], e[2]
+                if segs:        # previous life ends where this begins
+                    segs[-1][3] = min(segs[-1][3], e[2])
+                segs.append([a["rid"], a.get("replica"), e[2],
+                             float("inf")])
             if e[0] == "request/retire":
                 retire_t = e[2]
-        if rid is not None:
-            # lo is padded: the engine's own queued instant fires just
-            # BEFORE the driver records the engine-submit join anchor.
+        if segs and retire_t is not None and retire_t >= segs[-1][2]:
             # hi is exact: the driver's retire follows every engine
             # event of the request (the harvest trim guard keeps a
             # retired rid from ever being tagged again).
+            segs[-1][3] = min(segs[-1][3], retire_t)
+        for rid, replica, grant_t, hi in segs:
+            # lo is padded: the engine's own queued instant fires just
+            # BEFORE the driver records the engine-submit join anchor.
             lo = grant_t - 1e-3
-            hi = retire_t if retire_t is not None else float("inf")
             for e in evs:
                 a = e[5]
                 if (a is not None and "request_id" not in a
-                        and a.get("rid") == rid and lo <= e[2] <= hi):
+                        and a.get("rid") == rid and lo <= e[2] <= hi
+                        and (replica is None
+                             or a.get("replica") in (None, replica))):
                     out.append(e)
         out.sort(key=lambda e: e[2])
         return out

@@ -17,6 +17,26 @@ A **fault plan** is a ``;``-separated list of entries
     ckpt:save:partial:step=40   # corrupt the step-40 save specifically
     data:read:transient_io:p=0.01   # fail ~1% of record reads (seeded)
     data:read:transient_io:n=2      # fail the first 2 read ATTEMPTS
+    serve:dispatch:5:raise          # engine driver dies at dispatch 5
+    serve:dispatch:5:hang           # ... hangs mid-dispatch (watchdog)
+    serve:dispatch:5:kill9:replica=1    # replica 1 vanishes abruptly
+
+Serving-side entries (``serve:dispatch``) fire at the engine driver's
+Nth decode dispatch — the serving analog of the trainer's step
+boundary, so replica failover is chaos-testable the way training
+recovery is.  ``replica=K`` scopes an entry to one replica of a
+multi-replica gateway; entries without it fire on every driver, each
+driver with its own independent ``times`` budget.
+Actions mirror the process-level ones at replica granularity:
+``raise`` kills the driver loop with error propagation (pending
+requests learn immediately), ``hang`` wedges the dispatch
+(``hang_s=`` bounds the sleep; default 3600 — the watchdog's prey),
+and ``kill9`` makes an IN-PROCESS replica vanish abruptly: the driver
+thread exits without resolving a single handle or recording a corpse
+— nobody is notified, exactly what SIGKILL looks like to the pool's
+liveness monitor.  (A true ``os.kill`` would take every replica in
+the process down with it; subprocess replicas — the seam
+``server.replicas`` keeps open — will get the real signal.)
 
 Data-read faults count *attempts*, and the retry loop's attempts count
 too: ``n`` below ``filesource.IO_RETRY_ATTEMPTS`` (3) is absorbed by
@@ -36,8 +56,9 @@ guard on the module-level ``ARMED`` flag (one attribute read — no
 function call, no dict lookup) and only enter this module when a plan
 is live.  The armed sites are the trainer step boundary
 (``training.trainer``), ``CheckpointManager.save``
-(``training.checkpoint``) and the record-level reads of the file
-sources (``data.filesource`` / ``data.tfrecord``).
+(``training.checkpoint``), the record-level reads of the file
+sources (``data.filesource`` / ``data.tfrecord``), and the engine
+driver's dispatch boundary (``server.driver``).
 """
 
 from __future__ import annotations
@@ -46,6 +67,8 @@ import dataclasses
 import logging
 import os
 import signal
+import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -73,9 +96,17 @@ class InjectedTransientIO(OSError):
     kind (``data.filesource.read_with_retries`` absorbs it)."""
 
 
+class InjectedKill(BaseException):
+    """An in-process replica's ``kill9``: the engine driver loop must
+    exit WITHOUT resolving handles or recording a failure — SIGKILL
+    semantics at thread granularity (a BaseException so ordinary
+    ``except Exception`` recovery machinery cannot absorb it)."""
+
+
 _STEP_ACTIONS = ("raise", "kill9", "sigterm", "exit")
 _CKPT_ACTIONS = ("partial",)
 _DATA_ACTIONS = ("transient_io",)
+_SERVE_ACTIONS = ("raise", "hang", "kill9")
 
 
 @dataclasses.dataclass
@@ -85,6 +116,11 @@ class FaultEntry:
     trigger_step: Optional[int] = None   # step entries: fire at/after it
     params: dict = dataclasses.field(default_factory=dict)
     fired: int = 0
+    # serve:dispatch only — fire budget PER DRIVER (keyed by replica
+    # id, None standalone): an unscoped entry fires on EVERY replica's
+    # driver, `times` times each, instead of N drivers racing one
+    # shared budget.
+    fired_per: dict = dataclasses.field(default_factory=dict)
 
     @property
     def times(self) -> int:
@@ -182,6 +218,24 @@ def parse_plan(spec: str, *, seed: int = 0,
                     f"{action!r}; have {_CKPT_ACTIONS}")
             entries.append(FaultEntry("ckpt:save", action,
                                       params=_parse_params(rest)))
+        elif site == "serve":
+            if len(parts) < 4 or parts[1] != "dispatch":
+                raise ValueError(
+                    f"fault entry {raw!r}: want serve:dispatch:<N>:"
+                    f"<action>")
+            try:
+                trigger = int(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"fault entry {raw!r}: dispatch trigger {parts[2]!r} "
+                    "is not an integer") from None
+            action, rest = parts[3], parts[4:]
+            if action not in _SERVE_ACTIONS:
+                raise ValueError(
+                    f"fault entry {raw!r}: unknown serve action "
+                    f"{action!r}; have {_SERVE_ACTIONS}")
+            entries.append(FaultEntry("serve:dispatch", action, trigger,
+                                      _parse_params(rest)))
         elif site == "data":
             if len(parts) < 3 or parts[1] != "read":
                 raise ValueError(
@@ -199,7 +253,7 @@ def parse_plan(spec: str, *, seed: int = 0,
         else:
             raise ValueError(
                 f"fault entry {raw!r}: unknown site {site!r}; have "
-                "step | ckpt:save | data:read")
+                "step | ckpt:save | data:read | serve:dispatch")
     if not entries:
         raise ValueError(f"fault plan {spec!r} has no entries")
     return FaultPlan(entries, seed=seed, attempt=attempt)
@@ -312,6 +366,65 @@ def _make_partial(step_dir: str) -> None:
                     f.truncate(max(0, os.path.getsize(path) // 2))
             except OSError:
                 pass
+
+
+# Serve-site firing is the one injection point hit from N concurrent
+# driver threads: the budget check-and-bump must be atomic, and the
+# ACTION must run outside the lock (a hang holding it would stall every
+# other driver's fault check).
+_SERVE_LOCK = threading.Lock()
+
+
+def on_serve_dispatch(n: int, replica: Optional[int] = None) -> None:
+    """Engine-driver dispatch injection point (called by
+    ``server.driver`` before the Nth ``serve_step``; ``replica`` is the
+    driver's replica id in a pool, None standalone).  Triggers fire
+    at/after their dispatch ordinal (the step-boundary rule), with an
+    independent ``times`` budget PER DRIVER — an entry without
+    ``replica=`` fires on every replica; the first matching entry wins
+    a given dispatch."""
+    p = _PLAN
+    if p is None:
+        return
+    fire = None
+    with _SERVE_LOCK:
+        for entry in p.entries:
+            if entry.site != "serve:dispatch":
+                continue
+            if entry.attempt is not None and p.attempt != entry.attempt:
+                continue
+            want = entry.params.get("replica")
+            if want is not None and (replica is None
+                                     or int(want) != int(replica)):
+                continue
+            if n < entry.trigger_step:
+                continue
+            if entry.fired_per.get(replica, 0) >= entry.times:
+                continue
+            entry.fired_per[replica] = entry.fired_per.get(replica,
+                                                           0) + 1
+            entry.fired += 1
+            fire = entry
+            break
+    if fire is None:
+        return
+    if fire.action == "raise":
+        raise InjectedFault(
+            f"injected serve fault at dispatch {n}"
+            + (f" (replica {replica})" if replica is not None else ""))
+    if fire.action == "hang":
+        hang_s = float(fire.params.get("hang_s", 3600))
+        logger.warning(
+            "fault injection: hanging dispatch %d (replica %s) "
+            "for %gs", n, replica, hang_s)
+        time.sleep(hang_s)
+        return
+    if fire.action == "kill9":
+        logger.warning(
+            "fault injection: replica %s vanishes at dispatch %d",
+            replica, n)
+        raise InjectedKill(
+            f"injected kill9 at dispatch {n} (replica {replica})")
 
 
 def on_data_read(index: int) -> None:

@@ -4,12 +4,16 @@ compute layer of).
 
 - ``server.driver`` — the engine-owning background thread + thread-safe
   submission bridge (futures, bounded admission, deadlines, streaming);
+- ``server.replicas`` — N drivers behind one admission layer:
+  load/KV-affinity routing, per-replica health + hung-dispatch
+  watchdog, deterministic request failover, staged drain;
 - ``server.gateway`` — stdlib threaded HTTP frontend
   (``/v1/generate``, ``/healthz``, ``/metrics``) and drain lifecycle;
 - ``server.metrics`` — stdlib Prometheus text-format registry.
 
 Launcher: ``tools/serve_http.py``; load generator:
-``tools/bench_gateway.py``.
+``tools/bench_gateway.py``; chaos gate: ``tools/chaos_check.py
+--serving``.
 """
 
 from tensorflow_train_distributed_tpu.server.driver import (  # noqa: F401
@@ -26,4 +30,9 @@ from tensorflow_train_distributed_tpu.server.gateway import (  # noqa: F401
 from tensorflow_train_distributed_tpu.server.metrics import (  # noqa: F401
     GatewayMetrics,
     Registry,
+)
+from tensorflow_train_distributed_tpu.server.replicas import (  # noqa: F401
+    NoReplicas,
+    Replica,
+    ReplicaPool,
 )

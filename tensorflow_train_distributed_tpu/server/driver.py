@@ -37,6 +37,7 @@ normally.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import queue
 import threading
@@ -44,7 +45,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Optional
 
-from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime import events, faults
 
 logger = logging.getLogger(__name__)
 
@@ -88,13 +89,14 @@ class RequestHandle:
 
     def __init__(self, req_id: int, prompt: list, max_new: int,
                  seed: Optional[int], stream: bool,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], resume_from: int = 0):
         self.id = req_id
         self.prompt = prompt
         self.max_new = max_new
         self.seed = seed
         self.stream = stream
         self.deadline = deadline
+        self.resume_from = resume_from   # failover re-admission offset
         self.t_submit = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.last_commit_at: Optional[float] = None  # inter-token feed
@@ -163,7 +165,8 @@ class EngineDriver:
     def __init__(self, engine, *, max_queue: int = 64,
                  validate: Optional[Callable] = None,
                  metrics=None, default_timeout_s: Optional[float] = None,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 replica_id: Optional[int] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._engine = engine
@@ -179,8 +182,40 @@ class EngineDriver:
         self._next_id = 0
         self._draining = False
         self._failed: Optional[BaseException] = None
+        # Replica identity (None standalone): tagged onto this driver's
+        # flight-recorder events (the loop thread via thread attrs,
+        # caller-thread instants via _ev_attrs) and handed to the
+        # serve:dispatch fault site, so chaos plans can target one
+        # replica of a pool.
+        self._replica_id = replica_id
+        self._ev_attrs = ({} if replica_id is None
+                          else {"replica": replica_id})
+        # Hung-dispatch watchdog feed: monotonic start of the
+        # serve_step in progress (None between steps).  Plain attribute
+        # writes — atomic, read-only consumers.
+        self._step_t0: Optional[float] = None
+        self._dispatch_n = 0               # serve_step ordinal (faults)
+        self._steps_done = 0               # completed serve_steps
+        self._vanished = False             # kill9 fault: died unnotified
+        # Does the engine speak resume-from-token admission?  Detected
+        # once by signature: engines without it (test stubs, external
+        # implementations) still serve failed-over requests — the
+        # resumed tokens ride in the prompt either way — they just
+        # cannot offset a sampling rng stream (greedy/deterministic
+        # decode is unaffected).
+        try:
+            self._engine_resumes = (
+                "resume_from" in inspect.signature(
+                    engine.validate_request).parameters
+                and "resume_from" in inspect.signature(
+                    engine.submit).parameters)
+        except (TypeError, ValueError):     # builtins / odd callables
+            self._engine_resumes = False
         self._thread = threading.Thread(
-            target=self._loop, name="engine-driver", daemon=True)
+            target=self._loop,
+            name=("engine-driver" if replica_id is None
+                  else f"engine-driver-{replica_id}"),
+            daemon=True)
 
     # -- public api ------------------------------------------------------
 
@@ -213,19 +248,69 @@ class EngineDriver:
         with self._cv:
             return self._failed
 
+    def vanished(self) -> bool:
+        """True when the loop exited ABRUPTLY without notifying anyone
+        (the in-process kill9 fault): no corpse in ``failure()``, no
+        handles resolved — detectable only by liveness, exactly like a
+        SIGKILLed subprocess replica."""
+        return self._vanished
+
+    def step_elapsed(self) -> float:
+        """Seconds the serve_step in progress has been running (0.0
+        between steps) — the hung-dispatch watchdog's feed: a healthy
+        chunk completes in milliseconds-to-seconds, so an elapsed time
+        past the watchdog deadline means the dispatch is wedged on the
+        device (or a hang fault) and the replica must be declared dead
+        even though its thread is technically alive."""
+        t0 = self._step_t0
+        return 0.0 if t0 is None else max(0.0, time.monotonic() - t0)
+
+    def steps_completed(self) -> int:
+        """Completed serve_steps — the watchdog's arming condition: a
+        driver's FIRST dispatch includes XLA compilation (potentially
+        minutes on a cold TPU), so the hung-dispatch deadline only
+        applies once at least one step has proven the programs
+        compiled.  (A dispatch that truly hangs before any completes
+        still surfaces: requests there never commit, callers time out,
+        and operators see step_elapsed() growing.)"""
+        return self._steps_done
+
+    def replica_id(self) -> Optional[int]:
+        return self._replica_id
+
     def active_slots(self) -> int:
         return self._engine.active_slots()
 
     def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
                stream: bool = False,
-               timeout_s: Optional[float] = None) -> RequestHandle:
+               timeout_s: Optional[float] = None,
+               request_id: Optional[int] = None,
+               resume_from: int = 0,
+               requeue: bool = False) -> RequestHandle:
         """Admit one request; raises ``RequestError`` (bad payload),
         ``AdmissionFull`` (shed), or ``Draining``.  Safe from any
-        thread: only read-only engine calls happen here."""
+        thread: only read-only engine calls happen here.
+
+        Pool plumbing (standalone callers never pass these):
+        ``request_id`` uses the caller's id instead of minting one (the
+        replica pool mints pool-unique ids so a failed-over request
+        keeps its identity across replicas); ``resume_from=g`` marks
+        the prompt's last ``g`` tokens as the request's own earlier
+        output (threaded to the engine's resume-from-token admission);
+        ``requeue`` bypasses the draining refusal and the queue bound —
+        a failover re-admission was already admitted once, and dropping
+        it now would break the no-token-lost contract."""
         if self._validate is not None:
             self._validate(prompt, max_new, seed)
         try:
-            prompt = self._engine.validate_request(prompt, max_new, seed)
+            # resume_from only reaches engines that speak it (test
+            # stubs and older engines keep their 3-arg surface).
+            if resume_from and self._engine_resumes:
+                prompt = self._engine.validate_request(
+                    prompt, max_new, seed, resume_from)
+            else:
+                prompt = self._engine.validate_request(prompt, max_new,
+                                                       seed)
         except ValueError as e:
             raise RequestError(str(e))
         if timeout_s is None:
@@ -238,13 +323,17 @@ class EngineDriver:
             if self._failed is not None:
                 raise RuntimeError(
                     f"engine driver failed: {self._failed!r}")
-            if self._draining:
-                raise Draining("gateway is draining; not admitting")
-            if self.waiting() >= self._max_queue:
-                raise AdmissionFull(self.waiting(), self._retry_after_s)
-            handle = RequestHandle(self._next_id, prompt, max_new, seed,
-                                   stream, deadline)
-            self._next_id += 1
+            if not requeue:
+                if self._draining:
+                    raise Draining("gateway is draining; not admitting")
+                if self.waiting() >= self._max_queue:
+                    raise AdmissionFull(self.waiting(),
+                                        self._retry_after_s)
+            if request_id is None:
+                request_id = self._next_id
+                self._next_id += 1
+            handle = RequestHandle(request_id, prompt, max_new, seed,
+                                   stream, deadline, resume_from)
             # The request_id minted above tags every later lifecycle
             # event — the flight-recorder key /v1/requests/<id>
             # resolves.  Recorded BEFORE the notify releases the driver
@@ -253,7 +342,8 @@ class EngineDriver:
             # admission would fall outside the window.
             events.instant("request/admitted", request_id=handle.id,
                            prompt_len=len(prompt), max_new=max_new,
-                           stream=stream)
+                           stream=stream, resumed=resume_from,
+                           **self._ev_attrs)
             self._admit.append(handle)
             self._cv.notify()
         return handle
@@ -308,6 +398,11 @@ class EngineDriver:
     # -- driver loop -----------------------------------------------------
 
     def _loop(self) -> None:
+        if self._replica_id is not None:
+            # Every event this thread records — driver lifecycle AND
+            # engine internals (prefill/decode/kv spans) — carries the
+            # replica id without per-call plumbing.
+            events.set_thread_attrs(replica=self._replica_id)
         try:
             while True:
                 with self._cv:
@@ -320,8 +415,31 @@ class EngineDriver:
                     self._admit_pending()
                     if not self._inflight:
                         continue      # everything expired at admission
+                self._dispatch_n += 1
+                # The watchdog window opens for the whole engine step
+                # (dispatch + device wait): _step_t0 is cleared only
+                # when serve_step returns, so a wedged chunk shows an
+                # ever-growing step_elapsed().  The fault hook sits
+                # INSIDE the window — an injected hang must look
+                # exactly like a wedged device.
+                self._step_t0 = time.monotonic()
+                if faults.ARMED:
+                    faults.on_serve_dispatch(self._dispatch_n,
+                                             replica=self._replica_id)
                 done = self._engine.serve_step()
+                self._step_t0 = None
+                self._steps_done += 1
                 self._harvest(done)
+        except faults.InjectedKill:
+            # kill -9 semantics for an in-process replica: vanish.  No
+            # handle resolution, no _failed corpse, no retire events —
+            # pending requests learn nothing (their pool pump's
+            # liveness watch is the only detector), exactly like a
+            # SIGKILLed subprocess.
+            self._vanished = True
+            logger.warning("engine driver %s vanished (injected kill9)",
+                           self._replica_id)
+            return
         except BaseException as e:      # noqa: BLE001 — fail loudly
             logger.exception("engine driver loop died")
             with self._cv:
@@ -348,8 +466,16 @@ class EngineDriver:
                 self._expire(handle)
                 continue
             try:
-                rid = self._engine.submit(handle.prompt, handle.max_new,
-                                          seed=handle.seed)
+                # resume_from is only passed when resuming: test stubs
+                # and pre-resume engines keep their 3-arg submit.
+                if handle.resume_from and self._engine_resumes:
+                    rid = self._engine.submit(
+                        handle.prompt, handle.max_new, seed=handle.seed,
+                        resume_from=handle.resume_from)
+                else:
+                    rid = self._engine.submit(handle.prompt,
+                                              handle.max_new,
+                                              seed=handle.seed)
             except ValueError as e:
                 # validate_request screened already; a late preload
                 # could still shift the bucket rule — report, don't die.

@@ -16,6 +16,10 @@ Endpoints:
   ``{"done": true}`` (or ``{"error", "status"}`` terminally).
 - ``GET /healthz`` — ``{"status": "ok"|"draining", ...occupancy}``;
   503 while draining (load balancers stop routing before shutdown).
+  Multi-replica gateways report per-replica state
+  (``alive|draining|dead``, occupancy, free KV blocks) and answer 503
+  only when NO replica can accept work — one dead replica of several
+  is ``degraded`` at 200.
 - ``GET /metrics`` — Prometheus text (``server.metrics`` names).
 - ``GET /debug/trace?last_s=N`` — the flight recorder's recent window
   as Chrome trace-event JSON (``runtime.events``; load in Perfetto or
@@ -35,6 +39,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import socketserver
 import threading
@@ -51,11 +56,38 @@ from tensorflow_train_distributed_tpu.server.driver import (
     RequestError,
 )
 from tensorflow_train_distributed_tpu.server.metrics import GatewayMetrics
+from tensorflow_train_distributed_tpu.server.replicas import (
+    NoReplicas,
+    ReplicaPool,
+)
 
 logger = logging.getLogger(__name__)
 
 MAX_BODY_BYTES = 1 << 20          # requests are token-id lists; 1 MiB
 #                                   bounds hostile/bogus payloads
+
+
+def _failover_killed() -> bool:
+    """``TTD_NO_FAILOVER=1`` restores the single-engine gateway
+    byte-for-byte (only the FIRST engine of a multi-engine list is
+    used) — the same no-redeploy kill-switch contract as
+    ``TTD_NO_OVERLAP`` and friends."""
+    return os.environ.get("TTD_NO_FAILOVER", "0") not in ("", "0")
+
+
+def _agg(engines, name, ratio: bool = False):
+    """One scrape callable over N engines' per-engine stat (None when
+    no engine has it — the stub-engine contract): sums, or the mean
+    for ratio-shaped stats."""
+    fns = [f for f in (getattr(e, name, None) for e in engines)
+           if f is not None]
+    if not fns:
+        return None
+    if len(fns) == 1:
+        return fns[0]
+    if ratio:
+        return lambda: sum(f() for f in fns) / len(fns)
+    return lambda: sum(f() for f in fns)
 
 
 class _GatewayHTTPServer(ThreadingHTTPServer):
@@ -100,32 +132,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):                           # noqa: N802
         path, _, query = self.path.partition("?")
         if path == "/healthz":
-            gw = self.gateway
-            draining = gw.draining
-            # Driver death outranks everything but an orderly drain
-            # (drain stops the loop too — that is not a failure): a
-            # dead engine loop means every accepted request 500s, so
-            # the health check must pull this instance out of rotation
-            # even though the listener socket still answers.
-            dead = not draining and not gw.driver.alive()
-            status = ("draining" if draining
-                      else "driver_dead" if dead else "ok")
-            body = {
-                "status": status,
-                "queue_depth": gw.driver.waiting(),
-                "slots_in_use": gw.driver.active_slots(),
-                "slots_total": gw.engine.slots,
-            }
-            # Paged-KV engines: admission is keyed on free blocks, so
-            # the block occupancy IS the capacity signal load
-            # balancers should watch (absent for linear-cache engines
-            # and stubs).
-            total_fn = getattr(gw.engine, "kv_blocks_total", None)
-            total = total_fn() if total_fn is not None else 0
-            if total:
-                body["kv_blocks_total"] = total
-                body["kv_blocks_in_use"] = gw.engine.kv_blocks_in_use()
-            self._reply_json(200 if status == "ok" else 503, body)
+            self._healthz()
         elif path == "/metrics":
             body = self.gateway.metrics.render().encode()
             self.send_response(200)
@@ -140,6 +147,60 @@ class _Handler(BaseHTTPRequestHandler):
             self._request_timeline(path[len("/v1/requests/"):])
         else:
             self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def _healthz(self) -> None:
+        gw = self.gateway
+        draining = gw.draining
+        if gw.pool is not None:
+            # Pool health: overall status is 503 ONLY when no replica
+            # can accept work (all dead, or an orderly drain) — one
+            # dead replica of several degrades capacity, it does not
+            # pull the instance out of rotation.
+            reps = gw.pool.replica_states()
+            alive = gw.pool.alive_count()
+            if draining:
+                status = "draining"
+            elif alive == 0:
+                status = "no_replicas"
+            elif alive < len(reps):
+                status = "degraded"
+            else:
+                status = "ok"
+            body = {
+                "status": status,
+                "replicas_alive": alive,
+                "replicas": reps,
+                "queue_depth": gw.driver.waiting(),
+                "slots_in_use": gw.driver.active_slots(),
+                "slots_total": sum(r["slots_total"] for r in reps),
+            }
+            self._reply_json(
+                200 if status in ("ok", "degraded") else 503, body)
+            return
+        # Driver death outranks everything but an orderly drain
+        # (drain stops the loop too — that is not a failure): a
+        # dead engine loop means every accepted request 500s, so
+        # the health check must pull this instance out of rotation
+        # even though the listener socket still answers.
+        dead = not draining and not gw.driver.alive()
+        status = ("draining" if draining
+                  else "driver_dead" if dead else "ok")
+        body = {
+            "status": status,
+            "queue_depth": gw.driver.waiting(),
+            "slots_in_use": gw.driver.active_slots(),
+            "slots_total": gw.engine.slots,
+        }
+        # Paged-KV engines: admission is keyed on free blocks, so
+        # the block occupancy IS the capacity signal load
+        # balancers should watch (absent for linear-cache engines
+        # and stubs).
+        total_fn = getattr(gw.engine, "kv_blocks_total", None)
+        total = total_fn() if total_fn is not None else 0
+        if total:
+            body["kv_blocks_total"] = total
+            body["kv_blocks_in_use"] = gw.engine.kv_blocks_in_use()
+        self._reply_json(200 if status == "ok" else 503, body)
 
     def _debug_trace(self, query: str) -> None:
         """The recent flight-recorder window, Chrome-trace JSON."""
@@ -217,6 +278,16 @@ class _Handler(BaseHTTPRequestHandler):
                          f"{max(1, round(e.retry_after_s))}"})
             return
         except Draining as e:
+            self._reply_json(503, {"error": str(e)},
+                             headers={"Retry-After": "5"})
+            return
+        except NoReplicas as e:
+            # Every replica is dead: unlike a single driver's terminal
+            # 500, this is a service-unavailable condition an operator
+            # can clear (restart replicas) — 503 + Retry-After so
+            # clients and load balancers back off instead of giving
+            # the request up for lost.
+            self.gateway.metrics.requests.inc(label_value="shed")
             self._reply_json(503, {"error": str(e)},
                              headers={"Retry-After": "5"})
             return
@@ -313,7 +384,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ServingGateway:
-    """Engine + driver + HTTP listener, one lifecycle.
+    """Engine(s) + driver/pool + HTTP listener, one lifecycle.
+
+    ``engine`` is one engine (the classic single-driver gateway) or a
+    list of engine replicas: with two or more, admissions route
+    through a ``ReplicaPool`` — per-replica health + hung-dispatch
+    watchdog (``watchdog_timeout_s``), load/KV-affinity routing,
+    deterministic request failover, staged per-replica drain — while
+    the HTTP surface stays identical.  ``TTD_NO_FAILOVER=1`` (or a
+    single-engine list) restores the single-driver path byte-for-byte,
+    driving only the first engine.
 
     ``validate`` is threaded through to the driver (the CLI's
     ``check_vocab_ids`` hook); ``port=0`` binds an ephemeral port
@@ -324,32 +404,46 @@ class ServingGateway:
                  port: int = 8000, max_queue: int = 64,
                  default_timeout_s: Optional[float] = None,
                  default_max_new: int = 32, validate=None,
-                 retry_after_s: float = 1.0):
-        self.engine = engine
+                 retry_after_s: float = 1.0,
+                 watchdog_timeout_s: Optional[float] = 30.0):
+        engines = (list(engine) if isinstance(engine, (list, tuple))
+                   else [engine])
+        if not engines:
+            raise ValueError("need at least one engine")
+        self.engine = engines[0]
+        self.engines = engines
         self.default_max_new = default_max_new
-        self.driver = EngineDriver(
-            engine, max_queue=max_queue, validate=validate,
-            default_timeout_s=default_timeout_s,
-            retry_after_s=retry_after_s)
+        self.pool: Optional[ReplicaPool] = None
+        if len(engines) > 1 and not _failover_killed():
+            self.pool = ReplicaPool(
+                engines, max_queue=max_queue, validate=validate,
+                default_timeout_s=default_timeout_s,
+                retry_after_s=retry_after_s,
+                watchdog_timeout_s=watchdog_timeout_s)
+            self.driver = self.pool
+        else:
+            self.driver = EngineDriver(
+                engines[0], max_queue=max_queue, validate=validate,
+                default_timeout_s=default_timeout_s,
+                retry_after_s=retry_after_s)
+        active = engines if self.pool is not None else engines[:1]
         self.metrics = GatewayMetrics(
             queue_depth_fn=self.driver.waiting,
             slots_in_use_fn=self.driver.active_slots,
-            slots_total=engine.slots,
+            slots_total=sum(e.slots for e in active),
             driver_alive_fn=self.driver.alive,
-            # getattr: test stubs (and any engine without the decode
-            # lookahead / prefill scheduler) scrape a truthful
-            # constant 0.
-            overlap_ratio_fn=getattr(engine, "overlap_ratio", None),
-            prefill_stall_fn=getattr(engine, "prefill_stall_s", None),
-            # Paged-KV gauges/counters (scrape 0 for linear-cache
-            # engines and stubs — the same getattr contract).
-            kv_blocks_in_use_fn=getattr(engine, "kv_blocks_in_use",
-                                        None),
-            kv_blocks_total_fn=getattr(engine, "kv_blocks_total", None),
-            kv_prefix_hit_tokens_fn=getattr(engine,
-                                            "kv_prefix_hit_tokens",
-                                            None),
-            kv_evictions_fn=getattr(engine, "kv_evictions", None))
+            replicas_alive_fn=(None if self.pool is None
+                               else self.pool.alive_count),
+            # _agg/getattr: test stubs (and any engine without the
+            # decode lookahead / prefill scheduler / paged KV) scrape
+            # a truthful constant 0; a pool scrapes the sum (mean for
+            # the overlap ratio).
+            overlap_ratio_fn=_agg(active, "overlap_ratio", ratio=True),
+            prefill_stall_fn=_agg(active, "prefill_stall_s"),
+            kv_blocks_in_use_fn=_agg(active, "kv_blocks_in_use"),
+            kv_blocks_total_fn=_agg(active, "kv_blocks_total"),
+            kv_prefix_hit_tokens_fn=_agg(active, "kv_prefix_hit_tokens"),
+            kv_evictions_fn=_agg(active, "kv_evictions"))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]
@@ -391,13 +485,17 @@ class ServingGateway:
         return drained
 
     def install_signal_handlers(self, signals=(signal.SIGTERM,
-                                               signal.SIGINT)) -> None:
+                                               signal.SIGINT),
+                                drain_timeout: Optional[float] = None
+                                ) -> None:
         """SIGTERM/SIGINT → drain (from a helper thread: handlers must
-        return fast, and drain() waits on in-flight decode)."""
+        return fast, and drain() waits on in-flight decode — replicas
+        drain one at a time under a pool, so capacity degrades
+        gradually instead of all at once)."""
         def _on_signal(signum, frame):
             logger.info("signal %d: draining", signum)
-            threading.Thread(target=self.drain, name="gateway-drain",
-                             daemon=True).start()
+            threading.Thread(target=self.drain, args=(drain_timeout,),
+                             name="gateway-drain", daemon=True).start()
 
         for s in signals:
             signal.signal(s, _on_signal)

@@ -223,6 +223,7 @@ class GatewayMetrics:
     def __init__(self, queue_depth_fn: Callable[[], int],
                  slots_in_use_fn: Callable[[], int], slots_total: int,
                  driver_alive_fn: Optional[Callable[[], bool]] = None,
+                 replicas_alive_fn: Optional[Callable[[], int]] = None,
                  overlap_ratio_fn: Optional[Callable[[], float]] = None,
                  prefill_stall_fn: Optional[Callable[[], float]] = None,
                  kv_blocks_in_use_fn: Optional[Callable[[], int]] = None,
@@ -258,6 +259,28 @@ class GatewayMetrics:
                 else (lambda: 1.0 if driver_alive_fn() else 0.0)))
         if driver_alive_fn is None:
             self.driver_alive.set(1.0)
+        # Multi-replica serving: how many engine replicas can take work
+        # (a single-engine gateway truthfully scrapes its driver's
+        # aliveness — 1 or 0), and the pool's robustness counters: how
+        # often a dying replica's requests were re-admitted on a
+        # survivor, and how often a transient placement refusal was
+        # retried with backoff instead of shed.
+        self.replicas_alive = r.gauge(
+            "ttd_gateway_replicas_alive",
+            "Engine replicas currently able to accept work.",
+            fn=(replicas_alive_fn if replicas_alive_fn is not None
+                else (None if driver_alive_fn is None
+                      else (lambda: 1 if driver_alive_fn() else 0))))
+        if replicas_alive_fn is None and driver_alive_fn is None:
+            self.replicas_alive.set(1)
+        self.failovers = r.counter(
+            "ttd_gateway_failovers_total",
+            "Requests re-admitted on a survivor replica after their "
+            "replica died mid-flight.")
+        self.retries = r.counter(
+            "ttd_gateway_retries_total",
+            "Placement retries after transient admission refusals "
+            "(pool pressure backoff, not client-visible sheds).")
         # Fraction of the engine's host harvest/refill time hidden
         # under device compute by async decode pipelining — the
         # driver-visible proof the overlap path engages (0 under the

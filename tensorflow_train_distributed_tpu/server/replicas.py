@@ -1,0 +1,685 @@
+"""Replica pool: N engine drivers behind one admission layer.
+
+The gateway's single point of failure was its one ``EngineDriver`` — a
+dead or hung driver turned every in-flight and queued request into a
+loss.  This module fronts N engine replicas (in-process driver threads;
+the seam deliberately admits subprocess replicas later — every
+replica interaction goes through the ``EngineDriver`` surface, which an
+IPC proxy can implement) with:
+
+- **routing**: admissions go to the alive replica with the warmest
+  KV affinity (a request whose prompt shares its leading KV block with
+  one recently routed to a replica prefers that replica — its radix
+  prefix cache holds the warm blocks), ties broken by load (waiting +
+  active lanes), then index;
+- **health**: per-replica ``driver.alive()`` plus a hung-dispatch
+  watchdog — a decode chunk that exceeds ``watchdog_timeout_s``
+  declares the replica dead even though its thread still exists (the
+  wedged-device failure mode liveness alone cannot see).  A first
+  dispatch COMPILES (XLA): size the watchdog above worst-case compile
+  time, or warm every replica up before taking traffic (the
+  bench/chaos harness idiom);
+- **deterministic failover**: a request whose replica dies is
+  re-admitted on a survivor with its ORIGINAL seed, its original
+  prompt plus every token already committed, and
+  ``resume_from=<committed count>`` — the engine's resume-from-token
+  admission continues the request's rng stream at its original
+  position, so greedy and seeded-sampling outputs equal an
+  uninterrupted single-replica run, with no token duplicated or
+  dropped (the stream simply continues);
+- **bounded retry with backoff**: a placement refused for transient
+  pool pressure (every replica's admission queue full) retries with
+  exponential backoff and gives up at the request's own deadline
+  instead of failing fast;
+- **graceful drain**: replicas drain ONE AT A TIME, so capacity
+  degrades gradually instead of all at once.
+
+Each pool request runs a small pump thread that places the request,
+relays committed chunks from the replica's stream to the caller's
+handle, and re-places on a survivor when the replica dies — the
+caller-facing ``RequestHandle`` surface (``result()`` /
+``iter_tokens()``) is exactly the single-driver one, so the gateway's
+HTTP frontend is replica-blind.
+
+Chaos: ``runtime.faults`` serve-side entries
+(``serve:dispatch:N:raise|hang|kill9[:replica=K]``) kill exactly the
+failure modes above — error-propagating death, hung dispatch, and
+abrupt vanish — deterministically, per replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.server.driver import (
+    _DONE,
+    _TERMINAL_KEEP,
+    AdmissionFull,
+    DeadlineExceeded,
+    Draining,
+    EngineDriver,
+    RequestError,
+    RequestHandle,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class NoReplicas(RuntimeError):
+    """No live replica can accept work (HTTP 503 + Retry-After: the
+    condition may clear — operators restart replicas — unlike a single
+    driver's terminal death)."""
+
+
+# Pump liveness poll while waiting on the next chunk: only paid when
+# the stream is IDLE (a ready chunk returns immediately), so it bounds
+# failover detection latency, not token latency.
+_POLL_S = 0.05
+
+# Recent first-block routing keys remembered per replica (the affinity
+# table's LRU bound).
+_AFFINITY_KEEP = 512
+
+
+class Replica:
+    """One engine + its driver + the pool-level health state."""
+
+    def __init__(self, idx: int, engine, *, max_queue: int,
+                 default_timeout_s: Optional[float],
+                 retry_after_s: float):
+        self.idx = idx
+        self.engine = engine
+        # validate=None: the pool screens once at its own admission.
+        self.driver = EngineDriver(
+            engine, max_queue=max_queue, validate=None,
+            default_timeout_s=default_timeout_s,
+            retry_after_s=retry_after_s, replica_id=idx)
+        self.slots = engine.slots
+        self.dead = False
+        self.dead_reason: Optional[str] = None
+        self._affinity: OrderedDict = OrderedDict()   # block key -> None
+        self._aff_lock = threading.Lock()
+
+    def state(self) -> str:
+        if self.dead:
+            return "dead"
+        if self.driver.is_draining():
+            return "draining"
+        return "alive"
+
+    def accepting(self) -> bool:
+        """Routable for NEW admissions (drain/death excluded)."""
+        return (not self.dead and self.driver.alive()
+                and not self.driver.is_draining())
+
+    def usable(self) -> bool:
+        """Usable for failover/drain-time re-admission: a DRAINING
+        replica still finishes accepted work, and a failed-over request
+        was accepted once — only death disqualifies."""
+        return not self.dead and self.driver.alive()
+
+    def load(self) -> int:
+        return self.driver.waiting() + self.driver.active_slots()
+
+    def note_affinity(self, key) -> None:
+        if key is None:
+            return
+        with self._aff_lock:
+            self._affinity[key] = None
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > _AFFINITY_KEEP:
+                self._affinity.popitem(last=False)
+
+    def affinity(self, key) -> int:
+        if key is None:
+            return 0
+        with self._aff_lock:
+            return 1 if key in self._affinity else 0
+
+
+class _PoolRequest:
+    """Pool-side record of one live request (the pump's state)."""
+
+    __slots__ = ("handle", "generated", "replica", "inner", "excluded",
+                 "failovers", "affinity_key", "thread",
+                 "queue_wait_seen")
+
+    def __init__(self, handle: RequestHandle, affinity_key):
+        self.handle = handle
+        self.generated: list = []      # committed tokens relayed so far
+        self.replica: Optional[Replica] = None
+        self.inner: Optional[RequestHandle] = None
+        self.excluded: set = set()     # replica idxs that died under it
+        self.failovers = 0
+        self.affinity_key = affinity_key
+        self.thread: Optional[threading.Thread] = None
+        self.queue_wait_seen = False
+
+
+class ReplicaPool:
+    """N replicas behind the ``EngineDriver`` submission surface.
+
+    The gateway talks to this exactly as it talks to a single driver
+    (``submit``/``waiting``/``active_slots``/``alive``/``drain``/
+    ``join``/``request_status``/``abandon``), so the HTTP layer is
+    replica-blind; everything replica-aware (routing, health, failover,
+    per-replica drain) lives here.
+    """
+
+    def __init__(self, engines, *, max_queue: int = 64,
+                 validate: Optional[Callable] = None,
+                 default_timeout_s: Optional[float] = None,
+                 retry_after_s: float = 1.0,
+                 watchdog_timeout_s: Optional[float] = 30.0,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0,
+                 replica_max_queue: Optional[int] = None,
+                 monitor_poll_s: Optional[float] = None):
+        engines = list(engines)
+        if len(engines) < 1:
+            raise ValueError("ReplicaPool needs at least one engine")
+        if watchdog_timeout_s is not None and watchdog_timeout_s <= 0:
+            raise ValueError(
+                f"watchdog_timeout_s must be > 0 (None disables), got "
+                f"{watchdog_timeout_s}")
+        self._validate = validate
+        self._max_queue = max_queue
+        self._default_timeout_s = default_timeout_s
+        self._retry_after_s = retry_after_s
+        self._watchdog_s = watchdog_timeout_s
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        # Per-replica admission bound: the pool-wide ``max_queue`` is
+        # the SHED bound (429); each replica's driver holds its share,
+        # so a skewed placement (affinity pinning, uneven drain) hits a
+        # TRANSIENT per-replica refusal the pump absorbs with backoff
+        # instead of a client-visible shed.
+        if replica_max_queue is None:
+            replica_max_queue = max(1, -(-max_queue // len(engines)))
+        self._replicas = [
+            Replica(i, e, max_queue=replica_max_queue,
+                    default_timeout_s=default_timeout_s,
+                    retry_after_s=retry_after_s)
+            for i, e in enumerate(engines)]
+        self._metrics = None
+        # RLock: submit() holds it across its waiting()/alive() checks
+        # (which take it again) so admission decisions are atomic.
+        self._lock = threading.RLock()
+        self._requests: dict = {}          # pool id -> _PoolRequest
+        self._terminal: OrderedDict = OrderedDict()
+        self._next_id = 0
+        self._draining = False
+        self._stop = threading.Event()
+        if monitor_poll_s is None:
+            monitor_poll_s = (min(0.05, watchdog_timeout_s / 4)
+                              if watchdog_timeout_s else 0.05)
+        self._monitor_poll_s = monitor_poll_s
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, name="replica-monitor", daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaPool":
+        for rep in self._replicas:
+            rep.driver.start()
+        self._monitor_thread.start()
+        return self
+
+    def set_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    @property
+    def replicas(self) -> list:
+        return self._replicas
+
+    # -- health / occupancy ------------------------------------------------
+
+    def alive(self) -> bool:
+        """True while at least one replica can make progress."""
+        return any(rep.usable() for rep in self._replicas)
+
+    def alive_count(self) -> int:
+        return sum(rep.usable() for rep in self._replicas)
+
+    def failure(self) -> Optional[BaseException]:
+        """Total-loss summary once EVERY replica is dead, else None
+        (one dead replica is a degraded pool, not a failed one)."""
+        if self.alive():
+            return None
+        reasons = [f"replica {rep.idx}: {rep.dead_reason or 'dead'}"
+                   for rep in self._replicas]
+        return RuntimeError("all replicas dead (" + "; ".join(reasons)
+                            + ")")
+
+    def waiting(self) -> int:
+        """Requests admitted by the pool but not yet decoding anywhere:
+        un-placed pump requests plus the live replicas' own queues."""
+        with self._lock:
+            unplaced = sum(1 for preq in self._requests.values()
+                           if preq.inner is None)
+        return unplaced + sum(rep.driver.waiting()
+                              for rep in self._replicas if rep.usable())
+
+    def active_slots(self) -> int:
+        return sum(rep.driver.active_slots()
+                   for rep in self._replicas if rep.usable())
+
+    def is_draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def replica_states(self) -> list:
+        """Per-replica health the /healthz endpoint reports."""
+        out = []
+        for rep in self._replicas:
+            d = {"replica": rep.idx, "state": rep.state(),
+                 "queue_depth": rep.driver.waiting(),
+                 "slots_in_use": rep.driver.active_slots(),
+                 "slots_total": rep.slots}
+            if rep.dead_reason:
+                d["reason"] = rep.dead_reason
+            total_fn = getattr(rep.engine, "kv_blocks_total", None)
+            total = total_fn() if total_fn is not None else 0
+            if total:
+                d["kv_blocks_total"] = total
+                d["kv_blocks_free"] = (total
+                                       - rep.engine.kv_blocks_in_use())
+            out.append(d)
+        return out
+
+    # -- admission ---------------------------------------------------------
+
+    def _affinity_key(self, prompt):
+        """First-KV-block token key: requests sharing it share their
+        leading physical blocks on whichever replica holds them."""
+        bs = getattr(self._replicas[0].engine, "kv_block_size", 16)
+        return tuple(prompt[:bs]) if len(prompt) >= bs else None
+
+    def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
+               stream: bool = False,
+               timeout_s: Optional[float] = None) -> RequestHandle:
+        """Admit one request to the pool; raises ``RequestError``,
+        ``AdmissionFull``, ``Draining``, or ``NoReplicas``.  The
+        returned handle is the single-driver one — ``result()`` /
+        ``iter_tokens()`` hide placement, retries, and failover."""
+        if self._validate is not None:
+            self._validate(prompt, max_new, seed)
+        try:
+            prompt = self._replicas[0].engine.validate_request(
+                prompt, max_new, seed)
+        except ValueError as e:
+            raise RequestError(str(e))
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
+        if timeout_s is not None and timeout_s <= 0:
+            raise RequestError(f"timeout_s must be > 0, got {timeout_s}")
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        with self._lock:
+            if self._draining:
+                raise Draining("gateway is draining; not admitting")
+            if not self.alive():
+                raise NoReplicas(
+                    "no live replica can accept work: "
+                    + "; ".join(f"replica {r.idx} {r.state()}"
+                                f" ({r.dead_reason})" if r.dead_reason
+                                else f"replica {r.idx} {r.state()}"
+                                for r in self._replicas))
+            if self.waiting() >= self._max_queue:
+                raise AdmissionFull(self.waiting(), self._retry_after_s)
+            pool_id = self._next_id
+            self._next_id += 1
+            if seed is None:
+                # Pin the effective seed NOW: an engine defaults a None
+                # seed to its own internal rid, which (a) collides
+                # across replicas — two engines both mint rid 0, so two
+                # concurrent seedless sampled requests draw the SAME
+                # default stream, breaking the distinct-per-request
+                # contract — and (b) changes across a failover
+                # re-admission, splicing an unrelated stream onto the
+                # committed prefix.  The pool-unique id restores both;
+                # greedy decode ignores it entirely.
+                seed = pool_id % 2 ** 32
+            handle = RequestHandle(pool_id, prompt, max_new, seed,
+                                   stream, deadline)
+            preq = _PoolRequest(handle, self._affinity_key(prompt))
+            self._requests[pool_id] = preq
+            # The pool-level admission anchor request_timeline keys on:
+            # failover re-admits the SAME id on a survivor, and the
+            # timeline must show every life plus the hop.
+            events.instant("request/pool_admitted", request_id=pool_id,
+                           prompt_len=len(prompt), max_new=max_new,
+                           stream=stream)
+        preq.thread = threading.Thread(
+            target=self._pump, args=(preq,),
+            name=f"pool-req-{pool_id}", daemon=True)
+        preq.thread.start()
+        return handle
+
+    # -- placement ---------------------------------------------------------
+
+    def _candidates(self, preq: _PoolRequest,
+                    allow_draining: bool) -> list:
+        """Routable replicas, best first: warm KV affinity, then load,
+        then index.  A replica this request already died on is never a
+        candidate (replicas do not resurrect)."""
+        reps = [rep for rep in self._replicas
+                if rep.idx not in preq.excluded
+                and (rep.usable() if allow_draining
+                     else rep.accepting())]
+        key = preq.affinity_key
+        reps.sort(key=lambda r: (-r.affinity(key), r.load(), r.idx))
+        return reps
+
+    def _place(self, preq: _PoolRequest, requeue: bool) -> None:
+        """Submit the request (or its resumed remainder) to the best
+        replica that will take it; when EVERY candidate refuses for
+        transient pool pressure, retry with exponential backoff until
+        the request's own deadline.  Raises ``DeadlineExceeded`` /
+        ``NoReplicas`` when placement cannot happen."""
+        outer = preq.handle
+        backoff = self._backoff_base_s
+        allow_draining = requeue or self.is_draining()
+        while True:
+            if (outer.deadline is not None
+                    and time.monotonic() >= outer.deadline):
+                raise DeadlineExceeded(
+                    f"request {outer.id} exceeded its deadline")
+            # Re-read the drain flag every pass: a pump looping in the
+            # backoff branch when drain BEGINS must widen its candidate
+            # set to draining replicas (accepted work runs to
+            # completion), not starve into NoReplicas.
+            allow_draining = allow_draining or self.is_draining()
+            reps = self._candidates(preq, allow_draining)
+            if not reps:
+                raise NoReplicas(
+                    f"request {outer.id}: no live replica left "
+                    f"(excluded: {sorted(preq.excluded)})")
+            gen = len(preq.generated)
+            prompt = (outer.prompt + preq.generated if gen
+                      else outer.prompt)
+            timeout_s = None
+            if outer.deadline is not None:
+                timeout_s = max(1e-3,
+                                outer.deadline - time.monotonic())
+            refused = False
+            for rep in reps:
+                try:
+                    inner = rep.driver.submit(
+                        prompt, outer.max_new - gen, seed=outer.seed,
+                        stream=True, timeout_s=timeout_s,
+                        request_id=outer.id, resume_from=gen,
+                        requeue=requeue or allow_draining)
+                except AdmissionFull:
+                    refused = True
+                    continue
+                except Draining:
+                    # Began draining between the candidate scan and
+                    # the submit: the next pass re-scans with the
+                    # drain-aware rule.
+                    allow_draining = True
+                    continue
+                except RuntimeError:
+                    # Driver died between scan and submit; the monitor
+                    # will mark it — never a candidate again.
+                    preq.excluded.add(rep.idx)
+                    continue
+                rep.note_affinity(preq.affinity_key)
+                preq.replica, preq.inner = rep, inner
+                return
+            if not refused:
+                continue        # candidate set changed under us: rescan
+            # EVERY candidate refused (transient pool pressure): the
+            # wait is bounded by the request's own deadline, so backoff
+            # replaces fail-fast INSIDE the pool — the pool-level bound
+            # in submit() still sheds 429 when the whole pool is over
+            # capacity.
+            if self._metrics is not None:
+                self._metrics.retries.inc()
+            events.instant("request/place_retry", request_id=outer.id,
+                           backoff_s=round(backoff, 4))
+            sleep = backoff
+            if outer.deadline is not None:
+                sleep = min(sleep, max(
+                    0.0, outer.deadline - time.monotonic()))
+            time.sleep(sleep)
+            backoff = min(backoff * 2, self._backoff_cap_s)
+
+    # -- the per-request pump ----------------------------------------------
+
+    def _pump(self, preq: _PoolRequest) -> None:
+        outer = preq.handle
+        requeue = False
+        try:
+            while True:
+                try:
+                    self._place(preq, requeue)
+                except DeadlineExceeded as e:
+                    self._finish(preq, None, e, "expired")
+                    return
+                except NoReplicas as e:
+                    self._finish(preq, None, e, "error")
+                    return
+                except RequestError as e:
+                    self._finish(preq, None, e, "invalid")
+                    return
+                verdict = self._relay(preq)
+                if verdict == "done":
+                    self._finish(preq,
+                                 list(outer.prompt) + preq.generated,
+                                 None, "ok")
+                    return
+                if verdict == "failover":
+                    requeue = True
+                    continue
+                return                      # _relay already finished it
+        except BaseException as e:          # noqa: BLE001 — fail loudly
+            logger.exception("pool pump for request %d died", outer.id)
+            self._finish(preq, None,
+                         RuntimeError(f"pool pump failed: {e!r}"),
+                         "error")
+
+    def _relay(self, preq: _PoolRequest) -> str:
+        """Relay committed chunks from the inner stream to the outer
+        handle until the life ends: returns ``"done"``, ``"failover"``
+        (replica died — the pump re-places), or ``"finished"`` when a
+        terminal error was already delivered."""
+        outer, inner, rep = preq.handle, preq.inner, preq.replica
+        q = inner._queue
+        while True:
+            try:
+                item = q.get(timeout=_POLL_S)
+            except queue_mod.Empty:
+                if rep.dead or not rep.driver.alive():
+                    # The monitor declared the replica dead (hung
+                    # dispatch or vanish) — or its driver thread is
+                    # simply gone (a drain race can strand a late
+                    # requeue): either way the inner handle will never
+                    # resolve; fail over from the last COMMITTED token.
+                    # (A normally-drained request delivers its _DONE
+                    # before the thread exits, so reaching here with a
+                    # dead thread means the handle truly dangles.)
+                    return self._begin_failover(
+                        preq, rep.dead_reason or "replica gone")
+                continue
+            if item is _DONE:
+                return "done"
+            if isinstance(item, DeadlineExceeded):
+                self._finish(preq, None, item, "expired")
+                return "finished"
+            if isinstance(item, RequestError):
+                self._finish(preq, None, item, "invalid")
+                return "finished"
+            if isinstance(item, BaseException):
+                # The driver loop died with error propagation: the
+                # replica is (about to be marked) dead; fail over.
+                return self._begin_failover(preq, repr(item))
+            # A committed chunk of generated tokens.
+            preq.generated.extend(item)
+            self._on_chunk(preq, item)
+
+    def _begin_failover(self, preq: _PoolRequest, reason: str) -> str:
+        rep = preq.replica
+        preq.excluded.add(rep.idx)
+        preq.failovers += 1
+        preq.replica = preq.inner = None
+        if self._metrics is not None:
+            self._metrics.failovers.inc()
+        events.instant("request/failover", request_id=preq.handle.id,
+                       from_replica=rep.idx,
+                       resumed_at=len(preq.generated),
+                       reason=str(reason)[:200])
+        logger.warning(
+            "request %d failing over from replica %d at %d generated "
+            "tokens (%s)", preq.handle.id, rep.idx,
+            len(preq.generated), reason)
+        return "failover"
+
+    def _on_chunk(self, preq: _PoolRequest, chunk: list) -> None:
+        outer = preq.handle
+        now = time.monotonic()
+        m = self._metrics
+        if not preq.queue_wait_seen:
+            preq.queue_wait_seen = True
+            granted = preq.inner.slot_granted_at or now
+            if m is not None:
+                m.queue_wait.observe(max(0.0, granted - outer.t_submit))
+        if outer.first_token_at is None:
+            outer.first_token_at = now
+            if m is not None:
+                m.ttft.observe(now - outer.t_submit)
+        if m is not None:
+            m.tokens.inc(len(chunk))
+            if outer.last_commit_at is not None:
+                m.inter_token.observe(
+                    (now - outer.last_commit_at) / len(chunk))
+        outer.last_commit_at = now
+        # No pool-side commit instant: the replica's driver already
+        # records request/commit for every chunk (with its replica id).
+        outer._push_new(list(outer.prompt) + preq.generated)
+
+    def _finish(self, preq: _PoolRequest, tokens: Optional[list],
+                error: Optional[BaseException], status: str) -> None:
+        outer = preq.handle
+        with self._lock:
+            if outer.id not in self._requests:
+                return                      # already finished
+            del self._requests[outer.id]
+            self._terminal[outer.id] = status
+            while len(self._terminal) > _TERMINAL_KEEP:
+                self._terminal.popitem(last=False)
+        m = self._metrics
+        if m is not None:
+            m.requests.inc(label_value=status)
+            if status == "ok":
+                m.latency.observe(time.monotonic() - outer.t_submit)
+        events.instant("request/pool_retire", request_id=outer.id,
+                       status=status, failovers=preq.failovers)
+        outer._resolve(tokens, error)
+
+    # -- request forensics / control ---------------------------------------
+
+    def request_status(self, request_id: int) -> str:
+        with self._lock:
+            status = self._terminal.get(request_id)
+            if status is not None:
+                return status
+            preq = self._requests.get(request_id)
+        if preq is None:
+            return "unknown"
+        rep, inner = preq.replica, preq.inner
+        if rep is None or inner is None:
+            return "queued"                 # placing / failing over
+        status = rep.driver.request_status(request_id)
+        if status in ("queued", "active"):
+            return status
+        return "active"     # life just ended; the pump is resolving
+
+    def abandon(self, handle: RequestHandle) -> None:
+        """Streaming client went away: collapse the deadline so the
+        current life is cancelled at the replica's next sweep and the
+        pump expires instead of decoding for nobody."""
+        handle.deadline = time.monotonic()
+        with self._lock:
+            preq = self._requests.get(handle.id)
+        if preq is not None:
+            rep, inner = preq.replica, preq.inner
+            if rep is not None and inner is not None:
+                rep.driver.abandon(inner)
+
+    # -- health monitor ----------------------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self._monitor_poll_s):
+            for rep in self._replicas:
+                if rep.dead:
+                    continue
+                drv = rep.driver
+                reason = None
+                failure = drv.failure()
+                if failure is not None:
+                    reason = f"driver failed: {failure!r}"
+                elif not drv.alive() and not drv.is_draining():
+                    reason = "driver vanished (no corpse, no drain)"
+                elif (self._watchdog_s is not None
+                      and drv.steps_completed() > 0
+                      and drv.step_elapsed() > self._watchdog_s):
+                    # Armed only after a completed step: the first
+                    # dispatch compiles (XLA — minutes on a cold TPU)
+                    # and must not read as a hang.
+                    reason = (f"dispatch hung > {self._watchdog_s:g}s "
+                              f"(watchdog)")
+                if reason is not None:
+                    self._declare_dead(rep, reason)
+
+    def _declare_dead(self, rep: Replica, reason: str) -> None:
+        rep.dead = True
+        rep.dead_reason = reason
+        events.instant("replica/dead", replica=rep.idx, reason=reason)
+        logger.error("replica %d declared DEAD: %s (%d alive)",
+                     rep.idx, reason, self.alive_count())
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop admitting new pool requests; already-accepted work
+        (including failover re-admissions) runs to completion.
+        Idempotent and non-blocking — ``join()`` does the staged
+        per-replica drain."""
+        with self._lock:
+            self._draining = True
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Drain and wait: replicas drain ONE AT A TIME (capacity
+        degrades gradually — the pool analog of the single driver's
+        stop-the-world drain), then the surviving pumps finish.
+        Returns True when everything drained inside ``timeout``."""
+        self.drain()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+
+        def left() -> Optional[float]:
+            return (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+
+        drained = True
+        for rep in self._replicas:          # sequential, by design
+            if not rep.usable():
+                continue
+            rep.driver.drain()
+            drained &= rep.driver.join(left())
+        for preq in list(self._requests.values()):
+            t = preq.thread
+            if t is not None:
+                t.join(left())
+                drained &= not t.is_alive()
+        self._stop.set()
+        with self._lock:
+            drained &= not self._requests
+        return drained

@@ -168,6 +168,7 @@ class _PrefillTask:
     padded: np.ndarray             # [1, piece * n_pieces] token ids
     piece: int
     n_pieces: int
+    resume: int = 0                # rng counter of the first pick
     pre_pair: Optional[tuple] = None   # matched prefix caches (linear)
     cursor: int = 0                # target pieces completed
     cache_1: object = None         # target batch-1 cache in progress
@@ -563,7 +564,7 @@ class ServingEngine:
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
     def _prefill_piece(self, variables, cache, tokens_1xl, local_idx,
-                       seed):
+                       seed, count0):
         """One batch-1 prefill piece appended to ``cache`` (a zeroed
         cache == fresh, so the whole-prompt case is a single piece).
 
@@ -574,13 +575,19 @@ class ServingEngine:
         index to the true prompt length so decode overwrites each pad
         row before any query can attend it (writes precede reads at
         every position).
+
+        ``count0`` is the rng counter of the pick — 0 for a fresh
+        request; a resumed request (failover re-admission whose prompt
+        tail is its own earlier output) picks at its original stream
+        position, so the continuation is the one the uninterrupted run
+        would have sampled.
         """
         with quantized_inference():
             logits, vs = self._prefill_model.apply(
                 dict(variables, cache=cache), tokens_1xl,
                 mutable=["cache"])
         first = self._pick(logits[:, local_idx],
-                           seed[None], jnp.zeros((1,), jnp.int32))[0]
+                           seed[None], count0[None])[0]
         return vs["cache"], first.astype(tokens_1xl.dtype)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -870,7 +877,8 @@ class ServingEngine:
     # -- host-side loop ----------------------------------------------------
 
     def validate_request(self, prompt, max_new_tokens: int,
-                         seed: Optional[int] = None) -> list:
+                         seed: Optional[int] = None,
+                         resume_from: int = 0) -> list:
         """All of ``submit()``'s checks WITHOUT enqueuing; returns the
         normalized prompt (a list of ints).  Read-only, so the HTTP
         gateway's handler threads can reject bad requests (400) before
@@ -883,6 +891,12 @@ class ServingEngine:
             raise ValueError(f"seed must be a uint32, got {seed}")
         if not prompt:
             raise ValueError("empty prompt")
+        if resume_from < 0 or resume_from >= len(prompt):
+            # The resumed tail is part of the prompt, and at least one
+            # ORIGINAL prompt token must remain under it.
+            raise ValueError(
+                f"resume_from must be in [0, len(prompt)), got "
+                f"{resume_from} for a {len(prompt)}-token prompt")
         if max_new_tokens < 0:
             raise ValueError(f"max_new_tokens must be >= 0, got "
                              f"{max_new_tokens}")
@@ -901,7 +915,8 @@ class ServingEngine:
                     f"request needs {need} KV blocks "
                     f"(block_size={self.kv_block_size}) but the pool "
                     f"has {self._kv_pool.n_blocks}")
-        if not self._exact_prefill and self.prefill_chunk is None:
+        if (not self._exact_prefill and self.prefill_chunk is None
+                and not resume_from):
             # Catch at submit time: failing later inside run() would
             # drop this request silently and abort others mid-flight.
             # Only the SUFFIX after the longest preloaded prefix needs
@@ -912,6 +927,12 @@ class ServingEngine:
             # Paged mode anchors the rule on operator-DECLARED preloads
             # (radix entries evict under pressure; admission chunks a
             # grown suffix, but validation must stay deterministic).
+            # RESUMED requests are exempt: the original admission
+            # already passed this policy bound, the resumed tail is the
+            # request's own output, and ``_pieces_for`` chunks any span
+            # into largest-bucket pieces (the long-preload mechanics) —
+            # rejecting here would kill an accepted half-streamed
+            # request as 'invalid' mid-failover.
             work = len(prompt) - (self._longest_declared_prefix(prompt)
                                   if self.paged
                                   else self._match_prefix(prompt)[0])
@@ -923,17 +944,27 @@ class ServingEngine:
         return prompt
 
     def submit(self, prompt, max_new_tokens: int,
-               seed: Optional[int] = None) -> int:
+               seed: Optional[int] = None, resume_from: int = 0) -> int:
         """Enqueue a request; returns its id (resolved by ``run()``).
 
         ``seed`` names the request's sampling stream (ignored under
         greedy); default: the request id — distinct per request,
-        reproducible across identical engine sessions."""
-        prompt = self.validate_request(prompt, max_new_tokens, seed)
+        reproducible across identical engine sessions.
+
+        ``resume_from=g`` declares the prompt's LAST ``g`` tokens to be
+        this request's own earlier output (the failover re-admission
+        contract): the rng counter starts at ``g`` instead of 0, so a
+        seeded-sampling continuation lands exactly where the
+        uninterrupted stream would have — the re-admitted request's
+        output is the original's, minus the tokens already delivered.
+        Greedy ignores the counter and resumes for free."""
+        prompt = self.validate_request(prompt, max_new_tokens, seed,
+                                       resume_from)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(
-            (rid, prompt, max_new_tokens, rid if seed is None else seed))
+            (rid, prompt, max_new_tokens,
+             rid if seed is None else seed, resume_from))
         events.instant("engine/queued", rid=rid, prompt_len=len(prompt),
                        max_new=max_new_tokens)
         return rid
@@ -1048,18 +1079,20 @@ class ServingEngine:
         return piece, -(-m // piece)
 
     def _run_target_piece(self, cache_1, padded, piece: int, i: int,
-                          m: int, seed: int):
+                          m: int, seed: int, rng0: int = 0):
         """Piece ``i`` of a target prefill — THE single source of the
         per-piece layout/local-idx rule, shared by atomic admission
         (``_prefill_tokens``) and the staged scheduler
-        (``_advance_piece``) so the two paths stay byte-for-byte."""
+        (``_advance_piece``) so the two paths stay byte-for-byte.
+        ``rng0``: the first pick's rng counter (resume-from-token
+        admission continues a stream; fresh requests pick at 0)."""
         toks = jnp.asarray(padded[:, i * piece:(i + 1) * piece])
         # local_idx only matters on the piece holding the last real
         # token (the final one).
         local = min(m - 1 - i * piece, piece - 1)
         return self._prefill_piece(self._variables, cache_1, toks,
                                    jnp.int32(max(local, 0)),
-                                   jnp.uint32(seed))
+                                   jnp.uint32(seed), jnp.int32(rng0))
 
     def _run_draft_piece(self, d_cache_1, padded, piece: int, i: int):
         """Piece ``i`` of a draft prefill (same piece grid as the
@@ -1068,7 +1101,8 @@ class ServingEngine:
         return self._draft_prefill_piece(self._draft_variables,
                                          d_cache_1, toks)
 
-    def _prefill_tokens(self, work, *, seed: int, cache_1, draft: bool):
+    def _prefill_tokens(self, work, *, seed: int, cache_1, draft: bool,
+                        rng0: int = 0):
         """Append ``work`` to a batch-1 cache in compile-bounded pieces
         (shared by request prefill and prefix preload, target and
         draft).  Returns (cache, first_token) — ``first`` is the pick
@@ -1085,7 +1119,7 @@ class ServingEngine:
                                                 piece, i)
             else:
                 cache_1, first = self._run_target_piece(
-                    cache_1, padded, piece, i, m, seed)
+                    cache_1, padded, piece, i, m, seed, rng0)
         return cache_1, first
 
     def preload_prefix(self, tokens) -> None:
@@ -1454,7 +1488,8 @@ class ServingEngine:
             # or first-token EOS) must not leave the slot idle for a
             # whole decode chunk while runnable work waits.
             while self._slot_states[slot] is None and self._queue:
-                rid, prompt, max_new, seed = self._queue.popleft()
+                rid, prompt, max_new, seed, resume = \
+                    self._queue.popleft()
                 if max_new == 0:
                     self._outputs[rid] = list(prompt)
                     continue
@@ -1467,7 +1502,7 @@ class ServingEngine:
                         # (the request takes its place back; blocks
                         # free as lanes retire).
                         self._queue.appendleft(
-                            (rid, prompt, max_new, seed))
+                            (rid, prompt, max_new, seed, resume))
                         if prefilled and stalled:
                             self.prefill_stats["stall_s"] += (
                                 time.perf_counter() - t0)
@@ -1488,11 +1523,13 @@ class ServingEngine:
                     cache_1 = self._admission_cache_1(
                         pre_pair, kv, table_j, draft=False)
                     cache_1, first = self._prefill_tokens(
-                        work, seed=seed, cache_1=cache_1, draft=False)
+                        work, seed=seed, cache_1=cache_1, draft=False,
+                        rng0=resume)
                 first = int(first)
                 state = _SlotState(request_id=rid, remaining=max_new - 1,
                                    tokens=list(prompt) + [first],
-                                   last_token=first, seed=seed, count=1)
+                                   last_token=first, seed=seed,
+                                   count=resume + 1)
                 if (max_new == 1 or (self.eos_id is not None
                                      and first == self.eos_id)):
                     # Resolved at prefill — and checked BEFORE the draft
@@ -1565,7 +1602,8 @@ class ServingEngine:
                     or slot in self._staging):
                 continue
             while self._queue:
-                rid, prompt, max_new, seed = self._queue.popleft()
+                rid, prompt, max_new, seed, resume = \
+                    self._queue.popleft()
                 if max_new == 0:
                     self._outputs[rid] = list(prompt)
                     continue
@@ -1577,7 +1615,7 @@ class ServingEngine:
                         # entirely (FIFO — nothing behind may jump the
                         # head; blocks free as lanes retire).
                         self._queue.appendleft(
-                            (rid, prompt, max_new, seed))
+                            (rid, prompt, max_new, seed, resume))
                         return
                     table_j = self._kv_table(kv)
                     pre_len, pre_pair = self._admission_match(kv, prompt)
@@ -1594,7 +1632,8 @@ class ServingEngine:
                     request_id=rid, prompt=list(prompt),
                     max_new=max_new, seed=seed, work=work,
                     padded=padded, piece=piece, n_pieces=n_pieces,
-                    pre_pair=pre_pair, kv=kv, table=table_j)
+                    resume=resume, pre_pair=pre_pair, kv=kv,
+                    table=table_j)
                 self.prefill_stats["staged_requests"] += 1
                 break
 
@@ -1605,7 +1644,8 @@ class ServingEngine:
         state = _SlotState(request_id=task.request_id,
                            remaining=task.max_new - 1,
                            tokens=list(task.prompt) + [first],
-                           last_token=first, seed=task.seed, count=1)
+                           last_token=first, seed=task.seed,
+                           count=task.resume + 1)
         if self._cache is None:
             self._cache = self._fresh_cache(self.slots, grid=True)
         n = len(task.prompt)
@@ -1659,7 +1699,7 @@ class ServingEngine:
                         task.pre_pair, task.kv, task.table, draft=False)
                 task.cache_1, task.first = self._run_target_piece(
                     task.cache_1, task.padded, task.piece, task.cursor,
-                    len(task.work), task.seed)
+                    len(task.work), task.seed, task.resume)
                 task.cursor += 1
                 if task.cursor == task.n_pieces:
                     # Materializing the first token blocks the host on

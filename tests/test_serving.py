@@ -811,9 +811,9 @@ class TestPrefixCaching:
         calls = []
         orig = eng._prefill_piece
 
-        def counting(variables, cache, toks, local, seed):
+        def counting(variables, cache, toks, local, seed, count0):
             calls.append(int(toks.shape[1]))
-            return orig(variables, cache, toks, local, seed)
+            return orig(variables, cache, toks, local, seed, count0)
 
         eng._prefill_piece = counting
         ids = [eng.submit(p, m) for p, m in reqs]
@@ -1029,9 +1029,9 @@ def test_prefix_caching_composes_with_tp_mesh(params, mesh_2d):
         pieces = []
         orig = eng._prefill_piece
 
-        def counting(variables, cache, toks, local, seed):
+        def counting(variables, cache, toks, local, seed, count0):
             pieces.append(int(toks.shape[1]))
-            return orig(variables, cache, toks, local, seed)
+            return orig(variables, cache, toks, local, seed, count0)
 
         eng._prefill_piece = counting
         ids = [eng.submit(p, n) for p, n in reqs]

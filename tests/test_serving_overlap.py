@@ -104,9 +104,9 @@ def _instrument(eng):
     events = []
     orig_p, orig_d = eng._prefill_piece, eng._decode_chunk
 
-    def p(variables, cache, toks, local, seed):
+    def p(variables, cache, toks, local, seed, count0):
         events.append("p")
-        return orig_p(variables, cache, toks, local, seed)
+        return orig_p(variables, cache, toks, local, seed, count0)
 
     def d(variables, cache, tok, seeds, counts):
         events.append("d")
@@ -412,9 +412,9 @@ def test_prefix_reuse_under_overlap_with_midstream_refill(params):
         pieces = []
         orig = eng._prefill_piece
 
-        def counting(variables, cache, toks, local, seed):
+        def counting(variables, cache, toks, local, seed, count0):
             pieces.append(int(toks.shape[1]))
-            return orig(variables, cache, toks, local, seed)
+            return orig(variables, cache, toks, local, seed, count0)
 
         eng._prefill_piece = counting
         out = {}

@@ -387,7 +387,8 @@ def bench_gateway_mixed(preset, slots, chunk, max_queue, seed, timeout,
 
 def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
                   requests_per_client, prompt_range, new_range,
-                  cache_len, seed, timeout, overlap_ab=True):
+                  cache_len, seed, timeout, overlap_ab=True,
+                  replicas=1):
     loop_args = (clients, requests_per_client, prompt_range, new_range)
 
     def finish(rec):
@@ -400,6 +401,7 @@ def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
             "slots": slots,
             "chunk": chunk,
             "max_queue": max_queue,
+            "replicas": replicas,
         })
         return rec
 
@@ -425,9 +427,11 @@ def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
 
     def one_mode(overlap):
-        eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
-                            cache_len=cache_len, overlap=overlap)
-        gw = ServingGateway(eng, host="127.0.0.1", port=0,
+        engines = [ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                                 cache_len=cache_len, overlap=overlap)
+                   for _ in range(replicas)]
+        gw = ServingGateway(engines if replicas > 1 else engines[0],
+                            host="127.0.0.1", port=0,
                             max_queue=max_queue).start()
         try:
             return _run_closed_loop(f"http://127.0.0.1:{gw.port}",
@@ -459,6 +463,10 @@ def main(argv=None) -> int:
                         "harness, not a quality one)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind the in-process gateway "
+                        "(load + KV-affinity routed; ignored with "
+                        "--base-url and --mixed)")
     p.add_argument("--max-queue", type=int, default=16)
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--requests-per-client", type=int, default=8)
@@ -527,7 +535,8 @@ def main(argv=None) -> int:
                     args.max_queue, args.clients,
                     args.requests_per_client,
                     prompt_range, new_range, args.cache_len or None,
-                    args.seed, args.timeout, overlap_ab=not args.no_ab)
+                    args.seed, args.timeout, overlap_ab=not args.no_ab,
+                    replicas=max(1, args.replicas))
     except Exception as e:
         metric = (f"{args.preset}_gateway_mixed_p99_inter_token_ms"
                   if args.mixed

@@ -16,9 +16,21 @@ resume the data stream mid-epoch, and finish — with final params
 stdout; exit 0 iff every check passed.  Usable locally and as a CI
 gate; the tier-1 chaos parity test drives this same entry point.
 
+``--serving`` runs the SERVING-side chaos parity instead — the same
+discipline applied to the multi-replica gateway: a two-replica
+gateway under concurrent streaming load has replica 0 killed
+(``serve:dispatch:N:kill9:replica=0`` — abrupt vanish, no
+notification) mid-stream, and the gate asserts every accepted request
+completes on the survivor with a token stream **equal to an
+uninterrupted single-replica run** (greedy and seeded-sampling legs),
+exactly one replica dead, at least one failover, and /healthz
+degraded-but-routable.  The tier-1 serving chaos smoke drives this
+same entry point in-process.
+
 Usage::
 
     python tools/chaos_check.py [--workdir DIR] [--steps 8]
+    python tools/chaos_check.py --serving
 """
 
 import argparse
@@ -140,6 +152,131 @@ def run_chaos_check(workdir: str, *, steps: int = 8,
                            if not all(checks.values()) else "")}
 
 
+def run_serving_chaos(*, sampling: bool = True, n_requests: int = 8,
+                      kill_dispatch: int = 4,
+                      watchdog_timeout_s: float = 10.0,
+                      timeout_s: float = 120.0) -> dict:
+    """Kill one of two gateway replicas mid-stream under load; every
+    accepted request must complete on the survivor with tokens EQUAL
+    to an uninterrupted single-replica run.  In-process (the kill9
+    serve fault is an abrupt replica-thread vanish — a true SIGKILL
+    would take both replicas).  Returns ``{"ok", "checks", ...}``."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # CLI path only: in-process callers (the tier-1 smoke) already
+        # run on the CPU backend, and force_platform's clear_backends
+        # would invalidate their live arrays.
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform("cpu")
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+        LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import faults
+    from tensorflow_train_distributed_tpu.server import ServingGateway
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    checks = {}
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    kw = dict(slots=2, cache_len=64, chunk=4, prompt_buckets=(8, 16, 32))
+    if sampling:
+        kw.update(temperature=0.8, top_k=40)
+    rng = np.random.default_rng(0)
+    reqs = [([int(t) for t in rng.integers(1, 200,
+                                           int(rng.integers(2, 8)))],
+             int(rng.integers(6, 14)), 1000 + i)
+            for i in range(n_requests)]
+
+    # Reference: the same requests on ONE uninterrupted engine.
+    ref_eng = ServingEngine(cfg, params, **kw)
+    rids = [ref_eng.submit(p, m, seed=s if sampling else None)
+            for p, m, s in reqs]
+    ref_out = ref_eng.run()
+    refs = [ref_out[r] for r in rids]
+
+    # Two replicas, prewarmed (a first dispatch compiles — the
+    # watchdog must see hung devices, not XLA).
+    engines = [ServingEngine(cfg, params, **kw) for _ in range(2)]
+    for e in engines:
+        e.submit([1, 2, 3], 5, seed=0 if sampling else None)
+        e.run()
+    faults.arm(f"serve:dispatch:{kill_dispatch}:kill9:replica=0")
+    gw = ServingGateway(engines, host="127.0.0.1", port=0,
+                        max_queue=4 * n_requests,
+                        watchdog_timeout_s=watchdog_timeout_s).start()
+    try:
+        results: list = [None] * len(reqs)
+
+        def client(i):
+            prompt, max_new, seed = reqs[i]
+            body = {"prompt": prompt, "max_new": max_new,
+                    "stream": True}
+            if sampling:
+                body["seed"] = seed
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/generate",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    toks, err = [], None
+                    for raw in r:
+                        obj = _json.loads(raw)
+                        if "tokens" in obj:
+                            toks.extend(obj["tokens"])
+                        elif "error" in obj:
+                            err = obj["error"]
+                    results[i] = (err, list(prompt) + toks)
+            except OSError as e:
+                results[i] = (f"{type(e).__name__}: {e}", None)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        checks["all_completed"] = all(
+            r is not None and r[0] is None for r in results)
+        checks["streams_match_reference"] = checks[
+            "all_completed"] and all(
+            r[1] == ref for r, ref in zip(results, refs))
+        states = gw.pool.replica_states()
+        checks["one_replica_dead"] = (
+            sum(s["state"] == "dead" for s in states) == 1)
+        checks["failover_happened"] = (
+            gw.metrics.failovers.value() >= 1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz", timeout=10) as r:
+            checks["healthz_degraded_not_503"] = (
+                r.status == 200
+                and _json.loads(r.read())["status"] == "degraded")
+    finally:
+        faults.disarm()
+        gw.drain(timeout=30)
+    return {"ok": all(checks.values()), "checks": checks,
+            "mode": "serving",
+            "leg": "sampled" if sampling else "greedy",
+            "failovers": gw.metrics.failovers.value(),
+            "results": [] if all(checks.values()) else
+            [(r[0] if r else "no result") for r in results]}
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser(
@@ -150,7 +287,21 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--keep", action="store_true",
                    help="keep the scratch dir for inspection")
+    p.add_argument("--serving", action="store_true",
+                   help="serving-side chaos instead: kill one of two "
+                        "gateway replicas mid-stream under load; "
+                        "accepted requests must complete on the "
+                        "survivor token-equal to an uninterrupted "
+                        "single-replica run (greedy + sampled legs)")
     args = p.parse_args(argv)
+    if args.serving:
+        greedy = run_serving_chaos(sampling=False)
+        sampled = run_serving_chaos(sampling=True)
+        verdict = {"ok": greedy["ok"] and sampled["ok"],
+                   "mode": "serving", "greedy": greedy,
+                   "sampled": sampled}
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else 1
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_check_")
     os.makedirs(workdir, exist_ok=True)
     try:

@@ -27,8 +27,14 @@ with Retry-After), per-request deadlines (``--default-timeout`` /
 per-request ``timeout_s`` → 504, slot freed), request-size and vocab
 validation (``check_vocab_ids`` — same screens as serve.py), graceful
 drain on SIGTERM/SIGINT (stop admitting, finish in-flight, flush
-metrics).  Model/engine flags are shared with serve.py
-(``add_engine_args``), so both CLIs configure the engine identically.
+metrics).  With ``--replicas N`` the gateway fronts N independent
+engine replicas (load + KV-affinity routing, per-replica health and
+``--watchdog-timeout`` hung-dispatch detection in ``/healthz``,
+deterministic failover that resumes a dead replica's requests on
+survivors from their last streamed token, staged ``--drain-timeout``
+drain); 503 only when NO replica can accept work.  Model/engine flags
+are shared with serve.py (``add_engine_args``), so both CLIs configure
+every replica identically.
 
 Examples:
   python tools/serve_http.py --config llama_tiny_sft \\
@@ -88,7 +94,25 @@ def main(argv=None) -> int:
                         "request answers 504 and frees its slot")
     p.add_argument("--retry-after", type=float, default=1.0,
                    help="Retry-After seconds on shed (429) responses")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind the gateway: with N>1 "
+                        "admissions route by load + KV-prefix affinity, "
+                        "each replica has its own health/watchdog, and "
+                        "a request whose replica dies resumes on a "
+                        "survivor from its last streamed token "
+                        "(TTD_NO_FAILOVER=1 forces the single-engine "
+                        "path)")
+    p.add_argument("--watchdog-timeout", type=float, default=30.0,
+                   help="seconds a decode dispatch may run before the "
+                        "replica is declared dead (hung-device "
+                        "detection; 0 disables — size it above "
+                        "worst-case XLA compile time or warm up first)")
+    p.add_argument("--drain-timeout", type=float, default=0.0,
+                   help="bound on the SIGTERM drain (replicas drain "
+                        "one at a time; 0 = wait indefinitely)")
     args = p.parse_args(argv)
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
 
     logging.basicConfig(
         level=logging.INFO,
@@ -105,22 +129,40 @@ def main(argv=None) -> int:
 
     _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
     prefix_ids = parse_prefix_arg(args, cfg)
-    eng = build_engine(args, cfg, is_moe, prefix_ids)
+    # One engine per replica, configured identically (each builds its
+    # own caches and preloads the prefix into its own pool — replica
+    # state stays fully independent so any one can die alone).
+    engines = [build_engine(args, cfg, is_moe, prefix_ids)
+               for _ in range(args.replicas)]
     # Online: request lengths are unknowable at startup, so a dense-
     # dispatch MoE always gets the compile-storm warning.
-    maybe_dense_moe_hint(eng)
+    maybe_dense_moe_hint(engines[0])
+    if args.replicas > 1:
+        # Warm every replica before taking traffic: the decode program
+        # (and one prefill shape) compiles now, so the first user
+        # request is fast on every replica and the pool's
+        # hung-dispatch watchdog never has to stare down a cold
+        # compile (it additionally only arms after a replica's first
+        # completed step).
+        for i, eng in enumerate(engines):
+            print(f"warming replica {i}...", flush=True)
+            eng.submit([1], 1)
+            eng.run()
 
     gw = ServingGateway(
-        eng, host=args.host, port=args.port, max_queue=args.max_queue,
+        engines if args.replicas > 1 else engines[0],
+        host=args.host, port=args.port, max_queue=args.max_queue,
         default_timeout_s=args.default_timeout or None,
         default_max_new=args.max_new,
         validate=make_vocab_validator(cfg.vocab_size),
-        retry_after_s=args.retry_after)
-    gw.install_signal_handlers()
+        retry_after_s=args.retry_after,
+        watchdog_timeout_s=args.watchdog_timeout or None)
+    gw.install_signal_handlers(
+        drain_timeout=args.drain_timeout or None)
     gw.start()
     print(f"gateway listening on {args.host}:{gw.port} "
-          f"(config={args.config}, slots={args.slots}, "
-          f"max_queue={args.max_queue})", flush=True)
+          f"(config={args.config}, replicas={args.replicas}, "
+          f"slots={args.slots}, max_queue={args.max_queue})", flush=True)
     gw.wait()           # until SIGTERM/SIGINT drains
     return 0
 
