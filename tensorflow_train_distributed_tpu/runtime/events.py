@@ -47,6 +47,10 @@ import time
 from collections import deque
 from typing import Optional
 
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+)
+
 _KILL_ENV = "TTD_NO_TRACE"
 _CAPACITY_ENV = "TTD_TRACE_CAPACITY"
 DEFAULT_CAPACITY = 65536
@@ -140,6 +144,7 @@ def get_thread_attrs() -> Optional[dict]:
     return getattr(_TLS, "attrs", None)
 
 
+@concurrency_guarded
 class Recorder:
     """Lock-cheap bounded ring buffer of trace events.
 
@@ -148,6 +153,10 @@ class Recorder:
     held for one ``deque.append`` / one ``list()`` copy — never across
     user code.
     """
+
+    # Every thread role appends; every access locks (ttd-lint's
+    # concurrency checker + TTD_LOCKCHECK=1 enforce it stays so).
+    _GUARDED_BY = {"_buf": ("_lock",)}
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         if capacity < 1:
@@ -227,8 +236,8 @@ class Recorder:
         ``replica`` attr so two replicas' identical engine rids never
         cross-join."""
         evs = self.events()
-        admit_t = pool_t = None
-        for e in evs:               # latest (pool) admission wins
+        admit_t = pool_t = solo_t = None
+        for e in evs:               # latest admission wins, per kind
             a = e[5]
             if a is None or a.get("request_id") != request_id:
                 continue
@@ -236,7 +245,17 @@ class Recorder:
                 pool_t = e[2]
             elif e[0] == "request/admitted":
                 admit_t = e[2]
-        if pool_t is not None:
+                # A per-life admission on a pool replica carries the
+                # replica id; a STANDALONE driver's does not.  Only
+                # the latter may outrank a pool anchor — a newer
+                # single-driver request reusing the id (driver ids
+                # restart per driver) must not join a stale pool
+                # life's events, and vice versa a failover's per-life
+                # re-admissions must never displace their own pool
+                # anchor.
+                if a.get("replica") is None:
+                    solo_t = e[2]
+        if pool_t is not None and (solo_t is None or pool_t > solo_t):
             admit_t = pool_t
         out = []
         segs: list = []           # [rid, replica, grant_t, hi] per life

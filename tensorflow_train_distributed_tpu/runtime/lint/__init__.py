@@ -1,0 +1,49 @@
+"""ttd-lint: static concurrency/purity analysis + runtime lock sanitizer.
+
+The correctness discipline of this codebase, turned from reviewer
+vigilance into a mechanically-enforced pass (the TF-Replicator lesson:
+replica orchestration lives or dies on enforced invariants).  Two
+halves share one annotation registry (``registry``):
+
+- **static checkers** (``python -m tools.ttd_lint``, and the tier-1
+  test that runs them over the whole package):
+
+  - ``concurrency`` — classes declare which lock guards which shared
+    attribute (``_GUARDED_BY``) and which thread role(s) each entry
+    point runs on (``@thread_role``); the checker walks each class's
+    call graph and flags any guarded-attribute access on a path where
+    the owning lock is not provably held (the exact bug class of the
+    PR 6/7 review-pass fixes);
+  - ``dispatch`` — host-sync hazards inside ``@dispatch_critical``
+    functions (the overlap-critical decode window) and Python-time
+    nondeterminism / host syncs inside jitted functions;
+  - ``flags`` — every ``TTD_*`` kill switch referenced anywhere must
+    be documented in README and exercised by at least one test;
+  - ``prometheus`` — metric naming conventions (counters ``_total``,
+    histograms ``_seconds``) and README coverage for every ``ttd_*``
+    metric name, unified from the old ad-hoc test lint.
+
+- **runtime sanitizer** (``lockcheck``): ``TTD_LOCKCHECK=1`` wraps the
+  package's locks with an acquisition-order graph that raises on
+  cycles (potential deadlock) and arms per-attribute guards that raise
+  on guarded access without the declared lock — conftest arms it for
+  tier-1, so every existing gateway/replica/chaos test doubles as a
+  race test.  ``TTD_NO_LOCKCHECK=1`` is the escape hatch.
+
+One suppression format everywhere: ``# ttd-lint: disable=<checker>``
+on the offending line (comma-separate several checkers).
+"""
+
+from tensorflow_train_distributed_tpu.runtime.lint.core import (  # noqa: F401
+    Finding,
+    iter_source_files,
+    run_lint,
+)
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (  # noqa: F401
+    THREAD_ROLES,
+    concurrency_guarded,
+    current_role,
+    dispatch_critical,
+    locks_held,
+    thread_role,
+)

@@ -1,0 +1,176 @@
+"""ttd-lint core: findings, suppressions, file walking, the runner.
+
+Checkers are functions ``(tree, source_lines, path, ctx) -> [Finding]``
+registered in ``CHECKERS``; ``run_lint`` parses each file once and
+fans it to every requested checker, then drops findings suppressed by
+the one shared suppression format:
+
+    some_code()            # ttd-lint: disable=concurrency
+    other_code()           # ttd-lint: disable=concurrency,dispatch
+
+A suppression names the checker it silences (never a bare
+``disable``), so grepping for a checker's name finds every place it
+was overridden — the suppression IS documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+_SUPPRESS_RE = re.compile(r"#\s*ttd-lint:\s*disable=([a-z0-9_,\- ]+)")
+
+# Directories never linted (fixtures PLANT bugs for the checkers'
+# own mutation tests; caches are noise).
+_SKIP_DIRS = {"__pycache__", "lint_fixtures", ".git"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str
+    line: int
+    message: str
+
+    def format(self, root: Optional[str] = None) -> str:
+        path = (os.path.relpath(self.path, root)
+                if root else self.path)
+        return f"{path}:{self.line}: [{self.checker}] {self.message}"
+
+
+def _suppressed(lines: Sequence[str], lineno: int, checker: str) -> bool:
+    if not 1 <= lineno <= len(lines):
+        return False
+    m = _SUPPRESS_RE.search(lines[lineno - 1])
+    if not m:
+        return False
+    names = {n.strip() for n in m.group(1).split(",")}
+    return checker in names
+
+
+def iter_source_files(paths: Sequence[str]) -> List[str]:
+    """Every ``.py`` under the given files/dirs (sorted, skip-listed
+    dirs pruned)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for f in filenames:
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return sorted(set(out))
+
+
+class LintContext:
+    """Cross-file state checkers may need (repo root for README/tests
+    lookups; lazily-read shared docs)."""
+
+    def __init__(self, root: Optional[str] = None):
+        if root is None:
+            # runtime/lint/core.py -> repo root is four levels up.
+            root = os.path.abspath(os.path.join(
+                os.path.dirname(__file__), "..", "..", ".."))
+        self.root = root
+        self._docs: Dict[str, str] = {}
+
+    def read_doc(self, relpath: str) -> str:
+        if relpath not in self._docs:
+            full = os.path.join(self.root, relpath)
+            try:
+                with open(full, encoding="utf-8") as f:
+                    self._docs[relpath] = f.read()
+            except OSError:
+                self._docs[relpath] = ""
+        return self._docs[relpath]
+
+    def tests_corpus(self) -> str:
+        """Concatenated test sources (the kill-switch checker's
+        "exercised by at least one test" evidence), fixtures included
+        — a fixture exercising a flag counts, linting fixtures for
+        PLANTED bugs is what's excluded."""
+        key = "<tests>"
+        if key not in self._docs:
+            tests_dir = os.path.join(self.root, "tests")
+            chunks = []
+            for dirpath, dirnames, filenames in os.walk(tests_dir):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        try:
+                            with open(os.path.join(dirpath, f),
+                                      encoding="utf-8") as fh:
+                                chunks.append(fh.read())
+                        except OSError:
+                            pass
+            self._docs[key] = "\n".join(chunks)
+        return self._docs[key]
+
+
+# name -> checker fn; populated by the checker modules at import.
+CHECKERS: Dict[str, Callable] = {}
+
+
+def register_checker(name: str):
+    def deco(fn):
+        CHECKERS[name] = fn
+        return fn
+    return deco
+
+
+def _load_checkers() -> None:
+    # Imported lazily so ``import runtime.lint.core`` alone stays
+    # dependency-free; each module registers itself.
+    from tensorflow_train_distributed_tpu.runtime.lint import (  # noqa: F401
+        concurrency,
+        dispatch,
+        flags,
+        prometheus,
+    )
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             checkers: Optional[Sequence[str]] = None,
+             root: Optional[str] = None) -> List[Finding]:
+    """Run the requested checkers (default: all) over ``paths``
+    (default: the package + tools), dropping suppressed findings."""
+    _load_checkers()
+    ctx = LintContext(root)
+    if paths is None:
+        paths = [os.path.join(ctx.root, "tensorflow_train_distributed_tpu"),
+                 os.path.join(ctx.root, "tools")]
+    if checkers is None:
+        names = sorted(CHECKERS)
+    else:
+        unknown = [c for c in checkers if c not in CHECKERS]
+        if unknown:
+            raise ValueError(f"unknown checker(s) {unknown}; "
+                             f"known: {sorted(CHECKERS)}")
+        names = list(checkers)
+    findings: List[Finding] = []
+    for path in iter_source_files(list(paths)):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("io", path, 0, f"unreadable: {e}"))
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "syntax", path, e.lineno or 0, f"syntax error: {e.msg}"))
+            continue
+        lines = source.splitlines()
+        for name in names:
+            for f_ in CHECKERS[name](tree, lines, path, ctx):
+                if not _suppressed(lines, f_.line, f_.checker):
+                    findings.append(f_)
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings

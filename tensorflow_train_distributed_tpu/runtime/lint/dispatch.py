@@ -1,0 +1,217 @@
+"""Dispatch-purity checker: host-sync and jit hazards.
+
+Two function populations, two hazard sets:
+
+1. ``@dispatch_critical`` functions — the overlap-critical decode
+   window (``ServingEngine._dispatch_chunk`` and friends): everything
+   between harvesting chunk N and enqueueing chunk N+1 must stay
+   sync-free, or the one-chunk lookahead quietly degrades to the
+   synchronous path while the A/B still *reports* overlap.  Flagged:
+
+   - ``.block_until_ready()`` — the literal sync;
+   - ``np.asarray(...)`` / ``np.array(...)`` / ``jax.device_get`` /
+     ``.item()`` / ``float(...)`` / ``int(...)`` on expressions —
+     device-value materialization (a host constant is fine; suppress
+     with ``# ttd-lint: disable=dispatch`` and say why);
+   - ``os.environ[...]`` / ``os.environ.get(...)`` — ~1us per read on
+     a per-chunk path; use a module flag read once, or the
+     ``os.environ._data`` fast path the flight recorder uses;
+   - ``time.time()`` — wall clock (steps under NTP); use
+     ``time.monotonic()`` / ``time.perf_counter()``.
+
+2. jitted functions (``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+   ``f = jax.jit(g)``) — Python-time effects burn in at TRACE time and
+   silently freeze: ``time.*`` clocks, ``random``/``np.random``,
+   ``os.environ``, ``print``, plus the same materialization calls
+   (a host sync inside a traced fn is a tracer leak).  Also flagged:
+   ``jax.jit(..., static_argnums=...)`` call sites in the same module
+   whose static argument expression is visibly a traced value
+   (a ``jnp.*`` call or a name bound to one) — the classic
+   recompile-per-value hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tensorflow_train_distributed_tpu.runtime.lint.core import (
+    Finding,
+    register_checker,
+)
+
+CHECKER = "dispatch"
+
+_CLOCKS = {"time": {"time"}}
+_JIT_CLOCKS = {"time": {"time", "monotonic", "perf_counter",
+                        "process_time"}}
+_MATERIALIZERS = {("np", "asarray"), ("np", "array"),
+                  ("numpy", "asarray"), ("numpy", "array"),
+                  ("jax", "device_get")}
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _decorator_name(dec: ast.expr) -> Optional[str]:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    return _dotted(target)
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = _decorator_name(dec)
+        if name in ("jax.jit", "jit"):
+            return True
+        # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+        if (isinstance(dec, ast.Call)
+                and name in ("partial", "functools.partial")
+                and dec.args
+                and _dotted(dec.args[0]) in ("jax.jit", "jit")):
+            return True
+    return False
+
+
+def _is_dispatch_critical(fn: ast.FunctionDef) -> bool:
+    return any(_decorator_name(d) == "dispatch_critical"
+               for d in fn.decorator_list)
+
+
+def _hazards(fn: ast.FunctionDef, path: str, jit: bool) -> List[Finding]:
+    where = "jitted function" if jit else "dispatch-critical window"
+    clocks = _JIT_CLOCKS if jit else _CLOCKS
+    out: List[Finding] = []
+
+    def flag(node, msg):
+        out.append(Finding(CHECKER, path, node.lineno,
+                           f"{fn.name}: {msg} inside {where}"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = _dotted(f) or ""
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready":
+                    flag(node, "block_until_ready() host sync")
+                    continue
+                if f.attr == "item" and not node.args:
+                    flag(node, ".item() device-value materialization")
+                    continue
+                if jit and name.startswith(("random.", "np.random.",
+                                            "numpy.random.")):
+                    flag(node, f"{name}(): Python-time randomness "
+                               f"(burns in at trace time)")
+                    continue
+                parts = name.split(".")
+                if len(parts) == 2:
+                    mod, attr = parts
+                    if (mod, attr) in _MATERIALIZERS:
+                        flag(node, f"{name}() device-value "
+                                   f"materialization / host sync")
+                        continue
+                    if mod in clocks and attr in clocks[mod]:
+                        what = ("Python-time clock (burns in at trace "
+                                "time)" if jit else
+                                "wall clock (use time.monotonic)")
+                        flag(node, f"{name}(): {what}")
+                        continue
+                    if mod == "os" and attr == "urandom" and jit:
+                        flag(node, "os.urandom(): Python-time "
+                                   "randomness")
+                        continue
+                if name in ("os.environ.get",):
+                    flag(node, "os.environ.get(): slow env read on a "
+                               "hot path (hoist to a module flag or "
+                               "use the os.environ._data fast path)")
+                    continue
+            elif isinstance(f, ast.Name):
+                if jit and f.id == "print":
+                    flag(node, "print(): host side effect at trace "
+                               "time")
+                    continue
+                if f.id in ("float", "int") and len(node.args) == 1:
+                    a = node.args[0]
+                    if isinstance(a, ast.UnaryOp):
+                        a = a.operand        # float(-1e9) is constant
+                    if not isinstance(a, ast.Constant):
+                        flag(node, f"{f.id}() on a non-constant "
+                                   f"(device-value materialization if "
+                                   f"the argument is on device)")
+                    continue
+        elif isinstance(node, ast.Subscript):
+            if _dotted(node.value) == "os.environ":
+                flag(node, "os.environ[...]: slow env read on a hot "
+                           "path (hoist to a module flag or use the "
+                           "os.environ._data fast path)")
+    return out
+
+
+def _static_arg_hazards(tree: ast.Module, path: str) -> List[Finding]:
+    """``f = jax.jit(g, static_argnums=(k,))`` whose call sites pass a
+    visibly-traced expression in a static position."""
+    out: List[Finding] = []
+    jnp_names: set = set()          # names bound to jnp.* results
+    jitted: Dict[str, List[int]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t, v = node.targets[0], node.value
+            if isinstance(t, ast.Name) and isinstance(v, ast.Call):
+                callee = _dotted(v.func) or ""
+                if callee.startswith(("jnp.", "jax.numpy.")):
+                    jnp_names.add(t.id)
+                if callee in ("jax.jit", "jit"):
+                    nums: List[int] = []
+                    for kw in v.keywords:
+                        if kw.arg == "static_argnums":
+                            val = kw.value
+                            elts = (val.elts
+                                    if isinstance(val, (ast.Tuple,
+                                                        ast.List))
+                                    else [val])
+                            for e in elts:
+                                if (isinstance(e, ast.Constant)
+                                        and isinstance(e.value, int)):
+                                    nums.append(e.value)
+                    if nums:
+                        jitted[t.id] = nums
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted):
+            continue
+        for k in jitted[node.func.id]:
+            if k >= len(node.args):
+                continue
+            arg = node.args[k]
+            traced = (isinstance(arg, ast.Call)
+                      and (_dotted(arg.func) or "").startswith(
+                          ("jnp.", "jax.numpy."))) or (
+                isinstance(arg, ast.Name) and arg.id in jnp_names)
+            if traced:
+                out.append(Finding(
+                    CHECKER, path, node.lineno,
+                    f"traced value passed in static_argnums position "
+                    f"{k} of jitted '{node.func.id}' (recompiles per "
+                    f"value; pass it traced or hash a host scalar)"))
+    return out
+
+
+@register_checker(CHECKER)
+def check(tree: ast.Module, lines, path: str, ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            if _is_dispatch_critical(node):
+                findings.extend(_hazards(node, path, jit=False))
+            if _is_jit_decorated(node):
+                findings.extend(_hazards(node, path, jit=True))
+    findings.extend(_static_arg_hazards(tree, path))
+    return findings

@@ -1,0 +1,66 @@
+"""Kill-switch / env-flag checker.
+
+Every ``TTD_*`` name referenced in package or tools source must be
+(1) documented in README.md and (2) exercised by at least one test —
+an undocumented kill switch is an operator trap, and an untested one
+is a switch nobody knows still works.  This includes stdout tags that
+LOOK like env vars (``TTD_RESULT:`` — documented all the same: an
+operator grepping logs meets it before reading the source).
+
+Family names are honored: ``TTD_K8S_REPLICAS`` is satisfied by README
+documenting either the exact name or a ``TTD_K8S_*`` family entry.
+Suppress a deliberate exception with
+``# ttd-lint: disable=kill-switch`` on the referencing line.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tensorflow_train_distributed_tpu.runtime.lint.core import (
+    Finding,
+    register_checker,
+)
+
+CHECKER = "kill-switch"
+
+# Trailing underscore excluded: ``TTD_FOO_*`` family globs in docs
+# are not variable references.
+_VAR_RE = re.compile(r"\bTTD_[A-Z0-9_]*[A-Z0-9]\b")
+
+
+def _family_documented(var: str, doc: str) -> bool:
+    """Exact name, or any ``TTD_FOO_*`` family glob whose prefix
+    matches the var."""
+    if var in doc:
+        return True
+    parts = var.split("_")
+    for i in range(2, len(parts)):
+        if "_".join(parts[:i]) + "_*" in doc:
+            return True
+    return False
+
+
+@register_checker(CHECKER)
+def check(tree, lines, path: str, ctx) -> List[Finding]:
+    readme = ctx.read_doc("README.md")
+    tests = ctx.tests_corpus()
+    findings: List[Finding] = []
+    seen: set = set()
+    for lineno, line in enumerate(lines, start=1):
+        for m in _VAR_RE.finditer(line):
+            var = m.group(0)
+            if var in seen:
+                continue
+            seen.add(var)
+            if not _family_documented(var, readme):
+                findings.append(Finding(
+                    CHECKER, path, lineno,
+                    f"env flag {var} is not documented in README.md"))
+            if var not in tests:
+                findings.append(Finding(
+                    CHECKER, path, lineno,
+                    f"env flag {var} is not exercised by any test "
+                    f"under tests/"))
+    return findings

@@ -48,6 +48,10 @@ import sys
 import time
 from typing import Optional, Sequence
 
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    thread_role,
+)
+
 from tensorflow_train_distributed_tpu.runtime import events
 from tensorflow_train_distributed_tpu.runtime.preemption import (
     PREEMPTION_EXIT_CODE,
@@ -165,6 +169,7 @@ class TrainSupervisor:
             except OSError:      # child raced to exit
                 pass
 
+    @thread_role("supervisor")
     def run(self) -> SupervisorResult:
         prev_handlers = {}
         if self.handle_signals:

@@ -46,6 +46,11 @@ from collections import OrderedDict, deque
 from typing import Callable, Optional
 
 from tensorflow_train_distributed_tpu.runtime import events, faults
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+    locks_held,
+    thread_role,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -151,6 +156,7 @@ class RequestHandle:
         return self._done.is_set()
 
 
+@concurrency_guarded
 class EngineDriver:
     """Background thread owning a ``ServingEngine``; concurrent-safe
     ``submit()`` for everyone else.
@@ -161,6 +167,24 @@ class EngineDriver:
     ``metrics``: a ``GatewayMetrics`` (optional — the driver works bare
     for library use/tests).
     """
+
+    # Every cross-thread structure is ``_cv``-guarded for ALL access —
+    # including the driver loop's own: the loop MUTATES these while
+    # handler threads iterate them under the lock, and a lock-free
+    # owner write would race the locked readers (the `_inflight` del
+    # vs ``request_status`` iteration bug ttd-lint's concurrency
+    # checker now catches statically and TTD_LOCKCHECK=1 at runtime).
+    # Deliberately NOT declared (single-field atomic publishes with
+    # read-only consumers): _step_t0, _steps_done, _dispatch_n,
+    # _vanished.
+    _GUARDED_BY = {
+        "_admit": ("_cv",),
+        "_inflight": ("_cv",),
+        "_terminal": ("_cv",),
+        "_draining": ("_cv",),
+        "_failed": ("_cv",),
+        "_poisoned": ("_cv",),
+    }
 
     def __init__(self, engine, *, max_queue: int = 64,
                  validate: Optional[Callable] = None,
@@ -182,6 +206,14 @@ class EngineDriver:
         self._next_id = 0
         self._draining = False
         self._failed: Optional[BaseException] = None
+        # Fencing: a watchdog-declared-dead replica's loop thread may
+        # still EXIST (wedged in a hung dispatch) — when it eventually
+        # wakes it must not dispatch again (its requests failed over
+        # long ago; a zombie driving the device — or consuming armed
+        # chaos-fault budgets — corrupts whoever took over).  The pool
+        # poisons the driver at declaration; the loop exits at its
+        # next iteration instead of dispatching.
+        self._poisoned: Optional[str] = None
         # Replica identity (None standalone): tagged onto this driver's
         # flight-recorder events (the loop thread via thread attrs,
         # caller-thread instants via _ev_attrs) and handed to the
@@ -232,8 +264,10 @@ class EngineDriver:
         """Requests admitted but not yet in a lane (the shed gauge):
         driver-side admissions plus the engine's own queue.  A request
         staged mid-prefill holds a lane already — it counts toward
-        ``active_slots()``, not here."""
-        return len(self._admit) + self._engine.queue_depth()
+        ``active_slots()``, not here.  (``_cv`` is a re-entrant
+        Condition: ``submit()`` calls this while holding it.)"""
+        with self._cv:
+            return len(self._admit) + self._engine.queue_depth()
 
     def alive(self) -> bool:
         """Is the driver loop able to make progress?  False once the
@@ -241,7 +275,9 @@ class EngineDriver:
         finished — the signal /healthz and the ``driver_alive`` gauge
         expose so load balancers stop routing to a zombie gateway
         whose listener still accepts sockets."""
-        return self._failed is None and self._thread.is_alive()
+        with self._cv:
+            failed = self._failed is not None
+        return not failed and self._thread.is_alive()
 
     def failure(self) -> Optional[BaseException]:
         """The exception that killed the driver loop, if any."""
@@ -281,6 +317,7 @@ class EngineDriver:
     def active_slots(self) -> int:
         return self._engine.active_slots()
 
+    @thread_role("handler", "pump", "main")
     def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
                stream: bool = False,
                timeout_s: Optional[float] = None,
@@ -378,6 +415,18 @@ class EngineDriver:
         ever compares it against the clock — so no lock is needed."""
         handle.deadline = time.monotonic()
 
+    def poison(self, reason: str) -> None:
+        """Fence a declared-dead driver: the loop exits at its next
+        iteration WITHOUT dispatching again.  The pool's watchdog calls
+        this the moment it declares a replica dead — a wedged dispatch
+        that later wakes must not touch the device (or consume armed
+        chaos-fault budgets) after its requests failed over.  A hang
+        in ``serve_step`` is unaffected (the thread sleeps outside the
+        lock); the fence lands when the step returns."""
+        with self._cv:
+            self._poisoned = reason
+            self._cv.notify()
+
     def is_draining(self) -> bool:
         with self._cv:
             return self._draining
@@ -397,6 +446,7 @@ class EngineDriver:
 
     # -- driver loop -----------------------------------------------------
 
+    @thread_role("driver")
     def _loop(self) -> None:
         if self._replica_id is not None:
             # Every event this thread records — driver lifecycle AND
@@ -407,8 +457,20 @@ class EngineDriver:
             while True:
                 with self._cv:
                     while not (self._admit or self._inflight
-                               or self._draining):
+                               or self._draining or self._poisoned):
                         self._cv.wait()
+                    if self._poisoned:
+                        # Fenced (watchdog declared this replica dead):
+                        # exit before the next dispatch — kill9
+                        # semantics, chosen on purpose: the backlog
+                        # already failed over, and resolving anything
+                        # here would race the survivors.
+                        logger.warning(
+                            "engine driver %s fenced after death "
+                            "declaration (%s); exiting without "
+                            "dispatching", self._replica_id,
+                            self._poisoned)
+                        return
                     if (self._draining and not self._admit
                             and not self._inflight):
                         return
@@ -456,6 +518,7 @@ class EngineDriver:
                 handle._resolve(None, RuntimeError(
                     f"engine driver failed: {e!r}"))
 
+    @locks_held("_cv")
     def _admit_pending(self) -> None:
         """Move admitted requests into the engine (driver thread only,
         under the lock — the ONE place engine.submit is called)."""
@@ -498,7 +561,15 @@ class EngineDriver:
         still staged inside the engine appears in neither ``done`` nor
         the snapshot — it falls through to the deadline check below,
         so an expired prefilling request is cancelled (lane freed,
-        partial cache discarded) exactly like a decoding one."""
+        partial cache discarded) exactly like a decoding one.
+
+        The whole pass holds ``_cv``: the dels below used to run
+        lock-free ("driver thread only") while ``request_status``
+        iterated ``_inflight.values()`` under the lock from handler
+        threads — a dict resized mid-iteration raises in the READER
+        (the exact `_prefix_caches` bug class from PR 6, one layer
+        up).  Everything in here is host bookkeeping — the hold is
+        microseconds and no device work runs under it."""
         now = time.monotonic()
         snapshot = self._engine.snapshot()
         # Lanes reserved for staged prefills count as granted — the
@@ -506,57 +577,63 @@ class EngineDriver:
         # yet (engines without the staged scheduler, e.g. test stubs,
         # simply have none).
         staged = getattr(self._engine, "staged_rids", tuple)()
-        for rid, handle in list(self._inflight.items()):
-            tokens = done.get(rid)
-            finished = tokens is not None
-            if not finished:
-                tokens = snapshot.get(rid)
-            if handle.slot_granted_at is None and (
-                    tokens is not None or rid in staged):
-                # First time the request holds a lane (decoding, done,
-                # or staged mid-prefill): the queue-depth gauge's
-                # latency companion, chunk-granular like every harvest
-                # signal.
-                handle.slot_granted_at = now
-                wait = now - handle.t_submit
-                if self._metrics is not None:
-                    self._metrics.queue_wait.observe(wait)
-                events.instant("request/slot_granted",
-                               request_id=handle.id, rid=rid,
-                               wait_ms=round(wait * 1e3, 3))
-            if tokens is not None and len(tokens) > len(handle.prompt):
-                if handle.first_token_at is None:
-                    handle.first_token_at = now
+        with self._cv:
+            for rid, handle in list(self._inflight.items()):
+                tokens = done.get(rid)
+                finished = tokens is not None
+                if not finished:
+                    tokens = snapshot.get(rid)
+                if handle.slot_granted_at is None and (
+                        tokens is not None or rid in staged):
+                    # First time the request holds a lane (decoding,
+                    # done, or staged mid-prefill): the queue-depth
+                    # gauge's latency companion, chunk-granular like
+                    # every harvest signal.
+                    handle.slot_granted_at = now
+                    wait = now - handle.t_submit
                     if self._metrics is not None:
-                        self._metrics.ttft.observe(now - handle.t_submit)
-                fresh = handle._push_new(tokens)
-                if fresh:
-                    events.instant("request/commit",
-                                   request_id=handle.id, tokens=fresh)
+                        self._metrics.queue_wait.observe(wait)
+                    events.instant("request/slot_granted",
+                                   request_id=handle.id, rid=rid,
+                                   wait_ms=round(wait * 1e3, 3))
+                if tokens is not None and len(tokens) > len(handle.prompt):
+                    if handle.first_token_at is None:
+                        handle.first_token_at = now
+                        if self._metrics is not None:
+                            self._metrics.ttft.observe(
+                                now - handle.t_submit)
+                    fresh = handle._push_new(tokens)
+                    if fresh:
+                        events.instant("request/commit",
+                                       request_id=handle.id, tokens=fresh)
+                        if self._metrics is not None:
+                            self._metrics.tokens.inc(fresh)
+                            if handle.last_commit_at is not None:
+                                # Commit-to-commit gap amortized over
+                                # the tokens it delivered: the stream's
+                                # per-token pace, chunk-granular.
+                                self._metrics.inter_token.observe(
+                                    (now - handle.last_commit_at) / fresh)
+                        handle.last_commit_at = now
+                if finished:
+                    del self._inflight[rid]
+                    self._count("ok")
+                    self._set_terminal(handle.id, "ok")
+                    events.instant(
+                        "request/retire", request_id=handle.id,
+                        status="ok",
+                        tokens=len(tokens) - len(handle.prompt),
+                        latency_ms=round((now - handle.t_submit) * 1e3,
+                                         3))
                     if self._metrics is not None:
-                        self._metrics.tokens.inc(fresh)
-                        if handle.last_commit_at is not None:
-                            # Commit-to-commit gap amortized over the
-                            # tokens it delivered: the stream's
-                            # per-token pace, chunk-granular.
-                            self._metrics.inter_token.observe(
-                                (now - handle.last_commit_at) / fresh)
-                    handle.last_commit_at = now
-            if finished:
-                del self._inflight[rid]
-                self._count("ok")
-                self._set_terminal(handle.id, "ok")
-                events.instant(
-                    "request/retire", request_id=handle.id, status="ok",
-                    tokens=len(tokens) - len(handle.prompt),
-                    latency_ms=round((now - handle.t_submit) * 1e3, 3))
-                if self._metrics is not None:
-                    self._metrics.latency.observe(now - handle.t_submit)
-                handle._resolve(tokens, None)
-            elif handle.deadline is not None and now >= handle.deadline:
-                self._engine.cancel(rid)
-                del self._inflight[rid]
-                self._expire(handle)
+                        self._metrics.latency.observe(
+                            now - handle.t_submit)
+                    handle._resolve(tokens, None)
+                elif (handle.deadline is not None
+                        and now >= handle.deadline):
+                    self._engine.cancel(rid)
+                    del self._inflight[rid]
+                    self._expire(handle)
 
     def _expire(self, handle: RequestHandle) -> None:
         self._count("expired")

@@ -48,6 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint.registry import thread_role
 from tensorflow_train_distributed_tpu.server.driver import (
     AdmissionFull,
     DeadlineExceeded,
@@ -129,6 +130,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ----------------------------------------------------------
 
+    @thread_role("handler")
     def do_GET(self):                           # noqa: N802
         path, _, query = self.path.partition("?")
         if path == "/healthz":
@@ -247,6 +249,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply_json(200, {"id": request_id, "status": status,
                                "timeline": timeline})
 
+    @thread_role("handler")
     def do_POST(self):                          # noqa: N802
         if self.path != "/v1/generate":
             # Body never read: close, or its bytes would be parsed as

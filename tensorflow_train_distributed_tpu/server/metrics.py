@@ -24,6 +24,10 @@ import math
 import threading
 from typing import Callable, Optional, Sequence
 
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+)
+
 # Prometheus's default latency ladder, extended to 60 s: a serving
 # deadline default lives in seconds-to-a-minute territory and a bucket
 # past it keeps the histogram's tail observable.
@@ -52,8 +56,13 @@ def _labels(pairs: dict) -> str:
     return "{" + inner + "}"
 
 
+@concurrency_guarded
 class Counter:
     """Monotonic counter, optionally split by ONE label (``status``)."""
+
+    # inc() lands from handler threads, the driver loop, and pool
+    # pumps while scrapes render — every access locks.
+    _GUARDED_BY = {"_values": ("_lock",)}
 
     def __init__(self, name: str, help_: str, label: Optional[str] = None):
         self.name, self.help, self.label = name, help_, label
@@ -111,8 +120,11 @@ class FnCounter(Counter):
                 f"{self.name} {_fmt(self.value())}"]
 
 
+@concurrency_guarded
 class Gauge:
     """Set-anytime value, or a callable sampled at scrape time."""
+
+    _GUARDED_BY = {"_value": ("_lock",)}
 
     def __init__(self, name: str, help_: str,
                  fn: Optional[Callable[[], float]] = None):
@@ -136,8 +148,14 @@ class Gauge:
                 f"{self.name} {_fmt(self.value())}"]
 
 
+@concurrency_guarded
 class Histogram:
     """Cumulative-bucket histogram (observe in seconds)."""
+
+    # The driver observes per committed chunk while scrapes render
+    # cumulative buckets: both sides lock (monotonic-bucket hammer
+    # test pins the visible invariant).
+    _GUARDED_BY = {"_counts": ("_lock",), "_sum": ("_lock",)}
 
     def __init__(self, name: str, help_: str,
                  buckets: Sequence[float] = LATENCY_BUCKETS):

@@ -57,6 +57,10 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+    thread_role,
+)
 from tensorflow_train_distributed_tpu.server.driver import (
     _DONE,
     _TERMINAL_KEEP,
@@ -87,8 +91,21 @@ _POLL_S = 0.05
 _AFFINITY_KEEP = 512
 
 
+@concurrency_guarded
 class Replica:
     """One engine + its driver + the pool-level health state."""
+
+    # The affinity LRU is read by handler threads (routing scans) while
+    # pump threads note placements — every touch locks.  The health
+    # pair is ATOMIC-PUBLISH: written exactly once, by the watchdog
+    # monitor alone (``mark_dead``), read lock-free everywhere —
+    # single-field reads are safe, and the write ORDER (reason first,
+    # flag second) guarantees a reader that saw ``dead`` also sees why.
+    _GUARDED_BY = {
+        "_affinity": ("_aff_lock",),
+        "dead": (None, "watchdog"),
+        "dead_reason": (None, "watchdog"),
+    }
 
     def __init__(self, idx: int, engine, *, max_queue: int,
                  default_timeout_s: Optional[float],
@@ -127,6 +144,16 @@ class Replica:
     def load(self) -> int:
         return self.driver.waiting() + self.driver.active_slots()
 
+    @thread_role("watchdog")
+    def mark_dead(self, reason: str) -> None:
+        """Publish the death verdict (monitor thread only).  The
+        REASON is written before the flag: readers everywhere check
+        ``dead`` first and then format ``dead_reason`` into errors and
+        health bodies lock-free, so the old flag-first order could
+        publish a death with a ``None`` explanation mid-read."""
+        self.dead_reason = reason
+        self.dead = True
+
     def note_affinity(self, key) -> None:
         if key is None:
             return
@@ -162,6 +189,7 @@ class _PoolRequest:
         self.queue_wait_seen = False
 
 
+@concurrency_guarded
 class ReplicaPool:
     """N replicas behind the ``EngineDriver`` submission surface.
 
@@ -171,6 +199,16 @@ class ReplicaPool:
     replica-blind; everything replica-aware (routing, health, failover,
     per-replica drain) lives here.
     """
+
+    # Touched by handler threads (submit/status), pump threads
+    # (_finish), and the drain path — every access locks (``_lock`` is
+    # re-entrant, so submit's nested waiting()/alive() reads are fine).
+    _GUARDED_BY = {
+        "_requests": ("_lock",),
+        "_terminal": ("_lock",),
+        "_draining": ("_lock",),
+        "_next_id": ("_lock",),
+    }
 
     def __init__(self, engines, *, max_queue: int = 64,
                  validate: Optional[Callable] = None,
@@ -301,6 +339,7 @@ class ReplicaPool:
         bs = getattr(self._replicas[0].engine, "kv_block_size", 16)
         return tuple(prompt[:bs]) if len(prompt) >= bs else None
 
+    @thread_role("handler", "main")
     def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
                stream: bool = False,
                timeout_s: Optional[float] = None) -> RequestHandle:
@@ -453,6 +492,7 @@ class ReplicaPool:
 
     # -- the per-request pump ----------------------------------------------
 
+    @thread_role("pump")
     def _pump(self, preq: _PoolRequest) -> None:
         outer = preq.handle
         requeue = False
@@ -615,6 +655,7 @@ class ReplicaPool:
 
     # -- health monitor ----------------------------------------------------
 
+    @thread_role("watchdog")
     def _monitor(self) -> None:
         while not self._stop.wait(self._monitor_poll_s):
             for rep in self._replicas:
@@ -639,8 +680,12 @@ class ReplicaPool:
                     self._declare_dead(rep, reason)
 
     def _declare_dead(self, rep: Replica, reason: str) -> None:
-        rep.dead = True
-        rep.dead_reason = reason
+        rep.mark_dead(reason)
+        # Fence the corpse: a wedged dispatch that WAKES later must
+        # not drive the device (or consume armed chaos-fault budgets)
+        # after its requests failed over — the driver loop exits at
+        # its next iteration instead of dispatching.
+        rep.driver.poison(reason)
         events.instant("replica/dead", replica=rep.idx, reason=reason)
         logger.error("replica %d declared DEAD: %s (%d alive)",
                      rep.idx, reason, self.alive_count())
@@ -674,7 +719,12 @@ class ReplicaPool:
                 continue
             rep.driver.drain()
             drained &= rep.driver.join(left())
-        for preq in list(self._requests.values()):
+        # Snapshot under the lock: pumps _finish() concurrently (del
+        # under ``_lock``) and a dict-values iteration racing those
+        # dels raises "dictionary changed size" in THIS thread.
+        with self._lock:
+            pending = list(self._requests.values())
+        for preq in pending:
             t = preq.thread
             if t is not None:
                 t.join(left())

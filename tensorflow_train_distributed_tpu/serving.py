@@ -125,6 +125,11 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from tensorflow_train_distributed_tpu.runtime import compat, events
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+    dispatch_critical,
+    thread_role,
+)
 from tensorflow_train_distributed_tpu import serving_kv
 from tensorflow_train_distributed_tpu.models.generate import (
     _decode_model,
@@ -211,6 +216,7 @@ def _bucket_len(n: int, buckets) -> int:
                      f"bucket {buckets[-1]}")
 
 
+@concurrency_guarded
 class ServingEngine:
     """Continuous-batching decoder over a fixed slot grid.
 
@@ -223,6 +229,27 @@ class ServingEngine:
     only change *when* work happens, never the math: per-slot positions
     give every request the same RoPE/mask view it would have alone.
     """
+
+    # The engine is single-threaded (the driver loop owns every
+    # mutating call) EXCEPT these cross-thread surfaces.  The prefix
+    # stores: handler threads validate while the driver LRU-touches —
+    # every access locks (the PR 6 review-pass bug, now enforced).
+    # The stats dicts: single-writer on the driver/offline loop (which
+    # reads its own writes lock-free — the owner-role exemption), but
+    # scrape-thread readers (`/metrics` FnCounters and gauges sampling
+    # ``kv_prefix_hit_tokens``/``overlap_ratio``/... at scrape time)
+    # take ``_stats_lock``, and every WRITE takes it too so a scrape
+    # between the fields of one logical update (hits vs hit_tokens;
+    # harvest_s vs overlapped_harvest_s) can no longer observe a torn
+    # pair.
+    _GUARDED_BY = {
+        "_prefix_caches": ("_prefix_lock",),
+        "_preloaded": ("_prefix_lock",),
+        "kv_stats": ("_stats_lock", "driver", "main"),
+        "prefill_stats": ("_stats_lock", "driver", "main"),
+        "overlap_stats": ("_stats_lock", "driver", "main"),
+        "spec_stats": ("_stats_lock", "driver", "main"),
+    }
 
     def __init__(self, config, params, *, slots: int = 8,
                  cache_len: Optional[int] = None, eos_id: Optional[int] = None,
@@ -470,6 +497,12 @@ class ServingEngine:
         # walks, never device work).
         import threading
         self._prefix_lock = threading.Lock()
+        # Guards the stats dicts' cross-thread consistency: writes on
+        # the driver loop are per-admission/per-step (never per-token),
+        # scrape-thread readers (`/metrics` callables) take it so a
+        # multi-field update is observed whole.  Declared in
+        # ``_GUARDED_BY`` above; ttd-lint enforces the discipline.
+        self._stats_lock = threading.Lock()
         # Paged-mode per-lane claims and admission bookkeeping:
         # _lane_kv[slot] holds the LaneKV while the lane decodes;
         # _stale_slots are lanes retired/cancelled since the last
@@ -876,6 +909,7 @@ class ServingEngine:
 
     # -- host-side loop ----------------------------------------------------
 
+    @thread_role("handler", "driver", "main")
     def validate_request(self, prompt, max_new_tokens: int,
                          seed: Optional[int] = None,
                          resume_from: int = 0) -> list:
@@ -943,6 +977,7 @@ class ServingEngine:
                     f"prefill bucket {self.prompt_buckets[-1]}")
         return prompt
 
+    @thread_role("driver", "main")
     def submit(self, prompt, max_new_tokens: int,
                seed: Optional[int] = None, resume_from: int = 0) -> int:
         """Enqueue a request; returns its id (resolved by ``run()``).
@@ -969,6 +1004,7 @@ class ServingEngine:
                        max_new=max_new_tokens)
         return rid
 
+    @thread_role("driver", "main")
     def cancel(self, request_id: int) -> bool:
         """Abandon a live request: drop it from the queue, discard its
         staged partial prefill, or free its slot so the next refill
@@ -1122,6 +1158,7 @@ class ServingEngine:
                     cache_1, padded, piece, i, m, seed, rng0)
         return cache_1, first
 
+    @thread_role("main", "driver")
     def preload_prefix(self, tokens) -> None:
         """Prefill a shared prompt prefix ONCE; every later request
         whose prompt strictly extends it prefills only the suffix.
@@ -1217,7 +1254,8 @@ class ServingEngine:
             if fresh is None:
                 evicted = self._radix.evict_for(n_new)
                 if evicted:
-                    self.kv_stats["evictions"] += evicted
+                    with self._stats_lock:
+                        self.kv_stats["evictions"] += evicted
                     events.instant("kv/evict", blocks=evicted)
                 fresh = self._kv_pool.alloc(n_new)
             if fresh is None:
@@ -1336,7 +1374,8 @@ class ServingEngine:
             if owned is None:
                 evicted = self._radix.evict_for(n_owned)
                 if evicted:
-                    self.kv_stats["evictions"] += evicted
+                    with self._stats_lock:
+                        self.kv_stats["evictions"] += evicted
                     events.instant("kv/evict", blocks=evicted)
                 owned = self._kv_pool.alloc(n_owned)
         if owned is None:
@@ -1348,12 +1387,14 @@ class ServingEngine:
             # for one waiting request.
             if self._kv_refused_rid != rid:
                 self._kv_refused_rid = rid
-                self.kv_stats["alloc_refusals"] += 1
+                with self._stats_lock:
+                    self.kv_stats["alloc_refusals"] += 1
                 events.instant("kv/refused", rid=rid, blocks=n_owned)
             return None
         if matched:
-            self.kv_stats["prefix_hits"] += 1
-            self.kv_stats["prefix_hit_tokens"] += matched
+            with self._stats_lock:
+                self.kv_stats["prefix_hits"] += 1
+                self.kv_stats["prefix_hit_tokens"] += matched
             events.instant("kv/prefix_hit", rid=rid, tokens=matched)
         return serving_kv.LaneKV(request_id=rid, matched=matched,
                                  shared=shared, owned=owned)
@@ -1400,6 +1441,7 @@ class ServingEngine:
         self._lane_kv[slot] = None
         self._stale_slots.add(slot)
 
+    @dispatch_critical
     def _flush_stale_lanes(self) -> None:
         """Zero retired/cancelled lanes' block-table rows before the
         next decode program (their freed blocks may already belong to
@@ -1463,15 +1505,21 @@ class ServingEngine:
         """Blocks currently referenced (live lanes + radix cache)."""
         return self._kv_pool.blocks_in_use() if self.paged else 0
 
+    @thread_role("handler", "driver")
     def kv_prefix_hit_tokens(self) -> int:
         """Cumulative prompt tokens whose prefill was skipped via
-        radix prefix hits (the prefill-compute-saved counter)."""
-        return self.kv_stats["prefix_hit_tokens"]
+        radix prefix hits (the prefill-compute-saved counter; the
+        `/metrics` FnCounter samples this from handler threads at
+        scrape time, so the read locks)."""
+        with self._stats_lock:
+            return self.kv_stats["prefix_hit_tokens"]
 
+    @thread_role("handler", "driver")
     def kv_evictions(self) -> int:
         """Cumulative blocks LRU-evicted from the radix cache under
-        allocation pressure."""
-        return self.kv_stats["evictions"]
+        allocation pressure (scrape-sampled: the read locks)."""
+        with self._stats_lock:
+            return self.kv_stats["evictions"]
 
     def _fill_free_slots(self):
         """ATOMIC admission — the ``prefill_budget=0`` /
@@ -1504,8 +1552,9 @@ class ServingEngine:
                         self._queue.appendleft(
                             (rid, prompt, max_new, seed, resume))
                         if prefilled and stalled:
-                            self.prefill_stats["stall_s"] += (
-                                time.perf_counter() - t0)
+                            with self._stats_lock:
+                                self.prefill_stats["stall_s"] += (
+                                    time.perf_counter() - t0)
                         return
                     table_j = self._kv_table(kv)
                     pre_len, pre_pair = self._admission_match(kv, prompt)
@@ -1585,7 +1634,8 @@ class ServingEngine:
                 self._refills.add(slot)
                 events.instant("slot/insert", rid=rid, slot=slot)
         if prefilled and stalled:
-            self.prefill_stats["stall_s"] += time.perf_counter() - t0
+            with self._stats_lock:
+                self.prefill_stats["stall_s"] += time.perf_counter() - t0
 
     # -- staged prefill (decode-priority chunked-prefill scheduling) -------
 
@@ -1634,7 +1684,8 @@ class ServingEngine:
                     padded=padded, piece=piece, n_pieces=n_pieces,
                     resume=resume, pre_pair=pre_pair, kv=kv,
                     table=table_j)
-                self.prefill_stats["staged_requests"] += 1
+                with self._stats_lock:
+                    self.prefill_stats["staged_requests"] += 1
                 break
 
     def _finalize_prefill(self, slot: int, task: _PrefillTask) -> None:
@@ -1751,7 +1802,8 @@ class ServingEngine:
         while self._staging:
             slot = next(iter(self._staging))
             spent += self._advance_piece(slot, self._staging[slot])
-            self.prefill_stats["installments"] += 1
+            with self._stats_lock:
+                self.prefill_stats["installments"] += 1
             if slot not in self._staging:
                 # Resolved or inserted: restage so a freed lane keeps
                 # the budget flowing to the next queued request.
@@ -1760,16 +1812,20 @@ class ServingEngine:
                              or spent >= self.prefill_budget):
                 break
         if decoding and not hidden:
-            self.prefill_stats["stall_s"] += time.perf_counter() - t0
+            with self._stats_lock:
+                self.prefill_stats["stall_s"] += time.perf_counter() - t0
 
+    @thread_role("handler", "driver")
     def prefill_stall_s(self) -> float:
         """Cumulative seconds decode lanes spent blocked behind
         admission prefill (wall time of prefill work run while >= 1
         lane was decoding with no successor chunk in flight to hide
         it).  Grows with every long admission on the atomic path;
         collapses to ~0 with interleaving on.  The gateway exposes it
-        as ``ttd_engine_prefill_stall_seconds``."""
-        return self.prefill_stats["stall_s"]
+        as ``ttd_engine_prefill_stall_seconds`` — scraped from handler
+        threads, so the read locks."""
+        with self._stats_lock:
+            return self.prefill_stats["stall_s"]
 
     def _consume(self, state, tokens) -> None:
         """Append generated tokens to a slot's request, enforcing the
@@ -1820,17 +1876,19 @@ class ServingEngine:
         ``next_tok`` after consuming.  ``rids``: the overlap trim
         guard, same rule as ``_harvest``."""
         del next_tok  # == emit[slot, emitted-1], consumed above
-        self.spec_stats["rounds"] += 1     # engine rounds, not slot-rounds
+        with self._stats_lock:
+            self.spec_stats["rounds"] += 1  # engine, not slot-rounds
         for slot, state in enumerate(self._slot_states):
             if state is None:
                 continue
             if rids is not None and state.request_id != rids[slot]:
                 continue
             before = len(state.tokens)
-            self.spec_stats["slot_rounds"] += 1
-            self.spec_stats["drafted_accepted"] += int(accepted[slot])
             self._consume(state, emit[slot, :int(emitted[slot])])
-            self.spec_stats["emitted"] += len(state.tokens) - before
+            with self._stats_lock:
+                self.spec_stats["slot_rounds"] += 1
+                self.spec_stats["drafted_accepted"] += int(accepted[slot])
+                self.spec_stats["emitted"] += len(state.tokens) - before
             self._retire_if_done(slot, state)
 
     def pending(self) -> int:
@@ -1858,6 +1916,7 @@ class ServingEngine:
 
     # -- async decode pipelining (one-chunk lookahead) ---------------------
 
+    @dispatch_critical
     def _carry_arrays(self):
         """The next dispatch's (tok, counts): the device-resident carry
         from the previous chunk, with host values spliced in for slots
@@ -1892,6 +1951,7 @@ class ServingEngine:
             self._refills.clear()
         return tok, counts
 
+    @dispatch_critical
     def _dispatch_chunk(self) -> None:
         """Enqueue one decode chunk (or speculative round) for ALL
         slots from the device-resident carry.  No host sync: the call
@@ -1934,8 +1994,10 @@ class ServingEngine:
                 self._carry = (last, counts_next)
                 self._inflight = {"spec": False, "rids": rids,
                                   "toks": toks}
-        self.overlap_stats["chunks"] += 1
+        with self._stats_lock:
+            self.overlap_stats["chunks"] += 1
 
+    @dispatch_critical
     def _skip_eager_dispatch(self) -> bool:
         """Whether to fall back to harvest-first for this one step:
         when EVERY active slot certainly retires in the in-flight chunk
@@ -1987,11 +2049,13 @@ class ServingEngine:
             else:
                 self._harvest(toks, rids=rids)
         dt = time.perf_counter() - t0
-        self.overlap_stats["harvest_s"] += dt
-        if overlapped:
-            self.overlap_stats["overlapped_harvests"] += 1
-            self.overlap_stats["overlapped_harvest_s"] += dt
+        with self._stats_lock:
+            self.overlap_stats["harvest_s"] += dt
+            if overlapped:
+                self.overlap_stats["overlapped_harvests"] += 1
+                self.overlap_stats["overlapped_harvest_s"] += dt
 
+    @thread_role("handler", "driver")
     def overlap_ratio(self) -> float:
         """Fraction of host harvest wall time spent with a successor
         chunk concurrently in flight — the host-stall share the
@@ -1999,15 +2063,18 @@ class ServingEngine:
         The gateway exposes it as ``ttd_engine_overlap_ratio``.
 
         Scraped from the gateway's metrics thread while the driver
-        harvests: ``_harvest_prev`` bumps the denominator BEFORE the
-        numerator, so reading numerator first (plus the clamp) keeps a
-        torn read inside the documented [0, 1]."""
-        num = self.overlap_stats["overlapped_harvest_s"]
-        total = self.overlap_stats["harvest_s"]
+        harvests: the pair is read under ``_stats_lock`` (and the
+        writer updates both fields under it), so a scrape can no
+        longer land between the denominator and numerator bumps and
+        report a torn ratio."""
+        with self._stats_lock:
+            num = self.overlap_stats["overlapped_harvest_s"]
+            total = self.overlap_stats["harvest_s"]
         if total <= 0.0:
             return 0.0
         return min(1.0, num / total)
 
+    @thread_role("driver", "main")
     def serve_step(self) -> dict:
         """ONE service iteration: refill free slots from the queue, run
         one decode chunk, harvest — then hand control back, so callers
@@ -2155,6 +2222,7 @@ class ServingEngine:
         out, self._outputs = self._outputs, {}
         return out
 
+    @thread_role("main", "driver")
     def run(self) -> dict:
         """Serve every submitted request to completion; returns
         ``{request_id: [prompt + generated tokens]}``.  (A loop over

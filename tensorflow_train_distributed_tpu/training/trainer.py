@@ -30,6 +30,9 @@ import numpy as np
 import optax
 
 from tensorflow_train_distributed_tpu.runtime import compat, events, faults
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    thread_role,
+)
 from tensorflow_train_distributed_tpu.parallel import collectives
 from tensorflow_train_distributed_tpu.parallel import sharding as sharding_lib
 from tensorflow_train_distributed_tpu.parallel.sharding import (
@@ -506,6 +509,7 @@ class Trainer:
                     return
             yield jax.tree.map(lambda *xs: np.stack(xs), *group)
 
+    @thread_role("trainer")
     def fit(
         self,
         batches: Iterable[Mapping[str, np.ndarray]],

@@ -18,6 +18,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # still set for child processes we fork
 # unless the caller asked for them.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
+# Arm the runtime lock-order sanitizer for the WHOLE tier-1 suite:
+# every gateway/replica/chaos test doubles as a race test — package
+# locks get acquisition-order cycle detection and ``_GUARDED_BY``
+# attributes get live access guards (see runtime/lint/lockcheck.py;
+# measured overhead bar pinned in tests/test_lockcheck.py).  Must run
+# BEFORE any package module is imported: locks are instrumented at
+# creation and guard descriptors install at class-decoration time.
+# ``TTD_NO_LOCKCHECK=1`` is the escape hatch (honored by armed()).
+os.environ.setdefault("TTD_LOCKCHECK", "1")
+from tensorflow_train_distributed_tpu.runtime.lint import lockcheck  # noqa: E402
+
+lockcheck.install()
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,8 @@
+"""Seeded-mutation fixtures for ttd-lint's own tests.
+
+Every module here PLANTS exactly the bug one checker exists to catch;
+tests/test_ttd_lint.py runs each checker over its fixture and asserts
+the planted finding is flagged — so deleting or breaking a checker
+fails its fixture test (the linter is itself mutation-tested).  The
+directory is excluded from real-tree lint runs (core._SKIP_DIRS).
+"""

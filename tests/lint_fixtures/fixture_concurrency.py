@@ -1,0 +1,107 @@
+"""Planted concurrency bugs (see lint_fixtures/__init__.py).
+
+Never imported by product code; the decorators are stub-declared so
+the module stays import-free for the AST checker.
+"""
+
+
+def thread_role(*roles):                    # AST-matched by name
+    def deco(fn):
+        return fn
+    return deco
+
+
+def locks_held(*locks):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class BuggyDriver:
+    """Every method below plants one distinct concurrency finding."""
+
+    _GUARDED_BY = {
+        "_inflight": ("_cv",),
+        "stats": ("_lock", "driver"),
+        "dead": (None, "watchdog"),
+    }
+
+    def __init__(self):
+        import threading
+        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self.stats = {"n": 0}
+        self.dead = False
+
+    @thread_role("driver")
+    def loop(self):
+        with self._cv:
+            self._admit()
+        self.harvest()                      # propagates driver role
+
+    @locks_held("_cv")
+    def _admit(self):
+        self._inflight[0] = 1               # OK: declared locks_held
+
+    def harvest(self):
+        # PLANTED: the PR-6/7 bug class — the owner loop mutates a
+        # cv-guarded map lock-free while locked readers iterate.
+        del self._inflight[0]               # finding: write w/o _cv
+
+    @thread_role("handler")
+    def status(self):
+        return list(self._inflight.values())    # finding: read w/o _cv
+
+    @thread_role("handler")
+    def scrape(self):
+        return self.stats["n"]              # finding: non-owner read
+
+    @thread_role("driver")
+    def bump(self):
+        self.stats["n"] += 1                # finding: write w/o _lock
+
+    @thread_role("pump")
+    def kill(self):
+        self.dead = True                    # finding: non-owner write
+
+    def rogue(self):
+        self._admit()                       # finding: locks_held callee
+
+
+class CleanDriver:
+    """The same shapes done right: must produce ZERO findings (the
+    checker's false-positive guard)."""
+
+    _GUARDED_BY = {
+        "_inflight": ("_cv",),
+        "stats": ("_lock", "driver"),
+    }
+
+    def __init__(self):
+        import threading
+        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self.stats = {"n": 0}
+
+    @thread_role("driver")
+    def loop(self):
+        with self._cv:
+            self._admit()
+            del self._inflight[0]
+        self.tally()
+
+    @locks_held("_cv")
+    def _admit(self):
+        self._inflight[0] = 1
+
+    def tally(self):
+        n = self.stats["n"]                 # driver-role read: exempt
+        with self._lock:
+            self.stats["n"] = n + 1
+
+    @thread_role("handler")
+    def scrape(self):
+        with self._lock:
+            return self.stats["n"]

@@ -1,0 +1,12 @@
+"""Planted kill-switch audit gap (see __init__.py): an env flag that
+is deliberately absent from README.md — the checker must flag it.
+(It IS referenced under tests/, so only the documentation finding
+fires; the coverage finding is pinned with a name referenced nowhere
+else at all.)"""
+
+import os
+
+
+def fixture_killed() -> bool:
+    # PLANTED: never documented in README.
+    return os.environ.get("TTD_FIXTURE_UNDOCUMENTED", "0") != "0"

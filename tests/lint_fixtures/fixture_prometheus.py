@@ -1,0 +1,22 @@
+"""Planted Prometheus-convention violations (see __init__.py)."""
+
+
+class _Registry:
+    def counter(self, name, help_):
+        return name
+
+    def histogram(self, name, help_):
+        return name
+
+    def gauge(self, name, help_):
+        return name
+
+
+def build(r: _Registry):
+    # PLANTED: a counter without _total, a histogram without _seconds,
+    # and a ttd_ gauge README never documents.
+    bad_counter = r.counter("ttd_fixture_requests", "no _total")
+    bad_histogram = r.histogram("ttd_fixture_latency_ms", "not seconds")
+    undocumented = r.gauge("ttd_fixture_mystery_gauge", "no README entry")
+    ok = r.counter("ttd_gateway_requests_total", "fine (documented)")
+    return bad_counter, bad_histogram, undocumented, ok

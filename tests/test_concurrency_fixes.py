@@ -1,0 +1,237 @@
+"""Regression tests for the races ttd-lint surfaced on the real tree
+(ISSUE 9 satellite: every real finding gets a fix + a pinning test).
+
+1. ``EngineDriver._harvest`` used to del from ``_inflight`` lock-free
+   ("driver thread only") while ``request_status`` iterated it under
+   ``_cv`` from handler threads — a dict resized mid-iteration raises
+   in the reader.  Fixed: the harvest pass holds ``_cv``.
+2. ``ReplicaPool.join`` used to iterate ``_requests.values()``
+   lock-free while pump ``_finish`` deleted entries under ``_lock`` —
+   same crash shape, in the drain path.  Fixed: snapshot under the
+   lock.
+3. ``Replica`` death was published flag-first: a reader could observe
+   ``dead=True`` with ``dead_reason`` still ``None``.  Fixed:
+   ``mark_dead`` writes the reason BEFORE the flag.
+4. Engine scrape accessors (the `/metrics` FnCounter/gauge sources)
+   read the stats dicts bare while the driver updated multi-field
+   groups.  Fixed: writers and scrape readers share ``_stats_lock``,
+   so a scrape blocks until a mid-flight update completes.
+
+Tests 1-2 are DETERMINISTIC, not probabilistic hammers: the guarded
+dict is swapped for a subclass that asserts the owning lock is held on
+every iteration and mutation (the sanitizer's instrumented locks
+expose ``held_by_current``), so ANY lock-free access anywhere in the
+exercised paths fails the test on the spot — running the pre-fix
+``_harvest``/``join`` under this probe fails immediately.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_gateway import StubEngine
+
+from tensorflow_train_distributed_tpu.runtime import events
+
+
+@pytest.fixture(autouse=True)
+def _recorder_hygiene():
+    """These tests flood the process-global flight recorder with
+    hundreds of request lifecycles; clear it afterward so later tests'
+    request timelines cannot join this module's ids."""
+    yield
+    events.get_recorder().clear()
+
+from tensorflow_train_distributed_tpu.server.driver import EngineDriver
+from tensorflow_train_distributed_tpu.server.replicas import (
+    Replica,
+    ReplicaPool,
+)
+
+
+class _LockAssertingDict(dict):
+    """Every iteration/mutation must happen with the declared lock
+    held — the runtime embodiment of the ``_GUARDED_BY`` contract."""
+
+    def __init__(self, held_fn):
+        super().__init__()
+        self._held = held_fn
+        self.violations = []
+
+    def _chk(self):
+        if not self._held():
+            self.violations.append("".join(
+                __import__("traceback").format_stack(limit=6)))
+
+    def items(self):
+        self._chk()
+        return super().items()
+
+    def values(self):
+        self._chk()
+        return super().values()
+
+    def __setitem__(self, k, v):
+        self._chk()
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._chk()
+        super().__delitem__(k)
+
+
+def test_harvest_and_status_hold_cv_on_every_inflight_access():
+    """Pre-fix, ``_harvest`` iterated and deleted from ``_inflight``
+    lock-free while handler threads iterated under ``_cv`` (reader
+    crash: dict resized mid-iteration).  The probe dict proves every
+    access — driver loop AND status polls — now holds the lock, for a
+    full 400-request serve with concurrent pollers."""
+    drv = EngineDriver(StubEngine(slots=8), max_queue=4096)
+    if not hasattr(drv._cv._lock, "held_by_current"):
+        pytest.skip("lock sanitizer disarmed (TTD_NO_LOCKCHECK)")
+    probe = _LockAssertingDict(drv._cv._lock.held_by_current)
+    drv._inflight = probe
+    drv.start()
+    errs = []
+    stop = threading.Event()
+
+    def poller():
+        try:
+            i = 0
+            while not stop.is_set():
+                drv.request_status(i % 400)
+                i += 1
+        except BaseException as e:          # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=poller) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        handles = [drv.submit([1], 3) for _ in range(400)]
+        for h in handles:
+            h.result(timeout=60)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        drv.join(timeout=10)
+    assert errs == []
+    assert probe.violations == [], probe.violations[0]
+    assert drv.request_status(handles[0].id) == "ok"
+
+
+def test_pool_requests_map_locked_through_submit_serve_drain():
+    """Pre-fix, ``join()`` iterated ``_requests.values()`` lock-free
+    while pump ``_finish`` deleted under ``_lock``.  The probe dict
+    proves every access across the pool's whole lifecycle — admission,
+    pumping, status polls, and the drain snapshot — holds the lock."""
+    pool = ReplicaPool([StubEngine(slots=4), StubEngine(slots=4)],
+                       max_queue=1024, watchdog_timeout_s=None)
+    if not hasattr(pool._lock, "held_by_current"):
+        pytest.skip("lock sanitizer disarmed (TTD_NO_LOCKCHECK)")
+    probe = _LockAssertingDict(pool._lock.held_by_current)
+    pool._requests = probe
+    pool.start()
+    handles = [pool.submit([1], 2, stream=True) for _ in range(200)]
+    # Join immediately: requests are mid-flight, pumps finishing.
+    assert pool.join(timeout=60)
+    for h in handles:
+        assert h.result(timeout=1)[-1] == 3     # 1 +1 +1 (mod 997)
+    assert probe.violations == [], probe.violations[0]
+    assert pool.request_status(handles[-1].id) == "ok"
+
+
+def test_mark_dead_publishes_reason_before_flag():
+    order = []
+
+    class Recording(Replica):
+        def __setattr__(self, name, value):
+            if name in ("dead", "dead_reason") and value:
+                order.append(name)
+            super().__setattr__(name, value)
+
+    rep = Recording(0, StubEngine(), max_queue=4,
+                    default_timeout_s=None, retry_after_s=1.0)
+    rep.mark_dead("watchdog: wedged")
+    assert order == ["dead_reason", "dead"]
+    assert rep.dead and rep.dead_reason == "watchdog: wedged"
+    assert rep.state() == "dead"
+
+
+def test_scrape_accessor_blocks_until_multi_field_update_completes():
+    """The FnCounter-vs-driver fix, deterministically: a scrape that
+    lands mid-update (writer holds ``_stats_lock`` across the paired
+    fields) returns only AFTER the update completes, never a torn
+    half."""
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    eng = ServingEngine.__new__(ServingEngine)      # no model needed
+    eng._stats_lock = threading.Lock()
+    eng.kv_stats = {"prefix_hits": 0, "prefix_hit_tokens": 0,
+                    "evictions": 0, "alloc_refusals": 0}
+    eng.overlap_stats = {"chunks": 0, "overlapped_harvests": 0,
+                         "harvest_s": 0.0, "overlapped_harvest_s": 0.0}
+    in_update = threading.Event()
+
+    def writer():
+        with eng._stats_lock:               # one logical update
+            eng.kv_stats["prefix_hits"] += 1
+            in_update.set()
+            time.sleep(0.2)                 # scrape lands right here
+            eng.kv_stats["prefix_hit_tokens"] += 96
+    t = threading.Thread(target=writer)
+    t.start()
+    assert in_update.wait(5)
+    t0 = time.monotonic()
+    tokens = eng.kv_prefix_hit_tokens()     # the scrape path
+    waited = time.monotonic() - t0
+    t.join()
+    assert tokens == 96, "scrape observed a torn half-update"
+    assert waited > 0.1, "scrape did not wait for the in-flight update"
+    # And the pair-locked ratio reader: both fields under one hold.
+    assert eng.overlap_ratio() == 0.0
+
+
+def test_scrape_counters_monotonic_under_hammer():
+    """Concurrent locked writers + scrape readers: sampled values are
+    non-decreasing (the Prometheus counter contract FnCounter renders
+    from these sources)."""
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    eng = ServingEngine.__new__(ServingEngine)
+    eng._stats_lock = threading.Lock()
+    eng.kv_stats = {"prefix_hits": 0, "prefix_hit_tokens": 0,
+                    "evictions": 0, "alloc_refusals": 0}
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        while not stop.is_set():
+            with eng._stats_lock:
+                eng.kv_stats["prefix_hits"] += 1
+                eng.kv_stats["prefix_hit_tokens"] += 16
+                eng.kv_stats["evictions"] += 1
+
+    def reader():
+        last_tok = last_ev = 0
+        try:
+            for _ in range(4000):
+                tok = eng.kv_prefix_hit_tokens()
+                ev = eng.kv_evictions()
+                assert tok >= last_tok and ev >= last_ev
+                last_tok, last_ev = tok, ev
+        except BaseException as e:          # noqa: BLE001
+            errs.append(e)
+
+    w = threading.Thread(target=writer)
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    w.start()
+    for r in readers:
+        r.start()
+    for r in readers:
+        r.join(timeout=60)
+    stop.set()
+    w.join(timeout=10)
+    assert errs == []

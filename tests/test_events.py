@@ -127,6 +127,41 @@ def test_request_timeline_joins_latest_life_only():
                      "slot/insert", "request/commit", "request/retire"]
 
 
+def test_request_timeline_stale_pool_anchor_never_captures_solo_life():
+    """Ids collide across serving sessions in one process (driver ids
+    restart; the recorder is global): a NEWER standalone-driver
+    request must anchor on its own admission, not join a stale pool
+    request's events — and a pool request's own per-life re-admissions
+    (tagged with their replica) must never displace the pool anchor."""
+    rec = Recorder(capacity=64)
+    # Old pool request id 3 (a finished replica-pool session).
+    rec.instant("request/pool_admitted", request_id=3)
+    rec.instant("request/admitted", request_id=3, replica=0)
+    rec.instant("request/engine_submit", request_id=3, rid=0, replica=0)
+    rec.instant("request/commit", request_id=3, tokens=2, replica=0)
+    rec.instant("request/pool_retire", request_id=3, status="ok")
+    # Newer SINGLE-DRIVER session reuses id 3.
+    rec.instant("request/admitted", request_id=3)
+    rec.instant("request/engine_submit", request_id=3, rid=9)
+    rec.instant("request/commit", request_id=3, tokens=1)
+    rec.instant("request/retire", request_id=3, status="ok")
+    names = [e[0] for e in rec.request_timeline(3)]
+    assert names == ["request/admitted", "request/engine_submit",
+                     "request/commit", "request/retire"]
+    # The converse: a pool life whose per-life (replica-tagged)
+    # admissions come after pool_admitted keeps the POOL anchor.
+    rec2 = Recorder(capacity=64)
+    rec2.instant("request/pool_admitted", request_id=5)
+    rec2.instant("request/admitted", request_id=5, replica=1)
+    rec2.instant("request/failover", request_id=5, from_replica=1,
+                 resumed_at=2, reason="dead")
+    rec2.instant("request/admitted", request_id=5, replica=0)
+    rec2.instant("request/pool_retire", request_id=5, status="ok")
+    names = [e[0] for e in rec2.request_timeline(5)]
+    assert names[0] == "request/pool_admitted"
+    assert names.count("request/admitted") == 2
+
+
 def test_concurrent_appends_and_reads_are_safe():
     rec = Recorder(capacity=1024)
     stop = threading.Event()

@@ -196,6 +196,36 @@ def test_failover_on_hung_dispatch_watchdog():
         pool.join(timeout=10)
 
 
+def test_dead_replica_driver_is_fenced_after_wake():
+    """A hung dispatch that WAKES after the watchdog declared its
+    replica dead must not dispatch again: the pool poisons the driver
+    at declaration, so the woken loop exits instead of working its
+    stale backlog — a zombie driving the device (or consuming a
+    later-armed chaos-fault budget, the flake this regression pins)
+    corrupts whoever took over."""
+    faults.arm("serve:dispatch:2:hang:replica=0:hang_s=1.5")
+    pool = _stub_pool(2, watchdog_timeout_s=0.3)
+    try:
+        hs = [pool.submit([3 + i], 20) for i in range(4)]
+        for i, h in enumerate(hs):
+            assert h.result(timeout=30) == StubEngine.expected(
+                [3 + i], 20), i
+        dead = [r for r in pool.replicas if r.dead]
+        assert len(dead) == 1 and dead[0].idx == 0
+        drv = dead[0].driver
+        # The wedged thread wakes from the hang and must EXIT —
+        # unfenced it would decode its whole failed-over backlog and
+        # then wait on the condition forever (this join times out).
+        drv._thread.join(timeout=10)
+        assert not drv._thread.is_alive()
+        # ...without completing more than the step it was wedged in
+        # (unfenced, the backlog adds dozens of completed steps).
+        assert drv.steps_completed() <= 3, drv.steps_completed()
+    finally:
+        faults.disarm()
+        pool.join(timeout=10)
+
+
 def test_unscoped_serve_fault_fires_on_every_replica():
     """A serve:dispatch entry WITHOUT replica= kills every driver —
     each has its own fire budget (N drivers must not race one shared

@@ -1,0 +1,235 @@
+"""ttd-lint: the static analyzer's own suite.
+
+Three layers:
+
+- **tier-1 gate**: the whole package + tools must lint CLEAN — a new
+  unguarded access, undocumented kill switch, or misnamed metric fails
+  the suite, not a review pass;
+- **seeded mutation**: every checker is run over a fixture module with
+  that checker's bug class deliberately planted
+  (tests/lint_fixtures/) and must flag each plant — delete or break a
+  checker and its fixture test fails, so the linter itself is
+  mutation-tested;
+- **mechanics**: suppression format, spec validation, CLI exit codes.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from tensorflow_train_distributed_tpu.runtime.lint import run_lint
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    locks_held,
+    thread_role,
+)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def _messages(findings):
+    return [f"{f.line}:{f.message}" for f in findings]
+
+
+# ── tier-1 gate ────────────────────────────────────────────────────────
+
+
+def test_whole_tree_lints_clean():
+    """Package + tools, every checker, zero findings — the enforced
+    discipline the ISSUE's motivation demands (suppressions are visible
+    greppable exceptions, not absences)."""
+    findings = run_lint(root=ROOT)
+    assert findings == [], "\n" + "\n".join(
+        f.format(root=ROOT) for f in findings)
+
+
+# ── seeded mutation: concurrency ───────────────────────────────────────
+
+
+def test_concurrency_fixture_every_plant_flagged():
+    path = os.path.join(FIXTURES, "fixture_concurrency.py")
+    findings = run_lint(paths=[path], checkers=["concurrency"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    # One finding per planted bug, attributed to the right method.
+    assert "BuggyDriver.harvest: write to '_inflight'" in msgs
+    assert "BuggyDriver.status: read of '_inflight'" in msgs
+    assert "BuggyDriver.scrape: read of 'stats'" in msgs
+    assert "BuggyDriver.bump: write to 'stats'" in msgs
+    assert "BuggyDriver.kill: write to atomic-publish attribute 'dead'" \
+        in msgs
+    assert "BuggyDriver.rogue calls _admit()" in msgs
+    assert len(findings) == 6
+    # The well-behaved twin stays silent (false-positive guard): the
+    # driver-role lock-free READ of an owner-exempt attr, the
+    # locks_held call under the with, and locked access all pass.
+    assert "CleanDriver" not in msgs
+
+
+def test_concurrency_checker_validates_guard_specs(tmp_path):
+    bad = tmp_path / "bad_spec.py"
+    bad.write_text(
+        "class C:\n"
+        "    _GUARDED_BY = {'x': (None,)}\n"
+        "    def __init__(self):\n"
+        "        self.x = 1\n")
+    findings = run_lint(paths=[str(bad)], checkers=["concurrency"],
+                        root=ROOT)
+    assert any("needs an owner role" in f.message for f in findings)
+
+
+def test_concurrency_checker_flags_typod_lock_name(tmp_path):
+    bad = tmp_path / "typo_lock.py"
+    bad.write_text(
+        "class C:\n"
+        "    _GUARDED_BY = {'x': ('_lok',)}\n"
+        "    def __init__(self):\n"
+        "        import threading\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self.x\n")
+    findings = run_lint(paths=[str(bad)], checkers=["concurrency"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    # Both symptoms surface: the declared lock never exists, and the
+    # with-block therefore never matches.
+    assert "never assigned on self" in msgs
+    assert "read of 'x' without holding self._lok" in msgs
+
+
+# ── seeded mutation: dispatch purity ───────────────────────────────────
+
+
+def test_dispatch_fixture_every_plant_flagged():
+    path = os.path.join(FIXTURES, "fixture_dispatch.py")
+    findings = run_lint(paths=[path], checkers=["dispatch"], root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "block_until_ready() host sync" in msgs
+    assert "float() on a non-constant" in msgs
+    assert "os.environ.get(): slow env read" in msgs
+    assert "time.time(): wall clock" in msgs
+    assert "time.monotonic(): Python-time clock" in msgs
+    assert "np.random.rand(): Python-time randomness" in msgs
+    assert "print(): host side effect" in msgs
+    assert ".item() device-value materialization" in msgs
+    assert "static_argnums position 0" in msgs
+    assert len(findings) == 9
+
+
+# ── seeded mutation: kill switches ─────────────────────────────────────
+
+
+def test_flags_fixture_undocumented_var_flagged():
+    path = os.path.join(FIXTURES, "fixture_flags.py")
+    findings = run_lint(paths=[path], checkers=["kill-switch"],
+                        root=ROOT)
+    assert any("TTD_FIXTURE_UNDOCUMENTED is not documented"
+               in f.message for f in findings)
+
+
+def test_flags_checker_requires_test_coverage(tmp_path):
+    # Assembled so THIS file's source never contains the flag name —
+    # the tests corpus includes this very test, and a literal would
+    # satisfy the coverage rule by accident.
+    var = "TTD_NEVER_" + "EXERCISED_ANYWHERE"
+    mod = tmp_path / "flagged.py"
+    mod.write_text(f"import os\nV = os.environ.get({var!r})\n")
+    findings = run_lint(paths=[str(mod)], checkers=["kill-switch"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "is not exercised by any test" in msgs
+    assert "is not documented in README" in msgs
+
+
+def test_flags_family_glob_satisfies_documentation(tmp_path):
+    # TTD_K8S_COORDINATOR is documented via README's family entry (or
+    # exact name); either way the checker accepts it and only coverage
+    # matters — pin the family-matching rule directly.
+    from tensorflow_train_distributed_tpu.runtime.lint.flags import (
+        _family_documented,
+    )
+    assert _family_documented("TTD_K8S_COORDINATOR",
+                              "docs: `TTD_K8S_*` family")
+    assert not _family_documented("TTD_OTHER_THING",
+                                  "docs: `TTD_K8S_*` family")
+
+
+# ── seeded mutation: prometheus conventions ────────────────────────────
+
+
+def test_prometheus_fixture_every_plant_flagged():
+    path = os.path.join(FIXTURES, "fixture_prometheus.py")
+    findings = run_lint(paths=[path], checkers=["prometheus"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "counter 'ttd_fixture_requests' must end in _total" in msgs
+    assert ("histogram 'ttd_fixture_latency_ms' must end in _seconds"
+            in msgs)
+    assert ("metric 'ttd_fixture_mystery_gauge' missing from README"
+            in msgs)
+    # ttd_fixture_requests / _latency_ms also miss README (they are
+    # fixtures) — but the documented real name must NOT be flagged.
+    assert "ttd_gateway_requests_total" not in msgs
+
+
+# ── mechanics ──────────────────────────────────────────────────────────
+
+
+def test_suppression_format_silences_exactly_the_named_checker(tmp_path):
+    mod = tmp_path / "suppressed.py"
+    mod.write_text(
+        "class R:\n"
+        "    def counter(self, n, h):\n"
+        "        return n\n"
+        "r = R()\n"
+        "a = r.counter('bad_name', 'x')"
+        "  # ttd-lint: disable=prometheus\n"
+        "b = r.counter('also_bad', 'x')\n")
+    findings = run_lint(paths=[str(mod)], checkers=["prometheus"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "also_bad" in msgs
+    assert "bad_name" not in msgs
+
+
+def test_registry_rejects_unknown_roles_and_empty_locks():
+    with pytest.raises(ValueError, match="unknown thread role"):
+        thread_role("not_a_role")
+    with pytest.raises(ValueError):
+        thread_role()
+    with pytest.raises(ValueError):
+        locks_held()
+
+
+def test_thread_role_preserves_signature_for_resume_detection():
+    """EngineDriver sniffs resume_from support via inspect.signature;
+    the decorator must stay transparent to it."""
+    import inspect
+
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    sig = inspect.signature(ServingEngine.validate_request)
+    assert "resume_from" in sig.parameters
+    sig = inspect.signature(ServingEngine.submit)
+    assert "resume_from" in sig.parameters
+
+
+def test_cli_runs_and_exits_nonzero_on_findings(capsys):
+    spec = importlib.util.spec_from_file_location(
+        "ttd_lint_cli", os.path.join(ROOT, "tools", "ttd_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("concurrency", "dispatch", "kill-switch", "prometheus"):
+        assert name in out
+    # Fixture file: findings -> exit 1, formatted path:line output.
+    rc = mod.main(["--checker", "prometheus",
+                   os.path.join(FIXTURES, "fixture_prometheus.py")])
+    assert rc == 1
+    assert "fixture_prometheus.py" in capsys.readouterr().out
+    # Unknown checker -> usage error.
+    assert mod.main(["--checker", "nope"]) == 2

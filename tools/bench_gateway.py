@@ -43,6 +43,15 @@ import urllib.request
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (  # noqa: E402
+    thread_role,
+)
+
+# Load-generation threads carry the ``loadgen`` role so the runtime
+# lock sanitizer (and the flight recorder's forensics) can tell bench
+# traffic from the gateway's own handler threads.
+_loadgen_role = thread_role("loadgen")
+
 
 def _requests_for(client: int, n: int, plo, phi, glo, ghi, vocab, seed):
     import numpy as np
@@ -83,6 +92,7 @@ class _Client(threading.Thread):
         self.latencies, self.gen_tokens = [], 0
         self.sheds = self.failures = 0
 
+    @_loadgen_role
     def run(self):
         for prompt, max_new in self.reqs:
             body = {"prompt": prompt, "max_new": max_new}
@@ -213,6 +223,7 @@ class _StreamLane(threading.Thread):
         self.first_token_at = None
         self.error = None
 
+    @_loadgen_role
     def run(self):
         req = urllib.request.Request(
             self.base_url + "/v1/generate",

@@ -153,14 +153,21 @@ def run_chaos_check(workdir: str, *, steps: int = 8,
 
 
 def run_serving_chaos(*, sampling: bool = True, n_requests: int = 8,
-                      kill_dispatch: int = 4,
+                      kill_dispatch: int = 3,
                       watchdog_timeout_s: float = 10.0,
                       timeout_s: float = 120.0) -> dict:
     """Kill one of two gateway replicas mid-stream under load; every
     accepted request must complete on the survivor with tokens EQUAL
     to an uninterrupted single-replica run.  In-process (the kill9
     serve fault is an abrupt replica-thread vanish — a true SIGKILL
-    would take both replicas).  Returns ``{"ok", "checks", ...}``."""
+    would take both replicas).  Returns ``{"ok", "checks", ...}``.
+
+    ``kill_dispatch`` must stay within replica 0's GUARANTEED dispatch
+    count under the worst placement skew: with a small ``n_requests``
+    its share can be one short request (~3 serve_steps: staged
+    prefill + two decode chunks), so an ordinal past 3 can simply
+    never fire and the run reports no-death/no-failover instead of
+    chaos parity."""
     import json as _json
     import threading
     import urllib.request

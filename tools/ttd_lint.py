@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""ttd-lint CLI: static concurrency/purity/conventions analysis.
+
+Usage::
+
+    python -m tools.ttd_lint                  # whole package + tools
+    python -m tools.ttd_lint --checker concurrency path/to/file.py
+    python -m tools.ttd_lint --list
+
+Exit status: 0 clean, 1 findings, 2 usage error.  The tier-1 test
+(tests/test_ttd_lint.py) runs the same entry over the whole tree and
+asserts zero findings — run this locally before pushing anything that
+touches locks, thread roles, ``TTD_*`` flags, or metric names.
+
+Suppress a deliberate exception with ``# ttd-lint:
+disable=<checker>`` on the offending line (one shared format across
+all checkers); the suppression is greppable documentation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # Keep the analyzers importable from a bare checkout.
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tensorflow_train_distributed_tpu.runtime.lint import core
+
+    core._load_checkers()
+    parser = argparse.ArgumentParser(
+        prog="ttd_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the package "
+                             "and tools/)")
+    parser.add_argument("--checker", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this checker (repeatable); "
+                             "default: all")
+    parser.add_argument("--list", action="store_true",
+                        help="list known checkers and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in sorted(core.CHECKERS):
+            print(name)
+        return 0
+    try:
+        findings = core.run_lint(paths=args.paths or None,
+                                 checkers=args.checker, root=repo)
+    except ValueError as e:
+        print(f"ttd_lint: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format(root=repo))
+    if findings:
+        print(f"ttd_lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
