@@ -31,6 +31,9 @@ from tensorflow_train_distributed_tpu.models.quant import (
     maybe_quant_variables,
     quantized_inference,
 )
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    compile_site,
+)
 
 
 def _decode_model(config, cache_len: int, slot_decode: bool = False,
@@ -197,6 +200,13 @@ def generate(config: LlamaConfig, params, prompt: jax.Array,
                      quant_scales)
 
 
+@compile_site(buckets="exact (offline batch API: one compile per "
+                      "prompt/output shape is the documented contract "
+                      "— the serving engine is the bucketed path)",
+              donates=(), statics=(),
+              static_names=("config", "max_new_tokens", "greedy",
+                            "top_k", "use_top_p"),
+              max_compiles=None)
 @partial(jax.jit, static_argnames=("config", "max_new_tokens", "greedy",
                                    "top_k", "use_top_p"))
 def _generate(config: LlamaConfig, max_new_tokens: int, greedy: bool,

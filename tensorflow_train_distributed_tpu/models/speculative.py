@@ -36,6 +36,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    compile_site,
+)
 from tensorflow_train_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaModel,
@@ -232,6 +235,13 @@ def generate_speculative(target_config: LlamaConfig, target_params,
     return out, stats
 
 
+@compile_site(buckets="exact (offline batch API: one compile per "
+                      "prompt shape / sampling config)",
+              donates=(), statics=(),
+              static_names=("target_config", "draft_config",
+                            "max_new", "k", "temperature",
+                            "top_k", "top_p"),
+              max_compiles=None)
 @partial(jax.jit, static_argnames=("target_config", "draft_config",
                                    "max_new", "k", "temperature",
                                    "top_k", "top_p"))

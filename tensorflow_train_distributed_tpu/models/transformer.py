@@ -18,6 +18,9 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_train_distributed_tpu.models import layers as L
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    compile_site,
+)
 from tensorflow_train_distributed_tpu.ops.losses import (
     fold_sample_weight, softmax_cross_entropy,
 )
@@ -175,6 +178,12 @@ def make_task(config: TransformerConfig = TRANSFORMER_PRESETS[
     return Seq2SeqTask(config)
 
 
+@compile_site(buckets="exact (WMT eval batches: one compile per "
+                      "source-batch shape / max_len)",
+              donates=(), statics=(),
+              static_names=("config", "max_len", "bos_id", "eos_id",
+                            "pad_id"),
+              max_compiles=None)
 @partial(jax.jit, static_argnames=("config", "max_len", "bos_id", "eos_id",
                                    "pad_id"))
 def greedy_translate(config: TransformerConfig, params, inputs,
@@ -213,6 +222,12 @@ def greedy_translate(config: TransformerConfig, params, inputs,
     return ys[:, 1:]
 
 
+@compile_site(buckets="exact (WMT eval batches: one compile per "
+                      "source-batch shape / max_len / beam)",
+              donates=(), statics=(),
+              static_names=("config", "max_len", "beam_size",
+                            "bos_id", "eos_id", "pad_id"),
+              max_compiles=None)
 @partial(jax.jit, static_argnames=("config", "max_len", "beam_size",
                                    "bos_id", "eos_id", "pad_id"))
 def beam_translate(config: TransformerConfig, params, inputs,

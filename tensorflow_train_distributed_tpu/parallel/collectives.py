@@ -31,6 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorflow_train_distributed_tpu.runtime.compat import axis_size, shard_map
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    compile_site,
+)
 
 AxisNames = str | Sequence[str]
 
@@ -160,6 +163,9 @@ def allreduce_bus_bandwidth(
     per_shard = max(1, int(size_mb * 1e6 / np.dtype(dtype).itemsize))
     spec = P(axis)
 
+    @compile_site(site="collectives.allreduce_bench_step",
+                  buckets="exact (microbenchmark: one shape per run)",
+                  donates=(), statics=(), max_compiles=None)
     @jax.jit
     def step(x):
         def _inner(s):

@@ -36,6 +36,13 @@ prefill/decode/retire events with ``rid``, and
 endpoint and ``tools/trace_report.py`` are its consumers.  Keep attr
 values JSON-scalar (str/int/float/bool): the export serializes them
 verbatim.
+
+The compile-discipline sanitizer (``runtime.lint.compilecheck``,
+``TTD_COMPILECHECK=1``) records a ``compile/<site>`` span around every
+dispatch that compiles a new signature at an instrumented jit site —
+compile time shows up in the same timeline as the decode/prefill spans
+it stalls, and ``tools/trace_report.py`` folds the spans into a
+per-site compilation table.
 """
 
 from __future__ import annotations
@@ -65,26 +72,37 @@ DEFAULT_CAPACITY = 65536
 # fsencoded-bytes keys, kept in sync by __setitem__/__delitem__ — so
 # monkeypatch.setenv flips it live too).  Fall back to the public API
 # where the private layout differs.
-try:
-    _ENV_DATA = os.environ._data
-    _KILL_KEY = os.fsencode(_KILL_ENV)
-    # Layout probe: the fast path needs bytes keys (posix).  A
-    # str-keyed _data (Windows) would make .get() return None forever
-    # — silently disabling the kill switch — so check the key type,
-    # not just that .get() doesn't raise.
-    if not isinstance(next(iter(_ENV_DATA)), bytes):
-        raise TypeError("os.environ._data keys are not bytes")
 
-    def trace_killed() -> bool:
-        """``TTD_NO_TRACE=1`` disables recording process-wide (re-read
-        per event, so a test or an operator shell can flip it live)."""
-        v = _ENV_DATA.get(_KILL_KEY)
-        return v is not None and v not in (b"", b"0")
-except (AttributeError, TypeError, StopIteration):  # pragma: no cover
-    def trace_killed() -> bool:
-        """``TTD_NO_TRACE=1`` disables recording process-wide (re-read
-        per event, so a test or an operator shell can flip it live)."""
-        return os.environ.get(_KILL_ENV, "0") not in ("", "0")
+
+def make_env_flag_reader(env_name: str):
+    """A ``() -> bool`` truthiness reader for one env flag, using the
+    ``os.environ._data`` fast path when the layout allows — THE shared
+    implementation of every per-event/per-dispatch live kill switch
+    (``TTD_NO_TRACE`` here, ``TTD_NO_COMPILECHECK`` in
+    runtime.lint.compilecheck), so the subtle layout probe lives
+    once."""
+    try:
+        env_data = os.environ._data
+        key = os.fsencode(env_name)
+        # Layout probe: the fast path needs bytes keys (posix).  A
+        # str-keyed _data (Windows) would make .get() return None
+        # forever — silently disabling the kill switch — so check the
+        # key type, not just that .get() doesn't raise.
+        if not isinstance(next(iter(env_data)), bytes):
+            raise TypeError("os.environ._data keys are not bytes")
+
+        def read() -> bool:
+            v = env_data.get(key)
+            return v is not None and v not in (b"", b"0")
+    except (AttributeError, TypeError, StopIteration):  # pragma: no cover
+        def read() -> bool:
+            return os.environ.get(env_name, "0") not in ("", "0")
+    return read
+
+
+#: ``TTD_NO_TRACE=1`` disables recording process-wide (re-read per
+#: event, so a test or an operator shell can flip it live).
+trace_killed = make_env_flag_reader(_KILL_ENV)
 
 
 class _Span:

@@ -21,26 +21,42 @@ halves share one annotation registry (``registry``):
     be documented in README and exercised by at least one test;
   - ``prometheus`` — metric naming conventions (counters ``_total``,
     histograms ``_seconds``) and README coverage for every ``ttd_*``
-    metric name, unified from the old ad-hoc test lint.
+    metric name, unified from the old ad-hoc test lint;
+  - ``compilecheck`` — every ``jax.jit`` site must declare its compile
+    discipline with ``@compile_site(buckets=..., donates=...)`` (or
+    route through ``compilecheck.jit``), the declared donation/statics
+    must match the jit kwargs, and call sites must not feed raw
+    host-measured sizes (``len``/``.shape``) or python-scalar closures
+    across the boundary un-bucketed.
 
-- **runtime sanitizer** (``lockcheck``): ``TTD_LOCKCHECK=1`` wraps the
-  package's locks with an acquisition-order graph that raises on
+- **runtime sanitizers**: ``TTD_LOCKCHECK=1`` (``lockcheck``) wraps
+  the package's locks with an acquisition-order graph that raises on
   cycles (potential deadlock) and arms per-attribute guards that raise
-  on guarded access without the declared lock — conftest arms it for
-  tier-1, so every existing gateway/replica/chaos test doubles as a
-  race test.  ``TTD_NO_LOCKCHECK=1`` is the escape hatch.
+  on guarded access without the declared lock; ``TTD_COMPILECHECK=1``
+  (``compilecheck``) wraps the annotated jit sites with per-callsite
+  compile tracking that raises ``RecompileError`` past a site's
+  declared budget, emits ``compile/<site>`` flight-recorder spans, and
+  feeds ``ttd_engine_compiles_total``.  conftest arms BOTH for tier-1,
+  so every existing test doubles as a race test and a recompile-storm
+  test.  ``TTD_NO_LOCKCHECK=1`` / ``TTD_NO_COMPILECHECK=1`` are the
+  escape hatches.
 
-One suppression format everywhere: ``# ttd-lint: disable=<checker>``
-on the offending line (comma-separate several checkers).
+One suppression format everywhere: ``# ttd-lint: disable=<checker> --
+<why>`` on the offending line (comma-separate several checkers).  The
+reason is mandatory and unused suppressions are themselves findings —
+the framework lints its own escape hatch.
 """
 
 from tensorflow_train_distributed_tpu.runtime.lint.core import (  # noqa: F401
+    CHECKER_EXIT_BITS,
     Finding,
+    exit_code,
     iter_source_files,
     run_lint,
 )
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (  # noqa: F401
     THREAD_ROLES,
+    compile_site,
     concurrency_guarded,
     current_role,
     dispatch_critical,

@@ -1,0 +1,767 @@
+"""Compile-discipline pass: jit-boundary shape analyzer + recompile sanitizer.
+
+The stack's TPU performance story assumes every hot ``jax.jit`` site
+compiles once per shape bucket and never again — one un-bucketed
+prompt length or python-scalar closure reaching a jit boundary turns a
+~7 ms decode step into a multi-second recompile storm (the dense-MoE
+varied-length storm was hand-found in PR 3; this module makes the
+whole bug class mechanical).  Same two-half shape as the concurrency
+discipline (``concurrency.py`` + ``lockcheck.py``):
+
+- **static checker** (``compilecheck``, registered in ``core``): every
+  ``jax.jit`` in the package must be either decorated with
+  ``@compile_site(...)`` (``runtime.lint.registry``) or routed through
+  the call-style seam ``compilecheck.jit(fn, site=..., ...)`` — the
+  declared ``donates``/``statics``/``static_names`` must match the jit
+  decorator's ``donate_argnums``/``static_argnums``/``static_argnames``
+  exactly (a donation miss silently doubles peak HBM: the cache buffer
+  AND its successor both live).  Call sites of annotated programs must
+  not feed raw host-measured sizes (``len(...)`` / ``.shape``) across
+  the boundary un-bucketed (wrap them in a bucket helper —
+  ``_bucket_len`` / ``_pieces_for`` / anything named ``*bucket*``), and
+  a jitted closure must not capture a local produced by
+  ``len``/``int``/``float``/``.shape`` (the value burns in at trace
+  time: every new value is a silent recompile).
+
+- **runtime sanitizer** (``TTD_COMPILECHECK=1``; ``TTD_NO_COMPILECHECK=1``
+  is the live escape hatch, re-read per dispatch through the
+  ``os.environ._data`` fast path): annotated sites record a
+  ``(static args) -> {abstract dynamic signatures}`` map per call
+  site.  A dispatch whose signature was seen before is a dict+set
+  lookup (two pinned bars, tests/test_compilecheck.py: < 5 us for
+  flat-array signatures; < 40 us for pytree-carrying programs, whose
+  per-dispatch ``tree_flatten`` is leaf-proportional — ~18 us on the
+  llama_tiny decode program, ≈0.04% of a decode chunk); a NEW
+  signature is a compile — it increments the process-wide counter
+  (``ttd_engine_compiles_total`` on ``/metrics`` samples it) and wraps
+  the dispatch in a ``compile/<site>`` flight-recorder span (visible in
+  ``/debug/trace`` and ``tools/trace_report.py``), so compile time is
+  attributed in the same timeline as everything else.  When the number
+  of distinct signatures for one static group exceeds the site's
+  declared ``max_compiles`` budget, the first excess dispatch raises
+  ``RecompileError`` with the old and new signatures diffed — a
+  recompile storm fails the test that exhibits it instead of shipping.
+  conftest arms it for all of tier-1, so every serving/training test
+  doubles as a recompile-storm test.
+
+Static groups key on the static arguments (the engine/trainer instance
+behind ``static_argnums=(0,)``, the config behind ``static_argnames``):
+a new engine legitimately compiles its own bucket set, so budgets are
+per-instance, not process-global.  ``max_compiles=None`` declares a
+deliberately exact-shape site (offline batch APIs like
+``models.generate``: one compile per prompt shape is the documented
+contract) — recorded and counted, never budget-enforced.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import functools
+import inspect
+import itertools
+import os
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+# events is import-light (stdlib + the registry); it hosts the shared
+# fast-env-flag reader and the span recorder the compile spans land in.
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint.core import (
+    Finding,
+    register_checker,
+)
+from tensorflow_train_distributed_tpu.runtime.lint.dispatch import (
+    _decorator_name,
+    _dotted,
+    _is_jit_decorated,
+)
+
+CHECKER = "compilecheck"
+
+_ARM_ENV = "TTD_COMPILECHECK"
+_KILL_ENV = "TTD_NO_COMPILECHECK"
+
+
+class RecompileError(RuntimeError):
+    """A jit site exceeded its declared compile budget (recompile storm)."""
+
+
+# -- arming ----------------------------------------------------------------
+
+
+def _truthy(v: Optional[str]) -> bool:
+    return v is not None and v not in ("", "0")
+
+
+def armed() -> bool:
+    """``TTD_COMPILECHECK`` truthy and not vetoed by
+    ``TTD_NO_COMPILECHECK`` — checked at decoration time (sites wrap at
+    import, the lockcheck contract: arm BEFORE importing the package)."""
+    if _truthy(os.environ.get(_KILL_ENV)):
+        return False
+    return _truthy(os.environ.get(_ARM_ENV))
+
+
+# The veto is ALSO re-read per dispatch (an operator shell can disarm a
+# misbehaving sanitizer live, no redeploy) — through the flight
+# recorder's shared ``os.environ._data`` fast-path reader (~0.14 us vs
+# ~1 us for os.environ.get on a per-chunk path; one implementation of
+# the subtle layout probe, see events.make_env_flag_reader).
+_vetoed = events.make_env_flag_reader(_KILL_ENV)
+
+
+# -- site registry + dispatch bookkeeping ----------------------------------
+
+
+@dataclass(frozen=True)
+class SiteSpec:
+    """One jit site's declared compile discipline."""
+
+    site: str
+    buckets: object = ()           # descriptive: which bucket rule pads
+    donates: Tuple[int, ...] = ()
+    statics: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    max_compiles: Optional[int] = 8
+
+
+# Raw lock on purpose: this module is imported by the lint CLI from a
+# bare checkout and must never depend on lockcheck's factories being
+# (un)installed; the critical sections are leaf-level dict/set updates.
+_STATE_LOCK = threading.Lock()
+_SITES: Dict[str, SiteSpec] = {}
+# (site, static_key) -> {"sigs": set, "last": sig} — the per-instance
+# signature groups the budget is enforced over.
+_GROUPS: Dict[tuple, dict] = {}
+_BUDGET_OVERRIDES: Dict[str, Optional[int]] = {}
+_COMPILES = 0
+_TOKENS = itertools.count(1)
+_TREE_UTIL = None               # lazy jax.tree_util (keep import light)
+
+
+def register_site(spec: SiteSpec) -> SiteSpec:
+    with _STATE_LOCK:
+        _SITES[spec.site] = spec
+    return spec
+
+
+def sites() -> Tuple[str, ...]:
+    """Registered site names (populated at import of annotated modules)."""
+    with _STATE_LOCK:
+        return tuple(sorted(_SITES))
+
+
+def site_spec(site: str) -> Optional[SiteSpec]:
+    with _STATE_LOCK:
+        return _SITES.get(site)
+
+
+def total_compiles() -> int:
+    """Process-wide compile events observed at instrumented sites (the
+    ``ttd_engine_compiles_total`` source; 0 unless the sanitizer is
+    armed)."""
+    with _STATE_LOCK:
+        return _COMPILES
+
+
+def reset(site: Optional[str] = None) -> None:
+    """Forget recorded signatures (test isolation; the tier-1 suite
+    deliberately accumulates).  ``site=None`` clears everything
+    including the compile counter."""
+    global _COMPILES
+    with _STATE_LOCK:
+        if site is None:
+            _GROUPS.clear()
+            _COMPILES = 0
+        else:
+            for key in [k for k in _GROUPS if k[0] == site]:
+                del _GROUPS[key]
+
+
+@contextlib.contextmanager
+def override_budget(site: str, max_compiles: Optional[int]):
+    """Temporarily replace a site's compile budget (the storm tests'
+    lever: plant a 3-signature storm against a budget of 2 instead of
+    compiling past a production-sized budget)."""
+    missing = object()
+    prev = _BUDGET_OVERRIDES.get(site, missing)
+    _BUDGET_OVERRIDES[site] = max_compiles
+    try:
+        yield
+    finally:
+        if prev is missing:
+            _BUDGET_OVERRIDES.pop(site, None)
+        else:
+            _BUDGET_OVERRIDES[site] = prev
+
+
+# -- signatures ------------------------------------------------------------
+
+
+def _skey_contains(skey, entry) -> bool:
+    for e in skey:
+        if e == entry:
+            return True
+        if isinstance(e, tuple) and len(e) == 2 and e[1] == entry:
+            return True                # (kwarg_name, entry) pairs
+    return False
+
+
+def _purge_token_groups(tok_entry) -> None:
+    """Weakref finalizer: a tokened instance (engine/trainer) died —
+    drop every signature group keyed on it, so a long-lived armed
+    process that churns engines does not leak dead groups (the
+    ``_prefix_caches`` lesson, applied to the sanitizer itself)."""
+    with _STATE_LOCK:
+        for key in [k for k in _GROUPS
+                    if _skey_contains(k[1], tok_entry)]:
+            del _GROUPS[key]
+
+
+def _instance_token(x) -> object:
+    """A stable per-instance key for static objects (the engine behind
+    ``static_argnums=(0,)``).  ``id()`` alone merges a dead engine's
+    signature group into whatever object reuses its address — attach a
+    monotonic token instead (with a finalizer purging the token's
+    groups at gc), falling back to hash (value-keyed configs) then id
+    (immutable, unhashable) only when the object refuses it."""
+    tok = getattr(x, "__ttd_cc_token__", None)
+    if tok is not None:
+        return ("tok", tok)
+    try:
+        tok = next(_TOKENS)
+        object.__setattr__(x, "__ttd_cc_token__", tok)
+    except (AttributeError, TypeError):
+        try:
+            return ("hash", type(x).__name__, hash(x))
+        except TypeError:
+            return ("id", id(x))
+    entry = ("tok", tok)
+    try:
+        weakref.finalize(x, _purge_token_groups, entry)
+    except TypeError:
+        pass                           # not weakref-able: manual reset()
+    return entry
+
+
+def _static_entry(x) -> object:
+    if x is None or type(x) in (bool, int, float, str, bytes):
+        return x
+    return _instance_token(x)
+
+
+def _leaf_entry(x) -> object:
+    # Shapes are already tuples on jax/np values and dtypes are
+    # hashable singletons — keep the raw objects (no tuple copies, no
+    # str()): this function is THE per-dispatch cost the <5us bar
+    # measures; stringification happens only in error messages.
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (shape, dtype)
+    # Python scalars trace weak-typed: abstractly identical per type,
+    # value-independent — exactly how jit sees them.
+    return ("py", type(x).__name__)
+
+
+def _dyn_entry(x) -> object:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (shape, dtype)
+    if x is None or type(x) in (bool, int, float, complex, str, bytes):
+        return ("py", type(x).__name__)
+    global _TREE_UTIL
+    if _TREE_UTIL is None:
+        from jax import tree_util as _TREE_UTIL_mod
+        _TREE_UTIL = _TREE_UTIL_mod
+    leaves, treedef = _TREE_UTIL.tree_flatten(x)
+    try:
+        # All-array fast path (the variables/cache trees on every
+        # engine dispatch): direct C-property reads, no per-leaf
+        # Python call — this loop IS the pytree-site dispatch cost
+        # the second overhead bar pins.
+        return (treedef, tuple((l.shape, l.dtype) for l in leaves))
+    except AttributeError:
+        return (treedef, tuple(_leaf_entry(leaf) for leaf in leaves))
+
+
+def _signature(args, kwargs, static_pos, static_nm):
+    """``(static_key, dynamic_signature)`` for one dispatch — the
+    static key picks the budget group, the dynamic signature is what a
+    new compile looks like."""
+    stat: list = []
+    dyn: list = []
+    for i, a in enumerate(args):
+        if i in static_pos:
+            stat.append(_static_entry(a))
+        else:
+            dyn.append(_dyn_entry(a))
+    if kwargs:
+        for k in sorted(kwargs):
+            v = kwargs[k]
+            if k in static_nm:
+                stat.append((k, _static_entry(v)))
+            else:
+                dyn.append((k, _dyn_entry(v)))
+    return tuple(stat), tuple(dyn)
+
+
+def _fmt_sig(sig) -> str:
+    if sig is None:
+        return "<none>"
+    return "(" + ", ".join(str(e) for e in sig) + ")"
+
+
+def _diff_sigs(old, new) -> str:
+    if old is None:
+        return f"new signature {_fmt_sig(new)}"
+    parts = []
+    for i in range(max(len(old), len(new))):
+        a = old[i] if i < len(old) else "<absent>"
+        b = new[i] if i < len(new) else "<absent>"
+        if a != b:
+            parts.append(f"arg[{i}]: {a} -> {b}")
+    return "; ".join(parts) or "identical structure (treedef change)"
+
+
+def _observe(site: str, spec: SiteSpec, skey, sig) -> Optional[int]:
+    """Record one dispatch.  None when the signature was already
+    compiled (the fast path); the 1-based signature ordinal when this
+    dispatch will compile; raises ``RecompileError`` on the first
+    dispatch past the site's budget."""
+    key = (site, skey)
+    grp = _GROUPS.get(key)
+    if grp is not None and sig in grp["sigs"]:
+        return None
+    global _COMPILES
+    with _STATE_LOCK:
+        grp = _GROUPS.setdefault(key, {"sigs": set(), "last": None})
+        if sig in grp["sigs"]:
+            return None
+        budget = _BUDGET_OVERRIDES.get(site, spec.max_compiles)
+        n = len(grp["sigs"]) + 1
+        if budget is not None and n > budget:
+            raise RecompileError(
+                f"compile budget exceeded at jit site '{site}': this "
+                f"dispatch would compile signature #{n} for one static "
+                f"group (budget max_compiles={budget}).  "
+                f"{_diff_sigs(grp['last'], sig)}.  An un-bucketed "
+                f"dynamic dimension is reaching the jit boundary — pad "
+                f"it through the site's bucket helpers (declared "
+                f"buckets: {spec.buckets!r}), or raise the site's "
+                f"max_compiles if the shape set legitimately grew")
+        grp["sigs"].add(sig)
+        grp["last"] = sig
+        _COMPILES += 1
+    return n
+
+
+def _wrap(fn, spec: SiteSpec, group=None):
+    """The armed wrapper: signature bookkeeping around every dispatch,
+    a ``compile/<site>`` span around the compiling ones."""
+    site = spec.site
+    static_pos = set(spec.statics)
+    static_nm = frozenset(spec.static_names)
+    # static_argnames callers may still pass positionally (jax accepts
+    # both); map names to positions once so the runtime keying matches
+    # jit's static/dynamic split either way.
+    try:
+        params = list(inspect.signature(fn).parameters)
+        static_pos |= {params.index(n) for n in spec.static_names
+                       if n in params}
+    except (ValueError, TypeError):        # pragma: no cover - C callables
+        pass
+    static_pos = frozenset(static_pos)
+    group_tok = None if group is None else _static_entry(group)
+
+    def _observe_call(args, kwargs):
+        skey, sig = _signature(args, kwargs, static_pos, static_nm)
+        if group_tok is not None:
+            skey = (group_tok,) + skey
+        return _observe(site, spec, skey, sig)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _vetoed():
+            return fn(*args, **kwargs)
+        n = _observe_call(args, kwargs)
+        if n is None:
+            return fn(*args, **kwargs)
+        with events.span("compile/" + site, site=site, signature=n):
+            return fn(*args, **kwargs)
+
+    if hasattr(fn, "lower"):
+        def lower(*args, **kwargs):
+            """AOT face of the same seam: a ``.lower()`` is a compile
+            the sanitizer must see (trainer.lower_train_step routes
+            here so the AOT proof and the live step share one site)."""
+            if _vetoed():
+                return fn.lower(*args, **kwargs)
+            n = _observe_call(args, kwargs)
+            if n is None:
+                return fn.lower(*args, **kwargs)
+            with events.span("compile/" + site, site=site, signature=n,
+                             aot=True):
+                return fn.lower(*args, **kwargs)
+        wrapper.lower = lower
+    wrapper.__ttd_compile_site__ = site
+    wrapper.__ttd_compile_wrapped__ = True
+    return wrapper
+
+
+def _default_site(fn) -> str:
+    mod = getattr(fn, "__module__", "") or ""
+    qual = getattr(fn, "__qualname__", None) or getattr(
+        fn, "__name__", None) or repr(fn)
+    return f"{mod.rsplit('.', 1)[-1]}.{qual}"
+
+
+def annotate(fn, *, buckets=(), donates=(), statics=(), static_names=(),
+             max_compiles: Optional[int] = 8, site: Optional[str] = None):
+    """Implementation of ``registry.compile_site`` (deferred there to
+    keep the registry import-light)."""
+    name = site or _default_site(fn)
+    spec = register_site(SiteSpec(
+        site=name, buckets=buckets, donates=tuple(donates),
+        statics=tuple(statics), static_names=tuple(static_names),
+        max_compiles=max_compiles))
+    try:
+        fn.__ttd_compile_site__ = name
+    except (AttributeError, TypeError):
+        pass                       # C-level jit callables may refuse
+    if not armed():
+        return fn
+    return _wrap(fn, spec)
+
+
+def jit(fn, *, site: str, buckets=(), max_compiles: Optional[int] = 8,
+        group=None, **jit_kwargs):
+    """The call-style seam: ``compilecheck.jit(step, site=..., ...)``
+    replaces a raw ``jax.jit(step, ...)`` wherever decorator syntax
+    cannot reach (the trainer's per-instance step builders and its AOT
+    ``.lower()`` path).  ``group`` keys the budget to an owning
+    instance (the trainer), since call-style sites have no
+    ``static_argnums=(0,)`` self to group by.  Unarmed, this IS
+    ``jax.jit`` — same object, zero overhead."""
+    import jax
+
+    def _norm(v):
+        if v is None:
+            return ()
+        return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+
+    spec = register_site(SiteSpec(
+        site=site, buckets=buckets,
+        donates=_norm(jit_kwargs.get("donate_argnums", ())),
+        statics=_norm(jit_kwargs.get("static_argnums", ())),
+        static_names=_norm(jit_kwargs.get("static_argnames", ())),
+        max_compiles=max_compiles))
+    jitted = jax.jit(fn, **jit_kwargs)  # ttd-lint: disable=compilecheck -- this IS the instrumented seam every raw jit routes through
+    if not armed():
+        return jitted
+    return _wrap(jitted, spec, group=group)
+
+
+# -- static checker --------------------------------------------------------
+
+#: Call names sanctioned to carry a host-measured size across a jit
+#: boundary: the bucket helpers (anything *bucket*-named) plus the
+#: engine's piece-sizing rule.
+_BUCKET_HELPERS = {"_pieces_for"}
+
+_SEAM_SUFFIXES = ("compilecheck.jit",)
+
+
+def _is_seam_call(name: str) -> bool:
+    return any(name == s or name.endswith("." + s) for s in _SEAM_SUFFIXES)
+
+
+def _compile_site_decorator(fn: ast.FunctionDef) -> Optional[ast.expr]:
+    for dec in fn.decorator_list:
+        name = _decorator_name(dec)
+        if name and name.split(".")[-1] == "compile_site":
+            return dec
+    return None
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> Optional[ast.expr]:
+    for dec in fn.decorator_list:
+        name = _decorator_name(dec)
+        if name in ("jax.jit", "jit"):
+            return dec
+        if (isinstance(dec, ast.Call)
+                and name in ("partial", "functools.partial")
+                and dec.args
+                and _dotted(dec.args[0]) in ("jax.jit", "jit")):
+            return dec
+    return None
+
+
+def _literal_tuple(node: Optional[ast.expr]) -> Optional[tuple]:
+    """Evaluate a literal int/str tuple (or scalar) kwarg; None when
+    absent or not a literal (computed specs skip the comparison)."""
+    if node is None:
+        return ()
+    elts = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+            else [node])
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, (int, str)):
+            out.append(e.value)
+        else:
+            return None
+    return tuple(out)
+
+
+def _kwarg(call: Optional[ast.expr], name: str) -> Optional[ast.expr]:
+    if not isinstance(call, ast.Call):
+        return None
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _annotation_findings(fn: ast.FunctionDef, path: str) -> List[Finding]:
+    """Annotation presence + declared-vs-actual jit kwargs for one
+    jit-decorated function."""
+    out: List[Finding] = []
+    jit_dec = _jit_decorator(fn)
+    site_dec = _compile_site_decorator(fn)
+    if site_dec is None:
+        out.append(Finding(
+            CHECKER, path, fn.lineno,
+            f"jit site '{fn.name}' is not annotated: declare its "
+            f"compile discipline with @compile_site(buckets=..., "
+            f"donates=..., statics=...) above the jit decorator (or "
+            f"route through compilecheck.jit(site=...))"))
+        return out
+    pairs = (("donates", "donate_argnums", "donation mismatch doubles "
+              "peak HBM: the un-donated buffer and its successor both "
+              "live"),
+             ("statics", "static_argnums", "the sanitizer keys budget "
+              "groups on the declared statics"),
+             ("static_names", "static_argnames", "the sanitizer keys "
+              "budget groups on the declared statics"))
+    for ann_name, jit_name, why in pairs:
+        declared = _literal_tuple(_kwarg(site_dec, ann_name))
+        actual = _literal_tuple(_kwarg(jit_dec, jit_name))
+        if declared is None or actual is None:
+            continue               # computed spec: runtime's job
+        if tuple(sorted(map(str, declared))) != tuple(
+                sorted(map(str, actual))):
+            out.append(Finding(
+                CHECKER, path, fn.lineno,
+                f"'{fn.name}': @compile_site({ann_name}={declared}) "
+                f"does not match jax.jit({jit_name}={actual}) — {why}"))
+    return out
+
+
+def _raw_jit_calls(tree: ast.Module, path: str) -> List[Finding]:
+    """Standalone ``jax.jit(...)`` calls (not a decorator of an
+    annotated function, not the seam) — each must be annotated, routed
+    through ``compilecheck.jit``, or suppressed with a reason."""
+    decorator_calls = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                decorator_calls.add(id(dec))
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in decorator_calls:
+            continue
+        if _dotted(node.func) in ("jax.jit", "jit"):
+            out.append(Finding(
+                CHECKER, path, node.lineno,
+                "raw jax.jit(...) call: route it through "
+                "compilecheck.jit(fn, site=..., ...) so the "
+                "recompilation sanitizer sees the site (or annotate "
+                "the decorated form with @compile_site)"))
+    return out
+
+
+def _annotated_callables(tree: ast.Module) -> Set[str]:
+    """Names that resolve to compile-site programs in this module:
+    decorated functions plus names assigned from the seam."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and _compile_site_decorator(node) is not None:
+            names.add(node.name)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _is_seam_call(_dotted(node.value.func) or ""):
+            names.add(node.targets[0].id)
+    return names
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _scan_unbucketed(node: ast.expr, state: str, flag) -> None:
+    """Flag host-measured sizes (``len(...)`` / ``.shape``) that drive
+    the jit boundary's SHAPES: bare in the argument expression
+    (``state == "top"``, possibly under arithmetic) or inside a
+    subscript slice (``state == "slice"`` — ``prompt[:len(prompt)]``,
+    THE storm shape).  Wrapping in any non-bucket call (``state ==
+    "wrapped"``, e.g. ``jnp.int32(len(prompt))``) turns the value into
+    traced DATA — shape-stable, so not flagged; a bucket helper
+    (``state == "sanctioned"``) blesses everything under it."""
+    if state == "sanctioned":
+        return
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func) or ""
+        short = name.split(".")[-1]
+        if short == "len" and state in ("top", "slice"):
+            flag(node, "len(...)")
+        if "bucket" in short or short in _BUCKET_HELPERS:
+            inner = "sanctioned"
+        elif state == "slice":
+            inner = "slice"        # min(len(p), 8) in a slice: still raw
+        else:
+            inner = "wrapped"
+        for child in ast.iter_child_nodes(node):
+            _scan_unbucketed(child, inner, flag)
+        return
+    if isinstance(node, ast.Attribute) and node.attr == "shape" \
+            and state in ("top", "slice"):
+        flag(node, ".shape")
+    if isinstance(node, ast.Subscript):
+        _scan_unbucketed(node.value, state, flag)
+        _scan_unbucketed(node.slice, "slice", flag)
+        return
+    for child in ast.iter_child_nodes(node):
+        _scan_unbucketed(child, state, flag)
+
+
+def _unbucketed_findings(tree: ast.Module, path: str) -> List[Finding]:
+    annotated = _annotated_callables(tree)
+    if not annotated:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node)
+        if callee not in annotated:
+            continue
+
+        def flag(n, what, _callee=callee):
+            out.append(Finding(
+                CHECKER, path, n.lineno,
+                f"un-bucketed dynamic dim: {what} flows into jit site "
+                f"'{_callee}' raw — every distinct value is a silent "
+                f"recompile; pad it through a bucket helper "
+                f"(_bucket_len / _pieces_for) first"))
+
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            _scan_unbucketed(arg, "top", flag)
+    return out
+
+
+_TAINTING = {"len", "int", "float"}
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Dict[str, str]:
+    """Local names assigned from host-measured scalars
+    (``len``/``int``/``float`` calls or ``.shape`` reads) — the values
+    that freeze into a jitted closure at trace time."""
+    tainted: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        why = None
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                short = (_dotted(sub.func) or "").split(".")[-1]
+                if short in _TAINTING:
+                    why = f"{short}(...)"
+                    break
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                why = ".shape"
+                break
+        if why:
+            tainted[node.targets[0].id] = why
+    return tainted
+
+
+def _closure_leak_findings(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for outer in ast.walk(tree):
+        if not isinstance(outer, ast.FunctionDef):
+            continue
+        tainted = _tainted_names(outer)
+        if not tainted:
+            continue
+        inner_defs = {n.name: n for n in ast.iter_child_nodes(outer)
+                      if isinstance(n, ast.FunctionDef)}
+        # jit targets: lambdas / inner defs handed to jax.jit or the
+        # seam, plus jit-decorated inner defs.
+        targets: List[Tuple[ast.AST, int]] = []
+        for node in ast.walk(outer):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func) or ""
+                if name in ("jax.jit", "jit") or _is_seam_call(name):
+                    if node.args:
+                        a0 = node.args[0]
+                        if isinstance(a0, ast.Lambda):
+                            targets.append((a0, node.lineno))
+                        elif isinstance(a0, ast.Name) \
+                                and a0.id in inner_defs:
+                            targets.append((inner_defs[a0.id],
+                                            node.lineno))
+        for inner in inner_defs.values():
+            if _is_jit_decorated(inner):
+                targets.append((inner, inner.lineno))
+        seen: Set[Tuple[int, str]] = set()
+        for target, lineno in targets:
+            args = target.args
+            bound = {a.arg for a in
+                     args.posonlyargs + args.args + args.kwonlyargs}
+            if args.vararg:
+                bound.add(args.vararg.arg)
+            if args.kwarg:
+                bound.add(args.kwarg.arg)
+            body = (target.body if isinstance(target.body, list)
+                    else [target.body])
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Name) \
+                            and isinstance(sub.ctx, ast.Load) \
+                            and sub.id in tainted \
+                            and sub.id not in bound \
+                            and (lineno, sub.id) not in seen:
+                        seen.add((lineno, sub.id))
+                        out.append(Finding(
+                            CHECKER, path, lineno,
+                            f"python scalar closure: '{sub.id}' "
+                            f"(from {tainted[sub.id]}) is captured by "
+                            f"a jitted closure — the value burns in "
+                            f"at trace time and every new value "
+                            f"recompiles; pass it as a traced "
+                            f"argument or bucket it"))
+    return out
+
+
+@register_checker(CHECKER)
+def check(tree: ast.Module, lines, path: str, ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and _is_jit_decorated(node):
+            findings.extend(_annotation_findings(node, path))
+    findings.extend(_raw_jit_calls(tree, path))
+    findings.extend(_unbucketed_findings(tree, path))
+    findings.extend(_closure_leak_findings(tree, path))
+    return findings

@@ -5,23 +5,33 @@ registered in ``CHECKERS``; ``run_lint`` parses each file once and
 fans it to every requested checker, then drops findings suppressed by
 the one shared suppression format:
 
-    some_code()            # ttd-lint: disable=concurrency
-    other_code()           # ttd-lint: disable=concurrency,dispatch
+    some_code()    # ttd-lint: disable=concurrency -- scrape is read-only
+    other_code()   # ttd-lint: disable=concurrency,dispatch -- bench path
 
 A suppression names the checker it silences (never a bare
-``disable``), so grepping for a checker's name finds every place it
-was overridden — the suppression IS documentation.
+``disable``) AND carries a trailing ``-- <why>`` reason, so grepping
+for a checker's name finds every place it was overridden — the
+suppression IS documentation, and the reason is its body.  The
+framework lints the linter's own escape hatch: a suppression without
+a reason, and a suppression that silenced nothing in this run (an
+*unused* suppression — the hazard it excused is gone, or the comment
+drifted off its line), are both reported as ``suppression`` findings.
+Only suppressions naming a checker that actually ran are audited, so
+``--checker``-scoped runs never flag another checker's suppressions.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
-from typing import Callable, Dict, List, Optional, Sequence
+import tokenize
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-_SUPPRESS_RE = re.compile(r"#\s*ttd-lint:\s*disable=([a-z0-9_,\- ]+)")
+_SUPPRESS_RE = re.compile(
+    r"#\s*ttd-lint:\s*disable=([a-z0-9_,\- ]+?)(?:\s+--\s*(\S.*))?$")
 
 # Directories never linted (fixtures PLANT bugs for the checkers'
 # own mutation tests; caches are noise).
@@ -41,14 +51,63 @@ class Finding:
         return f"{path}:{self.line}: [{self.checker}] {self.message}"
 
 
+def _parse_suppression(text: str) -> Optional[Tuple[Set[str], Optional[str]]]:
+    """``(checker_names, reason_or_None)`` for a suppression comment,
+    None when ``text`` carries no suppression at all."""
+    m = _SUPPRESS_RE.search(text)
+    if not m:
+        return None
+    names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+    reason = m.group(2)
+    return names, (reason.strip() if reason else None)
+
+
 def _suppressed(lines: Sequence[str], lineno: int, checker: str) -> bool:
     if not 1 <= lineno <= len(lines):
         return False
-    m = _SUPPRESS_RE.search(lines[lineno - 1])
-    if not m:
-        return False
-    names = {n.strip() for n in m.group(1).split(",")}
-    return checker in names
+    parsed = _parse_suppression(lines[lineno - 1])
+    return parsed is not None and checker in parsed[0]
+
+
+def _iter_suppression_comments(
+        source: str) -> Iterator[Tuple[int, Set[str], Optional[str]]]:
+    """``(lineno, checker_names, reason)`` for every REAL suppression
+    comment — tokenized, so docstring examples of the format (this very
+    module's, for one) are not mistaken for live suppressions."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            parsed = _parse_suppression(tok.string)
+            if parsed is not None:
+                yield tok.start[0], parsed[0], parsed[1]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+#: Stable per-checker exit-code bits for the CLI (OR'd together, so a
+#: machine caller can tell WHICH disciplines failed from the code alone;
+#: ``--json`` carries the same map in-band).  0 = clean, 2 = usage
+#: error (argparse convention, below every checker bit), 1 = findings
+#: from an unregistered source (io/syntax).
+CHECKER_EXIT_BITS: Dict[str, int] = {
+    "concurrency": 4,
+    "dispatch": 8,
+    "kill-switch": 16,
+    "prometheus": 32,
+    "compilecheck": 64,
+    "suppression": 128,
+}
+
+
+def exit_code(findings: Sequence["Finding"]) -> int:
+    """The CLI exit status for a finding list: OR of each finding
+    checker's stable bit (1 for io/syntax), 0 when clean."""
+    code = 0
+    for f in findings:
+        code |= CHECKER_EXIT_BITS.get(f.checker, 1)
+    return code
 
 
 def iter_source_files(paths: Sequence[str]) -> List[str]:
@@ -128,6 +187,7 @@ def _load_checkers() -> None:
     # Imported lazily so ``import runtime.lint.core`` alone stays
     # dependency-free; each module registers itself.
     from tensorflow_train_distributed_tpu.runtime.lint import (  # noqa: F401
+        compilecheck,
         concurrency,
         dispatch,
         flags,
@@ -168,9 +228,32 @@ def run_lint(paths: Optional[Sequence[str]] = None,
                 "syntax", path, e.lineno or 0, f"syntax error: {e.msg}"))
             continue
         lines = source.splitlines()
+        used: set = set()          # (lineno, checker) actually silenced
         for name in names:
             for f_ in CHECKERS[name](tree, lines, path, ctx):
-                if not _suppressed(lines, f_.line, f_.checker):
+                if _suppressed(lines, f_.line, f_.checker):
+                    used.add((f_.line, f_.checker))
+                else:
                     findings.append(f_)
+        # Lint the linter's escape hatch: reasons are mandatory, and a
+        # suppression that silenced nothing (for a checker that RAN) is
+        # dead weight hiding a fixed hazard — report both.
+        ran = set(names)
+        for lineno, sup_names, reason in _iter_suppression_comments(source):
+            active = sorted(sup_names & ran)
+            if not active:
+                continue
+            if reason is None:
+                findings.append(Finding(
+                    "suppression", path, lineno,
+                    "suppression missing a reason: write '# ttd-lint: "
+                    "disable=<checker> -- <why>'"))
+            for c in active:
+                if (lineno, c) not in used:
+                    findings.append(Finding(
+                        "suppression", path, lineno,
+                        f"unused suppression for checker '{c}' (no "
+                        f"finding was silenced on this line — remove "
+                        f"it, or re-anchor it to the hazard)"))
     findings.sort(key=lambda f: (f.path, f.line, f.checker))
     return findings

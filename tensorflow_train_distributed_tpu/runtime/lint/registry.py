@@ -149,6 +149,44 @@ def dispatch_critical(fn: Callable) -> Callable:
     return fn
 
 
+def compile_site(*, buckets=(), donates=(), statics=(), static_names=(),
+                 max_compiles: Optional[int] = 8,
+                 site: Optional[str] = None) -> Callable:
+    """Declare a hot ``jax.jit`` site's compile discipline (stack this
+    ABOVE the jit decorator).
+
+    - ``buckets``: which bucket rule pads this site's dynamic dims
+      (descriptive — ``"prompt_buckets"``, ``"exact"``; it lands in the
+      RecompileError so the storm message names the missing padding);
+    - ``donates`` / ``statics`` / ``static_names``: must mirror the jit
+      decorator's ``donate_argnums`` / ``static_argnums`` /
+      ``static_argnames`` exactly — the static ``compilecheck`` checker
+      cross-checks them (a donation miss doubles peak HBM; the statics
+      key the sanitizer's budget groups);
+    - ``max_compiles``: distinct compiled signatures allowed per static
+      group (per engine/trainer instance) before the runtime sanitizer
+      (``TTD_COMPILECHECK=1``) raises ``RecompileError``.  ``None``
+      declares a deliberately exact-shape batch API: recorded, counted
+      on ``ttd_engine_compiles_total``, never budget-enforced.
+
+    Like ``@thread_role``, the declaration is free when the sanitizer
+    is unarmed: the function comes back untouched.
+    """
+    def deco(fn):
+        # Deferred import: the registry stays import-light (the
+        # lockcheck/concurrency_guarded convention).
+        from tensorflow_train_distributed_tpu.runtime.lint import (
+            compilecheck,
+        )
+
+        return compilecheck.annotate(
+            fn, buckets=buckets, donates=donates, statics=statics,
+            static_names=static_names, max_compiles=max_compiles,
+            site=site)
+
+    return deco
+
+
 def _normalize_spec(attr: str, spec) -> Tuple[Optional[str], Tuple[str, ...]]:
     """-> (lock_name_or_None, owner_roles)."""
     if isinstance(spec, str):

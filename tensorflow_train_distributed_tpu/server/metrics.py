@@ -24,6 +24,7 @@ import math
 import threading
 from typing import Callable, Optional, Sequence
 
+from tensorflow_train_distributed_tpu.runtime.lint import compilecheck
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     concurrency_guarded,
 )
@@ -347,6 +348,19 @@ class GatewayMetrics:
             "Paged-KV blocks LRU-evicted from the retired-prefix "
             "cache under allocation pressure.",
             fn=kv_evictions_fn)
+        # Compile discipline: XLA compilations observed at the
+        # package's @compile_site-instrumented jit sites, process-wide
+        # (every engine program, the trainer's step seam, the batch
+        # APIs).  Flat after warmup is the healthy shape; a climbing
+        # counter during steady serving IS the recompile storm the
+        # compilecheck sanitizer exists to catch (which, armed via
+        # TTD_COMPILECHECK=1, raises RecompileError past a site's
+        # budget; unarmed, the counter truthfully scrapes 0).
+        self.compiles = r.fn_counter(
+            "ttd_engine_compiles_total",
+            "XLA compilations observed at instrumented jit sites "
+            "(0 unless TTD_COMPILECHECK=1 arms the sanitizer).",
+            fn=compilecheck.total_compiles)
         # The queue-depth gauge's latency companion: how long admission
         # actually COSTS (admission → engine slot granted), observed by
         # the driver when engine.submit succeeds — queue depth alone

@@ -126,6 +126,7 @@ logger = logging.getLogger(__name__)
 
 from tensorflow_train_distributed_tpu.runtime import compat, events
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    compile_site,
     concurrency_guarded,
     dispatch_critical,
     thread_role,
@@ -595,6 +596,18 @@ class ServingEngine:
             lambda k, l: jax.random.categorical(k, l)
         )(keys, logits).astype(jnp.int32)
 
+    # Compile discipline (ttd-lint compilecheck + TTD_COMPILECHECK=1):
+    # every program below declares which bucket rule pads its dynamic
+    # dims, which args it donates, and how many distinct signatures one
+    # engine may legitimately compile.  Prefill pieces see one shape
+    # per prompt bucket (or ONE prefill_chunk shape) — except
+    # dense-MoE exact-length prefill, which deliberately compiles per
+    # distinct prompt length (the engine warns per new length), hence
+    # the wider budget.  The grid programs (decode/spec/insert/reset)
+    # are shape-fixed per engine: tiny budgets, so an un-bucketed
+    # shape reaching them raises on the FIRST excess dispatch.
+    @compile_site(buckets="prompt_buckets|prefill_chunk|exact(dense-MoE)",
+                  donates=(2,), statics=(0,), max_compiles=32)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
     def _prefill_piece(self, variables, cache, tokens_1xl, local_idx,
                        seed, count0):
@@ -623,6 +636,8 @@ class ServingEngine:
                            seed[None], count0[None])[0]
         return vs["cache"], first.astype(tokens_1xl.dtype)
 
+    @compile_site(buckets="prompt_buckets|prefill_chunk",
+                  donates=(2,), statics=(0,), max_compiles=32)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
     def _draft_prefill_piece(self, variables, cache, tokens_1xl):
         """Draft-model prefill piece (no token pick — the draft only
@@ -657,6 +672,8 @@ class ServingEngine:
             d_block, q, p, us, final_keys)
         return (emit.astype(dtype), emitted, a, final.astype(dtype))
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(3, 4), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
     def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok, seeds,
                     counts):
@@ -745,6 +762,8 @@ class ServingEngine:
         return (t_cache, d_cache, emit, emitted, next_tok, a,
                 counts + emitted)
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(1,), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, cache_b, cache_1, slot, true_len):
         """Copy a prefilled request's cache rows into ``slot`` and pin
@@ -803,6 +822,8 @@ class ServingEngine:
 
         return jax.tree_util.tree_map_with_path(scatter, cache)
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(1,), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _paged_insert(self, cache, cache_1, slot, table_row, start,
                       true_len):
@@ -824,6 +845,8 @@ class ServingEngine:
 
         return jax.tree_util.tree_map_with_path(pin, cache)
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(1,), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _paged_preload(self, cache, cache_1, table_row, start, end):
         """Scatter a preloaded prefix's rows [start, end) into
@@ -833,6 +856,8 @@ class ServingEngine:
         return self._scatter_rows_tree(cache, cache_1, table_row,
                                        start, end)
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(), statics=(0, 3), max_compiles=4)
     @partial(jax.jit, static_argnums=(0, 3))
     def _gather_prefix(self, cache, table_row, draft, matched):
         """The inverse of ``_scatter_rows_tree``: read a lane's leading
@@ -865,6 +890,8 @@ class ServingEngine:
 
         return jax.tree_util.tree_map_with_path(build, struct)
 
+    @compile_site(buckets="slot-grid (shape-fixed per engine)",
+                  donates=(1,), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _reset_lanes(self, cache, stale):
         """Point ``stale`` lanes' block tables at the scratch block and
@@ -883,6 +910,10 @@ class ServingEngine:
 
         return jax.tree_util.tree_map_with_path(rst, cache)
 
+    @compile_site(buckets="slot-grid (the un-bucketed-prompt storm "
+                          "surfaces HERE when prefill discipline "
+                          "slips)",
+                  donates=(2,), statics=(0,), max_compiles=4)
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
     def _decode_chunk(self, variables, cache, tok, seeds, counts):
         """``chunk`` decode steps for all slots; one device round-trip.

@@ -30,6 +30,7 @@ import numpy as np
 import optax
 
 from tensorflow_train_distributed_tpu.runtime import compat, events, faults
+from tensorflow_train_distributed_tpu.runtime.lint import compilecheck
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     thread_role,
 )
@@ -207,7 +208,12 @@ class Trainer:
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
                 compat.set_mesh(self.mesh):
             self.state_shardings = shardings
-            state = jax.jit(_create, out_shardings=self.state_shardings)()
+            # Through the compilecheck seam (not raw jax.jit): state
+            # creation is a one-shot compile per trainer, and the
+            # sanitizer holds it to that.
+            state = compilecheck.jit(
+                _create, site="trainer.create_state", group=self,
+                out_shardings=self.state_shardings)()
         state = nn.unbox(state)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
         if params is not None:
@@ -295,8 +301,14 @@ class Trainer:
                     np.asarray(x).dtype, sharding=batch_sharding),
                 sample_batch)
             donate = (0,) if self.config.donate_state else ()
-            return jax.jit(step, donate_argnums=donate).lower(
-                state_in, batch_in)
+            # SAME instrumented site as the live train step: the AOT
+            # proof must not bypass the compile-discipline seam — a
+            # ``.lower()`` is a compile, recorded and budgeted like a
+            # live dispatch (regression-pinned in
+            # tests/test_compilecheck.py).
+            return compilecheck.jit(
+                step, site="trainer.train_step", group=self,
+                donate_argnums=donate).lower(state_in, batch_in)
 
     # -- step functions ------------------------------------------------------
 
@@ -430,19 +442,26 @@ class Trainer:
         )
         return new_state, metrics
 
-    def _jit_step(self, fn, *, donate=()):
+    def _jit_step(self, fn, *, site, donate=()):
         """jit ``fn(state, batch)`` with the trainer's mesh + logical rules.
 
         set_mesh must wrap the *call* (it is illegal inside jit): it binds
         the abstract mesh at trace time so mesh-aware ops (seq-parallel
         attention) see it regardless of call site.
+
+        Every trainer program routes through the compilecheck seam
+        under its declared ``site`` (budget grouped per trainer): a
+        step that silently recompiles mid-fit — a batch shape drifting,
+        a donated state replaced by an undonated copy — raises under
+        ``TTD_COMPILECHECK=1`` instead of eating the step budget.
         """
 
         def step(state, batch):
             with sharding_lib.with_logical_rules(self.mesh, self.rules):
                 return fn(state, batch)
 
-        jitted = jax.jit(step, donate_argnums=donate)
+        jitted = compilecheck.jit(step, site=f"trainer.{site}",
+                                  group=self, donate_argnums=donate)
 
         def call(state, batch):
             with compat.set_mesh(self.mesh):
@@ -462,7 +481,8 @@ class Trainer:
             return new_state, jax.tree.map(lambda m: m[-1], ms)
 
         donate = (0,) if self.config.donate_state else ()
-        self._train_step = self._jit_step(step, donate=donate)
+        self._train_step = self._jit_step(step, site="train_step",
+                                          donate=donate)
         return self._train_step
 
     def _compiled_eval_step(self):
@@ -477,7 +497,7 @@ class Trainer:
             loss, (metrics, _) = loss_fn(state.params)
             return dict(metrics, loss=loss)
 
-        self._eval_step = self._jit_step(step)
+        self._eval_step = self._jit_step(step, site="eval_step")
         return self._eval_step
 
     def _compiled_predict_step(self):
@@ -493,7 +513,7 @@ class Trainer:
             b = self.policy.cast_to_compute(batch)
             return self.task.predict_fn(p, state.model_state, b)
 
-        self._predict_step = self._jit_step(step)
+        self._predict_step = self._jit_step(step, site="predict_step")
         return self._predict_step
 
     # -- loops ---------------------------------------------------------------

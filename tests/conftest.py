@@ -27,6 +27,15 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 # creation and guard descriptors install at class-decoration time.
 # ``TTD_NO_LOCKCHECK=1`` is the escape hatch (honored by armed()).
 os.environ.setdefault("TTD_LOCKCHECK", "1")
+# ...and the runtime RECOMPILATION sanitizer alongside it: every
+# serving/training test doubles as a recompile-storm test — annotated
+# jit sites (``@compile_site`` / ``compilecheck.jit``) track per-site
+# compile signatures and raise RecompileError past their declared
+# budget (see runtime/lint/compilecheck.py; overhead bar pinned in
+# tests/test_compilecheck.py).  Must also be set BEFORE package
+# imports: sites wrap at decoration time.  ``TTD_NO_COMPILECHECK=1``
+# is the escape hatch.
+os.environ.setdefault("TTD_COMPILECHECK", "1")
 from tensorflow_train_distributed_tpu.runtime.lint import lockcheck  # noqa: E402
 
 lockcheck.install()

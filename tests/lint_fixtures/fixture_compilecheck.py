@@ -1,0 +1,90 @@
+"""Planted compile-discipline bugs (see __init__.py).
+
+One plant per bug class the compilecheck checker exists for — delete
+or break the checker and tests/test_ttd_lint.py fails on this file:
+
+- an UN-ANNOTATED jit site (no ``@compile_site`` declaration);
+- a DONATION MISMATCH (declared ``donates`` != ``donate_argnums`` —
+  the miss that silently doubles peak HBM);
+- an UN-BUCKETED DYNAMIC DIM (``len(prompt)`` slicing straight into a
+  jit boundary: the recompile-storm shape);
+- a RAW ``jax.jit`` call not routed through the compilecheck seam;
+- a SCALAR-CLOSURE LEAK (a ``len()``-derived python local captured by
+  a jitted closure: burns in at trace time, recompiles per value).
+
+The clean twins (``clean_site`` / ``clean_caller``) pin the checker's
+false-positive guard: matching declarations, bucket-helper-wrapped
+sizes, and traced-scalar casts must stay silent.
+
+Stub decorators keep the module import-free for the AST checker.
+"""
+
+
+def compile_site(**kw):                     # AST stand-in
+    def deco(fn):
+        return fn
+    return deco
+
+
+def partial(fn, *a, **kw):                  # AST stand-in
+    return fn
+
+
+class jax:                                  # noqa: N801 — AST stand-in
+    @staticmethod
+    def jit(fn=None, **kw):
+        return fn if fn is not None else (lambda f: f)
+
+
+def _bucket_len(n, buckets):                # the sanctioned helper
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@partial(jax.jit, static_argnums=(0,))
+def unannotated_program(cfg, x):
+    # PLANTED: jit site with no @compile_site declaration.
+    return x
+
+
+@compile_site(buckets="prompt", donates=(1,), statics=(0,))
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def donation_mismatch(cfg, a, cache):
+    # PLANTED: declares donates=(1,) but actually donates arg 2 —
+    # the checker must refuse the annotation as documentation-of-lies.
+    return cache
+
+
+@compile_site(buckets="prompt", donates=(), statics=())
+@jax.jit
+def bucketed_program(tokens):
+    return tokens
+
+
+def storm_caller(prompt):
+    # PLANTED: host-measured length slices straight across the jit
+    # boundary — one compile per distinct prompt length.
+    return bucketed_program(prompt[:len(prompt)])
+
+
+def clean_caller(prompt):
+    # Clean twin: the same size routed through the bucket helper.
+    return bucketed_program(prompt[:_bucket_len(len(prompt), (8, 16))])
+
+
+@compile_site(buckets="prompt", donates=(2,), statics=(0,))
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def clean_site(cfg, tokens, cache):
+    # Clean twin: declaration matches the jit kwargs exactly.
+    return cache
+
+
+def scalar_closure_leak(xs):
+    n = len(xs)
+    # PLANTED (x2): a raw jax.jit call, whose lambda also captures the
+    # len()-derived local — n freezes at trace time; every new length
+    # retraces and recompiles.
+    f = jax.jit(lambda a: a * n)
+    return f

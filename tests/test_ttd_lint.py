@@ -119,6 +119,59 @@ def test_dispatch_fixture_every_plant_flagged():
     assert len(findings) == 9
 
 
+# ── seeded mutation: compile discipline ────────────────────────────────
+
+
+def test_compilecheck_fixture_every_plant_flagged():
+    path = os.path.join(FIXTURES, "fixture_compilecheck.py")
+    findings = run_lint(paths=[path], checkers=["compilecheck"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    # One finding per planted bug class.
+    assert "jit site 'unannotated_program' is not annotated" in msgs
+    assert ("'donation_mismatch': @compile_site(donates=(1,)) does "
+            "not match jax.jit(donate_argnums=(2,))") in msgs
+    assert ("un-bucketed dynamic dim: len(...) flows into jit site "
+            "'bucketed_program' raw") in msgs
+    assert "raw jax.jit(...) call" in msgs
+    assert ("python scalar closure: 'n' (from len(...)) is captured "
+            "by a jitted closure") in msgs
+    assert len(findings) == 5
+    # The clean twins stay silent (false-positive guard): a matching
+    # annotation, a bucket-helper-wrapped size, and the helper itself.
+    assert "clean_site" not in msgs
+    assert "clean_caller" not in msgs
+
+
+def test_compilecheck_traced_scalar_cast_not_flagged(tmp_path):
+    """``jnp.int32(len(prompt))`` is traced DATA (shape-stable), not a
+    shape: the exact idiom serving's insert path uses must stay
+    clean — only bare sizes and slice bounds are storm shapes."""
+    mod = tmp_path / "cast.py"
+    mod.write_text(
+        "def compile_site(**kw):\n"
+        "    def deco(fn):\n"
+        "        return fn\n"
+        "    return deco\n"
+        "class jax:\n"
+        "    @staticmethod\n"
+        "    def jit(fn=None, **kw):\n"
+        "        return fn\n"
+        "class jnp:\n"
+        "    @staticmethod\n"
+        "    def int32(v):\n"
+        "        return v\n"
+        "@compile_site(donates=(), statics=())\n"
+        "@jax.jit\n"
+        "def prog(tokens, true_len):\n"
+        "    return tokens\n"
+        "def caller(cache, prompt):\n"
+        "    return prog(cache, jnp.int32(len(prompt)))\n")
+    findings = run_lint(paths=[str(mod)], checkers=["compilecheck"],
+                        root=ROOT)
+    assert findings == [], _messages(findings)
+
+
 # ── seeded mutation: kill switches ─────────────────────────────────────
 
 
@@ -186,13 +239,66 @@ def test_suppression_format_silences_exactly_the_named_checker(tmp_path):
         "        return n\n"
         "r = R()\n"
         "a = r.counter('bad_name', 'x')"
-        "  # ttd-lint: disable=prometheus\n"
+        "  # ttd-lint: disable=prometheus -- fixture metric, not scraped\n"
         "b = r.counter('also_bad', 'x')\n")
     findings = run_lint(paths=[str(mod)], checkers=["prometheus"],
                         root=ROOT)
     msgs = "\n".join(_messages(findings))
     assert "also_bad" in msgs
     assert "bad_name" not in msgs
+    # A used, reasoned suppression generates NO suppression findings.
+    assert "suppression" not in {f.checker for f in findings}
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    """The escape hatch is itself linted: a reasonless suppression
+    still silences its finding but is reported until it says why."""
+    mod = tmp_path / "reasonless.py"
+    mod.write_text(
+        "class R:\n"
+        "    def counter(self, n, h):\n"
+        "        return n\n"
+        "r = R()\n"
+        "a = r.counter('bad_name', 'x')"
+        "  # ttd-lint: disable=prometheus\n")
+    findings = run_lint(paths=[str(mod)], checkers=["prometheus"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "bad_name" not in msgs           # still silenced...
+    assert "missing a reason" in msgs       # ...but the hatch is flagged
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    mod = tmp_path / "unused.py"
+    mod.write_text(
+        "x = 1  # ttd-lint: disable=prometheus -- stale: metric moved\n")
+    findings = run_lint(paths=[str(mod)], checkers=["prometheus"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    assert "unused suppression for checker 'prometheus'" in msgs
+
+
+def test_suppression_audit_scoped_to_checkers_that_ran(tmp_path):
+    """A ``--checker prometheus`` run must not flag a concurrency
+    suppression as unused — the verdict needs the checker to run."""
+    mod = tmp_path / "scoped.py"
+    mod.write_text(
+        "x = 1  # ttd-lint: disable=concurrency\n")
+    findings = run_lint(paths=[str(mod)], checkers=["prometheus"],
+                        root=ROOT)
+    assert findings == [], _messages(findings)
+
+
+def test_docstring_suppression_examples_not_audited():
+    """core.py's own docstring SHOWS the format; tokenize-based comment
+    scanning must not mistake string contents for live suppressions
+    (the whole-tree gate passing already proves this; pin it
+    directly)."""
+    core_py = os.path.join(
+        ROOT, "tensorflow_train_distributed_tpu", "runtime", "lint",
+        "core.py")
+    findings = run_lint(paths=[core_py], root=ROOT)
+    assert [f for f in findings if f.checker == "suppression"] == []
 
 
 def test_registry_rejects_unknown_roles_and_empty_locks():
@@ -217,19 +323,57 @@ def test_thread_role_preserves_signature_for_resume_detection():
     assert "resume_from" in sig.parameters
 
 
-def test_cli_runs_and_exits_nonzero_on_findings(capsys):
+def _cli():
     spec = importlib.util.spec_from_file_location(
         "ttd_lint_cli", os.path.join(ROOT, "tools", "ttd_lint.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_runs_and_exits_per_checker_bits(capsys):
+    mod = _cli()
     assert mod.main(["--list"]) == 0
     out = capsys.readouterr().out
-    for name in ("concurrency", "dispatch", "kill-switch", "prometheus"):
+    for name in ("compilecheck", "concurrency", "dispatch",
+                 "kill-switch", "prometheus"):
         assert name in out
-    # Fixture file: findings -> exit 1, formatted path:line output.
+    # Fixture file: findings -> the checker's stable exit bit,
+    # formatted path:line output.
     rc = mod.main(["--checker", "prometheus",
                    os.path.join(FIXTURES, "fixture_prometheus.py")])
-    assert rc == 1
+    assert rc == 32                 # CHECKER_EXIT_BITS["prometheus"]
     assert "fixture_prometheus.py" in capsys.readouterr().out
-    # Unknown checker -> usage error.
+    rc = mod.main(["--checker", "compilecheck",
+                   os.path.join(FIXTURES, "fixture_compilecheck.py")])
+    assert rc == 64                 # CHECKER_EXIT_BITS["compilecheck"]
+    capsys.readouterr()
+    # Unknown checker -> usage error (below every checker bit).
     assert mod.main(["--checker", "nope"]) == 2
+
+
+def test_cli_json_output_is_structured(capsys):
+    """The tier-1 gate's machine interface: ``--json`` carries the
+    findings, per-checker counts, and the exit code in-band, and the
+    process exit matches."""
+    import json
+
+    mod = _cli()
+    rc = mod.main(["--json", "--checker", "compilecheck",
+                   os.path.join(FIXTURES, "fixture_compilecheck.py")])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == payload["exit_code"] == 64
+    assert payload["counts"]["compilecheck"] == 5
+    assert len(payload["findings"]) == 5
+    f = payload["findings"][0]
+    assert set(f) == {"checker", "path", "line", "message"}
+    assert f["checker"] == "compilecheck"
+    assert f["path"].endswith("fixture_compilecheck.py")
+    assert payload["exit_bits"]["compilecheck"] == 64
+    # A clean run is exit 0 with empty findings — same shape.
+    rc = mod.main(["--json", "--checker", "prometheus",
+                   os.path.join(ROOT, "tensorflow_train_distributed_tpu",
+                                "server", "metrics.py")])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == payload["exit_code"] == 0
+    assert payload["findings"] == []

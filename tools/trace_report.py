@@ -97,6 +97,26 @@ def kv_cache_summary(evs: list) -> dict:
     return out if seen else {}
 
 
+def compile_summary(evs: list) -> list:
+    """Per-jit-site compilation table from the compilecheck sanitizer's
+    ``compile/<site>`` spans (``TTD_COMPILECHECK=1``): how many
+    signatures each site compiled in the window and what they cost —
+    the "where did my decode step go" answer when the stall WAS a
+    recompile.  Empty when the window has no compile spans (sanitizer
+    unarmed, or a healthy steady state past warmup)."""
+    per: dict = {}
+    for e in evs:
+        name = e.get("name", "")
+        if e.get("ph") != "X" or not name.startswith("compile/"):
+            continue
+        site = name[len("compile/"):]
+        row = per.setdefault(site, [0, 0.0])
+        row[0] += 1
+        row[1] += e.get("dur", 0.0) / 1e3
+    return sorted(((site, n, ms) for site, (n, ms) in per.items()),
+                  key=lambda r: -r[2])
+
+
 def request_ids(evs: list) -> list:
     """(request_id, status) for every gateway request in the window
     (status from its retire instant; 'in-window' when none recorded)."""
@@ -214,6 +234,13 @@ def main(argv=None) -> int:
               f"  ({kv['prefix_hit_tokens']} prompt tokens skipped)")
         print(f"  evicted blocks     {kv['evicted_blocks']}")
         print(f"  refused admissions {kv['refused_admissions']}")
+
+    compiles = compile_summary(evs)
+    if compiles:
+        print("\n== compilations (compilecheck spans)")
+        print(f"{'count':>7}  {'total_ms':>10}  site")
+        for site, n, ms in compiles:
+            print(f"{n:7d}  {ms:10.2f}  {site}")
 
     if args.requests:
         ids = request_ids(evs)

@@ -1,25 +1,35 @@
 #!/usr/bin/env python
-"""ttd-lint CLI: static concurrency/purity/conventions analysis.
+"""ttd-lint CLI: static concurrency/purity/compile/conventions analysis.
 
 Usage::
 
     python -m tools.ttd_lint                  # whole package + tools
     python -m tools.ttd_lint --checker concurrency path/to/file.py
+    python -m tools.ttd_lint --json           # machine-readable findings
     python -m tools.ttd_lint --list
 
-Exit status: 0 clean, 1 findings, 2 usage error.  The tier-1 test
-(tests/test_ttd_lint.py) runs the same entry over the whole tree and
-asserts zero findings — run this locally before pushing anything that
-touches locks, thread roles, ``TTD_*`` flags, or metric names.
+Exit status: 0 clean, 2 usage error; findings exit with the OR of each
+failing checker's stable bit (concurrency=4, dispatch=8,
+kill-switch=16, prometheus=32, compilecheck=64, suppression=128,
+io/syntax=1 — ``core.CHECKER_EXIT_BITS``), so a machine caller can
+tell WHICH disciplines failed from the status alone.  ``--json``
+prints ``{"findings": [...], "counts": {...}, "exit_code": N}`` on
+stdout for callers that want structure instead of text (the tier-1
+gate asserts on it).  The tier-1 test (tests/test_ttd_lint.py) runs
+the same entry over the whole tree and asserts zero findings — run
+this locally before pushing anything that touches locks, thread
+roles, jit boundaries, ``TTD_*`` flags, or metric names.
 
 Suppress a deliberate exception with ``# ttd-lint:
-disable=<checker>`` on the offending line (one shared format across
-all checkers); the suppression is greppable documentation.
+disable=<checker> -- <why>`` on the offending line (one shared format
+across all checkers; the reason is mandatory and unused suppressions
+are reported) — the suppression is greppable documentation.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -42,6 +52,10 @@ def main(argv=None) -> int:
                         metavar="NAME",
                         help="run only this checker (repeatable); "
                              "default: all")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output: findings, "
+                             "per-checker counts, and the exit code "
+                             "as one JSON object")
     parser.add_argument("--list", action="store_true",
                         help="list known checkers and exit")
     args = parser.parse_args(argv)
@@ -56,12 +70,26 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"ttd_lint: {e}", file=sys.stderr)
         return 2
+    code = core.exit_code(findings)
+    if args.json:
+        counts: dict = {}
+        for f in findings:
+            counts[f.checker] = counts.get(f.checker, 0) + 1
+        print(json.dumps({
+            "findings": [{"checker": f.checker,
+                          "path": os.path.relpath(f.path, repo),
+                          "line": f.line,
+                          "message": f.message} for f in findings],
+            "counts": counts,
+            "exit_bits": core.CHECKER_EXIT_BITS,
+            "exit_code": code,
+        }, indent=2))
+        return code
     for f in findings:
         print(f.format(root=repo))
     if findings:
         print(f"ttd_lint: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    return 0
+    return code
 
 
 if __name__ == "__main__":
