@@ -142,6 +142,20 @@ def apply_rope(x, positions, *, base: float = 10000.0, scaling=None):
     return out.astype(x.dtype)
 
 
+def _quantize_kv_rows(t):
+    """Symmetric int8 quantization of KV rows, one f32 scale per
+    (..., row, kv_head) amax'd over head_dim — THE one KV quantization
+    recipe.  The linear cache, the per-slot serving cache, and the
+    paged block pool all store exactly these values, which is what
+    makes the cross-layout int8 parity bitwise (pinned in
+    tests/test_serving_paged.py)."""
+    amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    qt = jnp.clip(jnp.round(t.astype(jnp.float32) / scale[..., None]),
+                  -127, 127).astype(jnp.int8)
+    return qt, scale
+
+
 class RMSNorm(nn.Module):
     """Llama-family norm; scale is replicated ("norm" logical axis).
 
@@ -214,20 +228,22 @@ class MultiHeadAttention(nn.Module):
     # prefill (q_len = prompt length) and stepping (q_len = 1) alike.
     decode: bool = False
     cache_len: int = 0
-    # int8 KV cache (decode only, linear cache): rows quantize per
-    # (position, kv_head) with an f32 scale — halves cache HBM vs bf16
-    # (cache reads dominate large-batch/long-context decode) and the
-    # dequant fuses into the attention einsum's read.  Unsupported with
-    # the rolling window cache (roll/concat would need scale plumbing;
-    # the window already bounds cache memory).
+    # int8 KV cache (decode only): rows quantize per (position,
+    # kv_head) with an f32 scale — halves cache HBM vs bf16 (cache
+    # reads dominate large-batch/long-context decode) and the dequant
+    # fuses into the attention read.  Composes with the shared-index
+    # linear cache, the per-slot serving cache, AND the paged block
+    # pool (scales ride in a parallel pool var).  Unsupported with the
+    # rolling window cache (roll/concat would need scale plumbing; the
+    # window already bounds cache memory).
     kv_cache_int8: bool = False
     # Per-slot decode (continuous-batching serving, serving.ServingEngine): the
     # cache index is a VECTOR [B] — each batch row ("slot") sits at its
     # own position, so requests of different lengths decode together and
     # a finished slot can be refilled mid-flight.  Writes become
     # per-row scatters and the causal mask goes per-slot; RoPE reads
-    # each slot's own position.  Linear full-precision cache only
-    # (window/sinks/int8-KV keep the shared-index fast path).
+    # each slot's own position.  Linear cache, full-precision or
+    # kv_cache_int8 (window/sinks keep the shared-index fast path).
     slot_decode: bool = False
     # Paged KV cache (serving.ServingEngine paged mode; needs
     # slot_decode): instead of one contiguous [B, cache_len] strip per
@@ -443,12 +459,11 @@ class MultiHeadAttention(nn.Module):
                 "paged_kv_blocks requires slot_decode=True (the paged "
                 "pool is the serving engine's per-lane cache mode)")
         if self.slot_decode:
-            if (self.window is not None or self.sinks
-                    or self.kv_cache_int8):
+            if self.window is not None or self.sinks:
                 raise ValueError(
                     "slot_decode (per-slot cache positions) supports the "
-                    "LINEAR full-precision cache only — window/sinks/"
-                    "kv_cache_int8 keep the shared-index path")
+                    "LINEAR cache only (full-precision or kv_cache_int8) "
+                    "— window/sinks keep the shared-index path")
             if self.paged_kv_blocks:
                 if self.paged_kv_blocks < 2:
                     raise ValueError(
@@ -550,17 +565,9 @@ class MultiHeadAttention(nn.Module):
                                       kv_heads, b, q_len, x.shape[-1])
         if self.kv_cache_int8:
             # Quantize this call's rows: amax over head_dim per
-            # (batch, position, kv_head).
-            def quantize(t):
-                amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1)
-                scale = jnp.where(amax > 0, amax / 127.0, 1.0)  # [b,q,h]
-                qt = jnp.clip(jnp.round(
-                    t.astype(jnp.float32) / scale[..., None]),
-                    -127, 127).astype(jnp.int8)
-                return qt, scale
-
-            qk, sk = quantize(k)
-            qv, sv = quantize(v)
+            # (batch, position, kv_head) — the shared recipe.
+            qk, sk = _quantize_kv_rows(k)
+            qv, sv = _quantize_kv_rows(v)
             cache_k.value = jax.lax.dynamic_update_slice(
                 cache_k.value, qk, (0, cur, 0, 0))
             cache_v.value = jax.lax.dynamic_update_slice(
@@ -629,18 +636,29 @@ class MultiHeadAttention(nn.Module):
         refilled slot's stale rows are harmless: position p's row is
         always rewritten before any query can attend it (mask is
         kv_pos <= position and writes happen first).
+
+        ``kv_cache_int8`` composes: rows store int8 with the shared
+        per-(slot, position, kv_head) scale recipe
+        (``_quantize_kv_rows``) in a [2, B, cache_len, kv_heads] scale
+        var, dequant fused into the attention read — the serving
+        engine's batch-1 prefill cache for int8 configs.
         """
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
 
         q, k, v = self._qkv(x)
 
+        cache_dtype = jnp.int8 if self.kv_cache_int8 else self.dtype
         cache_k = self.variable(
             "cache", "key_cache", jnp.zeros,
-            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+            (b, self.cache_len, kv_heads, self.head_dim), cache_dtype)
         cache_v = self.variable(
             "cache", "value_cache", jnp.zeros,
-            (b, self.cache_len, kv_heads, self.head_dim), self.dtype)
+            (b, self.cache_len, kv_heads, self.head_dim), cache_dtype)
+        if self.kv_cache_int8:
+            kv_scales = self.variable(
+                "cache", "kv_scales", jnp.zeros,
+                (2, b, self.cache_len, kv_heads), jnp.float32)
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
         cur = index.value                                   # [B]
@@ -654,13 +672,26 @@ class MultiHeadAttention(nn.Module):
 
         kdt = cache_k.value.dtype
         bidx = jnp.arange(b)[:, None]
-        cache_k.value = cache_k.value.at[bidx, positions].set(
-            k.astype(kdt))
-        cache_v.value = cache_v.value.at[bidx, positions].set(
-            v.astype(kdt))
+        if self.kv_cache_int8:
+            qk, sk = _quantize_kv_rows(k)
+            qv, sv = _quantize_kv_rows(v)
+            cache_k.value = cache_k.value.at[bidx, positions].set(qk)
+            cache_v.value = cache_v.value.at[bidx, positions].set(qv)
+            kv_scales.value = kv_scales.value.at[
+                :, bidx, positions].set(jnp.stack([sk, sv]))
+            kc = (cache_k.value.astype(self.dtype)
+                  * kv_scales.value[0][..., None].astype(self.dtype))
+            vc = (cache_v.value.astype(self.dtype)
+                  * kv_scales.value[1][..., None].astype(self.dtype))
+        else:
+            cache_k.value = cache_k.value.at[bidx, positions].set(
+                k.astype(kdt))
+            cache_v.value = cache_v.value.at[bidx, positions].set(
+                v.astype(kdt))
+            kc, vc = cache_k.value, cache_v.value
         kv_pos = jnp.arange(self.cache_len)
         mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B,q,C]
-        return self._cache_attend(q, cache_k.value, cache_v.value,
+        return self._cache_attend(q, kc, vc,
                                   mask[:, None], kv_heads, b, q_len,
                                   x.shape[-1])
 
@@ -681,10 +712,25 @@ class MultiHeadAttention(nn.Module):
         shapes, so outputs are bitwise-identical to the linear cache
         whenever the gathered bytes are (which the engine's block
         bookkeeping guarantees — pinned in tests/test_serving_paged.py).
+
+        On TPU (or under ``TTD_FUSED_ATTN_INTERPRET=1``), the gather +
+        attend pair is replaced by ONE fused kernel
+        (``ops.pallas_kernels.paged_attention``) that computes
+        flash-style decode attention directly through the block table
+        — the dense per-lane KV view is never materialized, halving
+        decode's HBM traffic.  ``TTD_NO_FUSED_ATTN=1`` restores the
+        gather path (the byte-comparable A/B leg).  Sharded serving
+        (an ambient mesh) keeps the gather path: GSPMD partitions the
+        XLA gather, while the hand kernel is single-device.
+
+        ``kv_cache_int8`` composes: pools store int8 rows quantized by
+        the shared per-(row, kv_head) recipe, scales ride in a parallel
+        [2, num_blocks, block_size, kv_heads] pool, and the dequant
+        happens at read — fused into the kernel's block load, or into
+        the gathered view's attention read on the A/B leg.
         """
-        from tensorflow_train_distributed_tpu.ops.pallas_kernels import (
-            paged_kv_gather,
-        )
+        from tensorflow_train_distributed_tpu.ops import pallas_kernels \
+            as pk
 
         kv_heads = self.num_kv_heads or self.num_heads
         b, q_len, _ = x.shape
@@ -694,12 +740,17 @@ class MultiHeadAttention(nn.Module):
 
         q, k, v = self._qkv(x)
 
+        cache_dtype = jnp.int8 if self.kv_cache_int8 else self.dtype
         cache_k = self.variable(
             "cache", "key_pool", jnp.zeros,
-            (nb, bs, kv_heads, self.head_dim), self.dtype)
+            (nb, bs, kv_heads, self.head_dim), cache_dtype)
         cache_v = self.variable(
             "cache", "value_pool", jnp.zeros,
-            (nb, bs, kv_heads, self.head_dim), self.dtype)
+            (nb, bs, kv_heads, self.head_dim), cache_dtype)
+        if self.kv_cache_int8:
+            kv_scales = self.variable(
+                "cache", "kv_pool_scales", jnp.zeros,
+                (2, nb, bs, kv_heads), jnp.float32)
         # All-zero init: every lane starts mapped to the scratch block,
         # so pre-insert garbage decode is self-contained by
         # construction.
@@ -717,6 +768,11 @@ class MultiHeadAttention(nn.Module):
         index.value = cur + q_len
 
         kdt = cache_k.value.dtype
+        if self.kv_cache_int8:
+            k_store, sk = _quantize_kv_rows(k)
+            v_store, sv = _quantize_kv_rows(v)
+        else:
+            k_store, v_store = k.astype(kdt), v.astype(kdt)
         # Physical destination row per (lane, token): the table lookup
         # CLIPS the block index (gather semantics would otherwise wrap)
         # and overrun positions are sent out of range so the scatter
@@ -730,22 +786,63 @@ class MultiHeadAttention(nn.Module):
         cache_k.value = (
             cache_k.value.reshape(flat_shape)
             .at[dest.reshape(-1)]
-            .set(k.astype(kdt).reshape(-1, kv_heads, self.head_dim),
+            .set(k_store.reshape(-1, kv_heads, self.head_dim),
                  mode="drop")
             .reshape(nb, bs, kv_heads, self.head_dim))
         cache_v.value = (
             cache_v.value.reshape(flat_shape)
             .at[dest.reshape(-1)]
-            .set(v.astype(kdt).reshape(-1, kv_heads, self.head_dim),
+            .set(v_store.reshape(-1, kv_heads, self.head_dim),
                  mode="drop")
             .reshape(nb, bs, kv_heads, self.head_dim))
+        if self.kv_cache_int8:
+            sflat = kv_scales.value.reshape(2, nb * bs, kv_heads)
+            sflat = sflat.at[:, dest.reshape(-1)].set(
+                jnp.stack([sk, sv]).reshape(2, -1, kv_heads),
+                mode="drop")
+            kv_scales.value = sflat.reshape(2, nb, bs, kv_heads)
 
-        kc = paged_kv_gather(cache_k.value, table.value, self.cache_len)
-        vc = paged_kv_gather(cache_v.value, table.value, self.cache_len)
+        if self._fused_paged_ok():
+            out = pk.paged_attention(
+                q, cache_k.value, cache_v.value, table.value, cur,
+                k_scales=(kv_scales.value[0] if self.kv_cache_int8
+                          else None),
+                v_scales=(kv_scales.value[1] if self.kv_cache_int8
+                          else None),
+                cache_len=self.cache_len, use_pallas=True,
+                interpret=pk.fused_attn_interpret())
+            return self._attn_epilogue(out, b, q_len, x.shape[-1])
+
+        kc = pk.paged_kv_gather(cache_k.value, table.value,
+                                self.cache_len)
+        vc = pk.paged_kv_gather(cache_v.value, table.value,
+                                self.cache_len)
+        if self.kv_cache_int8:
+            ks = pk.paged_kv_gather(kv_scales.value[0][..., None],
+                                    table.value, self.cache_len)
+            vs = pk.paged_kv_gather(kv_scales.value[1][..., None],
+                                    table.value, self.cache_len)
+            kc = kc.astype(self.dtype) * ks.astype(self.dtype)
+            vc = vc.astype(self.dtype) * vs.astype(self.dtype)
         kv_pos = jnp.arange(self.cache_len)
         mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B,q,C]
         return self._cache_attend(q, kc, vc, mask[:, None], kv_heads, b,
                                   q_len, x.shape[-1])
+
+    def _fused_paged_ok(self) -> bool:
+        """Whether this paged decode should run the fused kernel: the
+        env/backend decision (``use_fused_paged_attention``), vetoed
+        under any >1-way ambient mesh — sharded serving keeps the XLA
+        gather path so GSPMD can partition it (the hand kernel is
+        single-device)."""
+        from tensorflow_train_distributed_tpu.ops import pallas_kernels \
+            as pk
+
+        mesh = compat.get_abstract_mesh()
+        if (mesh is not None and not mesh.empty
+                and any(v > 1 for v in mesh.shape.values())):
+            return False
+        return pk.use_fused_paged_attention()
 
     def _cache_attend(self, q, kc, vc, mask, kv_heads, b, q_len, features):
         """Masked einsum attention of q over the cache buffers."""
@@ -771,6 +868,12 @@ class MultiHeadAttention(nn.Module):
 
         out = dot_product_attention(qh, kh, vh, mask=mask)
         out = out.transpose(0, 2, 1, 3)
+        return self._attn_epilogue(out, b, q_len, features)
+
+    def _attn_epilogue(self, out, b, q_len, features):
+        """Shared decode tail — constraint, head-merge, out-proj — for
+        the gathered-attend path and the fused paged-attention kernel
+        (one epilogue keeps the two paths' param use identical)."""
         out = nn.with_logical_constraint(
             out, ("batch", "length", self._head_ax(self.num_heads), "kv"))
         out = out.reshape(b, q_len, self.num_heads * self.head_dim)

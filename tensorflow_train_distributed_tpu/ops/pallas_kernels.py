@@ -17,7 +17,7 @@ writes kernels.  TPU-first, the two ops worth owning beyond attention are:
   softmax blockwise from the saved logsumexp, so HBM cost is the logits
   themselves and [tokens]-sized residuals.
 
-A third, serving-side kernel backs the engine's paged KV cache:
+Two serving-side kernels back the engine's paged KV cache:
 
 - **Paged KV gather** (``paged_kv_gather``): the decode step reads each
   lane's KV through a block table (physical blocks of ``block_size``
@@ -29,6 +29,22 @@ A third, serving-side kernel backs the engine's paged KV cache:
   each grid step is one contiguous [block_size, kv_heads·head_dim] VMEM
   copy at the natural tile shape, no per-row index math on the vector
   units.
+- **Fused paged attention** (``paged_attention``): the gather above
+  still MATERIALIZES a dense [lanes, cache_len, kv_heads, head_dim]
+  KV view in HBM before attention ever runs — doubling HBM traffic on
+  the one resource decode is bound by (the paged_kv_ab residual).
+  This kernel computes flash-style decode attention DIRECTLY through
+  the block table: grid (lane, logical block) with the same
+  scalar-prefetched table steering each block's DMA, an online
+  (max, sumexp, acc) accumulator per (head, query row) carried across
+  blocks in VMEM scratch, per-lane causal masking from a prefetched
+  length vector, GQA handled per kv-head group in-kernel, and optional
+  int8-pool dequant fused into the block read (per-row symmetric
+  scales ride in a parallel scale pool) — the dense per-lane view is
+  never materialized.  ``TTD_NO_FUSED_ATTN=1`` restores the
+  gather-then-attend path (the byte-comparable A/B leg);
+  ``TTD_FUSED_ATTN_INTERPRET=1`` forces the kernel in interpret mode
+  off-TPU (the CPU parity-test path).
 
 Both have pure-jax references (the CPU path and the numerics oracle) and
 run in interpreter mode in tests (``interpret=True``); kernel layout
@@ -56,8 +72,6 @@ def env_flag(name: str) -> bool:
     would make NAME=0 silently flip the A/B (the TTD_NO_PALLAS lesson).
     One parser for every switch so the semantics cannot diverge.
     """
-    import os
-
     return os.environ.get(name, "").lower() not in ("", "0", "false")
 
 
@@ -134,6 +148,213 @@ def paged_kv_gather(pool, table, cache_len: int, *,
         interpret=interpret,
     )(table, flat)
     return out[:, :cache_len].reshape(lanes, cache_len, kvh, hd)
+
+
+# ---------------------------------------------------------------------------
+# Fused paged attention (serving.ServingEngine paged decode)
+# ---------------------------------------------------------------------------
+
+
+def use_fused_paged_attention() -> bool:
+    """Whether the paged decode step should run the FUSED kernel
+    (``paged_attention``) instead of gather-then-attend.
+
+    ``TTD_NO_FUSED_ATTN=1`` is the production kill switch (wins over
+    everything — restores the XLA block-gather path, byte-comparable as
+    the A/B leg); ``TTD_FUSED_ATTN_INTERPRET=1`` forces the kernel ON
+    in interpret mode off-TPU (the CPU parity-test path — slow, tiny
+    shapes only); otherwise the decision is the standard pallas one
+    (TPU backend, TTD_NO_PALLAS respected).  Read at TRACE time — flip
+    before the engine compiles its decode programs."""
+    if env_flag("TTD_NO_FUSED_ATTN"):
+        return False
+    if env_flag("TTD_FUSED_ATTN_INTERPRET"):
+        return True
+    return _use_pallas(None)
+
+
+def fused_attn_interpret() -> bool:
+    """True when the fused kernel should run INTERPRETED (the
+    TTD_FUSED_ATTN_INTERPRET CPU test path; on a real TPU the flag is
+    ignored — the compiled kernel is the thing being shipped)."""
+    return (env_flag("TTD_FUSED_ATTN_INTERPRET")
+            and jax.default_backend() != "tpu")
+
+
+def paged_attention_reference(q, k_pool, v_pool, table, lengths, *,
+                              k_scales=None, v_scales=None,
+                              cache_len: Optional[int] = None):
+    """Pure-jax oracle: gather-then-attend, the exact math of the
+    engine's XLA block-gather leg (``models.layers`` ``_cache_attend``
+    minus the sharding constraints, which are numerically no-ops).
+
+    ``q``: [lanes, q_len, heads, head_dim] (RoPE already applied);
+    ``k_pool``/``v_pool``: [num_blocks, block_size, kv_heads, head_dim]
+    (int8 when ``k_scales``/``v_scales`` [num_blocks, block_size,
+    kv_heads] are given — per-row symmetric dequant, the linear-cache
+    kv8 recipe); ``table``: [lanes, n_blk] int32; ``lengths``: [lanes]
+    int32, each lane's pre-call row count (query i sits at position
+    ``lengths[lane] + i`` and sees rows ``<=`` it).  Returns
+    [lanes, q_len, heads, head_dim]."""
+    from tensorflow_train_distributed_tpu.ops.attention import (
+        dot_product_attention,
+    )
+
+    nb, bs, kvh, hd = k_pool.shape
+    lanes, q_len, heads, _ = q.shape
+    c = cache_len if cache_len is not None else table.shape[1] * bs
+    kc = paged_kv_gather_reference(k_pool, table, c)
+    vc = paged_kv_gather_reference(v_pool, table, c)
+    if k_scales is not None:
+        ks = paged_kv_gather_reference(k_scales[..., None], table, c)
+        vs = paged_kv_gather_reference(v_scales[..., None], table, c)
+        kc = kc.astype(q.dtype) * ks.astype(q.dtype)
+        vc = vc.astype(q.dtype) * vs.astype(q.dtype)
+    if kvh != heads:
+        rep = heads // kvh
+        kc = jnp.repeat(kc, rep, axis=2)
+        vc = jnp.repeat(vc, rep, axis=2)
+    positions = lengths[:, None] + jnp.arange(q_len)        # [B, q]
+    mask = jnp.arange(c)[None, None, :] <= positions[:, :, None]
+    out = dot_product_attention(
+        q.transpose(0, 2, 1, 3), kc.transpose(0, 2, 1, 3),
+        vc.transpose(0, 2, 1, 3), mask=mask[:, None])
+    return out.transpose(0, 2, 1, 3)
+
+
+def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                       bs, kvh, rep, q_len, hd, scale, int8):
+    """Grid (lane, logical block), block innermost: the index maps
+    already steered this step's K/V (and scale) DMA to physical block
+    ``table[lane, j]``; the body folds the block into each query row's
+    online (max, sumexp, acc) accumulator.  Row layout is
+    [heads·q_len, hd] with row = head·q_len + qi, so each GQA group's
+    rows are one contiguous slice and the per-row query position is
+    ``row % q_len``."""
+    if int8:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cur = len_ref[i]
+    qf = q_ref[0].astype(jnp.float32)        # [heads*q_len, hd]
+    kf = k_ref[0]                            # [bs, kvh*hd]
+    vf = v_ref[0]
+    r = rep * q_len                          # rows per kv-head group
+    for g in range(kvh):                     # static: tiny head count
+        kg = kf[:, g * hd:(g + 1) * hd].astype(jnp.float32)
+        vg = vf[:, g * hd:(g + 1) * hd].astype(jnp.float32)
+        if int8:
+            # Per-row symmetric dequant fused into the block read —
+            # int8 bytes came off HBM, f32 math from here.
+            kg = kg * ks_ref[0][:, g:g + 1]
+            vg = vg * vs_ref[0][:, g:g + 1]
+        qg = qf[g * r:(g + 1) * r]           # [r, hd]
+        logits = jax.lax.dot_general(
+            qg, kg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [r, bs]
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (r, bs), 1)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (r, bs), 0) % q_len
+        # Causal through the table: row p visible to query qi iff
+        # p <= cur + qi.  Rows past the lane's length (incl. the whole
+        # scratch block a reset lane's table points at) mask out here;
+        # block 0 always has a visible row for every query (p=0), so
+        # the accumulator never divides by an all-masked zero.
+        logits = jnp.where(pos <= cur + qi, logits, _NEG)
+        rows = slice(g * r, (g + 1) * r)
+        m_prev = m_ref[rows]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[rows] = (l_ref[rows] * alpha
+                       + jnp.sum(p, axis=-1, keepdims=True))
+        acc_ref[rows] = acc_ref[rows] * alpha + jax.lax.dot_general(
+            p, vg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[rows] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, lengths, *,
+                    k_scales=None, v_scales=None,
+                    cache_len: Optional[int] = None,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Flash-style decode attention DIRECTLY through the block table —
+    the dense per-lane KV view ``paged_kv_gather`` materializes never
+    exists.  Arguments as ``paged_attention_reference`` (the pure-jax
+    oracle this is tested against; also the CPU path).  One grid step
+    DMAs exactly one physical block per lane, so HBM reads are the
+    pool bytes once instead of pool-bytes + dense-copy twice."""
+    if not _use_pallas(use_pallas) and not interpret:
+        return paged_attention_reference(
+            q, k_pool, v_pool, table, lengths, k_scales=k_scales,
+            v_scales=v_scales, cache_len=cache_len)
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, bs, kvh, hd = k_pool.shape
+    lanes, q_len, heads, _ = q.shape
+    n_blk = table.shape[1]
+    if heads % kvh:
+        raise ValueError(f"heads {heads} not a multiple of kv_heads "
+                         f"{kvh}")
+    rep = heads // kvh
+    int8 = k_scales is not None
+    # [lanes, q_len, H, hd] → [lanes, H*q_len, hd]: row = h*q_len + qi,
+    # so each kv-head group's rows are contiguous in the kernel.
+    qt = q.transpose(0, 2, 1, 3).reshape(lanes, heads * q_len, hd)
+    kf = k_pool.reshape(nb, bs, kvh * hd)
+    vf = v_pool.reshape(nb, bs, kvh * hd)
+    in_specs = [
+        pl.BlockSpec((1, heads * q_len, hd),
+                     lambda i, j, tbl, lens: (i, 0, 0)),
+        pl.BlockSpec((1, bs, kvh * hd),
+                     lambda i, j, tbl, lens: (tbl[i, j], 0, 0)),
+        pl.BlockSpec((1, bs, kvh * hd),
+                     lambda i, j, tbl, lens: (tbl[i, j], 0, 0)),
+    ]
+    args = [table, lengths.astype(jnp.int32), qt, kf, vf]
+    if int8:
+        in_specs += [
+            pl.BlockSpec((1, bs, kvh),
+                         lambda i, j, tbl, lens: (tbl[i, j], 0, 0)),
+            pl.BlockSpec((1, bs, kvh),
+                         lambda i, j, tbl, lens: (tbl[i, j], 0, 0)),
+        ]
+        args += [k_scales, v_scales]
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_attn_kernel, bs=bs, kvh=kvh, rep=rep, q_len=q_len,
+            hd=hd, scale=hd ** -0.5, int8=int8),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(lanes, n_blk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, heads * q_len, hd),
+                                   lambda i, j, tbl, lens: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((heads * q_len, 1), jnp.float32),
+                pltpu.VMEM((heads * q_len, 1), jnp.float32),
+                pltpu.VMEM((heads * q_len, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((lanes, heads * q_len, hd),
+                                       q.dtype),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(lanes, heads, q_len, hd).transpose(0, 2, 1, 3)
 
 
 # ---------------------------------------------------------------------------

@@ -446,7 +446,8 @@ class ServingGateway:
             kv_blocks_in_use_fn=_agg(active, "kv_blocks_in_use"),
             kv_blocks_total_fn=_agg(active, "kv_blocks_total"),
             kv_prefix_hit_tokens_fn=_agg(active, "kv_prefix_hit_tokens"),
-            kv_evictions_fn=_agg(active, "kv_evictions"))
+            kv_evictions_fn=_agg(active, "kv_evictions"),
+            kv_pool_bytes_fn=_agg(active, "kv_pool_bytes"))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

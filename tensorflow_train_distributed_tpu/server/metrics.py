@@ -249,7 +249,8 @@ class GatewayMetrics:
                  kv_blocks_total_fn: Optional[Callable[[], int]] = None,
                  kv_prefix_hit_tokens_fn: Optional[
                      Callable[[], int]] = None,
-                 kv_evictions_fn: Optional[Callable[[], int]] = None):
+                 kv_evictions_fn: Optional[Callable[[], int]] = None,
+                 kv_pool_bytes_fn: Optional[Callable[[], int]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -348,6 +349,15 @@ class GatewayMetrics:
             "Paged-KV blocks LRU-evicted from the retired-prefix "
             "cache under allocation pressure.",
             fn=kv_evictions_fn)
+        # Device bytes the paged pools pin (int8 scale pools included,
+        # target + draft; constant per engine — the pool never grows).
+        # The --kv-pool-blocks oversizing lever budgets against this:
+        # int8 halves it, and the freed HBM buys more blocks/slots.
+        self.kv_pool_bytes = r.gauge(
+            "ttd_engine_kv_pool_bytes",
+            "Device bytes held by the paged KV block pools "
+            "(0 = linear cache).",
+            fn=kv_pool_bytes_fn)
         # Compile discipline: XLA compilations observed at the
         # package's @compile_site-instrumented jit sites, process-wide
         # (every engine program, the trainer's step seam, the batch

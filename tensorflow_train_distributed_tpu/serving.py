@@ -102,8 +102,21 @@ serving via ``mesh=`` — the models' logical constraints shard weights
 and cache over the mesh, GSPMD inserts the collectives, and outputs
 stay token-identical.  Shared prompt prefixes prefill once
 (``preload_prefix``); later requests prefill only their suffix on a
-copied cache.  int8 KV cache, LoRA-unmerged params and sliding
-windows keep the shared-index ``generate()`` path.
+copied cache.  ``kv_cache_int8`` configs serve here too: the per-slot
+prefill cache and the paged pool both quantize with the linear-cache
+recipe (int8 rows + per-row f32 scales in a parallel pool), halving
+cache HBM so ``--kv-pool-blocks`` can grow effective batch into the
+freed headroom.  LoRA-unmerged params and sliding windows keep the
+shared-index ``generate()`` path.
+
+**Fused paged attention** (TPU): the paged decode read is ONE Pallas
+kernel (``ops.pallas_kernels.paged_attention``) that computes
+flash-style attention directly through the block table — the dense
+per-lane KV copy ``paged_kv_gather`` would materialize never exists.
+``TTD_NO_FUSED_ATTN=1`` (set BEFORE engine construction — the choice
+compiles into the decode programs) restores gather-then-attend as the
+byte-comparable A/B leg; CPU and sharded (``mesh=``) serving always
+use the gather path.
 """
 
 from __future__ import annotations
@@ -269,15 +282,18 @@ class ServingEngine:
                  kv_block_size: int = 16,
                  kv_pool_blocks: Optional[int] = None,
                  prefix_cache_limit: int = 32):
-        # MoeConfig has no window/int8-KV knobs; getattr keeps one check
-        # covering both decoder families.
+        # MoeConfig has no window knob; getattr keeps one check covering
+        # both decoder families.  kv_cache_int8 configs SERVE here (the
+        # per-slot and paged caches both quantize with the linear-cache
+        # recipe); only the rolling-window/sink cache shapes stay
+        # generate()-only.
         if (getattr(config, "sliding_window", None) is not None
-                or getattr(config, "kv_cache_int8", False)
                 or getattr(config, "attention_sinks", 0)):
             raise ValueError(
-                "the serving engine uses the per-slot linear cache; "
-                "sliding_window / attention_sinks / kv_cache_int8 "
-                "configs serve through models.generate")
+                "the serving engine's per-slot caches hold the full "
+                "context; sliding_window / attention_sinks configs "
+                "serve through models.generate (kv_cache_int8 is "
+                "supported here)")
         if has_lora_leaves(params):
             raise ValueError(
                 "merge LoRA adapters before engine serving: params = "
@@ -412,14 +428,14 @@ class ServingEngine:
                 raise ValueError(
                     f"draft_config needs speculative_k >= 1, got "
                     f"{self._spec_k}")
-            if (getattr(draft_config, "kv_cache_int8", False)
-                    or getattr(draft_config, "attention_sinks", 0)):
+            if getattr(draft_config, "attention_sinks", 0):
                 # Same screen as the target's: a bad draft config would
                 # otherwise crash inside run(), aborting in-flight work.
+                # (kv_cache_int8 drafts serve — same caches as the
+                # target's.)
                 raise ValueError(
-                    "the draft uses the per-slot linear cache too; "
-                    "attention_sinks / kv_cache_int8 draft configs are "
-                    "unsupported")
+                    "the draft uses the per-slot caches too; "
+                    "attention_sinks draft configs are unsupported")
             from tensorflow_train_distributed_tpu.models.speculative import (
                 _reject_config,
             )
@@ -557,6 +573,52 @@ class ServingEngine:
         self.overlap_stats = {"chunks": 0, "overlapped_harvests": 0,
                               "harvest_s": 0.0,
                               "overlapped_harvest_s": 0.0}
+        # Fused paged attention (ops.pallas_kernels.paged_attention):
+        # decided at construction from the same env/backend rule the
+        # decode trace reads (TTD_NO_FUSED_ATTN kills it; TPU default)
+        # — recorded here so dispatch spans and benches can tag which
+        # leg ran.  Flip the switch BEFORE constructing the engine:
+        # the decision burns into the compiled decode programs.
+        from tensorflow_train_distributed_tpu.ops import (
+            pallas_kernels as _pk,
+        )
+
+        self.kv_cache_int8 = bool(getattr(config, "kv_cache_int8",
+                                          False))
+        # Same mesh rule as layers._fused_paged_ok: any >1-way mesh
+        # keeps the XLA gather (GSPMD partitions it); a trivial mesh
+        # does not veto the kernel.
+        meshed = (self._mesh is not None
+                  and any(v > 1 for v in self._mesh.shape.values()))
+        self._fused_attn = bool(self.paged and not meshed
+                                and _pk.use_fused_paged_attention())
+        # Span-arg form, precomputed: the dispatch-critical window must
+        # not run int() (the dispatch lint cannot tell a host bool from
+        # a device scalar there, and keeping the window conversion-free
+        # is the cheaper discipline anyway).
+        self._fused_tag = 1 if self._fused_attn else 0
+        # Device bytes the paged pools pin (target + draft, int8 scale
+        # pools included) — computed once from the memoized cache
+        # eval_shape (host-only trace, no device work) so the /metrics
+        # scrape thread reads a plain int.  The --kv-pool-blocks
+        # oversizing lever is sized against this number.
+        self._kv_pool_bytes = 0
+        if self.paged:
+            def _pool_bytes(struct):
+                return sum(
+                    int(np.prod(leaf.shape))
+                    * jnp.dtype(leaf.dtype).itemsize
+                    for p, leaf in
+                    jax.tree_util.tree_flatten_with_path(struct)[0]
+                    if getattr(p[-1], "key", "") in
+                    ("key_pool", "value_pool", "kv_pool_scales"))
+
+            self._kv_pool_bytes = _pool_bytes(
+                self._cache_struct(self.slots, grid=True))
+            if self._draft_model is not None:
+                self._kv_pool_bytes += _pool_bytes(
+                    self._cache_struct(self.slots, draft=True,
+                                       grid=True))
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -769,12 +831,16 @@ class ServingEngine:
         """Copy a prefilled request's cache rows into ``slot`` and pin
         the slot's per-slot index to the TRUE prompt length.  Leaves are
         [..., B, C, kv_heads, head_dim] (a leading layer axis under
-        scan_layers) and the index [..., B]."""
+        scan_layers), the index [..., B], and — int8 configs — the
+        kv_scales [..., 2, B, C, kv_heads] (batch axis at ndim-3, not
+        ndim-4)."""
         def ins(path, pb, p1):
-            if any(getattr(k, "key", "") == "index" for k in path):
+            name = getattr(path[-1], "key", "")
+            if name == "index":
                 return pb.at[..., slot].set(true_len)
             return jax.lax.dynamic_update_slice_in_dim(
-                pb, p1, slot, axis=pb.ndim - 4)
+                pb, p1, slot,
+                axis=pb.ndim - (3 if name == "kv_scales" else 4))
 
         return jax.tree_util.tree_map_with_path(ins, cache_b, cache_1)
 
@@ -801,9 +867,13 @@ class ServingEngine:
         """Scatter the batch-1 LINEAR cache's rows [start, end) into the
         paged pool at ``table_row``'s blocks (traced helper shared by
         insert and preload; leaves pair by module path — only the leaf
-        names differ between the two cache layouts)."""
+        names differ between the two cache layouts).  int8 configs
+        carry the per-row scales along the same row map: the pool
+        stores exactly the bytes the batch-1 prefill quantized, which
+        is what keeps int8 paged parity bitwise."""
         dest = self._lane_dest_rows(table_row, start, end)
-        rename = {"key_pool": "key_cache", "value_pool": "value_cache"}
+        rename = {"key_pool": "key_cache", "value_pool": "value_cache",
+                  "kv_pool_scales": "kv_scales"}
         flat_1 = {self._path_key(p): leaf for p, leaf
                   in jax.tree_util.tree_flatten_with_path(cache_1)[0]}
 
@@ -812,10 +882,18 @@ class ServingEngine:
             if name not in rename:
                 return leaf
             src = flat_1[self._path_key(path[:-1]) + (rename[name],)]
-            src = jnp.squeeze(src, axis=-4)        # drop the batch-1 dim
-            n_lead = leaf.ndim - 4
-            flat = leaf.reshape(leaf.shape[:n_lead] + (-1,)
-                                + leaf.shape[-2:])
+            if name == "kv_pool_scales":
+                # [..., 2, 1, C, kvh] → rows at axis -2 of the
+                # flattened [..., 2, nb*bs, kvh] pool.
+                src = jnp.squeeze(src, axis=-3)    # drop the batch-1 dim
+                n_lead = leaf.ndim - 3             # dims before (nb, bs)
+                flat = leaf.reshape(leaf.shape[:n_lead] + (-1,)
+                                    + leaf.shape[-1:])
+            else:
+                src = jnp.squeeze(src, axis=-4)    # drop the batch-1 dim
+                n_lead = leaf.ndim - 4
+                flat = leaf.reshape(leaf.shape[:n_lead] + (-1,)
+                                    + leaf.shape[-2:])
             idx = (slice(None),) * n_lead + (dest,)
             flat = flat.at[idx].set(src.astype(flat.dtype), mode="drop")
             return flat.reshape(leaf.shape)
@@ -872,7 +950,8 @@ class ServingEngine:
         pos = jnp.arange(self.cache_len)
         rows = (table_row[jnp.clip(pos // bs, 0, self._kv_nblk_lane - 1)]
                 * bs + pos % bs)
-        rename = {"key_cache": "key_pool", "value_cache": "value_pool"}
+        rename = {"key_cache": "key_pool", "value_cache": "value_pool",
+                  "kv_scales": "kv_pool_scales"}
         pools = {self._path_key(p): leaf for p, leaf
                  in jax.tree_util.tree_flatten_with_path(cache)[0]}
         struct = self._cache_struct(1, draft=draft)
@@ -882,9 +961,16 @@ class ServingEngine:
             if name == "index":
                 return jnp.full(s.shape, matched, s.dtype)
             src = pools[self._path_key(path[:-1]) + (rename[name],)]
-            n_lead = src.ndim - 4
-            flat = src.reshape(src.shape[:n_lead] + (-1,)
-                               + src.shape[-2:])
+            if name == "kv_scales":
+                # Pool [..., 2, nb, bs, kvh] → batch-1 [..., 2, 1, C,
+                # kvh]: same row map, batch dim re-inserted at -3.
+                n_lead = src.ndim - 3
+                flat = src.reshape(src.shape[:n_lead] + (-1,)
+                                   + src.shape[-1:])
+            else:
+                n_lead = src.ndim - 4
+                flat = src.reshape(src.shape[:n_lead] + (-1,)
+                                   + src.shape[-2:])
             take = jnp.take(flat, rows, axis=n_lead)
             return jnp.expand_dims(take, axis=n_lead).astype(s.dtype)
 
@@ -1536,6 +1622,20 @@ class ServingEngine:
         """Blocks currently referenced (live lanes + radix cache)."""
         return self._kv_pool.blocks_in_use() if self.paged else 0
 
+    def kv_pool_bytes(self) -> int:
+        """Device bytes the paged KV pools pin across layers (target +
+        draft; int8 scale pools included; 0 = linear cache).  Constant
+        per engine — the pool never grows — so scrape threads read a
+        plain int; the ``--kv-pool-blocks`` oversizing lever budgets
+        against this."""
+        return self._kv_pool_bytes
+
+    def fused_attn(self) -> bool:
+        """Whether the decode programs were compiled with the fused
+        paged-attention kernel (False on CPU, under a mesh, with the
+        linear cache, or when TTD_NO_FUSED_ATTN killed it)."""
+        return self._fused_attn
+
     @thread_role("handler", "driver")
     def kv_prefix_hit_tokens(self) -> int:
         """Cumulative prompt tokens whose prefill was skipped via
@@ -1998,7 +2098,8 @@ class ServingEngine:
                 rids[slot] = state.request_id
         with self._ctx(), events.span(
                 "decode/dispatch",
-                active=sum(r is not None for r in rids)):
+                active=sum(r is not None for r in rids),
+                fused=self._fused_tag):
             # Retired/cancelled lanes' tables must point at scratch
             # BEFORE this chunk: their freed blocks may already be
             # reallocated, and this chunk decodes them as garbage.
@@ -2223,8 +2324,9 @@ class ServingEngine:
                     counts[slot] = state.count
                     n_active += 1
             if self._draft_model is not None:
-                with self._ctx(), events.span("decode/dispatch",
-                                              active=n_active):
+                with self._ctx(), events.span(
+                        "decode/dispatch", active=n_active,
+                        fused=self._fused_tag):
                     self._flush_stale_lanes()
                     (self._cache, self._d_cache, emit, emitted,
                      next_tok, acc, _) = self._spec_round(
@@ -2240,8 +2342,9 @@ class ServingEngine:
                 with events.span("decode/harvest", overlapped=False):
                     self._harvest_spec(*args)
             else:
-                with self._ctx(), events.span("decode/dispatch",
-                                              active=n_active):
+                with self._ctx(), events.span(
+                        "decode/dispatch", active=n_active,
+                        fused=self._fused_tag):
                     self._flush_stale_lanes()
                     self._cache, toks, _, _ = self._decode_chunk(
                         self._variables, self._cache, jnp.asarray(tok),

@@ -5,8 +5,15 @@ The HOST half of the serving engine's paged KV cache
 memory is one fixed pool of ``[num_blocks, block_size, kv_heads,
 head_dim]`` rows per layer (static shape — jit/sharding see one
 allocation for the whole session, the Mesh-TensorFlow static-shape
-rule); WHICH physical block backs WHICH logical position of WHICH lane
-is pure host bookkeeping, and this module owns all of it:
+rule; ``kv_cache_int8`` configs store int8 rows with a parallel
+``[2, num_blocks, block_size, kv_heads]`` f32 scale pool — same block
+ids, half the row bytes, so every table this module hands out covers
+both).  The DEVICE read is either an XLA block gather or the fused
+paged-attention kernel (``ops.pallas_kernels.paged_attention``) —
+both steer their DMA by the tables built here, so this bookkeeping is
+layout-authoritative for both legs.  WHICH physical block backs WHICH
+logical position of WHICH lane is pure host bookkeeping, and this
+module owns all of it:
 
 - ``KVBlockPool``: a free list + per-block reference counts over the
   ``n_blocks`` allocatable physical blocks.  Block id 0 is RESERVED as

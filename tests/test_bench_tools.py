@@ -266,3 +266,63 @@ def test_bench_emit_headline_is_bounded_and_last(tmp_path, monkeypatch):
     # Full record persisted verbatim for archaeology.
     with open(bench.FULL_EMIT_PATH) as f:
         assert json.load(f)["error"] == "x" * 500
+
+
+# ── decode MBU fields (the serving benches' shared byte model) ─────────
+
+
+class TestDecodeMbuFields:
+    """``bench_gateway.decode_mbu_fields`` — the model-bandwidth
+    companion every serving record now carries: the byte model follows
+    bench_generate's convention (cast params once + the slot-grid KV
+    working set per decode step; int8 halves rows and adds f32
+    scales), and off-TPU ``mbu_pct`` is honestly null, never a made-up
+    number."""
+
+    @pytest.fixture(scope="class")
+    def mbu_mod(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_gateway_under_test",
+            os.path.join(_TOOLS, "bench_gateway.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @pytest.fixture(scope="class")
+    def cfg(self):
+        from tensorflow_train_distributed_tpu.models.llama import (
+            LLAMA_PRESETS,
+        )
+
+        return LLAMA_PRESETS["llama_tiny"]
+
+    def test_byte_model_and_cpu_null(self, mbu_mod, cfg):
+        import jax.numpy as jnp
+
+        n_params, slots, rows = 1000, 4, 64
+        out = mbu_mod.decode_mbu_fields(cfg, n_params, slots, rows,
+                                        tokens_per_sec=100.0)
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        kvh = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.d_model // cfg.num_heads
+        want = (n_params * itemsize
+                + 2 * cfg.num_layers * slots * rows * kvh * hd
+                * itemsize)
+        assert out["decode_bytes_per_step"] == want
+        assert out["mbu_pct"] is None      # CPU: no bandwidth table
+
+    def test_int8_halves_rows_adds_scales(self, mbu_mod, cfg):
+        import jax.numpy as jnp
+
+        n_params, slots, rows = 1000, 4, 64
+        fp = mbu_mod.decode_mbu_fields(cfg, n_params, slots, rows,
+                                       100.0)
+        q8 = mbu_mod.decode_mbu_fields(cfg, n_params, slots, rows,
+                                       100.0, kv_int8=True)
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        kvh = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.d_model // cfg.num_heads
+        kv_rows = 2 * cfg.num_layers * slots * rows * kvh
+        assert (fp["decode_bytes_per_step"]
+                - q8["decode_bytes_per_step"]
+                == kv_rows * hd * (itemsize - 1) - kv_rows * 4)

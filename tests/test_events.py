@@ -296,6 +296,35 @@ def test_trace_report_renders_tables_and_waterfall(tmp_path, capsys):
     assert "class=crash" in out            # journal overlay
 
 
+def test_trace_report_counts_fused_dispatches(tmp_path):
+    """The paged-KV summary reports how many decode dispatches ran the
+    fused paged-attention kernel (the ``decode/dispatch`` span's
+    ``fused`` tag the engine stamps per chunk) — and a gather-leg
+    window (fused=0) truthfully reports zero."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__),
+                                     "..", "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rec = Recorder(capacity=64)
+    with rec.span("decode/dispatch", active=2, fused=1):
+        pass
+    with rec.span("decode/dispatch", active=2, fused=1):
+        pass
+    with rec.span("decode/dispatch", active=1, fused=0):
+        pass
+    rec.instant("kv/prefix_hit", rid=1, tokens=8)
+    path = tmp_path / "trace.json"
+    rec.save(str(path))
+    kv = mod.kv_cache_summary(mod.load_events(str(path)))
+    assert kv["fused_attn_dispatches"] == 2
+    assert kv["prefix_hit_tokens"] == 8
+
+
 # ── supervisor instants ────────────────────────────────────────────────
 
 

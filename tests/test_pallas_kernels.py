@@ -131,6 +131,146 @@ def test_env_kill_switch_disables_pallas(monkeypatch):
     assert pk._use_pallas(None) is False
 
 
+class TestPagedAttention:
+    """The fused paged-attention decode kernel vs its pure-jax oracle
+    (``paged_attention_reference`` — the exact math of the engine's
+    XLA block-gather leg).  A gather has no math; attention does, so
+    the bar is tight-tolerance numerics, with layout/masking cases
+    pinned exactly: GQA head groups, ragged per-lane lengths,
+    scratch-block-0 lanes, and a stale/garbage block-table lane (the
+    overlap scheduler's reset-lane case)."""
+
+    @staticmethod
+    def _mk(lanes, q_len, heads, kvh, hd=8, nb=9, bs=4, n_blk=5,
+            seed=0, lengths=None):
+        rng = np.random.default_rng(seed)
+        kp = jnp.asarray(rng.normal(size=(nb, bs, kvh, hd)).astype(
+            np.float32))
+        vp = jnp.asarray(rng.normal(size=(nb, bs, kvh, hd)).astype(
+            np.float32))
+        table = jnp.asarray(rng.integers(0, nb, (lanes, n_blk)).astype(
+            np.int32))
+        if lengths is None:
+            lengths = rng.integers(0, n_blk * bs - q_len + 1, lanes)
+        lengths = jnp.asarray(np.asarray(lengths, np.int32))
+        q = jnp.asarray(rng.normal(
+            size=(lanes, q_len, heads, hd)).astype(np.float32))
+        return q, kp, vp, table, lengths
+
+    @pytest.mark.parametrize("heads,kvh,q_len", [
+        (4, 2, 1),    # GQA, single-token decode step
+        (4, 1, 3),    # MQA-extreme, speculative verify block
+        (2, 2, 2),    # MHA, multi-token
+    ])
+    def test_kernel_matches_oracle(self, heads, kvh, q_len):
+        q, kp, vp, table, lengths = self._mk(3, q_len, heads, kvh)
+        ref = pk.paged_attention_reference(q, kp, vp, table, lengths)
+        out = pk.paged_attention(q, kp, vp, table, lengths,
+                                 use_pallas=True, interpret=True)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ragged_lane_lengths(self):
+        # Length 0 (fresh lane: only its own new rows visible), a
+        # mid-block length, and a block-aligned one — all in one grid.
+        q, kp, vp, table, lengths = self._mk(
+            3, 2, 4, 2, lengths=[0, 7, 16])
+        ref = pk.paged_attention_reference(q, kp, vp, table, lengths)
+        out = pk.paged_attention(q, kp, vp, table, lengths,
+                                 use_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_scratch_block_zero_lane_masked(self):
+        """A reset lane (table all scratch-0, length 0 — what the
+        engine's ``_reset_lanes`` leaves behind) must produce the
+        oracle's exact garbage-in-garbage-out and stay finite: the
+        masking gives query i exactly rows 0..i of the scratch block,
+        never NaN."""
+        q, kp, vp, table, lengths = self._mk(3, 2, 4, 2)
+        table = table.at[1].set(0)
+        lengths = lengths.at[1].set(0)
+        ref = pk.paged_attention_reference(q, kp, vp, table, lengths)
+        out = pk.paged_attention(q, kp, vp, table, lengths,
+                                 use_pallas=True, interpret=True)
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_stale_garbage_table_lane_isolated(self):
+        """The overlap scheduler's one garbage chunk: a lane whose
+        table holds stale physical ids (blocks now owned by OTHERS)
+        must not perturb its neighbors — their rows are read-only to
+        the attention, so the healthy lanes' outputs are BITWISE equal
+        with and without the garbage lane's corruption."""
+        q, kp, vp, table, lengths = self._mk(3, 1, 4, 2)
+        clean = pk.paged_attention(q, kp, vp, table, lengths,
+                                   use_pallas=True, interpret=True)
+        garbage_table = table.at[1].set(
+            jnp.asarray([8, 8, 3, 1, 2], jnp.int32))
+        dirty = pk.paged_attention(q, kp, vp, garbage_table, lengths,
+                                   use_pallas=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(clean[0]),
+                                      np.asarray(dirty[0]))
+        np.testing.assert_array_equal(np.asarray(clean[2]),
+                                      np.asarray(dirty[2]))
+
+    def test_int8_pool_dequant_matches_oracle(self):
+        rng = np.random.default_rng(3)
+        nb, bs, kvh, hd = 7, 4, 2, 8
+        q, _, _, table, lengths = self._mk(3, 2, 4, kvh, hd=hd, nb=nb,
+                                           bs=bs, seed=3)
+        kp = jnp.asarray(rng.integers(-127, 128,
+                                      (nb, bs, kvh, hd)).astype(np.int8))
+        vp = jnp.asarray(rng.integers(-127, 128,
+                                      (nb, bs, kvh, hd)).astype(np.int8))
+        ks = jnp.asarray((np.abs(rng.normal(size=(nb, bs, kvh)))
+                          .astype(np.float32) / 127.0) + 1e-3)
+        vs = jnp.asarray((np.abs(rng.normal(size=(nb, bs, kvh)))
+                          .astype(np.float32) / 127.0) + 1e-3)
+        ref = pk.paged_attention_reference(q, kp, vp, table, lengths,
+                                           k_scales=ks, v_scales=vs)
+        out = pk.paged_attention(q, kp, vp, table, lengths,
+                                 k_scales=ks, v_scales=vs,
+                                 use_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cpu_path_uses_reference(self):
+        # On this CPU backend the public entry must route to the
+        # reference — BITWISE equal (it IS the reference), the property
+        # that makes TTD_NO_FUSED_ATTN parity trivial off-TPU.
+        q, kp, vp, table, lengths = self._mk(2, 1, 2, 2)
+        out = pk.paged_attention(q, kp, vp, table, lengths)
+        ref = pk.paged_attention_reference(q, kp, vp, table, lengths)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_fused_attn_kill_switches(monkeypatch):
+    """TTD_NO_FUSED_ATTN wins over everything (the production kill
+    switch back to the XLA block-gather leg); TTD_FUSED_ATTN_INTERPRET
+    forces the kernel ON off-TPU (the CPU parity-test path); default
+    follows the backend."""
+    monkeypatch.setenv("TTD_NO_FUSED_ATTN", "1")
+    assert pk.use_fused_paged_attention() is False
+    monkeypatch.setenv("TTD_FUSED_ATTN_INTERPRET", "1")
+    assert pk.use_fused_paged_attention() is False  # kill switch wins
+    monkeypatch.delenv("TTD_NO_FUSED_ATTN")
+    assert pk.use_fused_paged_attention() is True
+    assert pk.fused_attn_interpret() is (
+        __import__("jax").default_backend() != "tpu")
+    monkeypatch.delenv("TTD_FUSED_ATTN_INTERPRET")
+    assert pk.use_fused_paged_attention() is (
+        __import__("jax").default_backend() == "tpu")
+    assert pk.fused_attn_interpret() is False
+    # "0"/"false" mean OFF for both flags (the env_flag parser).
+    monkeypatch.setenv("TTD_NO_FUSED_ATTN", "0")
+    monkeypatch.setenv("TTD_FUSED_ATTN_INTERPRET", "false")
+    assert pk.use_fused_paged_attention() is (
+        __import__("jax").default_backend() == "tpu")
+
+
 class TestPagedKvGather:
     """The serving engine's paged-KV gather: the scalar-prefetch block
     copy must move exactly the reference's bytes (a gather has no math

@@ -110,9 +110,13 @@ def test_validation_errors(params):
     wcfg = dataclasses.replace(CFG, sliding_window=8)
     with pytest.raises(ValueError, match="sliding_window"):
         ServingEngine(wcfg, params)
+    # kv_cache_int8 configs SERVE through the engine since PR 11 (the
+    # per-slot and paged caches quantize with the linear recipe) — the
+    # old rejection must stay lifted.
     icfg = dataclasses.replace(CFG, kv_cache_int8=True)
-    with pytest.raises(ValueError, match="kv_cache_int8|linear"):
-        ServingEngine(icfg, params)
+    eng8 = ServingEngine(icfg, params, slots=2, cache_len=32,
+                         prompt_buckets=(8,))
+    assert eng8.kv_cache_int8 and eng8.paged
 
 
 def test_slot_decode_layer_guards():

@@ -162,6 +162,78 @@ def test_paged_engine_smoke_matches_generate(params):
     eng._radix.check_invariants()
 
 
+def test_fused_kill_switch_bitwise_and_attrs(params, monkeypatch):
+    """Fast-tier canary for the fused paged-attention plumbing: on CPU
+    the fused kernel never engages (``fused_attn()`` False), so the
+    default engine and the ``TTD_NO_FUSED_ATTN=1`` engine must be
+    BITWISE identical — the kill-switch plumbing changes dispatch,
+    never math; and ``kv_pool_bytes`` truthfully reports the pool's
+    device footprint (0 on the linear engine)."""
+    rng = np.random.default_rng(7)
+    reqs = [(list(rng.integers(1, 200, 5)), 4),
+            (list(rng.integers(1, 200, 3)), 5)]
+    out, eng = _serve(params, reqs, slots=2, cache_len=32, chunk=2,
+                      prompt_buckets=(8,), kv_block_size=4)
+    assert eng.fused_attn() is False          # CPU: gather path
+    assert eng.kv_pool_bytes() > 0
+    monkeypatch.setenv("TTD_NO_FUSED_ATTN", "1")
+    killed, eng_k = _serve(params, reqs, slots=2, cache_len=32, chunk=2,
+                           prompt_buckets=(8,), kv_block_size=4)
+    assert eng_k.fused_attn() is False
+    assert killed == out
+    monkeypatch.delenv("TTD_NO_FUSED_ATTN")
+    lin, eng_l = _serve(params, reqs, slots=2, cache_len=32, chunk=2,
+                        prompt_buckets=(8,), kv_block_size=4,
+                        paged=False)
+    assert eng_l.kv_pool_bytes() == 0 and eng_l.fused_attn() is False
+
+
+ICFG = dataclasses.replace(CFG, kv_cache_int8=True)
+
+
+def _serve_cfg(cfg, params, reqs, *, seeds=None, **kw):
+    eng = ServingEngine(cfg, params, **kw)
+    seeds = seeds or [None] * len(reqs)
+    ids = [eng.submit(p, m, seed=s) for (p, m), s in zip(reqs, seeds)]
+    out = eng.run()
+    return [out[i] for i in ids], eng
+
+
+def _ref_cfg(cfg, params, prompt, max_new, **kw):
+    from tensorflow_train_distributed_tpu.models.generate import generate
+
+    return np.asarray(generate(
+        cfg, params, jnp.asarray([prompt], jnp.int32), max_new,
+        **kw))[0].tolist()
+
+
+def test_int8_paged_engine_smoke_matches_generate(params):
+    """Fast-tier canary for the int8 paged pool: a kv_cache_int8
+    config SERVES through the engine (the old rejection is lifted),
+    the pool stores int8 rows + a parallel f32 scale pool, and greedy
+    outputs are token-identical to generate() with the same config
+    (the linear-cache int8 recipe applied block-wise — same quantized
+    bytes, different layout)."""
+    rng = np.random.default_rng(1)
+    reqs = [(list(rng.integers(1, 200, 5)), 4),
+            (list(rng.integers(1, 200, 3)), 5)]
+    out, eng = _serve_cfg(ICFG, params, reqs, slots=2, cache_len=32,
+                          chunk=2, prompt_buckets=(8,), kv_block_size=4)
+    assert eng.paged and eng.kv_cache_int8
+    for o, (p, m) in zip(out, reqs):
+        assert o == _ref_cfg(ICFG, params, p, m)
+    kinds = {p[-1].key: leaf.dtype for p, leaf in
+             jax.tree_util.tree_flatten_with_path(eng._cache)[0]}
+    assert kinds["key_pool"] == jnp.int8
+    assert kinds["value_pool"] == jnp.int8
+    assert kinds["kv_pool_scales"] == jnp.float32
+    # int8 pool + f32 scales < the fp32 pool it replaces.
+    _, eng_fp = _serve_cfg(CFG, params, reqs, slots=2, cache_len=32,
+                           chunk=2, prompt_buckets=(8,),
+                           kv_block_size=4)
+    assert eng.kv_pool_bytes() < eng_fp.kv_pool_bytes()
+
+
 # ── slow tier: the full parity matrix ──────────────────────────────────
 
 pytestmark_slow = pytest.mark.slow
@@ -382,6 +454,143 @@ def test_paged_rejects_nothing_linear_accepts(params):
     wcfg = dataclasses.replace(CFG, sliding_window=8)
     with pytest.raises(ValueError, match="sliding_window"):
         ServingEngine(wcfg, params)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sampling", [
+    dict(),
+    dict(temperature=0.9, top_k=16),
+])
+def test_int8_paged_matches_linear_with_refills(params, sampling):
+    """kv_cache_int8 through two slots with every lane refilling:
+    paged == the int8 LINEAR engine bitwise (same quantized rows, same
+    scales, different physical layout) for greedy and seeded
+    sampling — the 'int8-pool parity pinned against the linear-cache
+    kv_cache_int8 path at matched config' acceptance bar."""
+    rng = np.random.default_rng(11)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 6), (3, 9), (7, 4), (4, 8), (6, 1)]]
+    seeds = [11, 22, 33, 44, 55]
+    kw = dict(slots=2, cache_len=64, chunk=4, prompt_buckets=(8, 16),
+              kv_block_size=4, **sampling)
+    out, _ = _serve_cfg(ICFG, params, reqs, seeds=seeds, **kw)
+    lin, _ = _serve_cfg(ICFG, params, reqs, seeds=seeds, paged=False,
+                        **kw)
+    assert out == lin
+    # And token-identical to the shared-index generate() path (greedy
+    # only: generate's sampling streams are per-batch, not comparable).
+    if not sampling:
+        for o, (p, m) in zip(out, reqs):
+            assert o == _ref_cfg(ICFG, params, p, m)
+
+
+@pytest.mark.slow
+def test_int8_paged_speculative_and_prefix(params):
+    """int8 composes with the rest of the paged feature set:
+    speculative serving (int8 target AND int8 draft — shared block
+    tables, both pools quantized) and radix prefix sharing (the
+    ``_gather_prefix`` copy carries the scale rows, so a prefix hit
+    reads the exact bytes the original prefill quantized)."""
+    rng = np.random.default_rng(12)
+    reqs = [(list(rng.integers(1, 200, n)), m)
+            for n, m in [(5, 8), (7, 6), (3, 9)]]
+    kw = dict(slots=2, cache_len=48, chunk=3, prompt_buckets=(8,),
+              kv_block_size=4, draft_config=ICFG, draft_params=params,
+              speculative_k=3)
+    out, eng = _serve_cfg(ICFG, params, reqs, seeds=[1, 2, 3], **kw)
+    lin, _ = _serve_cfg(ICFG, params, reqs, seeds=[1, 2, 3],
+                        paged=False, **kw)
+    assert out == lin
+    assert eng.spec_stats["rounds"] >= 1
+    # Prefix sharing: a block-aligned shared prefix hits warm int8 KV
+    # and the continuation still equals generate().
+    pre = list(rng.integers(1, 200, 8))
+    a = pre + list(rng.integers(1, 200, 3))
+    b = pre + list(rng.integers(1, 200, 3))
+    eng2 = ServingEngine(ICFG, params, slots=2, cache_len=48, chunk=3,
+                         prompt_buckets=(16,), kv_block_size=4)
+    ia = eng2.submit(a, 6)
+    o1 = eng2.run()
+    ib = eng2.submit(b, 6)
+    o2 = eng2.run()
+    assert o1[ia] == _ref_cfg(ICFG, params, a, 6)
+    assert o2[ib] == _ref_cfg(ICFG, params, b, 6)
+    assert eng2.kv_stats["prefix_hit_tokens"] >= 8
+    # preload_prefix seeds the same int8 pool.
+    eng3 = ServingEngine(ICFG, params, slots=2, cache_len=48, chunk=3,
+                         prompt_buckets=(16,), kv_block_size=4)
+    eng3.preload_prefix(pre)
+    ic = eng3.submit(a, 6)
+    assert eng3.run()[ic] == _ref_cfg(ICFG, params, a, 6)
+
+
+@pytest.mark.slow
+def test_fused_interpret_parity_matrix(params, monkeypatch):
+    """The fused-kernel serving parity bar, exercised FOR REAL on CPU:
+    ``TTD_FUSED_ATTN_INTERPRET=1`` compiles the decode programs with
+    the interpret-mode fused kernel, and every scenario — greedy,
+    seeded sampling, speculative, staged-prefill interleave,
+    prefix-hit admission, mid-stream cancel, int8 pool — must produce
+    the SAME TOKENS as the ``TTD_NO_FUSED_ATTN=1`` XLA block-gather
+    leg.  Both legs are deterministic functions of the same inputs, so
+    token equality here is a stable pin, not a flaky race."""
+    rng = np.random.default_rng(13)
+    pre = list(rng.integers(1, 200, 8))
+    reqs = [(list(rng.integers(1, 200, 5)), 8),
+            (pre + list(rng.integers(1, 200, 3)), 6),
+            (pre + list(rng.integers(1, 200, 4)), 5)]
+    long_req = (list(rng.integers(1, 200, 24)), 6)
+
+    def scenario(cfg, **kw):
+        eng = ServingEngine(cfg, params, slots=2, cache_len=64, chunk=3,
+                            prompt_buckets=(8,), prefill_chunk=8,
+                            kv_block_size=4, **kw)
+        ids = [eng.submit(p, m, seed=5 + i)
+               for i, (p, m) in enumerate(reqs)]
+        eng.serve_step()
+        ids.append(eng.submit(*long_req, seed=99))  # staged interleave
+        victim = eng.submit(list(rng.integers(1, 200, 5)), 9, seed=42)
+        eng.serve_step()
+        assert eng.cancel(victim)                   # mid-stream cancel
+        out = {}
+        while eng.pending():
+            out.update(eng.serve_step())
+        return [out[i] for i in ids], eng
+
+    def legs(cfg, **kw):
+        monkeypatch.setenv("TTD_FUSED_ATTN_INTERPRET", "1")
+        fused, eng_f = scenario(cfg, **kw)
+        assert eng_f.fused_attn() is True
+        monkeypatch.delenv("TTD_FUSED_ATTN_INTERPRET")
+        monkeypatch.setenv("TTD_NO_FUSED_ATTN", "1")
+        gather, eng_g = scenario(cfg, **kw)
+        assert eng_g.fused_attn() is False
+        monkeypatch.delenv("TTD_NO_FUSED_ATTN")
+        return fused, gather
+
+    for cfg in (CFG, ICFG):
+        fused, gather = legs(cfg)                       # greedy
+        assert fused == gather
+        fused, gather = legs(cfg, temperature=0.8, top_k=16)  # sampled
+        assert fused == gather
+    fused, gather = legs(CFG, draft_config=CFG, draft_params=params,
+                         speculative_k=3)               # speculative
+    assert fused == gather
+
+
+@pytest.mark.slow
+def test_engine_accepts_int8_rejects_windows(params):
+    """The PR-11 screen shape: kv_cache_int8 configs construct and
+    serve (the stale 'serves through models.generate' claim is gone);
+    rolling-window/sink configs still fail loudly, without blaming
+    int8."""
+    eng = ServingEngine(ICFG, params, slots=1, cache_len=16, chunk=2,
+                        prompt_buckets=(8,))
+    assert eng.kv_cache_int8
+    wcfg = dataclasses.replace(CFG, sliding_window=8)
+    with pytest.raises(ValueError, match="sliding_window") as ei:
+        ServingEngine(wcfg, params)
+    assert "kv_cache_int8 is supported" in str(ei.value)
 
 
 @pytest.mark.slow

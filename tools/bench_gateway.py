@@ -62,6 +62,50 @@ def _requests_for(client: int, n: int, plo, phi, glo, ghi, vocab, seed):
              int(rng.integers(glo, ghi + 1))) for _ in range(n)]
 
 
+def decode_mbu_fields(cfg, n_params, slots, cache_len,
+                      tokens_per_sec, kv_int8=False):
+    """Model-bandwidth-utilization fields for a DECODE-side serving
+    record — the serving analog of training MFU, so every committed
+    engine/gateway record carries the headline metric decode
+    optimization is judged by (bench_generate's convention, shared by
+    bench_serving and bench_gateway).
+
+    Byte model per decode step (one token for every slot): the cast
+    params stream once + the slot-grid KV working set (2 tensors × L ×
+    slots × cache_len × kv_heads × head_dim at the cache dtype; int8
+    adds its f32 per-row scales).  Steps/sec is tokens_per_sec /
+    slots — generated tok/s counts all lanes, a full step emits one
+    token per lane.  ``mbu_pct`` is None off-TPU (no bandwidth table —
+    the field still lands in every record so TPU reruns of the same
+    harness fill it in).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.training.memory import (
+        hbm_bandwidth_bytes_per_sec,
+    )
+
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.d_model // cfg.num_heads
+    kv_rows = 2 * cfg.num_layers * slots * cache_len * kv_heads
+    cache_bytes = kv_rows * head_dim * (1 if kv_int8 else itemsize)
+    if kv_int8:
+        cache_bytes += kv_rows * 4          # f32 per-row scales
+    bytes_per_step = n_params * itemsize + cache_bytes
+    out = {"decode_bytes_per_step": int(bytes_per_step),
+           "mbu_pct": None}
+    dev = jax.devices()[0]
+    bw = (hbm_bandwidth_bytes_per_sec(dev.device_kind)
+          if dev.platform == "tpu" else None)
+    if bw and tokens_per_sec:
+        steps_per_sec = tokens_per_sec / slots
+        out["mbu_pct"] = round(
+            100.0 * bytes_per_step * steps_per_sec / bw, 2)
+    return out
+
+
 def _post(base_url: str, body: dict, timeout: float):
     """(status, parsed_json, retry_after_s) — errors surface as status;
     network-level failures (timeout, refused, reset) as status 0, so a
@@ -454,8 +498,14 @@ def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
     dev = jax.devices()[0]
     rec["backend"] = dev.platform
     rec["device_kind"] = dev.device_kind
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    rows = cache_len or cfg.max_positions
+    rec.update(decode_mbu_fields(cfg, n_params, slots, rows,
+                                 rec["value"]))
     if overlap_ab:
         off = one_mode(overlap=False)
+        off.update(decode_mbu_fields(cfg, n_params, slots, rows,
+                                     off["tokens_per_sec"]))
         rec["no_overlap"] = off
         if rec["value"] and off["tokens_per_sec"]:
             rec["overlap_speedup"] = round(

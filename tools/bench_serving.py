@@ -27,6 +27,17 @@ identical passes of one engine, reporting the tok/s overhead
 percentage — the committed proof the recorder is cheap enough to leave
 on (``profiles/bench/trace_overhead_ab.jsonl``).
 
+``--fused-ab`` runs the fused paged-attention decode push's three
+stacked A/Bs (fused kernel vs the ``TTD_NO_FUSED_ATTN`` XLA
+block-gather leg, int8 KV pool vs fp, and the ``--sweep-slots``
+capacity-growth curve) — committed to
+``profiles/bench/fused_attn_ab.jsonl``.
+
+Every decode record carries ``mbu_pct`` (model-bandwidth utilization,
+the serving analog of training MFU — null off-TPU where no bandwidth
+table exists) beside tok/s, so the metric decode optimization is
+judged by lands in every committed record.
+
 Prints one JSON line per run (bench_lm.py conventions).
 """
 
@@ -41,7 +52,10 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root (the package)
 sys.path.insert(0, _HERE)                   # tools/ siblings
 
-from bench_gateway import _percentile  # noqa: E402 (shared helper)
+from bench_gateway import (  # noqa: E402 (shared helpers)
+    _percentile,
+    decode_mbu_fields,
+)
 
 
 def _requests(n, plo, phi, glo, ghi, vocab, seed):
@@ -413,9 +427,11 @@ def bench_paged_kv_ab(preset, slots, chunk, n_requests, prefix_len,
         ratios.sort()
         return eng, best, hits, ratios[len(ratios) // 2], ratios
 
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
     def leg(best, hits, gen_tokens):
         wall, ttfts, itls, _ = best
-        return {
+        out = {
             "tokens_per_sec": round(gen_tokens / wall, 1),
             "wall_s": round(wall, 3),
             "ttft_ms_p50": round(1e3 * _percentile(ttfts, 0.5), 2),
@@ -423,6 +439,9 @@ def bench_paged_kv_ab(preset, slots, chunk, n_requests, prefix_len,
                 1e3 * sum(itls) / len(itls), 3) if itls else 0.0,
             "prefix_hit_tokens": hits,
         }
+        out.update(decode_mbu_fields(cfg, n_params, slots, cache_len,
+                                     out["tokens_per_sec"]))
+        return out
 
     gen_tokens = n_requests * new
     _, s_best, s_hits, s_ratio, s_ratios = ab(shared_pass)
@@ -464,10 +483,191 @@ def bench_paged_kv_ab(preset, slots, chunk, n_requests, prefix_len,
     return rec
 
 
+def bench_fused_attn_ab(preset, slots, chunk, n_requests, prompt_range,
+                        new_range, cache_len, seed, kv_block_size,
+                        sweep_slots, reps=3):
+    """The --fused-ab run: the three stacked decode-speed stages of the
+    fused paged-attention push, each as its own A/B, one committed
+    record (``profiles/bench/fused_attn_ab.jsonl``).
+
+    1. **fused vs gather** — one engine compiled with the fused
+       paged-attention kernel (the default), one under the
+       ``TTD_NO_FUSED_ATTN=1`` kill switch (the XLA block-gather leg);
+       the env choice burns into the compiled programs, so each leg is
+       its own warmed engine and the switch flips around CONSTRUCTION,
+       not the timed passes.  On CPU both legs compile the gather
+       program — the committed ratio ~1.0 IS the no-regression bar
+       (≤2%), and the same harness run on TPU measures the real
+       kernel.
+    2. **int8 pool vs fp** — ``kv_cache_int8`` engine vs the
+       full-precision pool at the same shape (half the cache bytes on
+       the bandwidth-bound path; CPU pays the quantize/dequant compute
+       honestly).
+    3. **capacity growth** — the freed HBM spent: slots grown along
+       ``sweep_slots`` with the pool sized to match
+       (slots × ceil(cache_len / block_size) int8 blocks), tok/s +
+       ``mbu_pct`` + ``kv_pool_bytes`` per point — the raw decode-MBU
+       curve ROADMAP item 2 asks for.
+
+    All timed pairs follow the trace-ab noise discipline:
+    leg-order-alternating back-to-back pairs, median of per-pair wall
+    ratios.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    icfg = dataclasses.replace(cfg, kv_cache_int8=True)
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    vocab = min(cfg.vocab_size, 30_000)
+    reqs = _requests(n_requests, *prompt_range, *new_range, vocab, seed)
+    gen_tokens = sum(m for _, m in reqs)
+    rows = cache_len or cfg.max_positions
+    nblk_lane = -(-rows // kv_block_size)
+
+    def build(config, fused_killed, s=slots, pool=None):
+        """Construct + warm an engine under the requested kill-switch
+        state (the fused/gather choice compiles in at first trace;
+        after warmup the jit cache pins it, so the timed passes below
+        need no env management)."""
+        had = os.environ.get("TTD_NO_FUSED_ATTN")
+        if fused_killed:
+            os.environ["TTD_NO_FUSED_ATTN"] = "1"
+        else:
+            os.environ.pop("TTD_NO_FUSED_ATTN", None)
+        try:
+            e = ServingEngine(config, params, slots=s, chunk=chunk,
+                              cache_len=cache_len,
+                              kv_block_size=kv_block_size,
+                              kv_pool_blocks=pool)
+            for p, m in reqs:                      # warmup: compiles
+                e.submit(p, m)
+            e.run()
+        finally:
+            if had is None:
+                os.environ.pop("TTD_NO_FUSED_ATTN", None)
+            else:
+                os.environ["TTD_NO_FUSED_ATTN"] = had
+        return e
+
+    def ab(eng_a, eng_b, kv8_a=False, kv8_b=False):
+        """Leg-order-alternating pairs → (leg_a, leg_b, median of
+        per-pair wall ratios b/a, ratios).  >1 means leg a faster."""
+        best = {"a": None, "b": None}
+        ratios = []
+        for i in range(max(1, reps)):
+            walls = {}
+            for tag in (("a", "b") if i % 2 == 0 else ("b", "a")):
+                e = eng_a if tag == "a" else eng_b
+                r = _run_engine_timed(e, reqs)
+                walls[tag] = r[0]
+                if best[tag] is None or r[0] < best[tag][0]:
+                    best[tag] = r
+            ratios.append(walls["b"] / walls["a"])
+        ratios.sort()
+
+        def leg(b, s, kv8):
+            wall, ttfts, itls, _ = b
+            out = {
+                "tokens_per_sec": round(gen_tokens / wall, 1),
+                "wall_s": round(wall, 3),
+                "ttft_ms_p50": round(1e3 * _percentile(ttfts, 0.5), 2),
+            }
+            out.update(decode_mbu_fields(
+                cfg, n_params, s, rows, out["tokens_per_sec"], kv8))
+            return out
+
+        return (leg(best["a"], slots, kv8_a), leg(best["b"], slots,
+                                                  kv8_b),
+                ratios[len(ratios) // 2],
+                [round(r, 4) for r in ratios])
+
+    # Stage 1: fused vs the TTD_NO_FUSED_ATTN gather leg.
+    eng_fused = build(cfg, fused_killed=False)
+    eng_gather = build(cfg, fused_killed=True)
+    fused_leg, gather_leg, fused_ratio, fused_ratios = ab(
+        eng_fused, eng_gather)
+
+    # Stage 2: int8 pool vs fp (both on the default fused/gather
+    # choice — the fp leg reuses stage 1's engine).
+    eng_int8 = build(icfg, fused_killed=False)
+    int8_leg, fp_leg, int8_ratio, int8_ratios = ab(
+        eng_int8, eng_fused, kv8_a=True)
+    int8_leg["kv_pool_bytes"] = eng_int8.kv_pool_bytes()
+    fp_leg["kv_pool_bytes"] = eng_fused.kv_pool_bytes()
+
+    # Stage 3: spend the freed HBM — slots (and the pool with them)
+    # grown along the sweep, int8 pools, mbu per point.  The stage-1/2
+    # engines are fully consumed: drop them BEFORE the sweep, or their
+    # three pinned pools (+ cast param copies) shrink the very HBM
+    # headroom the largest sweep points exist to probe.
+    fused_engaged = eng_fused.fused_attn()
+    del eng_fused, eng_gather, eng_int8
+    growth = []
+    for s in sweep_slots:
+        e = build(icfg, fused_killed=False, s=s, pool=s * nblk_lane)
+        best = None
+        for _ in range(max(1, reps)):
+            r = _run_engine_timed(e, reqs)
+            if best is None or r[0] < best[0]:
+                best = r
+        tps = round(gen_tokens / best[0], 1)
+        point = {"slots": s, "kv_pool_blocks": s * nblk_lane,
+                 "kv_pool_bytes": e.kv_pool_bytes(),
+                 "tokens_per_sec": tps,
+                 "wall_s": round(best[0], 3)}
+        point.update(decode_mbu_fields(icfg, n_params, s, rows, tps,
+                                       True))
+        growth.append(point)
+
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_serving_fused_attn_wall_ratio",
+        "value": round(fused_ratio, 3),
+        "unit": "x wall, XLA block-gather leg vs fused paged-attention"
+                " leg (median of per-pair wall ratios; ~1.0 on CPU "
+                "where both legs compile the gather program — the "
+                "no-regression bar; >1 on TPU = fused faster)",
+        "fused_engaged": fused_engaged,
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n_requests,
+        "gen_tokens": gen_tokens,
+        "cache_len": rows,
+        "kv_block_size": kv_block_size,
+        "reps": reps,
+        "fused": fused_leg,
+        "gather": gather_leg,
+        "pair_wall_ratios": fused_ratios,
+        "int8_pool": {
+            "unit": "x wall, fp pool vs int8 pool (median of per-pair "
+                    "wall ratios; >1 = int8 faster)",
+            "wall_ratio_median": round(int8_ratio, 3),
+            "pair_wall_ratios": int8_ratios,
+            "int8": int8_leg,
+            "fp": fp_leg,
+        },
+        "pool_growth": growth,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def bench_serving(preset, slots, chunk, n_requests, prompt_range,
                   new_range, cache_len, baseline, seed,
                   draft_preset="", speculative_k=0, overlap_ab=True,
-                  reps=3):
+                  kv_int8=False, reps=3):
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -479,6 +679,10 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     from tensorflow_train_distributed_tpu.serving import ServingEngine
 
     cfg = LLAMA_PRESETS[preset]
+    if kv_int8:
+        # int8 paged/per-slot KV cache: half the cache bytes per decode
+        # step — params are layout-independent, so the same tree serves.
+        cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     params = LlamaModel(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
     reqs = _requests(n_requests, *prompt_range, *new_range,
@@ -494,6 +698,14 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         draft_cfg, draft_params = cfg, params
     elif draft_preset:
         draft_cfg = LLAMA_PRESETS[draft_preset]
+        if kv_int8:
+            # The draft's caches quantize in lockstep with the
+            # target's (the tools/serve.py --kv-int8 rule) — a
+            # '_kv8'-named record must not secretly serve an fp-KV
+            # draft.  The 'self' branch above shares cfg, already
+            # replaced.
+            draft_cfg = dataclasses.replace(draft_cfg,
+                                            kv_cache_int8=True)
         draft_params = LlamaModel(draft_cfg).init(
             jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
 
@@ -556,11 +768,18 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     on_rec, total_len = summarize(best_on)
     dt = on_rec["wall_s"]
     dev = jax.devices()[0]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    rows = cache_len or cfg.max_positions
+    mbu_of = lambda tps: decode_mbu_fields(  # noqa: E731 (leg helper)
+        cfg, n_params, slots, rows, tps, kv_int8)
     # Ceiling ('self') and floor (random-init) runs must be
     # distinguishable by metric name alone, not just the draft_preset
-    # field.
+    # field — and int8-KV runs by the _kv8 suffix (the bench_lm
+    # convention).
     name = (f"{preset}_serving_engine_spec_{draft_preset}"
             if draft_preset else f"{preset}_serving_engine")
+    if kv_int8:
+        name += "_kv8"
     rec = {
         "metric": f"{name}_tokens_per_sec",
         "value": on_rec["tokens_per_sec"],
@@ -575,13 +794,19 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
         "n_requests": n_requests,
         "gen_tokens": gen_tokens,
         "total_tokens_out": total_len,
+        "fused_attn": eng.fused_attn(),
         "backend": dev.platform,
         "device_kind": dev.device_kind,
     }
+    rec.update(mbu_of(on_rec["tokens_per_sec"]))
+    if kv_int8:
+        rec["kv_cache"] = "int8"
+        rec["kv_pool_bytes"] = eng.kv_pool_bytes()
     if overlap_ab:
         # The OFF leg: the synchronous path the TTD_NO_OVERLAP kill
         # switch restores — the host-stall A/B the headline claims.
         off_rec, _ = summarize(best_off)
+        off_rec.update(mbu_of(off_rec["tokens_per_sec"]))
         rec["no_overlap"] = off_rec
         if off_rec["wall_s"]:
             rec["overlap_speedup"] = round(
@@ -673,7 +898,27 @@ def main(argv=None) -> int:
                    help="--shared-prefix only: shared system prompt "
                         "length in tokens")
     p.add_argument("--kv-block-size", type=int, default=16,
-                   help="--shared-prefix only: paged-KV block size")
+                   help="--shared-prefix / --fused-ab: paged-KV block "
+                        "size")
+    p.add_argument("--fused-ab", action="store_true",
+                   help="fused paged-attention A/B instead of the "
+                        "throughput run: fused kernel vs the "
+                        "TTD_NO_FUSED_ATTN XLA block-gather leg, int8 "
+                        "KV pool vs fp, and the --sweep-slots capacity "
+                        "growth curve — tok/s + mbu_pct per leg "
+                        "(committed record: "
+                        "profiles/bench/fused_attn_ab.jsonl)")
+    p.add_argument("--sweep-slots", default="",
+                   help="--fused-ab only: comma-separated slot counts "
+                        "for the capacity-growth sweep (each point "
+                        "sizes the int8 pool to slots * "
+                        "ceil(cache_len/block_size)); default: "
+                        "slots,2*slots")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="throughput run with the int8 KV cache "
+                        "(kv_cache_int8 config): half the cache bytes "
+                        "per decode step; metric name gains the _kv8 "
+                        "suffix")
     p.add_argument("--trace-ab", action="store_true",
                    help="flight-recorder overhead A/B instead of the "
                         "throughput run: identical passes with the "
@@ -729,6 +974,15 @@ def main(argv=None) -> int:
                                      args.requests, prompt_range,
                                      new_range, args.cache_len or None,
                                      args.seed, reps=args.reps)
+            elif args.fused_ab:
+                sweep = ([int(s) for s in args.sweep_slots.split(",")]
+                         if args.sweep_slots
+                         else [args.slots, 2 * args.slots])
+                rec = bench_fused_attn_ab(
+                    args.preset, args.slots, args.chunk, args.requests,
+                    prompt_range, new_range, args.cache_len or None,
+                    args.seed, args.kv_block_size, sweep,
+                    reps=args.reps)
             else:
                 rec = bench_serving(args.preset, args.slots, args.chunk,
                                     args.requests, prompt_range,
@@ -739,6 +993,7 @@ def main(argv=None) -> int:
                                     draft_preset=args.speculative_draft,
                                     speculative_k=args.speculative_k,
                                     overlap_ab=not args.no_ab,
+                                    kv_int8=args.kv_int8,
                                     reps=args.reps)
     except Exception as e:
         if args.mixed:
@@ -751,6 +1006,10 @@ def main(argv=None) -> int:
         elif args.trace_ab:
             metric = f"{args.preset}_serving_trace_overhead_pct"
             unit = "% tok/s lost, flight recorder on vs TTD_NO_TRACE=1"
+        elif args.fused_ab:
+            metric = f"{args.preset}_serving_fused_attn_wall_ratio"
+            unit = ("x wall, XLA block-gather leg vs fused "
+                    "paged-attention leg")
         else:
             name = (f"{args.preset}_serving_engine_spec"
                     if args.speculative_draft

@@ -53,6 +53,25 @@ timeout 1200 python tools/bench_generate.py --preset llama_125m \
     --batch 8 --prompt-len 128 --max-new 256 --sliding-window 256 \
     2>>"$LOG" | tee -a "$LOG"
 
+say "--- 7. fused paged-attention decode push (3 stacked A/Bs in one run:"
+say "    fused kernel vs TTD_NO_FUSED_ATTN block-gather, int8 KV pool vs"
+say "    fp, --sweep-slots capacity growth; every leg carries mbu_pct) ---"
+timeout 2400 python tools/bench_serving.py --preset llama_125m \
+    --slots 32 --chunk 16 --requests 64 --prompt-range 16,120 \
+    --new-range 32,128 --cache-len 512 --kv-block-size 16 \
+    --fused-ab --sweep-slots 32,48,64 2>>"$LOG" | tee -a "$LOG"
+
+say "--- 8. kv-int8 engine throughput (paged pool; vs the fp leg the"
+say "    same flags produce without --kv-int8) ---"
+timeout 1200 python tools/bench_serving.py --preset llama_125m \
+    --slots 32 --chunk 16 --requests 64 --cache-len 512 --kv-int8 \
+    --no-ab 2>>"$LOG" | tee -a "$LOG"
+timeout 1200 python tools/bench_serving.py --preset llama_125m \
+    --slots 32 --chunk 16 --requests 64 --cache-len 512 \
+    --no-ab 2>>"$LOG" | tee -a "$LOG"
+
 say "=== playbook done $(date -u); results in $LOG ==="
 say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
-say "pallas verdict from 4 — keep whichever wins as the default)."
+say "pallas verdict from 4 — keep whichever wins as the default;"
+say "fused/int8/growth verdicts from 7-8 -> append the TPU legs to"
+say "profiles/bench/fused_attn_ab.jsonl and keep the faster default)."

@@ -76,6 +76,16 @@ def add_engine_args(p) -> None:
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--quant", default="", choices=["", "int8"])
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache (llama-family configs): cache "
+                        "rows store 1 byte + a per-row f32 scale — "
+                        "halves KV HBM in both the paged pool and the "
+                        "linear cache, the large-batch decode "
+                        "bandwidth lever. The freed memory is worth "
+                        "spending: grow --kv-pool-blocks (and --slots) "
+                        "into it. Composes with --quant and "
+                        "speculative serving (the draft's caches "
+                        "quantize too)")
     p.add_argument("--speculative-draft-config", default=None,
                    help="enable speculative serving: registry config of "
                         "the DRAFT model (same vocab). Every slot keeps "
@@ -190,6 +200,14 @@ def build_engine(args, cfg, is_moe, prefix_ids):
     from tensorflow_train_distributed_tpu.serving import ServingEngine
 
     cfg = apply_dispatch_arg(args, cfg, is_moe)
+    if getattr(args, "kv_int8", False):
+        import dataclasses
+
+        if is_moe:
+            raise SystemExit("--kv-int8 applies to llama-family "
+                             "configs only (MoeConfig has no "
+                             "kv_cache_int8 knob)")
+        cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     draft_cfg = draft_params = None
     if (args.speculative_draft_checkpoint
             and not args.speculative_draft_config):
@@ -204,6 +222,13 @@ def build_engine(args, cfg, is_moe, prefix_ids):
         if draft_moe:
             raise SystemExit("the draft config must be a llama-family "
                              "decoder")
+        if getattr(args, "kv_int8", False):
+            import dataclasses
+
+            # Both caches ride the same bandwidth: --kv-int8 quantizes
+            # the draft's KV alongside the target's (the --quant rule).
+            draft_cfg = dataclasses.replace(draft_cfg,
+                                            kv_cache_int8=True)
         draft_params = _restore_params(args.speculative_draft_checkpoint)
 
     cfg, params = load_decoder_params(args, cfg, is_moe)

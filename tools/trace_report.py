@@ -76,17 +76,27 @@ def kv_cache_summary(evs: list) -> dict:
     events: ``kv/alloc`` spans land in the stage table like any other
     stage; this folds the instants' args into totals — prefix-hit
     count + tokens saved (prefill compute skipped), blocks evicted
-    under pressure, and admissions refused for want of blocks.
-    Empty dict when the window has no paged-KV events (linear cache)."""
+    under pressure, and admissions refused for want of blocks — plus
+    how many decode dispatches ran the FUSED paged-attention kernel
+    (the ``decode/dispatch`` span's ``fused`` tag: the engine records
+    at each dispatch whether its programs were compiled with
+    ``ops.pallas_kernels.paged_attention`` or the XLA block-gather
+    A/B leg).  Empty dict when the window has no paged-KV events
+    (linear cache)."""
     out = {"prefix_hits": 0, "prefix_hit_tokens": 0,
-           "evicted_blocks": 0, "refused_admissions": 0}
+           "evicted_blocks": 0, "refused_admissions": 0,
+           "fused_attn_dispatches": 0}
     seen = False
     for e in evs:
         name = e.get("name", "")
+        args = e.get("args") or {}
+        if name == "decode/dispatch" and args.get("fused"):
+            out["fused_attn_dispatches"] += 1
+            seen = True
+            continue
         if not name.startswith("kv/"):
             continue
         seen = True
-        args = e.get("args") or {}
         if name == "kv/prefix_hit":
             out["prefix_hits"] += 1
             out["prefix_hit_tokens"] += args.get("tokens", 0)
@@ -234,6 +244,9 @@ def main(argv=None) -> int:
               f"  ({kv['prefix_hit_tokens']} prompt tokens skipped)")
         print(f"  evicted blocks     {kv['evicted_blocks']}")
         print(f"  refused admissions {kv['refused_admissions']}")
+        print(f"  fused-attn dispatches {kv['fused_attn_dispatches']}"
+              f"  (decode chunks through ops.pallas_kernels."
+              f"paged_attention)")
 
     compiles = compile_summary(evs)
     if compiles:
