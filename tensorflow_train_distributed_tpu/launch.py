@@ -120,6 +120,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO-1: shard optimizer moments over the data "
                         "axis (N× less optimizer memory on an N-way dp "
                         "mesh; numerically identical)")
+    p.add_argument("--grad-quant", default="none",
+                   choices=["none", "f32", "int8"],
+                   help="quantized gradient collectives (EQuARX): "
+                        "explicit reduce-scatter → int8-quantize → "
+                        "all-gather gradient exchange with an "
+                        "error-feedback residual in the train state "
+                        "(~4x less gradient wire traffic); 'f32' is "
+                        "the explicit-pipeline exact baseline (A/B "
+                        "leg), 'none' (default) today's implicit GSPMD "
+                        "allreduce.  TTD_NO_GRAD_QUANT=1 forces none. "
+                        "Pure data-parallel meshes only")
+    p.add_argument("--sharded-update", action="store_true",
+                   help="cross-replica sharded weight update (arxiv "
+                        "2004.13336): each data replica runs the "
+                        "optimizer on only its gradient shard, then "
+                        "params are all-gathered — zero1 extended from "
+                        "the moments to the update compute (implies "
+                        "--zero1's moment shardings)")
     p.add_argument("--bleu-eval", type=int, default=0, metavar="N",
                    help="after training, beam-decode N eval batches and "
                         "report corpus BLEU (seq2seq/wmt configs only)")
@@ -878,6 +896,8 @@ def run(args: argparse.Namespace) -> RunResult:
             checkpoint_every=args.checkpoint_every,
             log_grad_norm=args.log_grad_norm,
             zero1=args.zero1,
+            grad_quant=args.grad_quant,
+            sharded_update=args.sharded_update,
             # Mid-training eval (--eval-every) must score the SAME model
             # the final eval/export does: the EMA view when enabled.
             eval_state_view=(

@@ -424,13 +424,19 @@ def _pipelined_blocks(cfg: LlamaConfig, block_params, x, mesh,
         gpipe_layers,
     )
 
+    # Constructed OUTSIDE layer_fn: flax forbids Module CONSTRUCTION at
+    # a deeper trace level than the enclosing module context (layer_fn
+    # runs inside scan-in-shard_map), while ``.apply`` opens a fresh
+    # context and is legal anywhere.
+    block = DecoderBlock(cfg)
+
     def layer_fn(p, carry):
         h, seg, pos = carry
         # Inside shard_map every mesh axis is manual: logical sharding
         # constraints are meaningless there (and illegal to apply), so the
         # block runs under empty rules — pure per-shard compute.
         with nn.logical_axis_rules(()):
-            h = DecoderBlock(cfg).apply({"params": p}, h, seg, pos)
+            h = block.apply({"params": p}, h, seg, pos)
         return (h, seg, pos)
 
     if wants_outer_remat(cfg):

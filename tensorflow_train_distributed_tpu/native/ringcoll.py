@@ -20,6 +20,61 @@ import numpy as np
 
 from tensorflow_train_distributed_tpu import native
 
+#: Quantization block size of the EQuARX-style int8 wire format — ONE
+#: recipe shared by every quantized collective in the stack: the native
+#: C++ ring (``kQBlock`` in native/src/ringcoll.cpp), this module's
+#: numpy reference below, and the device-side gradient pipeline
+#: (``parallel.collectives.quantize_q8``).  A drift between them would
+#: silently change the error bound of every quantized allreduce, so the
+#: three are pinned against each other in tests/test_grad_quant.py.
+Q8_BLOCK = 512
+
+
+def quantize_q8_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy reference of the shared int8 quantization recipe.
+
+    Mirrors ``QuantizeBlocks`` in native/src/ringcoll.cpp exactly
+    (float32 arithmetic throughout): per ``Q8_BLOCK``-element block,
+    scale = amax/127 with a fallback to 1.0 when the derived scale/inv
+    are zero or non-finite (all-zero, subnormal, or non-finite blocks),
+    values clamped to [-127, 127] with NaN mapping to 0, rounded
+    half-to-even (``lrintf`` semantics).  Returns ``(q int8 [n],
+    scales f32 [ceil(n/Q8_BLOCK)])`` for a 1-D input.
+    """
+    x = np.ascontiguousarray(x, np.float32).reshape(-1)
+    n = x.size
+    nb = -(-n // Q8_BLOCK) if n else 0
+    xb = np.zeros((nb, Q8_BLOCK), np.float32)
+    xb.reshape(-1)[:n] = x
+    a = np.abs(xb)
+    # C's running `if (a > amax)` skips NaN (comparisons are false):
+    amax = np.where(np.isnan(a), np.float32(0), a).max(axis=1,
+                                                       initial=np.float32(0))
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        scale = (amax / np.float32(127.0)).astype(np.float32)
+        inv = (np.float32(1.0) / scale).astype(np.float32)
+    bad = ~(scale > 0) | ~np.isfinite(inv) | ~np.isfinite(scale)
+    scale = np.where(bad, np.float32(1.0), scale)
+    inv = np.where(bad, np.float32(1.0), inv)
+    v = xb * inv[:, None]
+    v = np.where(np.isnan(v), np.float32(0),
+                 np.clip(v, np.float32(-127.0), np.float32(127.0)))
+    q = np.rint(v).astype(np.int8)
+    return q.reshape(-1)[:n], scale
+
+
+def dequantize_q8_np(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Inverse of ``quantize_q8_np`` (``DequantInto`` in the native
+    ring): per-block ``q * scale`` in float32."""
+    q = np.ascontiguousarray(q, np.int8).reshape(-1)
+    n = q.size
+    nb = -(-n // Q8_BLOCK) if n else 0
+    qb = np.zeros((nb, Q8_BLOCK), np.int8)
+    qb.reshape(-1)[:n] = q
+    out = qb.astype(np.float32) * np.asarray(scales,
+                                             np.float32)[:, None]
+    return out.reshape(-1)[:n]
+
 
 class _NativeGroup:
     """Shared lifecycle for ctypes-backed process groups.
@@ -107,7 +162,11 @@ class HostRing(_NativeGroup):
         bandwidth-scarce host/DCN path.  Approximate (per-hop
         requantization in the reduce-scatter phase; error ~(W-1)·
         max|partial|/254 per element) but BIT-CONSISTENT across ranks
-        (the all-gather forwards each owner's bytes verbatim)."""
+        (the all-gather forwards each owner's bytes verbatim).  The
+        quantization recipe is the module-level shared one
+        (``Q8_BLOCK``/``quantize_q8_np`` above == the device-side
+        ``parallel.collectives.quantize_q8``), cross-checked in
+        tests/test_grad_quant.py."""
         return self._reduce_f32(self._lib.ttd_ring_allreduce_q8_f32, x)
 
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:

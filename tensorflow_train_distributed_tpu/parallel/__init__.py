@@ -12,11 +12,17 @@ from tensorflow_train_distributed_tpu.parallel.collectives import (  # noqa: F40
     all_to_all,
     allreduce_bus_bandwidth,
     broadcast_from_coordinator,
+    dequantize_q8,
+    ef_grad_sync,
+    grad_sync_wire_bytes,
+    q8_all_reduce,
+    quantize_q8,
     reduce_scatter,
     ring_permute,
 )
 from tensorflow_train_distributed_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,
+    cross_replica_update_shardings,
     logical_sharding,
     make_state_shardings,
     zero1_opt_shardings,

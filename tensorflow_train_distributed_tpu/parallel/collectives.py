@@ -19,10 +19,20 @@ bus-bandwidth microbenchmark, which is one of the driver's headline metrics
 
 All per-shard functions take ``axis`` names bound by an enclosing
 ``shard_map``/``pjit``; host-level helpers take the ``Mesh`` explicitly.
+
+The quantized-gradient section (``quantize_q8``/``ef_grad_sync``) is the
+device face of the EQuARX recipe (arxiv 2506.17615) the native TCP ring
+already speaks: ONE quantization recipe — ``native.ringcoll.Q8_BLOCK``
+blocks, per-block f32 scale = amax/127 with a fallback to 1, symmetric
+round-half-to-even int8 — shared bit-for-bit between
+``native/ringcoll.HostRing.allreduce_q8`` (host/DCN path) and the
+trainer's gradient pipeline here (device path), pinned against each
+other in tests/test_grad_quant.py.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Sequence
 
@@ -30,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from tensorflow_train_distributed_tpu.native.ringcoll import Q8_BLOCK
 from tensorflow_train_distributed_tpu.runtime.compat import axis_size, shard_map
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     compile_site,
@@ -95,6 +106,226 @@ def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     return jax.lax.ppermute(x, axis, perm)
 
 
+# --- quantized gradient collectives (EQuARX recipe, device face) ------------
+
+#: Leaves smaller than this stay on the exact f32 path: the scale
+#: sidecar + quantize/dequant work would cost more than the bytes saved
+#: (the EQuARX large-tensor-only convention).  Their residual stays 0.
+DEFAULT_MIN_QUANT_ELEMS = 512
+
+
+def quantize_q8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Device half of the shared int8 recipe (1-D input).
+
+    Bit-for-bit the same function as ``native.ringcoll.quantize_q8_np``
+    and the native ring's ``QuantizeBlocks``: per ``Q8_BLOCK`` block,
+    f32 scale = amax/127 falling back to 1.0 when the derived scale/inv
+    are zero or non-finite, values clamped to [-127, 127] (NaN → 0),
+    rounded half-to-even.  Returns ``(q int8 [n], scales f32 [nb])``.
+    """
+    x = x.astype(jnp.float32)
+    n = x.shape[0]
+    nb = max(1, -(-n // Q8_BLOCK))
+    xb = jnp.pad(x, (0, nb * Q8_BLOCK - n)).reshape(nb, Q8_BLOCK)
+    a = jnp.abs(xb)
+    amax = jnp.max(jnp.where(jnp.isnan(a), 0.0, a), axis=1)
+    scale = amax / jnp.float32(127.0)
+    inv = jnp.float32(1.0) / scale
+    bad = ~(scale > 0) | ~jnp.isfinite(inv) | ~jnp.isfinite(scale)
+    scale = jnp.where(bad, 1.0, scale)
+    inv = jnp.where(bad, 1.0, inv)
+    v = xb * inv[:, None]
+    v = jnp.where(jnp.isnan(v), 0.0, jnp.clip(v, -127.0, 127.0))
+    q = jnp.rint(v).astype(jnp.int8)
+    return q.reshape(-1)[:n], scale
+
+
+def dequantize_q8(q: jax.Array, scales: jax.Array) -> jax.Array:
+    """Per-block ``q * scale`` in f32 (1-D; inverse of ``quantize_q8``)."""
+    n = q.shape[0]
+    nb = scales.shape[0]
+    qb = jnp.pad(q, (0, nb * Q8_BLOCK - n)).reshape(nb, Q8_BLOCK)
+    out = qb.astype(jnp.float32) * scales[:, None].astype(jnp.float32)
+    return out.reshape(-1)[:n]
+
+
+def _q8_sum(flat: jax.Array, axis: str):
+    """int8-wire sum-allreduce of per-shard 1-D ``flat`` (inside
+    shard_map over ``axis``), returning the quantization-error terms
+    error feedback needs.
+
+    Algorithm (the EQuARX shape, expressed in XLA collectives instead
+    of a hand ring): pad to W chunks → per-chunk quantize (shared
+    recipe) → ``all_to_all`` of int8+scales (the reduce-scatter: each
+    rank receives every rank's copy of ITS chunk) → exact f32
+    dequant-sum (no per-hop requantization, so the only phase-1 error
+    is each rank's OWN send quantization — cleanly attributable, which
+    the native ring's forward-partials formulation is not) → owner
+    re-quantizes its reduced chunk once → int8 ``all_gather`` (every
+    rank dequantizes identical bytes — bit-consistent across ranks,
+    the native ring's phase-2 property).
+
+    Returns ``(summed [n] f32, send_err [W, c], owner_err [c])`` where
+    ``send_err`` is this rank's full-vector quantization error (chunk-
+    partitioned, padded) and ``owner_err`` the error of its owned
+    reduced chunk — together, every quantization error this rank
+    introduced, for the caller's residual.
+    """
+    W = axis_size(axis)
+    n = flat.shape[0]
+    c = -(-n // W)
+    p = jnp.pad(flat, (0, W * c - n)).reshape(W, c)
+    q, s = jax.vmap(quantize_q8)(p)                      # (W,c) / (W,nb)
+    send_err = p - jax.vmap(dequantize_q8)(q, s)
+    tq = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                            tiled=True)
+    ts = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
+                            tiled=True)
+    red = jax.vmap(dequantize_q8)(tq, ts).sum(axis=0)    # (c,) exact f32 sum
+    q2, s2 = quantize_q8(red)
+    owner_err = red - dequantize_q8(q2, s2)
+    fq = jax.lax.all_gather(q2, axis, axis=0, tiled=True)   # (W*c,)
+    fs = jax.lax.all_gather(s2, axis, axis=0, tiled=True)   # (W*nb,)
+    nb = s2.shape[0]
+    summed = jax.vmap(dequantize_q8)(fq.reshape(W, c),
+                                     fs.reshape(W, nb)).reshape(-1)[:n]
+    return summed, send_err, owner_err
+
+
+def q8_wire_bytes(n: int, world: int) -> int:
+    """Per-rank wire bytes of one ``_q8_sum`` over ``n`` f32 elements:
+    phase 1 all_to_all sends ``(W-1)`` of the rank's W chunk rows
+    (int8 payload + one f32 scale per Q8 block), phase 2 all_gather
+    moves the same volume for the owner chunks.  THE one accounting
+    shared by ``grad_sync_wire_bytes`` and the busBW bench."""
+    W = max(1, int(world))
+    c = -(-int(n) // W)
+    nb = max(1, -(-c // Q8_BLOCK))
+    return 2 * (W - 1) * (c + 4 * nb)
+
+
+def q8_all_reduce(x: jax.Array, axis: str) -> jax.Array:
+    """int8-wire sum-allreduce of a 1-D per-shard buffer (inside
+    shard_map) — the bench/utility face of ``_q8_sum``, errors
+    discarded (no feedback)."""
+    summed, _, _ = _q8_sum(x, axis)
+    return summed
+
+
+def ef_grad_sync(grads, residual, axis: str, *, wire: str = "int8",
+                 min_quant_elems: int = DEFAULT_MIN_QUANT_ELEMS):
+    """Error-feedback gradient mean-allreduce, int8 on the wire.
+
+    Call INSIDE shard_map over ``axis`` (the trainer's grad-quant sync
+    program).  ``grads``/``residual`` leaves arrive with a leading
+    sharded axis of local size 1 (``[1, *shape]``): this rank's local
+    gradient of the local-mean loss, and its carried quantization
+    residual.  Per leaf: compensate (``g + r``), quantized allreduce
+    via ``_q8_sum``, then fold BOTH error terms this rank introduced —
+    its send quantization error and, on its owned chunk, the owner
+    re-quantization error — into the new residual, so quantization
+    error is compensated on later steps rather than accumulated
+    (EF14/EQuARX error feedback).  ``wire="f32"`` is the exact-psum
+    A/B baseline leg (residual stays zero); leaves smaller than
+    ``min_quant_elems`` always take it.
+
+    Returns ``(mean_grads, new_residual, finite)``: the cross-replica
+    MEAN gradient (leaves ``[*shape]``, replicated — local losses are
+    local means, so the global mean is the mean of shard sums), the
+    updated residual (``[1, *shape]``), and an all-replica all-leaves
+    finiteness flag computed on the PRE-quantization local grads —
+    quantization saturates inf and zeroes NaN, so the loss-scale
+    overflow signal must be taken before the wire.  On a non-finite
+    step the returned residual is the INPUT residual unchanged: the
+    optimizer skips the update (the loss-scale contract), and
+    committing this step's error terms would poison the residual with
+    the inf/NaN the wire clamped (``inf - 127 = inf`` send error) —
+    permanently corrupting every later step's compensation.
+    """
+    if wire not in ("f32", "int8"):
+        raise ValueError(f"wire must be f32|int8, got {wire!r}")
+    W = axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+
+    finite = jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]).all()
+    finite = jax.lax.pmin(finite.astype(jnp.int32), axis).astype(jnp.bool_)
+
+    leaves_g, treedef = jax.tree.flatten(grads)
+    leaves_r = treedef.flatten_up_to(residual)
+    shapes = [g.shape[1:] for g in leaves_g]
+    sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+    # One flat vector per group, not one pipeline per leaf: a per-leaf
+    # formulation costs ~6 small collectives per leaf (dispatch-bound on
+    # small models and wasteful on scale sidecars); concatenation costs
+    # one local copy and runs ONE pipeline.  Q8 blocks then span leaf
+    # boundaries — fine, the recipe quantizes a buffer, not semantic
+    # units, and error feedback compensates either way.
+    quant_ix = [i for i, n in enumerate(sizes)
+                if wire == "int8" and n >= min_quant_elems and W > 1]
+    exact_ix = [i for i in range(len(sizes)) if i not in set(quant_ix)]
+    out: list = [None] * len(sizes)
+    new_r: list = [jnp.zeros_like(r) for r in leaves_r]
+
+    def _split(flat, ixs):
+        offs = np.cumsum([0] + [sizes[i] for i in ixs])
+        return [flat[offs[j]:offs[j + 1]].reshape(shapes[i])
+                for j, i in enumerate(ixs)]
+
+    if exact_ix:
+        cat = jnp.concatenate(
+            [leaves_g[i][0].astype(jnp.float32).reshape(-1)
+             for i in exact_ix])
+        summed = jax.lax.psum(cat, axis)
+        for i, piece in zip(exact_ix, _split(summed / W, exact_ix)):
+            out[i] = piece
+    if quant_ix:
+        comp = jnp.concatenate(
+            [(leaves_g[i][0].astype(jnp.float32)
+              + leaves_r[i][0].astype(jnp.float32)).reshape(-1)
+             for i in quant_ix])
+        n = comp.shape[0]
+        summed, send_err, owner_err = _q8_sum(comp, axis)
+        err = send_err.at[idx].add(owner_err).reshape(-1)[:n]
+        for i, piece in zip(quant_ix, _split(summed / W, quant_ix)):
+            out[i] = piece
+        for i, piece in zip(quant_ix, _split(err, quant_ix)):
+            new_r[i] = jnp.where(finite, piece[None],
+                                 leaves_r[i]).astype(leaves_r[i].dtype)
+
+    mean_grads = treedef.unflatten(out)
+    new_residual = treedef.unflatten(new_r)
+    return mean_grads, new_residual, finite
+
+
+def grad_sync_wire_bytes(grads, world: int, wire: str = "int8",
+                         min_quant_elems: int = DEFAULT_MIN_QUANT_ELEMS
+                         ) -> int:
+    """Analytic per-rank wire bytes of one ``ef_grad_sync`` step.
+
+    ``grads`` may be abstract (ShapeDtypeStructs) or concrete; only
+    shapes are read.  Mirrors ``ef_grad_sync``'s grouping: leaves below
+    ``min_quant_elems`` concatenate onto the exact f32 path (ring
+    convention ``2·(W-1)/W · 4n``); quantized leaves concatenate into
+    ONE pipeline — phase 1 all_to_all sends ``(W-1)`` of the rank's W
+    chunk rows (int8 + f32 scale per Q8 block), phase 2 all_gather
+    moves the same wire volume for the owner chunks.
+    """
+    W = max(1, int(world))
+    n_exact = n_quant = 0
+    for leaf in jax.tree.leaves(grads):
+        n = int(np.prod(np.shape(leaf), dtype=np.int64)) if np.shape(leaf) \
+            else 1
+        if wire != "int8" or n < min_quant_elems or W <= 1:
+            n_exact += n
+        else:
+            n_quant += n
+    total = 2 * (W - 1) / W * 4 * n_exact
+    if n_quant:
+        total += q8_wire_bytes(n_quant, W)
+    return int(math.ceil(total))
+
+
 # --- host-level helpers -----------------------------------------------------
 
 
@@ -151,6 +382,7 @@ def allreduce_bus_bandwidth(
     iters: int = 10,
     warmup: int = 3,
     dtype=jnp.float32,
+    quant: str = "none",
 ) -> dict:
     """Measure allreduce algorithmic bus bandwidth over a mesh axis.
 
@@ -158,7 +390,17 @@ def allreduce_bus_bandwidth(
     where ``bytes`` is the per-rank buffer size (``size_mb``) — the NCCL
     benchmark convention, making the number directly comparable to the
     reference's NCCL allreduce measurements (BASELINE.md metric 3).
+
+    ``quant="int8"`` benchmarks the quantized leg instead: the
+    ``q8_all_reduce`` int8-wire pipeline (the trainer's grad-quant comm
+    program).  Its figure is EFFECTIVE f32 bandwidth — f32 payload
+    reduced per second, the same numerator as the exact leg — so the
+    wire win shows up as a higher number wherever the fabric (not the
+    quantize ALU work) is the bottleneck; ``wire_bytes`` reports the
+    actual bytes moved (~4x less).
     """
+    if quant not in ("none", "int8"):
+        raise ValueError(f"quant must be none|int8, got {quant!r}")
     k = mesh.shape[axis]
     per_shard = max(1, int(size_mb * 1e6 / np.dtype(dtype).itemsize))
     spec = P(axis)
@@ -169,6 +411,8 @@ def allreduce_bus_bandwidth(
     @jax.jit
     def step(x):
         def _inner(s):
+            if quant == "int8":
+                return q8_all_reduce(s, axis)
             return jax.lax.psum(s, axis)
 
         return shard_map(
@@ -190,10 +434,14 @@ def allreduce_bus_bandwidth(
     # Per-rank buffer, NOT the k× global array size (NCCL busBW convention).
     nbytes = per_shard * np.dtype(dtype).itemsize
     bus_bw = 2 * (k - 1) / k * nbytes / dt if k > 1 else nbytes / dt
-    return {
+    out_rec = {
         "axis": axis,
         "devices": k,
         "message_bytes": nbytes,
         "time_s": dt,
         "bus_bandwidth_gbps": bus_bw / 1e9,
+        "wire": "f32" if quant == "none" else "int8",
     }
+    if quant == "int8":
+        out_rec["wire_bytes"] = q8_wire_bytes(per_shard, k)
+    return out_rec

@@ -216,6 +216,35 @@ def shard_batch(mesh: Mesh, batch, *, spec: Optional[P] = None):
     return jax.tree.map(_put, batch)
 
 
+def _data_shard_leaf(mesh: Mesh, leaf, sh):
+    """Shard one leaf's largest data-divisible unsharded dim over
+    ``data`` (the ZeRO family's mechanics, shared by the moment
+    shardings and the cross-replica update shardings below).  Rank<2
+    leaves and leaves already touching ``data`` come back unchanged."""
+    n = mesh.shape["data"]
+    val = leaf.value if isinstance(leaf, nn.meta.AxisMetadata) else leaf
+    shape = getattr(val, "shape", None)
+    if (shape is None or len(shape) < 2
+            or not isinstance(sh, NamedSharding)):
+        return sh
+    # Inputs come from make_state_shardings, which already normalized
+    # rank-mismatched leaves to P(); pad the spec to the leaf's rank.
+    spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+    used = {a for entry in spec if entry is not None
+            for a in ((entry,) if isinstance(entry, str) else entry)}
+    if "data" in used:
+        return sh
+    best = None
+    for i, (size, assigned) in enumerate(zip(shape, spec)):
+        if assigned is None and size % n == 0 and size >= n:
+            if best is None or size > shape[best]:
+                best = i
+    if best is None:
+        return sh
+    spec[best] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
 def zero1_opt_shardings(mesh: Mesh, abstract_opt: Any, opt_shardings: Any):
     """ZeRO-1: shard optimizer moments over the ``data`` axis.
 
@@ -239,32 +268,36 @@ def zero1_opt_shardings(mesh: Mesh, abstract_opt: Any, opt_shardings: Any):
     """
     if mesh.shape.get("data", 1) <= 1:
         return opt_shardings
-    n = mesh.shape["data"]
-
-    def _leaf(leaf, sh):
-        val = leaf.value if isinstance(leaf, nn.meta.AxisMetadata) else leaf
-        shape = getattr(val, "shape", None)
-        if (shape is None or len(shape) < 2
-                or not isinstance(sh, NamedSharding)):
-            return sh
-        # Inputs come from make_state_shardings, which already normalized
-        # rank-mismatched leaves to P(); pad the spec to the leaf's rank.
-        spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
-        used = {a for entry in spec if entry is not None
-                for a in ((entry,) if isinstance(entry, str) else entry)}
-        if "data" in used:
-            return sh
-        best = None
-        for i, (size, assigned) in enumerate(zip(shape, spec)):
-            if assigned is None and size % n == 0 and size >= n:
-                if best is None or size > shape[best]:
-                    best = i
-        if best is None:
-            return sh
-        spec[best] = "data"
-        return NamedSharding(mesh, P(*spec))
 
     return jax.tree.map(
-        _leaf, abstract_opt, opt_shardings,
+        lambda leaf, sh: _data_shard_leaf(mesh, leaf, sh),
+        abstract_opt, opt_shardings,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+    )
+
+
+def cross_replica_update_shardings(mesh: Mesh, abstract_params: Any,
+                                   param_shardings: Any):
+    """The full cross-replica sharded weight update (arxiv 2004.13336),
+    ZeRO-1 extended from the moments to the UPDATE COMPUTATION itself.
+
+    ``zero1_opt_shardings`` shards what the optimizer *stores*; this
+    shards what it *computes*: per param leaf, the sharding the gradient
+    and the new-param value should carry DURING ``tx.update`` /
+    ``apply_updates``, so each data replica runs the optimizer math on
+    only its 1/N gradient shard (the redundant N-way elementwise apply
+    the paper removes) and the trainer all-gathers the updated params
+    back to their resting shardings afterwards.  Same leaf mechanics as
+    ZeRO-1 — largest data-divisible unsharded dim over ``data``; rank<2
+    leaves (biases) update replicated, their math is noise.  Returns
+    ``param_shardings`` unchanged on a data<=1 mesh (documented no-op,
+    matching ``zero1_opt_shardings``).
+    """
+    if mesh.shape.get("data", 1) <= 1:
+        return param_shardings
+
+    return jax.tree.map(
+        lambda leaf, sh: _data_shard_leaf(mesh, leaf, sh),
+        abstract_params, param_shardings,
         is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
     )

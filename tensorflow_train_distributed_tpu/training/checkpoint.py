@@ -90,6 +90,133 @@ class CheckpointManager:
         self._mgr.reload()
         return dst
 
+    def _saved_residual_leaves(self, step: int) -> Optional[bool]:
+        """Does the saved tree at ``step`` carry ``grad_residual``
+        LEAVES?  None when the metadata probe fails (fall back to a
+        plain restore).  A state saved with grad_residual=None keeps
+        the KEY with a None value in the metadata tree — presence
+        means leaves, not key membership."""
+        item_dir = os.path.join(self._step_dir(step), "default")
+        try:
+            meta = ocp.StandardCheckpointer().metadata(item_dir)
+            meta = getattr(meta, "item_metadata", meta)
+            return bool(jax.tree.leaves(
+                meta["grad_residual"] if "grad_residual" in meta
+                else None))
+        except Exception:  # noqa: BLE001 — metadata probe is best-effort
+            return None
+
+    def _split_missing_residual(self, step: int, abstract):
+        """Back-compat for checkpoints saved before the train state
+        carried ``grad_residual`` (quantized gradient collectives'
+        error-feedback buffers): when the template asks for residual
+        leaves but the saved tree has no ``grad_residual`` subtree,
+        return ``(abstract_without_residual, residual_template)`` so
+        the caller restores the old layout and zero-fills the residual
+        — a pre-quant run's checkpoint resumes into a grad-quant
+        trainer with error feedback starting from zero (its exact
+        semantics at step 0).  ``(abstract, None)`` when nothing to do.
+        """
+        res = getattr(abstract, "grad_residual", None)
+        if res is None or not jax.tree.leaves(res):
+            return abstract, None
+        if self._saved_residual_leaves(step) is not False:
+            return abstract, None
+        return abstract.replace(grad_residual=None), res
+
+    def _restore_dropping_residual(self, step: int, abstract):
+        """The reverse compat direction: the saved tree CARRIES
+        ``grad_residual`` leaves (a grad-quant run's checkpoint) but
+        the template does not (``--grad-quant none`` or the
+        ``TTD_NO_GRAD_QUANT=1`` kill-switch restart).  A
+        ``StandardRestore`` of the leafless template would trip over
+        the extra subtree, so restore every OTHER top-level subtree
+        via a partial ``PyTreeRestore`` into the template's shardings
+        — the residual bytes are never even deserialized (error
+        feedback restarts from zero if quant is re-enabled later,
+        which is what dropping the residual means)."""
+        import dataclasses as _dc
+
+        item_dir = os.path.join(self._step_dir(step), "default")
+        item = {}
+        rest = {}
+        for f in _dc.fields(abstract):
+            sub = getattr(abstract, f.name)
+            if f.name != "grad_residual" and jax.tree.leaves(sub):
+                item[f.name] = sub
+            else:
+                rest[f.name] = None if f.name == "grad_residual" else sub
+
+        def _ra(s):
+            sharding = getattr(s, "sharding", None)
+            if sharding is not None:
+                return ocp.ArrayRestoreArgs(sharding=sharding)
+            return ocp.RestoreArgs()
+
+        restored = ocp.PyTreeCheckpointer().restore(
+            item_dir,
+            args=ocp.args.PyTreeRestore(
+                item=item,
+                restore_args=jax.tree.map(_ra, item),
+                transforms={},
+            ),
+        )
+        logger.info(
+            "checkpoint carries grad_residual but the trainer runs "
+            "without grad-quant: restored dropping the residual "
+            "(error feedback restarts from zero if re-enabled)")
+        return type(abstract)(**{**rest, **restored})
+
+    @staticmethod
+    def _zero_residual(restored, residual_abstract):
+        """Reattach zero-initialized residual leaves in the template's
+        shardings (the quant trainer created them P("data")-sharded).
+        Zeros are created ON DEVICE into the target sharding: a host
+        np.zeros of the GLOBAL residual (world x params, f32) could
+        OOM the host for models whose sharded state fits fine."""
+        import functools
+
+        import jax.numpy as jnp
+
+        from tensorflow_train_distributed_tpu.runtime.lint import (
+            compilecheck,
+        )
+
+        def _zeros(s):
+            sharding = getattr(s, "sharding", None)
+            if sharding is None:
+                return np.zeros(s.shape, s.dtype)
+            return compilecheck.jit(
+                functools.partial(jnp.zeros, s.shape, s.dtype),
+                site="checkpoint.zero_residual", max_compiles=None,
+                out_shardings=sharding)()
+
+        zeros = jax.tree.map(_zeros, residual_abstract)
+        logger.info(
+            "checkpoint predates grad_residual: restored with "
+            "error-feedback residuals zero-initialized")
+        return restored.replace(grad_residual=zeros)
+
+    def _restore_adapted(self, step: int, abstract):
+        """One orbax restore with grad_residual compat in BOTH
+        directions: template-has/saved-lacks → restore old layout +
+        zero-fill; template-lacks/saved-has → partial restore dropping
+        the residual; otherwise a plain StandardRestore."""
+        import dataclasses as _dc
+
+        if (_dc.is_dataclass(abstract)
+                and hasattr(abstract, "grad_residual")
+                and not jax.tree.leaves(
+                    getattr(abstract, "grad_residual", None))
+                and self._saved_residual_leaves(step) is True):
+            return self._restore_dropping_residual(step, abstract)
+        abstract, res = self._split_missing_residual(step, abstract)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        if res is not None:
+            restored = self._zero_residual(restored, res)
+        return restored
+
     def _restore_step(self, step: int, abstract):
         """One restore attempt, validated: a missing commit marker is a
         torn save (crashed writer) and fails *before* orbax walks the
@@ -100,8 +227,7 @@ class CheckpointManager:
             raise ValueError(
                 f"checkpoint step {step} has no {COMMIT_MARKER} commit "
                 "marker (torn save)")
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        return self._restore_adapted(step, abstract)
 
     def restore(self, abstract_state: Any, step: Optional[int] = None):
         """Restore into the shardings/dtypes of ``abstract_state``.
@@ -164,8 +290,7 @@ class CheckpointManager:
                     quarantined)
                 continue
             try:
-                restored = self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(abstract))
+                restored = self._restore_adapted(step, abstract)
             except Exception as e:      # noqa: BLE001 — any torn read
                 deferred.append((step, e))
                 logger.error(

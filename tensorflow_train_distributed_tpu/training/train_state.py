@@ -28,6 +28,13 @@ class TrainState(struct.PyTreeNode):
     ``model_state`` carries non-trainable collections (e.g. ResNet
     ``batch_stats`` — the analog of the reference's sync-on-read BN
     variables).  ``loss_scale`` is present only under float16 policy.
+    ``grad_residual`` is present only under quantized gradient
+    collectives (``TrainerConfig.grad_quant``): the per-replica
+    error-feedback residual, one f32 leaf per param leaf with a leading
+    data-axis dim of the mesh's dp degree (sharded ``P("data")``, so
+    per-device it costs one f32 param copy).  Checkpoints saved before
+    this field existed restore with residuals zero-initialized
+    (``training.checkpoint`` handles the compat).
     """
 
     step: jax.Array
@@ -35,10 +42,12 @@ class TrainState(struct.PyTreeNode):
     model_state: Any
     opt_state: optax.OptState
     loss_scale: Optional[LossScaleState] = None
+    grad_residual: Any = None
 
     @classmethod
     def create(cls, *, params, model_state=None, tx: optax.GradientTransformation,
-               loss_scale: Optional[LossScaleState] = None) -> "TrainState":
+               loss_scale: Optional[LossScaleState] = None,
+               grad_residual: Any = None) -> "TrainState":
         import jax.numpy as jnp
 
         return cls(
@@ -47,6 +56,7 @@ class TrainState(struct.PyTreeNode):
             model_state={} if model_state is None else model_state,
             opt_state=tx.init(params),
             loss_scale=loss_scale,
+            grad_residual=grad_residual,
         )
 
     def num_params(self) -> int:

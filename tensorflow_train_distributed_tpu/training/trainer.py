@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, Sequence
 
@@ -99,6 +100,30 @@ class TrainerConfig:
     # an N-way dp mesh for one extra all-gather per step; numerically
     # identical (parity-tested).
     zero1: bool = False
+    # Quantized gradient collectives (EQuARX, arxiv 2506.17615): replace
+    # the implicit GSPMD gradient allreduce with an explicit per-shard
+    # pipeline — reduce-scatter via int8+scales all_to_all (the shared
+    # native-ring recipe), exact f32 dequant-sum, int8 all-gather — with
+    # a per-leaf error-feedback residual carried in the train state so
+    # quantization error is compensated, not accumulated.  "int8" is the
+    # quantized wire, "f32" the explicit-pipeline exact baseline (the
+    # A/B leg), "none" (default) today's single-program GSPMD step,
+    # bitwise-unchanged.  TTD_NO_GRAD_QUANT=1 (read at Trainer
+    # construction — the residual leaves compile into the state) forces
+    # "none".  Requires a pure data-parallel mesh (data>1, every other
+    # axis 1), grad_accum=1, steps_per_execution=1, and a task with no
+    # mutable model collections (BN batch_stats are reduced by GSPMD in
+    # the implicit path; the per-shard pipeline has no equivalent).
+    grad_quant: str = "none"
+    # Cross-replica sharded weight update (arxiv 2004.13336):
+    # zero1 extended from the moments to the update computation — each
+    # data replica runs the optimizer math on only its gradient shard
+    # (sharding constraints around tx.update) and the new params are
+    # all-gathered back, removing the redundant N-way elementwise
+    # apply.  Implies zero1's moment shardings.  Composes with
+    # grad_quant; numerically identical to the replicated apply up to
+    # reduction order.
+    sharded_update: bool = False
     # View applied to the state for EVERY eval fit runs (mid-training
     # eval_every AND the final one launch.py drives): e.g. EMA weight
     # swapping (training.ema.swap_ema_params), so val_* metrics feeding
@@ -140,9 +165,59 @@ class Trainer:
         self._predict_step = None
         self.state_shardings = None
         self._live_state = None
+        # Cross-replica sharded update: per-leaf shardings the gradient
+        # and the new params carry DURING the optimizer apply (None =
+        # replicated apply, today's path).  Resolved with the state
+        # shardings in _abstract_state_and_shardings.
+        self._update_shardings = None
+        self._param_shardings = None
         # Guard callbacks (TerminateOnNaN) set this to veto further
         # checkpoint writes of a numerically-poisoned state.
         self.state_poisoned = False
+        # Quantized gradient collectives: resolve the flag ONCE at
+        # construction (the kill switch must win before the residual
+        # leaves are compiled into the state).
+        self.grad_quant = self._resolve_grad_quant(config, mesh)
+
+    @staticmethod
+    def _resolve_grad_quant(config: TrainerConfig, mesh) -> str:
+        gq = config.grad_quant
+        if gq not in ("none", "f32", "int8"):
+            raise ValueError(
+                f"grad_quant must be none|f32|int8, got {gq!r}")
+        if gq == "none":
+            return gq
+        if os.environ.get("TTD_NO_GRAD_QUANT", "0") not in ("", "0"):
+            logger.warning(
+                "TTD_NO_GRAD_QUANT=1: quantized gradient collectives "
+                "disabled — exact single-program GSPMD step (set before "
+                "Trainer construction; the choice compiles in)")
+            return "none"
+        sizes = dict(mesh.shape)
+        others = {a: s for a, s in sizes.items()
+                  if a != "data" and s > 1}
+        if others:
+            raise ValueError(
+                f"grad_quant={gq!r} supports pure data-parallel meshes "
+                f"(the explicit pipeline manualizes only the data axis); "
+                f"mesh also shards {others} — drop grad-quant or the "
+                "model-parallel axes")
+        if sizes.get("data", 1) <= 1:
+            logger.warning(
+                "grad_quant=%r is a no-op on a data=1 mesh; using the "
+                "exact single-program step", gq)
+            return "none"
+        if config.grad_accum > 1:
+            raise ValueError(
+                "grad_quant does not compose with grad_accum>1 yet "
+                "(the accumulation scan lives inside the single-program "
+                "step); drop one of the two")
+        if config.steps_per_execution > 1:
+            raise ValueError(
+                "grad_quant does not compose with steps_per_execution>1 "
+                "(the comm program is dispatched separately per step); "
+                "drop one of the two")
+        return gq
 
     # -- state ---------------------------------------------------------------
 
@@ -160,6 +235,9 @@ class Trainer:
             sample_batch,
         )
 
+        is_boxed = (lambda x:  # noqa: E731
+                    isinstance(x, nn.meta.AxisMetadata))
+
         def _create():
             # Zeros with the batch's shapes/dtypes: tasks get real traced
             # arrays (the natural `model.init(rng, batch["x"])` idiom works)
@@ -170,24 +248,59 @@ class Trainer:
             variables = self.task.init_variables(rng, init_batch)
             variables = dict(variables)
             params = variables.pop("params")
+            residual = None
+            if self.grad_quant != "none":
+                # Error-feedback residual: one f32 leaf per param leaf
+                # with a leading per-replica dim (sharded over data
+                # below — per-device cost is one f32 param copy).
+                W = self.mesh.shape["data"]
+                residual = jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (W,) + tuple((p.value if is_boxed(p) else p).shape),
+                        jnp.float32),
+                    params, is_leaf=is_boxed)
             return TrainState.create(
                 params=params,
                 model_state=variables,
                 tx=self.tx,
                 loss_scale=mp.LossScaleState.create(self.policy),
+                grad_residual=residual,
             )
 
         with sharding_lib.with_logical_rules(self.mesh, self.rules), \
                 compat.set_mesh(self.mesh):
             abstract = jax.eval_shape(_create)
+            if (self.grad_quant != "none"
+                    and jax.tree.leaves(abstract.model_state)):
+                raise ValueError(
+                    "grad_quant requires a task with no mutable model "
+                    "collections (e.g. BatchNorm batch_stats): the "
+                    "implicit GSPMD path reduces them across the batch "
+                    "axis, which the per-shard gradient pipeline does "
+                    "not reproduce — drop grad-quant for this task")
             shardings = sharding_lib.make_state_shardings(
                 self.mesh, abstract, self.rules
             )
-            if self.config.zero1:
+            if self.config.zero1 or self.config.sharded_update:
                 shardings = shardings.replace(
                     opt_state=sharding_lib.zero1_opt_shardings(
                         self.mesh, abstract.opt_state,
                         shardings.opt_state))
+            if abstract.grad_residual is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                shardings = shardings.replace(
+                    grad_residual=jax.tree.map(
+                        lambda _: NamedSharding(self.mesh, P("data")),
+                        abstract.grad_residual))
+            if self.config.sharded_update:
+                # The cross-replica sharded weight update's compute
+                # shardings (arxiv 2004.13336): resolved once, used by
+                # every step build.
+                self._param_shardings = shardings.params
+                self._update_shardings = (
+                    sharding_lib.cross_replica_update_shardings(
+                        self.mesh, abstract.params, shardings.params))
         return _create, abstract, shardings
 
     def create_state(self, sample_batch, params=None) -> TrainState:
@@ -216,6 +329,13 @@ class Trainer:
                 out_shardings=self.state_shardings)()
         state = nn.unbox(state)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
+        if self.config.sharded_update:
+            # Re-resolve from the PLACED state: make_state_shardings may
+            # have downgraded dims that don't divide the mesh.
+            self._param_shardings = self.state_shardings.params
+            self._update_shardings = (
+                sharding_lib.cross_replica_update_shardings(
+                    self.mesh, state.params, self.state_shardings.params))
         if params is not None:
             # Cast on HOST, then device_put straight into the target
             # sharding: a jnp cast would materialize each full leaf on one
@@ -262,6 +382,14 @@ class Trainer:
         from tensorflow_train_distributed_tpu.parallel.sharding import (
             shard_batch_spec,
         )
+
+        if self.grad_quant != "none":
+            raise ValueError(
+                "lower_train_step lowers the single-program GSPMD step; "
+                f"grad_quant={self.grad_quant!r} runs a three-program "
+                "pipeline (grad_step/grad_sync/apply_step) with no "
+                "single lowering — lower with grad_quant='none' "
+                "(numerics-identical off-path) for the AOT proof")
 
         k = self.config.steps_per_execution
 
@@ -385,18 +513,43 @@ class Trainer:
             metrics["loss_weight"] = w_total  # total, as one big batch would
         return grads, jnp.sum(losses * ws) / w_total, metrics, new_ms
 
-    def _single_step(self, state: TrainState, batch):
-        rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
-        if self.config.grad_accum > 1:
-            grads, loss, metrics, new_ms = self._accumulated_grads(
-                state, batch, rng)
-        else:
-            grads, loss, metrics, new_ms = self._microbatch_grads(
-                state.params, state.model_state, batch, rng,
-                state.loss_scale)
+    def _constrain_update(self, grads):
+        """Cross-replica sharded weight update, entry half: pin the
+        gradients to the per-leaf ``data``-sharded update shardings so
+        GSPMD turns the gradient all-reduce into reduce-scatter and the
+        optimizer math that follows runs on 1/N elements per replica
+        (arxiv 2004.13336).  No-op when ``sharded_update`` is off."""
+        if self._update_shardings is None:
+            return grads
+        return jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                            self._update_shardings)
 
+    def _gather_updated(self, new_params):
+        """Cross-replica sharded weight update, exit half: all-gather
+        the shard-updated params back to their resting shardings."""
+        if self._param_shardings is None:
+            return new_params
+        return jax.tree.map(jax.lax.with_sharding_constraint, new_params,
+                            self._param_shardings)
+
+    def _apply_grads(self, state: TrainState, grads, finite=None):
+        """The optimizer-apply half of a train step, shared VERBATIM by
+        the implicit single-program step and the quant pipeline's apply
+        program — the loss-scale overflow contract and the lr/grad_norm
+        metric surface must never fork between the two (the kill-switch
+        bitwise-parity guarantee rides on it).
+
+        ``finite``: precomputed all-finite flag (the quant path, where
+        it must be taken on the PRE-quantization local grads); None =
+        compute from ``grads`` here (the implicit path).  Returns
+        ``(new_params, new_opt, new_ls, extra_metrics)``; the caller
+        assembles the state (model_state/residual differ per path).
+        """
+        grads = self._constrain_update(grads)
+        metrics = {}
         if state.loss_scale is not None:
-            finite = mp.grads_finite(grads)
+            if finite is None:
+                finite = mp.grads_finite(grads)
             updates, new_opt = self.tx.update(grads, state.opt_state,
                                               state.params)
             new_params = optax.apply_updates(state.params, updates)
@@ -415,8 +568,8 @@ class Trainer:
                                               state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_ls = None
+        new_params = self._gather_updated(new_params)
 
-        metrics = dict(metrics, loss=loss)
         if self.config.log_grad_norm:
             metrics["grad_norm"] = optax.global_norm(grads)
         if self.lr_schedule is not None:
@@ -433,6 +586,19 @@ class Trainer:
             inj = get_injected_hyperparam(state.opt_state, "learning_rate")
             if inj is not None:
                 metrics["lr"] = jnp.asarray(inj, jnp.float32)
+        return new_params, new_opt, new_ls, metrics
+
+    def _single_step(self, state: TrainState, batch):
+        rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
+        if self.config.grad_accum > 1:
+            grads, loss, metrics, new_ms = self._accumulated_grads(
+                state, batch, rng)
+        else:
+            grads, loss, metrics, new_ms = self._microbatch_grads(
+                state.params, state.model_state, batch, rng,
+                state.loss_scale)
+        new_params, new_opt, new_ls, extra = self._apply_grads(state, grads)
+        metrics = dict(metrics, loss=loss, **extra)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -443,7 +609,7 @@ class Trainer:
         return new_state, metrics
 
     def _jit_step(self, fn, *, site, donate=()):
-        """jit ``fn(state, batch)`` with the trainer's mesh + logical rules.
+        """jit ``fn(*args)`` with the trainer's mesh + logical rules.
 
         set_mesh must wrap the *call* (it is illegal inside jit): it binds
         the abstract mesh at trace time so mesh-aware ops (seq-parallel
@@ -456,21 +622,24 @@ class Trainer:
         ``TTD_COMPILECHECK=1`` instead of eating the step budget.
         """
 
-        def step(state, batch):
+        def step(*args):
             with sharding_lib.with_logical_rules(self.mesh, self.rules):
-                return fn(state, batch)
+                return fn(*args)
 
         jitted = compilecheck.jit(step, site=f"trainer.{site}",
                                   group=self, donate_argnums=donate)
 
-        def call(state, batch):
+        def call(*args):
             with compat.set_mesh(self.mesh):
-                return jitted(state, batch)
+                return jitted(*args)
 
         return call
 
     def _compiled_train_step(self):
         if self._train_step is not None:
+            return self._train_step
+        if self.grad_quant != "none":
+            self._train_step = self._build_quant_step()
             return self._train_step
         k = self.config.steps_per_execution
 
@@ -484,6 +653,155 @@ class Trainer:
         self._train_step = self._jit_step(step, site="train_step",
                                           donate=donate)
         return self._train_step
+
+    # -- quantized gradient collectives (grad_quant != "none") ---------------
+
+    def _build_quant_step(self):
+        """The explicit-gradient-exchange step: THREE jitted programs
+        instead of one, so the gradient communication is a separate
+        dispatch the flight recorder can meter (``train/grad_comm`` vs
+        ``train/optimizer_apply`` sub-spans inside ``step_dispatch``).
+
+        1. ``trainer.grad_step`` — fwd/bwd per data shard inside
+           shard_map (the loss is the LOCAL mean; no cross-replica
+           reduction happens here, unlike the implicit GSPMD step);
+           local grads leave with a leading per-replica dim, sharded.
+        2. ``trainer.grad_sync`` — ``collectives.ef_grad_sync``: the
+           error-feedback int8-wire allreduce (or the exact-psum f32
+           A/B leg).  The only cross-replica traffic of the step.
+           BOTH inputs are donated: the residual buffers alias their
+           outputs, or peak HBM grows by a full f32 param copy.
+        3. ``trainer.apply_step`` — the optimizer apply (with the
+           cross-replica sharded-update constraints when configured),
+           donating the state.
+
+        The composite blocks at each program boundary so the sub-span
+        durations are real device time, not dispatch time — the price
+        of a meterable comm fraction (documented in README; the
+        ``none`` path keeps today's fully-async single dispatch).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            shard_batch_spec,
+        )
+
+        mesh = self.mesh
+        W = mesh.shape["data"]
+        wire = self.grad_quant
+        seed = self.config.seed
+        batch_spec = shard_batch_spec(mesh)
+
+        def per_shard_grads(params, model_state, loss_scale, step,
+                            local_batch):
+            rng = jax.random.fold_in(jax.random.key(seed), step)
+            # Decorrelate per-shard randomness (dropout): the implicit
+            # path generates masks globally and shards them; per-shard
+            # tracing would otherwise repeat one mask on every shard.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            # Logical sharding rules are meaningless inside the manual
+            # region (every >1 axis is manualized): null them so model
+            # constraint annotations no-op instead of naming manual axes.
+            with nn.logical_axis_rules(()):
+                grads, loss, metrics, _ = self._microbatch_grads(
+                    params, model_state, local_batch, rng, loss_scale)
+            metrics = dict(metrics, loss=loss)
+            w = metrics.get("loss_weight")
+            if w is None:
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.pmean(
+                        jnp.asarray(m, jnp.float32), "data"), metrics)
+            else:
+                # Weighted-mean tasks (the Task contract): the global
+                # gradient is the weight-weighted mean of shard
+                # gradients — pre-scale so the sync's uniform mean
+                # comes out as the true weighted mean; metrics combine
+                # the same way.
+                w = jnp.asarray(w, jnp.float32)
+                w_total = jnp.maximum(jax.lax.psum(w, "data"), 1e-6)
+                scale = w * W / w_total
+                grads = jax.tree.map(lambda g: g * scale.astype(g.dtype),
+                                     grads)
+                metrics = {
+                    kk: (w_total if kk == "loss_weight"
+                         else jax.lax.psum(
+                             jnp.asarray(m, jnp.float32) * w,
+                             "data") / w_total)
+                    for kk, m in metrics.items()}
+            return jax.tree.map(lambda g: g[None], grads), metrics
+
+        def grad_prog(state, batch):
+            sm = compat.shard_map(
+                per_shard_grads, mesh=mesh,
+                in_specs=(P(), P(), P(), P(), batch_spec),
+                out_specs=(P("data"), P()),
+                check_vma=False)
+            return sm(state.params, state.model_state, state.loss_scale,
+                      state.step, batch)
+
+        def sync_prog(local_grads, residual):
+            sm = compat.shard_map(
+                lambda g, r: collectives.ef_grad_sync(g, r, "data",
+                                                      wire=wire),
+                mesh=mesh, in_specs=(P("data"), P("data")),
+                out_specs=(P(), P("data"), P()),
+                check_vma=False)
+            return sm(local_grads, residual)
+
+        def apply_prog(state, grads, finite):
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
+                                 state.params)
+            # ``finite`` was computed on the PRE-quantization local
+            # grads (the wire saturates inf and zeroes NaN, so post-
+            # sync grads can no longer carry the overflow signal);
+            # _apply_grads enforces the same skip-on-overflow contract
+            # as the implicit step.
+            new_params, new_opt, new_ls, metrics = self._apply_grads(
+                state, grads, finite=finite)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                loss_scale=new_ls,
+            )
+            return new_state, metrics
+
+        g_jit = self._jit_step(grad_prog, site="grad_step")
+        # local_grads (arg 0) are pipeline-internal — always donated.
+        # The residual (arg 1) is PART OF THE CALLER'S STATE: donating
+        # it under donate_state=False would delete buffers of a state
+        # the caller explicitly asked to keep (rollback, checkpoint-on-
+        # failure).  With donation off you pay one extra residual copy
+        # per step — the same trade the undonated state makes.
+        sync_donate = (0, 1) if self.config.donate_state else (0,)
+        sync_jit = self._jit_step(sync_prog, site="grad_sync",
+                                  donate=sync_donate)
+        apply_donate = (0, 1) if self.config.donate_state else (1,)
+        apply_jit = self._jit_step(apply_prog, site="apply_step",
+                                   donate=apply_donate)
+        wire_mb_cell: list = []
+
+        def step(state, batch):
+            if not wire_mb_cell:
+                wire_mb_cell.append(collectives.grad_sync_wire_bytes(
+                    state.params, W, wire) / 1e6)
+            residual = state.grad_residual
+            lean = state.replace(grad_residual=None)
+            with events.span("train/grad_fwdbwd"):
+                local_grads, metrics = g_jit(lean, batch)
+                jax.block_until_ready(local_grads)
+            with events.span("train/grad_comm", wire=wire,
+                             mb=wire_mb_cell[0]):
+                synced, new_residual, finite = sync_jit(local_grads,
+                                                        residual)
+                jax.block_until_ready(synced)
+            with events.span("train/optimizer_apply"):
+                new_lean, extra = apply_jit(lean, synced, finite)
+            metrics = dict(metrics, **extra)
+            metrics["grad_comm_mb"] = wire_mb_cell[0]
+            return new_lean.replace(grad_residual=new_residual), metrics
+
+        return step
 
     def _compiled_eval_step(self):
         if self._eval_step is not None:

@@ -108,6 +108,20 @@ class TestBusBandwidth:
         assert r["devices"] == 8
         assert r["bus_bandwidth_gbps"] > 0
         assert r["message_bytes"] >= 1e6
+        assert r["wire"] == "f32"
+
+    def test_allreduce_bench_int8_leg(self, mesh8):
+        """The quantized leg: int8+scales on the wire (the trainer's
+        grad-quant comm program), ~4x fewer wire bytes than the f32
+        message it reduces."""
+        r = coll.allreduce_bus_bandwidth(mesh8, "data", size_mb=1,
+                                         iters=2, warmup=1, quant="int8")
+        assert r["wire"] == "int8"
+        assert r["bus_bandwidth_gbps"] > 0
+        assert 0 < r["wire_bytes"] < r["message_bytes"] * 2 * 7 / 8 / 3
+        with pytest.raises(ValueError, match="none.int8"):
+            coll.allreduce_bus_bandwidth(mesh8, "data", size_mb=1,
+                                         iters=1, quant="fp8")
 
 
 class TestBenchAllreduceTool:

@@ -70,8 +70,24 @@ timeout 1200 python tools/bench_serving.py --preset llama_125m \
     --slots 32 --chunk 16 --requests 64 --cache-len 512 \
     --no-ab 2>>"$LOG" | tee -a "$LOG"
 
+say "--- 9. quantized gradient collectives A/B (train-side analogue of"
+say "    7-8: int8-wire EQuARX pipeline + error feedback vs the f32"
+say "    explicit pipeline vs today's implicit GSPMD allreduce; needs a"
+say "    multi-chip slice — on data=1 the trainer falls back to the"
+say "    exact path and the record says so) ---"
+timeout 1200 python tools/bench_grad_quant.py --steps 30 \
+    2>>"$LOG" | tee -a "$LOG"
+# device allreduce busBW, f32 vs int8 wire (NCCL convention; int8 leg
+# reports EFFECTIVE f32 bandwidth — the ICI-bound regime is where the
+# 4x wire saving becomes throughput):
+timeout 600 python tools/bench_allreduce.py --size-mb 64 2>>"$LOG" | tee -a "$LOG"
+timeout 600 python tools/bench_allreduce.py --size-mb 64 --quant int8 \
+    2>>"$LOG" | tee -a "$LOG"
+
 say "=== playbook done $(date -u); results in $LOG ==="
 say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
 say "pallas verdict from 4 — keep whichever wins as the default;"
 say "fused/int8/growth verdicts from 7-8 -> append the TPU legs to"
-say "profiles/bench/fused_attn_ab.jsonl and keep the faster default)."
+say "profiles/bench/fused_attn_ab.jsonl and keep the faster default;"
+say "grad-quant + busBW verdicts from 9 -> append the TPU legs to"
+say "profiles/bench/grad_quant_ab.jsonl)."
