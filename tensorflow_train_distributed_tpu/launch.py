@@ -233,6 +233,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "consecutive crash")
     p.add_argument("--restart-backoff-max", type=float, default=60.0,
                    help="cap on the crash-relaunch delay")
+    p.add_argument("--restart-window", type=float, default=0.0,
+                   help="rolling window (seconds) for the crash budget: "
+                        "only crashes within it count against "
+                        "--max-restarts, so a correlated burst cannot "
+                        "permanently exhaust a long run's protection "
+                        "(0 = lifetime accounting)")
+    p.add_argument("--restart-jitter", type=float, default=0.1,
+                   help="jitter the crash backoff UP by up to this "
+                        "fraction of itself (decorrelates fleet-wide "
+                        "relaunch stampedes; 0 disables)")
+    p.add_argument("--no-elastic", action="store_true",
+                   help="treat device-loss exits as plain crashes "
+                        "instead of relaunching onto the surviving "
+                        "devices with the checkpoint resharded "
+                        "(TTD_NO_ELASTIC=1 is the env equivalent)")
+    p.add_argument("--max-device-losses", type=int, default=16,
+                   help="give up after this many device-loss relaunches "
+                        "(they are crash-budget-free, but a mesh can "
+                        "only shrink so many times — a flapping chip "
+                        "must not relaunch forever)")
     p.add_argument("--no-restart-on-preemption", action="store_true",
                    help="hand the preemption exit code to the caller "
                         "instead of relaunching (external scheduler "
@@ -531,6 +551,35 @@ def run(args: argparse.Namespace) -> RunResult:
 
         _validate_constant_lr(args, _reg.get_entry(args.config))
 
+    # Elastic relaunch (runtime.supervisor): after a device-loss exit
+    # the supervisor pins the surviving device count; the relaunched
+    # child shrinks its virtual CPU platform (or slices the real device
+    # list below) and lets the mesh preset re-resolve on the survivors.
+    import os as _os
+
+    from tensorflow_train_distributed_tpu.runtime.supervisor import (
+        ENV_ELASTIC_DEVICES,
+    )
+
+    elastic_devices = None
+    _elastic_env = _os.environ.get(ENV_ELASTIC_DEVICES)
+    if _elastic_env:
+        try:
+            elastic_devices = int(_elastic_env)
+        except ValueError:
+            raise SystemExit(
+                f"{ENV_ELASTIC_DEVICES}={_elastic_env!r}: device count "
+                "must be an integer") from None
+        if elastic_devices < 1:
+            raise SystemExit(
+                f"{ENV_ELASTIC_DEVICES}={_elastic_env!r}: device count "
+                "must be >= 1")
+        if args.cpu_devices:
+            args.cpu_devices = min(args.cpu_devices, elastic_devices)
+            logger.warning(
+                "elastic relaunch: virtual CPU platform shrunk to %d "
+                "device(s) (%s)", args.cpu_devices, ENV_ELASTIC_DEVICES)
+
     if args.platform or args.cpu_devices:
         from tensorflow_train_distributed_tpu.runtime.mesh import (
             force_platform,
@@ -568,7 +617,23 @@ def run(args: argparse.Namespace) -> RunResult:
     # 2. Mesh from strategy preset (+ explicit axis overrides).
     entry = registry.get_entry(args.config)
     strategy = args.strategy or entry["strategy"]
-    n_dev = len(jax.devices())
+    devices = list(jax.devices())
+    if elastic_devices is not None and elastic_devices < len(devices):
+        # Real-backend elastic relaunch: the dead chips may still be
+        # enumerable for a while — pin the mesh to the surviving count.
+        # KNOWN APPROXIMATION: the sidecar carries a COUNT, not device
+        # ids, so the prefix slice can pick a still-enumerable dead
+        # chip (and drop a healthy one) when the runtime keeps listing
+        # it.  That relaunch exits 113 again and the supervisor's
+        # max_device_losses cap bounds the loop; identifying survivors
+        # by id/health-probe is the multi-host elasticity seam
+        # (ROADMAP) — the virtual-CPU path shrinks the platform itself,
+        # so the slice is exact there.
+        devices = devices[:elastic_devices]
+        logger.warning(
+            "elastic relaunch: building the mesh over %d of %d "
+            "visible device(s)", len(devices), len(jax.devices()))
+    n_dev = len(devices)
     cfg = strategy_preset(strategy, n_dev)
     if args.mesh:
         overrides = _parse_mesh_overrides(args.mesh)
@@ -577,8 +642,23 @@ def run(args: argparse.Namespace) -> RunResult:
         if -1 not in sizes.values() and "data" not in overrides:
             sizes["data"] = -1  # let data absorb the remaining devices
         cfg = MeshConfig(strategy=strategy, **sizes)
+    if elastic_devices is not None:
+        # Divisibility degrade: explicit --mesh sizes pinned for the
+        # original device count shrink to the nearest valid layout on
+        # the survivors instead of crash-looping the relaunch.
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            degrade_to_fit,
+        )
+
+        fitted = degrade_to_fit(cfg, n_dev)
+        if fitted.axis_sizes() != cfg.axis_sizes():
+            logger.warning(
+                "elastic relaunch: mesh %s does not fit %d device(s); "
+                "degraded to %s", cfg.axis_sizes(), n_dev,
+                fitted.axis_sizes())
+        cfg = fitted
     dcn_axes = _parse_mesh_overrides(args.dcn) if args.dcn else None
-    mesh = build_mesh(cfg, dcn_axes=dcn_axes)
+    mesh = build_mesh(cfg, devices=devices, dcn_axes=dcn_axes)
     logger.info("mesh: %s (strategy=%s, %d devices)",
                 dict(mesh.shape), strategy, n_dev)
 
@@ -1111,6 +1191,43 @@ def run(args: argparse.Namespace) -> RunResult:
                      preempted=preempted)
 
 
+def _handle_device_loss(args, dl) -> int:
+    """Device-loss exit contract (the elastic half of fault tolerance):
+    record the surviving device count in the elastic sidecar — the path
+    the supervisor exported (``TTD_ELASTIC_STATE``), falling back to a
+    checkpoint-dir sidecar for externally-supervised runs — and hand
+    back ``DEVICE_LOSS_EXIT_CODE`` so the supervisor relaunches onto
+    the survivors instead of burning the crash budget."""
+    import json
+    import os
+    import time
+
+    from tensorflow_train_distributed_tpu.runtime.supervisor import (
+        DEVICE_LOSS_EXIT_CODE, ENV_ELASTIC_STATE,
+    )
+
+    path = os.environ.get(ENV_ELASTIC_STATE)
+    if not path and args.checkpoint_dir:
+        path = os.path.join(args.checkpoint_dir, "elastic.json")
+    if path:
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "w") as f:
+                json.dump({"survivors": dl.survivors,
+                           "time": time.time(),
+                           "error": str(dl)[:500]}, f)
+        except OSError:
+            logger.error("could not write elastic sidecar %s", path,
+                         exc_info=True)
+    logger.error(
+        "DEVICE LOSS: %s — exiting %d (surviving devices: %s; a "
+        "supervisor relaunches onto them with the checkpoint "
+        "resharded)", dl, DEVICE_LOSS_EXIT_CODE,
+        "unknown" if dl.survivors is None else dl.survivors)
+    return DEVICE_LOSS_EXIT_CODE
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -1141,7 +1258,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         PREEMPTION_EXIT_CODE,
     )
 
-    result = run(args)
+    try:
+        result = run(args)
+    except Exception as e:
+        # Device-loss classification: an injected DeviceLost
+        # (mesh:device_lost fault plan) or a real runtime error whose
+        # text matches the known device-failure signatures becomes the
+        # device-loss exit contract; every other error crashes as
+        # before (the supervisor's crash budget applies).
+        from tensorflow_train_distributed_tpu.runtime import faults as _f
+
+        dl = _f.as_device_loss(e)
+        if dl is None:
+            raise
+        return _handle_device_loss(args, dl)
     if result.preempted:
         # The shared exit-code contract (runtime.preemption): non-zero so
         # schedulers reschedule, and distinct so supervisors know this

@@ -187,6 +187,47 @@ def make_state_shardings(
     )
 
 
+def fold_leading_replicas(arr, w_new: int):
+    """Refold a per-replica leading dim from ``W_old`` rows to
+    ``w_new``, preserving the row-sum.
+
+    The elastic-restore transform for mesh-size-dependent state (the
+    quantized-collectives ``grad_residual``: one row per data replica,
+    each row the replica's unsent quantization error).  Error feedback
+    only ever consumes the rows by adding them into the pre-sync local
+    gradients, whose cross-replica SUM is what reaches the weights —
+    so any refold that preserves the total is semantically exact:
+
+    - ``W_old == k·w_new`` — sum groups of k adjacent rows (shrink);
+    - ``w_new == k·W_old`` — old rows keep their error, new rows start
+      at zero (grow);
+    - otherwise (the divisibility degrade) — the whole total lands on
+      row 0 and the rest start at zero, instead of raising: restore
+      onto ANY surviving mesh beats losing the residual.
+    """
+    import numpy as np
+
+    arr = np.asarray(arr)
+    w_old = arr.shape[0]
+    if w_old == w_new:
+        return arr
+    if w_new < 1:
+        raise ValueError(f"w_new must be >= 1, got {w_new}")
+    tail = arr.shape[1:]
+    if w_old % w_new == 0:
+        return arr.reshape((w_new, w_old // w_new) + tail).sum(axis=1)
+    out = np.zeros((w_new,) + tail, arr.dtype)
+    if w_new % w_old == 0:
+        out[:w_old] = arr
+    else:
+        logger.warning(
+            "fold_leading_replicas: %d -> %d rows do not divide; "
+            "folding the whole residual into row 0 (sum-preserving "
+            "degrade)", w_old, w_new)
+        out[0] = arr.sum(axis=0)
+    return out
+
+
 def shard_batch_spec(mesh: Mesh) -> P:
     """PartitionSpec for host batches: leading dim over every DP-like axis."""
     from tensorflow_train_distributed_tpu.runtime.mesh import batch_axes

@@ -13,6 +13,7 @@ A **fault plan** is a ``;``-separated list of entries
     step:120:raise              # raise InjectedFault at step 120
     step:200:kill9              # SIGKILL the process at step 200
     step:80:sigterm             # deliver SIGTERM (preemption sim)
+    mesh:device_lost:4:step=5   # lose devices at step 5; 4 survive
     ckpt:save:partial           # corrupt the next finished save
     ckpt:save:partial:step=40   # corrupt the step-40 save specifically
     data:read:transient_io:p=0.01   # fail ~1% of record reads (seeded)
@@ -20,6 +21,17 @@ A **fault plan** is a ``;``-separated list of entries
     serve:dispatch:5:raise          # engine driver dies at dispatch 5
     serve:dispatch:5:hang           # ... hangs mid-dispatch (watchdog)
     serve:dispatch:5:kill9:replica=1    # replica 1 vanishes abruptly
+
+Mesh-side entries (``mesh:device_lost:<survivors>``) simulate losing
+part of the device mesh mid-training: at/after the ``step=`` trigger
+(default: the first observed boundary) the trainer raises
+``DeviceLost(survivors)`` — the same exception ``launch.py`` converts
+real runtime device failures into — which the launcher turns into the
+device-loss exit-code contract (``runtime.supervisor``): surviving
+device count recorded in the elastic sidecar, exit
+``DEVICE_LOSS_EXIT_CODE``, supervisor relaunch onto the survivors with
+the checkpoint resharded (``training.checkpoint``).  This is the
+trainer-side analog of ``serve:dispatch:kill9`` at mesh granularity.
 
 Serving-side entries (``serve:dispatch``) fire at the engine driver's
 Nth decode dispatch — the serving analog of the trainer's step
@@ -103,7 +115,57 @@ class InjectedKill(BaseException):
     ``except Exception`` recovery machinery cannot absorb it)."""
 
 
+class DeviceLost(RuntimeError):
+    """Part of the device mesh failed mid-run.
+
+    ``survivors`` is the usable device count after the loss (None when
+    unknown — a real runtime failure where nothing can be probed).
+    Raised by the ``mesh:device_lost`` injection point, or converted
+    from a real runtime error by ``as_device_loss``; ``launch.py``
+    turns it into the device-loss exit-code contract the supervisor
+    relaunches on (``runtime.supervisor.DEVICE_LOSS_EXIT_CODE``)."""
+
+    def __init__(self, message: str, survivors: Optional[int] = None):
+        super().__init__(message)
+        self.survivors = survivors
+
+
+# Signatures of runtime errors that mean a device (not the program)
+# died: the PJRT/XLA strings raised when a chip drops off the ICI
+# fabric or its runtime process dies mid-execution.  Deliberately
+# narrow — a false positive would reshard a healthy mesh on an
+# ordinary crash, silently shrinking the run's compute, and relaunch
+# it free of the crash budget.  Generic status-code strings
+# ("DATA_LOSS", gRPC's "failed to connect to all addresses") are
+# EXCLUDED on purpose: they also decorate corrupted-input reads and
+# misconfigured-coordinator failures, which must stay ordinary
+# budgeted crashes.
+_DEVICE_LOSS_SIGNATURES = (
+    "device is in an invalid state",
+    "Device or slice has been lost",
+    "TPU is in an unhealthy state",
+)
+
+
+def as_device_loss(exc: BaseException) -> Optional[DeviceLost]:
+    """``DeviceLost`` view of a runtime error, or None.
+
+    Passes an existing ``DeviceLost`` through; otherwise matches the
+    error text against the known device-failure signatures.  Survivor
+    count stays None for converted errors — after a real device loss
+    the backend cannot be probed from this process; the relaunch
+    re-discovers the device set itself."""
+    if isinstance(exc, DeviceLost):
+        return exc
+    text = str(exc)
+    if any(sig in text for sig in _DEVICE_LOSS_SIGNATURES):
+        return DeviceLost(f"device loss inferred from runtime error: "
+                          f"{type(exc).__name__}: {text[:500]}")
+    return None
+
+
 _STEP_ACTIONS = ("raise", "kill9", "sigterm", "exit")
+_MESH_ACTIONS = ("device_lost",)
 _CKPT_ACTIONS = ("partial",)
 _DATA_ACTIONS = ("transient_io",)
 _SERVE_ACTIONS = ("raise", "hang", "kill9")
@@ -207,6 +269,27 @@ def parse_plan(spec: str, *, seed: int = 0,
                     f"{action!r}; have {_STEP_ACTIONS}")
             entries.append(FaultEntry("step", action, trigger,
                                       _parse_params(rest)))
+        elif site == "mesh":
+            if len(parts) < 3 or parts[1] not in _MESH_ACTIONS:
+                raise ValueError(
+                    f"fault entry {raw!r}: want "
+                    f"mesh:device_lost:<survivors>[:step=N]")
+            try:
+                survivors = int(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"fault entry {raw!r}: survivor count {parts[2]!r} is "
+                    "not an integer") from None
+            if survivors < 1:
+                raise ValueError(
+                    f"fault entry {raw!r}: survivors must be >= 1 (a "
+                    "0-device mesh has nothing to relaunch onto)")
+            params = _parse_params(parts[3:])
+            params["survivors"] = survivors
+            # ``step=`` picks the boundary (default 1: the first one the
+            # loop observes) — the step-entry trigger semantics.
+            entries.append(FaultEntry(
+                "mesh", parts[1], int(params.get("step", 1)), params))
         elif site == "ckpt":
             if len(parts) < 3 or parts[1] != "save":
                 raise ValueError(
@@ -253,7 +336,7 @@ def parse_plan(spec: str, *, seed: int = 0,
         else:
             raise ValueError(
                 f"fault entry {raw!r}: unknown site {site!r}; have "
-                "step | ckpt:save | data:read | serve:dispatch")
+                "step | mesh | ckpt:save | data:read | serve:dispatch")
     if not entries:
         raise ValueError(f"fault plan {spec!r} has no entries")
     return FaultPlan(entries, seed=seed, attempt=attempt)
@@ -316,16 +399,28 @@ def step_boundary(step: int) -> None:
     Fires entries whose trigger has been reached (``trigger <= step`` —
     with ``steps_per_execution`` k>1 the loop only observes every k-th
     boundary, and a trigger between two boundaries fires at the next
-    one rather than never).
+    one rather than never).  ``mesh:device_lost`` entries share the
+    boundary: a lost chip surfaces to the host loop at the next
+    dispatch, which is exactly here.
     """
     p = _PLAN
     if p is None:
         return
     for entry in p.entries:
-        if entry.site != "step" or not entry.live(p.attempt):
+        if entry.site not in ("step", "mesh") or not entry.live(p.attempt):
             continue
-        if step >= entry.trigger_step:
-            _execute_step_action(entry, step)
+        if step < entry.trigger_step:
+            continue
+        if entry.site == "mesh":
+            entry.fired += 1
+            survivors = int(entry.params["survivors"])
+            logger.warning(
+                "fault injection: device loss at step %d (%d devices "
+                "survive)", step, survivors)
+            raise DeviceLost(
+                f"injected device loss at step {step} "
+                f"({survivors} devices survive)", survivors)
+        _execute_step_action(entry, step)
 
 
 def on_checkpoint_save(step: int, step_dir: str,

@@ -180,7 +180,15 @@ def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:
     cfg = STRATEGY_PRESETS[name]
     if n_devices is None:
         return cfg
-    sizes = cfg.axis_sizes()
+    return MeshConfig(strategy=name,
+                      **_shrink_sizes(cfg.axis_sizes(), n_devices))
+
+
+def _shrink_sizes(sizes: dict, n_devices: int) -> dict:
+    """Shrink fixed (>1, non-inferred) axes until the mesh fits
+    ``n_devices`` — halving non-dividers first, then the largest fixed
+    axis until the fixed product divides the device count."""
+    sizes = dict(sizes)
     fixed_axes = [a for a, s in sizes.items() if s not in (1, -1)]
     for axis in fixed_axes:
         while sizes[axis] > 1 and n_devices % sizes[axis]:
@@ -194,7 +202,37 @@ def strategy_preset(name: str, n_devices: Optional[int] = None) -> MeshConfig:
             break
         sizes[big] //= 2
         fixed = math.prod(s for s in sizes.values() if s != -1)
-    return MeshConfig(strategy=name, **sizes)
+    return sizes
+
+
+def degrade_to_fit(config: MeshConfig, n_devices: int) -> MeshConfig:
+    """Nearest valid layout for ``config`` on ``n_devices`` devices.
+
+    The elastic-relaunch divisibility degrade: a run configured with
+    explicit ``--mesh`` axis sizes that no longer fit the surviving
+    device set comes back with its fixed axes shrunk (same rules as
+    ``strategy_preset``'s shrink-to-fit) and any explicitly-pinned
+    product mismatch absorbed by the data axis — training continues on
+    the smaller mesh instead of crash-looping the relaunch.  Returns
+    ``config`` unchanged when it already resolves.
+    """
+    try:
+        config.resolve(n_devices)
+        return config
+    except ValueError:
+        pass
+    sizes = _shrink_sizes(config.axis_sizes(), n_devices)
+    probe = MeshConfig(strategy=config.strategy, **sizes)
+    try:
+        probe.resolve(n_devices)
+    except ValueError:
+        # Fixed axes fit but the explicit product mismatches (e.g.
+        # data pinned to the old device count): let data absorb the
+        # remainder.
+        sizes["data"] = -1
+        probe = MeshConfig(strategy=config.strategy, **sizes)
+        probe.resolve(n_devices)  # raises only if truly unsatisfiable
+    return probe
 
 
 def hybrid_shapes(sizes: dict[str, int],

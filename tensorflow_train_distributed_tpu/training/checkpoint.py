@@ -90,23 +90,26 @@ class CheckpointManager:
         self._mgr.reload()
         return dst
 
-    def _saved_residual_leaves(self, step: int) -> Optional[bool]:
-        """Does the saved tree at ``step`` carry ``grad_residual``
-        LEAVES?  None when the metadata probe fails (fall back to a
-        plain restore).  A state saved with grad_residual=None keeps
-        the KEY with a None value in the metadata tree — presence
-        means leaves, not key membership."""
+    def _probe_residual_meta(self, step: int):
+        """One best-effort orbax metadata probe of the saved
+        ``grad_residual``: ``(True, subtree)`` when the saved tree
+        carries residual LEAVES (leaf objects carry
+        ``.shape``/``.dtype``), ``(True, None)`` when it does not, and
+        ``(False, None)`` when the probe itself fails (callers fall
+        back to a plain restore).  A state saved with
+        grad_residual=None keeps the KEY with a None value in the
+        metadata tree — presence means leaves, not key membership."""
         item_dir = os.path.join(self._step_dir(step), "default")
         try:
             meta = ocp.StandardCheckpointer().metadata(item_dir)
             meta = getattr(meta, "item_metadata", meta)
-            return bool(jax.tree.leaves(
-                meta["grad_residual"] if "grad_residual" in meta
-                else None))
+            sub = meta["grad_residual"] if "grad_residual" in meta else None
+            return True, (sub if jax.tree.leaves(sub) else None)
         except Exception:  # noqa: BLE001 — metadata probe is best-effort
-            return None
+            return False, None
 
-    def _split_missing_residual(self, step: int, abstract):
+    def _split_missing_residual(self, step: int, abstract,
+                                probed=None):
         """Back-compat for checkpoints saved before the train state
         carried ``grad_residual`` (quantized gradient collectives'
         error-feedback buffers): when the template asks for residual
@@ -116,25 +119,25 @@ class CheckpointManager:
         — a pre-quant run's checkpoint resumes into a grad-quant
         trainer with error feedback starting from zero (its exact
         semantics at step 0).  ``(abstract, None)`` when nothing to do.
+        ``probed`` reuses a caller's ``_probe_residual_meta`` result
+        instead of probing the same directory twice.
         """
         res = getattr(abstract, "grad_residual", None)
         if res is None or not jax.tree.leaves(res):
             return abstract, None
-        if self._saved_residual_leaves(step) is not False:
+        ok, saved = probed if probed is not None \
+            else self._probe_residual_meta(step)
+        if not ok or saved is not None:
             return abstract, None
         return abstract.replace(grad_residual=None), res
 
-    def _restore_dropping_residual(self, step: int, abstract):
-        """The reverse compat direction: the saved tree CARRIES
-        ``grad_residual`` leaves (a grad-quant run's checkpoint) but
-        the template does not (``--grad-quant none`` or the
-        ``TTD_NO_GRAD_QUANT=1`` kill-switch restart).  A
-        ``StandardRestore`` of the leafless template would trip over
-        the extra subtree, so restore every OTHER top-level subtree
-        via a partial ``PyTreeRestore`` into the template's shardings
-        — the residual bytes are never even deserialized (error
-        feedback restarts from zero if quant is re-enabled later,
-        which is what dropping the residual means)."""
+    def _restore_without_residual(self, step: int, abstract):
+        """Partial restore of every top-level subtree EXCEPT
+        ``grad_residual`` into the template's shardings; the state
+        comes back with ``grad_residual=None`` and the residual bytes
+        are never deserialized.  Shared by the drop-residual compat
+        path and the mesh-resize reshard path (which reattaches a
+        refolded residual afterwards)."""
         import dataclasses as _dc
 
         item_dir = os.path.join(self._step_dir(step), "default")
@@ -161,11 +164,81 @@ class CheckpointManager:
                 transforms={},
             ),
         )
+        return type(abstract)(**{**rest, **restored})
+
+    def _restore_dropping_residual(self, step: int, abstract):
+        """The reverse compat direction: the saved tree CARRIES
+        ``grad_residual`` leaves (a grad-quant run's checkpoint) but
+        the template does not (``--grad-quant none`` or the
+        ``TTD_NO_GRAD_QUANT=1`` kill-switch restart).  A
+        ``StandardRestore`` of the leafless template would trip over
+        the extra subtree, so restore every OTHER top-level subtree
+        via a partial ``PyTreeRestore`` into the template's shardings
+        — the residual bytes are never even deserialized (error
+        feedback restarts from zero if quant is re-enabled later,
+        which is what dropping the residual means)."""
+        restored = self._restore_without_residual(step, abstract)
         logger.info(
             "checkpoint carries grad_residual but the trainer runs "
             "without grad-quant: restored dropping the residual "
             "(error feedback restarts from zero if re-enabled)")
-        return type(abstract)(**{**rest, **restored})
+        return restored
+
+    def _restore_resharded_residual(self, step: int, abstract,
+                                    saved_meta):
+        """Mesh-resize restore for the one shape-dependent leaf family:
+        ``grad_residual`` rows are PER DATA REPLICA (leading dim = the
+        saving mesh's dp degree), so an N-chip checkpoint's residual
+        cannot StandardRestore into an M-chip template.  Restore
+        everything else into the template's shardings, deserialize the
+        residual at its SAVED shape (host arrays), refold the leading
+        dim sum-preservingly (``sharding.fold_leading_replicas`` — the
+        cross-replica sum is all error feedback ever consumes), and
+        place the result into the template's shardings."""
+        from tensorflow_train_distributed_tpu.parallel.sharding import (
+            fold_leading_replicas,
+        )
+
+        restored = self._restore_without_residual(step, abstract)
+        item_dir = os.path.join(self._step_dir(step), "default")
+        old_abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), saved_meta)
+        item = {"grad_residual": old_abstract}
+        raw = ocp.PyTreeCheckpointer().restore(
+            item_dir,
+            args=ocp.args.PyTreeRestore(
+                item=item,
+                restore_args=jax.tree.map(lambda _: ocp.RestoreArgs(),
+                                          item),
+                transforms={},
+            ),
+        )["grad_residual"]
+
+        template = abstract.grad_residual
+        w_old = jax.tree.leaves(old_abstract)[0].shape[0]
+        w_new = jax.tree.leaves(template)[0].shape[0]
+
+        def _place(old, tmpl):
+            folded = fold_leading_replicas(np.asarray(old),
+                                           tmpl.shape[0])
+            if folded.shape != tmpl.shape:
+                raise ValueError(
+                    f"resharded grad_residual leaf {folded.shape} does "
+                    f"not match the template's {tmpl.shape}: the "
+                    "per-replica rows reshard, the per-param tail must "
+                    "match (different model?)")
+            folded = folded.astype(tmpl.dtype)
+            sharding = getattr(tmpl, "sharding", None)
+            if sharding is not None:
+                return jax.device_put(folded, sharding)
+            return folded
+
+        residual = jax.tree.map(_place, raw, template)
+        logger.info(
+            "restored checkpoint step %d with grad_residual resharded "
+            "%d -> %d data replicas (sum-preserving refold)", step,
+            w_old, w_new)
+        return restored.replace(grad_residual=residual)
 
     @staticmethod
     def _zero_residual(restored, residual_abstract):
@@ -198,19 +271,33 @@ class CheckpointManager:
         return restored.replace(grad_residual=zeros)
 
     def _restore_adapted(self, step: int, abstract):
-        """One orbax restore with grad_residual compat in BOTH
-        directions: template-has/saved-lacks → restore old layout +
+        """One orbax restore with grad_residual compat in every
+        direction: template-has/saved-lacks → restore old layout +
         zero-fill; template-lacks/saved-has → partial restore dropping
-        the residual; otherwise a plain StandardRestore."""
+        the residual; both-have but the per-replica leading dim differs
+        (an N-chip checkpoint restoring onto an M-chip mesh — the
+        elastic reshard) → refold the residual; otherwise a plain
+        StandardRestore.  Every other leaf is mesh-shape-independent:
+        orbax reshards it into the template's shardings natively."""
         import dataclasses as _dc
 
+        probed = None
         if (_dc.is_dataclass(abstract)
-                and hasattr(abstract, "grad_residual")
-                and not jax.tree.leaves(
-                    getattr(abstract, "grad_residual", None))
-                and self._saved_residual_leaves(step) is True):
-            return self._restore_dropping_residual(step, abstract)
-        abstract, res = self._split_missing_residual(step, abstract)
+                and hasattr(abstract, "grad_residual")):
+            probed = self._probe_residual_meta(step)
+            ok, saved_meta = probed
+            template_res = getattr(abstract, "grad_residual", None)
+            if not jax.tree.leaves(template_res):
+                if ok and saved_meta is not None:
+                    return self._restore_dropping_residual(step, abstract)
+            elif ok and saved_meta is not None:
+                saved_w = jax.tree.leaves(saved_meta)[0].shape[0]
+                tmpl_w = jax.tree.leaves(template_res)[0].shape[0]
+                if saved_w != tmpl_w:
+                    return self._restore_resharded_residual(
+                        step, abstract, saved_meta)
+        abstract, res = self._split_missing_residual(step, abstract,
+                                                     probed)
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract))
         if res is not None:
@@ -236,6 +323,16 @@ class CheckpointManager:
         reused — the mid-run ``BackupAndRestore`` path) or a tree of
         ShapeDtypeStructs with shardings attached.  Returns None when no
         checkpoint exists (caller starts fresh).
+
+        Reshard-on-resize: the template's shardings may target a mesh
+        of a DIFFERENT size/shape than the one that saved (the elastic
+        relaunch after device loss, or a deliberate resize) — orbax
+        reads each leaf straight into the target sharding, and the one
+        mesh-shape-dependent leaf family (the quantized-collectives
+        ``grad_residual``, one row per data replica) is refolded
+        sum-preservingly (``_restore_resharded_residual``).  Covered
+        layouts: dp, dp×fsdp/tp, zero1 moments, residual-carrying
+        quant states.
 
         Crash-consistent fallback (``step=None`` — the relaunch path): a
         step that fails to restore (torn save from a kill -9, truncated

@@ -943,7 +943,20 @@ class Trainer:
                     break
                 cur = start_step + done + k
                 with events.span("train/step_dispatch", step=cur):
-                    state, metrics = step_fn(state, dev_batch)
+                    try:
+                        state, metrics = step_fn(state, dev_batch)
+                    except Exception as e:
+                        # Device-loss classification at the dispatch
+                        # boundary: a runtime error matching the known
+                        # device-failure signatures re-raises as
+                        # DeviceLost so launch.py exits with the
+                        # device-loss contract (supervisor relaunches
+                        # onto the survivors) instead of spending the
+                        # crash budget on dead hardware.
+                        dl = faults.as_device_loss(e)
+                        if dl is not None:
+                            raise dl from e
+                        raise
                 # Callbacks that checkpoint (preemption handler) read the
                 # current state from here — fit's loop variable is otherwise
                 # invisible to them.

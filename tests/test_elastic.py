@@ -1,0 +1,409 @@
+"""Elastic mesh training tests: reshard-on-resize restore across the
+supported layouts, the per-replica residual refold, and the supervisor's
+device-loss classification/relaunch — plus the tier-1 elastic chaos
+smoke driving ``tools/chaos_check.py --train-elastic`` (the same
+one-command gate CI uses), matching how ``--serving`` chaos runs in
+tier-1 today.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_train_distributed_tpu.parallel.sharding import (
+    fold_leading_replicas, shard_batch,
+)
+from tensorflow_train_distributed_tpu.runtime.mesh import (
+    MeshConfig, build_mesh, degrade_to_fit,
+)
+from tensorflow_train_distributed_tpu.runtime.supervisor import (
+    DEVICE_LOSS_EXIT_CODE,
+    ENV_ELASTIC_DEVICES,
+    ENV_ELASTIC_STATE,
+    TrainSupervisor,
+)
+from tensorflow_train_distributed_tpu.training import Trainer, TrainerConfig
+from tensorflow_train_distributed_tpu.training.checkpoint import (
+    CheckpointManager,
+)
+
+from tests.test_trainer import _BlobsTask, _loader
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+_TOOLS = os.path.join(REPO_ROOT, "tools")
+
+
+# ── reshard-on-resize restore: N→M for every supported layout ──────────
+
+
+def _trainer(mesh, **cfg_kw):
+    return Trainer(_BlobsTask(), optax.adam(1e-2), mesh,
+                   config=TrainerConfig(log_every=100, **cfg_kw))
+
+
+def _advance(trainer, mesh, state, batch, n=2):
+    step = trainer._compiled_train_step()
+    for _ in range(n):
+        state, metrics = step(state, shard_batch(mesh, batch))
+    return state, metrics
+
+
+def _step_loss(trainer, mesh, state, batch):
+    _, metrics = trainer._compiled_train_step()(
+        state, shard_batch(mesh, batch))
+    return float(metrics["loss"])
+
+
+@pytest.mark.parametrize("layout,save_cfg,restore_cfg,cfg_kw", [
+    ("dp", MeshConfig(data=8), MeshConfig(data=4), {}),
+    ("dp_fsdp", MeshConfig(data=2, fsdp=4), MeshConfig(data=2, fsdp=2),
+     {}),
+    ("zero1", MeshConfig(data=8), MeshConfig(data=4), {"zero1": True}),
+])
+def test_reshard_restore_step_parity(layout, save_cfg, restore_cfg,
+                                     cfg_kw, tmp_path):
+    """An N-chip checkpoint restores onto an M-chip mesh with the
+    template's shardings, and the next step matches a SAME-mesh restore
+    of the same checkpoint (the reshard changed placement, not state).
+    """
+    devs = jax.devices()
+    mesh_n = build_mesh(save_cfg, devices=devs[:8])
+    n_m = int(np.prod(list(restore_cfg.axis_sizes().values())))
+    mesh_m = build_mesh(restore_cfg, devices=devs[:n_m])
+
+    batch = next(iter(_loader()))
+    t_n = _trainer(mesh_n, **cfg_kw)
+    state, _ = _advance(t_n, mesh_n, t_n.create_state(batch), batch)
+    mgr = CheckpointManager(str(tmp_path / layout), async_save=False)
+    try:
+        assert mgr.save(int(state.step), state)
+        mgr.wait_until_finished()
+
+        # Same-mesh restore: the parity baseline.
+        t_same = _trainer(mesh_n, **cfg_kw)
+        same = mgr.restore(t_same.create_state(batch))
+        # Resharded restore onto the smaller mesh.
+        t_m = _trainer(mesh_m, **cfg_kw)
+        resharded = mgr.restore(t_m.create_state(batch))
+        assert int(resharded.step) == int(same.step)
+        # Values identical leaf-wise; shardings re-target mesh_m.
+        for a, b in zip(jax.tree.leaves(same.params),
+                        jax.tree.leaves(resharded.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        leaf = jax.tree.leaves(resharded.params)[0]
+        assert leaf.sharding.mesh.shape == dict(mesh_m.shape)
+
+        # Step parity: one more step on each restore, same global
+        # batch — same loss up to the M-way vs N-way reduction
+        # reassociation (the retuned cross-topology numerics bar).
+        loss_same = _step_loss(t_same, mesh_n, same, batch)
+        loss_resh = _step_loss(t_m, mesh_m, resharded, batch)
+        np.testing.assert_allclose(loss_resh, loss_same, rtol=1e-3)
+    finally:
+        mgr.close()
+
+
+def test_reshard_restore_grad_quant_residual(tmp_path):
+    """A ``--grad-quant int8`` checkpoint (per-replica error-feedback
+    residual, leading dim = the saving mesh's dp degree) restores onto
+    a HALF-size mesh: the residual refolds sum-preservingly and the
+    next step stays loss-parity with a same-mesh restore."""
+    devs = jax.devices()
+    mesh8 = build_mesh(MeshConfig(data=8), devices=devs[:8])
+    mesh4 = build_mesh(MeshConfig(data=4), devices=devs[:4])
+    batch = next(iter(_loader()))
+
+    t8 = _trainer(mesh8, grad_quant="int8")
+    state, _ = _advance(t8, mesh8, t8.create_state(batch), batch)
+    res_leaves = jax.tree.leaves(state.grad_residual)
+    assert res_leaves and res_leaves[0].shape[0] == 8
+    # The quantizer really left error behind (else the fold is vacuous).
+    assert any(float(np.abs(np.asarray(leaf)).max()) > 0
+               for leaf in res_leaves)
+    saved_sums = [np.asarray(leaf).sum(axis=0) for leaf in res_leaves]
+
+    mgr = CheckpointManager(str(tmp_path / "quant"), async_save=False)
+    try:
+        assert mgr.save(int(state.step), state)
+        mgr.wait_until_finished()
+
+        t_same = _trainer(mesh8, grad_quant="int8")
+        same = mgr.restore(t_same.create_state(batch))
+        t4 = _trainer(mesh4, grad_quant="int8")
+        resharded = mgr.restore(t4.create_state(batch))
+
+        new_leaves = jax.tree.leaves(resharded.grad_residual)
+        assert all(leaf.shape[0] == 4 for leaf in new_leaves)
+        # Sum-preserving refold: the cross-replica total — the only
+        # quantity error feedback ever feeds back — is exact.
+        for saved, leaf in zip(saved_sums, new_leaves):
+            np.testing.assert_allclose(np.asarray(leaf).sum(axis=0),
+                                       saved, rtol=1e-6, atol=1e-7)
+
+        # Step parity: the 4-replica wire quantizes different shard
+        # boundaries than the 8-replica wire, so the bar is the quant
+        # A/B's loss-parity convention, not the exact-arith one.
+        loss_same = _step_loss(t_same, mesh8, same, batch)
+        loss_resh = _step_loss(t4, mesh4, resharded, batch)
+        np.testing.assert_allclose(loss_resh, loss_same, rtol=1e-2)
+    finally:
+        mgr.close()
+
+
+class TestFoldLeadingReplicas:
+    def test_divisible_shrink_sums_groups(self):
+        a = np.arange(24, dtype=np.float32).reshape(8, 3)
+        out = fold_leading_replicas(a, 4)
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out[0], a[0] + a[1])
+        np.testing.assert_allclose(out.sum(0), a.sum(0))
+
+    def test_divisible_grow_zero_fills(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        out = fold_leading_replicas(a, 8)
+        assert out.shape == (8, 3)
+        np.testing.assert_allclose(out[:4], a)
+        np.testing.assert_allclose(out[4:], 0.0)
+
+    def test_non_divisible_degrades_sum_to_row0(self):
+        # The divisibility DEGRADE: 8→3 cannot group evenly; the whole
+        # total lands on row 0 instead of raising.
+        a = np.arange(24, dtype=np.float32).reshape(8, 3)
+        out = fold_leading_replicas(a, 3)
+        assert out.shape == (3, 3)
+        np.testing.assert_allclose(out[0], a.sum(0))
+        np.testing.assert_allclose(out[1:], 0.0)
+
+    def test_identity(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(fold_leading_replicas(a, 2), a)
+
+
+class TestMeshDegrade:
+    def test_fitting_config_unchanged(self):
+        cfg = MeshConfig(data=4)
+        assert degrade_to_fit(cfg, 4) is cfg
+
+    def test_pinned_data_shrinks(self):
+        sizes = degrade_to_fit(MeshConfig(data=8), 4).axis_sizes()
+        assert sizes["data"] == 4
+
+    def test_fixed_axes_shrink_then_data_absorbs(self):
+        sizes = degrade_to_fit(MeshConfig(data=2, fsdp=4), 4).axis_sizes()
+        assert sizes["fsdp"] == 2 and sizes["data"] == 2
+
+
+# ── supervisor device-loss classification + elastic relaunch ───────────
+
+
+def _sidecar_child(sidecar: str, marker: str) -> list:
+    """First attempt: write the elastic sidecar (via the env path the
+    supervisor exported) and exit with the device-loss code.  Relaunch:
+    record TTD_ELASTIC_DEVICES to ``marker`` and exit clean."""
+    code = (
+        "import json, os, pathlib, sys\n"
+        f"m = pathlib.Path({marker!r})\n"
+        "if m.exists():\n"
+        "    m.write_text(os.environ.get("
+        f"{ENV_ELASTIC_DEVICES!r}, 'MISSING'))\n"
+        "    sys.exit(0)\n"
+        "m.write_text('')\n"
+        f"path = os.environ[{ENV_ELASTIC_STATE!r}]\n"
+        "json.dump({'survivors': 4}, open(path, 'w'))\n"
+        f"sys.exit({DEVICE_LOSS_EXIT_CODE})\n"
+    )
+    return [sys.executable, "-c", code]
+
+
+def test_device_loss_relaunches_on_survivors(tmp_path):
+    marker = tmp_path / "marker"
+    sidecar = tmp_path / "elastic.json"
+    res = TrainSupervisor(
+        _sidecar_child(str(sidecar), str(marker)),
+        max_restarts=0,          # ZERO crash budget: the relaunch must
+        backoff_s=0.0,           # be budget-free to happen at all
+        elastic_state_path=str(sidecar)).run()
+    assert res.returncode == 0
+    assert res.device_losses == 1 and res.crashes == 0
+    assert res.attempts == 2 and not res.gave_up
+    # The relaunch saw the surviving device count.
+    assert marker.read_text() == "4"
+
+
+def test_device_loss_journal_and_resize_event(tmp_path):
+    marker = tmp_path / "marker"
+    sidecar = tmp_path / "elastic.json"
+    journal = tmp_path / "j.jsonl"
+    TrainSupervisor(
+        _sidecar_child(str(sidecar), str(marker)),
+        max_restarts=0, backoff_s=0.0,
+        elastic_state_path=str(sidecar),
+        journal_path=str(journal)).run()
+    events = [json.loads(line)
+              for line in journal.read_text().splitlines()]
+    exits = [e for e in events if e["event"] == "exit"]
+    assert [e["class"] for e in exits] == ["device_loss", "clean"]
+    assert exits[0]["rc"] == DEVICE_LOSS_EXIT_CODE
+    assert exits[0]["survivors"] == 4
+    resizes = [e for e in events if e["event"] == "resize"]
+    assert len(resizes) == 1 and resizes[0]["survivors"] == 4
+
+
+def test_no_elastic_env_classifies_as_crash(monkeypatch):
+    """TTD_NO_ELASTIC=1 kill switch: the device-loss exit consumes the
+    crash budget (no resize, no free relaunch)."""
+    monkeypatch.setenv("TTD_NO_ELASTIC", "1")
+    res = TrainSupervisor(
+        [sys.executable, "-c",
+         f"raise SystemExit({DEVICE_LOSS_EXIT_CODE})"],
+        max_restarts=0, backoff_s=0.0).run()
+    assert res.gave_up and res.returncode == DEVICE_LOSS_EXIT_CODE
+    assert res.crashes == 1 and res.device_losses == 0
+
+
+def test_unreadable_sidecar_relaunches_unpinned(tmp_path):
+    """A device-loss exit whose sidecar is missing/garbled still
+    relaunches (survivors unknown → device set unpinned) — losing the
+    sidecar must not turn a recoverable event into a giveup."""
+    marker = tmp_path / "marker"
+    sidecar = tmp_path / "elastic.json"
+    code = (
+        "import os, pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if m.exists():\n"
+        "    m.write_text(os.environ.get("
+        f"{ENV_ELASTIC_DEVICES!r}, 'MISSING'))\n"
+        "    sys.exit(0)\n"
+        "m.write_text('')\n"
+        f"open({str(sidecar)!r}, 'w').write('not json')\n"
+        f"sys.exit({DEVICE_LOSS_EXIT_CODE})\n"
+    )
+    res = TrainSupervisor(
+        [sys.executable, "-c", code],
+        max_restarts=0, backoff_s=0.0,
+        elastic_state_path=str(sidecar)).run()
+    assert res.returncode == 0 and res.device_losses == 1
+    assert marker.read_text() == "MISSING"
+
+
+def test_device_loss_cap_gives_up(tmp_path):
+    """A child that exits 113 on EVERY attempt (flapping chip, unscoped
+    fault plan, misclassified persistent error) must not relaunch
+    forever just because device-loss exits are crash-budget-free."""
+    res = TrainSupervisor(
+        [sys.executable, "-c",
+         f"raise SystemExit({DEVICE_LOSS_EXIT_CODE})"],
+        max_restarts=0, backoff_s=0.0, max_device_losses=2).run()
+    assert res.gave_up and res.returncode == DEVICE_LOSS_EXIT_CODE
+    assert res.device_losses == 3 and res.crashes == 0
+    assert res.attempts == 3
+
+
+def test_stale_sidecar_not_readopted(tmp_path):
+    """The sidecar is consumed on read: device loss #1 pins survivors=4,
+    device loss #2 fails to write a sidecar — the second relaunch must
+    run with the device set UNPINNED (re-discovery), not re-adopt the
+    stale count from the first loss."""
+    counter = tmp_path / "n"
+    marker1 = tmp_path / "m1"
+    marker2 = tmp_path / "m2"
+    sidecar = tmp_path / "elastic.json"
+    code = (
+        "import json, os, pathlib, sys\n"
+        f"c = pathlib.Path({str(counter)!r})\n"
+        "n = int(c.read_text()) if c.exists() else 0\n"
+        "c.write_text(str(n + 1))\n"
+        f"env = os.environ.get({ENV_ELASTIC_DEVICES!r}, 'MISSING')\n"
+        "if n == 0:\n"
+        "    json.dump({'survivors': 4},\n"
+        f"              open(os.environ[{ENV_ELASTIC_STATE!r}], 'w'))\n"
+        f"    sys.exit({DEVICE_LOSS_EXIT_CODE})\n"
+        "if n == 1:\n"
+        f"    pathlib.Path({str(marker1)!r}).write_text(env)\n"
+        f"    sys.exit({DEVICE_LOSS_EXIT_CODE})\n"
+        f"pathlib.Path({str(marker2)!r}).write_text(env)\n"
+        "sys.exit(0)\n"
+    )
+    res = TrainSupervisor(
+        [sys.executable, "-c", code],
+        max_restarts=0, backoff_s=0.0,
+        elastic_state_path=str(sidecar)).run()
+    assert res.returncode == 0 and res.device_losses == 2
+    assert marker1.read_text() == "4"
+    assert marker2.read_text() == "MISSING"
+    assert not sidecar.exists()
+
+
+def test_device_loss_sidecar_written_to_env_path(tmp_path, monkeypatch):
+    """The child half of the TTD_ELASTIC_STATE contract: launch's
+    device-loss handler records the surviving device count at the path
+    the supervisor exported, and returns the device-loss exit code."""
+    from tensorflow_train_distributed_tpu import launch
+    from tensorflow_train_distributed_tpu.runtime import faults
+
+    path = tmp_path / "elastic.json"
+    monkeypatch.setenv("TTD_ELASTIC_STATE", str(path))
+    args = launch.build_parser().parse_args(["--config", "mnist"])
+    rc = launch._handle_device_loss(
+        args, faults.DeviceLost("chip gone", survivors=4))
+    assert rc == DEVICE_LOSS_EXIT_CODE
+    with open(path) as f:
+        sidecar = json.load(f)
+    assert sidecar["survivors"] == 4
+
+
+def test_elastic_devices_env_shrinks_cpu_platform(tmp_path):
+    """The relaunch half of the TTD_ELASTIC_DEVICES contract, through
+    the real CLI: with the env pinned to 4, an 8-virtual-device run
+    builds a 4-device mesh (fresh subprocess — force_platform must run
+    before any backend probe)."""
+    import subprocess
+
+    code = (
+        "from tensorflow_train_distributed_tpu import launch\n"
+        "args = launch.build_parser().parse_args(\n"
+        "    ['--config', 'mnist', '--steps', '1', '--platform', 'cpu',\n"
+        "     '--cpu-devices', '8', '--global-batch-size', '16',\n"
+        "     '--log-every', '1'])\n"
+        "result = launch.run(args)\n"
+        "print('MESHDATA', dict(result.mesh.shape)['data'])\n"
+    )
+    env = dict(os.environ, TTD_ELASTIC_DEVICES="4",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=240,
+                         cwd=REPO_ROOT, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "MESHDATA 4" in out.stdout
+
+
+# ── tier-1 elastic chaos smoke (tools/chaos_check.py --train-elastic) ──
+
+
+def test_train_elastic_chaos_smoke(tmp_path):
+    """Tier-1-sized smoke of the elastic chaos gate: a supervised
+    8-virtual-CPU-device mnist run loses half its devices at step 5
+    (``mesh:device_lost:4``), relaunches on the 4 survivors with the
+    step-4 checkpoint resharded, and converges loss-parity with an
+    uninterrupted 8-device run — driving the same
+    ``run_train_elastic`` entry the CLI gate uses."""
+    spec = importlib.util.spec_from_file_location(
+        "chaos_check_elastic_under_test",
+        os.path.join(_TOOLS, "chaos_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    verdict = mod.run_train_elastic(str(tmp_path))
+    assert verdict["ok"], verdict
+    assert verdict["checks"]["device_loss_then_clean"]
+    assert verdict["checks"]["crash_budget_untouched"]
+    assert verdict["checks"]["restored_pre_loss_step"]
+    assert verdict["checks"]["relaunched_on_survivors"]
+    assert verdict["checks"]["loss_parity"]

@@ -51,10 +51,23 @@ class TestPlanGrammar:
         "", "step:x:raise", "step:10:explode", "foo:1:raise",
         "ckpt:restore:partial", "data:read:boom",
         "data:read:transient_io:p=1.5", "step:10:raise:oops",
+        "mesh:device_lost", "mesh:device_lost:x", "mesh:device_lost:0",
+        "mesh:explode:4",
     ])
     def test_bad_specs_fail_at_parse(self, bad):
         with pytest.raises(ValueError):
             faults.parse_plan(bad)
+
+    def test_mesh_device_lost_parses(self):
+        plan = faults.parse_plan("mesh:device_lost:4:step=5:attempt=0")
+        e = plan.entries[0]
+        assert (e.site, e.action) == ("mesh", "device_lost")
+        assert e.trigger_step == 5
+        assert e.params["survivors"] == 4
+        assert e.attempt == 0
+        # Default trigger: the first observed boundary.
+        assert faults.parse_plan(
+            "mesh:device_lost:2").entries[0].trigger_step == 1
 
     def test_attempt_param(self):
         plan = faults.parse_plan("step:5:raise:attempt=1", attempt=0)
@@ -93,6 +106,39 @@ class TestStepTriggers:
         faults.disarm()
         assert faults.ARMED is False
         faults.step_boundary(100)         # no-op
+
+    def test_mesh_device_lost_fires_at_boundary(self):
+        faults.arm("mesh:device_lost:4:step=5")
+        faults.step_boundary(4)           # below trigger: nothing
+        with pytest.raises(faults.DeviceLost) as ei:
+            faults.step_boundary(6)       # at/after: fires
+        assert ei.value.survivors == 4
+        faults.step_boundary(7)           # fired once: quiet now
+
+
+class TestDeviceLossClassification:
+    def test_device_lost_passthrough(self):
+        dl = faults.DeviceLost("boom", survivors=4)
+        assert faults.as_device_loss(dl) is dl
+
+    def test_signature_match_converts(self):
+        dl = faults.as_device_loss(
+            RuntimeError("INTERNAL: Device or slice has been lost"))
+        assert isinstance(dl, faults.DeviceLost)
+        # Converted errors cannot probe the backend: survivors unknown.
+        assert dl.survivors is None
+
+    def test_ordinary_errors_do_not_convert(self):
+        # A false positive here would reshard a healthy mesh on a plain
+        # crash (and relaunch it crash-budget-free) — the narrowness is
+        # the contract.  Generic status strings that also decorate data
+        # corruption and connection misconfiguration must NOT convert.
+        assert faults.as_device_loss(RuntimeError("NaN loss")) is None
+        assert faults.as_device_loss(ValueError("bad shape")) is None
+        assert faults.as_device_loss(RuntimeError(
+            "DATA_LOSS: corrupted record at offset 123")) is None
+        assert faults.as_device_loss(RuntimeError(
+            "failed to connect to all addresses")) is None
 
 
 class TestDataFaultsAndRetry:

@@ -111,6 +111,143 @@ def test_preemption_saves_and_stops(tmp_path, mesh8):
     assert int(final.step) == 5
 
 
+class _FakeTime:
+    """Drop-in for the supervisor's ``time`` module: a clock the test
+    advances from the injected sleep, so rolling-window accounting is
+    testable without real waiting."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def monotonic(self):
+        return self.t
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _crashes_then_clean(tmp_path, n_crashes):
+    """Child argv that exits 1 for the first ``n_crashes`` attempts,
+    then 0 (counter file carries state across fresh processes)."""
+    import sys
+
+    counter = tmp_path / "attempts"
+    code = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(counter)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        f"sys.exit(1 if n < {n_crashes} else 0)\n"
+    )
+    return [sys.executable, "-c", code]
+
+
+class TestStormSafeRestartBudget:
+    """The supervisor's crash budget under correlated bursts: a rolling
+    window forgives crashes that age out, and the jittered backoff
+    decorrelates relaunch stampedes — so a burst of device-loss-adjacent
+    crashes cannot permanently exhaust the lifetime ``--max-restarts``
+    protection."""
+
+    def _supervisor(self, argv, clock, **kw):
+        import random
+
+        from tensorflow_train_distributed_tpu.runtime import (
+            supervisor as sup_mod,
+        )
+
+        sup = sup_mod.TrainSupervisor(
+            argv, rng=random.Random(0),
+            sleep=lambda s: clock.sleep(max(s, 30.0)), **kw)
+        return sup
+
+    def test_rolling_window_survives_a_burst(self, tmp_path,
+                                             monkeypatch):
+        """3 crashes against max_restarts=1: lifetime accounting gives
+        up at the 2nd, but with a 10 s rolling window each crash ages
+        out during the (advanced-clock) backoff — the run survives the
+        whole burst and finishes clean."""
+        from tensorflow_train_distributed_tpu.runtime import (
+            supervisor as sup_mod,
+        )
+
+        clock = _FakeTime()
+        monkeypatch.setattr(sup_mod, "time", clock)
+        res = self._supervisor(
+            _crashes_then_clean(tmp_path, 3), clock,
+            max_restarts=1, backoff_s=0.5, backoff_jitter=0.0,
+            restart_window_s=10.0).run()
+        assert res.returncode == 0 and not res.gave_up
+        assert res.crashes == 3 and res.attempts == 4
+
+    def test_lifetime_budget_still_gives_up(self, tmp_path,
+                                            monkeypatch):
+        from tensorflow_train_distributed_tpu.runtime import (
+            supervisor as sup_mod,
+        )
+
+        clock = _FakeTime()
+        monkeypatch.setattr(sup_mod, "time", clock)
+        res = self._supervisor(
+            _crashes_then_clean(tmp_path, 3), clock,
+            max_restarts=1, backoff_s=0.5, backoff_jitter=0.0).run()
+        assert res.gave_up and res.crashes == 2
+
+    def test_window_decays_backoff_exponent(self, tmp_path,
+                                            monkeypatch):
+        """With a window, the backoff exponent is the WINDOWED crash
+        count: after old crashes age out the delay returns to the base
+        instead of staying escalated forever."""
+        from tensorflow_train_distributed_tpu.runtime import (
+            supervisor as sup_mod,
+        )
+
+        clock = _FakeTime()
+        monkeypatch.setattr(sup_mod, "time", clock)
+        sleeps = []
+        sup = sup_mod.TrainSupervisor(
+            _crashes_then_clean(tmp_path, 3),
+            max_restarts=1, backoff_s=0.5, backoff_jitter=0.0,
+            restart_window_s=10.0,
+            sleep=lambda s: (sleeps.append(s), clock.sleep(30.0)))
+        res = sup.run()
+        assert res.returncode == 0
+        # Every crash is the only one inside its window → base delay,
+        # never the doubled one.
+        assert sleeps == [0.5, 0.5, 0.5]
+
+    def test_jitter_is_bounded_and_seeded(self, tmp_path):
+        """Jitter stretches the delay UP by at most the configured
+        fraction — never below the base (shaving it would defeat the
+        backoff) — and an injected rng makes it deterministic."""
+        import random
+
+        from tensorflow_train_distributed_tpu.runtime.supervisor import (
+            TrainSupervisor,
+        )
+
+        def run_once(tag):
+            d = tmp_path / tag
+            d.mkdir()
+            sleeps = []
+            TrainSupervisor(
+                _crashes_then_clean(d, 2),
+                max_restarts=3, backoff_s=0.5, backoff_jitter=0.5,
+                rng=random.Random(7), sleep=sleeps.append).run()
+            return sleeps
+
+        a = run_once("a")
+        b = run_once("b")
+        assert a == b                      # seeded → reproducible
+        assert len(a) == 2
+        assert 0.5 <= a[0] <= 0.75         # base 0.5, jitter ≤ +50%
+        assert 1.0 <= a[1] <= 1.5          # doubled, jitter ≤ +50%
+        assert a != [0.5, 1.0]             # jitter actually applied
+
+
 def test_programmatic_preemption(tmp_path, mesh8):
     watcher = PreemptionWatcher()  # not installed: flag set directly
 

@@ -82,11 +82,13 @@ class TestSupervisorLoop:
         sleeps = []
         res = TrainSupervisor(
             _counter_child(tmp_path, [7, 7, 0]),
-            max_restarts=3, backoff_s=0.5,
+            max_restarts=3, backoff_s=0.5, backoff_jitter=0.0,
             journal_path=str(journal),
             sleep=sleeps.append).run()
         assert res.returncode == 0
         assert res.attempts == 3 and res.crashes == 2
+        # jitter=0 pins the exact exponential; the jittered default is
+        # bounded/seeded-pinned in test_preemption.py's storm tests.
         assert sleeps == [0.5, 1.0]       # exponential, per crash
         events = [json.loads(line) for line in journal.read_text().splitlines()]
         assert [e["class"] for e in events if e["event"] == "exit"] == [

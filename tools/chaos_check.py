@@ -27,10 +27,21 @@ exactly one replica dead, at least one failover, and /healthz
 degraded-but-routable.  The tier-1 serving chaos smoke drives this
 same entry point in-process.
 
+``--train-elastic`` runs the ELASTIC-MESH chaos gate: a supervised
+8-device training run loses half its devices mid-run (the
+``mesh:device_lost`` fault point), the supervisor classifies the exit
+as device loss (crash budget untouched), relaunches onto the 4
+survivors, and the relaunch restores the pre-loss checkpoint
+RESHARDED onto the half-size mesh and finishes — with the final loss
+matching an uninterrupted 8-device run within the harness parity bar
+(reduction reassociation across mesh sizes makes bitwise impossible).
+The tier-1 elastic chaos smoke drives this same entry point.
+
 Usage::
 
     python tools/chaos_check.py [--workdir DIR] [--steps 8]
     python tools/chaos_check.py --serving
+    python tools/chaos_check.py --train-elastic
 """
 
 import argparse
@@ -49,13 +60,23 @@ if REPO_ROOT not in sys.path:    # runnable as `python tools/chaos_check.py`
 KILL_STEP = 5
 CORRUPT_STEP = 4
 CKPT_EVERY = 2
+# --train-elastic: lose half an 8-device mesh at this step; the
+# relaunch restores the step-4 checkpoint RESHARDED onto the 4
+# survivors and must converge loss-parity with an uninterrupted run.
+ELASTIC_DEVICES = 8
+ELASTIC_SURVIVORS = 4
+ELASTIC_LOSS_STEP = 5
+# Harness parity bar: the resharded continuation reassociates the
+# per-device reductions (8-way vs 4-way batch splits), so parity is a
+# tolerance, not bitwise — same bar family as the grad-quant A/B.
+ELASTIC_LOSS_BAR = 0.1
 
 
-def _cli(steps, ckpt_dir, *extra):
+def _cli(steps, ckpt_dir, *extra, cpu_devices=2):
     return [
         sys.executable, "-m", "tensorflow_train_distributed_tpu",
         "--config", "mnist", "--steps", str(steps),
-        "--platform", "cpu", "--cpu-devices", "2",
+        "--platform", "cpu", "--cpu-devices", str(cpu_devices),
         "--strategy", "dp", "--global-batch-size", "16",
         "--log-every", "1", "--seed", "0",
         "--checkpoint-dir", ckpt_dir,
@@ -149,6 +170,125 @@ def run_chaos_check(workdir: str, *, steps: int = 8,
     return {"ok": all(checks.values()), "checks": checks,
             "journal": exits,
             "chaos_tail": (chaos.stderr[-1500:]
+                           if not all(checks.values()) else "")}
+
+
+def run_train_elastic(workdir: str, *, steps: int = 8,
+                      devices: int = ELASTIC_DEVICES,
+                      survivors: int = ELASTIC_SURVIVORS,
+                      timeout_s: float = 600.0) -> dict:
+    """Elastic mesh chaos: kill half the devices mid-training, relaunch
+    on the survivors, demand loss parity with an uninterrupted run.
+
+    Two runs of the same mini config (LeNet/MNIST, fixed seed):
+
+    1. **reference** — uninterrupted ``--steps N`` on ``devices``
+       virtual CPU devices;
+    2. **chaos** — the same config under ``--supervise`` with
+       ``mesh:device_lost:<survivors>:step=<K>:attempt=0`` armed: the
+       step-K boundary raises ``DeviceLost``, the child records the
+       survivor count in the elastic sidecar and exits with the
+       device-loss code, and the supervisor relaunches it with
+       ``TTD_ELASTIC_DEVICES=<survivors>`` — the relaunch builds a
+       half-size mesh, restores the latest checkpoint RESHARDED onto
+       it, repositions the data stream, and finishes.
+
+    The gate: device_loss classified (not a crash — budget untouched),
+    the resize journaled, the relaunch restored the pre-loss
+    checkpoint onto the smaller mesh, and the final loss matches the
+    uninterrupted run within ``ELASTIC_LOSS_BAR`` (the 8-way → 4-way
+    reduction reassociation makes bitwise impossible; the bar is the
+    harness's loss-parity convention).
+    """
+    ref_dir = os.path.join(workdir, "ref")
+    chaos_dir = os.path.join(workdir, "chaos")
+    ref_jsonl = os.path.join(workdir, "ref.jsonl")
+    chaos_jsonl = os.path.join(workdir, "chaos.jsonl")
+    journal = os.path.join(workdir, "supervisor.jsonl")
+    checks = {}
+
+    ref = subprocess.run(
+        _cli(steps, ref_dir, "--jsonl-log", ref_jsonl,
+             cpu_devices=devices),
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT)
+    checks["reference_rc0"] = ref.returncode == 0
+    if not checks["reference_rc0"]:
+        return {"ok": False, "mode": "train-elastic", "checks": checks,
+                "stderr": ref.stderr[-2000:]}
+
+    plan = (f"mesh:device_lost:{survivors}:step={ELASTIC_LOSS_STEP}"
+            ":attempt=0")
+    chaos = subprocess.run(
+        _cli(steps, chaos_dir, "--jsonl-log", chaos_jsonl,
+             "--supervise", "--max-restarts", "2",
+             "--restart-backoff", "0.05",
+             "--supervisor-journal", journal,
+             "--fault-plan", plan,
+             cpu_devices=devices),
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT)
+    checks["chaos_rc0"] = chaos.returncode == 0
+    log = chaos.stderr + chaos.stdout
+
+    # Journal: one device_loss exit (classified, NOT a crash), a
+    # resize record carrying the survivor count, then a clean exit.
+    events = []
+    if os.path.exists(journal):
+        with open(journal) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    exits = [e for e in events if e.get("event") == "exit"]
+    resizes = [e for e in events if e.get("event") == "resize"]
+    from tensorflow_train_distributed_tpu.runtime.supervisor import (
+        DEVICE_LOSS_EXIT_CODE,
+    )
+
+    checks["device_loss_then_clean"] = (
+        len(exits) == 2
+        and exits[0]["class"] == "device_loss"
+        and exits[0]["rc"] == DEVICE_LOSS_EXIT_CODE
+        and exits[1]["class"] == "clean")
+    checks["crash_budget_untouched"] = not any(
+        e["class"] == "crash" for e in exits)
+    checks["resize_journaled"] = (
+        len(resizes) == 1 and resizes[0].get("survivors") == survivors)
+
+    # The relaunch restored the PRE-LOSS checkpoint onto the smaller
+    # mesh (reshard-on-resize restore), not a fresh init.
+    pre_loss_step = (ELASTIC_LOSS_STEP // CKPT_EVERY) * CKPT_EVERY
+    checks["restored_pre_loss_step"] = (
+        f"restored checkpoint step {pre_loss_step}" in log)
+    checks["relaunched_on_survivors"] = (
+        f"'data': {survivors}" in log)
+
+    # Headline: loss parity with the uninterrupted run at the final
+    # step (jsonl streams; the chaos file carries both attempts —
+    # the LAST record is the relaunched run's final step).
+    def last_loss(path):
+        if not os.path.exists(path):
+            return None
+        rec = None
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+        return rec
+
+    ref_last = last_loss(ref_jsonl)
+    chaos_last = last_loss(chaos_jsonl)
+    checks["reached_final_step"] = bool(
+        ref_last and chaos_last
+        and ref_last["step"] == steps and chaos_last["step"] == steps)
+    delta = (abs(ref_last["loss"] - chaos_last["loss"])
+             if checks["reached_final_step"] else None)
+    checks["loss_parity"] = (delta is not None
+                             and delta <= ELASTIC_LOSS_BAR)
+
+    return {"ok": all(checks.values()), "mode": "train-elastic",
+            "checks": checks, "journal": exits + resizes,
+            "final_loss_delta": delta,
+            "loss_bar": ELASTIC_LOSS_BAR,
+            "chaos_tail": (log[-1500:]
                            if not all(checks.values()) else "")}
 
 
@@ -300,7 +440,28 @@ def main(argv=None) -> int:
                         "accepted requests must complete on the "
                         "survivor token-equal to an uninterrupted "
                         "single-replica run (greedy + sampled legs)")
+    p.add_argument("--train-elastic", action="store_true",
+                   help="elastic mesh chaos instead: a supervised "
+                        "8-device training run loses half its devices "
+                        "mid-run (mesh:device_lost fault), relaunches "
+                        "on the 4 survivors with the checkpoint "
+                        "resharded, and must converge loss-parity "
+                        "with an uninterrupted 8-device run")
     args = p.parse_args(argv)
+    if args.serving and args.train_elastic:
+        p.error("--serving and --train-elastic are separate gates; "
+                "pick one")
+    if args.train_elastic:
+        workdir = args.workdir or tempfile.mkdtemp(
+            prefix="chaos_elastic_")
+        os.makedirs(workdir, exist_ok=True)
+        try:
+            verdict = run_train_elastic(workdir, steps=args.steps)
+        finally:
+            if not args.keep and args.workdir is None:
+                shutil.rmtree(workdir, ignore_errors=True)
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else 1
     if args.serving:
         greedy = run_serving_chaos(sampling=False)
         sampled = run_serving_chaos(sampling=True)
