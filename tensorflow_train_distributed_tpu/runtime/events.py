@@ -174,7 +174,7 @@ class Recorder:
 
     # Every thread role appends; every access locks (ttd-lint's
     # concurrency checker + TTD_LOCKCHECK=1 enforce it stays so).
-    _GUARDED_BY = {"_buf": ("_lock",)}
+    _GUARDED_BY = {"_buf": ("_lock",), "_seq": ("_lock",)}
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         if capacity < 1:
@@ -182,6 +182,12 @@ class Recorder:
         self.capacity = capacity
         self.pid = os.getpid()
         self._buf: deque = deque(maxlen=capacity)
+        # Total events ever appended — the cursor feed for
+        # ``events_after`` (a subprocess worker's event-relay loop
+        # ships only what it has not shipped yet; a deque index would
+        # shift as the ring drops old events, a running sequence does
+        # not).
+        self._seq = 0
         self._lock = threading.Lock()
         # Wall-clock anchor: wall time at monotonic ``_anchor_mono`` —
         # lets offline tooling place the monotonic timeline in real
@@ -206,6 +212,7 @@ class Recorder:
         ev = (name, ph, t0, dur, threading.get_ident(), attrs or None)
         with self._lock:
             self._buf.append(ev)
+            self._seq += 1
 
     # -- recording api ---------------------------------------------------
 
@@ -222,6 +229,20 @@ class Recorder:
             return
         self._append(name, "i", time.monotonic(), 0.0, attrs or None)
 
+    def record_at(self, name: str, ph: str, t0: float, dur: float = 0.0,
+                  attrs: Optional[dict] = None) -> None:
+        """Record one event with a CALLER-supplied timestamp — the
+        relay path for events that happened in another process (a
+        subprocess replica ships its recorder's events in stats frames;
+        the parent re-records them mapped into its own monotonic
+        domain so ``request_timeline`` joins both lives of a
+        failed-over request).  Honors the kill switch like every
+        recording entry point."""
+        if trace_killed():
+            return
+        self._append(name, ph if ph in ("X", "i") else "i", t0,
+                     dur if ph == "X" else 0.0, dict(attrs or {}) or None)
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
@@ -237,6 +258,24 @@ class Recorder:
             cutoff = time.monotonic() - last_s
             items = [e for e in items if e[2] + e[3] >= cutoff]
         return items
+
+    def events_after(self, cursor: int) -> tuple:
+        """``(new_cursor, events)``: every event appended since
+        ``cursor`` (a value previously returned here; 0 = everything
+        still in the ring).  The cursor is the recorder's running
+        append sequence, so it stays exact while the bounded ring
+        drops old events — events that fell off the back before being
+        read are simply gone (the ring's contract), never re-delivered
+        and never double-delivered.  The subprocess worker's stats
+        loop is the consumer: each frame ships exactly the new tail."""
+        with self._lock:
+            seq = self._seq
+            fresh = seq - int(cursor)
+            if fresh <= 0:
+                return seq, []
+            n = len(self._buf)
+            items = list(self._buf)[max(0, n - fresh):]
+        return seq, items
 
     def request_timeline(self, request_id: int) -> list:
         """Every event belonging to gateway request ``request_id``,

@@ -21,6 +21,7 @@ A **fault plan** is a ``;``-separated list of entries
     serve:dispatch:5:raise          # engine driver dies at dispatch 5
     serve:dispatch:5:hang           # ... hangs mid-dispatch (watchdog)
     serve:dispatch:5:kill9:replica=1    # replica 1 vanishes abruptly
+    serve:dispatch:5:killpid:replica=0  # REAL SIGKILL of this process
 
 Mesh-side entries (``mesh:device_lost:<survivors>``) simulate losing
 part of the device mesh mid-training: at/after the ``step=`` trigger
@@ -47,8 +48,15 @@ and ``kill9`` makes an IN-PROCESS replica vanish abruptly: the driver
 thread exits without resolving a single handle or recording a corpse
 — nobody is notified, exactly what SIGKILL looks like to the pool's
 liveness monitor.  (A true ``os.kill`` would take every replica in
-the process down with it; subprocess replicas — the seam
-``server.replicas`` keeps open — will get the real signal.)
+the process down with it; subprocess replicas get the real thing:)
+``killpid`` delivers an ACTUAL ``os.kill(os.getpid(), SIGKILL)`` at
+the dispatch boundary — the process is gone before the next
+instruction.  It only makes sense inside a subprocess replica worker
+(``server.worker`` arms plans from ``TTD_FAULT_PLAN`` in its own
+environment, so a ``replica=K``-scoped entry kills exactly one
+worker of a pool); armed in a test process or a single-process
+gateway it kills THAT process, by design — the whole point is that
+nothing survives to fake the signal.
 
 Data-read faults count *attempts*, and the retry loop's attempts count
 too: ``n`` below ``filesource.IO_RETRY_ATTEMPTS`` (3) is absorbed by
@@ -168,7 +176,7 @@ _STEP_ACTIONS = ("raise", "kill9", "sigterm", "exit")
 _MESH_ACTIONS = ("device_lost",)
 _CKPT_ACTIONS = ("partial",)
 _DATA_ACTIONS = ("transient_io",)
-_SERVE_ACTIONS = ("raise", "hang", "kill9")
+_SERVE_ACTIONS = ("raise", "hang", "kill9", "killpid")
 
 
 @dataclasses.dataclass
@@ -520,6 +528,18 @@ def on_serve_dispatch(n: int, replica: Optional[int] = None) -> None:
             replica, n)
         raise InjectedKill(
             f"injected kill9 at dispatch {n} (replica {replica})")
+    if fire.action == "killpid":
+        # The REAL thing: SIGKILL this whole process at the dispatch
+        # boundary.  No cleanup, no flush, no exception anyone could
+        # catch — the subprocess-replica chaos legs arm this in the
+        # WORKER's environment so the parent gateway observes a true
+        # worker death (EOF on the frame stream, waitpid says signal
+        # 9), not a simulation of one.
+        logger.warning(
+            "fault injection: SIGKILL of pid %d at dispatch %d "
+            "(replica %s)", os.getpid(), n, replica)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return          # pragma: no cover — unreachable past SIGKILL
 
 
 def on_data_read(index: int) -> None:

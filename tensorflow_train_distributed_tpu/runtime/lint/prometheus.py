@@ -31,11 +31,12 @@ CHECKER = "prometheus"
 
 _COUNTER_FNS = {"counter", "fn_counter"}
 _HISTOGRAM_FNS = {"histogram"}
-_GAUGE_FNS = {"gauge"}
+_GAUGE_FNS = {"gauge", "labeled_gauge"}
 _ALL_FNS = _COUNTER_FNS | _HISTOGRAM_FNS | _GAUGE_FNS
 # Constructor names double as registration sites (Counter("x", ...)).
 _CTOR_MAP = {"Counter": "counter", "FnCounter": "fn_counter",
-             "Histogram": "histogram", "Gauge": "gauge"}
+             "Histogram": "histogram", "Gauge": "gauge",
+             "LabeledGauge": "labeled_gauge"}
 
 
 def _metric_name(node: ast.Call) -> Optional[str]:

@@ -52,10 +52,16 @@ from typing import Callable, Dict, Optional, Tuple
 #: pump: a ReplicaPool per-request pump thread.  watchdog: the replica
 #: pool's health-monitor thread.  supervisor: the training supervisor's
 #: relaunch loop.  loadgen: bench load-generation threads.  trainer:
-#: the training host loop (fit + host callbacks).
+#: the training host loop (fit + host callbacks).  reader: a frame
+#: reader over a subprocess replica's driver protocol (one per worker,
+#: both sides: the parent-side ProcDriver reader and the worker's
+#: frame loop) — the only role that may touch a ProcDriver's
+#: parent-side request table.  scaler: the elastic proc pool's
+#: scale/respawn thread (spawns and drains workers; owns the published
+#: replica list).
 THREAD_ROLES = frozenset({
     "main", "handler", "driver", "pump", "watchdog", "supervisor",
-    "loadgen", "trainer",
+    "loadgen", "trainer", "reader", "scaler",
 })
 
 _ROLE_TLS = threading.local()

@@ -7,6 +7,10 @@ compute layer of).
 - ``server.replicas`` — N drivers behind one admission layer:
   load/KV-affinity routing, per-replica health + hung-dispatch
   watchdog, deterministic request failover, staged drain;
+- ``server.proto`` / ``server.worker`` / ``server.procpool`` — the
+  out-of-process face of the same pool: subprocess engine workers
+  speaking a versioned length-prefixed frame protocol, true-SIGKILL
+  fault isolation, elastic scale/respawn;
 - ``server.gateway`` — stdlib threaded HTTP frontend
   (``/v1/generate``, ``/healthz``, ``/metrics``) and drain lifecycle;
 - ``server.metrics`` — stdlib Prometheus text-format registry.
@@ -30,6 +34,13 @@ from tensorflow_train_distributed_tpu.server.gateway import (  # noqa: F401
 from tensorflow_train_distributed_tpu.server.metrics import (  # noqa: F401
     GatewayMetrics,
     Registry,
+)
+from tensorflow_train_distributed_tpu.server.procpool import (  # noqa: F401
+    ProcPool,
+    WorkerSpec,
+)
+from tensorflow_train_distributed_tpu.server.proto import (  # noqa: F401
+    ProtocolError,
 )
 from tensorflow_train_distributed_tpu.server.replicas import (  # noqa: F401
     NoReplicas,

@@ -317,7 +317,9 @@ class EngineDriver:
     def active_slots(self) -> int:
         return self._engine.active_slots()
 
-    @thread_role("handler", "pump", "main")
+    # "reader": the subprocess worker's frame loop submits parent
+    # placements into its local driver (server.worker).
+    @thread_role("handler", "pump", "main", "reader")
     def submit(self, prompt, max_new: int, *, seed: Optional[int] = None,
                stream: bool = False,
                timeout_s: Optional[float] = None,

@@ -164,7 +164,12 @@ class _Handler(BaseHTTPRequestHandler):
                 status = "draining"
             elif alive == 0:
                 status = "no_replicas"
-            elif alive < len(reps):
+            elif gw.pool.degraded():
+                # The pool owns the capacity verdict: for in-process
+                # replicas any death degrades for good; the elastic
+                # subprocess pool is whole again once its scaler
+                # respawned back to the scale_min floor (corpses stay
+                # listed for forensics without pinning the status).
                 status = "degraded"
             else:
                 status = "ok"
@@ -389,14 +394,20 @@ class _Handler(BaseHTTPRequestHandler):
 class ServingGateway:
     """Engine(s) + driver/pool + HTTP listener, one lifecycle.
 
-    ``engine`` is one engine (the classic single-driver gateway) or a
-    list of engine replicas: with two or more, admissions route
-    through a ``ReplicaPool`` — per-replica health + hung-dispatch
-    watchdog (``watchdog_timeout_s``), load/KV-affinity routing,
-    deterministic request failover, staged per-replica drain — while
-    the HTTP surface stays identical.  ``TTD_NO_FAILOVER=1`` (or a
+    ``engine`` is one engine (the classic single-driver gateway), a
+    list of engine replicas, or a PREBUILT ``ReplicaPool`` (the
+    out-of-process launchers construct a ``procpool.ProcPool`` of
+    subprocess workers and hand it over here UNSTARTED — this
+    gateway's ``start()``/``drain()`` own its lifecycle, and the HTTP
+    surface never learns the difference): with a pool, admissions
+    route through it —
+    per-replica health + hung-dispatch watchdog
+    (``watchdog_timeout_s``), load/KV-affinity routing, deterministic
+    request failover, staged per-replica drain — while the HTTP
+    surface stays identical.  ``TTD_NO_FAILOVER=1`` (or a
     single-engine list) restores the single-driver path byte-for-byte,
-    driving only the first engine.
+    driving only the first engine (a prebuilt pool, already
+    constructed by its launcher, is used as passed).
 
     ``validate`` is threaded through to the driver (the CLI's
     ``check_vocab_ids`` hook); ``port=0`` binds an ephemeral port
@@ -409,45 +420,76 @@ class ServingGateway:
                  default_max_new: int = 32, validate=None,
                  retry_after_s: float = 1.0,
                  watchdog_timeout_s: Optional[float] = 30.0):
-        engines = (list(engine) if isinstance(engine, (list, tuple))
-                   else [engine])
-        if not engines:
-            raise ValueError("need at least one engine")
-        self.engine = engines[0]
-        self.engines = engines
         self.default_max_new = default_max_new
         self.pool: Optional[ReplicaPool] = None
-        if len(engines) > 1 and not _failover_killed():
-            self.pool = ReplicaPool(
-                engines, max_queue=max_queue, validate=validate,
-                default_timeout_s=default_timeout_s,
-                retry_after_s=retry_after_s,
-                watchdog_timeout_s=watchdog_timeout_s)
-            self.driver = self.pool
+        if isinstance(engine, ReplicaPool):
+            # Prebuilt pool (the subprocess-replica launchers): the
+            # pool already owns its replicas, validation, and scaling
+            # policy — the gateway just fronts it.
+            self.engine = None
+            self.engines = []
+            self.pool = engine
+            self.driver = engine
         else:
-            self.driver = EngineDriver(
-                engines[0], max_queue=max_queue, validate=validate,
-                default_timeout_s=default_timeout_s,
-                retry_after_s=retry_after_s)
-        active = engines if self.pool is not None else engines[:1]
-        self.metrics = GatewayMetrics(
-            queue_depth_fn=self.driver.waiting,
-            slots_in_use_fn=self.driver.active_slots,
-            slots_total=sum(e.slots for e in active),
-            driver_alive_fn=self.driver.alive,
-            replicas_alive_fn=(None if self.pool is None
-                               else self.pool.alive_count),
-            # _agg/getattr: test stubs (and any engine without the
-            # decode lookahead / prefill scheduler / paged KV) scrape
-            # a truthful constant 0; a pool scrapes the sum (mean for
-            # the overlap ratio).
-            overlap_ratio_fn=_agg(active, "overlap_ratio", ratio=True),
-            prefill_stall_fn=_agg(active, "prefill_stall_s"),
-            kv_blocks_in_use_fn=_agg(active, "kv_blocks_in_use"),
-            kv_blocks_total_fn=_agg(active, "kv_blocks_total"),
-            kv_prefix_hit_tokens_fn=_agg(active, "kv_prefix_hit_tokens"),
-            kv_evictions_fn=_agg(active, "kv_evictions"),
-            kv_pool_bytes_fn=_agg(active, "kv_pool_bytes"))
+            engines = (list(engine)
+                       if isinstance(engine, (list, tuple))
+                       else [engine])
+            if not engines:
+                raise ValueError("need at least one engine")
+            self.engine = engines[0]
+            self.engines = engines
+            if len(engines) > 1 and not _failover_killed():
+                self.pool = ReplicaPool(
+                    engines, max_queue=max_queue, validate=validate,
+                    default_timeout_s=default_timeout_s,
+                    retry_after_s=retry_after_s,
+                    watchdog_timeout_s=watchdog_timeout_s)
+                self.driver = self.pool
+            else:
+                self.driver = EngineDriver(
+                    engines[0], max_queue=max_queue, validate=validate,
+                    default_timeout_s=default_timeout_s,
+                    retry_after_s=retry_after_s)
+        if self.pool is not None:
+            # Engine-level scrape callables come from the pool's own
+            # aggregation — LIVE values (dead replicas drop out; an
+            # elastic pool's workers spawn and drain, so slot capacity
+            # is a function, not a constant) — one wiring for
+            # in-process and subprocess pools alike.
+            self.metrics = GatewayMetrics(
+                queue_depth_fn=self.driver.waiting,
+                slots_in_use_fn=self.driver.active_slots,
+                slots_total=0,          # unused: the live fn rules
+                slots_total_fn=self.pool.slots_total,
+                driver_alive_fn=self.driver.alive,
+                replicas_alive_fn=self.pool.alive_count,
+                overlap_ratio_fn=self.pool.overlap_ratio,
+                prefill_stall_fn=self.pool.prefill_stall_s,
+                kv_blocks_in_use_fn=self.pool.kv_blocks_in_use,
+                kv_blocks_total_fn=self.pool.kv_blocks_total,
+                kv_prefix_hit_tokens_fn=self.pool.kv_prefix_hit_tokens,
+                kv_evictions_fn=self.pool.kv_evictions,
+                kv_pool_bytes_fn=self.pool.kv_pool_bytes,
+                replica_rss_fn=self.pool.replica_rss)
+        else:
+            one = [self.engine]
+            self.metrics = GatewayMetrics(
+                queue_depth_fn=self.driver.waiting,
+                slots_in_use_fn=self.driver.active_slots,
+                slots_total=self.engine.slots,
+                driver_alive_fn=self.driver.alive,
+                # _agg/getattr: test stubs (and any engine without the
+                # decode lookahead / prefill scheduler / paged KV)
+                # scrape a truthful constant 0.
+                overlap_ratio_fn=_agg(one, "overlap_ratio",
+                                      ratio=True),
+                prefill_stall_fn=_agg(one, "prefill_stall_s"),
+                kv_blocks_in_use_fn=_agg(one, "kv_blocks_in_use"),
+                kv_blocks_total_fn=_agg(one, "kv_blocks_total"),
+                kv_prefix_hit_tokens_fn=_agg(one,
+                                             "kv_prefix_hit_tokens"),
+                kv_evictions_fn=_agg(one, "kv_evictions"),
+                kv_pool_bytes_fn=_agg(one, "kv_pool_bytes"))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

@@ -149,6 +149,34 @@ class Gauge:
                 f"{self.name} {_fmt(self.value())}"]
 
 
+class LabeledGauge:
+    """Callable-backed gauge split by ONE label: the callable returns
+    ``{label_value: value}`` sampled at scrape time (the per-worker
+    rss gauge — workers spawn and drain under the elastic pool, so
+    the label set is live, not declared).  Renders nothing but
+    HELP/TYPE when the source has no series (e.g. in-process replicas,
+    which share the gateway's own rss and truthfully report none)."""
+
+    def __init__(self, name: str, help_: str, label: str,
+                 fn: Optional[Callable[[], dict]] = None):
+        self.name, self.help, self.label = name, help_, label
+        self._fn = fn
+
+    def values(self) -> dict:
+        return dict(self._fn() or {}) if self._fn is not None else {}
+
+    def value(self, label_value) -> float:
+        return float(self.values().get(str(label_value), 0.0))
+
+    def render(self) -> list:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        for lv, v in sorted(self.values().items()):
+            lines.append(
+                f"{self.name}{_labels({self.label: lv})} {_fmt(v)}")
+        return lines
+
+
 @concurrency_guarded
 class Histogram:
     """Cumulative-bucket histogram (observe in seconds)."""
@@ -212,6 +240,9 @@ class Registry:
     def gauge(self, name, help_, fn=None) -> Gauge:
         return self._add(Gauge(name, help_, fn))
 
+    def labeled_gauge(self, name, help_, label, fn=None) -> LabeledGauge:
+        return self._add(LabeledGauge(name, help_, label, fn))
+
     def histogram(self, name, help_, buckets=LATENCY_BUCKETS) -> Histogram:
         return self._add(Histogram(name, help_, buckets))
 
@@ -250,7 +281,9 @@ class GatewayMetrics:
                  kv_prefix_hit_tokens_fn: Optional[
                      Callable[[], int]] = None,
                  kv_evictions_fn: Optional[Callable[[], int]] = None,
-                 kv_pool_bytes_fn: Optional[Callable[[], int]] = None):
+                 kv_pool_bytes_fn: Optional[Callable[[], int]] = None,
+                 slots_total_fn: Optional[Callable[[], int]] = None,
+                 replica_rss_fn: Optional[Callable[[], dict]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -266,9 +299,14 @@ class GatewayMetrics:
         self.slots_in_use = r.gauge(
             "ttd_gateway_slots_in_use",
             "Engine slots currently decoding.", fn=slots_in_use_fn)
+        # Callable-backed under the elastic proc pool (capacity is
+        # live: workers spawn and drain), a set-once constant
+        # otherwise.
         self.slots_total = r.gauge(
-            "ttd_gateway_slots_total", "Engine slot capacity.")
-        self.slots_total.set(slots_total)
+            "ttd_gateway_slots_total", "Engine slot capacity.",
+            fn=slots_total_fn)
+        if slots_total_fn is None:
+            self.slots_total.set(slots_total)
         # Sampled at scrape time like the occupancy gauges: 1 while the
         # engine-driver thread can make progress, 0 once it died or
         # drained — the alert line for "listener up, engine dead".
@@ -301,6 +339,22 @@ class GatewayMetrics:
             "ttd_gateway_retries_total",
             "Placement retries after transient admission refusals "
             "(pool pressure backoff, not client-visible sheds).")
+        # Out-of-process replicas (server.procpool): how many dead
+        # workers the elastic pool respawned (a climbing counter is a
+        # crash-looping engine; the restart budget bounds it), and
+        # each live worker's resident set from its stats frames — the
+        # per-replica memory signal an in-process pool cannot have
+        # (all replicas share one rss there, and this gauge truthfully
+        # renders no series).
+        self.replica_restarts = r.counter(
+            "ttd_gateway_replica_restarts_total",
+            "Dead subprocess workers respawned by the elastic pool's "
+            "scaler (under its restart budget).")
+        self.replica_rss = r.labeled_gauge(
+            "ttd_gateway_replica_rss_bytes",
+            "Resident-set bytes per subprocess replica worker, from "
+            "its latest stats frame (no series for in-process "
+            "replicas).", "replica", fn=replica_rss_fn)
         # Fraction of the engine's host harvest/refill time hidden
         # under device compute by async decode pipelining — the
         # driver-visible proof the overlap path engages (0 under the

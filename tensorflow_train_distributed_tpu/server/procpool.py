@@ -1,0 +1,972 @@
+"""Out-of-process serving replicas: subprocess workers behind the pool.
+
+PR 7's ``ReplicaPool`` made the gateway replica-blind behind the
+``EngineDriver`` submission surface; this module crosses that seam for
+real.  Each replica becomes a **subprocess** (``server.worker``: a thin
+frame loop around the same engine + driver the in-process gateway
+runs), and the parent side speaks ``server.proto``'s length-prefixed
+versioned frames through a ``ProcDriver`` that implements the driver
+surface — so routing, KV-prefix affinity, the hung-dispatch watchdog,
+and the deterministic resume-from-token failover path in
+``server.replicas`` are reused UNCHANGED.  What changes is the blast
+radius:
+
+- a worker killed with a real ``os.kill(pid, SIGKILL)`` mid-stream is
+  an EOF on the frame stream and a waitpid corpse — the pool fails the
+  request over to a survivor from its last committed token, bitwise
+  equal to an uninterrupted run (greedy and seeded sampling), and the
+  gateway process never feels it;
+- a worker OOM, a native crash (Pallas kernel, XLA), or a protocol
+  violation (truncated frame, oversized length prefix, version
+  mismatch) fails exactly ONE replica, classified in its /healthz
+  state — never the pool;
+- the pool is **elastic**: a scaler thread spawns workers under queue
+  pressure up to ``scale_max``, drains them back (the staged-drain
+  machinery, one at a time) after ``idle_grace_s`` of idle, and
+  respawns dead workers under an exponential-backoff restart budget
+  (the supervisor idiom) — ``ttd_gateway_replica_restarts_total``
+  counts the respawns, ``ttd_gateway_replica_rss_bytes`` gauges each
+  worker from its stats frames.
+
+Workers are interchangeable behind one spec (the TF-Replicator
+replica-orchestration idiom): every spawn replays the same serialized
+engine flags, so parent-side screening and worker-side engines agree
+and the fleet can grow, shrink, and die while the gateway stays
+replica-blind.  ``TTD_NO_PROC_REPLICAS=1`` is the kill switch: the
+launchers fall back to in-process replicas, and constructing this pool
+refuses loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    concurrency_guarded,
+    locks_held,
+    thread_role,
+)
+from tensorflow_train_distributed_tpu.server import proto
+from tensorflow_train_distributed_tpu.server.driver import (
+    _TERMINAL_KEEP,
+    AdmissionFull,
+    DeadlineExceeded,
+    Draining,
+    RequestError,
+    RequestHandle,
+)
+from tensorflow_train_distributed_tpu.server.replicas import (
+    Replica,
+    ReplicaPool,
+)
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def proc_replicas_killed() -> bool:
+    """``TTD_NO_PROC_REPLICAS=1`` disables subprocess replicas: the
+    launchers fall back to the in-process ``ReplicaPool`` (byte-for-
+    byte PR 7 behavior) — the same no-redeploy kill-switch contract as
+    ``TTD_NO_FAILOVER`` one layer down."""
+    return os.environ.get("TTD_NO_PROC_REPLICAS", "0") not in ("", "0")
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """Everything needed to spawn one interchangeable worker.
+
+    ``factory`` is a ``server.worker`` builtin (``stub``, ``llama``)
+    or an importable ``module:function``; ``factory_json`` is its
+    spec — for the production launcher, the CLI's serialized engine
+    flags, so parent and child construct identical engines.
+    ``pythonpath`` entries are prepended to the child's PYTHONPATH
+    (the repo root is always added); ``env`` overlays the child's
+    environment (chaos plans arm ``TTD_FAULT_PLAN`` here, scoped to
+    one replica with ``replica=K``)."""
+
+    factory: str = "stub"
+    factory_json: dict = dataclasses.field(default_factory=dict)
+    stats_interval_s: float = 0.2
+    max_frame_bytes: int = proto.MAX_FRAME_BYTES
+    pythonpath: tuple = ()
+    env: dict = dataclasses.field(default_factory=dict)
+    python_exe: str = ""
+    test_corrupt: str = ""        # protocol-hardening tests only
+
+
+@concurrency_guarded
+class RemoteEngine:
+    """Parent-side facade of a worker's engine: the static shape from
+    the HELLO plus the latest stats-frame gauges — what the pool's
+    screening, routing, and /metrics aggregation consume in place of
+    an in-process engine object."""
+
+    # HELLO fields are ATOMIC-PUBLISH by the reader thread (written
+    # once at handshake, plain-scalar reads everywhere); the gauges
+    # dict is replaced wholesale under the lock because scrape threads
+    # read several fields per render.
+    _GUARDED_BY = {
+        "_gauges": ("_lock",),
+        "_rss": ("_lock",),
+        "slots": (None, "reader", "main"),
+        "kv_block_size": (None, "reader", "main"),
+        "cache_len": (None, "reader", "main"),
+        "paged": (None, "reader", "main"),
+        "pool_blocks": (None, "reader", "main"),
+        "pid": (None, "reader", "main"),
+    }
+
+    def __init__(self):
+        self.slots = 0
+        self.kv_block_size = 16
+        self.cache_len: Optional[int] = None
+        self.paged = False
+        self.pool_blocks: Optional[int] = None
+        self.pid: Optional[int] = None
+        self._lock = threading.Lock()
+        self._gauges: dict = {}
+        self._rss = 0
+
+    @thread_role("reader")
+    def update_hello(self, body: dict) -> None:
+        eng = body.get("engine") or {}
+        self.kv_block_size = int(eng.get("kv_block_size") or 16)
+        self.cache_len = eng.get("cache_len")
+        self.paged = bool(eng.get("paged"))
+        self.pool_blocks = eng.get("pool_blocks")
+        self.pid = body.get("pid")
+        # slots LAST: replica_states readers key capacity off it, and
+        # the rest of the shape must be visible once it is.
+        self.slots = int(eng.get("slots") or 0)
+
+    @thread_role("reader")
+    def update_stats(self, body: dict) -> None:
+        with self._lock:
+            self._gauges = dict(body.get("gauges") or {})
+            self._rss = int(body.get("rss") or 0)
+
+    def _g(self, name: str) -> float:
+        with self._lock:
+            return float(self._gauges.get(name, 0.0))
+
+    def rss_bytes(self) -> int:
+        with self._lock:
+            return self._rss
+
+    def kv_blocks_total(self) -> float:
+        return self._g("kv_blocks_total")
+
+    def kv_blocks_in_use(self) -> float:
+        return self._g("kv_blocks_in_use")
+
+    def kv_prefix_hit_tokens(self) -> float:
+        return self._g("kv_prefix_hit_tokens")
+
+    def kv_evictions(self) -> float:
+        return self._g("kv_evictions")
+
+    def kv_pool_bytes(self) -> float:
+        return self._g("kv_pool_bytes")
+
+    def overlap_ratio(self) -> float:
+        return self._g("overlap_ratio")
+
+    def prefill_stall_s(self) -> float:
+        return self._g("prefill_stall_s")
+
+    def validate_request(self, prompt, max_new: int,
+                         seed: Optional[int] = None,
+                         resume_from: int = 0) -> list:
+        """The cheap half of the engine's screening, from the
+        HELLO-advertised shape (enough for 400s at the gateway edge);
+        policy the facade cannot know — prefill-bucket fit against
+        preloaded prefixes — stays with the worker's real engine,
+        whose rejection comes back as a classified ``invalid``
+        retire."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if seed is not None and not 0 <= seed < 2 ** 32:
+            raise ValueError(f"seed must be a uint32, got {seed}")
+        if resume_from < 0 or resume_from >= len(prompt):
+            raise ValueError(
+                f"resume_from must be in [0, len(prompt)), got "
+                f"{resume_from} for a {len(prompt)}-token prompt")
+        if max_new < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got "
+                             f"{max_new}")
+        if self.cache_len and len(prompt) + max_new > self.cache_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + {max_new} new exceeds "
+                f"cache_len={self.cache_len}")
+        if self.paged and self.pool_blocks:
+            need = -(-(len(prompt) + max_new) // self.kv_block_size)
+            if need > self.pool_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks "
+                    f"(block_size={self.kv_block_size}) but the pool "
+                    f"has {self.pool_blocks}")
+        return prompt
+
+
+class _ProcRequest:
+    """Parent-side record of one live request on a worker."""
+
+    __slots__ = ("handle", "generated")
+
+    def __init__(self, handle: RequestHandle):
+        self.handle = handle
+        self.generated: list = []
+
+
+@concurrency_guarded
+class ProcDriver:
+    """The ``EngineDriver`` surface over one subprocess worker.
+
+    The parent half of the frame protocol: ``submit`` frames requests
+    out; a reader thread resolves ``CHUNK``/``RETIRE`` into the same
+    ``RequestHandle`` futures the in-process driver mints, folds
+    ``STATS`` into the facade (and the hung-dispatch watchdog feed),
+    and relays the worker's request-scoped flight-recorder events into
+    this process's ring.  Worker death — SIGKILL, OOM, native crash —
+    is an EOF here; protocol violations fail THIS replica with a
+    classified ``ProtocolError`` and a defensive SIGKILL of the
+    worker.
+    """
+
+    # The request table and terminal map are touched by handler/pump
+    # submitters and the reader thread — every access locks.
+    # Deliberately NOT declared (single-writer atomic publishes with
+    # read-only consumers, the EngineDriver idiom): _failed, _vanished,
+    # _drained, _poisoned, _returncode, _stats, _stats_rx, _mono_offset.
+    _GUARDED_BY = {
+        "_recs": ("_lock",),
+        "_terminal": ("_lock",),
+        "_draining": ("_lock",),
+        "_next_id": ("_lock",),
+    }
+
+    def __init__(self, spec: WorkerSpec, engine: RemoteEngine, *,
+                 replica_id: Optional[int] = None, max_queue: int = 64,
+                 default_timeout_s: Optional[float] = None,
+                 retry_after_s: float = 1.0):
+        self._spec = spec
+        self._engine = engine
+        self._replica_id = replica_id
+        self._max_queue = max_queue
+        self._default_timeout_s = default_timeout_s
+        self._retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._recs: dict = {}               # request id -> _ProcRequest
+        self._terminal: OrderedDict = OrderedDict()
+        self._next_id = 0
+        self._draining = False
+        self._drained = False               # worker confirmed BYE
+        self._failed: Optional[BaseException] = None
+        self._vanished = False
+        self._poisoned: Optional[str] = None
+        self._returncode: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._sock = self._rfp = self._wfp = None
+        self._sender: Optional[proto.FrameSender] = None
+        self._ready = threading.Event()
+        self._mono_offset: Optional[float] = None
+        # Latest stats frame (whole-dict atomic publish) + its arrival
+        # time: the watchdog feed.  A wedged engine keeps heartbeating
+        # a growing step_elapsed; a SIGKILLed worker stops entirely —
+        # both surface through step_elapsed()/alive().
+        self._stats = {"queue_depth": 0, "active_slots": 0, "steps": 0,
+                       "step_elapsed": 0.0, "in_step": False}
+        self._stats_rx = time.monotonic()
+        self._reader: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ProcDriver":
+        spec = self._spec
+        parent_sock, child_sock = socket.socketpair()
+        child_fd = child_sock.fileno()
+        cmd = [spec.python_exe or sys.executable,
+               "-m", "tensorflow_train_distributed_tpu.server.worker",
+               "--fd", str(child_fd),
+               "--factory", spec.factory,
+               "--json", json.dumps(spec.factory_json),
+               "--max-queue", str(self._max_queue),
+               "--stats-interval", str(spec.stats_interval_s),
+               "--max-frame", str(spec.max_frame_bytes)]
+        if self._replica_id is not None:
+            cmd += ["--replica-id", str(self._replica_id)]
+        if spec.test_corrupt:
+            cmd += ["--test-corrupt", spec.test_corrupt]
+        env = dict(os.environ)
+        env.update(spec.env)
+        path = [_REPO_ROOT] + list(spec.pythonpath)
+        if env.get("PYTHONPATH"):
+            path.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(path)
+        self._proc = subprocess.Popen(
+            cmd, pass_fds=(child_fd,), env=env,
+            stdin=subprocess.DEVNULL)
+        child_sock.close()
+        self._sock = parent_sock
+        self._rfp = parent_sock.makefile("rb")
+        self._wfp = parent_sock.makefile("wb")
+        self._sender = proto.FrameSender(self._wfp,
+                                         spec.max_frame_bytes)
+        self._stats_rx = time.monotonic()
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f"proc-reader-{self._replica_id}", daemon=True)
+        self._reader.start()
+        events.instant("replica/worker_spawn",
+                       replica=self._replica_id, pid=self._proc.pid)
+        return self
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the worker's HELLO landed (engine built)."""
+        return self._ready.wait(timeout)
+
+    def ready(self) -> bool:
+        """Has the HELLO landed (non-blocking)?"""
+        return self._ready.is_set()
+
+    def _send(self, ftype: int, body: dict) -> bool:
+        s = self._sender
+        return s.send(ftype, body) if s is not None else False
+
+    # -- the frame reader ------------------------------------------------
+
+    @thread_role("reader")
+    def _read_loop(self) -> None:
+        try:
+            frame = proto.read_frame(self._rfp,
+                                     self._spec.max_frame_bytes)
+            if frame is None:
+                self._on_eof()
+                return
+            body = proto.check_hello(*frame)
+            self._mono_offset = time.monotonic() - float(
+                body.get("mono") or 0.0)
+            self._engine.update_hello(body)
+            self._stats_rx = time.monotonic()
+            self._ready.set()
+            while True:
+                frame = proto.read_frame(self._rfp,
+                                         self._spec.max_frame_bytes)
+                if frame is None:
+                    self._on_eof()
+                    return
+                self._dispatch(*frame)
+        except proto.ProtocolError as e:
+            self._fail_protocol(e)
+        except (OSError, ValueError) as e:
+            self._fail_protocol(proto.ProtocolError(
+                f"frame stream error: {type(e).__name__}: {e}"))
+
+    def _dispatch(self, ftype: int, body: dict) -> None:
+        if ftype == proto.CHUNK:
+            rid = int(body["id"])
+            with self._lock:
+                rec = self._recs.get(rid)
+            if rec is None:
+                return                     # late chunk after terminal
+            handle = rec.handle
+            if (handle.slot_granted_at is None
+                    and "granted_ago" in body):
+                handle.slot_granted_at = (
+                    time.monotonic() - float(body["granted_ago"]))
+            rec.generated.extend(int(t) for t in body["toks"])
+            handle._push_new(list(handle.prompt) + rec.generated)
+        elif ftype == proto.RETIRE:
+            self._retire(int(body["id"]), str(body.get("status")),
+                         body.get("error"))
+        elif ftype == proto.STATS:
+            self._on_stats(body)
+        elif ftype == proto.DIED:
+            self._failed = RuntimeError(
+                f"worker driver died: {body.get('error')}")
+            # The worker's relays RETIRE every pending request before
+            # DIED lands; anything still here missed its relay —
+            # resolve with the corpse so no caller blocks forever.
+            with self._lock:
+                leftovers = list(self._recs.items())
+                self._recs.clear()
+            for rid, rec in leftovers:
+                self._set_terminal(rid, "error")
+                rec.handle._resolve(None, RuntimeError(
+                    f"worker driver died: {body.get('error')}"))
+        elif ftype == proto.BYE:
+            self._drained = True
+        # Unknown frame types are ignored (forward compatibility).
+
+    def _retire(self, rid: int, status: str, error) -> None:
+        with self._lock:
+            rec = self._recs.pop(rid, None)
+        self._set_terminal(rid, status)
+        if rec is None:
+            return
+        handle = rec.handle
+        if status == "ok":
+            handle._resolve(list(handle.prompt) + rec.generated, None)
+        elif status == "expired":
+            handle._resolve(None, DeadlineExceeded(
+                error or f"request {rid} exceeded its deadline"))
+        elif status == "invalid":
+            handle._resolve(None, RequestError(
+                error or f"request {rid} rejected by the engine"))
+        else:
+            handle._resolve(None, RuntimeError(
+                error or f"request {rid} failed on the worker"))
+
+    def _set_terminal(self, rid: int, status: str) -> None:
+        with self._lock:
+            self._terminal[rid] = status
+            while len(self._terminal) > _TERMINAL_KEEP:
+                self._terminal.popitem(last=False)
+
+    def _on_stats(self, body: dict) -> None:
+        self._stats = {
+            "queue_depth": int(body.get("queue_depth") or 0),
+            "active_slots": int(body.get("active_slots") or 0),
+            "steps": int(body.get("steps") or 0),
+            "step_elapsed": float(body.get("step_elapsed") or 0.0),
+            "in_step": bool(body.get("in_step")),
+        }
+        self._stats_rx = time.monotonic()
+        self._engine.update_stats(body)
+        if (not body.get("driver_alive", True)
+                and not body.get("draining")
+                and not self.is_draining()
+                and self._failed is None):
+            # The worker's driver loop vanished (in-process kill9
+            # fault inside the child) without a DIED corpse — surface
+            # it so the monitor declares this replica dead.  An
+            # ORDERLY drain is exempt: the worker's driver thread
+            # legitimately exits once its backlog finishes, and a
+            # stats heartbeat racing the BYE must not read as a death
+            # (either side's drain flag settles it).
+            self._failed = RuntimeError(
+                "worker's engine driver vanished (no corpse)")
+        offset = self._mono_offset
+        if offset is None:
+            return
+        rec = events.get_recorder()
+        for ev in body.get("events") or ():
+            try:
+                name, ph, t0, dur, attrs = ev
+                rec.record_at(str(name), str(ph), float(t0) + offset,
+                              float(dur), attrs if isinstance(
+                                  attrs, dict) else None)
+            except (TypeError, ValueError):
+                continue          # one malformed event never kills the
+                #                   reader — frames were JSON-validated
+
+    def _on_eof(self) -> None:
+        rc = None
+        if self._proc is not None:
+            try:
+                rc = self._proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                # Stream closed but the process lingers (wedged past
+                # its own drain): make the death real.
+                self._proc.kill()
+                rc = self._proc.wait()
+        self._returncode = rc
+        if not (self._drained and rc == 0) and self._failed is None:
+            # Abrupt end: no BYE, no corpse — SIGKILL semantics.  No
+            # handle is resolved (nobody was notified); the pool
+            # pump's liveness watch is the only detector, exactly like
+            # the in-process kill9 fault.
+            self._vanished = True
+            logger.warning("worker %s (pid %s) vanished (rc=%s)",
+                           self._replica_id, self._engine.pid, rc)
+        events.instant("replica/worker_exit",
+                       replica=self._replica_id, returncode=rc,
+                       drained=self._drained)
+
+    def _fail_protocol(self, e: proto.ProtocolError) -> None:
+        """An unusable frame stream fails THIS replica, classified —
+        and the worker is SIGKILLed defensively (its stream can no
+        longer be trusted, so it must not keep decoding)."""
+        self._failed = e
+        logger.error("worker %s protocol failure: %s",
+                     self._replica_id, e)
+        events.instant("replica/protocol_error",
+                       replica=self._replica_id, error=str(e)[:200])
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._returncode = self._proc.wait()
+
+    # -- the EngineDriver surface ----------------------------------------
+
+    @thread_role("handler", "pump", "main")
+    def submit(self, prompt, max_new: int, *,
+               seed: Optional[int] = None, stream: bool = False,
+               timeout_s: Optional[float] = None,
+               request_id: Optional[int] = None,
+               resume_from: int = 0,
+               requeue: bool = False) -> RequestHandle:
+        if self._failed is not None:
+            raise RuntimeError(
+                f"engine driver failed: {self._failed!r}")
+        if not self.alive():
+            raise RuntimeError(
+                f"worker {self._replica_id} is gone")
+        try:
+            prompt = self._engine.validate_request(prompt, max_new,
+                                                   seed, resume_from)
+        except ValueError as e:
+            raise RequestError(str(e))
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
+        if timeout_s is not None and timeout_s <= 0:
+            raise RequestError(f"timeout_s must be > 0, got {timeout_s}")
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        with self._lock:
+            if not requeue:
+                if self._draining:
+                    raise Draining("worker is draining; not admitting")
+                waiting = self._waiting_locked()
+                if waiting >= self._max_queue:
+                    raise AdmissionFull(waiting, self._retry_after_s)
+            if request_id is None:
+                request_id = self._next_id
+                self._next_id += 1
+            handle = RequestHandle(request_id, prompt, max_new, seed,
+                                   stream, deadline, resume_from)
+            self._recs[request_id] = _ProcRequest(handle)
+        try:
+            # Pre-encoded so an OVERSIZED request is the CLIENT's
+            # error (400), clearly distinct from a genuinely closed
+            # pipe — it must not read as a dead replica and burn
+            # every healthy candidate in the pool's placement loop.
+            frame = proto.encode_frame(proto.SUBMIT, {
+                "id": request_id, "prompt": prompt,
+                "max_new": max_new, "seed": seed,
+                "timeout_s": timeout_s, "resume_from": resume_from},
+                self._spec.max_frame_bytes)
+        except proto.ProtocolError as e:
+            with self._lock:
+                self._recs.pop(request_id, None)
+            raise RequestError(str(e))
+        sender = self._sender
+        if sender is None or not sender.send_frame(frame):
+            with self._lock:
+                self._recs.pop(request_id, None)
+            raise RuntimeError(
+                f"worker {self._replica_id} pipe closed")
+        return handle
+
+    @locks_held("_lock")
+    def _waiting_locked(self) -> int:
+        return sum(1 for rec in self._recs.values()
+                   if rec.handle.slot_granted_at is None)
+
+    def waiting(self) -> int:
+        """Requests submitted here that hold no worker lane yet (the
+        routing/shed gauge; grant news arrives with the first chunk)."""
+        with self._lock:
+            return self._waiting_locked()
+
+    def active_slots(self) -> int:
+        return self._stats["active_slots"]
+
+    def alive(self) -> bool:
+        if self._failed is not None:
+            return False
+        p = self._proc
+        return p is not None and p.poll() is None
+
+    def failure(self) -> Optional[BaseException]:
+        return self._failed
+
+    def vanished(self) -> bool:
+        return self._vanished
+
+    def vanish_reason(self) -> Optional[str]:
+        """How the worker went away, from its wait status — the
+        monitor folds this into the replica's dead_reason so /healthz
+        says "killed by signal 9", not just "vanished"."""
+        if not self._vanished:
+            return None
+        rc = self._returncode
+        pid = self._engine.pid or (self._proc.pid if self._proc
+                                   else None)
+        if rc is not None and rc < 0:
+            return f"worker pid {pid} killed by signal {-rc}"
+        return f"worker pid {pid} exited unexpectedly (code {rc})"
+
+    def failure_class(self) -> Optional[str]:
+        """Coarse per-replica failure classification for /healthz."""
+        if isinstance(self._failed, proto.ProtocolError):
+            return "protocol"
+        if self._failed is not None:
+            return "worker_error"
+        if self._vanished:
+            rc = self._returncode
+            return "killed" if rc is not None and rc < 0 else "exited"
+        return None
+
+    def health_extra(self) -> dict:
+        d: dict = {}
+        if self._engine.pid is not None:
+            d["pid"] = self._engine.pid
+        rss = self._engine.rss_bytes()
+        if rss:
+            d["rss_bytes"] = rss
+        cls = self.failure_class()
+        if cls is not None:
+            d["failure_class"] = cls
+        return d
+
+    def step_elapsed(self) -> float:
+        """The watchdog feed, reconstructed from heartbeats: the
+        worker's own in-step elapsed plus the heartbeat's age — a
+        wedged dispatch keeps reporting a growing elapsed, and a
+        worker gone COMPLETELY silent (stats thread dead too) shows
+        its silence age once it exceeds a few heartbeat intervals."""
+        if not self._ready.is_set():
+            return 0.0              # still building the engine
+        s = self._stats
+        age = max(0.0, time.monotonic() - self._stats_rx)
+        if s["in_step"]:
+            return s["step_elapsed"] + age
+        if age > max(1.0, 5 * self._spec.stats_interval_s):
+            return age
+        return 0.0
+
+    def steps_completed(self) -> int:
+        return self._stats["steps"]
+
+    def replica_id(self) -> Optional[int]:
+        return self._replica_id
+
+    def request_status(self, request_id: int) -> str:
+        with self._lock:
+            status = self._terminal.get(request_id)
+            if status is not None:
+                return status
+            rec = self._recs.get(request_id)
+        if rec is None:
+            return "unknown"
+        return ("queued" if rec.handle.slot_granted_at is None
+                else "active")
+
+    def abandon(self, handle: RequestHandle) -> None:
+        handle.deadline = time.monotonic()
+        self._send(proto.CANCEL, {"id": handle.id})
+
+    def poison(self, reason: str) -> None:
+        """Fence a declared-dead worker: for a subprocess the fence is
+        the real thing — SIGKILL.  A wedged worker that would
+        eventually wake must never stream into a request that already
+        failed over."""
+        self._poisoned = reason
+        p = self._proc
+        if p is not None and p.poll() is None:
+            logger.warning("SIGKILLing poisoned worker %s (pid %d): %s",
+                           self._replica_id, p.pid, reason)
+            p.kill()
+
+    def is_draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._send(proto.DRAIN, {})
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        self.drain()
+        if self._proc is None:
+            return True
+        try:
+            self._proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return False
+        if self._reader is not None:
+            self._reader.join(timeout=5.0)
+        return True
+
+
+class _SpecReplica(Replica):
+    """One subprocess replica: the base Replica with a ProcDriver and
+    the parent-side facade in the engine seat."""
+
+    def __init__(self, idx: int, spec: WorkerSpec, *, max_queue: int,
+                 default_timeout_s: Optional[float],
+                 retry_after_s: float):
+        engine = RemoteEngine()
+        driver = ProcDriver(spec, engine, replica_id=idx,
+                            max_queue=max_queue,
+                            default_timeout_s=default_timeout_s,
+                            retry_after_s=retry_after_s)
+        super().__init__(idx, engine, max_queue=max_queue,
+                         default_timeout_s=default_timeout_s,
+                         retry_after_s=retry_after_s, driver=driver)
+
+
+@concurrency_guarded
+class ProcPool(ReplicaPool):
+    """``ReplicaPool`` over subprocess workers, made elastic.
+
+    Everything request-shaped — admission, routing, failover, the
+    watchdog, staged drain — is inherited; this class owns worker
+    LIFECYCLE: spawning from one shared ``WorkerSpec``, a scaler
+    thread that grows the fleet under queue pressure
+    (``scale_up_queue`` waiting requests per accepting replica) up to
+    ``scale_max``, drains it back to ``scale_min`` after
+    ``idle_grace_s`` of idle (one worker at a time — the staged-drain
+    rule), and respawns dead workers with exponential backoff under a
+    ``max_restarts`` budget (the PR 2 supervisor idiom).  While the
+    respawn budget lasts, a request caught with NO live replica waits
+    (bounded by its own deadline) instead of failing — capacity is
+    coming back.
+    """
+
+    # Scaler-thread-owned bookkeeping (single writer, monitor/handler
+    # readers see atomic scalars).  Only THIS class's additions are
+    # declared: the lock-guarded request/terminal/drain structures —
+    # and the atomic-publish `_replicas` snapshot the scaler replaces
+    # wholesale — are declared (and checked) on ReplicaPool itself.
+    _GUARDED_BY = {
+        "_replicas": (None, "scaler", "main"),
+        "_idle_since": (None, "scaler"),
+        "_respawn_at": (None, "scaler"),
+        "_respawn_streak": (None, "scaler"),
+        "_restarts": (None, "scaler"),
+        "_last_spawn_t": (None, "scaler"),
+        "_next_idx": (None, "scaler", "main"),
+    }
+
+    def __init__(self, spec: WorkerSpec, *, replicas: int = 2,
+                 scale_min: Optional[int] = None,
+                 scale_max: Optional[int] = None,
+                 max_queue: int = 64, validate=None,
+                 default_timeout_s: Optional[float] = None,
+                 retry_after_s: float = 1.0,
+                 watchdog_timeout_s: Optional[float] = 30.0,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0,
+                 replica_max_queue: Optional[int] = None,
+                 monitor_poll_s: Optional[float] = None,
+                 scale_poll_s: float = 0.25,
+                 scale_up_queue: int = 2,
+                 idle_grace_s: float = 10.0,
+                 spawn_cooldown_s: float = 1.0,
+                 max_restarts: int = 8,
+                 restart_backoff_s: float = 0.5,
+                 restart_backoff_cap_s: float = 10.0):
+        if proc_replicas_killed():
+            raise RuntimeError(
+                "subprocess replicas are disabled "
+                "(TTD_NO_PROC_REPLICAS=1); use in-process replicas")
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        scale_min = replicas if scale_min is None else int(scale_min)
+        scale_max = (max(replicas, scale_min) if scale_max is None
+                     else int(scale_max))
+        if not 1 <= scale_min <= scale_max:
+            raise ValueError(
+                f"need 1 <= scale_min ({scale_min}) <= scale_max "
+                f"({scale_max})")
+        if not scale_min <= replicas <= scale_max:
+            raise ValueError(
+                f"replicas ({replicas}) must lie in "
+                f"[scale_min={scale_min}, scale_max={scale_max}]")
+        self._spec = spec
+        self._scale_min = scale_min
+        self._scale_max = scale_max
+        self._scale_poll_s = scale_poll_s
+        self._scale_up_queue = max(1, int(scale_up_queue))
+        self._idle_grace_s = idle_grace_s
+        self._spawn_cooldown_s = spawn_cooldown_s
+        self._max_restarts = max_restarts
+        self._restart_backoff_s = restart_backoff_s
+        self._restart_backoff_cap_s = restart_backoff_cap_s
+        self._next_idx = replicas
+        self._restarts = 0
+        self._respawn_streak = 0
+        self._respawn_at = 0.0
+        self._idle_since: Optional[float] = None
+        self._last_spawn_t = 0.0
+        self._budget_logged = False
+        super().__init__([spec] * replicas, max_queue=max_queue,
+                         validate=validate,
+                         default_timeout_s=default_timeout_s,
+                         retry_after_s=retry_after_s,
+                         watchdog_timeout_s=watchdog_timeout_s,
+                         backoff_base_s=backoff_base_s,
+                         backoff_cap_s=backoff_cap_s,
+                         replica_max_queue=replica_max_queue,
+                         monitor_poll_s=monitor_poll_s)
+        self._scaler_thread = threading.Thread(
+            target=self._scale_loop, name="proc-scaler", daemon=True)
+
+    def _make_replica(self, idx: int, spec) -> Replica:
+        return _SpecReplica(idx, spec,
+                            max_queue=self._replica_max_queue,
+                            default_timeout_s=self._default_timeout_s,
+                            retry_after_s=self._retry_after_s)
+
+    def start(self) -> "ProcPool":
+        super().start()
+        self._scaler_thread.start()
+        return self
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the fleet is SERVING-ready: at least
+        ``scale_min`` replicas finished their HELLO handshake (engine
+        built + warm in the child) and are still usable — launchers
+        call this before advertising the port, the warm-up analog.
+        Survives a worker that dies BEFORE its HELLO (bad flags, OOM
+        mid-compile): the corpse stays visible in the replica list
+        but stops being waited on, the scaler's respawns count as
+        they come up, and a fleet that cannot reach ``scale_min``
+        returns False at the timeout instead of blocking on a corpse
+        forever."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            ready = sum(1 for rep in self._replicas
+                        if rep.usable() and rep.driver.ready())
+            if ready >= self._scale_min:
+                return True
+            if (deadline is not None
+                    and time.monotonic() >= deadline):
+                return False
+            time.sleep(0.05)
+
+    def restarts_total(self) -> int:
+        return self._restarts
+
+    def degraded(self) -> bool:
+        """Reduced capacity means fewer USABLE workers than the floor
+        the operator asked for — dead corpses kept visible for
+        /healthz forensics do not count against a fleet the scaler
+        already respawned back to strength."""
+        return self.alive_count() < self._scale_min
+
+    # -- elasticity ------------------------------------------------------
+
+    def _restart_budget_left(self) -> bool:
+        return self._restarts < self._max_restarts
+
+    def _placement_may_recover(self) -> bool:
+        """A dead fleet with respawn budget left recovers on its own:
+        pumps wait (bounded by their deadlines) instead of failing."""
+        return not self.is_draining() and self._restart_budget_left()
+
+    @thread_role("scaler")
+    def _scale_loop(self) -> None:
+        while not self._stop.wait(self._scale_poll_s):
+            if self.is_draining():
+                continue
+            try:
+                self._scale_once()
+            except Exception:       # noqa: BLE001 — scaler must survive
+                logger.exception("proc-pool scaler pass failed")
+
+    def _scale_once(self) -> None:
+        now = time.monotonic()
+        reps = self._replicas
+        usable = [r for r in reps if r.usable()]
+        accepting = [r for r in reps if r.accepting()]
+        # 1) Respawn toward scale_min after deaths, under the restart
+        # budget, with exponential backoff (a crash-looping engine —
+        # bad checkpoint, poisoned config — must not fork-bomb).
+        if len(usable) < self._scale_min:
+            self._idle_since = None
+            if not self._restart_budget_left():
+                if not self._budget_logged:
+                    self._budget_logged = True
+                    events.instant("replica/restart_budget_exhausted",
+                                   restarts=self._restarts)
+                    logger.error(
+                        "worker restart budget exhausted after %d "
+                        "respawns; pool stays at %d usable replicas",
+                        self._restarts, len(usable))
+                return
+            if now < self._respawn_at:
+                return
+            self._restarts += 1
+            self._respawn_streak += 1
+            backoff = min(
+                self._restart_backoff_cap_s,
+                self._restart_backoff_s * 2 ** (self._respawn_streak
+                                                - 1))
+            self._respawn_at = now + backoff
+            m = self._metrics
+            counter = getattr(m, "replica_restarts", None)
+            if counter is not None:
+                counter.inc()
+            self._spawn("respawn")
+            return
+        self._respawn_streak = 0
+        # 2) Scale up under queue pressure.
+        if (len(accepting) < self._scale_max
+                and now - self._last_spawn_t >= self._spawn_cooldown_s
+                and self.waiting() > self._scale_up_queue
+                * max(1, len(accepting))):
+            self._idle_since = None
+            self._spawn("scale_up")
+            return
+        # 3) Scale down at sustained idle — ONE draining worker at a
+        # time (the staged-drain rule), never below scale_min.
+        if (len(accepting) > self._scale_min
+                and self.waiting() == 0 and self.active_slots() == 0):
+            if self._idle_since is None:
+                self._idle_since = now
+            elif (now - self._idle_since >= self._idle_grace_s
+                    and not any(r.state() == "draining" for r in reps)):
+                victim = accepting[-1]
+                events.instant("replica/scale_down",
+                               replica=victim.idx)
+                logger.info("idle %.1fs: draining worker %d "
+                            "(%d accepting, scale_min %d)",
+                            now - self._idle_since, victim.idx,
+                            len(accepting), self._scale_min)
+                victim.driver.drain()
+        else:
+            self._idle_since = None
+        # 4) Prune fully-drained scale-down workers from the published
+        # snapshot (dead replicas stay visible — operators read their
+        # classification in /healthz; drained ones left on purpose).
+        gone = [r for r in reps if r.state() == "drained"]
+        if gone:
+            self._replicas = [r for r in reps if r not in gone]
+
+    def _spawn(self, kind: str) -> None:
+        idx = self._next_idx
+        self._next_idx += 1
+        rep = self._make_replica(idx, self._spec)
+        rep.driver.start()
+        # Publish AFTER start: readers must never see a replica whose
+        # driver has no process yet.
+        self._replicas = self._replicas + [rep]
+        self._last_spawn_t = time.monotonic()
+        events.instant("replica/spawn", replica=idx, kind=kind)
+        logger.info("spawned worker %d (%s); fleet=%d", idx, kind,
+                    len(self._replicas))

@@ -109,25 +109,45 @@ class Replica:
 
     def __init__(self, idx: int, engine, *, max_queue: int,
                  default_timeout_s: Optional[float],
-                 retry_after_s: float):
+                 retry_after_s: float, driver=None):
         self.idx = idx
         self.engine = engine
-        # validate=None: the pool screens once at its own admission.
-        self.driver = EngineDriver(
-            engine, max_queue=max_queue, validate=None,
-            default_timeout_s=default_timeout_s,
-            retry_after_s=retry_after_s, replica_id=idx)
-        self.slots = engine.slots
+        # ``driver`` injection is the subprocess seam: a ProcDriver
+        # (server.procpool) implements the same surface over the frame
+        # protocol, and everything else in this module — routing,
+        # health, failover, drain — consumes it unchanged.
+        if driver is None:
+            # validate=None: the pool screens once at its own admission.
+            driver = EngineDriver(
+                engine, max_queue=max_queue, validate=None,
+                default_timeout_s=default_timeout_s,
+                retry_after_s=retry_after_s, replica_id=idx)
+        self.driver = driver
         self.dead = False
         self.dead_reason: Optional[str] = None
         self._affinity: OrderedDict = OrderedDict()   # block key -> None
         self._aff_lock = threading.Lock()
 
+    @property
+    def slots(self) -> int:
+        """Live read: a subprocess replica's facade learns its slot
+        count at the HELLO handshake, after construction."""
+        return getattr(self.engine, "slots", 0)
+
     def state(self) -> str:
         if self.dead:
             return "dead"
         if self.driver.is_draining():
-            return "draining"
+            # "drained": an orderly drain that already finished (the
+            # elastic pool's scale-down end state) — distinct from a
+            # drain in progress, which still finishes accepted work,
+            # and from a worker that VANISHED mid-drain (SIGKILL/OOM
+            # before its BYE): that one is a death the monitor is
+            # about to classify, and the scaler must never prune it
+            # as a clean scale-down.
+            if self.driver.alive():
+                return "draining"
+            return "dead" if self.driver.vanished() else "drained"
         return "alive"
 
     def accepting(self) -> bool:
@@ -203,11 +223,16 @@ class ReplicaPool:
     # Touched by handler threads (submit/status), pump threads
     # (_finish), and the drain path — every access locks (``_lock`` is
     # re-entrant, so submit's nested waiting()/alive() reads are fine).
+    # ``_replicas`` is ATOMIC-PUBLISH: the list object is immutable
+    # once published (the elastic proc pool's scaler REPLACES it with
+    # a new list on spawn/prune, never mutates it in place), so every
+    # reader iterates a consistent snapshot lock-free.
     _GUARDED_BY = {
         "_requests": ("_lock",),
         "_terminal": ("_lock",),
         "_draining": ("_lock",),
         "_next_id": ("_lock",),
+        "_replicas": (None, "scaler", "main"),
     }
 
     def __init__(self, engines, *, max_queue: int = 64,
@@ -240,11 +265,9 @@ class ReplicaPool:
         # instead of a client-visible shed.
         if replica_max_queue is None:
             replica_max_queue = max(1, -(-max_queue // len(engines)))
-        self._replicas = [
-            Replica(i, e, max_queue=replica_max_queue,
-                    default_timeout_s=default_timeout_s,
-                    retry_after_s=retry_after_s)
-            for i, e in enumerate(engines)]
+        self._replica_max_queue = replica_max_queue
+        self._replicas = [self._make_replica(i, e)
+                          for i, e in enumerate(engines)]
         self._metrics = None
         # RLock: submit() holds it across its waiting()/alive() checks
         # (which take it again) so admission decisions are atomic.
@@ -262,6 +285,23 @@ class ReplicaPool:
             target=self._monitor, name="replica-monitor", daemon=True)
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _make_replica(self, idx: int, engine) -> Replica:
+        """Build one replica — the subclass seam: the subprocess pool
+        builds a ProcDriver-backed replica from a worker SPEC here
+        instead of an in-process engine."""
+        return Replica(idx, engine, max_queue=self._replica_max_queue,
+                       default_timeout_s=self._default_timeout_s,
+                       retry_after_s=self._retry_after_s)
+
+    def _placement_may_recover(self) -> bool:
+        """May capacity come back without operator action?  The base
+        pool's replicas never resurrect — an empty candidate set is
+        terminal (``NoReplicas``).  The elastic subprocess pool
+        overrides this while its respawn budget lasts, so a request
+        caught between a death and the respawn WAITS (bounded by its
+        own deadline) instead of failing."""
+        return False
 
     def start(self) -> "ReplicaPool":
         for rep in self._replicas:
@@ -284,6 +324,15 @@ class ReplicaPool:
 
     def alive_count(self) -> int:
         return sum(rep.usable() for rep in self._replicas)
+
+    def degraded(self) -> bool:
+        """Is serving capacity reduced?  For the base pool any dead
+        replica is capacity gone for good (replicas never resurrect).
+        The elastic subprocess pool overrides this: a respawned fleet
+        back at strength is NOT degraded even though its corpses stay
+        listed for forensics — /healthz keys the load-balancer signal
+        here, not on corpse counting."""
+        return self.alive_count() < len(self._replicas)
 
     def failure(self) -> Optional[BaseException]:
         """Total-loss summary once EVERY replica is dead, else None
@@ -328,7 +377,69 @@ class ReplicaPool:
                 d["kv_blocks_total"] = total
                 d["kv_blocks_free"] = (total
                                        - rep.engine.kv_blocks_in_use())
+            # Driver-specific extras: a subprocess replica's ProcDriver
+            # reports pid/rss/protocol state here, so /healthz
+            # classifies worker-level failures per replica.
+            extra = getattr(rep.driver, "health_extra", None)
+            if extra is not None:
+                d.update(extra())
             out.append(d)
+        return out
+
+    # -- engine-stat aggregation (the gateway's /metrics feed) -------------
+
+    def slots_total(self) -> int:
+        """Current slot capacity across usable replicas — a LIVE value
+        under the elastic subprocess pool (workers spawn and drain)."""
+        return sum(rep.slots for rep in self._replicas if rep.usable())
+
+    def _engine_stat(self, name: str, ratio: bool = False) -> float:
+        vals = []
+        for rep in self._replicas:
+            if not rep.usable():
+                continue
+            fn = getattr(rep.engine, name, None)
+            if fn is None:
+                continue
+            vals.append(float(fn()))
+        if not vals:
+            return 0.0
+        return sum(vals) / len(vals) if ratio else sum(vals)
+
+    def overlap_ratio(self) -> float:
+        return self._engine_stat("overlap_ratio", ratio=True)
+
+    def prefill_stall_s(self) -> float:
+        return self._engine_stat("prefill_stall_s")
+
+    def kv_blocks_in_use(self) -> float:
+        return self._engine_stat("kv_blocks_in_use")
+
+    def kv_blocks_total(self) -> float:
+        return self._engine_stat("kv_blocks_total")
+
+    def kv_prefix_hit_tokens(self) -> float:
+        return self._engine_stat("kv_prefix_hit_tokens")
+
+    def kv_evictions(self) -> float:
+        return self._engine_stat("kv_evictions")
+
+    def kv_pool_bytes(self) -> float:
+        return self._engine_stat("kv_pool_bytes")
+
+    def replica_rss(self) -> dict:
+        """Per-replica resident-set bytes (``{replica: bytes}``) for
+        engines that report it — subprocess facades do (from the stats
+        frames); in-process replicas share the gateway's own rss and
+        truthfully report nothing."""
+        out = {}
+        for rep in self._replicas:
+            fn = getattr(rep.engine, "rss_bytes", None)
+            if fn is None:
+                continue
+            v = fn()
+            if v:
+                out[str(rep.idx)] = float(v)
         return out
 
     # -- admission ---------------------------------------------------------
@@ -350,6 +461,9 @@ class ReplicaPool:
         if self._validate is not None:
             self._validate(prompt, max_new, seed)
         try:
+            # The screening engine: any replica's validator agrees
+            # (identically-configured engines); a subprocess pool's
+            # facade answers from the HELLO-advertised shape.
             prompt = self._replicas[0].engine.validate_request(
                 prompt, max_new, seed)
         except ValueError as e:
@@ -363,7 +477,7 @@ class ReplicaPool:
         with self._lock:
             if self._draining:
                 raise Draining("gateway is draining; not admitting")
-            if not self.alive():
+            if not self.alive() and not self._placement_may_recover():
                 raise NoReplicas(
                     "no live replica can accept work: "
                     + "; ".join(f"replica {r.idx} {r.state()}"
@@ -436,7 +550,7 @@ class ReplicaPool:
             # completion), not starve into NoReplicas.
             allow_draining = allow_draining or self.is_draining()
             reps = self._candidates(preq, allow_draining)
-            if not reps:
+            if not reps and not self._placement_may_recover():
                 raise NoReplicas(
                     f"request {outer.id}: no live replica left "
                     f"(excluded: {sorted(preq.excluded)})")
@@ -447,7 +561,10 @@ class ReplicaPool:
             if outer.deadline is not None:
                 timeout_s = max(1e-3,
                                 outer.deadline - time.monotonic())
-            refused = False
+            # Empty candidate set with recovery possible (the elastic
+            # pool's respawn window): wait out the backoff exactly
+            # like an everyone-refused pass — capacity is coming.
+            refused = not reps
             for rep in reps:
                 try:
                     inner = rep.driver.submit(
@@ -666,8 +783,19 @@ class ReplicaPool:
                 failure = drv.failure()
                 if failure is not None:
                     reason = f"driver failed: {failure!r}"
-                elif not drv.alive() and not drv.is_draining():
-                    reason = "driver vanished (no corpse, no drain)"
+                elif not drv.alive() and (not drv.is_draining()
+                                          or drv.vanished()):
+                    # Drivers that can say HOW they vanished do (a
+                    # ProcDriver reports the worker's wait status —
+                    # "killed by signal 9" beats "vanished").  The
+                    # drain exemption covers ONLY an orderly drain: a
+                    # worker SIGKILLed/OOMed mid-drain vanishes
+                    # abruptly (no BYE, nonzero wait status) and must
+                    # be classified dead, not pruned as a clean
+                    # scale-down.
+                    how = getattr(drv, "vanish_reason", None)
+                    reason = ((how() if how is not None else None)
+                              or "driver vanished (no corpse, no drain)")
                 elif (self._watchdog_s is not None
                       and drv.steps_completed() > 0
                       and drv.step_elapsed() > self._watchdog_s):

@@ -513,6 +513,173 @@ def bench_gateway(base_url, preset, slots, chunk, max_queue, clients,
     return rec
 
 
+def _recovery_gap_ms(pool, kill, prompt, max_new, reps, timeout):
+    """Failover-recovery latency: ONE streaming request; after its
+    first committed chunk, ``kill(replica)`` murders the replica
+    serving it; the headline is the widest inter-chunk gap the CLIENT
+    observed — the failover hole (death detection + re-placement +
+    resume prefill).  Median over ``reps`` runs."""
+    gaps = []
+    for _ in range(reps):
+        h = pool.submit(list(prompt), max_new, stream=True,
+                        timeout_s=timeout)
+        it = h.iter_tokens()
+        next(it)                          # first chunk: placed, decoding
+        rep = pool._requests[h.id].replica
+        t_kill = time.perf_counter()
+        kill(rep)
+        prev, worst = t_kill, 0.0
+        for _chunk in it:
+            now = time.perf_counter()
+            worst = max(worst, now - prev)
+            prev = now
+        gaps.append(worst)
+    gaps.sort()
+    return round(1e3 * gaps[len(gaps) // 2], 1)
+
+
+def bench_gateway_procs_ab(preset, slots, chunk, max_queue, clients,
+                           requests_per_client, prompt_range,
+                           new_range, cache_len, seed, timeout,
+                           replicas=2, reps=3):
+    """Out-of-process vs in-process replicas, one workload: two
+    gateways (N in-process engine replicas; N subprocess workers built
+    from the same preset/init seed) serve identical closed-loop client
+    fleets as leg-order-alternating BACK-TO-BACK PAIRS — the headline
+    wall ratio is the MEDIAN of per-pair ratios (the established
+    noise discipline), with tok/s and the gateway-observed TTFT per
+    leg.  A separate leg measures FAILOVER-RECOVERY latency on each
+    pool: a streaming request's replica is killed after its first
+    chunk (a real SIGKILL for the subprocess pool, the in-process
+    kill9 vanish fault for the other) and the widest client-observed
+    inter-chunk gap — death detection + re-placement + resume — is
+    the recovery hole."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import faults
+    from tensorflow_train_distributed_tpu.server import (
+        ProcPool, ServingGateway, WorkerSpec,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    vocab = min(cfg.vocab_size, 30_000)
+    cache_len = cache_len or min(256, cfg.max_positions)
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    loop_args = (clients, requests_per_client, prompt_range, new_range)
+
+    engines = [ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                             cache_len=cache_len)
+               for _ in range(replicas)]
+    for e in engines:                      # warm: compile before timing
+        e.submit([1, 2, 3], 5)
+        e.run()
+    gw_in = ServingGateway(engines, host="127.0.0.1", port=0,
+                           max_queue=max_queue).start()
+    spec = WorkerSpec(
+        factory="llama",
+        factory_json=dict(preset=preset, init_seed=0, slots=slots,
+                          chunk=chunk, cache_len=cache_len))
+    pool = ProcPool(spec, replicas=replicas, max_queue=max_queue,
+                    monitor_poll_s=0.02, restart_backoff_s=0.05)
+    gw_proc = ServingGateway(pool, host="127.0.0.1", port=0).start()
+    urls = {"in_process": f"http://127.0.0.1:{gw_in.port}",
+            "procs": f"http://127.0.0.1:{gw_proc.port}"}
+    try:
+        if not pool.wait_ready(timeout=600.0):
+            raise RuntimeError("subprocess workers failed to come up")
+        best = {}
+        ratios = []
+        for i in range(max(1, reps)):
+            walls = {}
+            order = (("in_process", "procs") if i % 2 == 0
+                     else ("procs", "in_process"))
+            for leg in order:
+                rec = _run_closed_loop(urls[leg], *loop_args, vocab,
+                                       seed, timeout)
+                walls[leg] = rec["wall_s"]
+                if (leg not in best
+                        or rec["wall_s"] < best[leg]["wall_s"]):
+                    best[leg] = rec
+            ratios.append(walls["procs"] / walls["in_process"])
+        ratios.sort()
+
+        # Failover-recovery legs (after the timed pairs: they kill
+        # replicas).  Subprocess pool first — its scaler respawns the
+        # corpse; the in-process pool uses a fresh third gateway so
+        # the timed one above stays clean for the record's tok/s.
+        rec_prompt = [1, 2, 3, 4]
+        rec_new = max(64, new_range[1])
+        import os as _os
+        import signal as _signal
+
+        recovery = {"procs": _recovery_gap_ms(
+            pool, lambda rep: _os.kill(rep.driver.pid, _signal.SIGKILL),
+            rec_prompt, rec_new, reps, timeout)}
+
+        gaps = []
+        for _ in range(reps):
+            # A fresh pool per run: in-process replicas never
+            # resurrect, so each kill9 spends one for good (the
+            # subprocess pool above respawns its own corpses).  The
+            # kill9 vanish fault is the in-process analog of SIGKILL,
+            # armed after the first chunk, scoped to the replica
+            # serving the stream — same measurement loop as the
+            # subprocess leg, different kill.
+            eng3 = [ServingEngine(cfg, params, slots=slots,
+                                  chunk=chunk, cache_len=cache_len)
+                    for _ in range(replicas)]
+            for e in eng3:
+                e.submit([1, 2, 3], 5)
+                e.run()
+            gw3 = ServingGateway(eng3, host="127.0.0.1", port=0,
+                                 max_queue=max_queue).start()
+            try:
+                gaps.append(_recovery_gap_ms(
+                    gw3.pool,
+                    lambda rep: faults.arm(
+                        f"serve:dispatch:1:kill9:replica={rep.idx}"),
+                    rec_prompt, rec_new, 1, timeout))
+            finally:
+                faults.disarm()
+                gw3.drain(timeout=30)
+        gaps.sort()
+        recovery["in_process"] = gaps[len(gaps) // 2]
+    finally:
+        gw_proc.drain(timeout=60)
+        gw_in.drain(timeout=30)
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"{preset}_gateway_proc_replicas_tokens_per_sec",
+        "value": best["procs"]["tokens_per_sec"],
+        "unit": "generated tokens/sec, subprocess workers "
+                "(wall_ratio_median: procs/in-process, median of "
+                "per-pair wall ratios)",
+        "replicas": replicas,
+        "slots": slots,
+        "chunk": chunk,
+        "cache_len": cache_len,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "max_queue": max_queue,
+        "reps": reps,
+        "procs": best["procs"],
+        "in_process": best["in_process"],
+        "wall_ratio_median": round(ratios[len(ratios) // 2], 3),
+        "pair_wall_ratios": [round(r, 4) for r in ratios],
+        "failover_recovery_ms": recovery,
+        "worker_restarts": pool.restarts_total(),
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+    return rec
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--base-url", default="",
@@ -528,6 +695,15 @@ def main(argv=None) -> int:
                    help="engine replicas behind the in-process gateway "
                         "(load + KV-affinity routed; ignored with "
                         "--base-url and --mixed)")
+    p.add_argument("--replica-procs", action="store_true",
+                   help="A/B subprocess replica workers "
+                        "(server.procpool) against in-process "
+                        "replicas on the same closed-loop workload: "
+                        "tok/s + TTFT per leg, the median of per-pair "
+                        "wall ratios, and a failover-recovery-latency "
+                        "leg (real SIGKILL vs the in-process kill9 "
+                        "vanish) — in-process runs only; uses "
+                        "--replicas (min 2) workers per leg")
     p.add_argument("--max-queue", type=int, default=16)
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--requests-per-client", type=int, default=8)
@@ -558,7 +734,9 @@ def main(argv=None) -> int:
                    help="--mixed only: budget installments the long "
                         "prompt spans")
     p.add_argument("--reps", type=int, default=3,
-                   help="--mixed only: passes per leg (best p99 wins)")
+                   help="--mixed: passes per leg (best p99 wins); "
+                        "--replica-procs: back-to-back A/B pairs "
+                        "(median of per-pair wall ratios)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",
                    help="force a jax platform ('cpu' for smoke runs)")
@@ -582,9 +760,21 @@ def main(argv=None) -> int:
     if args.mixed and args.base_url:
         raise SystemExit("--mixed builds its own A/B gateways "
                          "in-process; it cannot target --base-url")
+    if args.replica_procs and (args.base_url or args.mixed):
+        raise SystemExit("--replica-procs builds its own A/B gateways "
+                         "in-process; it composes with neither "
+                         "--base-url nor --mixed")
     try:
         with cm:
-            if args.mixed:
+            if args.replica_procs:
+                rec = bench_gateway_procs_ab(
+                    args.preset, args.slots, args.chunk,
+                    args.max_queue, args.clients,
+                    args.requests_per_client, prompt_range, new_range,
+                    args.cache_len or None, args.seed, args.timeout,
+                    replicas=max(2, args.replicas),
+                    reps=args.reps)
+            elif args.mixed:
                 rec = bench_gateway_mixed(
                     args.preset, args.slots, args.chunk,
                     args.max_queue, args.seed, args.timeout,

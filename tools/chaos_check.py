@@ -27,6 +27,13 @@ exactly one replica dead, at least one failover, and /healthz
 degraded-but-routable.  The tier-1 serving chaos smoke drives this
 same entry point in-process.
 
+``--serving --procs`` runs the same gate over SUBPROCESS replicas
+(``server.procpool``): the kill is a **real** ``os.kill(pid,
+SIGKILL)`` delivered inside one worker by the ``killpid`` fault armed
+in that worker's own environment — the gateway process survives, the
+dead worker is classified "killed by signal 9" in /healthz, streams
+stay token-equal, and the elastic pool respawns the corpse.
+
 ``--train-elastic`` runs the ELASTIC-MESH chaos gate: a supervised
 8-device training run loses half its devices mid-run (the
 ``mesh:device_lost`` fault point), the supervisor classifies the exit
@@ -424,6 +431,173 @@ def run_serving_chaos(*, sampling: bool = True, n_requests: int = 8,
             [(r[0] if r else "no result") for r in results]}
 
 
+def run_serving_chaos_procs(*, sampling: bool = True,
+                            n_requests: int = 8,
+                            kill_dispatch: int = 3,
+                            workers: int = 2,
+                            watchdog_timeout_s: float = 30.0,
+                            timeout_s: float = 300.0) -> dict:
+    """The SUBPROCESS leg of the serving chaos gate: the same
+    discipline as ``run_serving_chaos``, but each replica is a real
+    subprocess worker (``server.procpool``) and the kill is a REAL
+    ``os.kill(pid, SIGKILL)`` — ``serve:dispatch:N:killpid:replica=0``
+    armed in the workers' own environment fires inside worker 0 at its
+    Nth dispatch, mid-stream under load.  The gate asserts:
+
+    - the GATEWAY process never feels it: every accepted request
+      completes, failed-over streams token-equal to an uninterrupted
+      in-process single-engine run (greedy and seeded legs — the
+      resume-from-token contract crosses the process boundary);
+    - exactly one worker dead, classified "killed by signal 9" in the
+      per-replica health state;
+    - the elastic pool RESPAWNS the dead worker (restart budget) and
+      capacity returns without operator action.
+
+    Workers warm their engines in the child before the HELLO, so the
+    watchdog never stares down a cold XLA compile."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform("cpu")
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+        LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.server import (
+        ProcPool,
+        ServingGateway,
+        WorkerSpec,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    checks = {}
+    kw = dict(slots=2, cache_len=64, chunk=4)
+    if sampling:
+        kw.update(temperature=0.8, top_k=40)
+    rng = np.random.default_rng(0)
+    reqs = [([int(t) for t in rng.integers(1, 200,
+                                           int(rng.integers(2, 8)))],
+             int(rng.integers(6, 14)), 1000 + i)
+            for i in range(n_requests)]
+
+    # Reference: the same requests on ONE uninterrupted in-process
+    # engine, built exactly as the workers build theirs (same preset,
+    # same init seed -> bitwise-identical params).
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    ref_eng = ServingEngine(cfg, params,
+                            prompt_buckets=(8, 16, 32), **kw)
+    rids = [ref_eng.submit(p, m, seed=s if sampling else None)
+            for p, m, s in reqs]
+    ref_out = ref_eng.run()
+    refs = [ref_out[r] for r in rids]
+
+    # The worker fleet: the killpid plan rides the workers' OWN
+    # environment, scoped to replica 0 — a REAL SIGKILL of exactly one
+    # subprocess, delivered at its kill_dispatch'th driver dispatch.
+    spec = WorkerSpec(
+        factory="llama",
+        factory_json=dict(preset="llama_tiny", init_seed=0,
+                          prompt_buckets=[8, 16, 32], **kw),
+        env={"TTD_FAULT_PLAN":
+             f"serve:dispatch:{kill_dispatch}:killpid:replica=0"})
+    pool = ProcPool(spec, replicas=workers,
+                    max_queue=4 * n_requests,
+                    watchdog_timeout_s=watchdog_timeout_s,
+                    monitor_poll_s=0.02,
+                    spawn_cooldown_s=0.0,
+                    restart_backoff_s=0.05)
+    gw = ServingGateway(pool, host="127.0.0.1", port=0).start()
+    try:
+        checks["workers_ready"] = pool.wait_ready(timeout=timeout_s)
+        killed_pid = pool.replicas[0].driver.pid
+        results: list = [None] * len(reqs)
+
+        def client(i):
+            prompt, max_new, seed = reqs[i]
+            body = {"prompt": prompt, "max_new": max_new,
+                    "stream": True}
+            if sampling:
+                body["seed"] = seed
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/generate",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    toks, err = [], None
+                    for raw in r:
+                        obj = _json.loads(raw)
+                        if "tokens" in obj:
+                            toks.extend(obj["tokens"])
+                        elif "error" in obj:
+                            err = obj["error"]
+                    results[i] = (err, list(prompt) + toks)
+            except OSError as e:
+                results[i] = (f"{type(e).__name__}: {e}", None)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        checks["all_completed"] = all(
+            r is not None and r[0] is None for r in results)
+        checks["streams_match_reference"] = checks[
+            "all_completed"] and all(
+            r[1] == ref for r, ref in zip(results, refs))
+        states = pool.replica_states()
+        dead = [s for s in states if s["state"] == "dead"]
+        checks["one_worker_dead"] = len(dead) == 1
+        checks["killed_by_signal_9"] = (
+            len(dead) == 1
+            and "signal 9" in dead[0].get("reason", "")
+            and dead[0].get("failure_class") == "killed"
+            and dead[0].get("pid") == killed_pid)
+        checks["failover_happened"] = (
+            gw.metrics.failovers.value() >= 1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz", timeout=10) as r:
+            checks["healthz_routable"] = (
+                r.status == 200
+                and _json.loads(r.read())["status"]
+                in ("ok", "degraded"))
+        # The elastic pool respawns the corpse (restart budget):
+        # capacity returns without operator action.
+        deadline = time.monotonic() + 30.0
+        while (pool.alive_count() < workers
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        checks["worker_respawned"] = (
+            pool.alive_count() >= workers
+            and pool.restarts_total() >= 1)
+    finally:
+        gw.drain(timeout=60)
+    return {"ok": all(checks.values()), "checks": checks,
+            "mode": "serving-procs",
+            "leg": "sampled" if sampling else "greedy",
+            "failovers": gw.metrics.failovers.value(),
+            "restarts": pool.restarts_total(),
+            "results": [] if all(checks.values()) else
+            [(r[0] if r else "no result") for r in results]}
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser(
@@ -440,6 +614,14 @@ def main(argv=None) -> int:
                         "accepted requests must complete on the "
                         "survivor token-equal to an uninterrupted "
                         "single-replica run (greedy + sampled legs)")
+    p.add_argument("--procs", action="store_true",
+                   help="with --serving: run the replicas as real "
+                        "SUBPROCESS workers and deliver a REAL "
+                        "os.kill(pid, SIGKILL) to one of them "
+                        "mid-stream (the killpid fault in the "
+                        "worker's own environment); survivors must "
+                        "complete everything token-equal and the "
+                        "elastic pool must respawn the corpse")
     p.add_argument("--train-elastic", action="store_true",
                    help="elastic mesh chaos instead: a supervised "
                         "8-device training run loses half its devices "
@@ -463,13 +645,18 @@ def main(argv=None) -> int:
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
     if args.serving:
-        greedy = run_serving_chaos(sampling=False)
-        sampled = run_serving_chaos(sampling=True)
+        run = (run_serving_chaos_procs if args.procs
+               else run_serving_chaos)
+        greedy = run(sampling=False)
+        sampled = run(sampling=True)
         verdict = {"ok": greedy["ok"] and sampled["ok"],
-                   "mode": "serving", "greedy": greedy,
-                   "sampled": sampled}
+                   "mode": ("serving-procs" if args.procs
+                            else "serving"),
+                   "greedy": greedy, "sampled": sampled}
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
+    if args.procs:
+        p.error("--procs modifies --serving; pass both")
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_check_")
     os.makedirs(workdir, exist_ok=True)
     try:

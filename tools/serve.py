@@ -269,6 +269,34 @@ def build_engine(args, cfg, is_moe, prefix_ids):
     return eng
 
 
+def worker_engine_factory(spec: dict):
+    """Subprocess-replica engine factory — the PRODUCTION one
+    ``server.worker`` resolves as ``serve:worker_engine_factory``.
+    ``spec`` is the launcher CLI's parsed flag namespace, serialized
+    (``vars(args)`` — everything argparse produced is JSON-clean), so
+    the worker replays the exact flag set the parent screened with:
+    parent-side facades and worker-side engines are built from ONE
+    flag surface and cannot drift."""
+    args = argparse.Namespace(**spec)
+    if getattr(args, "platform", ""):
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform(args.platform)
+    _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
+    prefix_ids = parse_prefix_arg(args, cfg)
+    eng = build_engine(args, cfg, is_moe, prefix_ids)
+    # Warm before the HELLO: the decode program (and one prefill
+    # shape) compiles now, inside the child, so the parent's
+    # wait_ready covers the compile and the pool's hung-dispatch
+    # watchdog never stares down a cold XLA compile.  Requests are
+    # seeded independently — a warm pass changes no later output.
+    eng.submit([1], 1)
+    eng.run()
+    return eng
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_engine_args(p)

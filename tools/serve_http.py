@@ -78,6 +78,57 @@ def make_vocab_validator(vocab_size: int):
     return _validate
 
 
+def _serve_procs(args, cfg) -> int:
+    """The out-of-process gateway: N subprocess workers behind a
+    ``ProcPool``, each rebuilding the engine from THIS CLI's serialized
+    flags (``serve.worker_engine_factory``), so parent screening and
+    worker engines agree.  A worker SIGKILL/OOM/native crash fails one
+    replica (classified in /healthz) and its requests resume on a
+    survivor; the pool scales between --scale-min/--scale-max and
+    respawns dead workers under --restart-budget."""
+    from tensorflow_train_distributed_tpu.server import (
+        ProcPool,
+        ServingGateway,
+        WorkerSpec,
+    )
+
+    spec = WorkerSpec(
+        factory="serve:worker_engine_factory",
+        factory_json=dict(vars(args)),
+        pythonpath=(_HERE,),
+    )
+    scale_min = args.scale_min or args.replicas
+    scale_max = max(args.scale_max or args.replicas, scale_min)
+    pool = ProcPool(
+        spec, replicas=args.replicas, scale_min=scale_min,
+        scale_max=scale_max, max_queue=args.max_queue,
+        validate=make_vocab_validator(cfg.vocab_size),
+        default_timeout_s=args.default_timeout or None,
+        retry_after_s=args.retry_after,
+        watchdog_timeout_s=args.watchdog_timeout or None,
+        idle_grace_s=args.idle_grace,
+        max_restarts=args.restart_budget)
+    gw = ServingGateway(pool, host=args.host, port=args.port,
+                        default_max_new=args.max_new)
+    gw.install_signal_handlers(drain_timeout=args.drain_timeout or None)
+    gw.start()
+    # Advertise the port only once every worker finished its handshake
+    # (engine built + warm in the child) — the warm-up analog.
+    print(f"waiting for {args.replicas} subprocess workers...",
+          flush=True)
+    if not pool.wait_ready(timeout=600.0):
+        print("workers failed to come up inside 600s; draining",
+              flush=True)
+        gw.drain(timeout=30)
+        return 1
+    print(f"gateway listening on {args.host}:{gw.port} "
+          f"(config={args.config}, replica-procs={args.replicas}, "
+          f"scale=[{scale_min},{scale_max}], slots={args.slots}, "
+          f"max_queue={args.max_queue})", flush=True)
+    gw.wait()           # until SIGTERM/SIGINT drains
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_engine_args(p)
@@ -102,6 +153,32 @@ def main(argv=None) -> int:
                         "survivor from its last streamed token "
                         "(TTD_NO_FAILOVER=1 forces the single-engine "
                         "path)")
+    p.add_argument("--replica-procs", action="store_true",
+                   help="run each replica as a SUBPROCESS worker "
+                        "(server.procpool) speaking the length-prefixed "
+                        "driver protocol: a replica OOM/native crash/"
+                        "SIGKILL fails one worker, never the gateway, "
+                        "and the pool scales elastically between "
+                        "--scale-min/--scale-max "
+                        "(TTD_NO_PROC_REPLICAS=1 falls back to "
+                        "in-process replicas)")
+    p.add_argument("--scale-min", type=int, default=0,
+                   help="--replica-procs: never drain below this many "
+                        "workers (0 = --replicas); dead workers are "
+                        "respawned toward it under --restart-budget")
+    p.add_argument("--scale-max", type=int, default=0,
+                   help="--replica-procs: spawn up to this many workers "
+                        "under queue pressure (0 = --replicas — no "
+                        "scale-up)")
+    p.add_argument("--restart-budget", type=int, default=8,
+                   help="--replica-procs: total dead-worker respawns "
+                        "before the pool stops resurrecting (a crash-"
+                        "looping engine must not fork-bomb); respawns "
+                        "back off exponentially")
+    p.add_argument("--idle-grace", type=float, default=30.0,
+                   help="--replica-procs: seconds of whole-pool idle "
+                        "before ONE scale-up worker is drained back "
+                        "(staged, never below --scale-min)")
     p.add_argument("--watchdog-timeout", type=float, default=30.0,
                    help="seconds a decode dispatch may run before the "
                         "replica is declared dead (hung-device "
@@ -129,6 +206,19 @@ def main(argv=None) -> int:
 
     _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
     prefix_ids = parse_prefix_arg(args, cfg)
+
+    if args.replica_procs:
+        from tensorflow_train_distributed_tpu.server.procpool import (
+            proc_replicas_killed,
+        )
+
+        if proc_replicas_killed():
+            print("TTD_NO_PROC_REPLICAS=1: subprocess replicas "
+                  "disabled, falling back to in-process replicas",
+                  flush=True)
+            args.replica_procs = False
+    if args.replica_procs:
+        return _serve_procs(args, cfg)
     # One engine per replica, configured identically (each builds its
     # own caches and preloads the prefix into its own pool — replica
     # state stays fully independent so any one can die alone).
