@@ -27,7 +27,14 @@ halves share one annotation registry (``registry``):
     route through ``compilecheck.jit``), the declared donation/statics
     must match the jit kwargs, and call sites must not feed raw
     host-measured sizes (``len``/``.shape``) or python-scalar closures
-    across the boundary un-bucketed.
+    across the boundary un-bucketed;
+  - ``memcheck`` — the big device allocators declare their HBM pool
+    and budget with ``@memory_budget(pool=..., budget_bytes=...)``;
+    in an annotated (hot) module every host-side device allocation
+    must be reachable from an annotated allocator / jit program /
+    eval_shape thunk, and call sites of donating ``@compile_site``
+    programs must rebind the donated buffer (a kept alias silently
+    doubles peak HBM).
 
 - **runtime sanitizers**: ``TTD_LOCKCHECK=1`` (``lockcheck``) wraps
   the package's locks with an acquisition-order graph that raises on
@@ -36,10 +43,16 @@ halves share one annotation registry (``registry``):
   (``compilecheck``) wraps the annotated jit sites with per-callsite
   compile tracking that raises ``RecompileError`` past a site's
   declared budget, emits ``compile/<site>`` flight-recorder spans, and
-  feeds ``ttd_engine_compiles_total``.  conftest arms BOTH for tier-1,
-  so every existing test doubles as a race test and a recompile-storm
-  test.  ``TTD_NO_LOCKCHECK=1`` / ``TTD_NO_COMPILECHECK=1`` are the
-  escape hatches.
+  feeds ``ttd_engine_compiles_total``; ``TTD_MEMCHECK=1``
+  (``memcheck``) tracks live bytes per declared pool, raises
+  ``MemoryBudgetError`` before an over-budget allocation with the
+  offending allocation diffed against the live set, emits
+  ``memory/<pool>`` spans, and feeds the labeled
+  ``ttd_engine_hbm_bytes{pool=...}`` gauge family.  conftest arms all
+  three for tier-1, so every existing test doubles as a race test, a
+  recompile-storm test, and a memory-budget test.
+  ``TTD_NO_LOCKCHECK=1`` / ``TTD_NO_COMPILECHECK=1`` /
+  ``TTD_NO_MEMCHECK=1`` are the escape hatches.
 
 One suppression format everywhere: ``# ttd-lint: disable=<checker> --
 <why>`` on the offending line (comma-separate several checkers).  The
@@ -61,5 +74,6 @@ from tensorflow_train_distributed_tpu.runtime.lint.registry import (  # noqa: F4
     current_role,
     dispatch_critical,
     locks_held,
+    memory_budget,
     thread_role,
 )

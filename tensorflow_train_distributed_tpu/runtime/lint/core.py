@@ -90,7 +90,11 @@ def _iter_suppression_comments(
 #: machine caller can tell WHICH disciplines failed from the code alone;
 #: ``--json`` carries the same map in-band).  0 = clean, 2 = usage
 #: error (argparse convention, below every checker bit), 1 = findings
-#: from an unregistered source (io/syntax).
+#: from an unregistered source (io/syntax).  Bits past 128 (memcheck
+#: was the seventh checker; process statuses are 8-bit) cannot survive
+#: the exit-status truncation, so ``exit_code`` folds them into the
+#: generic bit 1 — the status stays nonzero and names what it can,
+#: ``--json``'s ``exit_bits``/``counts`` carry the exact story.
 CHECKER_EXIT_BITS: Dict[str, int] = {
     "concurrency": 4,
     "dispatch": 8,
@@ -98,15 +102,20 @@ CHECKER_EXIT_BITS: Dict[str, int] = {
     "prometheus": 32,
     "compilecheck": 64,
     "suppression": 128,
+    "memcheck": 256,
 }
 
 
 def exit_code(findings: Sequence["Finding"]) -> int:
     """The CLI exit status for a finding list: OR of each finding
-    checker's stable bit (1 for io/syntax), 0 when clean."""
+    checker's stable bit (1 for io/syntax), 0 when clean.  Bits past
+    the 8-bit process-status range fold into bit 1 (a memcheck-only
+    run exits 1, never a false 0 — the shell truncates 256 to 0)."""
     code = 0
     for f in findings:
         code |= CHECKER_EXIT_BITS.get(f.checker, 1)
+    if code > 255:
+        code = (code & 0xFF) | 1
     return code
 
 
@@ -191,6 +200,7 @@ def _load_checkers() -> None:
         concurrency,
         dispatch,
         flags,
+        memcheck,
         prometheus,
     )
 

@@ -1,0 +1,933 @@
+"""Memory-budget discipline: HBM allocator registry + live-byte sanitizer.
+
+Every capacity lever in this stack — ``--kv-pool-blocks`` oversizing,
+int8 pools, elastic worker packing — bets on HBM headroom that nothing
+used to see or enforce: an OOM was an opaque XLA error after the fact,
+and admission reasoned about free blocks, not bytes.  This module is
+the ttd-lint framework's THIRD vertical (locks → ``lockcheck``,
+compiles → ``compilecheck``, memory → here), same two-half shape:
+
+- **static checker** (``memcheck``, registered in ``core``): a module
+  that declares any ``@memory_budget`` pool is a HOT ALLOCATOR MODULE
+  (``serving.py`` and ``training/trainer.py`` are REQUIRED to be), and
+  inside one every host-side device allocation (``jnp.zeros`` /
+  ``jnp.ones`` / ``jnp.full`` / ``jnp.empty`` / ``jax.device_put``)
+  must be reachable from a sanctioned owner: an ``@memory_budget``
+  allocator, a jit program (its allocations are the program's working
+  set, accounted at ITS caller's pool), or an ``jax.eval_shape`` thunk
+  (trace-only, never allocates).  A device allocation outside those is
+  an unbudgeted pool in the making.  The checker also audits
+  DONATION-DEFEATING ALIASING at call sites of ``@compile_site``
+  programs: passing ``self._cache`` in a donated position without
+  rebinding it from the result keeps the old buffer live behind the
+  donation — XLA cannot actually reuse it, and peak HBM silently
+  doubles (the exact failure mode the ``donates=`` cross-check guards
+  at the declaration; this guards the call).  And every
+  ``@memory_budget`` must declare a budget (``budget_bytes`` or
+  ``budget_fn``) — a pool without a budget is a gauge, not a
+  discipline.
+
+- **runtime sanitizer** (``TTD_MEMCHECK=1``; ``TTD_NO_MEMCHECK=1`` is
+  the live escape hatch, re-read per allocation through the
+  ``os.environ._data`` fast path): annotated allocators charge a
+  per-``(owner, pool)`` ledger with the byte size of the tree they
+  mint (host metadata only — shapes and dtypes, never a device sync).
+  BEFORE the allocation runs, the projected bytes (from the spec's
+  ``project_fn`` — the engine's memoized cache ``eval_shape`` — or the
+  memo of a previous identical-signature allocation) are checked
+  against the owner's declared budget, and the first allocation that
+  would exceed it raises ``MemoryBudgetError`` with the offending
+  allocation DIFFED against the owner's live set — pool by pool,
+  allocation by allocation — instead of letting XLA OOM later with no
+  attribution.  Charges are released when the owner dies, when the
+  minted leaves die (transient allocations), or when a same-site
+  same-signature allocation replaces them (rebuilt pools); every
+  charge records a ``memory/<pool>`` flight-recorder span and feeds
+  the ``ttd_engine_hbm_bytes{pool=...}`` gauge family, with a
+  ``memory/near_miss`` instant once a pool crosses 90% of its budget.
+
+Accounting honesty: the ledger tracks ALLOCATOR-MINTED buffers.  A
+donating jit program (``_prefill_piece`` threading a batch-1 cache)
+returns same-shaped SUCCESSOR buffers the wrapper never sees, so a
+``lifetime="leaf"`` charge ends at the first donation — transient
+prefill charges are therefore an admission-time budget gate, not a
+steady-state gauge, while ``lifetime="owner"`` pools (the KV block
+pool grid caches, the trainer state) are exact for the owner's whole
+life.  That split matches what HBM budgeting needs: the constant pools
+dominate, and the transient gate still catches the burst that would
+have OOMed.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint.core import (
+    Finding,
+    register_checker,
+)
+from tensorflow_train_distributed_tpu.runtime.lint.dispatch import (
+    _decorator_name,
+    _dotted,
+    _is_jit_decorated,
+)
+
+CHECKER = "memcheck"
+
+_ARM_ENV = "TTD_MEMCHECK"
+_KILL_ENV = "TTD_NO_MEMCHECK"
+
+
+class MemoryBudgetError(RuntimeError):
+    """An allocation would exceed its owner's declared HBM budget."""
+
+
+# -- arming ----------------------------------------------------------------
+
+
+def _truthy(v: Optional[str]) -> bool:
+    return v is not None and v not in ("", "0")
+
+
+def armed() -> bool:
+    """``TTD_MEMCHECK`` truthy and not vetoed by ``TTD_NO_MEMCHECK`` —
+    checked at decoration time (allocators wrap at import, the
+    lockcheck/compilecheck contract: arm BEFORE importing the
+    package)."""
+    if _truthy(os.environ.get(_KILL_ENV)):
+        return False
+    return _truthy(os.environ.get(_ARM_ENV))
+
+
+# Re-read per allocation (an operator shell can disarm a misbehaving
+# sanitizer live, no redeploy) — the shared fast-path reader.
+_vetoed = events.make_env_flag_reader(_KILL_ENV)
+
+
+# -- pool registry ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PoolSpec:
+    """One allocator's declared memory discipline."""
+
+    site: str
+    pool: object                   # str, or callable(*args, **kw) -> str
+    budget_bytes: Optional[int] = None
+    budget_fn: Optional[Callable] = None
+    project_fn: Optional[Callable] = None
+    lifetime: object = "owner"     # "owner" | "leaf", or callable
+    method: bool = False           # args[0] is the owning instance
+
+
+@dataclass
+class _Alloc:
+    label: str
+    nbytes: int
+    leaves_left: int = 0           # leaf-lifetime bookkeeping
+
+
+@dataclass
+class _OwnerLedger:
+    """Live allocations of one owner (engine/trainer/None=module),
+    split by pool."""
+
+    pools: Dict[str, Dict[int, _Alloc]] = field(default_factory=dict)
+    peak: Dict[str, int] = field(default_factory=dict)
+
+
+_STATE_LOCK = threading.Lock()
+_SITES: Dict[str, PoolSpec] = {}
+_LEDGERS: Dict[object, _OwnerLedger] = {}
+# (site, owner token, signature) -> bytes: the projection memo — a
+# repeat allocation of a known signature is budget-checked BEFORE it
+# runs even without a project_fn.  The OWNER is part of the key: two
+# engines can share a signature (same slots/draft/grid args) while
+# their configs mint very different trees — one engine's bytes must
+# never project another's.
+_PROJ: Dict[tuple, int] = {}
+_AIDS = itertools.count(1)
+_TOKENS = itertools.count(1)
+_IN_ALLOC = threading.local()      # re-entrancy guard: outermost wins
+
+
+def register_site(spec: PoolSpec) -> PoolSpec:
+    with _STATE_LOCK:
+        _SITES[spec.site] = spec
+    return spec
+
+
+def sites() -> Tuple[str, ...]:
+    """Registered allocator sites (populated at import of annotated
+    modules)."""
+    with _STATE_LOCK:
+        return tuple(sorted(_SITES))
+
+
+def reset() -> None:
+    """Forget every ledger and projection (test isolation)."""
+    with _STATE_LOCK:
+        _RELEASES.clear()
+        _LEDGERS.clear()
+        _PROJ.clear()
+
+
+def tree_bytes(tree) -> int:
+    """Total device bytes of a pytree's array leaves — pure host
+    metadata (shape × itemsize), no sync.  ShapeDtypeStructs count like
+    arrays, so eval_shape output projects for free."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return total
+
+
+def _purge_owner(tok) -> None:
+    with _STATE_LOCK:
+        _LEDGERS.pop(tok, None)
+        # The projection memo is owner-keyed too: a long-lived armed
+        # process churning engines must not accumulate dead owners'
+        # entries (the leak-catcher must not itself leak).
+        for k in [k for k in _PROJ if k[1] == tok]:
+            del _PROJ[k]
+
+
+def _owner_token(x) -> object:
+    """Stable ledger key for an allocation's owning instance, with a
+    finalizer purging the ledger at owner gc (the compilecheck
+    instance-token idiom: ``id()`` alone would merge a dead engine's
+    ledger into whatever reuses its address).  Attachment is locked:
+    a gateway handler thread's validate_request and the driver's
+    first allocation may both mint the first token, and a lost race
+    would split one engine's ledger over two keys."""
+    if x is None:
+        return None
+    tok = getattr(x, "__ttd_mc_token__", None)
+    if tok is not None:
+        return ("tok", tok)
+    with _STATE_LOCK:
+        tok = getattr(x, "__ttd_mc_token__", None)
+        if tok is not None:
+            return ("tok", tok)
+        try:
+            tok = next(_TOKENS)
+            object.__setattr__(x, "__ttd_mc_token__", tok)
+        except (AttributeError, TypeError):
+            return ("id", type(x).__name__, id(x))
+    entry = ("tok", tok)
+    try:
+        weakref.finalize(x, _purge_owner, entry)
+    except TypeError:              # pragma: no cover - not weakref-able
+        pass
+    return entry
+
+
+# -- ledger ----------------------------------------------------------------
+
+
+def live_bytes(owner=None, pool: Optional[str] = None) -> int:
+    """Live charged bytes — for one owner instance (pass the object),
+    one pool name, both, or everything (``owner=None`` sums every
+    owner, module-level allocations included)."""
+    tok = _owner_token(owner) if owner is not None else None
+    total = 0
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        for otok, ledger in _LEDGERS.items():
+            if owner is not None and otok != tok:
+                continue
+            for pname, allocs in ledger.pools.items():
+                if pool is not None and pname != pool:
+                    continue
+                total += sum(a.nbytes for a in allocs.values())
+    return total
+
+
+def _live_tok(tok) -> int:
+    """Live bytes of ONE ledger key (the wrapper's budget-check read:
+    ``tok`` may be None for module-level allocators, which
+    ``live_bytes(owner=None)`` cannot express)."""
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        ledger = _LEDGERS.get(tok)
+        if ledger is None:
+            return 0
+        return sum(a.nbytes for allocs in ledger.pools.values()
+                   for a in allocs.values())
+
+
+def _replaceable_bytes(tok, pool: str, site: str) -> int:
+    """Bytes of the owner-lifetime charge a same-site allocation is
+    about to REPLACE (``_charge`` deletes it) — the pre-allocation
+    budget check must not count both the old pool and its rebuild, or
+    any rebuild with budget < 2x the pool spuriously raises."""
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        ledger = _LEDGERS.get(tok)
+        if ledger is None:
+            return 0
+        allocs = ledger.pools.get(pool) or {}
+        return sum(a.nbytes for a in allocs.values()
+                   if a.label == site)
+
+
+def live_by_pool() -> Dict[str, float]:
+    """``{pool: live_bytes}`` across every owner — the
+    ``ttd_engine_hbm_bytes{pool=...}`` gauge family's source (and the
+    per-worker stats-frame payload)."""
+    out: Dict[str, float] = {}
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        for ledger in _LEDGERS.values():
+            for pname, allocs in ledger.pools.items():
+                if allocs:
+                    out[pname] = out.get(pname, 0.0) + float(
+                        sum(a.nbytes for a in allocs.values()))
+    return out
+
+
+def peak_by_pool() -> Dict[str, float]:
+    """``{pool: peak_live_bytes}`` across owners (forensics)."""
+    out: Dict[str, float] = {}
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        for ledger in _LEDGERS.values():
+            for pname, peak in ledger.peak.items():
+                out[pname] = max(out.get(pname, 0.0), float(peak))
+    return out
+
+
+def _live_set_locked(tok) -> List[tuple]:
+    ledger = _LEDGERS.get(tok)
+    if ledger is None:
+        return []
+    out = []
+    for pname, allocs in ledger.pools.items():
+        for a in allocs.values():
+            out.append((pname, a.label, a.nbytes))
+    return out
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.1f} {unit}" if unit != "B"
+                    else f"{int(n)} {unit}")
+        n /= 1024
+    return f"{n:.1f} GiB"          # pragma: no cover - loop returns
+
+
+def _budget_error(site: str, pool: str, projected: int, budget: int,
+                  tok) -> MemoryBudgetError:
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        live = _live_set_locked(tok)
+    total = sum(b for _, _, b in live)
+    lines = [f"  live {p}/{label}: {_fmt_bytes(b)}"
+             for p, label, b in sorted(live, key=lambda t: -t[2])]
+    listing = "\n".join(lines) or "  (no live allocations)"
+    return MemoryBudgetError(
+        f"memory budget exceeded at allocator '{site}': allocating "
+        f"{_fmt_bytes(projected)} into pool '{pool}' would put the "
+        f"owner at {_fmt_bytes(total + projected)} live, over its "
+        f"declared budget of {_fmt_bytes(budget)}.  The offending "
+        f"allocation against the live set:\n{listing}\n"
+        f"Shrink the pool (e.g. --kv-pool-blocks), raise the declared "
+        f"budget, or find the leak in the listing above")
+
+
+# Leaf finalizers run at gc time, which any allocation can trigger —
+# including allocations INSIDE a _STATE_LOCK section (a dict insert in
+# _charge).  A finalizer taking _STATE_LOCK there would self-deadlock,
+# so finalizers only APPEND to this lock-free deque; every ledger
+# reader/writer drains it under the lock first.
+from collections import deque as _deque
+
+_RELEASES: "_deque" = _deque()
+
+
+def _release(tok, pool: str, aid: int, nbytes: int) -> None:
+    _RELEASES.append((tok, pool, aid, nbytes))
+
+
+def _drain_releases_locked() -> None:
+    """Apply queued leaf releases (caller holds ``_STATE_LOCK``)."""
+    while True:
+        try:
+            tok, pool, aid, nbytes = _RELEASES.popleft()
+        except IndexError:
+            return
+        ledger = _LEDGERS.get(tok)
+        if ledger is None:
+            continue
+        allocs = ledger.pools.get(pool)
+        if allocs is None:
+            continue
+        a = allocs.get(aid)
+        if a is None:
+            continue
+        a.nbytes = max(0, a.nbytes - nbytes)
+        a.leaves_left -= 1
+        if a.leaves_left <= 0 or a.nbytes == 0:
+            del allocs[aid]
+
+
+def _charge(tok, pool: str, site: str, nbytes: int, result,
+            lifetime: str) -> None:
+    """Record one allocation.  ``lifetime="leaf"`` registers a
+    finalizer per minted leaf (released as the buffers die);
+    ``"owner"`` pins the charge until the owner dies — a SAME-SITE
+    owner-lifetime allocation replaces the previous one (a rebuilt
+    pool must not double-count)."""
+    import jax
+
+    aid = next(_AIDS)
+    label = site if lifetime == "owner" else f"{site}#{aid}"
+    leaves = []
+    if lifetime == "leaf":
+        leaves = [leaf for leaf in jax.tree_util.tree_leaves(result)
+                  if getattr(leaf, "shape", None) is not None]
+    with _STATE_LOCK:
+        _drain_releases_locked()
+        ledger = _LEDGERS.setdefault(tok, _OwnerLedger())
+        allocs = ledger.pools.setdefault(pool, {})
+        if lifetime == "owner":
+            for old_aid in [k for k, a in allocs.items()
+                            if a.label == label]:
+                del allocs[old_aid]
+        allocs[aid] = _Alloc(label=label, nbytes=nbytes,
+                             leaves_left=len(leaves) or 1)
+        live = sum(a.nbytes for a in allocs.values())
+        ledger.peak[pool] = max(ledger.peak.get(pool, 0), live)
+    if lifetime == "leaf":
+        import numpy as np
+
+        for leaf in leaves:
+            lb = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            try:
+                weakref.finalize(leaf, _release, tok, pool, aid, lb)
+            except TypeError:      # pragma: no cover - exotic leaf type
+                pass
+
+
+# -- the armed wrapper -----------------------------------------------------
+
+
+def _sig_entry(x) -> object:
+    """Hashable size-determining key for one allocator argument: array
+    leaves key by (shape, dtype) — two calls with the same signature
+    mint the same bytes, which is exactly what the projection memo
+    needs."""
+    if x is None or type(x) in (bool, int, float, str, bytes):
+        return ("v", x)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    import jax
+
+    try:
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        if len(leaves) == 1 and leaves[0] is x:
+            # Unregistered object: its own pytree leaf — recursing
+            # would never terminate.
+            return ("obj", type(x).__name__)
+        return (str(treedef),
+                tuple(_sig_entry(leaf) for leaf in leaves))
+    except Exception:              # noqa: BLE001 - opaque arg
+        return ("obj", type(x).__name__)
+
+
+def _signature(args, kwargs, method: bool) -> tuple:
+    sig = [_sig_entry(a) for a in (args[1:] if method else args)]
+    for k in sorted(kwargs):
+        sig.append((k, _sig_entry(kwargs[k])))
+    return tuple(sig)
+
+
+def _resolve(value, args, kwargs):
+    return value(*args, **kwargs) if callable(value) else value
+
+
+def _default_site(fn) -> str:
+    mod = getattr(fn, "__module__", "") or ""
+    qual = getattr(fn, "__qualname__", None) or getattr(
+        fn, "__name__", None) or repr(fn)
+    return f"{mod.rsplit('.', 1)[-1]}.{qual}"
+
+
+def _wrap(fn, spec: PoolSpec):
+    import functools
+
+    site = spec.site
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _vetoed() or getattr(_IN_ALLOC, "depth", 0):
+            # Vetoed live, or a nested annotated allocator under an
+            # outer one (``_admission_cache_1`` → ``_fresh_cache``):
+            # the OUTERMOST call owns the charge.
+            return fn(*args, **kwargs)
+        owner = args[0] if spec.method and args else None
+        tok = _owner_token(owner)
+        pool = str(_resolve(spec.pool, args, kwargs))
+        lifetime = str(_resolve(spec.lifetime, args, kwargs))
+        budget = (spec.budget_fn(*args, **kwargs)
+                  if spec.budget_fn is not None else spec.budget_bytes)
+        sig = _signature(args, kwargs, spec.method)
+        _IN_ALLOC.depth = 1
+        try:
+            # Projected bytes BEFORE the allocation: the spec's
+            # project_fn (the engine's memoized cache eval_shape) or
+            # the memo of a previous identical signature.  The first
+            # call of an unprojectable site charges after the fact —
+            # still ahead of the cumulative OOM.
+            projected = _PROJ.get((site, tok, sig))
+            if projected is None and spec.project_fn is not None:
+                try:
+                    projected = int(spec.project_fn(*args, **kwargs))
+                except Exception:  # noqa: BLE001 - projection must
+                    projected = None  # never break the allocator
+            if projected is not None and budget is not None:
+                live = _live_tok(tok)
+                if lifetime == "owner":
+                    # A rebuild replaces the previous same-site
+                    # charge — check the budget against the NET.
+                    live -= _replaceable_bytes(tok, pool, site)
+                if live + projected > budget:
+                    raise _budget_error(site, pool, projected, budget,
+                                        tok)
+            span = events.span("memory/" + pool, pool=pool, site=site,
+                               bytes=int(projected or 0), live=0,
+                               budget=int(budget or 0))
+            with span:
+                result = fn(*args, **kwargs)
+                actual = (projected if projected is not None
+                          else tree_bytes(result))
+                _PROJ.setdefault((site, tok, sig), actual)
+                _charge(tok, pool, site, actual, result, lifetime)
+                live = _live_tok(tok)
+                # The span records at exit: fill in what the
+                # allocation actually cost and where the pool landed.
+                attrs = getattr(span, "_attrs", None)
+                if attrs is not None:
+                    attrs["bytes"] = int(actual)
+                    attrs["live"] = int(live)
+            if budget is not None:
+                if live > budget:
+                    # Unprojectable first call that overran: the
+                    # charge stands (the buffers exist), the error
+                    # surfaces NOW — before the next allocation and
+                    # long before an opaque XLA OOM.
+                    raise _budget_error(site, pool, actual, budget,
+                                        tok)
+                if live > 0.9 * budget:
+                    events.instant("memory/near_miss", pool=pool,
+                                   site=site, live=int(live),
+                                   budget=int(budget))
+            return result
+        finally:
+            _IN_ALLOC.depth = 0
+
+    wrapper.__ttd_memory_pool__ = spec.pool
+    wrapper.__ttd_memcheck_wrapped__ = True
+    return wrapper
+
+
+def track(owner, pool: str, tree, label: str,
+          budget: Optional[int] = None) -> None:
+    """Explicitly charge a STORED tree (the preload prefix pairs: held
+    as minted, copied per admission, freed at LRU eviction — exactly
+    the leaf-lifetime contract).  No-op unless the sanitizer is armed.
+    Raises ``MemoryBudgetError`` when the charge lands over ``budget``
+    (the store already happened; the error stops the leak's growth)."""
+    if not armed() or _vetoed():
+        return
+    tok = _owner_token(owner)
+    nbytes = tree_bytes(tree)
+    _charge(tok, pool, f"track:{label}", nbytes, tree, "leaf")
+    events.instant("memory/" + pool, pool=pool, site=f"track:{label}",
+                   bytes=int(nbytes),
+                   live=int(live_bytes(owner=owner, pool=pool)))
+    if budget is not None and live_bytes(owner=owner) > budget:
+        raise _budget_error("track:" + label, pool, nbytes, budget, tok)
+
+
+def annotate(fn, *, pool, budget_bytes=None, budget_fn=None,
+             project_fn=None, lifetime="owner",
+             site: Optional[str] = None):
+    """Implementation of ``registry.memory_budget`` (deferred there to
+    keep the registry import-light)."""
+    import inspect
+
+    name = site or _default_site(fn)
+    try:
+        params = list(inspect.signature(fn).parameters)
+    except (ValueError, TypeError):    # pragma: no cover - C callables
+        params = []
+    spec = register_site(PoolSpec(
+        site=name, pool=pool, budget_bytes=budget_bytes,
+        budget_fn=budget_fn, project_fn=project_fn, lifetime=lifetime,
+        method=bool(params) and params[0] in ("self", "cls")))
+    try:
+        fn.__ttd_memory_pool__ = pool
+    except (AttributeError, TypeError):  # pragma: no cover
+        pass
+    if not armed():
+        return fn
+    return _wrap(fn, spec)
+
+
+# -- static checker --------------------------------------------------------
+
+#: Host-side device-allocation calls the hot-module rule audits (numpy
+#: allocations are host memory; ``jnp.asarray`` of small host lists is
+#: table/mask plumbing, deliberately out of scope).
+_ALLOC_CALLS = {"jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty",
+                "jax.device_put", "device_put"}
+
+#: Files that MUST declare at least one ``@memory_budget`` pool — the
+#: big-allocator modules the ROADMAP names (full package-relative
+#: paths: a tools/bench_serving.py must not match serving.py's rule).
+_REQUIRED_HOT = (
+    os.path.join("tensorflow_train_distributed_tpu", "serving.py"),
+    os.path.join("tensorflow_train_distributed_tpu", "training",
+                 "trainer.py"),
+)
+
+
+def _has_decorator(fn, name: str) -> Optional[ast.expr]:
+    for dec in fn.decorator_list:
+        dname = _decorator_name(dec)
+        if dname and dname.split(".")[-1] == name:
+            return dec
+    return None
+
+
+def _kwarg(call: Optional[ast.expr], name: str) -> Optional[ast.expr]:
+    if not isinstance(call, ast.Call):
+        return None
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _literal_ints(node: Optional[ast.expr]) -> Optional[tuple]:
+    if node is None:
+        return ()
+    elts = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+            else [node])
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.append(e.value)
+        else:
+            return None
+    return tuple(out)
+
+
+def _is_alloc_call(node: ast.Call) -> bool:
+    name = _dotted(node.func) or ""
+    short = name.split(".")[-1]
+    return (name in _ALLOC_CALLS
+            or (short in ("zeros", "ones", "full", "empty")
+                and name.startswith(("jnp.", "jax.numpy."))))
+
+
+def _func_defs(tree: ast.Module) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _called_names(fn: ast.FunctionDef) -> set:
+    """Names ``fn``'s body calls directly (``helper(...)``) or through
+    an instance (``self.helper(...)``) — the intra-module sanction
+    closure's edges."""
+    out = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif isinstance(f, ast.Attribute):
+            out.add(f.attr)
+    return out
+
+
+def _sanctioned_functions(tree: ast.Module) -> set:
+    """FunctionDef nodes (by id) whose device allocations are owned:
+    ``@memory_budget`` allocators, jit programs, ``eval_shape``
+    thunks, and everything those reach through intra-module calls —
+    nested defs inherit their enclosing def's sanction."""
+    defs = _func_defs(tree)
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for d in defs:
+        by_name.setdefault(d.name, []).append(d)
+    seeds = set()
+    eval_shape_args = set()
+    seam_args = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            if name.split(".")[-1] == "eval_shape":
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        eval_shape_args.add(a.id)
+            if name.endswith("compilecheck.jit") or name == "jit":
+                if node.args and isinstance(node.args[0], ast.Name):
+                    seam_args.add(node.args[0].id)
+    for d in defs:
+        if (_has_decorator(d, "memory_budget") is not None
+                or _has_decorator(d, "compile_site") is not None
+                or _is_jit_decorated(d)
+                or d.name in eval_shape_args
+                or d.name in seam_args):
+            seeds.add(id(d))
+    # Nested defs inherit; calls propagate (fixpoint over names).
+    parents: Dict[int, Optional[int]] = {}
+    for d in defs:
+        for child in ast.walk(d):
+            if child is not d and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parents.setdefault(id(child), id(d))
+    sanctioned = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for d in defs:
+            if id(d) in sanctioned:
+                continue
+            p = parents.get(id(d))
+            if p is not None and p in sanctioned:
+                sanctioned.add(id(d))
+                changed = True
+        for d in defs:
+            if id(d) not in sanctioned:
+                continue
+            for callee in _called_names(d):
+                for target in by_name.get(callee, ()):
+                    if id(target) not in sanctioned:
+                        sanctioned.add(id(target))
+                        changed = True
+    return sanctioned
+
+
+def _enclosing_chain(tree: ast.Module) -> Dict[int, List[ast.AST]]:
+    """node id -> chain of enclosing FunctionDefs (innermost last)."""
+    chains: Dict[int, List[ast.AST]] = {}
+
+    def visit(node, chain):
+        for child in ast.iter_child_nodes(node):
+            nchain = chain
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                nchain = chain + [child]
+            chains[id(child)] = nchain
+            visit(child, nchain)
+
+    chains[id(tree)] = []
+    visit(tree, [])
+    return chains
+
+
+def _unbudgeted_alloc_findings(tree: ast.Module,
+                               path: str) -> List[Finding]:
+    sanctioned = _sanctioned_functions(tree)
+    chains = _enclosing_chain(tree)
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_alloc_call(node)):
+            continue
+        chain = chains.get(id(node), [])
+        if any(id(d) in sanctioned for d in chain):
+            continue
+        where = chain[-1].name if chain else "<module scope>"
+        out.append(Finding(
+            CHECKER, path, node.lineno,
+            f"un-annotated device allocation: "
+            f"{_dotted(node.func)}(...) in '{where}' is not reachable "
+            f"from any @memory_budget allocator, jit program, or "
+            f"eval_shape thunk — declare the pool it belongs to "
+            f"(runtime/lint/registry.memory_budget) so the HBM "
+            f"sanitizer and ttd_engine_hbm_bytes can see it"))
+    return out
+
+
+def _expr_path(node) -> Optional[str]:
+    """Dotted source form of a Name/Attribute chain (``self._cache``),
+    None for anything the alias rule cannot compare."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_path(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _donating_programs(tree: ast.Module) -> Dict[str, tuple]:
+    """name -> (donated argnums, is_method) for every
+    ``@compile_site(donates=...)`` function in the module."""
+    out: Dict[str, tuple] = {}
+    for d in _func_defs(tree):
+        dec = _has_decorator(d, "compile_site")
+        if dec is None:
+            continue
+        donates = _literal_ints(_kwarg(dec, "donates"))
+        if not donates:
+            continue
+        args = d.args.posonlyargs + d.args.args
+        is_method = bool(args) and args[0].arg in ("self", "cls")
+        out[d.name] = (donates, is_method)
+    return out
+
+
+def _assign_targets(stmt) -> set:
+    """Dotted paths a statement rebinds (Assign targets, tuple
+    elements included)."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out = set()
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                p = _expr_path(e)
+                if p:
+                    out.add(p)
+        else:
+            p = _expr_path(t)
+            if p:
+                out.add(p)
+    return out
+
+
+def _donation_alias_findings(tree: ast.Module,
+                             path: str) -> List[Finding]:
+    """Call-site audit of declared donations: a donated argument that
+    is a bare name/attribute and is NOT rebound by the same statement
+    (and not returned) stays live behind the donation — XLA keeps both
+    buffers and peak HBM doubles."""
+    programs = _donating_programs(tree)
+    if not programs:
+        return []
+    out: List[Finding] = []
+    for stmt in ast.walk(tree):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.Expr,
+                                 ast.Return)):
+            continue
+        value = getattr(stmt, "value", None)
+        if not isinstance(value, ast.Call):
+            continue
+        f = value.func
+        callee = None
+        shift = 0
+        if isinstance(f, ast.Name) and f.id in programs:
+            callee = f.id
+        elif (isinstance(f, ast.Attribute) and f.attr in programs
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")):
+            callee = f.attr
+            shift = 1 if programs[f.attr][1] else 0
+        if callee is None:
+            continue
+        if isinstance(stmt, ast.Return):
+            continue               # ownership transfers to the caller
+        donates, _ = programs[callee]
+        rebound = _assign_targets(stmt)
+        arg_paths = [_expr_path(a) for a in value.args]
+        for argnum in donates:
+            idx = argnum - shift
+            if not 0 <= idx < len(arg_paths):
+                continue
+            p = arg_paths[idx]
+            if p is None:
+                continue
+            # Aliasing inside the call: the same buffer donated AND
+            # passed live in another position.
+            if arg_paths.count(p) > 1:
+                out.append(Finding(
+                    CHECKER, path, stmt.lineno,
+                    f"donation-defeating alias: '{p}' is passed to "
+                    f"'{callee}' both in donated position {argnum} "
+                    f"and again un-donated — XLA cannot reuse the "
+                    f"buffer and peak HBM doubles"))
+                continue
+            if "." in p and p not in rebound:
+                out.append(Finding(
+                    CHECKER, path, stmt.lineno,
+                    f"donation-defeating alias: '{p}' is donated to "
+                    f"'{callee}' (donates={tuple(donates)}) but stays "
+                    f"bound after the call — rebind it from the "
+                    f"result ('{p} = ...') or the donation is "
+                    f"defeated and peak HBM silently doubles"))
+    return out
+
+
+def _declaration_findings(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for d in _func_defs(tree):
+        dec = _has_decorator(d, "memory_budget")
+        if dec is None:
+            continue
+        if (_kwarg(dec, "budget_bytes") is None
+                and _kwarg(dec, "budget_fn") is None):
+            out.append(Finding(
+                CHECKER, path, d.lineno,
+                f"'{d.name}': @memory_budget declares a pool but no "
+                f"budget — add budget_bytes=... or budget_fn=... (a "
+                f"pool without a budget is a gauge, not a "
+                f"discipline; a budget_fn may return None to "
+                f"track-only at runtime, but the declaration must "
+                f"say so)"))
+        if _kwarg(dec, "pool") is None:
+            out.append(Finding(
+                CHECKER, path, d.lineno,
+                f"'{d.name}': @memory_budget without pool=... — the "
+                f"ledger, the gauges, and the trace spans all key on "
+                f"the pool name"))
+    return out
+
+
+def _module_is_hot(tree: ast.Module) -> bool:
+    return any(_has_decorator(d, "memory_budget") is not None
+               for d in _func_defs(tree))
+
+
+@register_checker(CHECKER)
+def check(tree: ast.Module, lines, path: str, ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    hot = _module_is_hot(tree)
+    required = any(path.endswith(req) for req in _REQUIRED_HOT)
+    if required and not hot:
+        findings.append(Finding(
+            CHECKER, path, 1,
+            "registered hot allocator module declares no "
+            "@memory_budget pool — the big device allocators here "
+            "must be budget-annotated (see README 'Memory "
+            "discipline')"))
+    if hot:
+        findings.extend(_unbudgeted_alloc_findings(tree, path))
+        findings.extend(_declaration_findings(tree, path))
+    # The donation-alias audit applies wherever donating programs are
+    # declared (compile_site's donates literal is the contract).
+    findings.extend(_donation_alias_findings(tree, path))
+    return findings

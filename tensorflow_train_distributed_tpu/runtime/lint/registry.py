@@ -193,6 +193,52 @@ def compile_site(*, buckets=(), donates=(), statics=(), static_names=(),
     return deco
 
 
+def memory_budget(*, pool, budget_bytes: Optional[int] = None,
+                  budget_fn: Optional[Callable] = None,
+                  project_fn: Optional[Callable] = None,
+                  lifetime="owner",
+                  site: Optional[str] = None) -> Callable:
+    """Declare a device-memory ALLOCATOR's pool and budget (the third
+    lint vertical — memory — mirroring ``@compile_site`` for compiles).
+
+    - ``pool``: the pool name the allocation charges (str, or a
+      callable over the allocator's args for multi-pool allocators —
+      the engine's ``_fresh_cache`` mints grid pools AND batch-1
+      prefill caches);
+    - ``budget_bytes`` / ``budget_fn``: the owner's HBM budget in
+      bytes (a callable receives the allocator's args; returning None
+      means track-only — gauges and spans, no enforcement).  One of
+      the two is REQUIRED (the static checker flags a budget-less
+      declaration);
+    - ``project_fn``: projected bytes of the allocation BEFORE it runs
+      (the engine's memoized cache ``eval_shape``) — with it, an
+      over-budget allocation raises ``MemoryBudgetError`` before any
+      buffer exists; without it, the first call of each signature
+      charges after the fact and later calls pre-check off the memo;
+    - ``lifetime``: ``"owner"`` (charge lives until the owning
+      instance dies — the constant pools) or ``"leaf"`` (released as
+      the minted buffers die — transient allocations); callable for
+      allocators that mint both.
+
+    Like ``@thread_role`` and ``@compile_site``, the declaration is
+    free when the sanitizer (``TTD_MEMCHECK=1``) is unarmed: the
+    function comes back untouched.
+    """
+    def deco(fn):
+        # Deferred import: the registry stays import-light (the
+        # compile_site convention).
+        from tensorflow_train_distributed_tpu.runtime.lint import (
+            memcheck,
+        )
+
+        return memcheck.annotate(
+            fn, pool=pool, budget_bytes=budget_bytes,
+            budget_fn=budget_fn, project_fn=project_fn,
+            lifetime=lifetime, site=site)
+
+    return deco
+
+
 def _normalize_spec(attr: str, spec) -> Tuple[Optional[str], Tuple[str, ...]]:
     """-> (lock_name_or_None, owner_roles)."""
     if isinstance(spec, str):

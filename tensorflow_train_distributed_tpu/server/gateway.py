@@ -470,7 +470,8 @@ class ServingGateway:
                 kv_prefix_hit_tokens_fn=self.pool.kv_prefix_hit_tokens,
                 kv_evictions_fn=self.pool.kv_evictions,
                 kv_pool_bytes_fn=self.pool.kv_pool_bytes,
-                replica_rss_fn=self.pool.replica_rss)
+                replica_rss_fn=self.pool.replica_rss,
+                hbm_bytes_fn=self.pool.hbm_by_pool)
         else:
             one = [self.engine]
             self.metrics = GatewayMetrics(

@@ -24,7 +24,10 @@ import math
 import threading
 from typing import Callable, Optional, Sequence
 
-from tensorflow_train_distributed_tpu.runtime.lint import compilecheck
+from tensorflow_train_distributed_tpu.runtime.lint import (
+    compilecheck,
+    memcheck,
+)
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     concurrency_guarded,
 )
@@ -283,7 +286,8 @@ class GatewayMetrics:
                  kv_evictions_fn: Optional[Callable[[], int]] = None,
                  kv_pool_bytes_fn: Optional[Callable[[], int]] = None,
                  slots_total_fn: Optional[Callable[[], int]] = None,
-                 replica_rss_fn: Optional[Callable[[], dict]] = None):
+                 replica_rss_fn: Optional[Callable[[], dict]] = None,
+                 hbm_bytes_fn: Optional[Callable[[], dict]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -412,6 +416,20 @@ class GatewayMetrics:
             "Device bytes held by the paged KV block pools "
             "(0 = linear cache).",
             fn=kv_pool_bytes_fn)
+        # Memory discipline (memcheck, the third lint vertical): live
+        # bytes per DECLARED pool — the @memory_budget ledger sampled
+        # at scrape time, labeled by pool name (kv_pool, draft_pool,
+        # prefill_cache, prefix_cache, trainer_state; under
+        # --replica-procs each subprocess worker's pools render as
+        # "<replica>/<pool>", so fleet memory is visible per worker).
+        # No series unless TTD_MEMCHECK=1 arms the sanitizer — the
+        # truthful constant, like ttd_engine_compiles_total.
+        self.hbm_bytes = r.labeled_gauge(
+            "ttd_engine_hbm_bytes",
+            "Live device bytes per declared @memory_budget pool "
+            "(no series unless TTD_MEMCHECK=1).", "pool",
+            fn=(hbm_bytes_fn if hbm_bytes_fn is not None
+                else memcheck.live_by_pool))
         # Compile discipline: XLA compilations observed at the
         # package's @compile_site-instrumented jit sites, process-wide
         # (every engine program, the trainer's step seam, the batch

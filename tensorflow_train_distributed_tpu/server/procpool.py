@@ -121,6 +121,7 @@ class RemoteEngine:
     # read several fields per render.
     _GUARDED_BY = {
         "_gauges": ("_lock",),
+        "_hbm": ("_lock",),
         "_rss": ("_lock",),
         "slots": (None, "reader", "main"),
         "kv_block_size": (None, "reader", "main"),
@@ -139,6 +140,7 @@ class RemoteEngine:
         self.pid: Optional[int] = None
         self._lock = threading.Lock()
         self._gauges: dict = {}
+        self._hbm: dict = {}
         self._rss = 0
 
     @thread_role("reader")
@@ -157,6 +159,7 @@ class RemoteEngine:
     def update_stats(self, body: dict) -> None:
         with self._lock:
             self._gauges = dict(body.get("gauges") or {})
+            self._hbm = dict(body.get("hbm") or {})
             self._rss = int(body.get("rss") or 0)
 
     def _g(self, name: str) -> float:
@@ -181,6 +184,17 @@ class RemoteEngine:
 
     def kv_pool_bytes(self) -> float:
         return self._g("kv_pool_bytes")
+
+    def kv_bytes_in_use(self) -> float:
+        return self._g("kv_bytes_in_use")
+
+    def hbm_by_pool(self) -> dict:
+        """The worker's memcheck ledger from its latest stats frame
+        (``{pool: live_bytes}``; empty unless the worker armed
+        TTD_MEMCHECK) — the per-worker half of the
+        ``ttd_engine_hbm_bytes`` gauge family."""
+        with self._lock:
+            return dict(self._hbm)
 
     def overlap_ratio(self) -> float:
         return self._g("overlap_ratio")
@@ -378,6 +392,22 @@ class ProcDriver:
         except proto.ProtocolError as e:
             self._fail_protocol(e)
         except (OSError, ValueError) as e:
+            # A SIGKILLed/OOMed worker can tear its socket down with
+            # data still in flight: the parent reads ECONNRESET
+            # instead of a clean EOF.  That is the DEATH's symptom,
+            # not a protocol violation by the worker — if there is a
+            # corpse (brief wait: the reset and the exit race by
+            # microseconds), classify it like the EOF it stands for
+            # ("killed by signal 9" in /healthz), never "protocol".
+            rc = None
+            if isinstance(e, OSError) and self._proc is not None:
+                try:
+                    rc = self._proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    rc = None
+            if rc is not None:
+                self._on_eof()
+                return
             self._fail_protocol(proto.ProtocolError(
                 f"frame stream error: {type(e).__name__}: {e}"))
 
@@ -599,16 +629,42 @@ class ProcDriver:
     def failure(self) -> Optional[BaseException]:
         return self._failed
 
+    def _corpse_rc(self) -> Optional[int]:
+        """The worker's wait status, live: the reader thread's
+        ``_on_eof`` records it durably at EOF, but the kernel has it
+        the MOMENT the process dies — ``poll()`` here lets the pool
+        monitor classify a SIGKILL on its very next tick instead of
+        reporting the generic "vanished" until the frame stream
+        drains (a real flake under load: the chaos gate read
+        /healthz between the death and the reader's EOF and missed
+        the "killed by signal 9" classification)."""
+        if self._returncode is not None:
+            return self._returncode
+        p = self._proc
+        return p.poll() if p is not None else None
+
     def vanished(self) -> bool:
-        return self._vanished
+        """Abrupt worker death: durable after the reader's EOF, and
+        detected LIVE from the wait status so classification never
+        lags the corpse.  Only a NONZERO/signal status counts live —
+        a clean exit is "vanished" only if the reader's EOF confirms
+        the BYE never came (an orderly drain's worker exits 0 moments
+        before its BYE frame is processed, and that window must never
+        classify a clean scale-down as a death)."""
+        if self._vanished:
+            return True
+        if self._drained or self._failed is not None:
+            return False
+        rc = self._corpse_rc()
+        return rc is not None and rc != 0
 
     def vanish_reason(self) -> Optional[str]:
         """How the worker went away, from its wait status — the
         monitor folds this into the replica's dead_reason so /healthz
         says "killed by signal 9", not just "vanished"."""
-        if not self._vanished:
+        if not self.vanished():
             return None
-        rc = self._returncode
+        rc = self._corpse_rc()
         pid = self._engine.pid or (self._proc.pid if self._proc
                                    else None)
         if rc is not None and rc < 0:
@@ -621,8 +677,8 @@ class ProcDriver:
             return "protocol"
         if self._failed is not None:
             return "worker_error"
-        if self._vanished:
-            rc = self._returncode
+        if self.vanished():
+            rc = self._corpse_rc()
             return "killed" if rc is not None and rc < 0 else "exited"
         return None
 

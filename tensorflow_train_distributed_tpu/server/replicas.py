@@ -377,6 +377,22 @@ class ReplicaPool:
                 d["kv_blocks_total"] = total
                 d["kv_blocks_free"] = (total
                                        - rep.engine.kv_blocks_in_use())
+                # Bytes next to blocks: the same capacity signal in
+                # the unit budgets reason in (per replica — under
+                # --replica-procs each worker reports its own pool
+                # from its stats frames instead of dropping it).
+                # kv_pool_bytes is the constant capacity,
+                # kv_bytes_in_use the referenced-blocks occupancy.
+                for name in ("kv_pool_bytes", "kv_bytes_in_use"):
+                    fn = getattr(rep.engine, name, None)
+                    v = fn() if fn is not None else 0
+                    if v:
+                        d[name] = v
+            hbm_fn = getattr(rep.engine, "hbm_by_pool", None)
+            if hbm_fn is not None:
+                hbm = hbm_fn()
+                if hbm:
+                    d["hbm_bytes"] = hbm
             # Driver-specific extras: a subprocess replica's ProcDriver
             # reports pid/rss/protocol state here, so /healthz
             # classifies worker-level failures per replica.
@@ -426,6 +442,31 @@ class ReplicaPool:
 
     def kv_pool_bytes(self) -> float:
         return self._engine_stat("kv_pool_bytes")
+
+    def hbm_by_pool(self) -> dict:
+        """Live bytes per declared memcheck pool, for the labeled
+        ``ttd_engine_hbm_bytes{pool=...}`` gauge.  Subprocess replicas
+        report their own ledgers through stats frames — rendered as
+        ``<replica>/<pool>`` so fleet memory is visible PER WORKER;
+        in-process replicas all live in this process, whose global
+        ledger is the truth (summing per engine would double-count
+        nothing, but the process view already covers every engine)."""
+        out: dict = {}
+        remote = False
+        for rep in self._replicas:
+            fn = getattr(rep.engine, "hbm_by_pool", None)
+            if fn is None or not rep.usable():
+                continue
+            remote = True
+            for pool, v in fn().items():
+                out[f"{rep.idx}/{pool}"] = float(v)
+        if not remote:
+            from tensorflow_train_distributed_tpu.runtime.lint import (
+                memcheck,
+            )
+
+            out = memcheck.live_by_pool()
+        return out
 
     def replica_rss(self) -> dict:
         """Per-replica resident-set bytes (``{replica: bytes}``) for

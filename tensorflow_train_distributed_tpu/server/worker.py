@@ -50,6 +50,7 @@ import time
 from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime import events, faults
+from tensorflow_train_distributed_tpu.runtime.lint import memcheck
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     thread_role,
 )
@@ -203,6 +204,7 @@ _LLAMA_ENGINE_KWARGS = (
     "slots", "cache_len", "chunk", "temperature", "top_k", "top_p",
     "prefill_chunk", "prefill_budget", "overlap", "paged",
     "kv_block_size", "kv_pool_blocks", "prefix_cache_limit",
+    "hbm_budget_bytes",
 )
 
 
@@ -332,7 +334,8 @@ def _engine_gauges(engine) -> dict:
     out = {}
     for name in ("kv_blocks_total", "kv_blocks_in_use",
                  "kv_prefix_hit_tokens", "kv_evictions",
-                 "kv_pool_bytes", "overlap_ratio", "prefill_stall_s"):
+                 "kv_pool_bytes", "kv_bytes_in_use", "overlap_ratio",
+                 "prefill_stall_s"):
         fn = getattr(engine, name, None)
         if fn is None:
             continue
@@ -372,6 +375,12 @@ def _send_stats(driver: EngineDriver, engine, sender: proto.FrameSender,
         "draining": driver.is_draining(),
         "rss": rss_bytes(),
         "gauges": _engine_gauges(engine),
+        # Live bytes per declared memcheck pool in THIS process (empty
+        # unless TTD_MEMCHECK=1 armed the worker): the parent renders
+        # them as ttd_engine_hbm_bytes{pool="<replica>/<pool>"}, so
+        # --replica-procs fleets report memory per worker instead of
+        # silently dropping the engine-local view.
+        "hbm": memcheck.live_by_pool(),
         "events": batch,
     }
     if dropped:

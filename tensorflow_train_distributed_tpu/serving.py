@@ -138,10 +138,12 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from tensorflow_train_distributed_tpu.runtime import compat, events
+from tensorflow_train_distributed_tpu.runtime.lint import memcheck
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     compile_site,
     concurrency_guarded,
     dispatch_critical,
+    memory_budget,
     thread_role,
 )
 from tensorflow_train_distributed_tpu import serving_kv
@@ -281,7 +283,8 @@ class ServingEngine:
                  paged: Optional[bool] = None,
                  kv_block_size: int = 16,
                  kv_pool_blocks: Optional[int] = None,
-                 prefix_cache_limit: int = 32):
+                 prefix_cache_limit: int = 32,
+                 hbm_budget_bytes: Optional[int] = None):
         # MoeConfig has no window knob; getattr keeps one check covering
         # both decoder families.  kv_cache_int8 configs SERVE here (the
         # per-slot and paged caches both quantize with the linear-cache
@@ -321,6 +324,21 @@ class ServingEngine:
                 f"{config.max_positions}")
         self.eos_id = eos_id
         self.chunk = chunk
+        # HBM budget (memcheck, the third lint vertical): the byte
+        # ceiling this engine's declared pools — grid KV pools, staged
+        # prefill caches, stored prefix pairs — are held to.  None =
+        # track-only: the ``TTD_MEMCHECK=1`` sanitizer still ledgers
+        # every pool (the ttd_engine_hbm_bytes{pool=...} gauge feed)
+        # but never raises; with a budget set, the allocation that
+        # would exceed it raises MemoryBudgetError with the live set
+        # diffed, and validate_request refuses admissions whose
+        # projected bytes cannot fit (alongside the free-blocks
+        # check).
+        if hbm_budget_bytes is not None and hbm_budget_bytes < 1:
+            raise ValueError(
+                f"hbm_budget_bytes must be >= 1, got {hbm_budget_bytes}")
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._prefill_bytes_memo: Optional[int] = None
         # Dense-dispatch MoE prefill must run at the EXACT prompt
         # length: the router's per-group capacity is ⌈cf·k·S/E⌉ — a
         # bucket-padded S changes the capacity constant, so drop
@@ -619,6 +637,19 @@ class ServingEngine:
                 self._kv_pool_bytes += _pool_bytes(
                     self._cache_struct(self.slots, draft=True,
                                        grid=True))
+            # Per-block row bytes across layers (draft + int8 scale
+            # pools included): the host allocator's byte view of its
+            # own blocks, so block-count accounting (serving_kv) can
+            # be read in BYTES too — what admission and the memcheck
+            # gauges reason in.
+            self._kv_pool.bytes_per_block = (
+                self._kv_pool_bytes // (1 + self._kv_pool.n_blocks))
+        if self.hbm_budget_bytes is not None:
+            # Budgeted engines precompute the admission projection NOW:
+            # validate_request runs on gateway HANDLER threads, which
+            # must read a memoized int, never trace an eval_shape
+            # concurrently with the driver.
+            self._prefill_pair_bytes()
 
     def _ctx(self):
         """Mesh + logical-rules context for device calls (no-op unsharded).
@@ -1066,6 +1097,26 @@ class ServingEngine:
                     f"request needs {need} KV blocks "
                     f"(block_size={self.kv_block_size}) but the pool "
                     f"has {self._kv_pool.n_blocks}")
+        if self.hbm_budget_bytes is not None:
+            # Projected BYTES alongside the free-blocks check: this
+            # request's marginal device allocation is one batch-1
+            # prefill cache pair — refuse admission when the live
+            # ledger (pools, stored prefixes, in-flight prefills)
+            # plus that pair cannot fit the declared budget.  An
+            # engine whose POOL alone exceeds the budget is not
+            # screened here: the pool allocator itself raises
+            # MemoryBudgetError at first insert with the live set
+            # diffed, which is the clearer error for a sizing bug.
+            live = (memcheck.live_bytes(owner=self)
+                    if memcheck.armed() else 0)
+            projected = live + self._prefill_pair_bytes()
+            if projected > self.hbm_budget_bytes:
+                raise ValueError(
+                    f"admission needs a projected {projected} bytes "
+                    f"(live pools + one prefill cache pair) but "
+                    f"hbm_budget_bytes={self.hbm_budget_bytes} — "
+                    f"shrink --kv-pool-blocks/slots or raise the "
+                    f"budget")
         if (not self._exact_prefill and self.prefill_chunk is None
                 and not resume_from):
             # Catch at submit time: failing later inside run() would
@@ -1207,6 +1258,27 @@ class ServingEngine:
             self._cache_shapes[key] = shapes
         return shapes
 
+    # Memory discipline (ttd-lint memcheck + TTD_MEMCHECK=1): THE
+    # engine allocator — every cache tree this engine mints on device
+    # comes through here (or through _admission_cache_1 below, whose
+    # gather/copy paths mint the same batch-1 layout).  The pool split
+    # mirrors what an operator budgets: the slot-grid pools (target
+    # "kv_pool", draft "draft_pool") are owner-lifetime — allocated
+    # once, alive until the engine dies, exact in the gauges — while
+    # batch-1 prefill caches are leaf-lifetime transients (the charge
+    # is the admission-time budget gate; donation threads the buffers
+    # through the piece programs as successors the ledger cannot see).
+    # Projection comes from the memoized cache eval_shape, so an
+    # over-budget pool raises BEFORE any buffer exists.
+    @memory_budget(
+        pool=lambda self, batch, draft=False, grid=False:
+            (("draft_pool" if draft else "kv_pool") if grid
+             else ("draft_prefill" if draft else "prefill_cache")),
+        budget_fn=lambda self, *a, **k: self.hbm_budget_bytes,
+        project_fn=lambda self, batch, draft=False, grid=False:
+            memcheck.tree_bytes(self._cache_struct(batch, draft, grid)),
+        lifetime=lambda self, batch, draft=False, grid=False:
+            ("owner" if grid else "leaf"))
     def _fresh_cache(self, batch: int, draft: bool = False,
                      grid: bool = False):
         """Zeroed cache tree for ``batch`` rows (target or draft model;
@@ -1337,6 +1409,14 @@ class ServingEngine:
                 self._preloaded.pop(evicted_key, None)
             if self.paged:
                 self._preloaded[tuple(tokens)] = n
+        # The STORED pair is a held-as-minted device tree (copied per
+        # admission, freed at LRU eviction) — exactly the
+        # leaf-lifetime contract, so the memcheck ledger tracks the
+        # prefix store byte-exactly and an unbounded preload pattern
+        # trips the budget here instead of OOMing later.
+        memcheck.track(self, "prefix_cache", (cache_1, d_cache_1),
+                       label=f"prefix{n}",
+                       budget=self.hbm_budget_bytes)
         if self.paged:
             # Paged mode ALSO seeds the radix index with the prefix's
             # full blocks (scattered from the just-built cache — no
@@ -1592,12 +1672,22 @@ class ServingEngine:
                 pre_len, pre_pair = lin_len, lin_pair
         return pre_len, pre_pair
 
+    @memory_budget(
+        pool=lambda self, pre_pair, kv, table_j, draft:
+            ("draft_prefill" if draft else "prefill_cache"),
+        budget_fn=lambda self, *a, **k: self.hbm_budget_bytes,
+        project_fn=lambda self, pre_pair, kv, table_j, draft:
+            memcheck.tree_bytes(self._cache_struct(1, draft=draft)),
+        lifetime="leaf")
     def _admission_cache_1(self, pre_pair, kv, table_j, draft: bool):
         """The batch-1 cache a request's suffix prefill appends to:
         fresh when nothing matched; the stored prefix cache's copy when
         a preloaded pair won the match; a pool gather of the
         radix-matched rows otherwise (copy instead of recompute — same
-        downstream piece programs every way)."""
+        downstream piece programs every way).  All three paths mint
+        the same batch-1 layout, which is what the @memory_budget
+        projection charges (the nested ``_fresh_cache`` call defers to
+        this outermost charge — the sanitizer's re-entrancy rule)."""
         if pre_pair is not None:
             return jax.tree.map(jnp.copy, pre_pair[1 if draft else 0])
         if not self.paged or kv is None or kv.matched == 0:
@@ -1622,6 +1712,13 @@ class ServingEngine:
         """Blocks currently referenced (live lanes + radix cache)."""
         return self._kv_pool.blocks_in_use() if self.paged else 0
 
+    def kv_bytes_in_use(self) -> int:
+        """Referenced pool blocks in device BYTES (live lanes + radix
+        cache at the real per-block row cost) — the occupancy half of
+        ``kv_pool_bytes()``'s constant capacity, relayed per worker in
+        stats frames and shown per replica in /healthz."""
+        return self._kv_pool.bytes_in_use() if self.paged else 0
+
     def kv_pool_bytes(self) -> int:
         """Device bytes the paged KV pools pin across layers (target +
         draft; int8 scale pools included; 0 = linear cache).  Constant
@@ -1629,6 +1726,19 @@ class ServingEngine:
         plain int; the ``--kv-pool-blocks`` oversizing lever budgets
         against this."""
         return self._kv_pool_bytes
+
+    def _prefill_pair_bytes(self) -> int:
+        """Bytes of one batch-1 prefill cache pair (target + draft) —
+        the marginal device allocation an admission mints; memoized
+        off the same cache eval_shape the pool-bytes gauge uses
+        (host-only trace, no device work)."""
+        if self._prefill_bytes_memo is None:
+            n = memcheck.tree_bytes(self._cache_struct(1))
+            if self._draft_model is not None:
+                n += memcheck.tree_bytes(self._cache_struct(1,
+                                                            draft=True))
+            self._prefill_bytes_memo = n
+        return self._prefill_bytes_memo
 
     def fused_attn(self) -> bool:
         """Whether the decode programs were compiled with the fused

@@ -73,6 +73,13 @@ class KVBlockPool:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.n_blocks = n_blocks
         self.block_size = block_size
+        # Device bytes one physical block's rows pin across layers
+        # (target + draft + int8 scale pools; 0 = unknown).  Set by
+        # the engine from the real cache eval_shape, so this host
+        # allocator can answer in BYTES — the unit HBM budgets and the
+        # /healthz capacity view reason in — not just block counts
+        # (``ServingEngine.kv_bytes_in_use`` is the consumer).
+        self.bytes_per_block = 0
         # LIFO free list: recently-freed blocks are re-handed first
         # (their rows are most likely still warm in cache hierarchy).
         self._free: List[int] = list(range(n_blocks, 0, -1))
@@ -84,6 +91,15 @@ class KVBlockPool:
 
     def blocks_in_use(self) -> int:
         return self.n_blocks - len(self._free)
+
+    def bytes_in_use(self) -> int:
+        """Referenced blocks in device bytes (live lanes + radix
+        cache; 0 when the engine never set ``bytes_per_block``)."""
+        return self.blocks_in_use() * self.bytes_per_block
+
+    def bytes_total(self) -> int:
+        """Allocatable capacity in device bytes."""
+        return self.n_blocks * self.bytes_per_block
 
     def refcount(self, block: int) -> int:
         return self._refs.get(block, 0)

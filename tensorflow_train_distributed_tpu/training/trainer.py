@@ -31,8 +31,12 @@ import numpy as np
 import optax
 
 from tensorflow_train_distributed_tpu.runtime import compat, events, faults
-from tensorflow_train_distributed_tpu.runtime.lint import compilecheck
+from tensorflow_train_distributed_tpu.runtime.lint import (
+    compilecheck,
+    memcheck,
+)
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
+    memory_budget,
     thread_role,
 )
 from tensorflow_train_distributed_tpu.parallel import collectives
@@ -130,6 +134,16 @@ class TrainerConfig:
     # EarlyStopping/ReduceLROnPlateau score the same model the final
     # eval/export does.  None = identity.
     eval_state_view: Optional[Callable] = None
+    # HBM budget for the trainer's declared memory pool (memcheck):
+    # the GLOBAL byte ceiling the train state — params, optimizer
+    # moments, mutable collections, grad-quant EF residuals — is held
+    # to at creation.  None = track-only: the TTD_MEMCHECK=1 sanitizer
+    # still ledgers the state under pool "trainer_state" (the
+    # ttd_engine_hbm_bytes gauge feed) but never raises; with a budget
+    # set, an over-budget create_state raises MemoryBudgetError BEFORE
+    # materializing anything (projection is the same eval_shape
+    # plan_state_memory uses).
+    hbm_budget_bytes: Optional[int] = None
 
 
 class Trainer:
@@ -303,6 +317,21 @@ class Trainer:
                         self.mesh, abstract.params, shardings.params))
         return _create, abstract, shardings
 
+    # Memory discipline (ttd-lint memcheck): the trainer's ONE big
+    # device allocation — params + optimizer moments + grad-quant EF
+    # residuals — declared as pool "trainer_state".  Projection reuses
+    # the abstract state the sharding resolution already traces, so an
+    # over-budget config raises BEFORE a single buffer materializes
+    # (a 7B f32 state is ~84 GB; the error beats the OOM by the whole
+    # allocation).  Owner lifetime: a rebuilt state on the same
+    # trainer replaces its charge instead of double-counting.
+    @memory_budget(
+        pool="trainer_state",
+        budget_fn=lambda self, *a, **k: self.config.hbm_budget_bytes,
+        project_fn=lambda self, sample_batch, params=None:
+            memcheck.tree_bytes(
+                self._abstract_state_and_shardings(sample_batch)[1]),
+        lifetime="owner")
     def create_state(self, sample_batch, params=None) -> TrainState:
         """Init params on-device directly into their target shardings.
 

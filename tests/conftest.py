@@ -36,6 +36,14 @@ os.environ.setdefault("TTD_LOCKCHECK", "1")
 # imports: sites wrap at decoration time.  ``TTD_NO_COMPILECHECK=1``
 # is the escape hatch.
 os.environ.setdefault("TTD_COMPILECHECK", "1")
+# ...and the runtime MEMORY sanitizer (the third vertical): annotated
+# allocators (``@memory_budget``) track live bytes per declared pool
+# and raise MemoryBudgetError before an allocation would exceed its
+# owner's budget, with the allocation diffed against the live set
+# (see runtime/lint/memcheck.py; overhead bar pinned in
+# tests/test_memcheck.py).  Same decoration-time contract: arm BEFORE
+# package imports.  ``TTD_NO_MEMCHECK=1`` is the escape hatch.
+os.environ.setdefault("TTD_MEMCHECK", "1")
 from tensorflow_train_distributed_tpu.runtime.lint import lockcheck  # noqa: E402
 
 lockcheck.install()

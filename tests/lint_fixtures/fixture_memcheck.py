@@ -1,0 +1,112 @@
+"""Planted memory-discipline bugs (see __init__.py).
+
+One plant per bug class the memcheck checker exists for — delete or
+break the checker and tests/test_ttd_lint.py fails on this file:
+
+- an UN-ANNOTATED DEVICE ALLOCATION (``jnp.zeros`` in a hot allocator
+  module, reachable from no ``@memory_budget`` allocator, jit program,
+  or eval_shape thunk — an unbudgeted pool in the making);
+- a DONATION-DEFEATING ALIAS (a donated ``self._cache`` that stays
+  bound after the call — XLA cannot reuse the buffer, peak HBM
+  silently doubles), plus the same-buffer-twice-in-one-call variant;
+- a BUDGET-OVERRUN TWIN: an ``@memory_budget`` that declares a pool
+  but NO budget (``budget_bytes``/``budget_fn`` both absent) — a pool
+  without a budget is a gauge, not a discipline.
+
+The clean twins (``clean_allocator`` / ``clean_rebind`` /
+``shape_only``) pin the false-positive guard: an annotated allocator's
+zeros, a donated arg rebound from the result, and an eval_shape thunk
+must all stay silent.
+
+Stub decorators keep the module import-free for the AST checker.
+"""
+
+
+def memory_budget(**kw):                    # AST stand-in
+    def deco(fn):
+        return fn
+    return deco
+
+
+def compile_site(**kw):                     # AST stand-in
+    def deco(fn):
+        return fn
+    return deco
+
+
+def partial(fn, *a, **kw):                  # AST stand-in
+    return fn
+
+
+class jax:                                  # noqa: N801 — AST stand-in
+    @staticmethod
+    def jit(fn=None, **kw):
+        return fn if fn is not None else (lambda f: f)
+
+    @staticmethod
+    def eval_shape(fn, *a):
+        return fn
+
+
+class jnp:                                  # noqa: N801 — AST stand-in
+    @staticmethod
+    def zeros(shape, dtype=None):
+        return shape
+
+
+@memory_budget(pool="fixture_pool", budget_bytes=1 << 20)
+def clean_allocator(shape):
+    # Clean twin: the allocation is owned by a declared, budgeted
+    # pool (this decorator is also what makes the module HOT).
+    return jnp.zeros(shape)
+
+
+def rogue_allocator(shape):
+    # PLANTED: a device allocation in a hot module with no pool — the
+    # sanitizer and the hbm gauges cannot see it.
+    return jnp.zeros(shape)
+
+
+@memory_budget(pool="unbudgeted_pool")
+def unbudgeted_allocator(shape):
+    # PLANTED (budget-overrun twin): declares the pool but no
+    # budget_bytes/budget_fn — nothing would ever raise.
+    return jnp.zeros(shape)
+
+
+def shape_only(shape):
+    def thunk():
+        # Clean twin: eval_shape thunks trace, they never allocate.
+        return jnp.zeros(shape)
+    return jax.eval_shape(thunk)
+
+
+@compile_site(buckets="grid", donates=(1,), statics=(0,))
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def insert_program(cfg, cache, row):
+    # Donating program (and jit-decorated, so ITS zeros are sanctioned).
+    return jnp.zeros((2, 2))
+
+
+class LeakyHolder:
+    def leaky_call(self, row):
+        # PLANTED: self._cache is donated to insert_program but stays
+        # bound after the call — donation defeated, peak HBM doubles.
+        out = self.insert_wrapper(row)
+        return out
+
+    def insert_wrapper(self, row):
+        doubled = insert_program(self, self._cache, row)
+        return doubled
+
+    def alias_call(self, row):
+        # PLANTED: the same buffer donated AND passed live in another
+        # position of one call.
+        out = insert_program(self, self._cache, self._cache)
+        return out
+
+    def clean_rebind(self, row):
+        # Clean twin: the donated buffer is rebound from the result —
+        # the sanctioned donate-and-replace pattern.
+        self._cache = insert_program(self, self._cache, row)
+        return self._cache

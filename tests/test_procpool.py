@@ -244,7 +244,11 @@ def test_sigkill_mid_drain_classified_dead_not_drained():
         os.kill(victim.driver.pid, signal.SIGKILL)   # ...kill lands
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
-            if victim.state() == "dead":
+            # state() flips "dead" the moment the corpse's wait
+            # status is visible; the KILL REASON is written by the
+            # monitor's classification one tick later — wait for
+            # both, or a loaded host reads the gap as a failure.
+            if victim.state() == "dead" and victim.dead_reason:
                 break
             assert victim.state() != "drained", (
                 "mid-drain kill misread as an orderly drain")

@@ -172,6 +172,51 @@ def test_compilecheck_traced_scalar_cast_not_flagged(tmp_path):
     assert findings == [], _messages(findings)
 
 
+# ── seeded mutation: memory discipline ─────────────────────────────────
+
+
+def test_memcheck_fixture_every_plant_flagged():
+    path = os.path.join(FIXTURES, "fixture_memcheck.py")
+    findings = run_lint(paths=[path], checkers=["memcheck"],
+                        root=ROOT)
+    msgs = "\n".join(_messages(findings))
+    # One finding per planted bug class.
+    assert ("un-annotated device allocation: jnp.zeros(...) in "
+            "'rogue_allocator'") in msgs
+    assert ("'unbudgeted_allocator': @memory_budget declares a pool "
+            "but no budget") in msgs
+    assert ("donation-defeating alias: 'self._cache' is donated to "
+            "'insert_program'") in msgs
+    assert ("'self._cache' is passed to 'insert_program' both in "
+            "donated position") in msgs
+    assert len(findings) == 4
+    # The clean twins stay silent (false-positive guard): an annotated
+    # allocator's zeros, an eval_shape thunk, the donate-and-rebind
+    # pattern, and the jit program's own allocations.
+    assert "clean_allocator" not in msgs
+    assert "shape_only" not in msgs
+    assert "clean_rebind" not in msgs
+    assert "insert_program' is not reachable" not in msgs
+
+
+def test_memcheck_hot_module_rule_is_opt_in(tmp_path):
+    """A module with no @memory_budget is NOT hot: its allocations are
+    not audited (the discipline is opted into by annotating), except
+    the required-hot files (serving.py, training/trainer.py) which
+    must declare at least one pool."""
+    cold = tmp_path / "cold.py"
+    cold.write_text(
+        "class jnp:\n"
+        "    @staticmethod\n"
+        "    def zeros(s):\n"
+        "        return s\n"
+        "def anything(s):\n"
+        "    return jnp.zeros(s)\n")
+    findings = run_lint(paths=[str(cold)], checkers=["memcheck"],
+                        root=ROOT)
+    assert findings == [], _messages(findings)
+
+
 # ── seeded mutation: kill switches ─────────────────────────────────────
 
 
@@ -336,7 +381,7 @@ def test_cli_runs_and_exits_per_checker_bits(capsys):
     assert mod.main(["--list"]) == 0
     out = capsys.readouterr().out
     for name in ("compilecheck", "concurrency", "dispatch",
-                 "kill-switch", "prometheus"):
+                 "kill-switch", "memcheck", "prometheus"):
         assert name in out
     # Fixture file: findings -> the checker's stable exit bit,
     # formatted path:line output.
@@ -347,6 +392,14 @@ def test_cli_runs_and_exits_per_checker_bits(capsys):
     rc = mod.main(["--checker", "compilecheck",
                    os.path.join(FIXTURES, "fixture_compilecheck.py")])
     assert rc == 64                 # CHECKER_EXIT_BITS["compilecheck"]
+    capsys.readouterr()
+    # memcheck's registered bit (256) cannot survive the 8-bit process
+    # status — the shell would truncate 256 to a FALSE-CLEAN 0 — so
+    # the CLI folds it into the generic bit 1: nonzero, and --json
+    # (below) carries the exact attribution.
+    rc = mod.main(["--checker", "memcheck",
+                   os.path.join(FIXTURES, "fixture_memcheck.py")])
+    assert rc == 1
     capsys.readouterr()
     # Unknown checker -> usage error (below every checker bit).
     assert mod.main(["--checker", "nope"]) == 2
@@ -370,6 +423,14 @@ def test_cli_json_output_is_structured(capsys):
     assert f["checker"] == "compilecheck"
     assert f["path"].endswith("fixture_compilecheck.py")
     assert payload["exit_bits"]["compilecheck"] == 64
+    # memcheck findings: the process status folds to 1 (8-bit), the
+    # JSON names the checker exactly — counts + its true bit.
+    rc = mod.main(["--json", "--checker", "memcheck",
+                   os.path.join(FIXTURES, "fixture_memcheck.py")])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == payload["exit_code"] == 1
+    assert payload["counts"]["memcheck"] == 4
+    assert payload["exit_bits"]["memcheck"] == 256
     # A clean run is exit 0 with empty findings — same shape.
     rc = mod.main(["--json", "--checker", "prometheus",
                    os.path.join(ROOT, "tensorflow_train_distributed_tpu",

@@ -157,6 +157,15 @@ def add_engine_args(p) -> None:
                         "equivalent. Outputs are bitwise-identical "
                         "either way — this is a memory-layout kill "
                         "switch")
+    p.add_argument("--hbm-budget-bytes", type=int, default=None,
+                   help="declared HBM budget for the engine's memory "
+                        "pools (memcheck): with TTD_MEMCHECK=1, the "
+                        "allocation that would exceed it raises "
+                        "MemoryBudgetError with the live set diffed "
+                        "(instead of an opaque XLA OOM later), and "
+                        "admission refuses requests whose projected "
+                        "bytes cannot fit. Default: track-only — "
+                        "ttd_engine_hbm_bytes gauges, no enforcement")
     p.add_argument("--platform", default="",
                    help="force a jax platform (e.g. 'cpu')")
 
@@ -261,7 +270,8 @@ def build_engine(args, cfg, is_moe, prefix_ids):
                             else getattr(args, "prefill_budget", None)),
             paged=not getattr(args, "no_paged_kv", False),
             kv_block_size=getattr(args, "kv_block_size", 16),
-            kv_pool_blocks=getattr(args, "kv_pool_blocks", None))
+            kv_pool_blocks=getattr(args, "kv_pool_blocks", None),
+            hbm_budget_bytes=getattr(args, "hbm_budget_bytes", None))
         if prefix_ids:
             eng.preload_prefix(prefix_ids)
     except ValueError as e:

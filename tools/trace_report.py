@@ -145,6 +145,40 @@ def train_step_summary(evs: list) -> list:
             for n, ms in [totals[name]]]
 
 
+def memory_summary(evs: list) -> dict:
+    """Per-pool memory table from the memcheck sanitizer's
+    ``memory/<pool>`` spans/instants (``TTD_MEMCHECK=1``): allocations
+    charged, peak and last-seen live bytes, the declared budget with
+    headroom %, and pre-raise near-misses (``memory/near_miss``
+    instants past 90% of budget) — the "where is my HBM" answer the
+    paged-KV and compile tables give for blocks and compiles.  Keyed
+    by pool name; empty when the window has no memory events
+    (sanitizer unarmed)."""
+    pools: dict = {}
+    for e in evs:
+        name = e.get("name", "")
+        args = e.get("args") or {}
+        if name == "memory/near_miss":
+            row = pools.setdefault(args.get("pool", "?"), {
+                "allocs": 0, "peak_live": 0, "live": 0, "budget": 0,
+                "near_misses": 0})
+            row["near_misses"] += 1
+            row["budget"] = max(row["budget"], args.get("budget", 0))
+            continue
+        if not name.startswith("memory/"):
+            continue
+        pool = args.get("pool") or name[len("memory/"):]
+        row = pools.setdefault(pool, {
+            "allocs": 0, "peak_live": 0, "live": 0, "budget": 0,
+            "near_misses": 0})
+        row["allocs"] += 1
+        live = args.get("live", args.get("bytes", 0)) or 0
+        row["peak_live"] = max(row["peak_live"], live)
+        row["live"] = live                 # events are time-ordered
+        row["budget"] = max(row["budget"], args.get("budget", 0))
+    return pools
+
+
 def compile_summary(evs: list) -> list:
     """Per-jit-site compilation table from the compilecheck sanitizer's
     ``compile/<site>`` spans (``TTD_COMPILECHECK=1``): how many
@@ -294,6 +328,23 @@ def main(argv=None) -> int:
             frac_s = (f"{frac:9.3f}" if name != "train/step_dispatch"
                       else " " * 9)
             print(f"{n:7d}  {ms:10.2f}  {frac_s}  {name}")
+
+    memory = memory_summary(evs)
+    if memory:
+        print("\n== memory pools (memcheck spans)")
+        print(f"{'allocs':>7}  {'peak_MiB':>9}  {'live_MiB':>9}  "
+              f"{'budget_MiB':>10}  {'headroom':>8}  {'near-miss':>9}"
+              f"  pool")
+        for pool in sorted(memory):
+            row = memory[pool]
+            mib = 1024.0 * 1024.0
+            budget = row["budget"]
+            headroom = (f"{100.0 * (1 - row['peak_live'] / budget):7.1f}%"
+                        if budget else "      --")
+            print(f"{row['allocs']:7d}  {row['peak_live'] / mib:9.2f}  "
+                  f"{row['live'] / mib:9.2f}  "
+                  f"{(budget / mib) if budget else 0:10.2f}  "
+                  f"{headroom}  {row['near_misses']:9d}  {pool}")
 
     compiles = compile_summary(evs)
     if compiles:

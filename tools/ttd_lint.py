@@ -11,11 +11,12 @@ Usage::
 Exit status: 0 clean, 2 usage error; findings exit with the OR of each
 failing checker's stable bit (concurrency=4, dispatch=8,
 kill-switch=16, prometheus=32, compilecheck=64, suppression=128,
-io/syntax=1 — ``core.CHECKER_EXIT_BITS``), so a machine caller can
-tell WHICH disciplines failed from the status alone.  ``--json``
-prints ``{"findings": [...], "counts": {...}, "exit_code": N}`` on
-stdout for callers that want structure instead of text (the tier-1
-gate asserts on it).  The tier-1 test (tests/test_ttd_lint.py) runs
+memcheck=256 — folded into the generic bit 1 in the 8-bit process
+status, exact in ``--json`` — io/syntax=1; ``core.CHECKER_EXIT_BITS``),
+so a machine caller can tell WHICH disciplines failed from the status
+alone.  ``--json`` prints ``{"findings": [...], "counts": {...},
+"exit_code": N}`` on stdout for callers that want structure instead of
+text (the tier-1 gate asserts on it).  The tier-1 test (tests/test_ttd_lint.py) runs
 the same entry over the whole tree and asserts zero findings — run
 this locally before pushing anything that touches locks, thread
 roles, jit boundaries, ``TTD_*`` flags, or metric names.
