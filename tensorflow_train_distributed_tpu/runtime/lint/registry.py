@@ -59,9 +59,14 @@ from typing import Callable, Dict, Optional, Tuple
 #: parent-side request table.  scaler: the elastic proc pool's
 #: scale/respawn thread (spawns and drains workers; owns the published
 #: replica list).
+#: acceptor: the network pool's TCP listener thread (admits dial-in
+#: workers; owns NetPool's published replica list the way the scaler
+#: owns ProcPool's).  dialer: a standalone worker daemon's
+#: gateway-dialing loop (tools/serve_worker — connect, serve, re-dial
+#: with backoff).
 THREAD_ROLES = frozenset({
     "main", "handler", "driver", "pump", "watchdog", "supervisor",
-    "loadgen", "trainer", "reader", "scaler",
+    "loadgen", "trainer", "reader", "scaler", "acceptor", "dialer",
 })
 
 _ROLE_TLS = threading.local()

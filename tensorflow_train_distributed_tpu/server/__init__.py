@@ -11,6 +11,12 @@ compute layer of).
   out-of-process face of the same pool: subprocess engine workers
   speaking a versioned length-prefixed frame protocol, true-SIGKILL
   fault isolation, elastic scale/respawn;
+- ``server.netpool`` — the multi-host face: TCP dial-in worker
+  daemons (``tools/serve_worker.py``) join the same pool with a
+  declared ``prefill|decode|both`` role, and dedicated prefill
+  workers hand finished KV to decode workers over binary KV_HANDOFF
+  frames (disaggregated serving; ``TTD_NO_DISAGG=1`` collapses the
+  role split);
 - ``server.gateway`` — stdlib threaded HTTP frontend
   (``/v1/generate``, ``/healthz``, ``/metrics``) and drain lifecycle;
 - ``server.metrics`` — stdlib Prometheus text-format registry.
@@ -34,6 +40,10 @@ from tensorflow_train_distributed_tpu.server.gateway import (  # noqa: F401
 from tensorflow_train_distributed_tpu.server.metrics import (  # noqa: F401
     GatewayMetrics,
     Registry,
+)
+from tensorflow_train_distributed_tpu.server.netpool import (  # noqa: F401
+    NetDriver,
+    NetPool,
 )
 from tensorflow_train_distributed_tpu.server.procpool import (  # noqa: F401
     ProcPool,

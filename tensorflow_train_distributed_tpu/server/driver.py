@@ -82,6 +82,28 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline expired before completion (HTTP 504)."""
 
 
+class _PendingCall:
+    """One queued ``EngineDriver.call``: a closure to run on the driver
+    thread plus the future its caller blocks on."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _run(self, engine) -> None:
+        try:
+            self._result = self._fn(engine)
+        except BaseException as e:      # noqa: BLE001 — relay to caller
+            self._error = e
+        self._done.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+
 class RequestHandle:
     """Caller's future for one submitted request.
 
@@ -184,6 +206,7 @@ class EngineDriver:
         "_draining": ("_cv",),
         "_failed": ("_cv",),
         "_poisoned": ("_cv",),
+        "_calls": ("_cv",),
     }
 
     def __init__(self, engine, *, max_queue: int = 64,
@@ -202,6 +225,7 @@ class EngineDriver:
         self._cv = threading.Condition()
         self._admit: deque = deque()       # RequestHandles not yet in engine
         self._inflight: dict = {}          # engine rid -> RequestHandle
+        self._calls: deque = deque()       # _PendingCalls for the loop
         self._terminal: OrderedDict = OrderedDict()  # id -> final status
         self._next_id = 0
         self._draining = False
@@ -387,6 +411,41 @@ class EngineDriver:
             self._cv.notify()
         return handle
 
+    # "reader"/"pump": the network worker's frame loop (and its
+    # per-frame helper threads) marshal KV export/install through here.
+    @thread_role("handler", "pump", "main", "reader")
+    def call(self, fn: Callable, timeout_s: Optional[float] = None):
+        """Run ``fn(engine)`` ON THE DRIVER THREAD between decode steps
+        and return its result (exceptions re-raise here).  The engine is
+        single-threaded by contract — every mutating call must come from
+        the loop — and this is the ONE seam other threads get: the
+        disaggregated-serving worker uses it to run KV export/install
+        (device gathers + pool scatters) without racing ``serve_step``.
+        Raises ``TimeoutError`` if the loop doesn't reach the call in
+        ``timeout_s`` (e.g. a wedged dispatch) and ``RuntimeError`` once
+        the driver has failed or finished draining."""
+        pc = _PendingCall(fn)
+        with self._cv:
+            if self._failed is not None:
+                raise RuntimeError(
+                    f"engine driver failed: {self._failed!r}")
+            if not self._thread.is_alive() and self._thread.ident is not None:
+                raise RuntimeError("engine driver loop has exited")
+            self._calls.append(pc)
+            self._cv.notify()
+        if not pc._done.wait(timeout_s):
+            raise TimeoutError("engine call still pending")
+        if pc._error is not None:
+            raise pc._error
+        return pc._result
+
+    @locks_held("_cv")
+    def _fail_calls_locked(self, reason: str) -> None:
+        """Resolve queued calls with an error at loop exit (callers
+        must not block forever on a driver that will never run them)."""
+        while self._calls:
+            self._calls.popleft()._fail(RuntimeError(reason))
+
     def request_status(self, request_id: int) -> str:
         """Lifecycle answer for /v1/requests/<id>: a remembered
         terminal status (``ok|expired|invalid|error``), else
@@ -459,6 +518,7 @@ class EngineDriver:
             while True:
                 with self._cv:
                     while not (self._admit or self._inflight
+                               or self._calls
                                or self._draining or self._poisoned):
                         self._cv.wait()
                     if self._poisoned:
@@ -472,11 +532,22 @@ class EngineDriver:
                             "declaration (%s); exiting without "
                             "dispatching", self._replica_id,
                             self._poisoned)
+                        self._fail_calls_locked(
+                            f"driver fenced: {self._poisoned}")
                         return
                     if (self._draining and not self._admit
-                            and not self._inflight):
+                            and not self._inflight and not self._calls):
                         return
                     self._admit_pending()
+                    calls = list(self._calls)
+                    self._calls.clear()
+                # Queued engine calls (KV export/install) run here —
+                # on the loop thread, outside the lock, between steps —
+                # so they can take as long as a device gather without
+                # blocking submitters.
+                for pc in calls:
+                    pc._run(self._engine)
+                with self._cv:
                     if not self._inflight:
                         continue      # everything expired at admission
                 self._dispatch_n += 1
@@ -511,6 +582,7 @@ class EngineDriver:
                 pending = list(self._admit) + list(self._inflight.values())
                 self._admit.clear()
                 self._inflight.clear()
+                self._fail_calls_locked(f"engine driver failed: {e!r}")
             events.instant("driver/died", error=repr(e))
             for handle in pending:
                 self._count("error")

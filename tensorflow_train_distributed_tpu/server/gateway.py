@@ -471,7 +471,9 @@ class ServingGateway:
                 kv_evictions_fn=self.pool.kv_evictions,
                 kv_pool_bytes_fn=self.pool.kv_pool_bytes,
                 replica_rss_fn=self.pool.replica_rss,
-                hbm_bytes_fn=self.pool.hbm_by_pool)
+                hbm_bytes_fn=self.pool.hbm_by_pool,
+                workers_by_role_fn=getattr(self.pool, "workers_by_role",
+                                           None))
         else:
             one = [self.engine]
             self.metrics = GatewayMetrics(

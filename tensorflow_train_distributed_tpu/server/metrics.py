@@ -287,7 +287,9 @@ class GatewayMetrics:
                  kv_pool_bytes_fn: Optional[Callable[[], int]] = None,
                  slots_total_fn: Optional[Callable[[], int]] = None,
                  replica_rss_fn: Optional[Callable[[], dict]] = None,
-                 hbm_bytes_fn: Optional[Callable[[], dict]] = None):
+                 hbm_bytes_fn: Optional[Callable[[], dict]] = None,
+                 workers_by_role_fn: Optional[
+                     Callable[[], dict]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -359,6 +361,27 @@ class GatewayMetrics:
             "Resident-set bytes per subprocess replica worker, from "
             "its latest stats frame (no series for in-process "
             "replicas).", "replica", fn=replica_rss_fn)
+        # Disaggregated serving (server.netpool + role-split routing):
+        # fleet composition by HELLO-declared role (every worker reads
+        # "both" under TTD_NO_DISAGG=1 or pre-role deployments), and
+        # the prefill→decode KV handoff's volume/latency — bytes of
+        # serialized int8 rows+scales shipped between workers, and the
+        # export→install wall time per successful handoff.  All three
+        # render trivially (no series / zeros) for in-process and
+        # co-located pools.
+        self.workers_alive = r.labeled_gauge(
+            "ttd_gateway_workers_alive",
+            "Usable worker replicas per disaggregated-serving role "
+            "(prefill|decode|both), from their HELLO frames.",
+            "role", fn=workers_by_role_fn)
+        self.handoff_bytes = r.counter(
+            "ttd_gateway_handoff_bytes_total",
+            "Serialized KV bytes shipped prefill→decode in successful "
+            "handoffs (int8 pool rows + scales).")
+        self.handoff_seconds = r.histogram(
+            "ttd_gateway_handoff_seconds",
+            "Prefill-export-to-decode-install wall time per "
+            "successful KV handoff.")
         # Fraction of the engine's host harvest/refill time hidden
         # under device compute by async decode pipelining — the
         # driver-visible proof the overlap path engages (0 under the

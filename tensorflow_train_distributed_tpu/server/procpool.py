@@ -129,6 +129,7 @@ class RemoteEngine:
         "paged": (None, "reader", "main"),
         "pool_blocks": (None, "reader", "main"),
         "pid": (None, "reader", "main"),
+        "role": (None, "reader", "main"),
     }
 
     def __init__(self):
@@ -138,6 +139,11 @@ class RemoteEngine:
         self.paged = False
         self.pool_blocks: Optional[int] = None
         self.pid: Optional[int] = None
+        # Disaggregated-serving role from the HELLO: ``prefill``
+        # workers only stage+export KV, ``decode`` workers only take
+        # placements, ``both`` (every pre-role worker) serves
+        # everything.
+        self.role = "both"
         self._lock = threading.Lock()
         self._gauges: dict = {}
         self._hbm: dict = {}
@@ -151,6 +157,9 @@ class RemoteEngine:
         self.paged = bool(eng.get("paged"))
         self.pool_blocks = eng.get("pool_blocks")
         self.pid = body.get("pid")
+        role = str(body.get("role") or "both")
+        self.role = role if role in ("prefill", "decode", "both") \
+            else "both"
         # slots LAST: replica_states readers key capacity off it, and
         # the rest of the shape must be visible once it is.
         self.slots = int(eng.get("slots") or 0)
@@ -247,6 +256,19 @@ class _ProcRequest:
         self.generated: list = []
 
 
+class _PendingHandoff:
+    """Rendezvous for one in-flight KV handoff exchange: the caller
+    waits on the event; the reader thread fills ``body`` from the
+    worker's KV_HANDOFF/KV_ACK reply.  ``body`` still None after the
+    event fires means the worker died — a refusal, never an error."""
+
+    __slots__ = ("event", "body")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.body: Optional[dict] = None
+
+
 @concurrency_guarded
 class ProcDriver:
     """The ``EngineDriver`` surface over one subprocess worker.
@@ -272,6 +294,8 @@ class ProcDriver:
         "_terminal": ("_lock",),
         "_draining": ("_lock",),
         "_next_id": ("_lock",),
+        "_handoffs": ("_lock",),
+        "_next_handoff": ("_lock",),
     }
 
     def __init__(self, spec: WorkerSpec, engine: RemoteEngine, *,
@@ -288,6 +312,8 @@ class ProcDriver:
         self._recs: dict = {}               # request id -> _ProcRequest
         self._terminal: OrderedDict = OrderedDict()
         self._next_id = 0
+        self._handoffs: dict = {}     # handoff id -> _PendingHandoff
+        self._next_handoff = 0
         self._draining = False
         self._drained = False               # worker confirmed BYE
         self._failed: Optional[BaseException] = None
@@ -392,24 +418,29 @@ class ProcDriver:
         except proto.ProtocolError as e:
             self._fail_protocol(e)
         except (OSError, ValueError) as e:
-            # A SIGKILLed/OOMed worker can tear its socket down with
-            # data still in flight: the parent reads ECONNRESET
-            # instead of a clean EOF.  That is the DEATH's symptom,
-            # not a protocol violation by the worker — if there is a
-            # corpse (brief wait: the reset and the exit race by
-            # microseconds), classify it like the EOF it stands for
-            # ("killed by signal 9" in /healthz), never "protocol".
-            rc = None
-            if isinstance(e, OSError) and self._proc is not None:
-                try:
-                    rc = self._proc.wait(timeout=1.0)
-                except subprocess.TimeoutExpired:
-                    rc = None
-            if rc is not None:
-                self._on_eof()
-                return
-            self._fail_protocol(proto.ProtocolError(
-                f"frame stream error: {type(e).__name__}: {e}"))
+            self._stream_error(e)
+
+    def _stream_error(self, e: BaseException) -> None:
+        """A torn frame stream, classified.  A SIGKILLed/OOMed worker
+        can tear its socket down with data still in flight: the parent
+        reads ECONNRESET instead of a clean EOF.  That is the DEATH's
+        symptom, not a protocol violation by the worker — if there is
+        a corpse (brief wait: the reset and the exit race by
+        microseconds), classify it like the EOF it stands for
+        ("killed by signal 9" in /healthz), never "protocol".  The
+        TCP driver overrides this (no corpse to consult across
+        hosts)."""
+        rc = None
+        if isinstance(e, OSError) and self._proc is not None:
+            try:
+                rc = self._proc.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                rc = None
+        if rc is not None:
+            self._on_eof()
+            return
+        self._fail_protocol(proto.ProtocolError(
+            f"frame stream error: {type(e).__name__}: {e}"))
 
     def _dispatch(self, ftype: int, body: dict) -> None:
         if ftype == proto.CHUNK:
@@ -430,6 +461,11 @@ class ProcDriver:
                          body.get("error"))
         elif ftype == proto.STATS:
             self._on_stats(body)
+        elif ftype in (proto.KV_HANDOFF, proto.KV_ACK):
+            # Disaggregated serving: a prefill worker's exported rows
+            # (binary KV_HANDOFF) or a decode worker's install verdict
+            # (KV_ACK) — either resolves the waiting handoff exchange.
+            self._resolve_handoff(body)
         elif ftype == proto.DIED:
             self._failed = RuntimeError(
                 f"worker driver died: {body.get('error')}")
@@ -443,6 +479,7 @@ class ProcDriver:
                 self._set_terminal(rid, "error")
                 rec.handle._resolve(None, RuntimeError(
                     f"worker driver died: {body.get('error')}"))
+            self._fail_handoffs()
         elif ftype == proto.BYE:
             self._drained = True
         # Unknown frame types are ignored (forward compatibility).
@@ -528,6 +565,7 @@ class ProcDriver:
             self._vanished = True
             logger.warning("worker %s (pid %s) vanished (rc=%s)",
                            self._replica_id, self._engine.pid, rc)
+        self._fail_handoffs()
         events.instant("replica/worker_exit",
                        replica=self._replica_id, returncode=rc,
                        drained=self._drained)
@@ -541,6 +579,7 @@ class ProcDriver:
                      self._replica_id, e)
         events.instant("replica/protocol_error",
                        replica=self._replica_id, error=str(e)[:200])
+        self._fail_handoffs()
         if self._proc is not None and self._proc.poll() is None:
             self._proc.kill()
             self._returncode = self._proc.wait()
@@ -730,6 +769,95 @@ class ProcDriver:
     def abandon(self, handle: RequestHandle) -> None:
         handle.deadline = time.monotonic()
         self._send(proto.CANCEL, {"id": handle.id})
+
+    # -- disaggregated serving: prefill→decode KV handoff ----------------
+
+    def _new_handoff(self) -> tuple:
+        pend = _PendingHandoff()
+        with self._lock:
+            hid = self._next_handoff
+            self._next_handoff += 1
+            self._handoffs[hid] = pend
+        return hid, pend
+
+    def _drop_handoff(self, hid: int) -> None:
+        with self._lock:
+            self._handoffs.pop(hid, None)
+
+    def _resolve_handoff(self, body: dict) -> None:
+        hid = body.get("id")
+        with self._lock:
+            pend = (self._handoffs.pop(int(hid), None)
+                    if hid is not None else None)
+        if pend is None:
+            return          # the waiter timed out and gave up already
+        pend.body = body
+        pend.event.set()
+
+    def _fail_handoffs(self) -> None:
+        """Wake every pending handoff waiter with a refusal (body stays
+        None) — a dead worker must never leave a pump blocked for the
+        full handoff timeout."""
+        with self._lock:
+            pending = list(self._handoffs.values())
+            self._handoffs.clear()
+        for pend in pending:
+            pend.event.set()
+
+    @thread_role("pump", "handler", "main")
+    def prefill_export(self, tokens,
+                       timeout_s: float = 60.0) -> Optional[tuple]:
+        """Ask THIS (prefill-role) worker to stage ``tokens``' head
+        through its per-piece prefill and ship the finished KV rows
+        back.  Returns ``(meta, blob)`` — the wire header (block span,
+        leaf manifest) and the raw int8-rows+scales payload — or None
+        on ANY refusal (nothing exportable, oversized frame, timeout,
+        worker death): the caller degrades that request to a local
+        prefill with bitwise-identical output, so no path here is
+        fatal."""
+        if not self.alive():
+            return None
+        hid, pend = self._new_handoff()
+        if not self._send(proto.PREFILL,
+                          {"id": hid,
+                           "tokens": [int(t) for t in tokens]}):
+            self._drop_handoff(hid)
+            return None
+        if not pend.event.wait(timeout_s):
+            self._drop_handoff(hid)
+            return None
+        body = pend.body
+        if body is None:                # worker died mid-export
+            return None
+        body = dict(body)
+        blob = body.pop(proto.BLOB_KEY, None)
+        if not blob or not body.get("n"):
+            return None                 # KV_ACK refusal (n=0)
+        body.pop("id", None)
+        return body, blob
+
+    @thread_role("pump", "handler", "main")
+    def install_handoff(self, meta: dict, blob: bytes,
+                        timeout_s: float = 60.0) -> int:
+        """Forward an exported prefix into THIS (decode-role) worker's
+        paged pool; returns the warm-token count its radix index now
+        answers (0 = refused — the request prefills locally with the
+        same output)."""
+        if not self.alive():
+            return 0
+        hid, pend = self._new_handoff()
+        s = self._sender
+        if s is None or not s.send_binary(proto.KV_HANDOFF,
+                                          dict(meta, id=hid), blob):
+            self._drop_handoff(hid)
+            return 0
+        if not pend.event.wait(timeout_s):
+            self._drop_handoff(hid)
+            return 0
+        body = pend.body
+        if body is None:                # worker died mid-install
+            return 0
+        return int(body.get("n") or 0)
 
     def poison(self, reason: str) -> None:
         """Fence a declared-dead worker: for a subprocess the fence is

@@ -9,8 +9,10 @@ heuristics: a reader either gets a complete frame or a
 hostile length prefix can never make the parent allocate or block on
 an unbounded read.  JSON bodies keep every frame printable in a log
 line while the framing itself stays binary (token-id lists are small;
-the KV-handoff frames a disaggregated-prefill step would add ride the
-same framing with a binary payload type).
+the one exception is ``KV_HANDOFF``, the reserved binary payload type:
+its payload is ``type byte + 4-byte header length + JSON header + raw
+row bytes``, so quantized KV pool rows ship verbatim without a base64
+detour — the JSON header still makes the frame log-printable).
 
 The stream is VERSIONED at the hello: the worker's first frame is
 ``HELLO`` carrying ``proto=PROTO_VERSION`` plus the engine's static
@@ -37,6 +39,18 @@ Frame types (direction):
 - ``BYE``     worker → parent: drain complete, exiting cleanly.
 - ``DIED``    worker → parent: the worker's driver loop died with
   error propagation (the corpse the parent's ``failure()`` reports).
+- ``PREFILL`` parent → prefill worker: run the staged per-piece
+  prefill for one prompt and export the finished KV (disaggregated
+  serving — the worker answers with a ``KV_HANDOFF`` or a ``KV_ACK``
+  carrying the refusal).
+- ``KV_HANDOFF``  the binary frame, both directions: prefill worker →
+  parent carries the exported block rows; parent → decode worker
+  carries the same bytes for installation.  Header keys: request id,
+  token ids, leaf manifest (path/dtype/shape per pool leaf); the blob
+  is the concatenated row bytes, bit-identical to the pool contents.
+- ``KV_ACK``  worker → parent: terminal answer to ``PREFILL`` (export
+  refused) or ``KV_HANDOFF`` (rows installed / install skipped), with
+  the matched-token count so routing knows how warm the prefix is.
 
 Everything here is pure framing — no sockets are owned, no threads
 are spawned: ``read_frame``/``write_frame`` work over any file-like
@@ -75,12 +89,25 @@ DRAIN = 6
 STATS = 7
 BYE = 8
 DIED = 9
+PREFILL = 10
+KV_HANDOFF = 11
+KV_ACK = 12
 
 FRAME_NAMES = {
     HELLO: "HELLO", SUBMIT: "SUBMIT", CHUNK: "CHUNK", RETIRE: "RETIRE",
     CANCEL: "CANCEL", DRAIN: "DRAIN", STATS: "STATS", BYE: "BYE",
-    DIED: "DIED",
+    DIED: "DIED", PREFILL: "PREFILL", KV_HANDOFF: "KV_HANDOFF",
+    KV_ACK: "KV_ACK",
 }
+
+#: Frame types whose payload is ``type byte + 4-byte header length +
+#: JSON header + raw bytes`` instead of pure JSON.  ``read_frame``
+#: surfaces the raw bytes under the reserved body key ``"blob"``.
+BINARY_FRAMES = frozenset({KV_HANDOFF})
+
+#: The body key binary frames deliver their raw bytes under (reserved:
+#: a JSON header may not use it).
+BLOB_KEY = "blob"
 
 
 class ProtocolError(RuntimeError):
@@ -94,6 +121,11 @@ class ProtocolError(RuntimeError):
 def encode_frame(ftype: int, body: dict,
                  max_frame: int = MAX_FRAME_BYTES) -> bytes:
     """One wire-ready frame: header + type byte + compact JSON."""
+    if ftype in BINARY_FRAMES:
+        raise ProtocolError(
+            f"{FRAME_NAMES.get(ftype, ftype)} is a binary frame type; "
+            "encode it with encode_binary_frame (a JSON-encoded body "
+            "would be mis-parsed as a binary layout on the far side)")
     payload = bytes([ftype]) + json.dumps(
         body, separators=(",", ":")).encode()
     if len(payload) > max_frame:
@@ -101,6 +133,32 @@ def encode_frame(ftype: int, body: dict,
             f"outgoing {FRAME_NAMES.get(ftype, ftype)} frame of "
             f"{len(payload)} bytes exceeds the {max_frame}-byte bound")
     return _HEADER.pack(len(payload)) + payload
+
+
+def encode_binary_frame(ftype: int, header: dict, blob: bytes,
+                        max_frame: int = MAX_FRAME_BYTES) -> bytes:
+    """One wire-ready BINARY frame: length prefix + type byte + 4-byte
+    big-endian JSON-header length + compact JSON header + raw blob.
+    The blob rides verbatim — no base64, no escaping — so pool rows
+    arrive bit-identical; the same ``max_frame`` bound applies to the
+    whole payload (a handoff bigger than the bound is refused on the
+    sending side, degrading that request to local prefill)."""
+    if ftype not in BINARY_FRAMES:
+        raise ProtocolError(
+            f"{FRAME_NAMES.get(ftype, ftype)} is not a binary frame "
+            f"type")
+    if BLOB_KEY in header:
+        raise ProtocolError(
+            f"binary frame header may not use the reserved "
+            f"{BLOB_KEY!r} key")
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    payload_len = 1 + _HEADER.size + len(hdr) + len(blob)
+    if payload_len > max_frame:
+        raise ProtocolError(
+            f"outgoing {FRAME_NAMES.get(ftype, ftype)} frame of "
+            f"{payload_len} bytes exceeds the {max_frame}-byte bound")
+    return (_HEADER.pack(payload_len) + bytes([ftype])
+            + _HEADER.pack(len(hdr)) + hdr + blob)
 
 
 def write_frame(fp, ftype: int, body: dict,
@@ -145,6 +203,16 @@ class FrameSender:
     def send(self, ftype: int, body: dict) -> bool:
         try:
             frame = encode_frame(ftype, body, self._max_frame)
+        except ProtocolError:
+            return False
+        return self.send_frame(frame)
+
+    def send_binary(self, ftype: int, header: dict, blob: bytes) -> bool:
+        """Binary-frame analog of ``send``: oversized payloads return
+        False without poisoning the stream (nothing was written)."""
+        try:
+            frame = encode_binary_frame(ftype, header, blob,
+                                        self._max_frame)
         except ProtocolError:
             return False
         return self.send_frame(frame)
@@ -197,6 +265,31 @@ def read_frame(fp, max_frame: int = MAX_FRAME_BYTES
             f"stream died mid-frame: {len(payload)} of {n} "
             f"payload bytes")
     ftype = payload[0]
+    if ftype in BINARY_FRAMES:
+        # type byte + 4-byte header length + JSON header + raw blob;
+        # the blob is delivered under the reserved "blob" body key.
+        if len(payload) < 1 + _HEADER.size:
+            raise ProtocolError(
+                f"binary frame too short for its header length "
+                f"({len(payload)} bytes)")
+        (hn,) = _HEADER.unpack(payload[1:1 + _HEADER.size])
+        hdr_end = 1 + _HEADER.size + hn
+        if hdr_end > len(payload):
+            raise ProtocolError(
+                f"binary frame header length {hn} exceeds the "
+                f"{len(payload)}-byte payload")
+        try:
+            body = json.loads(payload[1 + _HEADER.size:hdr_end].decode())
+        except (UnicodeDecodeError, ValueError) as e:
+            raise ProtocolError(
+                f"binary frame header is not JSON "
+                f"(type byte {ftype}): {e}") from None
+        if not isinstance(body, dict):
+            raise ProtocolError(
+                f"binary frame header must be a JSON object, got "
+                f"{type(body).__name__}")
+        body[BLOB_KEY] = payload[hdr_end:]
+        return ftype, body
     try:
         body = json.loads(payload[1:].decode())
     except (UnicodeDecodeError, ValueError) as e:

@@ -50,6 +50,7 @@ abrupt vanish — deterministically, per replica.
 from __future__ import annotations
 
 import logging
+import os
 import queue as queue_mod
 import threading
 import time
@@ -79,6 +80,17 @@ class NoReplicas(RuntimeError):
     """No live replica can accept work (HTTP 503 + Retry-After: the
     condition may clear — operators restart replicas — unlike a single
     driver's terminal death)."""
+
+
+def disagg_killed() -> bool:
+    """``TTD_NO_DISAGG=1`` disables disaggregated serving's role split
+    and prefill→decode KV handoff: every worker is routed as
+    ``role=both`` and requests prefill locally on whatever replica
+    decodes them (the pre-disagg behavior, bitwise-identical outputs —
+    handoff only ever changes WHERE prefill runs).  The TCP transport
+    itself stays up: killing routing must not take a cross-host fleet
+    offline.  Same no-redeploy contract as ``TTD_NO_PROC_REPLICAS``."""
+    return os.environ.get("TTD_NO_DISAGG", "0") not in ("", "0")
 
 
 # Pump liveness poll while waiting on the next chunk: only paid when
@@ -160,6 +172,22 @@ class Replica:
         replica still finishes accepted work, and a failed-over request
         was accepted once — only death disqualifies."""
         return not self.dead and self.driver.alive()
+
+    def role(self) -> str:
+        """Disaggregated-serving role (``prefill|decode|both``) from
+        the worker's HELLO; in-process engines have none and serve
+        everything.  Under ``TTD_NO_DISAGG=1`` every replica reads as
+        ``both`` — the kill switch collapses routing, not health."""
+        if disagg_killed():
+            return "both"
+        role = getattr(self.engine, "role", None) or "both"
+        return role if role in ("prefill", "decode", "both") else "both"
+
+    def decode_capable(self) -> bool:
+        """May this replica take a decode placement?  Dedicated
+        prefill workers only stage and export KV — they are never
+        placement candidates."""
+        return self.role() != "prefill"
 
     def load(self) -> int:
         return self.driver.waiting() + self.driver.active_slots()
@@ -245,7 +273,11 @@ class ReplicaPool:
                  replica_max_queue: Optional[int] = None,
                  monitor_poll_s: Optional[float] = None):
         engines = list(engines)
-        if len(engines) < 1:
+        # The network pool starts EMPTY (its replicas dial in) and
+        # opts out of this floor; every other pool needs a replica up
+        # front.
+        if len(engines) < 1 and not getattr(self, "_allow_empty",
+                                            False):
             raise ValueError("ReplicaPool needs at least one engine")
         if watchdog_timeout_s is not None and watchdog_timeout_s <= 0:
             raise ValueError(
@@ -264,7 +296,8 @@ class ReplicaPool:
         # TRANSIENT per-replica refusal the pump absorbs with backoff
         # instead of a client-visible shed.
         if replica_max_queue is None:
-            replica_max_queue = max(1, -(-max_queue // len(engines)))
+            replica_max_queue = max(
+                1, -(-max_queue // max(1, len(engines))))
         self._replica_max_queue = replica_max_queue
         self._replicas = [self._make_replica(i, e)
                           for i, e in enumerate(engines)]
@@ -369,6 +402,9 @@ class ReplicaPool:
                  "queue_depth": rep.driver.waiting(),
                  "slots_in_use": rep.driver.active_slots(),
                  "slots_total": rep.slots}
+            role = rep.role()
+            if role != "both":
+                d["role"] = role
             if rep.dead_reason:
                 d["reason"] = rep.dead_reason
             total_fn = getattr(rep.engine, "kv_blocks_total", None)
@@ -408,6 +444,18 @@ class ReplicaPool:
         """Current slot capacity across usable replicas — a LIVE value
         under the elastic subprocess pool (workers spawn and drain)."""
         return sum(rep.slots for rep in self._replicas if rep.usable())
+
+    def workers_by_role(self) -> dict:
+        """Usable replicas per disaggregated-serving role (``{role:
+        count}``) — the ``ttd_gateway_workers_alive{role=...}`` feed.
+        Under ``TTD_NO_DISAGG=1`` everything truthfully reads
+        ``both``."""
+        out: dict = {}
+        for rep in self._replicas:
+            if rep.usable():
+                r = rep.role()
+                out[r] = out.get(r, 0) + 1
+        return out
 
     def _engine_stat(self, name: str, ratio: bool = False) -> float:
         vals = []
@@ -488,7 +536,9 @@ class ReplicaPool:
     def _affinity_key(self, prompt):
         """First-KV-block token key: requests sharing it share their
         leading physical blocks on whichever replica holds them."""
-        bs = getattr(self._replicas[0].engine, "kv_block_size", 16)
+        reps = self._replicas
+        bs = (getattr(reps[0].engine, "kv_block_size", 16) if reps
+              else 16)
         return tuple(prompt[:bs]) if len(prompt) >= bs else None
 
     @thread_role("handler", "main")
@@ -504,9 +554,18 @@ class ReplicaPool:
         try:
             # The screening engine: any replica's validator agrees
             # (identically-configured engines); a subprocess pool's
-            # facade answers from the HELLO-advertised shape.
-            prompt = self._replicas[0].engine.validate_request(
-                prompt, max_new, seed)
+            # facade answers from the HELLO-advertised shape.  An
+            # EMPTY network pool (first worker still dialing in) can
+            # only coerce — the worker's real engine screens at
+            # placement, coming back as a classified invalid retire.
+            reps = self._replicas
+            if reps:
+                prompt = reps[0].engine.validate_request(
+                    prompt, max_new, seed)
+            else:
+                prompt = [int(t) for t in prompt]
+                if not prompt:
+                    raise ValueError("empty prompt")
         except ValueError as e:
             raise RequestError(str(e))
         if timeout_s is None:
@@ -560,11 +619,17 @@ class ReplicaPool:
 
     def _candidates(self, preq: _PoolRequest,
                     allow_draining: bool) -> list:
-        """Routable replicas, best first: warm KV affinity, then load,
-        then index.  A replica this request already died on is never a
-        candidate (replicas do not resurrect)."""
+        """Routable DECODE-capable replicas, best first: warm KV
+        affinity, then load, then index.  The affinity table is the
+        gateway-side mirror of each worker's radix prefix index —
+        placements AND finished handoffs feed it — so a warm prefix on
+        ANY decode worker wins placement fleet-wide.  Dedicated
+        prefill workers never take placements; a replica this request
+        already died on is never a candidate (replicas do not
+        resurrect)."""
         reps = [rep for rep in self._replicas
                 if rep.idx not in preq.excluded
+                and rep.decode_capable()
                 and (rep.usable() if allow_draining
                      else rep.accepting())]
         key = preq.affinity_key
@@ -607,6 +672,7 @@ class ReplicaPool:
             # like an everyone-refused pass — capacity is coming.
             refused = not reps
             for rep in reps:
+                self._maybe_handoff(preq, prompt, rep)
                 try:
                     inner = rep.driver.submit(
                         prompt, outer.max_new - gen, seed=outer.seed,
@@ -647,6 +713,74 @@ class ReplicaPool:
                     0.0, outer.deadline - time.monotonic()))
             time.sleep(sleep)
             backoff = min(backoff * 2, self._backoff_cap_s)
+
+    # -- prefill→decode KV handoff (disaggregated serving) -----------------
+
+    def _prefill_workers(self) -> list:
+        """Usable DEDICATED prefill replicas whose driver speaks the
+        handoff exchange, least loaded first."""
+        pres = [rep for rep in self._replicas
+                if rep.usable() and rep.role() == "prefill"
+                and getattr(rep.driver, "prefill_export", None)
+                is not None]
+        pres.sort(key=lambda r: (r.load(), r.idx))
+        return pres
+
+    def _maybe_handoff(self, preq: _PoolRequest, prompt,
+                       rep: Replica) -> None:
+        """Stage the prompt's head on a dedicated prefill worker and
+        install the exported KV rows on the chosen decode replica
+        BEFORE submitting — admission then takes the radix prefix hit,
+        which is already pinned bitwise-identical to a local prefill,
+        so disaggregation never changes output, only where prefill
+        runs.  Every failure path (no prefill worker, export refusal,
+        oversized frame, install refusal, a worker dying mid-handoff)
+        silently degrades the request to a local prefill; a prefill
+        worker that dies mid-export simply loses its staged work and
+        the request re-enters here on the next prefill candidate —
+        nothing was committed anywhere."""
+        if disagg_killed():
+            return
+        install = getattr(rep.driver, "install_handoff", None)
+        if install is None:
+            return
+        bs = getattr(rep.engine, "kv_block_size", 16) or 16
+        if len(prompt) <= bs:
+            return          # nothing exportable (the engine keeps at
+            #                 least one suffix token for decode anyway)
+        if rep.affinity(preq.affinity_key):
+            return          # already warm there: placement wins as-is
+        t0 = time.monotonic()
+        for pre in self._prefill_workers():
+            try:
+                out = pre.driver.prefill_export(prompt)
+            except RuntimeError:
+                continue    # prefill worker died between scan and ask
+            if out is None:
+                continue    # refusal (or death mid-export): next one
+            meta, blob = out
+            try:
+                n = install(meta, blob)
+            except RuntimeError:
+                n = 0
+            if n:
+                rep.note_affinity(preq.affinity_key)
+                m = self._metrics
+                if m is not None:
+                    hb = getattr(m, "handoff_bytes", None)
+                    if hb is not None:
+                        hb.inc(len(blob))
+                    hs = getattr(m, "handoff_seconds", None)
+                    if hs is not None:
+                        hs.observe(time.monotonic() - t0)
+                events.instant("request/kv_handoff",
+                               request_id=preq.handle.id,
+                               prefill_replica=pre.idx,
+                               decode_replica=rep.idx,
+                               tokens=int(n), bytes=len(blob))
+            # Decode-side refusal is final for this placement (its
+            # engine said no — e.g. pool pressure); local prefill.
+            return
 
     # -- the per-request pump ----------------------------------------------
 

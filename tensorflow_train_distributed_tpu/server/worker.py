@@ -225,6 +225,17 @@ def _factory_llama(spec: dict):
     kw = {k: spec[k] for k in _LLAMA_ENGINE_KWARGS if k in spec}
     if "prompt_buckets" in spec:
         kw["prompt_buckets"] = tuple(spec["prompt_buckets"])
+    if spec.get("draft_preset"):
+        # Speculative serving: the draft is its own preset + init seed,
+        # built as deterministically as the target, so every worker
+        # (and the in-process reference) speculates bitwise-alike.
+        dcfg = LLAMA_PRESETS[spec["draft_preset"]]
+        kw["draft_config"] = dcfg
+        kw["draft_params"] = LlamaModel(dcfg).init(
+            jax.random.PRNGKey(int(spec.get(
+                "draft_init_seed", spec.get("init_seed", 0)))),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        kw["speculative_k"] = int(spec.get("speculative_k", 3))
     eng = ServingEngine(cfg, params, **kw)
     if spec.get("warm", True):
         # Compile inside the child, before the HELLO: the parent's
@@ -303,6 +314,56 @@ def _relay(rid: int, handle, sender: proto.FrameSender, handles: dict,
     finally:
         with hlock:
             handles.pop(rid, None)
+
+
+@thread_role("pump")
+def _handoff_export(rid: int, tokens: list, driver: EngineDriver,
+                    sender: proto.FrameSender) -> None:
+    """Answer one PREFILL: run the prompt head's per-piece prefill +
+    KV export ON THE DRIVER THREAD (``driver.call`` — the engine stays
+    single-threaded) and ship the rows back as a binary KV_HANDOFF.
+    Every refusal is a KV_ACK with n=0 — the parent degrades that
+    request to a local prefill with identical output, so nothing here
+    is fatal."""
+    try:
+        out = driver.call(
+            lambda eng: getattr(eng, "export_prefix_kv",
+                                lambda t: None)(tokens),
+            timeout_s=300.0)
+    except BaseException as e:      # noqa: BLE001 — refusal, not death
+        sender.send(proto.KV_ACK, {"id": rid, "n": 0,
+                                   "error": repr(e)})
+        return
+    if out is None:
+        sender.send(proto.KV_ACK, {"id": rid, "n": 0,
+                                   "error": "nothing exportable"})
+        return
+    meta, blob = out
+    header = dict(meta, id=rid)
+    if not sender.send_binary(proto.KV_HANDOFF, header, blob):
+        # Oversized frame (or parent gone): nothing was written, the
+        # stream stays healthy — tell the parent to prefill locally.
+        sender.send(proto.KV_ACK, {"id": rid, "n": 0,
+                                   "error": "handoff frame refused"})
+
+
+@thread_role("pump")
+def _handoff_install(rid: int, meta: dict, blob: bytes,
+                     driver: EngineDriver,
+                     sender: proto.FrameSender) -> None:
+    """Install one KV_HANDOFF's rows into this worker's pool (driver
+    thread via ``driver.call``); KV_ACK carries the warm-token count
+    (0 = refused — the request prefills locally, same output)."""
+    try:
+        n = driver.call(
+            lambda eng: getattr(eng, "install_prefix_kv",
+                                lambda m, b: 0)(meta, blob),
+            timeout_s=300.0)
+    except BaseException as e:      # noqa: BLE001 — refusal, not death
+        sender.send(proto.KV_ACK, {"id": rid, "n": 0,
+                                   "error": repr(e)})
+        return
+    sender.send(proto.KV_ACK, {"id": rid, "n": int(n or 0)})
 
 
 def _jsonable_attrs(attrs: Optional[dict]) -> dict:
@@ -400,9 +461,20 @@ def _send_stats(driver: EngineDriver, engine, sender: proto.FrameSender,
 def run_worker(engine, sock: socket.socket, *,
                replica_id: Optional[int] = None, max_queue: int = 64,
                stats_interval: float = 0.25,
-               max_frame: int = proto.MAX_FRAME_BYTES) -> int:
+               max_frame: int = proto.MAX_FRAME_BYTES,
+               role: str = "both", on_drain=None) -> int:
     """Serve one engine over the frame protocol until drain or EOF.
-    Returns the process exit code (0 = clean drain / parent closed)."""
+    Returns the process exit code (0 = clean drain / parent closed).
+    ``role`` (``prefill|decode|both``) rides the HELLO: a pool doing
+    disaggregated serving routes PREFILL frames to prefill-role
+    workers and decode placements to decode-role workers; ``both``
+    (the default, and what every pre-role parent assumes) serves
+    everything.  ``on_drain`` fires when the gateway's DRAIN lands —
+    a dial-in daemon (tools/serve_worker) uses it to tell an orderly
+    scale-down from a connection drop it should re-dial after."""
+    if role not in ("prefill", "decode", "both"):
+        raise ValueError(f"role must be prefill|decode|both, "
+                         f"got {role!r}")
     rfp = sock.makefile("rb")
     wfp = sock.makefile("wb")
     sender = proto.FrameSender(wfp, max_frame)
@@ -416,6 +488,7 @@ def run_worker(engine, sock: socket.socket, *,
         "proto": proto.PROTO_VERSION,
         "pid": os.getpid(),
         "replica": replica_id,
+        "role": role,
         "mono": time.monotonic(),
         "engine": engine_info(engine),
     })
@@ -425,6 +498,8 @@ def run_worker(engine, sock: socket.socket, *,
         name="worker-stats", daemon=True).start()
 
     def _drain_and_exit():
+        if on_drain is not None:
+            on_drain()
         driver.join(None)
         # The driver resolved every handle, but the per-request relay
         # threads still have to DEQUEUE and send the final
@@ -493,6 +568,25 @@ def run_worker(engine, sock: socket.socket, *,
                     handle = handles.get(int(body["id"]))
                 if handle is not None:
                     driver.abandon(handle)
+            elif ftype == proto.PREFILL:
+                # Disaggregated serving: prefill this prompt's head and
+                # hand the KV back.  A helper thread marshals the work
+                # through driver.call — the reader must keep reading
+                # (CANCEL/DRAIN still arrive mid-export).
+                rid = int(body.get("id", -1))
+                threading.Thread(
+                    target=_handoff_export,
+                    args=(rid, list(body.get("tokens") or ()),
+                          driver, sender),
+                    name=f"worker-export-{rid}", daemon=True).start()
+            elif ftype == proto.KV_HANDOFF:
+                # Install a handed-off prefix (decode side).
+                blob = body.pop(proto.BLOB_KEY, b"")
+                rid = int(body.get("id", -1))
+                threading.Thread(
+                    target=_handoff_install,
+                    args=(rid, body, blob, driver, sender),
+                    name=f"worker-install-{rid}", daemon=True).start()
             elif ftype == proto.DRAIN:
                 threading.Thread(target=_drain_and_exit,
                                  name="worker-drain",
@@ -502,6 +596,13 @@ def run_worker(engine, sock: socket.socket, *,
             # optional frames must not kill an older worker).
     finally:
         stop.set()
+        # Release the engine: the driver thread is the only one allowed
+        # to touch it, so it must exit before a dial-in daemon reuses
+        # the engine on its next connection (and a subprocess worker
+        # whose parent vanished finishes its accepted backlog instead
+        # of orphaning it mid-decode).
+        driver.drain()
+        driver.join(30.0)
 
 
 # ── deliberately broken workers (protocol-hardening tests) ─────────────
@@ -548,6 +649,21 @@ def _run_corrupt(mode: str, sock: socket.socket) -> int:
         wfp.flush()
         rfp.read(1)
         return 0
+    if mode == "midhandoff":
+        # A healthy hello, then death in the MIDDLE of a binary
+        # KV_HANDOFF frame — the disaggregated analog of midframe:
+        # a prefill worker SIGKILLed while streaming rows.
+        proto.write_frame(wfp, proto.HELLO, {
+            "proto": proto.PROTO_VERSION, "pid": os.getpid(),
+            "replica": None, "role": "prefill",
+            "mono": time.monotonic(), "engine": {"slots": 1}})
+        frame = proto.encode_binary_frame(
+            proto.KV_HANDOFF,
+            {"id": 1, "tokens": [1, 2], "n": 2, "leaves": []},
+            b"\x00" * 4096)
+        wfp.write(frame[:len(frame) // 2])
+        wfp.flush()
+        os._exit(1)
     raise SystemExit(f"unknown --test-corrupt mode {mode!r}")
 
 
@@ -567,6 +683,10 @@ def main(argv=None) -> int:
     p.add_argument("--stats-interval", type=float, default=0.25)
     p.add_argument("--max-frame", type=int,
                    default=proto.MAX_FRAME_BYTES)
+    p.add_argument("--role", default="both",
+                   choices=("prefill", "decode", "both"),
+                   help="disaggregated serving role advertised in the "
+                        "HELLO (both = serve everything, the default)")
     p.add_argument("--test-corrupt", default="",
                    help="protocol-hardening test modes: speak broken "
                         "frames on purpose (badversion|oversize|"
@@ -591,7 +711,7 @@ def main(argv=None) -> int:
     return run_worker(engine, sock, replica_id=args.replica_id,
                       max_queue=args.max_queue,
                       stats_interval=args.stats_interval,
-                      max_frame=args.max_frame)
+                      max_frame=args.max_frame, role=args.role)
 
 
 if __name__ == "__main__":

@@ -1487,6 +1487,176 @@ class ServingEngine:
             for b in shared:
                 self._kv_pool.deref(b)
 
+    # Row-holding cache leaves, by batch-1 linear name, with the axis
+    # their rows live on: the serialization manifest for KV handoff
+    # (``kv_scales`` is [..., 2, 1, C, kvh] — rows at -2; key/value are
+    # [..., 1, C, kvh, hd] — rows at -3).
+    _KV_LEAF_ROW_AXIS = {"key_cache": -3, "value_cache": -3,
+                         "kv_scales": -2}
+
+    @thread_role("main", "driver")
+    def export_prefix_kv(self, tokens):
+        """Serialize the KV of ``tokens``' full leading blocks for a
+        prefill→decode handoff: ``(meta, blob)``, or None when there is
+        nothing exportable (linear cache, sub-block prompt, pool too
+        busy to share).
+
+        The prefill side of disaggregated serving: prefill the prompt's
+        block-aligned head (``preload_prefix`` — the tested machinery,
+        which also makes repeat prompts free on this worker), then
+        gather those pool rows back out (``_gather_prefix``) and ship
+        the bytes VERBATIM — the pool already stores the
+        ``_quantize_kv_rows`` output, so the receiving pool installs
+        bit-identical rows and the decode-side radix hit reproduces the
+        exact local-prefill output.  At least one suffix token is left
+        unexported (its logit picks the first generated token on the
+        decode worker, same as any radix hit).  Mutates engine state —
+        callers marshal onto the engine's owning thread
+        (``EngineDriver.call``)."""
+        if not self.paged or self._exact_prefill:
+            return None
+        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        bs = self.kv_block_size
+        m = max(0, (len(tokens) - 1) // bs)   # full blocks, head only
+        if m == 0:
+            return None
+        head = tokens[:m * bs]
+        matched, shared = self._radix.match(head, allow_full=True,
+                                            record=False)
+        if matched < m * bs:
+            self.preload_prefix(head)
+            matched, shared = self._radix.match(head, allow_full=True,
+                                                record=False)
+        if matched < m * bs or self._cache is None:
+            return None               # pool too busy to share the head
+        for b in shared:
+            self._kv_pool.ref(b)
+        try:
+            table_np = np.zeros((self._kv_nblk_lane,), np.int32)
+            table_np[:len(shared)] = shared
+            table_j = jnp.asarray(table_np)
+            span = jnp.int32(m * bs)
+            with self._ctx(), events.span("kv/export", tokens=m * bs):
+                pairs = [(False, self._gather_prefix(
+                    self._cache, table_j, False, span))]
+                if self._draft_model is not None:
+                    pairs.append((True, self._gather_prefix(
+                        self._d_cache, table_j, True, span)))
+                leaves, chunks = [], []
+                for draft, cache_1 in pairs:
+                    flat = jax.tree_util.tree_flatten_with_path(
+                        cache_1)[0]
+                    # Path-sorted for a deterministic wire order (the
+                    # installer replays the manifest positionally).
+                    for p, leaf in sorted(
+                            flat, key=lambda pl: self._path_key(pl[0])):
+                        name = getattr(p[-1], "key", "")
+                        axis = self._KV_LEAF_ROW_AXIS.get(name)
+                        if axis is None:
+                            continue
+                        idx = [slice(None)] * leaf.ndim
+                        idx[axis] = slice(0, m * bs)
+                        arr = np.asarray(jax.device_get(
+                            leaf[tuple(idx)]))
+                        leaves.append({
+                            "path": list(self._path_key(p)),
+                            "draft": draft,
+                            "dtype": arr.dtype.str,
+                            "shape": list(arr.shape)})
+                        chunks.append(np.ascontiguousarray(arr)
+                                      .tobytes())
+        finally:
+            for b in shared:
+                self._kv_pool.deref(b)
+        meta = {"tokens": head, "n": m * bs,
+                "draft": self._draft_model is not None,
+                "leaves": leaves}
+        return meta, b"".join(chunks)
+
+    @thread_role("main", "driver")
+    def install_prefix_kv(self, meta, blob) -> int:
+        """Install handed-off KV rows into this engine's pool + radix
+        index; returns the warm-token count (0 = refused, benign — the
+        request simply prefills locally with identical output).
+
+        The decode side of the handoff: rebuild the batch-1 linear
+        cache pair from the wire bytes (exact dtypes — the rows stay
+        bit-identical to the sender's pool) and hand it to
+        ``_seed_radix_from_cache``, the SAME path ``preload_prefix``
+        seeds the radix through, so allocation, eviction pressure, COW
+        and partial-failure semantics are all the tested ones.  Mutates
+        engine state — callers marshal onto the engine's owning thread
+        (``EngineDriver.call``)."""
+        if not self.paged or self._exact_prefill:
+            return 0
+        tokens = [int(t) for t in meta.get("tokens", ())]
+        n = int(meta.get("n", 0))
+        bs = self.kv_block_size
+        if n <= 0 or n % bs or n != len(tokens):
+            raise ValueError(f"bad handoff span: n={n} over "
+                             f"{len(tokens)} tokens (block_size={bs})")
+        if n >= self.cache_len:
+            raise ValueError(f"handoff span {n} exceeds "
+                             f"cache_len={self.cache_len}")
+        matched, _ = self._radix.match(tokens, allow_full=True,
+                                       record=False)
+        if matched >= n:
+            return n                  # already warm — nothing to do
+        if bool(meta.get("draft")) != (self._draft_model is not None):
+            return 0   # speculative mismatch: both caches must hold
+        #              # identical row sets, so refuse → local prefill
+        arrays, off = {}, 0
+        for leaf in meta.get("leaves", ()):
+            dtype = np.dtype(leaf["dtype"])
+            shape = tuple(int(d) for d in leaf["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            end = off + count * dtype.itemsize
+            if end > len(blob):
+                raise ValueError(
+                    f"handoff blob truncated: leaf {leaf['path']} "
+                    f"needs bytes [{off}, {end}) of {len(blob)}")
+            arrays[(bool(leaf.get("draft")), tuple(leaf["path"]))] = (
+                np.frombuffer(blob, dtype, count, off).reshape(shape))
+            off = end
+        if off != len(blob):
+            raise ValueError(f"handoff blob has {len(blob) - off} "
+                             f"trailing bytes")
+
+        def build_one(draft: bool):
+            want = {pk: a for (d, pk), a in arrays.items()
+                    if d is draft}
+
+            def fill(path, leaf):
+                name = getattr(path[-1], "key", "")
+                if name == "index":
+                    return jnp.full_like(leaf, n)
+                axis = self._KV_LEAF_ROW_AXIS.get(name)
+                arr = want.get(self._path_key(path))
+                if axis is None or arr is None:
+                    return leaf
+                idx = [slice(None)] * leaf.ndim
+                idx[axis] = slice(0, n)
+                want_shape = tuple(leaf[tuple(idx)].shape)
+                if arr.shape != want_shape or (arr.dtype
+                                               != leaf.dtype):
+                    raise ValueError(
+                        f"handoff leaf {self._path_key(path)} is "
+                        f"{arr.dtype}{list(arr.shape)}, this engine "
+                        f"needs {leaf.dtype}{list(want_shape)}")
+                return leaf.at[tuple(idx)].set(jnp.asarray(arr))
+
+            return jax.tree_util.tree_map_with_path(
+                fill, self._fresh_cache(1, draft=draft))
+
+        with self._ctx(), events.span("kv/install", tokens=n):
+            cache_1 = build_one(False)
+            d_cache_1 = (build_one(True)
+                         if self._draft_model is not None else None)
+            self._seed_radix_from_cache(tokens, cache_1, d_cache_1)
+        matched, _ = self._radix.match(tokens, allow_full=True,
+                                       record=False)
+        return matched
+
     def _match_prefix(self, prompt, touch: bool = False):
         """Longest stored prefix the prompt strictly extends →
         (prefix_len, (target_cache, draft_cache_or_None));

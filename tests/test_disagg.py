@@ -1,0 +1,214 @@
+"""Disaggregated serving: prefill→decode KV handoff across TCP worker
+daemons.
+
+The role split rides the dial-in transport (tests/test_netpool.py):
+workers declare ``prefill|decode|both`` in their HELLO, the pool runs
+staged prefill on prefill workers and ships the finished KV rows to
+the chosen decode worker as a binary KV_HANDOFF.  The contract pinned
+here is the repo's one serving invariant: disaggregation is a
+PLACEMENT lever, never a correctness knob — outputs are bitwise
+identical to a co-located engine (greedy tier-1; seeded sampling and
+speculative slow-tier), the shipped rows are bit-identical to the
+pool rows they came from (the shared ``_quantize_kv_rows`` recipe —
+install + re-export round-trips the exact bytes), and
+``TTD_NO_DISAGG=1`` collapses the role split without touching the
+transport.  The chaos leg (``tools/chaos_check.py --serving
+--disagg``) kills the prefill worker mid-handoff AND a decode worker
+mid-stream under load; survivors complete everything token-equal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.server.netpool import NetPool
+from tensorflow_train_distributed_tpu.server.replicas import (
+    Replica,
+    disagg_killed,
+)
+from tensorflow_train_distributed_tpu.server.worker import (
+    StubWorkerEngine,
+    _factory_llama,
+)
+from test_netpool import REPO_ROOT, SERVE_WORKER, _reap
+
+#: One spec dict for every engine in these tests — workers and the
+#: in-process reference construct bitwise-identical engines from it.
+SPEC = {"preset": "llama_tiny", "init_seed": 0, "slots": 2,
+        "cache_len": 64, "chunk": 4, "prompt_buckets": [8, 16, 32]}
+
+#: Mixed workload: the long prompts span >1 default KV block (16
+#: tokens), so their placement triggers a prefill→decode handoff; the
+#: short ones exercise the no-handoff path in the same run.
+REQS = [(list(range(3, 27)), 10), ([5, 9, 2], 6),
+        (list(range(40, 58)), 8), ([7, 11], 5)]
+
+
+def _llama_fleet(roles, spec):
+    pool = NetPool(host="127.0.0.1", port=0, scale_min=len(roles),
+                   max_workers=len(roles) + 1,
+                   monitor_poll_s=0.02).start()
+    procs = [subprocess.Popen(
+        [sys.executable, SERVE_WORKER,
+         "--dial", f"127.0.0.1:{pool.port}", "--factory", "llama",
+         "--json", json.dumps(spec), "--replica-id", str(i),
+         "--role", role],
+        cwd=REPO_ROOT, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+        for i, role in enumerate(roles)]
+    return pool, procs
+
+
+def _reference(spec, reqs, *, seeds=None):
+    eng = _factory_llama(dict(spec))
+    rids = [eng.submit(p, m, seed=seeds[i] if seeds else None)
+            for i, (p, m) in enumerate(reqs)]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def _disagg_parity(spec, *, seeds=None):
+    """One prefill + one decode worker over TCP serve the mixed
+    workload bitwise-equal to a co-located engine, with at least one
+    real KV handoff observed between distinct replicas."""
+    refs = _reference(spec, REQS, seeds=seeds)
+    rec = events.get_recorder()
+    cursor, _ = rec.events_after(0)
+    pool, procs = _llama_fleet(["prefill", "decode"], spec)
+    try:
+        assert pool.wait_ready(600), "llama workers never came up"
+        assert pool.workers_by_role() == {"prefill": 1, "decode": 1}
+        hs = [pool.submit(p, m, seed=seeds[i] if seeds else None)
+              for i, (p, m) in enumerate(REQS)]
+        outs = [h.result(timeout=300) for h in hs]
+        assert outs == refs, "disaggregated output diverged"
+        _, evs = rec.events_after(cursor)
+        handoffs = [e for e in evs if e[0] == "request/kv_handoff"]
+        assert handoffs, "no prefill→decode handoff happened"
+        for e in handoffs:
+            attrs = e[5]
+            assert attrs["prefill_replica"] != attrs["decode_replica"]
+            assert attrs["bytes"] > 0 and attrs["tokens"] >= 16
+    finally:
+        pool.join(timeout=60)
+        _reap(procs)
+
+
+def test_disagg_prefill_decode_parity_greedy():
+    """THE tentpole pin: greedy decode over a prefill+decode TCP
+    fleet — handoff taken for the long prompts — is bitwise-equal to
+    one co-located engine."""
+    _disagg_parity(SPEC)
+
+
+@pytest.mark.slow
+def test_disagg_prefill_decode_parity_seeded():
+    """Seeded sampling across the handoff: per-request rng streams
+    survive the KV rows having been prefilled on another host."""
+    _disagg_parity(dict(SPEC, temperature=0.8, top_k=40),
+                   seeds=[1000 + i for i in range(len(REQS))])
+
+
+@pytest.mark.slow
+def test_disagg_prefill_decode_parity_speculative():
+    """Speculative serving across the handoff: target AND draft pool
+    rows ship in one KV_HANDOFF (the manifest's draft leaves), and
+    the self-draft fleet still equals the co-located engine."""
+    _disagg_parity(dict(SPEC, draft_preset="llama_tiny",
+                        speculative_k=3))
+
+
+def test_handoff_rows_bitwise_equal_pool_rows():
+    """The serialization drive-by: the KV_HANDOFF blob is the pool's
+    own ``_quantize_kv_rows`` output verbatim — installing it and
+    re-exporting from the receiving pool round-trips the EXACT bytes
+    (no requantization, no dtype laundering), and the manifest
+    accounts for every byte."""
+    eng_a = _factory_llama(dict(SPEC))
+    eng_b = _factory_llama(dict(SPEC))
+    tokens = list(range(3, 27))             # 24 tokens -> one 16-row block
+    out = eng_a.export_prefix_kv(tokens)
+    assert out is not None, "export refused on a paged engine"
+    meta, blob = out
+    assert meta["n"] == 16
+    assert meta["tokens"] == tokens[:16]
+    # Manifest accounts for the blob byte-for-byte, and the int8 pool
+    # ships with its scales (the one shared quantization recipe).
+    sizes = [int(np.prod(leaf["shape"]))
+             * np.dtype(leaf["dtype"]).itemsize
+             for leaf in meta["leaves"]]
+    assert sum(sizes) == len(blob)
+    dtypes = {leaf["dtype"] for leaf in meta["leaves"]}
+    if "int8" in dtypes:
+        assert "float32" in dtypes          # per-row scales ride along
+    # Install into B, re-export from B's pool: bit-identical rows.
+    assert eng_b.install_prefix_kv(dict(meta), blob) == 16
+    meta2, blob2 = eng_b.export_prefix_kv(tokens)
+    assert meta2["leaves"] == meta["leaves"]
+    assert blob2 == blob
+    # And the installed prefix decodes bitwise-equal to the exporter.
+    ra = eng_a.submit(tokens, 8)
+    rb = eng_b.submit(tokens, 8)
+    assert eng_a.run()[ra] == eng_b.run()[rb]
+
+
+def test_kill_switch_collapses_role_split(monkeypatch):
+    """TTD_NO_DISAGG=1 collapses the role split (every worker routes
+    as 'both', no handoffs are attempted) WITHOUT touching the TCP
+    transport — the fleet keeps serving co-located-style."""
+    eng = StubWorkerEngine(slots=1)
+    eng.role = "prefill"
+    rep = Replica(0, eng, max_queue=4, default_timeout_s=None,
+                  retry_after_s=1.0)
+    monkeypatch.setenv("TTD_NO_DISAGG", "1")
+    assert disagg_killed()
+    assert rep.role() == "both"
+    assert rep.decode_capable()     # takes placements again
+    monkeypatch.setenv("TTD_NO_DISAGG", "0")
+    assert not disagg_killed()
+    assert rep.role() == "prefill"
+    assert not rep.decode_capable()
+
+
+# ── the chaos gate (tools/chaos_check.py --serving --disagg) ───────────
+
+
+def _chaos_disagg(**kw):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        from chaos_check import run_serving_chaos_disagg
+    finally:
+        sys.path.pop(0)
+    return run_serving_chaos_disagg(**kw)
+
+
+def test_chaos_check_serving_disagg_smoke():
+    """Tier-1 smoke of the disaggregated chaos gate: 1 prefill + 2
+    decode TCP workers under mixed load; the prefill worker is
+    SIGKILLed right after the first observed handoff and a decode
+    worker takes a real killpid mid-stream — survivors complete
+    EVERYTHING token-equal to a co-located run (later long prompts
+    degrade to local prefill, dead decode streams fail over via
+    resume-from-token)."""
+    verdict = _chaos_disagg(sampling=False, n_requests=5)
+    assert verdict["ok"], verdict
+    assert verdict["checks"]["streams_match_reference"]
+    assert verdict["checks"]["handoff_happened"]
+    assert verdict["checks"]["prefill_worker_dead"]
+    assert verdict["checks"]["decode_worker_dead"]
+
+
+@pytest.mark.slow
+def test_chaos_check_serving_disagg_sampled():
+    """The seeded-sampling leg: per-request rng streams survive both
+    the handoff and the double kill."""
+    verdict = _chaos_disagg(sampling=True, n_requests=6)
+    assert verdict["ok"], verdict
+    assert verdict["checks"]["streams_match_reference"]

@@ -48,7 +48,18 @@ def test_frame_roundtrip_every_type():
     for ftype in proto.FRAME_NAMES:
         bodies[ftype] = {"t": ftype, "payload": [1, 2, 3],
                          "text": "μtf-8 – ok"}
-        proto.write_frame(buf, ftype, bodies[ftype])
+        if ftype in proto.BINARY_FRAMES:
+            # Binary types have exactly one legal writer; a JSON body
+            # would be mis-parsed as a binary layout on the far side.
+            with pytest.raises(proto.ProtocolError, match="binary"):
+                proto.write_frame(buf, ftype, bodies[ftype])
+            blob = bytes(range(256)) * 3
+            buf.write(proto.encode_binary_frame(
+                ftype, bodies[ftype], blob))
+            bodies[ftype] = dict(bodies[ftype],
+                                 **{proto.BLOB_KEY: blob})
+        else:
+            proto.write_frame(buf, ftype, bodies[ftype])
     buf.seek(0)
     for ftype in proto.FRAME_NAMES:
         got = proto.read_frame(buf)

@@ -680,6 +680,164 @@ def bench_gateway_procs_ab(preset, slots, chunk, max_queue, clients,
     return rec
 
 
+def bench_gateway_disagg_ab(preset, slots, chunk, max_queue, clients,
+                            requests_per_client, prompt_range,
+                            new_range, cache_len, seed, timeout,
+                            decode_workers=2, reps=3):
+    """Disaggregated vs co-located TCP fleets, one workload: two
+    ``NetPool`` gateways — one behind a 1-prefill + N-decode role
+    split, one behind N+1 role-``both`` workers — serve identical
+    closed-loop client fleets (long-prompt-heavy, so placements cross
+    the KV-block threshold and actually hand off) as
+    leg-order-alternating BACK-TO-BACK PAIRS; the headline wall ratio
+    is the MEDIAN of per-pair ratios (the established noise
+    discipline).  The disagg legs also scrape the gateway's own
+    handoff counters: ``handoff_bytes_per_request`` and the handoff
+    count — the transfer tax the ratio is buying placement freedom
+    with.  Workers are real ``tools/serve_worker.py`` daemons pinned
+    to the CPU backend (same-host A/B — the harness measures the
+    protocol + routing overhead, not cross-host bandwidth)."""
+    import subprocess
+
+    import jax
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+    )
+    from tensorflow_train_distributed_tpu.server import (
+        NetPool, ServingGateway,
+    )
+
+    cfg = LLAMA_PRESETS[preset]
+    vocab = min(cfg.vocab_size, 30_000)
+    cache_len = cache_len or (prompt_range[1] + new_range[1] + 24)
+    buckets = [8, 16, 32, 48]
+    while buckets[-1] < prompt_range[1]:
+        buckets.append(buckets[-1] * 2)
+    loop_args = (clients, requests_per_client, prompt_range, new_range)
+    spec_json = json.dumps(dict(preset=preset, init_seed=0,
+                                slots=slots, chunk=chunk,
+                                cache_len=cache_len,
+                                prompt_buckets=buckets))
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def fleet(roles):
+        pool = NetPool(host="127.0.0.1", port=0, scale_min=len(roles),
+                       max_workers=len(roles), max_queue=max_queue,
+                       monitor_poll_s=0.02)
+        gw = ServingGateway(pool, host="127.0.0.1", port=0).start()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.join(here, "serve_worker.py"),
+             "--dial", f"127.0.0.1:{pool.port}",
+             "--factory", "llama", "--json", spec_json,
+             "--replica-id", str(i), "--role", role],
+            cwd=os.path.dirname(here), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for i, role in enumerate(roles)]
+        return pool, gw, procs
+
+    pool_d, gw_d, procs_d = fleet(
+        ["prefill"] + ["decode"] * decode_workers)
+    pool_c, gw_c, procs_c = fleet(["both"] * (decode_workers + 1))
+    urls = {"disagg": f"http://127.0.0.1:{gw_d.port}",
+            "colocated": f"http://127.0.0.1:{gw_c.port}"}
+    try:
+        for pool, what in ((pool_d, "disagg"), (pool_c, "colocated")):
+            if not pool.wait_ready(timeout=600.0):
+                raise RuntimeError(f"{what} workers failed to come up")
+        if pool_d.workers_by_role() != {"prefill": 1,
+                                        "decode": decode_workers}:
+            raise RuntimeError("disagg fleet lost its role split")
+        best = {}
+        ratios = []
+        handoffs_total = 0
+        handoff_bytes_total = 0
+        disagg_ok_total = 0
+        for i in range(max(1, reps)):
+            walls = {}
+            order = (("disagg", "colocated") if i % 2 == 0
+                     else ("colocated", "disagg"))
+            for leg in order:
+                base = _scrape(urls[leg])
+                rec = _run_closed_loop(urls[leg], *loop_args, vocab,
+                                       seed, timeout)
+                prom = _scrape(urls[leg])
+                if leg == "disagg":
+                    # The transfer tax, from the gateway's own
+                    # counters: bytes shipped per completed request
+                    # and how many placements actually handed off.
+                    rec["handoffs"] = int(
+                        _prom_sample(prom,
+                                     "ttd_gateway_handoff_seconds"
+                                     "_count")
+                        - _prom_sample(base,
+                                       "ttd_gateway_handoff_seconds"
+                                       "_count"))
+                    handoffs_total += rec["handoffs"]
+                    leg_bytes = int(
+                        _prom_sample(prom,
+                                     "ttd_gateway_handoff_bytes"
+                                     "_total")
+                        - _prom_sample(base,
+                                       "ttd_gateway_handoff_bytes"
+                                       "_total"))
+                    handoff_bytes_total += leg_bytes
+                    disagg_ok_total += rec["n_ok"]
+                    rec["handoff_bytes_per_request"] = round(
+                        leg_bytes / max(1, rec["n_ok"]), 1)
+                walls[leg] = rec["wall_s"]
+                if (leg not in best
+                        or rec["wall_s"] < best[leg]["wall_s"]):
+                    best[leg] = rec
+            ratios.append(walls["disagg"] / walls["colocated"])
+        ratios.sort()
+        if handoffs_total == 0:
+            raise RuntimeError(
+                "disagg legs never handed off — the workload's "
+                "prompts all fit one KV block; widen --prompt-range")
+    finally:
+        gw_d.drain(timeout=60)
+        gw_c.drain(timeout=60)
+        for proc in procs_d + procs_c:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_gateway_disagg_tokens_per_sec",
+        "value": best["disagg"]["tokens_per_sec"],
+        "unit": "generated tokens/sec, disaggregated prefill/decode "
+                "TCP fleet (wall_ratio_median: disagg/colocated, "
+                "median of per-pair wall ratios)",
+        "prefill_workers": 1,
+        "decode_workers": decode_workers,
+        "colocated_workers": decode_workers + 1,
+        "slots": slots,
+        "chunk": chunk,
+        "cache_len": cache_len,
+        "prompt_buckets": buckets,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "max_queue": max_queue,
+        "reps": reps,
+        "disagg": best["disagg"],
+        "colocated": best["colocated"],
+        "wall_ratio_median": round(ratios[len(ratios) // 2], 3),
+        "pair_wall_ratios": [round(r, 4) for r in ratios],
+        "handoffs_total": handoffs_total,
+        # Aggregated over ALL disagg legs: later legs ride warm
+        # prefix caches and hand off less, so a per-leg number from
+        # the best (warmest) leg would underreport the transfer tax.
+        "handoff_bytes_per_request": round(
+            handoff_bytes_total / max(1, disagg_ok_total), 1),
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--base-url", default="",
@@ -704,6 +862,15 @@ def main(argv=None) -> int:
                         "leg (real SIGKILL vs the in-process kill9 "
                         "vanish) — in-process runs only; uses "
                         "--replicas (min 2) workers per leg")
+    p.add_argument("--disagg", action="store_true",
+                   help="A/B a DISAGGREGATED TCP fleet (1 prefill + "
+                        "--replicas decode serve_worker daemons, KV "
+                        "handoff on long prompts) against a co-located "
+                        "fleet of the same worker count on the same "
+                        "closed-loop workload: tok/s + TTFT per leg, "
+                        "the median of per-pair wall ratios, and the "
+                        "gateway-scraped handoff bytes/request "
+                        "(in-process runs only; CPU-pinned workers)")
     p.add_argument("--max-queue", type=int, default=16)
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--requests-per-client", type=int, default=8)
@@ -764,9 +931,22 @@ def main(argv=None) -> int:
         raise SystemExit("--replica-procs builds its own A/B gateways "
                          "in-process; it composes with neither "
                          "--base-url nor --mixed")
+    if args.disagg and (args.base_url or args.mixed
+                        or args.replica_procs):
+        raise SystemExit("--disagg builds its own A/B fleets "
+                         "in-process; it composes with none of "
+                         "--base-url, --mixed, --replica-procs")
     try:
         with cm:
-            if args.replica_procs:
+            if args.disagg:
+                rec = bench_gateway_disagg_ab(
+                    args.preset, args.slots, args.chunk,
+                    args.max_queue, args.clients,
+                    args.requests_per_client, prompt_range, new_range,
+                    args.cache_len or None, args.seed, args.timeout,
+                    decode_workers=max(2, args.replicas),
+                    reps=args.reps)
+            elif args.replica_procs:
                 rec = bench_gateway_procs_ab(
                     args.preset, args.slots, args.chunk,
                     args.max_queue, args.clients,

@@ -34,6 +34,15 @@ in that worker's own environment — the gateway process survives, the
 dead worker is classified "killed by signal 9" in /healthz, streams
 stay token-equal, and the elastic pool respawns the corpse.
 
+``--serving --disagg`` runs the DISAGGREGATED leg over TCP dial-in
+workers (``server.netpool`` + ``tools/serve_worker.py``): a 1-prefill
++ 2-decode fleet under mixed load loses the prefill worker the moment
+the first KV handoff is observed AND one decode worker to a real
+in-worker SIGKILL mid-stream — survivors must complete every request
+token-equal to an uninterrupted co-located run, with later long
+prompts degrading to local prefill and dead-decode streams failing
+over via resume-from-token.
+
 ``--train-elastic`` runs the ELASTIC-MESH chaos gate: a supervised
 8-device training run loses half its devices mid-run (the
 ``mesh:device_lost`` fault point), the supervisor classifies the exit
@@ -48,6 +57,7 @@ Usage::
 
     python tools/chaos_check.py [--workdir DIR] [--steps 8]
     python tools/chaos_check.py --serving
+    python tools/chaos_check.py --serving --disagg
     python tools/chaos_check.py --train-elastic
 """
 
@@ -598,6 +608,220 @@ def run_serving_chaos_procs(*, sampling: bool = True,
             [(r[0] if r else "no result") for r in results]}
 
 
+def run_serving_chaos_disagg(*, sampling: bool = True,
+                             n_requests: int = 6,
+                             kill_dispatch: int = 2,
+                             watchdog_timeout_s: float = 30.0,
+                             timeout_s: float = 600.0) -> dict:
+    """The DISAGGREGATED leg of the serving chaos gate: a 1-prefill +
+    2-decode TCP dial-in fleet (``server.netpool`` +
+    ``tools/serve_worker.py``) under mixed long-prompt/short-prompt
+    streaming load loses BOTH halves of the split:
+
+    - the prefill worker is SIGKILLed the moment the first KV handoff
+      is observed (mid-handoff under load — every later long prompt
+      must degrade to LOCAL prefill on a decode worker);
+    - decode worker 1 takes a REAL ``os.kill(pid, SIGKILL)`` at its
+      ``kill_dispatch``'th dispatch (the killpid fault armed in ITS
+      environment, scoped by its ``--replica-id``) — mid-stream, so
+      in-flight streams fail over via resume-from-token.
+
+    The gate asserts every accepted request completes on the
+    survivors with tokens EQUAL to an uninterrupted co-located
+    in-process run (greedy and seeded legs — disaggregation plus a
+    double kill is still not a correctness knob), both corpses are
+    classified "vanished without BYE"/disconnected against their real
+    pids, at least one handoff and one failover actually happened,
+    and /healthz stays routable.
+
+    ``kill_dispatch`` must stay within decode worker 1's GUARANTEED
+    dispatch count under the worst placement skew (same rule as the
+    in-process leg): any one placed request yields at least two
+    dispatches (bucketed prefill + a decode chunk), and with
+    ``n_requests`` concurrent streams across two decode workers the
+    load-ranked placement hands every decode worker at least one —
+    so 2 always fires, while a larger ordinal can silently never
+    trigger and the run reports no-death instead of chaos parity."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform("cpu")
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+        LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import events
+    from tensorflow_train_distributed_tpu.server import (
+        NetPool,
+        ServingGateway,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    checks = {}
+    kw = dict(slots=2, cache_len=64, chunk=4)
+    if sampling:
+        kw.update(temperature=0.8, top_k=40)
+    rng = np.random.default_rng(0)
+    # Mixed load: even requests span >1 KV block (16 tokens) so their
+    # placement triggers a prefill→decode handoff; odd ones are short
+    # decode-heavy streams that keep the decode workers dispatching.
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(18, 30)) if i % 2 == 0 else int(
+            rng.integers(2, 8))
+        reqs.append(([int(t) for t in rng.integers(1, 200, plen)],
+                     int(rng.integers(6, 12)), 1000 + i))
+
+    # Reference: the same requests on ONE uninterrupted co-located
+    # engine, built exactly as the workers build theirs.
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    ref_eng = ServingEngine(cfg, params,
+                            prompt_buckets=(8, 16, 32), **kw)
+    rids = [ref_eng.submit(p, m, seed=s if sampling else None)
+            for p, m, s in reqs]
+    ref_out = ref_eng.run()
+    refs = [ref_out[r] for r in rids]
+
+    pool = NetPool(host="127.0.0.1", port=0,
+                   scale_min=3, max_workers=4,
+                   max_queue=4 * n_requests,
+                   watchdog_timeout_s=watchdog_timeout_s,
+                   monitor_poll_s=0.02)
+    # The gateway's start() starts the pool (and with it the TCP
+    # listener) — the workers can only learn the port after it.
+    gw = ServingGateway(pool, host="127.0.0.1", port=0).start()
+    spec_json = _json.dumps(dict(preset="llama_tiny", init_seed=0,
+                                 prompt_buckets=[8, 16, 32], **kw))
+
+    def worker(rid, role, extra_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "serve_worker.py"),
+             "--dial", f"127.0.0.1:{pool.port}",
+             "--factory", "llama", "--json", spec_json,
+             "--replica-id", str(rid), "--role", role],
+            cwd=REPO_ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    procs = [
+        worker(0, "prefill"),
+        worker(1, "decode",
+               {"TTD_FAULT_PLAN":
+                f"serve:dispatch:{kill_dispatch}:killpid:replica=1"}),
+        worker(2, "decode"),
+    ]
+    handoffs = 0
+    try:
+        checks["workers_ready"] = pool.wait_ready(timeout=timeout_s)
+        rec = events.get_recorder()
+        cursor, _ = rec.events_after(0)
+        results: list = [None] * len(reqs)
+
+        def client(i):
+            prompt, max_new, seed = reqs[i]
+            body = {"prompt": prompt, "max_new": max_new,
+                    "stream": True}
+            if sampling:
+                body["seed"] = seed
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/generate",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    toks, err = [], None
+                    for raw in r:
+                        obj = _json.loads(raw)
+                        if "tokens" in obj:
+                            toks.extend(obj["tokens"])
+                        elif "error" in obj:
+                            err = obj["error"]
+                    results[i] = (err, list(prompt) + toks)
+            except OSError as e:
+                results[i] = (f"{type(e).__name__}: {e}", None)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        # Kill the prefill worker the instant the first handoff lands
+        # (mid-handoff under load: more exchanges are imminent and
+        # must degrade to local prefill on the decode side).
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            cursor, evs = rec.events_after(cursor)
+            handoffs += sum(1 for e in evs
+                            if e[0] == "request/kv_handoff")
+            if handoffs:
+                procs[0].kill()
+                break
+            if all(t0 is not None for t0 in results):
+                break
+            time.sleep(0.005)
+        for t in threads:
+            t.join()
+
+        checks["all_completed"] = all(
+            r is not None and r[0] is None for r in results)
+        checks["streams_match_reference"] = checks[
+            "all_completed"] and all(
+            r[1] == ref for r, ref in zip(results, refs))
+        checks["handoff_happened"] = handoffs >= 1
+        states = pool.replica_states()
+
+        def dead_as_disconnect(pid):
+            dead = [s for s in states
+                    if s["state"] == "dead" and s.get("pid") == pid]
+            return (len(dead) == 1
+                    and dead[0].get("failure_class") == "disconnected")
+
+        checks["prefill_worker_dead"] = dead_as_disconnect(
+            procs[0].pid)
+        checks["decode_worker_dead"] = dead_as_disconnect(
+            procs[1].pid)
+        checks["failover_happened"] = (
+            gw.metrics.failovers.value() >= 1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz", timeout=10) as r:
+            checks["healthz_routable"] = (
+                r.status == 200
+                and _json.loads(r.read())["status"]
+                in ("ok", "degraded"))
+    finally:
+        gw.drain(timeout=60)
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+    return {"ok": all(checks.values()), "checks": checks,
+            "mode": "serving-disagg",
+            "leg": "sampled" if sampling else "greedy",
+            "failovers": gw.metrics.failovers.value(),
+            "handoffs": handoffs,
+            "results": [] if all(checks.values()) else
+            [(r[0] if r else "no result") for r in results]}
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser(
@@ -622,6 +846,14 @@ def main(argv=None) -> int:
                         "worker's own environment); survivors must "
                         "complete everything token-equal and the "
                         "elastic pool must respawn the corpse")
+    p.add_argument("--disagg", action="store_true",
+                   help="with --serving: run the DISAGGREGATED leg — "
+                        "a 1-prefill + 2-decode TCP dial-in fleet "
+                        "loses the prefill worker mid-handoff AND a "
+                        "decode worker mid-stream (real SIGKILLs); "
+                        "survivors must complete everything "
+                        "token-equal with later long prompts "
+                        "degrading to local prefill")
     p.add_argument("--train-elastic", action="store_true",
                    help="elastic mesh chaos instead: a supervised "
                         "8-device training run loses half its devices "
@@ -645,18 +877,25 @@ def main(argv=None) -> int:
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
     if args.serving:
-        run = (run_serving_chaos_procs if args.procs
+        if args.procs and args.disagg:
+            p.error("--procs and --disagg are separate serving legs; "
+                    "pick one")
+        run = (run_serving_chaos_disagg if args.disagg
+               else run_serving_chaos_procs if args.procs
                else run_serving_chaos)
         greedy = run(sampling=False)
         sampled = run(sampling=True)
         verdict = {"ok": greedy["ok"] and sampled["ok"],
-                   "mode": ("serving-procs" if args.procs
+                   "mode": ("serving-disagg" if args.disagg
+                            else "serving-procs" if args.procs
                             else "serving"),
                    "greedy": greedy, "sampled": sampled}
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
     if args.procs:
         p.error("--procs modifies --serving; pass both")
+    if args.disagg:
+        p.error("--disagg modifies --serving; pass both")
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_check_")
     os.makedirs(workdir, exist_ok=True)
     try:

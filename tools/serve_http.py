@@ -129,6 +129,53 @@ def _serve_procs(args, cfg) -> int:
     return 0
 
 
+def _serve_net(args, cfg) -> int:
+    """The multi-host gateway: a ``NetPool`` listens on ``--listen``
+    and standalone worker daemons (``tools/serve_worker.py``, any
+    machine) dial in, HELLO their ``--role``, and become replicas.
+    Dedicated prefill workers stage prompts and hand finished KV to
+    decode workers over binary KV_HANDOFF frames (disaggregated
+    serving; ``TTD_NO_DISAGG=1`` collapses the role split, workers
+    stay connected).  Engine flags on THIS CLI only drive gateway-side
+    screening — each worker builds its engine from its OWN flags."""
+    from tensorflow_train_distributed_tpu.server import (
+        NetPool,
+        ServingGateway,
+    )
+
+    lhost, sep, lport = args.listen.rpartition(":")
+    if not sep or not lport.isdigit():
+        raise SystemExit(f"--listen wants HOST:PORT, got {args.listen!r}")
+    scale_min = args.scale_min or args.replicas
+    max_workers = max(args.scale_max or args.replicas, scale_min)
+    pool = NetPool(
+        host=lhost or "0.0.0.0", port=int(lport),
+        scale_min=scale_min, max_workers=max_workers,
+        max_queue=args.max_queue,
+        validate=make_vocab_validator(cfg.vocab_size),
+        default_timeout_s=args.default_timeout or None,
+        retry_after_s=args.retry_after,
+        watchdog_timeout_s=args.watchdog_timeout or None,
+        max_restarts=args.restart_budget)
+    gw = ServingGateway(pool, host=args.host, port=args.port,
+                        default_max_new=args.max_new)
+    gw.install_signal_handlers(drain_timeout=args.drain_timeout or None)
+    gw.start()
+    print(f"worker listener on {lhost or '0.0.0.0'}:{pool.port}; "
+          f"waiting for {scale_min} dial-in workers...", flush=True)
+    if not pool.wait_ready(timeout=600.0):
+        print("workers failed to dial in inside 600s; draining",
+              flush=True)
+        gw.drain(timeout=30)
+        return 1
+    print(f"gateway listening on {args.host}:{gw.port} "
+          f"(config={args.config}, dial-in workers, "
+          f"scale_min={scale_min}, max_workers={max_workers}, "
+          f"max_queue={args.max_queue})", flush=True)
+    gw.wait()           # until SIGTERM/SIGINT drains
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_engine_args(p)
@@ -153,6 +200,17 @@ def main(argv=None) -> int:
                         "survivor from its last streamed token "
                         "(TTD_NO_FAILOVER=1 forces the single-engine "
                         "path)")
+    p.add_argument("--listen", default="", metavar="HOST:PORT",
+                   help="multi-host serving: listen here for "
+                        "tools/serve_worker.py daemons to DIAL IN as "
+                        "replicas (same frame protocol as "
+                        "--replica-procs, across machines; workers "
+                        "declare --role prefill|decode|both for "
+                        "disaggregated prefill→decode KV handoff; "
+                        "--replicas/--scale-min is the dial-in floor "
+                        "wait_ready blocks on, --scale-max the fleet "
+                        "cap; TTD_NO_DISAGG=1 collapses the role "
+                        "split)")
     p.add_argument("--replica-procs", action="store_true",
                    help="run each replica as a SUBPROCESS worker "
                         "(server.procpool) speaking the length-prefixed "
@@ -207,6 +265,8 @@ def main(argv=None) -> int:
     _, cfg, is_moe = resolve_decoder_task(args.config, "serving")
     prefix_ids = parse_prefix_arg(args, cfg)
 
+    if args.listen:
+        return _serve_net(args, cfg)
     if args.replica_procs:
         from tensorflow_train_distributed_tpu.server.procpool import (
             proc_replicas_killed,
