@@ -446,9 +446,82 @@ class EngineDriver:
         while self._calls:
             self._calls.popleft()._fail(RuntimeError(reason))
 
+    @thread_role("handler", "pump", "main", "reader")
+    def export_lane(self, request_id: int,
+                    timeout_s: Optional[float] = None):
+        """Export a live request's migration state AND retire it here,
+        atomically on the engine-owning thread: ``(meta, blob)`` or
+        None when the request is unknown, already finished, or the
+        engine cannot export.
+
+        The source half of live migration, driver level.  The whole
+        snapshot-then-cancel runs as ONE ``call()`` closure between
+        decode steps, so not a single token can generate after the
+        exported snapshot — the no-token-lost contract's anchor.  On
+        success the request leaves this replica as terminal status
+        ``migrated`` (its local handle resolves with an error nobody
+        should still be reading — the pool re-homed the stream).  An
+        admitted-but-not-yet-engine-queued request exports as pure
+        parameters (``kind="queued"``) without touching the engine."""
+        def _export(engine):
+            with self._cv:
+                for i, h in enumerate(self._admit):
+                    if h.id == request_id:
+                        del self._admit[i]
+                        meta = {"kind": "queued",
+                                "prompt": list(h.prompt),
+                                "max_new": int(h.max_new),
+                                "seed": h.seed,
+                                "resume_from": int(h.resume_from),
+                                "kv": None}
+                        self._retire_migrated(h)
+                        return meta, b""
+                rid = next((r for r, h in self._inflight.items()
+                            if h.id == request_id), None)
+            if rid is None:
+                return None
+            ex = getattr(engine, "export_lane", None)
+            if ex is None:
+                return None
+            out = ex(rid)
+            if out is None:
+                return None
+            engine.cancel(rid)
+            with self._cv:
+                handle = self._inflight.pop(rid, None)
+            if handle is not None:
+                self._retire_migrated(handle)
+            return out
+        return self.call(_export, timeout_s)
+
+    @thread_role("handler", "pump", "main", "reader")
+    def install_lane(self, meta, blob,
+                     timeout_s: Optional[float] = None) -> int:
+        """Install a migrated lane's KV on this replica's engine (the
+        target half); returns the warm-token count (0 = refused or
+        nothing shipped — the re-placed request prefills locally).
+        Marshalled through ``call()`` like every mutating engine
+        touch."""
+        return self.call(
+            lambda eng: getattr(eng, "install_lane",
+                                lambda m, b: 0)(meta, blob),
+            timeout_s)
+
+    def _retire_migrated(self, handle: RequestHandle) -> None:
+        """Terminal bookkeeping for a request that left this replica
+        alive: status ``migrated`` (the /v1/requests answer on the
+        source), retire event for the flight recorder, and a resolve
+        that unblocks any local reader with a pointer error."""
+        self._count("migrated")
+        self._set_terminal(handle.id, "migrated")
+        events.instant("request/retire", request_id=handle.id,
+                       status="migrated", **self._ev_attrs)
+        handle._resolve(None, RuntimeError(
+            f"request {handle.id} migrated to another replica"))
+
     def request_status(self, request_id: int) -> str:
         """Lifecycle answer for /v1/requests/<id>: a remembered
-        terminal status (``ok|expired|invalid|error``), else
+        terminal status (``ok|expired|invalid|error|migrated``), else
         ``queued`` (admitted, not yet in the engine), ``active``
         (in the engine), or ``unknown`` (never seen / evicted)."""
         with self._cv:

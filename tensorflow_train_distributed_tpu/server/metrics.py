@@ -382,6 +382,25 @@ class GatewayMetrics:
             "ttd_gateway_handoff_seconds",
             "Prefill-export-to-decode-install wall time per "
             "successful KV handoff.")
+        # Live mid-stream migration (drain/rebalance/defragment): how
+        # often lanes move between replicas without re-prefill, how
+        # long each move takes end to end (export → install →
+        # re-placed), and the serialized KV volume it ships.  All
+        # three stay flat under TTD_NO_MIGRATION=1 and for
+        # single-replica pools (nothing to move to).
+        self.migrations = r.counter(
+            "ttd_gateway_migrations_total",
+            "Active lanes live-migrated between replicas (drain "
+            "evacuation, explicit migrate(), defragmentation) "
+            "without re-prefilling.")
+        self.migration_seconds = r.histogram(
+            "ttd_gateway_migration_seconds",
+            "Source-export-to-target-install wall time per "
+            "successful lane migration.")
+        self.migrated_kv_bytes = r.counter(
+            "ttd_gateway_migrated_kv_bytes_total",
+            "Serialized KV bytes (int8 pool rows + scales) shipped in "
+            "successful lane migrations.")
         # Fraction of the engine's host harvest/refill time hidden
         # under device compute by async decode pipelining — the
         # driver-visible proof the overlap path engages (0 under the

@@ -69,6 +69,7 @@ from tensorflow_train_distributed_tpu.server.driver import (
 from tensorflow_train_distributed_tpu.server.replicas import (
     Replica,
     ReplicaPool,
+    migration_killed,
 )
 
 logger = logging.getLogger(__name__)
@@ -466,6 +467,20 @@ class ProcDriver:
             # (binary KV_HANDOFF) or a decode worker's install verdict
             # (KV_ACK) — either resolves the waiting handoff exchange.
             self._resolve_handoff(body)
+        elif ftype == proto.MIGRATE:
+            # A worker's exported lane (the reply to our MIGRATE
+            # export request).  The manifest version is validated
+            # HERE, before any waiter sees the body: installing a
+            # misread lane would corrupt a live stream, so a mismatch
+            # is a classified protocol failure of this ONE replica
+            # (the interrupted request completes via resume-from-token
+            # failover, never a poisoned install).
+            v = int(body.get("v") or 0)
+            if v != proto.MIGRATE_VERSION:
+                raise proto.ProtocolError(
+                    f"MIGRATE manifest version {v} != "
+                    f"{proto.MIGRATE_VERSION}")
+            self._resolve_handoff(body)
         elif ftype == proto.DIED:
             self._failed = RuntimeError(
                 f"worker driver died: {body.get('error')}")
@@ -859,6 +874,71 @@ class ProcDriver:
             return 0
         return int(body.get("n") or 0)
 
+    # -- live mid-stream migration ---------------------------------------
+
+    @thread_role("pump", "handler", "main")
+    def export_lane(self, request_id: int,
+                    timeout_s: float = 60.0) -> Optional[tuple]:
+        """Ask THIS worker to export request ``request_id``'s live
+        lane (token history, rng counter, KV block rows in the
+        KV_HANDOFF byte recipe) and retire it there; returns
+        ``(meta, blob)`` or None on refusal/timeout/death.  On success
+        the request is terminal ``migrated`` on this replica — the
+        pool re-homes the stream; the worker-relayed RETIRE for the
+        moved id is absorbed by the ``_recs`` pop here (whichever
+        lands first wins, both are the same verdict)."""
+        if not self.alive():
+            return None
+        hid, pend = self._new_handoff()
+        s = self._sender
+        hdr = {"id": hid, "rid": int(request_id), "op": "export",
+               "v": proto.MIGRATE_VERSION}
+        # An export REQUEST is a binary MIGRATE with an empty blob —
+        # one frame type serves both directions of the exchange.
+        if s is None or not s.send_binary(proto.MIGRATE, hdr, b""):
+            self._drop_handoff(hid)
+            return None
+        if not pend.event.wait(timeout_s):
+            self._drop_handoff(hid)
+            return None
+        body = pend.body
+        if body is None:                # worker died mid-export
+            return None
+        body = dict(body)
+        blob = body.pop(proto.BLOB_KEY, b"") or b""
+        if body.get("error") or "kind" not in body:
+            return None                 # KV_ACK refusal
+        body.pop("id", None)
+        body.pop("v", None)
+        with self._lock:
+            rec = self._recs.pop(int(request_id), None)
+        if rec is not None:
+            self._set_terminal(int(request_id), "migrated")
+        return body, blob
+
+    @thread_role("pump", "handler", "main")
+    def install_lane(self, meta: dict, blob: bytes,
+                     timeout_s: float = 60.0) -> int:
+        """Forward a migrated lane's KV into THIS worker's paged pool;
+        returns the warm-token count (0 = refused — the re-placed
+        request prefills locally, the failover path)."""
+        if not self.alive():
+            return 0
+        hid, pend = self._new_handoff()
+        s = self._sender
+        if s is None or not s.send_binary(
+                proto.MIGRATE,
+                dict(meta, id=hid, v=proto.MIGRATE_VERSION), blob):
+            self._drop_handoff(hid)
+            return 0
+        if not pend.event.wait(timeout_s):
+            self._drop_handoff(hid)
+            return 0
+        body = pend.body
+        if body is None:                # worker died mid-install
+            return 0
+        return int(body.get("n") or 0)
+
     def poison(self, reason: str) -> None:
         """Fence a declared-dead worker: for a subprocess the fence is
         the real thing — SIGKILL.  A wedged worker that would
@@ -1118,9 +1198,24 @@ class ProcPool(ReplicaPool):
             self._spawn("scale_up")
             return
         # 3) Scale down at sustained idle — ONE draining worker at a
-        # time (the staged-drain rule), never below scale_min.
+        # time (the staged-drain rule), never below scale_min.  With
+        # live migration available the idle test relaxes to a PACK
+        # test: the tail worker may still hold lanes as long as the
+        # rest of the accepting fleet has spare slots for all of them
+        # — `_evacuate` moves the streams, then the (now-empty) victim
+        # drains.  Long-tail stragglers stop pinning fleet size.
+        packable = False
+        if (len(accepting) > self._scale_min and self.waiting() == 0
+                and self.active_slots() > 0
+                and not migration_killed()):
+            tail = accepting[-1]
+            lanes = tail.driver.active_slots()
+            spare = sum(max(0, r.slots - r.driver.active_slots())
+                        for r in accepting if r is not tail)
+            packable = lanes <= spare
         if (len(accepting) > self._scale_min
-                and self.waiting() == 0 and self.active_slots() == 0):
+                and self.waiting() == 0
+                and (self.active_slots() == 0 or packable)):
             if self._idle_since is None:
                 self._idle_since = now
             elif (now - self._idle_since >= self._idle_grace_s
@@ -1132,6 +1227,7 @@ class ProcPool(ReplicaPool):
                             "(%d accepting, scale_min %d)",
                             now - self._idle_since, victim.idx,
                             len(accepting), self._scale_min)
+                self._evacuate(victim)
                 victim.driver.drain()
         else:
             self._idle_since = None

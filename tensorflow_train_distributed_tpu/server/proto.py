@@ -51,6 +51,19 @@ Frame types (direction):
 - ``KV_ACK``  worker → parent: terminal answer to ``PREFILL`` (export
   refused) or ``KV_HANDOFF`` (rows installed / install skipped), with
   the matched-token count so routing knows how warm the prefix is.
+- ``MIGRATE``  the second binary frame, both directions: live
+  mid-stream request migration.  A header with ``op="export"`` (empty
+  blob) asks the worker to serialize one live lane — KV block rows
+  (the exact ``KV_HANDOFF`` byte recipe), generated-token history,
+  rng counter, staged-prefill cursor — which comes back as a MIGRATE
+  whose header is the lane manifest and whose blob is the row bytes;
+  the parent forwards that frame to the target worker (``op`` absent)
+  for installation, answered by a ``KV_ACK`` with the warm-token
+  count.  Every header carries ``v=MIGRATE_VERSION``: a mismatch is a
+  classified ``ProtocolError`` that fails ONE replica, and a worker
+  that predates the frame ignores it (the exchange times out into the
+  resume-from-token failover fallback — no migration is ever
+  load-bearing for correctness).
 
 Everything here is pure framing — no sockets are owned, no threads
 are spawned: ``read_frame``/``write_frame`` work over any file-like
@@ -92,18 +105,27 @@ DIED = 9
 PREFILL = 10
 KV_HANDOFF = 11
 KV_ACK = 12
+MIGRATE = 13
 
 FRAME_NAMES = {
     HELLO: "HELLO", SUBMIT: "SUBMIT", CHUNK: "CHUNK", RETIRE: "RETIRE",
     CANCEL: "CANCEL", DRAIN: "DRAIN", STATS: "STATS", BYE: "BYE",
     DIED: "DIED", PREFILL: "PREFILL", KV_HANDOFF: "KV_HANDOFF",
-    KV_ACK: "KV_ACK",
+    KV_ACK: "KV_ACK", MIGRATE: "MIGRATE",
 }
 
 #: Frame types whose payload is ``type byte + 4-byte header length +
 #: JSON header + raw bytes`` instead of pure JSON.  ``read_frame``
 #: surfaces the raw bytes under the reserved body key ``"blob"``.
-BINARY_FRAMES = frozenset({KV_HANDOFF})
+BINARY_FRAMES = frozenset({KV_HANDOFF, MIGRATE})
+
+#: MIGRATE manifest version, carried as ``v`` in every MIGRATE header
+#: (requests AND payloads).  Orthogonal to ``PROTO_VERSION``: the lane
+#: manifest can evolve (new state fields) without re-versioning the
+#: whole stream, but a mismatched manifest must still fail ONE replica
+#: loudly — installing a misread lane would corrupt a live stream,
+#: which is strictly worse than the failover fallback.
+MIGRATE_VERSION = 1
 
 #: The body key binary frames deliver their raw bytes under (reserved:
 #: a JSON header may not use it).

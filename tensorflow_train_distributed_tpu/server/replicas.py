@@ -32,7 +32,14 @@ IPC proxy can implement) with:
   exponential backoff and gives up at the request's own deadline
   instead of failing fast;
 - **graceful drain**: replicas drain ONE AT A TIME, so capacity
-  degrades gradually instead of all at once.
+  degrades gradually instead of all at once — and with ≥2 usable
+  replicas a draining replica's live lanes are EVACUATED first;
+- **live migration**: ``migrate(request_id, target=None)`` moves an
+  ACTIVE stream between healthy replicas mid-generation — the lane's
+  KV blocks, token history, rng position, and staged-prefill cursor
+  cross via ``export_lane``/``install_lane`` and the stream resumes
+  bitwise-identical, no re-prefill (``TTD_NO_MIGRATION=1`` disables);
+  ``defragment()`` packs long-tail lanes onto fewer replicas.
 
 Each pool request runs a small pump thread that places the request,
 relays committed chunks from the replica's stream to the caller's
@@ -91,6 +98,18 @@ def disagg_killed() -> bool:
     itself stays up: killing routing must not take a cross-host fleet
     offline.  Same no-redeploy contract as ``TTD_NO_PROC_REPLICAS``."""
     return os.environ.get("TTD_NO_DISAGG", "0") not in ("", "0")
+
+
+def migration_killed() -> bool:
+    """``TTD_NO_MIGRATION=1`` disables live mid-stream migration:
+    ``ReplicaPool.migrate`` refuses, drain-time evacuation and the
+    elastic scaler's pack-drain revert to the pre-migration behavior
+    byte-for-byte (drains wait for accepted work to finish; deaths
+    fail over via resume-from-token re-prefill), and ``defragment``
+    is a no-op.  The ``MIGRATE`` protocol frames stay registered —
+    killing the feature must never change what the transport can
+    parse.  Same no-redeploy contract as ``TTD_NO_DISAGG``."""
+    return os.environ.get("TTD_NO_MIGRATION", "0") not in ("", "0")
 
 
 # Pump liveness poll while waiting on the next chunk: only paid when
@@ -223,7 +242,9 @@ class _PoolRequest:
 
     __slots__ = ("handle", "generated", "replica", "inner", "excluded",
                  "failovers", "affinity_key", "thread",
-                 "queue_wait_seen")
+                 "queue_wait_seen", "preferred", "avoid",
+                 "migrate_to", "migrate_done", "migrate_ok",
+                 "migrations")
 
     def __init__(self, handle: RequestHandle, affinity_key):
         self.handle = handle
@@ -235,6 +256,21 @@ class _PoolRequest:
         self.affinity_key = affinity_key
         self.thread: Optional[threading.Thread] = None
         self.queue_wait_seen = False
+        # Migration steering — SOFT, unlike ``excluded``: ``preferred``
+        # sorts first at the next placement (the migration target,
+        # where the KV just landed) and ``avoid`` is pruned only while
+        # alternatives remain (the evacuating source stays a legal
+        # last resort — it is alive, unlike a death-excluded replica).
+        self.preferred: Optional[int] = None
+        self.avoid: Optional[int] = None
+        # Migration rendezvous: ``migrate()`` publishes a target
+        # (Replica | "auto") and waits on the event; the pump's relay
+        # loop — the single consumer of the inner stream — performs
+        # the move inline and signals back.
+        self.migrate_to = None
+        self.migrate_done: Optional[threading.Event] = None
+        self.migrate_ok = False
+        self.migrations = 0
 
 
 @concurrency_guarded
@@ -405,6 +441,14 @@ class ReplicaPool:
             role = rep.role()
             if role != "both":
                 d["role"] = role
+            if d["state"] == "draining":
+                # The evacuation progress gauge: live pool requests
+                # still homed on this draining replica.  Operators
+                # watch it count down to 0 as lanes migrate off.
+                with self._lock:
+                    d["lanes_remaining"] = sum(
+                        1 for preq in self._requests.values()
+                        if preq.replica is rep)
             if rep.dead_reason:
                 d["reason"] = rep.dead_reason
             total_fn = getattr(rep.engine, "kv_blocks_total", None)
@@ -632,8 +676,13 @@ class ReplicaPool:
                 and rep.decode_capable()
                 and (rep.usable() if allow_draining
                      else rep.accepting())]
+        if preq.avoid is not None:
+            pruned = [r for r in reps if r.idx != preq.avoid]
+            if pruned:
+                reps = pruned       # soft: only while alternatives live
         key = preq.affinity_key
-        reps.sort(key=lambda r: (-r.affinity(key), r.load(), r.idx))
+        reps.sort(key=lambda r: (r.idx != preq.preferred,
+                                 -r.affinity(key), r.load(), r.idx))
         return reps
 
     def _place(self, preq: _PoolRequest, requeue: bool) -> None:
@@ -807,7 +856,12 @@ class ReplicaPool:
                                  list(outer.prompt) + preq.generated,
                                  None, "ok")
                     return
-                if verdict == "failover":
+                if verdict in ("failover", "migrate"):
+                    # Both re-place from the last committed token with
+                    # resume-from-token determinism; migration differs
+                    # only in that the KV already landed on the target
+                    # (radix hit instead of re-prefill) and the source
+                    # is avoided, not excluded.
                     requeue = True
                     continue
                 return                      # _relay already finished it
@@ -820,11 +874,21 @@ class ReplicaPool:
     def _relay(self, preq: _PoolRequest) -> str:
         """Relay committed chunks from the inner stream to the outer
         handle until the life ends: returns ``"done"``, ``"failover"``
-        (replica died — the pump re-places), or ``"finished"`` when a
-        terminal error was already delivered."""
+        (replica died — the pump re-places), ``"migrate"`` (the lane
+        was exported off this replica — the pump re-places onto the
+        target), or ``"finished"`` when a terminal error was already
+        delivered."""
         outer, inner, rep = preq.handle, preq.inner, preq.replica
         q = inner._queue
         while True:
+            if preq.migrate_to is not None:
+                # A migration was requested (operator move, drain
+                # evacuation, defrag).  The relay thread is the single
+                # consumer of the inner stream, so running the move
+                # HERE means no chunk can be relayed mid-export.
+                verdict = self._migrate_now(preq)
+                if verdict is not None:
+                    return verdict
             try:
                 item = q.get(timeout=_POLL_S)
             except queue_mod.Empty:
@@ -873,6 +937,222 @@ class ReplicaPool:
             len(preq.generated), reason)
         return "failover"
 
+    # -- live mid-stream migration -----------------------------------------
+
+    @thread_role("handler", "main", "scaler", "watchdog")
+    def migrate(self, request_id: int, target: Optional[int] = None,
+                timeout_s: float = 30.0) -> bool:
+        """Move one live request to another replica mid-stream WITHOUT
+        losing its KV: export the lane (block-table rows + token
+        history + rng counter, the KV_HANDOFF byte recipe), install it
+        on the target, and re-place the request there — it resumes
+        decoding bitwise (resume-from-token pins the rng stream; the
+        radix hit on the shipped rows replaces the re-prefill failover
+        would pay).  ``target`` picks a replica index; None lets the
+        pool choose (warmest affinity, then load).  Returns True once
+        the move committed, False when it could not happen (unknown or
+        finished request, no usable target, export refusal, the
+        ``TTD_NO_MIGRATION`` kill switch) — the request keeps running
+        where it was in every False case EXCEPT an export that
+        committed on the source and then failed to land: that one
+        still completes via the normal failover re-placement, tokens
+        intact (the no-token-lost contract is placement-independent).
+
+        Blocks up to ``timeout_s`` for the pump to perform the move
+        (the relay thread owns the inner stream; migration runs there
+        so no chunk can race the export)."""
+        if migration_killed():
+            return False
+        with self._lock:
+            preq = self._requests.get(request_id)
+        if preq is None:
+            return False
+        want = "auto"
+        if target is not None:
+            want = next((r for r in self._replicas
+                         if r.idx == int(target)), None)
+            if (want is None or not want.usable()
+                    or not want.decode_capable()):
+                return False
+        done = threading.Event()
+        preq.migrate_ok = False
+        preq.migrate_done = done
+        preq.migrate_to = want      # published last: the relay's cue
+        if not done.wait(timeout_s):
+            return False
+        return bool(preq.migrate_ok)
+
+    def _migrate_now(self, preq: _PoolRequest) -> Optional[str]:
+        """Perform a requested migration on the relay thread; returns
+        ``"migrate"`` when the lane left the source (the pump must
+        re-place), None when the move could not happen and the relay
+        should keep streaming from the current replica."""
+        outer, src = preq.handle, preq.replica
+        want = preq.migrate_to
+        target = want if isinstance(want, Replica) else None
+        if target is None:
+            cands = [r for r in self._replicas
+                     if r is not src and r.usable()
+                     and r.decode_capable()
+                     and r.idx not in preq.excluded]
+            key = preq.affinity_key
+            cands.sort(key=lambda r: (-r.affinity(key), r.load(),
+                                      r.idx))
+            target = cands[0] if cands else None
+        ok, warm, blob_len = False, 0, 0
+        t0 = time.monotonic()
+        if (target is not None and target is not src
+                and src is not None and target.usable()
+                and not migration_killed()):
+            export = getattr(src.driver, "export_lane", None)
+            out = None
+            if export is not None:
+                try:
+                    # Bounded: a replica that VANISHES mid-export
+                    # (kill9 semantics — pending calls never resolve)
+                    # must not wedge the relay thread forever; the
+                    # timeout lands in the except arm and the stream
+                    # finishes via the normal failover re-placement.
+                    out = export(outer.id, timeout_s=30.0)
+                except (RuntimeError, TimeoutError) as e:
+                    # Source died or wedged mid-export: nothing moved
+                    # (or the reply was lost AFTER the source retired
+                    # the lane — then the inner handle errors out and
+                    # the normal failover path resumes from the last
+                    # committed token; either way no token is lost).
+                    logger.warning(
+                        "request %d: migration export from replica %d "
+                        "failed (%s)", outer.id, src.idx, e)
+            if out is not None:
+                meta, blob = out
+                blob_len = len(blob)
+                # The source retired the lane at export — from here
+                # the move MUST complete via re-placement.  The meta
+                # token history is authoritative (snapshotted between
+                # engine steps, always >= what the relay delivered):
+                # commit the tail the stream never saw.
+                toks = meta.get("tokens")
+                if toks:
+                    base = len(outer.prompt) + len(preq.generated)
+                    fresh = [int(t) for t in toks[base:]]
+                    if fresh:
+                        preq.generated.extend(fresh)
+                        self._on_chunk(preq, fresh)
+                install = getattr(target.driver, "install_lane", None)
+                if install is not None and blob:
+                    try:
+                        warm = int(install(meta, blob,
+                                           timeout_s=30.0) or 0)
+                    except (RuntimeError, TimeoutError,
+                            ValueError) as e:
+                        # Install refusal/death is benign: the
+                        # re-placed request prefills locally —
+                        # exactly the failover path, bitwise.
+                        logger.warning(
+                            "request %d: migration install on replica "
+                            "%d refused (%s)", outer.id, target.idx, e)
+                        warm = 0
+                target.note_affinity(preq.affinity_key)
+                preq.preferred, preq.avoid = target.idx, src.idx
+                preq.replica = preq.inner = None
+                preq.migrations += 1
+                dt = time.monotonic() - t0
+                m = self._metrics
+                if m is not None:
+                    c = getattr(m, "migrations", None)
+                    if c is not None:
+                        c.inc()
+                    h = getattr(m, "migration_seconds", None)
+                    if h is not None:
+                        h.observe(dt)
+                    b = getattr(m, "migrated_kv_bytes", None)
+                    if b is not None:
+                        b.inc(blob_len)
+                events.instant("request/migrate", request_id=outer.id,
+                               from_replica=src.idx,
+                               to_replica=target.idx,
+                               tokens=int(warm), bytes=blob_len,
+                               resumed_at=len(preq.generated),
+                               ms=round(dt * 1e3, 3))
+                logger.info(
+                    "request %d migrated replica %d -> %d at %d "
+                    "generated tokens (%d warm, %d bytes)", outer.id,
+                    src.idx, target.idx, len(preq.generated), warm,
+                    blob_len)
+                ok = True
+        preq.migrate_ok = ok
+        preq.migrate_to = None
+        ev, preq.migrate_done = preq.migrate_done, None
+        if ev is not None:
+            ev.set()
+        return "migrate" if ok else None
+
+    def _evacuate(self, rep: Replica,
+                  timeout: Optional[float] = None) -> int:
+        """Migrate every live request off ``rep`` (drain-time
+        evacuation): with >=2 usable replicas a drain no longer makes
+        its streams WAIT for natural completion — they move and keep
+        decoding elsewhere.  Returns the number of requests moved;
+        whatever could not move (no survivor, export refusal, the
+        kill switch) simply drains the old way."""
+        if migration_killed():
+            return 0
+        if not any(r is not rep and r.usable() and r.decode_capable()
+                   for r in self._replicas):
+            return 0
+        with self._lock:
+            victims = [preq.handle.id
+                       for preq in self._requests.values()
+                       if preq.replica is rep]
+        if not victims:
+            return 0
+        per = 30.0 if timeout is None else max(1e-3,
+                                               min(30.0, timeout))
+        moved = sum(self.migrate(rid, timeout_s=per)
+                    for rid in victims)
+        events.instant("replica/evacuate", replica=rep.idx,
+                       lanes=len(victims), moved=moved)
+        logger.info("replica %d evacuated: %d/%d lanes migrated",
+                    rep.idx, moved, len(victims))
+        return moved
+
+    @thread_role("handler", "main", "scaler")
+    def defragment(self, max_moves: int = 8) -> int:
+        """Pack the least-occupied replica's lanes onto the rest of
+        the fleet (bounded by ``max_moves`` and the others' spare
+        slots) so low-tide scale-down can actually reclaim a worker —
+        the long-tail streams that used to pin a nearly-idle replica
+        now migrate off it.  Returns the number of lanes moved."""
+        if migration_killed():
+            return 0
+        usable = [r for r in self._replicas
+                  if r.usable() and r.decode_capable()
+                  and not r.driver.is_draining()]
+        if len(usable) < 2:
+            return 0
+        with self._lock:
+            by_rep: dict = {}
+            for preq in self._requests.values():
+                if preq.replica is not None:
+                    by_rep.setdefault(preq.replica.idx,
+                                      []).append(preq.handle.id)
+        occupied = [r for r in usable if by_rep.get(r.idx)]
+        if len(occupied) < 2:
+            return 0
+        donor = min(occupied, key=lambda r: (len(by_rep[r.idx]),
+                                             -r.idx))
+        spare = sum(max(0, r.slots - r.driver.active_slots())
+                    for r in usable if r is not donor)
+        moves = min(max_moves, len(by_rep[donor.idx]), spare)
+        if moves <= 0:
+            return 0
+        moved = sum(self.migrate(rid)
+                    for rid in by_rep[donor.idx][:moves])
+        if moved:
+            events.instant("pool/defragment", donor=donor.idx,
+                           moved=moved)
+        return moved
+
     def _on_chunk(self, preq: _PoolRequest, chunk: list) -> None:
         outer = preq.handle
         now = time.monotonic()
@@ -912,8 +1192,16 @@ class ReplicaPool:
             if status == "ok":
                 m.latency.observe(time.monotonic() - outer.t_submit)
         events.instant("request/pool_retire", request_id=outer.id,
-                       status=status, failovers=preq.failovers)
+                       status=status, failovers=preq.failovers,
+                       migrations=preq.migrations)
         outer._resolve(tokens, error)
+        # A migrate() caller blocked on a request that just finished
+        # must not hang out its timeout: signal failure (migrate_ok
+        # stays whatever the relay last published — False unless the
+        # move actually committed before the finish).
+        ev = preq.migrate_done
+        if ev is not None:
+            ev.set()
 
     # -- request forensics / control ---------------------------------------
 
@@ -1020,6 +1308,13 @@ class ReplicaPool:
         for rep in self._replicas:          # sequential, by design
             if not rep.usable():
                 continue
+            # Evacuate BEFORE the drain flag flips: live lanes migrate
+            # to a survivor and keep decoding (drain cost becomes one
+            # KV ship instead of waiting out the longest stream).
+            # With one replica left — or TTD_NO_MIGRATION=1 — this is
+            # a no-op and the drain waits for completion, the pre-
+            # migration behavior byte-for-byte.
+            self._evacuate(rep, left())
             rep.driver.drain()
             drained &= rep.driver.join(left())
         # Snapshot under the lock: pumps _finish() concurrently (del

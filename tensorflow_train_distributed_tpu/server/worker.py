@@ -172,6 +172,30 @@ class StubWorkerEngine:
     def snapshot(self):
         return {s[0]: list(s[3]) for s in self._slots if s is not None}
 
+    def export_lane(self, rid):
+        """Minimal migration surface so stub fleets exercise REAL
+        MIGRATE frames: parameters + token history, no KV (the stub
+        has none) — the re-placed request recomputes its arithmetic
+        deterministically, the same closed form as failover."""
+        for q, prompt, max_new in self._queue:
+            if q == rid:
+                return {"kind": "queued", "prompt": list(prompt),
+                        "max_new": int(max_new), "seed": None,
+                        "resume_from": 0, "kv": None}, b""
+        for s in self._slots:
+            if s is not None and s[0] == rid:
+                _, prompt, max_new, tokens = s
+                done = len(tokens) - len(prompt)
+                return {"kind": "lane", "tokens": list(tokens),
+                        "remaining": int(max_new - done),
+                        "last_token": int(tokens[-1]), "seed": 0,
+                        "count": int(done), "done": False,
+                        "kv": None}, b""
+        return None
+
+    def install_lane(self, meta, blob):
+        return 0                      # nothing to warm: no KV to ship
+
     def serve_step(self):
         for i in range(self.slots):
             if self._slots[i] is None and self._queue:
@@ -364,6 +388,59 @@ def _handoff_install(rid: int, meta: dict, blob: bytes,
                                    "error": repr(e)})
         return
     sender.send(proto.KV_ACK, {"id": rid, "n": int(n or 0)})
+
+
+@thread_role("pump")
+def _migrate_export(hid: int, rid: int, driver: EngineDriver,
+                    sender: proto.FrameSender) -> None:
+    """Answer one MIGRATE export request: snapshot-and-retire the live
+    lane through ``driver.export_lane`` (atomic on the engine-owning
+    thread — no token generates after the snapshot) and ship the
+    state back as a binary MIGRATE payload.  Refusals are KV_ACK n=0;
+    an export that committed but whose reply frame is refused
+    (oversized) is still safe — the retired request's relay sends its
+    terminal and the parent completes it via resume-from-token
+    failover."""
+    try:
+        out = driver.export_lane(rid, timeout_s=300.0)
+    except BaseException as e:      # noqa: BLE001 — refusal, not death
+        sender.send(proto.KV_ACK, {"id": hid, "n": 0,
+                                   "error": repr(e)})
+        return
+    if out is None:
+        sender.send(proto.KV_ACK, {"id": hid, "n": 0,
+                                   "error": "no such live request"})
+        return
+    meta, blob = out
+    header = dict(meta, id=hid, v=proto.MIGRATE_VERSION)
+    if not sender.send_binary(proto.MIGRATE, header, blob):
+        sender.send(proto.KV_ACK, {"id": hid, "n": 0,
+                                   "error": "migrate frame refused"})
+
+
+@thread_role("pump")
+def _migrate_install(hid: int, meta: dict, blob: bytes,
+                     driver: EngineDriver,
+                     sender: proto.FrameSender) -> None:
+    """Install one migrated lane's KV into this worker's pool (driver
+    thread via ``driver.install_lane``); KV_ACK carries the warm-token
+    count (0 = refused/nothing shipped — the re-placed request
+    prefills locally, same output).  A manifest version this worker
+    does not speak is a refusal, not a death: the parent's request
+    completes via the failover path."""
+    if int(meta.get("v") or 0) != proto.MIGRATE_VERSION:
+        sender.send(proto.KV_ACK, {
+            "id": hid, "n": 0,
+            "error": f"MIGRATE manifest version {meta.get('v')!r} "
+                     f"!= {proto.MIGRATE_VERSION}"})
+        return
+    try:
+        n = driver.install_lane(meta, blob, timeout_s=300.0)
+    except BaseException as e:      # noqa: BLE001 — refusal, not death
+        sender.send(proto.KV_ACK, {"id": hid, "n": 0,
+                                   "error": repr(e)})
+        return
+    sender.send(proto.KV_ACK, {"id": hid, "n": int(n or 0)})
 
 
 def _jsonable_attrs(attrs: Optional[dict]) -> dict:
@@ -587,6 +664,27 @@ def run_worker(engine, sock: socket.socket, *,
                     target=_handoff_install,
                     args=(rid, body, blob, driver, sender),
                     name=f"worker-install-{rid}", daemon=True).start()
+            elif ftype == proto.MIGRATE:
+                # Live migration: an export request (op=export, empty
+                # blob) snapshots-and-retires one live lane; anything
+                # else is a migrated lane's payload to install.  Helper
+                # threads marshal through driver.call — the reader
+                # keeps reading (CANCEL/DRAIN arrive mid-migration).
+                blob = body.pop(proto.BLOB_KEY, b"")
+                hid = int(body.get("id", -1))
+                if body.get("op") == "export":
+                    threading.Thread(
+                        target=_migrate_export,
+                        args=(hid, int(body.get("rid", -1)),
+                              driver, sender),
+                        name=f"worker-migrate-out-{hid}",
+                        daemon=True).start()
+                else:
+                    threading.Thread(
+                        target=_migrate_install,
+                        args=(hid, body, blob, driver, sender),
+                        name=f"worker-migrate-in-{hid}",
+                        daemon=True).start()
             elif ftype == proto.DRAIN:
                 threading.Thread(target=_drain_and_exit,
                                  name="worker-drain",
@@ -664,6 +762,39 @@ def _run_corrupt(mode: str, sock: socket.socket) -> int:
         wfp.write(frame[:len(frame) // 2])
         wfp.flush()
         os._exit(1)
+    if mode == "midmigrate":
+        # A healthy hello, then death in the MIDDLE of a binary
+        # MIGRATE frame — a source worker SIGKILLed while streaming a
+        # lane out.  The parent must classify the torn stream, never
+        # install half a manifest.
+        proto.write_frame(wfp, proto.HELLO, {
+            "proto": proto.PROTO_VERSION, "pid": os.getpid(),
+            "replica": None, "mono": time.monotonic(),
+            "engine": {"slots": 1}})
+        frame = proto.encode_binary_frame(
+            proto.MIGRATE,
+            {"id": 1, "v": proto.MIGRATE_VERSION, "kind": "lane",
+             "tokens": [1, 2, 3], "kv": {"n": 16, "leaves": []}},
+            b"\x00" * 4096)
+        wfp.write(frame[:len(frame) // 2])
+        wfp.flush()
+        os._exit(1)
+    if mode == "migrateversion":
+        # A healthy hello, then an unsolicited MIGRATE payload with a
+        # manifest version from the future: the parent must fail THIS
+        # replica with a classified protocol error — installing a
+        # misread lane would corrupt a live stream.
+        proto.write_frame(wfp, proto.HELLO, {
+            "proto": proto.PROTO_VERSION, "pid": os.getpid(),
+            "replica": None, "mono": time.monotonic(),
+            "engine": {"slots": 1}})
+        wfp.write(proto.encode_binary_frame(
+            proto.MIGRATE,
+            {"id": 1, "v": 999, "kind": "lane", "tokens": [1]},
+            b"\x00" * 64))
+        wfp.flush()
+        rfp.read(1)                      # wait for the parent to react
+        return 0
     raise SystemExit(f"unknown --test-corrupt mode {mode!r}")
 
 
@@ -690,7 +821,8 @@ def main(argv=None) -> int:
     p.add_argument("--test-corrupt", default="",
                    help="protocol-hardening test modes: speak broken "
                         "frames on purpose (badversion|oversize|"
-                        "truncate|midframe|garbage)")
+                        "truncate|midframe|garbage|midhandoff|"
+                        "midmigrate|migrateversion)")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO, stream=sys.stderr,

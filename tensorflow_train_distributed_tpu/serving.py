@@ -1535,43 +1535,50 @@ class ServingEngine:
             table_np = np.zeros((self._kv_nblk_lane,), np.int32)
             table_np[:len(shared)] = shared
             table_j = jnp.asarray(table_np)
-            span = jnp.int32(m * bs)
             with self._ctx(), events.span("kv/export", tokens=m * bs):
-                pairs = [(False, self._gather_prefix(
-                    self._cache, table_j, False, span))]
-                if self._draft_model is not None:
-                    pairs.append((True, self._gather_prefix(
-                        self._d_cache, table_j, True, span)))
-                leaves, chunks = [], []
-                for draft, cache_1 in pairs:
-                    flat = jax.tree_util.tree_flatten_with_path(
-                        cache_1)[0]
-                    # Path-sorted for a deterministic wire order (the
-                    # installer replays the manifest positionally).
-                    for p, leaf in sorted(
-                            flat, key=lambda pl: self._path_key(pl[0])):
-                        name = getattr(p[-1], "key", "")
-                        axis = self._KV_LEAF_ROW_AXIS.get(name)
-                        if axis is None:
-                            continue
-                        idx = [slice(None)] * leaf.ndim
-                        idx[axis] = slice(0, m * bs)
-                        arr = np.asarray(jax.device_get(
-                            leaf[tuple(idx)]))
-                        leaves.append({
-                            "path": list(self._path_key(p)),
-                            "draft": draft,
-                            "dtype": arr.dtype.str,
-                            "shape": list(arr.shape)})
-                        chunks.append(np.ascontiguousarray(arr)
-                                      .tobytes())
+                leaves, blob = self._serialize_rows(table_j, m * bs)
         finally:
             for b in shared:
                 self._kv_pool.deref(b)
         meta = {"tokens": head, "n": m * bs,
                 "draft": self._draft_model is not None,
                 "leaves": leaves}
-        return meta, b"".join(chunks)
+        return meta, blob
+
+    def _serialize_rows(self, table_j, n: int):
+        """The ONE wire byte-recipe every KV-bearing frame ships
+        (``KV_HANDOFF`` and ``MIGRATE``): gather the first ``n`` pool
+        rows reachable through ``table_j`` into a batch-1 linear cache
+        pair, slice each row-holding leaf, and concatenate contiguous
+        bytes in path-sorted manifest order (the installer replays the
+        manifest positionally).  Returns ``(leaves, blob)``.  Callers
+        hold refs on (or own) the table's blocks and run on the
+        engine-owning thread."""
+        span = jnp.int32(n)
+        pairs = [(False, self._gather_prefix(
+            self._cache, table_j, False, span))]
+        if self._draft_model is not None:
+            pairs.append((True, self._gather_prefix(
+                self._d_cache, table_j, True, span)))
+        leaves, chunks = [], []
+        for draft, cache_1 in pairs:
+            flat = jax.tree_util.tree_flatten_with_path(cache_1)[0]
+            for p, leaf in sorted(
+                    flat, key=lambda pl: self._path_key(pl[0])):
+                name = getattr(p[-1], "key", "")
+                axis = self._KV_LEAF_ROW_AXIS.get(name)
+                if axis is None:
+                    continue
+                idx = [slice(None)] * leaf.ndim
+                idx[axis] = slice(0, n)
+                arr = np.asarray(jax.device_get(leaf[tuple(idx)]))
+                leaves.append({
+                    "path": list(self._path_key(p)),
+                    "draft": draft,
+                    "dtype": arr.dtype.str,
+                    "shape": list(arr.shape)})
+                chunks.append(np.ascontiguousarray(arr).tobytes())
+        return leaves, b"".join(chunks)
 
     @thread_role("main", "driver")
     def install_prefix_kv(self, meta, blob) -> int:
@@ -1656,6 +1663,105 @@ class ServingEngine:
         matched, _ = self._radix.match(tokens, allow_full=True,
                                        record=False)
         return matched
+
+    @thread_role("main", "driver")
+    def export_lane(self, request_id: int):
+        """Serialize a live request's FULL migration state —
+        ``(meta, blob)`` — or None when the id is unknown/finished.
+
+        The source half of live mid-stream migration (the MIGRATE
+        frame's payload).  ``meta["kind"]`` names where the request
+        lived:
+
+        - ``"lane"``: a decoding slot.  ``tokens`` is the authoritative
+          prompt+generated history (a snapshot taken between engine
+          steps, so it is always >= what any relay has delivered),
+          ``remaining``/``seed``/``count`` restore the budget and the
+          rng counter, and ``meta["kv"]`` + ``blob`` carry the lane's
+          full-block pool rows in the exact ``KV_HANDOFF`` byte recipe
+          (``_serialize_rows`` — int8 + scales, bit-identical rows) so
+          the target resumes WITHOUT re-prefilling the head.  Rows are
+          gathered from the lane's OWN block table: valid for
+          ``[0, len(tokens) - 1)`` (the last sampled token was never
+          fed back), hence the head stops at the last full block under
+          that bound.  A linear-cache or sub-block lane exports with
+          ``kv=None`` — the target re-prefills, which is exactly the
+          failover path and stays bitwise by the same contract.
+        - ``"staged"``: mid-admission in a reserved lane.  The partial
+          batch-1 prefill is NOT shipped (pieces are cheap to redo and
+          piece boundaries are engine-local); the staged cursor rides
+          along so operators can see how far admission got.
+        - ``"queued"``: accepted but never placed — parameters only.
+
+        Export is read-only: the caller decides whether the move
+        committed and then ``cancel()``s this side (the
+        ``EngineDriver.export_lane`` wrapper does both atomically on
+        the engine-owning thread, so no token can generate after the
+        snapshot)."""
+        for item in self._queue:
+            if item[0] == request_id:
+                _, prompt, max_new, seed, resume = item
+                return {"kind": "queued", "prompt": list(prompt),
+                        "max_new": int(max_new), "seed": int(seed),
+                        "resume_from": int(resume), "kv": None}, b""
+        for task in self._staging.values():
+            if task.request_id == request_id:
+                return {"kind": "staged", "prompt": list(task.prompt),
+                        "max_new": int(task.max_new),
+                        "seed": int(task.seed),
+                        "resume_from": int(task.resume),
+                        "cursor": int(task.cursor), "kv": None}, b""
+        for slot, state in enumerate(self._slot_states):
+            if state is None or state.request_id != request_id:
+                continue
+            meta = {"kind": "lane",
+                    "tokens": [int(t) for t in state.tokens],
+                    "remaining": int(state.remaining),
+                    "last_token": int(state.last_token),
+                    "seed": int(state.seed), "count": int(state.count),
+                    "done": bool(state.done), "kv": None}
+            blob = b""
+            bs = self.kv_block_size
+            m = max(0, (len(state.tokens) - 1) // bs)
+            kv = (self._lane_kv[slot]
+                  if self.paged and not self._exact_prefill else None)
+            if kv is not None and m > 0 and self._cache is not None:
+                head = [int(t) for t in state.tokens[:m * bs]]
+                # The lane's claim already holds a ref on every block
+                # in its table, and we run between steps on the
+                # engine-owning thread — no eviction can race the
+                # gather, so no extra pinning is needed.
+                table_j = self._kv_table(kv)
+                with self._ctx(), events.span("kv/export",
+                                              tokens=m * bs):
+                    leaves, blob = self._serialize_rows(table_j,
+                                                        m * bs)
+                meta["kv"] = {"tokens": head, "n": m * bs,
+                              "draft": self._draft_model is not None,
+                              "leaves": leaves}
+            return meta, blob
+        return None
+
+    @thread_role("main", "driver")
+    def install_lane(self, meta, blob) -> int:
+        """Install a migrated lane's KV rows into this engine's pool +
+        radix index; returns the warm-token count (0 = nothing to
+        install or refused — benign: the re-admitted request simply
+        prefills locally with identical output, the failover path).
+
+        The target half of migration.  Only the KV needs engine-side
+        installation — the request itself is re-admitted through the
+        pool's normal resume-from-token placement, which radix-hits
+        the rows seeded here (``install_prefix_kv`` → the SAME
+        ``_seed_radix_from_cache`` path as a prefill→decode handoff,
+        so allocation, eviction pressure and partial-failure semantics
+        are all the tested ones).  Raises ValueError on a torn or
+        lying manifest — the transport classifies that as a protocol
+        failure of the one replica."""
+        kv = meta.get("kv") if isinstance(meta, dict) else None
+        if not kv or not blob:
+            return 0
+        return self.install_prefix_kv(dict(kv), blob)
 
     def _match_prefix(self, prompt, touch: bool = False):
         """Longest stored prefix the prompt strictly extends →

@@ -98,6 +98,30 @@ class StubEngine:
     def snapshot(self):
         return {s[0]: list(s[3]) for s in self._slots if s is not None}
 
+    def export_lane(self, rid):
+        """Minimal migration surface (mirrors the subprocess
+        ``StubWorkerEngine``): parameters + token history, no KV —
+        the re-placed request recomputes its arithmetic
+        deterministically, the same closed form as failover."""
+        for q, prompt, max_new in self._queue:
+            if q == rid:
+                return {"kind": "queued", "prompt": list(prompt),
+                        "max_new": int(max_new), "seed": None,
+                        "resume_from": 0, "kv": None}, b""
+        for s in self._slots:
+            if s is not None and s[0] == rid:
+                _, prompt, max_new, tokens = s
+                done = len(tokens) - len(prompt)
+                return {"kind": "lane", "tokens": list(tokens),
+                        "remaining": int(max_new - done),
+                        "last_token": int(tokens[-1]), "seed": 0,
+                        "count": int(done), "done": False,
+                        "kv": None}, b""
+        return None
+
+    def install_lane(self, meta, blob):
+        return 0                      # nothing to warm: no KV to ship
+
     def serve_step(self):
         for i in range(self.slots):
             if self._slots[i] is None and self._queue:

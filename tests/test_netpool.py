@@ -221,11 +221,22 @@ def _corrupt_bytes(mode):
             {"id": 1, "tokens": [1, 2], "n": 2, "leaves": []},
             b"\x00" * 4096)
         return hello + frame[:len(frame) // 2]
+    if mode == "midmigrate":
+        # A healthy hello, then EOF in the middle of a binary MIGRATE
+        # payload — a source worker torn down while exporting a lane.
+        frame = proto.encode_binary_frame(
+            proto.MIGRATE,
+            {"id": 1, "v": proto.MIGRATE_VERSION, "kind": "lane",
+             "tokens": [1, 2], "remaining": 4, "last_token": 2,
+             "seed": None, "count": 2, "done": False, "kv": None},
+            b"\x00" * 4096)
+        return hello + frame[:len(frame) // 2]
     raise AssertionError(mode)
 
 
 @pytest.mark.parametrize("mode", ["badversion", "oversize", "garbage",
-                                  "truncate", "midhandoff"])
+                                  "truncate", "midhandoff",
+                                  "midmigrate"])
 def test_hostile_peer_fails_one_replica_never_the_pool(mode):
     """Every hostile-peer failure mode over a REAL TCP socket — stale
     HELLO version, oversized length prefix from the remote side,
@@ -241,7 +252,7 @@ def test_hostile_peer_fails_one_replica_never_the_pool(mode):
         with socket.create_connection(("127.0.0.1", pool.port),
                                       timeout=10) as sock:
             sock.sendall(_corrupt_bytes(mode))
-            if mode in ("truncate", "midhandoff"):
+            if mode in ("truncate", "midhandoff", "midmigrate"):
                 sock.shutdown(socket.SHUT_WR)   # EOF mid-frame
             deadline = time.monotonic() + 15
             dead = []
@@ -303,6 +314,40 @@ def test_sigkill_midstream_disconnect_failover_and_redial_respawn():
         assert pool.alive_count() == 2
         assert pool.restarts_total() == 1
         assert not pool.degraded()
+        h2 = pool.submit([42], 4)
+        assert h2.result(timeout=30) == StubWorkerEngine.expected(
+            [42], 4)
+    finally:
+        pool.join(timeout=30)
+        _reap(procs)
+
+
+def test_live_migration_over_tcp_bitwise():
+    """A live lane crosses HOSTS: mid-stream ``pool.migrate`` exports
+    the lane from one dial-in daemon and installs it on the other over
+    real TCP MIGRATE frames, and the stream stays token-for-token
+    equal to the closed form — no re-prefill, no gap."""
+    pool = _pool(scale_min=2, max_workers=4)
+    procs = []
+    try:
+        procs = [_worker(pool.port, rid=i,
+                         spec={"slots": 2, "step_delay": 0.05})
+                 for i in range(2)]
+        assert pool.wait_ready(30)
+        h = pool.submit([5, 6, 7], 30, stream=True)
+        it = h.iter_tokens()
+        toks = list(next(it))               # placed and streaming
+        preq = pool._requests[h.id]
+        src = preq.replica
+        assert pool.migrate(h.id)
+        for chunk in it:
+            toks.extend(chunk)
+        assert [5, 6, 7] + toks == StubWorkerEngine.expected(
+            [5, 6, 7], 30)
+        assert preq.migrations == 1
+        assert preq.replica is not src
+        # Nobody died for this: both daemons still serve.
+        assert pool.alive_count() == 2
         h2 = pool.submit([42], 4)
         assert h2.result(timeout=30) == StubWorkerEngine.expected(
             [42], 4)

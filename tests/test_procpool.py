@@ -335,11 +335,13 @@ def test_kill_switch_refuses_proc_pool(monkeypatch):
 
 
 @pytest.mark.parametrize("mode", ["badversion", "oversize", "truncate",
-                                  "garbage", "midframe"])
+                                  "garbage", "midframe", "midmigrate",
+                                  "migrateversion"])
 def test_corrupt_worker_fails_one_replica_never_the_pool(mode):
     """Every protocol failure mode — stale hello version, oversized
-    length prefix, truncated frame, non-JSON payload, death mid-frame
-    — fails exactly the speaking replica, classified in its /healthz
+    length prefix, truncated frame, non-JSON payload, death mid-frame,
+    death mid-MIGRATE, and a MIGRATE manifest from a future version —
+    fails exactly the speaking replica, classified in its /healthz
     state, while the healthy replica keeps serving."""
 
     class MixedPool(ProcPool):

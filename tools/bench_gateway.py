@@ -838,6 +838,176 @@ def bench_gateway_disagg_ab(preset, slots, chunk, max_queue, clients,
     }
 
 
+def bench_gateway_migrate_drain_ab(preset, slots, chunk, max_queue,
+                                   cache_len, seed, timeout,
+                                   replicas=2, streams=4, max_new=64,
+                                   reps=5):
+    """Drain-with-migration vs drain-by-failover, one workload: a
+    replica serving live streams must go away (the staged-SIGTERM /
+    scale-down story).  Leg A evacuates it — every lane live-migrates
+    (KV rows shipped, decode resumes warm on the survivor); leg B
+    kills it (the in-process kill9 vanish, SIGKILL semantics) so the
+    same streams resume via failover re-prefill.  Both legs run as
+    leg-order-alternating BACK-TO-BACK PAIRS on fresh gateways; the
+    headline is the p99 of the widest client-observed inter-chunk gap
+    across the victim's streams — the resume hole — and the MEDIAN of
+    per-pair p99 ratios (migrate/failover), with the migrated KV
+    bytes per moved request pulled from the flight recorder."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import events, faults
+    from tensorflow_train_distributed_tpu.server import ServingGateway
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    cfg = LLAMA_PRESETS[preset]
+    vocab = min(cfg.vocab_size, 30_000)
+    cache_len = cache_len or min(256, cfg.max_positions)
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(seed)
+    # One prompt shape for every stream and both legs: long enough
+    # that a lane holds full KV blocks by disruption time (its export
+    # ships real rows), max_new deep enough that every stream is
+    # provably mid-generation when the replica goes away.
+    prompts = [[int(t) for t in rng.integers(1, vocab, 24)]
+               for _ in range(streams)]
+
+    def one_leg(mode):
+        engines = [ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                                 cache_len=cache_len)
+                   for _ in range(replicas)]
+        for e in engines:                  # warm: compile off the clock
+            e.submit([1, 2, 3], 5)
+            e.run()
+        gw = ServingGateway(engines, host="127.0.0.1", port=0,
+                            max_queue=max_queue).start()
+        pool = gw.pool
+        rec_ = events.get_recorder()
+        cursor, _ = rec_.events_after(0)
+        arrivals = [[] for _ in range(streams)]
+        first = [threading.Event() for _ in range(streams)]
+        errs = [None] * streams
+
+        def consume(i, it):
+            try:
+                for _chunk in it:
+                    arrivals[i].append(time.perf_counter())
+                    first[i].set()
+            except (RuntimeError, TimeoutError) as e:
+                errs[i] = e
+            finally:
+                first[i].set()
+        try:
+            handles = [pool.submit(list(p), max_new, stream=True,
+                                   timeout_s=timeout) for p in prompts]
+            threads = [threading.Thread(
+                target=consume, args=(i, h.iter_tokens()), daemon=True)
+                for i, h in enumerate(handles)]
+            for t in threads:
+                t.start()
+            for ev in first:
+                if not ev.wait(timeout):
+                    raise RuntimeError("stream never produced a chunk")
+            if any(errs):
+                raise RuntimeError(f"stream died pre-kill: {errs}")
+            victim = pool._requests[handles[0].id].replica
+            affected = [i for i, h in enumerate(handles)
+                        if pool._requests[h.id].replica is victim]
+            t0 = time.perf_counter()
+            if mode == "migrate":
+                pool._evacuate(victim)
+                victim.driver.drain()
+            else:
+                faults.arm("serve:dispatch:1:kill9:"
+                           f"replica={victim.idx}")
+            for t in threads:
+                t.join(timeout)
+                if t.is_alive():
+                    raise RuntimeError(f"{mode} leg: stream wedged")
+            if any(errs):
+                raise RuntimeError(f"{mode} leg stream error: {errs}")
+            # The resume hole per affected stream: the widest
+            # inter-chunk gap the CLIENT saw from the disruption on
+            # (same shape as the failover-recovery leg of
+            # --replica-procs).
+            gaps = []
+            for i in affected:
+                prev, worst = t0, 0.0
+                for ts in arrivals[i]:
+                    if ts <= t0:
+                        continue
+                    worst = max(worst, ts - prev)
+                    prev = ts
+                gaps.append(1e3 * worst)
+            gaps.sort()
+            _, evs = rec_.events_after(cursor)
+            moves = [e[5] for e in evs if e[0] == "request/migrate"]
+            return {"p99_ms": round(_percentile(gaps, 0.99), 1),
+                    "gaps_ms": [round(g, 1) for g in gaps],
+                    "lanes_moved": len(moves),
+                    "kv_bytes": sum(m.get("bytes", 0) for m in moves)}
+        finally:
+            faults.disarm()
+            gw.drain(timeout=60)
+
+    legs = {"migrate": [], "failover": []}
+    ratios = []
+    for i in range(max(1, reps)):
+        order = (("migrate", "failover") if i % 2 == 0
+                 else ("failover", "migrate"))
+        pair = {}
+        for leg in order:
+            pair[leg] = one_leg(leg)
+            legs[leg].append(pair[leg])
+        ratios.append(max(1e-3, pair["migrate"]["p99_ms"])
+                      / max(1e-3, pair["failover"]["p99_ms"]))
+    ratios.sort()
+
+    def med(leg):
+        vals = sorted(r["p99_ms"] for r in legs[leg])
+        return vals[len(vals) // 2]
+
+    moved = sum(r["lanes_moved"] for r in legs["migrate"])
+    kv_bytes = sum(r["kv_bytes"] for r in legs["migrate"])
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_gateway_migrate_drain_p99_resume_ms",
+        "value": med("migrate"),
+        "unit": "ms p99 client-observed resume gap, drain WITH live "
+                "migration (p99_ratio_median: migrate/failover, "
+                "median of per-pair p99 ratios)",
+        "replicas": replicas,
+        "slots": slots,
+        "chunk": chunk,
+        "cache_len": cache_len,
+        "streams": streams,
+        "max_new": max_new,
+        "reps": reps,
+        "migrate": {
+            "p99_resume_ms_median": med("migrate"),
+            "per_pair_p99_ms": [r["p99_ms"] for r in legs["migrate"]],
+            "lanes_moved_total": moved,
+            "kv_bytes_total": kv_bytes,
+            "kv_bytes_per_migrated_request": (
+                round(kv_bytes / moved) if moved else 0),
+        },
+        "failover": {
+            "p99_resume_ms_median": med("failover"),
+            "per_pair_p99_ms": [r["p99_ms"] for r in legs["failover"]],
+        },
+        "p99_ratio_median": round(ratios[len(ratios) // 2], 3),
+        "pair_p99_ratios": [round(r, 4) for r in ratios],
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--base-url", default="",
@@ -871,6 +1041,16 @@ def main(argv=None) -> int:
                         "the median of per-pair wall ratios, and the "
                         "gateway-scraped handoff bytes/request "
                         "(in-process runs only; CPU-pinned workers)")
+    p.add_argument("--migrate-drain", action="store_true",
+                   help="A/B draining a live replica WITH lane "
+                        "migration (evacuation: KV shipped, decode "
+                        "resumes warm) against drain-by-failover "
+                        "(kill9 vanish: streams re-prefill on the "
+                        "survivor) on fresh in-process gateways: "
+                        "p99 client-observed resume gap per leg, the "
+                        "median of per-pair p99 ratios, and migrated "
+                        "KV bytes per moved request (in-process runs "
+                        "only; uses --replicas, min 2)")
     p.add_argument("--max-queue", type=int, default=16)
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--requests-per-client", type=int, default=8)
@@ -936,9 +1116,22 @@ def main(argv=None) -> int:
         raise SystemExit("--disagg builds its own A/B fleets "
                          "in-process; it composes with none of "
                          "--base-url, --mixed, --replica-procs")
+    if args.migrate_drain and (args.base_url or args.mixed
+                               or args.replica_procs or args.disagg):
+        raise SystemExit("--migrate-drain builds its own A/B gateways "
+                         "in-process; it composes with none of "
+                         "--base-url, --mixed, --replica-procs, "
+                         "--disagg")
     try:
         with cm:
-            if args.disagg:
+            if args.migrate_drain:
+                rec = bench_gateway_migrate_drain_ab(
+                    args.preset, args.slots, args.chunk,
+                    args.max_queue, args.cache_len or None,
+                    args.seed, args.timeout,
+                    replicas=max(2, args.replicas),
+                    reps=args.reps)
+            elif args.disagg:
                 rec = bench_gateway_disagg_ab(
                     args.preset, args.slots, args.chunk,
                     args.max_queue, args.clients,

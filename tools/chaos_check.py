@@ -43,6 +43,16 @@ token-equal to an uninterrupted co-located run, with later long
 prompts degrading to local prefill and dead-decode streams failing
 over via resume-from-token.
 
+``--serving --migrate`` runs the LIVE-MIGRATION leg: every active
+stream on a three-replica gateway is migrated TWICE mid-generation
+under concurrent load — lane KV exported from its replica, installed
+on another, decode resumed without re-prefill — and one stream's
+replica is additionally killed mid-migration.  Every token stream
+must stay EQUAL to an uninterrupted single-engine run (greedy and
+seeded legs): migration is a placement lever, never a correctness
+knob, and the parity bar doubles as the no-token-duplicated/dropped
+detector.
+
 ``--train-elastic`` runs the ELASTIC-MESH chaos gate: a supervised
 8-device training run loses half its devices mid-run (the
 ``mesh:device_lost`` fault point), the supervisor classifies the exit
@@ -58,6 +68,7 @@ Usage::
     python tools/chaos_check.py [--workdir DIR] [--steps 8]
     python tools/chaos_check.py --serving
     python tools/chaos_check.py --serving --disagg
+    python tools/chaos_check.py --serving --migrate
     python tools/chaos_check.py --train-elastic
 """
 
@@ -69,6 +80,7 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:    # runnable as `python tools/chaos_check.py`
@@ -822,6 +834,234 @@ def run_serving_chaos_disagg(*, sampling: bool = True,
             [(r[0] if r else "no result") for r in results]}
 
 
+def run_serving_chaos_migrate(*, sampling: bool = True,
+                              speculative: bool = False,
+                              n_requests: int = 6,
+                              replicas: int = 3,
+                              watchdog_timeout_s: float = 10.0,
+                              timeout_s: float = 300.0) -> dict:
+    """The LIVE-MIGRATION leg of the serving chaos gate: every active
+    stream on a three-replica gateway is migrated TWICE mid-generation
+    under concurrent streaming load — lane KV exported from its
+    replica, installed on another, decode resumed WITHOUT re-prefill —
+    and every token stream must stay EQUAL to an uninterrupted
+    single-engine run (greedy and seeded legs: migration is a
+    placement lever, never a correctness knob).  Once a stream has
+    both hops, it starts murdering: its CURRENT replica takes a kill9
+    vanish (the in-process stand-in for SIGKILL, same as
+    ``run_serving_chaos``) armed mid-migration — the interrupted
+    stream must still complete via the failover/migration
+    re-placement with no token duplicated or dropped (the parity
+    check IS the dup/drop detector).
+
+    The gate asserts: every request completes token-equal to the
+    reference, every stream actually migrated twice (the client
+    triggers each hop only after a committed chunk proves the stream
+    mid-generation), KV bytes moved on at least one hop (long prompts
+    cross the block threshold), at least one replica died to an armed
+    mid-migration kill (never the whole fleet), and /healthz stays
+    routable."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        from tensorflow_train_distributed_tpu.runtime.mesh import (
+            force_platform,
+        )
+
+        force_platform("cpu")
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+        LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.runtime import events, faults
+    from tensorflow_train_distributed_tpu.server import ServingGateway
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    checks = {}
+    cfg = LLAMA_PRESETS["llama_tiny"]
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    # slots=3 leaves the fleet UNDER-subscribed (9 slots, 6 streams):
+    # a migration needs a free lane on a non-source replica, and at
+    # slots=2 the 6 concurrent streams saturate all 6 slots — every
+    # mid-run hop would fail on capacity until a stream finished.
+    kw = dict(slots=3, cache_len=64, chunk=4,
+              prompt_buckets=(8, 16, 32))
+    if sampling:
+        kw.update(temperature=0.8, top_k=40)
+    if speculative:
+        # The speculative leg: every lane carries a DRAFT KV cache
+        # alongside the target's — its export/install must round-trip
+        # both (the meta's kv["draft"] flag) and the migrated stream
+        # must still equal the uninterrupted speculative reference.
+        import dataclasses
+
+        if sampling:
+            raise ValueError("speculative leg runs greedy")
+        draft_cfg = dataclasses.replace(cfg, num_layers=1,
+                                        num_heads=2, num_kv_heads=1)
+        draft_params = LlamaModel(draft_cfg).init(
+            jax.random.PRNGKey(123),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        kw.update(draft_config=draft_cfg, draft_params=draft_params,
+                  speculative_k=3)
+    rng = np.random.default_rng(0)
+    # Long-ish prompts (even requests span >1 KV block, so their lane
+    # export ships real rows) and max_new >= 28 (7+ chunks at chunk=4:
+    # the engine cannot finish a stream before its client — which may
+    # lag a couple of chunks behind under GIL contention — has seen
+    # enough committed chunks to land both migrations).
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(18, 28)) if i % 2 == 0 else int(
+            rng.integers(2, 8))
+        reqs.append(([int(t) for t in rng.integers(1, 200, plen)],
+                     int(rng.integers(28, 36)), 1000 + i))
+
+    # Reference: the same requests on ONE uninterrupted engine.
+    ref_eng = ServingEngine(cfg, params, **kw)
+    rids = [ref_eng.submit(p, m, seed=s if sampling else None)
+            for p, m, s in reqs]
+    ref_out = ref_eng.run()
+    refs = [ref_out[r] for r in rids]
+
+    engines = [ServingEngine(cfg, params, **kw)
+               for _ in range(replicas)]
+    for e in engines:                  # warm: compile before the clock
+        e.submit([1, 2, 3], 5, seed=0 if sampling else None)
+        e.run()
+    gw = ServingGateway(engines, host="127.0.0.1", port=0,
+                        max_queue=4 * n_requests,
+                        watchdog_timeout_s=watchdog_timeout_s).start()
+    rec = events.get_recorder()
+    cursor, _ = rec.events_after(0)
+    migrations = [0] * n_requests
+    kill_lock = threading.Lock()
+    try:
+        results: list = [None] * len(reqs)
+
+        def client(i):
+            prompt, max_new, seed = reqs[i]
+            body = {"prompt": prompt, "max_new": max_new,
+                    "stream": True}
+            if sampling:
+                body["seed"] = seed
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/generate",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    toks, err, rid, chunks = [], None, None, 0
+                    for raw in r:
+                        obj = _json.loads(raw)
+                        if "id" in obj:
+                            rid = obj["id"]
+                        if "tokens" in obj:
+                            toks.extend(obj["tokens"])
+                            chunks += 1
+                            if rid is None:
+                                continue
+                            # Migrate on every committed chunk until
+                            # two hops landed — each attempt is
+                            # provably mid-generation (a committed,
+                            # non-final chunk just arrived); a False
+                            # (stream raced ahead, transient queue
+                            # state) simply retries next chunk.
+                            if migrations[i] < 2:
+                                if gw.pool.migrate(rid):
+                                    migrations[i] += 1
+                            else:
+                                # Later hops, any stream: murder the
+                                # CURRENT replica the instant another
+                                # migration begins — the export races
+                                # the death and the stream must finish
+                                # either way.  Re-armed on committed
+                                # chunks until a replica actually
+                                # dies (the export can win the race
+                                # AND leave the source laneless, in
+                                # which case the dispatch fault never
+                                # fires); the lock serializes the
+                                # no-death check against concurrent
+                                # armers.
+                                with kill_lock:
+                                    if any(s["state"] == "dead"
+                                           for s in
+                                           gw.pool.replica_states()):
+                                        continue
+                                    preq = gw.pool._requests.get(rid)
+                                    src = (preq.replica
+                                           if preq is not None
+                                           else None)
+                                    if src is None:
+                                        continue
+                                    faults.arm(
+                                        "serve:dispatch:1:kill9:"
+                                        f"replica={src.idx}")
+                                if gw.pool.migrate(rid):
+                                    migrations[i] += 1
+                        elif "error" in obj:
+                            err = obj["error"]
+                    results[i] = (err, list(prompt) + toks)
+            except OSError as e:
+                results[i] = (f"{type(e).__name__}: {e}", None)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        checks["all_completed"] = all(
+            r is not None and r[0] is None for r in results)
+        checks["streams_match_reference"] = checks[
+            "all_completed"] and all(
+            r[1] == ref for r, ref in zip(results, refs))
+        checks["every_stream_migrated_twice"] = all(
+            m >= 2 for m in migrations)
+        _, evs = rec.events_after(cursor)
+        moved_bytes = sum(e[5].get("bytes", 0) for e in evs
+                          if e[0] == "request/migrate")
+        checks["kv_bytes_moved"] = moved_bytes > 0
+        # The death DECLARATION can lag the last client completion
+        # (a laneless vanished replica is only noticed by the
+        # watchdog's liveness scan) — poll briefly before judging.
+        deadline = time.monotonic() + max(15.0, watchdog_timeout_s + 5)
+        while time.monotonic() < deadline:
+            states = gw.pool.replica_states()
+            if any(s["state"] == "dead" for s in states):
+                break
+            time.sleep(0.25)
+        n_dead = sum(s["state"] == "dead" for s in states)
+        checks["replica_died"] = 1 <= n_dead <= replicas - 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz", timeout=10) as r:
+            checks["healthz_routable"] = (
+                r.status == 200
+                and _json.loads(r.read())["status"]
+                in ("ok", "degraded"))
+    finally:
+        faults.disarm()
+        gw.drain(timeout=30)
+    return {"ok": all(checks.values()), "checks": checks,
+            "mode": "serving-migrate",
+            "leg": ("speculative" if speculative
+                    else "sampled" if sampling else "greedy"),
+            "migrations": migrations,
+            "migrated_kv_bytes": moved_bytes,
+            "results": [] if all(checks.values()) else
+            [(r[0] if r else "no result") for r in results]}
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser(
@@ -854,6 +1094,15 @@ def main(argv=None) -> int:
                         "survivors must complete everything "
                         "token-equal with later long prompts "
                         "degrading to local prefill")
+    p.add_argument("--migrate", action="store_true",
+                   help="with --serving: run the LIVE-MIGRATION leg — "
+                        "every active stream on a 3-replica gateway "
+                        "is migrated twice mid-generation under load "
+                        "(KV exported/installed, decode resumed "
+                        "without re-prefill), one stream's replica is "
+                        "additionally killed mid-migration, and every "
+                        "token stream must equal an uninterrupted "
+                        "single-engine run")
     p.add_argument("--train-elastic", action="store_true",
                    help="elastic mesh chaos instead: a supervised "
                         "8-device training run loses half its devices "
@@ -877,25 +1126,34 @@ def main(argv=None) -> int:
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
     if args.serving:
-        if args.procs and args.disagg:
-            p.error("--procs and --disagg are separate serving legs; "
-                    "pick one")
-        run = (run_serving_chaos_disagg if args.disagg
+        if sum((args.procs, args.disagg, args.migrate)) > 1:
+            p.error("--procs, --disagg and --migrate are separate "
+                    "serving legs; pick one")
+        run = (run_serving_chaos_migrate if args.migrate
+               else run_serving_chaos_disagg if args.disagg
                else run_serving_chaos_procs if args.procs
                else run_serving_chaos)
         greedy = run(sampling=False)
         sampled = run(sampling=True)
         verdict = {"ok": greedy["ok"] and sampled["ok"],
-                   "mode": ("serving-disagg" if args.disagg
+                   "mode": ("serving-migrate" if args.migrate
+                            else "serving-disagg" if args.disagg
                             else "serving-procs" if args.procs
                             else "serving"),
                    "greedy": greedy, "sampled": sampled}
+        if args.migrate:
+            spec = run_serving_chaos_migrate(sampling=False,
+                                             speculative=True)
+            verdict["speculative"] = spec
+            verdict["ok"] = verdict["ok"] and spec["ok"]
         print(json.dumps(verdict))
         return 0 if verdict["ok"] else 1
     if args.procs:
         p.error("--procs modifies --serving; pass both")
     if args.disagg:
         p.error("--disagg modifies --serving; pass both")
+    if args.migrate:
+        p.error("--migrate modifies --serving; pass both")
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_check_")
     os.makedirs(workdir, exist_ok=True)
     try:

@@ -32,7 +32,12 @@ engine replicas (load + KV-affinity routing, per-replica health and
 ``--watchdog-timeout`` hung-dispatch detection in ``/healthz``,
 deterministic failover that resumes a dead replica's requests on
 survivors from their last streamed token, staged ``--drain-timeout``
-drain); 503 only when NO replica can accept work.  Model/engine flags
+drain); 503 only when NO replica can accept work.  With ≥2 replicas
+the staged drain MIGRATES each draining replica's live lanes to
+survivors first (KV blocks + decode state over ``MIGRATE`` frames —
+no re-prefill, no stream interruption; ``TTD_NO_MIGRATION=1``
+restores wait-then-drain), and ``/healthz`` reports each draining
+replica's ``lanes_remaining``.  Model/engine flags
 are shared with serve.py (``add_engine_args``), so both CLIs configure
 every replica identically.
 

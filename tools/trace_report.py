@@ -107,6 +107,42 @@ def kv_cache_summary(evs: list) -> dict:
     return out if seen else {}
 
 
+def migration_summary(evs: list) -> dict:
+    """Live-migration economics from the pool's flight-recorder
+    instants: every ``request/migrate`` hop (who moved where, at which
+    token, how many KV bytes rode the MIGRATE frame), plus drain-time
+    ``replica/evacuate`` and ``pool/defragment`` roll-ups — the
+    "did the drain actually move my streams" answer next to the KV
+    table's "did prefix caching engage".  Empty when the window has no
+    migration events (single replica, or TTD_NO_MIGRATION=1)."""
+    out = {"migrations": 0, "kv_bytes": 0, "warm_tokens": 0,
+           "ms": [], "evacuations": 0, "evacuated_lanes": 0,
+           "defrag_moves": 0, "hops": []}
+    seen = False
+    for e in evs:
+        name = e.get("name", "")
+        args = e.get("args") or {}
+        if name == "request/migrate":
+            seen = True
+            out["migrations"] += 1
+            out["kv_bytes"] += args.get("bytes", 0)
+            out["warm_tokens"] += args.get("tokens", 0)
+            out["ms"].append(args.get("ms", 0.0))
+            out["hops"].append((args.get("request_id"),
+                                args.get("from_replica"),
+                                args.get("to_replica"),
+                                args.get("resumed_at"),
+                                args.get("bytes", 0)))
+        elif name == "replica/evacuate":
+            seen = True
+            out["evacuations"] += 1
+            out["evacuated_lanes"] += args.get("moved", 0)
+        elif name == "pool/defragment":
+            seen = True
+            out["defrag_moves"] += args.get("moved", 0)
+    return out if seen else {}
+
+
 #: The trainer's step sub-spans (grad-quant split step) plus the parent
 #: dispatch span — the denominator of the comm fraction.
 _TRAIN_STEP_SPANS = ("train/step_dispatch", "train/grad_fwdbwd",
@@ -319,6 +355,26 @@ def main(argv=None) -> int:
         print(f"  fused-attn dispatches {kv['fused_attn_dispatches']}"
               f"  (decode chunks through ops.pallas_kernels."
               f"paged_attention)")
+
+    mig = migration_summary(evs)
+    if mig:
+        ms = sorted(mig["ms"])
+        print("\n== live migration")
+        print(f"  migrations         {mig['migrations']}"
+              f"  ({mig['kv_bytes']} KV bytes shipped, "
+              f"{mig['warm_tokens']} warm tokens installed)")
+        if ms:
+            print(f"  move time ms       p50={_percentile(ms, 0.5):.3f}"
+                  f" p99={_percentile(ms, 0.99):.3f} max={ms[-1]:.3f}")
+        print(f"  drain evacuations  {mig['evacuations']}"
+              f"  ({mig['evacuated_lanes']} lanes moved)")
+        print(f"  defrag moves       {mig['defrag_moves']}")
+        if mig["hops"]:
+            print(f"  {'request':>9}  {'from':>4}  {'to':>4}  "
+                  f"{'at_tok':>6}  {'kv_bytes':>9}")
+            for rid, src, dst, at, nbytes in mig["hops"]:
+                print(f"  {rid!s:>9}  {src!s:>4}  {dst!s:>4}  "
+                      f"{at!s:>6}  {nbytes:9d}")
 
     anatomy = train_step_summary(evs)
     if anatomy:
