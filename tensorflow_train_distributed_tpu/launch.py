@@ -130,7 +130,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the explicit-pipeline exact baseline (A/B "
                         "leg), 'none' (default) today's implicit GSPMD "
                         "allreduce.  TTD_NO_GRAD_QUANT=1 forces none. "
-                        "Pure data-parallel meshes only")
+                        "Composes with dp×fsdp / dp×tp meshes and "
+                        "--grad-accum")
+    p.add_argument("--grad-overlap", type=int, default=4, metavar="K",
+                   help="with --grad-quant: partition the grad tree "
+                        "into K byte-balanced buckets (reverse-backward "
+                        "order) and dispatch each bucket's quantized "
+                        "sync + optimizer apply in-flight while later "
+                        "buckets compute (comm/compute overlap); 0 or "
+                        "1 restores the sequential three-program "
+                        "pipeline byte-for-byte.  TTD_NO_GRAD_OVERLAP=1 "
+                        "forces sequential")
     p.add_argument("--sharded-update", action="store_true",
                    help="cross-replica sharded weight update (arxiv "
                         "2004.13336): each data replica runs the "
@@ -977,6 +987,7 @@ def run(args: argparse.Namespace) -> RunResult:
             log_grad_norm=args.log_grad_norm,
             zero1=args.zero1,
             grad_quant=args.grad_quant,
+            grad_overlap=args.grad_overlap,
             sharded_update=args.sharded_update,
             # Mid-training eval (--eval-every) must score the SAME model
             # the final eval/export does: the EMA view when enabled.

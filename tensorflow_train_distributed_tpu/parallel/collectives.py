@@ -326,6 +326,187 @@ def grad_sync_wire_bytes(grads, world: int, wire: str = "int8",
     return int(math.ceil(total))
 
 
+# --- bucketed gradient sync (comm/compute overlap) --------------------------
+
+
+def plan_grad_buckets(grads, k: int) -> list[list[int]]:
+    """Partition grad-tree leaves into ``min(k, n)`` contiguous
+    byte-balanced buckets.
+
+    Returns bucket index lists in DISPATCH order: buckets are contiguous
+    runs of the REVERSED flatten order, so bucket 0 holds the tree's
+    last leaves — the first gradients reverse-mode AD materializes
+    (backward runs last-layer-first), letting its sync dispatch while
+    earlier layers' grads are still computing.  Within a bucket, indices
+    are ascending flatten order.  Byte balance is greedy on cumulative
+    size: bucket ``j`` closes once cumulative bytes reach ``j/k`` of the
+    total, and is force-closed when the remaining leaves are exactly
+    enough to give every remaining bucket one leaf — so exactly
+    ``min(k, n)`` non-empty buckets always come back (a skewed size
+    distribution degrades balance, never the bucket count).  A
+    deterministic pure function of (leaf shapes/dtypes, ``k``) —
+    abstract leaves (ShapeDtypeStructs) work.
+    """
+    leaves = jax.tree.leaves(grads)
+    n = len(leaves)
+    if n == 0:
+        return []
+    k = max(1, min(int(k), n))
+
+    def _bytes(leaf):
+        shape = np.shape(leaf)
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        return size * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+
+    order = list(range(n))[::-1]
+    sizes = [_bytes(leaves[i]) for i in order]
+    total = float(sum(sizes))
+    buckets: list[list[int]] = [[]]
+    cum = 0.0
+    for pos, i in enumerate(order):
+        buckets[-1].append(i)
+        cum += sizes[pos]
+        left = n - pos - 1
+        if len(buckets) < k and left >= 1 and (
+                cum >= len(buckets) * total / k
+                or left == k - len(buckets)):
+            buckets.append([])
+    return [sorted(b) for b in buckets]
+
+
+def _leaf_rank_chunk(n: int, world: int) -> int:
+    """Per-rank chunk length for one quantized leaf of ``n`` elements:
+    the smallest whole-``Q8_BLOCK`` multiple covering ``ceil(n/W)``."""
+    return Q8_BLOCK * max(1, -(-int(n) // (max(1, int(world)) * Q8_BLOCK)))
+
+
+def ef_bucket_sync(grads, residual, axis: str, *, wire: str = "int8",
+                   min_quant_elems: int = DEFAULT_MIN_QUANT_ELEMS):
+    """Error-feedback gradient mean-allreduce of ONE bucket (leaf subset).
+
+    Same call contract and return shape as ``ef_grad_sync`` — call
+    INSIDE shard_map over ``axis``, leaves ``[1, *shape]``, returns
+    ``(mean_grads, new_residual, finite)`` — but with LEAF-ALIGNED Q8
+    layout, which is what makes bucketing legal: each quantized leaf
+    (``m`` elements) gets its own per-rank chunk of
+    ``c = Q8_BLOCK·ceil(m/(W·Q8_BLOCK))`` (a whole number of Q8
+    blocks), is padded to ``(W, c)``, and the bucket's leaves are
+    concatenated ALONG THE CHUNK DIM into one ``(W, ΣC)`` pipeline —
+    still one all_to_all + one all_gather per bucket, but no Q8 block
+    and no rank chunk ever spans a leaf boundary.  Every leaf's
+    quantization, wire bytes, reduction order, and residual are
+    therefore computed independently of which OTHER leaves share its
+    bucket: results are bitwise-invariant to the bucket partition
+    (K ∈ {1..n_leaves} all agree; pinned in tests/test_grad_quant.py).
+
+    Two deltas vs ``ef_grad_sync`` (the sequential/kill-switch path,
+    which is kept byte-identical to its pre-bucketing form):
+
+    - layout: ``ef_grad_sync`` packs one flat vector whose chunking
+      depends on the TOTAL length, so its bytes differ from this
+      recipe's (padding to whole blocks costs ≤ ``W·Q8_BLOCK``
+      elements per leaf on the wire; both are ~4x under f32).
+    - ``finite`` is computed over THIS bucket's leaves only, and gates
+      only this bucket's residual commit.  Callers running K buckets
+      AND the per-bucket flags together for the optimizer's skip
+      decision; residual poisoning (the reason non-finite steps leave
+      the residual untouched) is per-leaf, so bucket-local gating
+      protects exactly the leaves that need it.
+    """
+    if wire not in ("f32", "int8"):
+        raise ValueError(f"wire must be f32|int8, got {wire!r}")
+    W = axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+
+    finite = jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]).all()
+    finite = jax.lax.pmin(finite.astype(jnp.int32), axis).astype(jnp.bool_)
+
+    leaves_g, treedef = jax.tree.flatten(grads)
+    leaves_r = treedef.flatten_up_to(residual)
+    shapes = [g.shape[1:] for g in leaves_g]
+    sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+    quant_ix = [i for i, n in enumerate(sizes)
+                if wire == "int8" and n >= min_quant_elems and W > 1]
+    exact_ix = [i for i in range(len(sizes)) if i not in set(quant_ix)]
+    out: list = [None] * len(sizes)
+    new_r: list = [jnp.zeros_like(r) for r in leaves_r]
+
+    if exact_ix:
+        cat = jnp.concatenate(
+            [leaves_g[i][0].astype(jnp.float32).reshape(-1)
+             for i in exact_ix])
+        summed = jax.lax.psum(cat, axis)
+        offs = np.cumsum([0] + [sizes[i] for i in exact_ix])
+        for j, i in enumerate(exact_ix):
+            out[i] = (summed[offs[j]:offs[j + 1]] / W).reshape(shapes[i])
+    if quant_ix:
+        chunks = [_leaf_rank_chunk(sizes[i], W) for i in quant_ix]
+        rows = []
+        for i, c in zip(quant_ix, chunks):
+            comp = (leaves_g[i][0].astype(jnp.float32)
+                    + leaves_r[i][0].astype(jnp.float32)).reshape(-1)
+            rows.append(jnp.pad(comp, (0, W * c - sizes[i])).reshape(W, c))
+        p = jnp.concatenate(rows, axis=1)                # (W, C)
+        C = p.shape[1]
+        q, s = jax.vmap(quantize_q8)(p)                  # (W,C) / (W,C/blk)
+        send_err = p - jax.vmap(dequantize_q8)(q, s)
+        tq = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+        ts = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+        red = jax.vmap(dequantize_q8)(tq, ts).sum(axis=0)   # (C,) exact f32
+        q2, s2 = quantize_q8(red)
+        owner_err = red - dequantize_q8(q2, s2)
+        fq = jax.lax.all_gather(q2, axis, axis=0, tiled=True)   # (W*C,)
+        fs = jax.lax.all_gather(s2, axis, axis=0, tiled=True)
+        summed = jax.vmap(dequantize_q8)(fq.reshape(W, C),
+                                         fs.reshape(W, s2.shape[0]))
+        err = send_err.at[idx].add(owner_err)            # (W, C)
+        off = 0
+        for i, c in zip(quant_ix, chunks):
+            m = sizes[i]
+            cols = slice(off, off + c)
+            out[i] = (summed[:, cols].reshape(-1)[:m] / W).reshape(shapes[i])
+            piece = err[:, cols].reshape(-1)[:m].reshape(shapes[i])
+            new_r[i] = jnp.where(finite, piece[None],
+                                 leaves_r[i]).astype(leaves_r[i].dtype)
+            off += c
+
+    mean_grads = treedef.unflatten(out)
+    new_residual = treedef.unflatten(new_r)
+    return mean_grads, new_residual, finite
+
+
+def bucket_sync_wire_bytes(grads, world: int, wire: str = "int8",
+                           min_quant_elems: int = DEFAULT_MIN_QUANT_ELEMS
+                           ) -> int:
+    """Analytic per-rank wire bytes of one ``ef_bucket_sync`` call.
+
+    Mirrors the leaf-aligned layout: every quantized leaf contributes a
+    whole-block per-rank chunk ``c = Q8_BLOCK·ceil(m/(W·Q8_BLOCK))``;
+    phase 1 all_to_all sends ``(W-1)`` rows of ``(ΣC int8 + one f32
+    scale per Q8 block)`` and phase 2 all_gather moves the same volume.
+    Exact-path leaves use the ring convention, as in
+    ``grad_sync_wire_bytes``.  Because the accounting is per-leaf, the
+    total over any bucket partition equals the single-bucket figure.
+    """
+    W = max(1, int(world))
+    n_exact = 0
+    C = 0
+    for leaf in jax.tree.leaves(grads):
+        n = int(np.prod(np.shape(leaf), dtype=np.int64)) if np.shape(leaf) \
+            else 1
+        if wire != "int8" or n < min_quant_elems or W <= 1:
+            n_exact += n
+        else:
+            C += _leaf_rank_chunk(n, W)
+    total = 2 * (W - 1) / W * 4 * n_exact
+    if C:
+        total += 2 * (W - 1) * (C + 4 * (C // Q8_BLOCK))
+    return int(math.ceil(total))
+
+
 # --- host-level helpers -----------------------------------------------------
 
 

@@ -218,7 +218,15 @@ class Recorder:
 
     def span(self, name: str, **attrs):
         """Context manager timing its block into one complete event
-        (``ph="X"``); a no-op singleton under the kill switch."""
+        (``ph="X"``); a no-op singleton under the kill switch.
+
+        Repeated spans of one name within a step are the sub-span
+        convention (no nesting needed): the bucketed-overlap trainer
+        emits one ``train/grad_comm`` / ``train/optimizer_apply`` span
+        PER BUCKET, tagged ``bucket=<i>, buckets=<K>`` in attrs, plus a
+        single ``train/step_barrier`` span at the only host-blocking
+        point — trace_report groups same-name spans per step and breaks
+        them out per bucket when the ``bucket`` attr is present."""
         if trace_killed():
             return _NULL_SPAN
         return _Span(self, name, attrs or None)

@@ -114,11 +114,30 @@ class TrainerConfig:
     # A/B leg), "none" (default) today's single-program GSPMD step,
     # bitwise-unchanged.  TTD_NO_GRAD_QUANT=1 (read at Trainer
     # construction — the residual leaves compile into the state) forces
-    # "none".  Requires a pure data-parallel mesh (data>1, every other
-    # axis 1), grad_accum=1, steps_per_execution=1, and a task with no
-    # mutable model collections (BN batch_stats are reduced by GSPMD in
-    # the implicit path; the per-shard pipeline has no equivalent).
+    # "none".  Requires data>1 (model-parallel axes — fsdp/tensor — are
+    # supported via partial manualization: only "data" is manual inside
+    # the pipeline's shard_maps, GSPMD keeps handling the rest),
+    # steps_per_execution=1, and a task with no mutable model
+    # collections (BN batch_stats are reduced by GSPMD in the implicit
+    # path; the per-shard pipeline has no equivalent).  grad_accum>1
+    # composes: microbatch grads accumulate in fp32 inside the per-shard
+    # program and the wire sees ONE quantized sync per optimizer step.
     grad_quant: str = "none"
+    # Comm/compute overlap for the explicit grad-quant pipeline
+    # (ROADMAP item 3): partition the grad pytree into ≤K byte-balanced
+    # buckets in reverse-backward layer order and dispatch each bucket's
+    # int8 reduce-scatter (collectives.ef_bucket_sync) and optimizer
+    # apply as in-flight async programs, barriering ONCE at step end —
+    # the fabric works while later buckets compute and the blocking
+    # comm-fraction drops to dispatch time.  0/1 = the sequential
+    # three-program pipeline (grad_step → ef_grad_sync → apply_step),
+    # byte-for-byte the pre-overlap step.  TTD_NO_GRAD_OVERLAP=1 (read
+    # at Trainer construction) forces 0.  Only meaningful with
+    # grad_quant != "none".  NOTE the per-bucket apply is bitwise-equal
+    # to the whole-tree apply for per-leaf optimizers (adam/sgd/adamw);
+    # transforms coupling leaves globally (clip_by_global_norm) would
+    # clip per-bucket — keep grad_overlap=0 with those.
+    grad_overlap: int = 4
     # Cross-replica sharded weight update (arxiv 2004.13336):
     # zero1 extended from the moments to the update computation — each
     # data replica runs the optimizer math on only its gradient shard
@@ -144,6 +163,100 @@ class TrainerConfig:
     # materializing anything (projection is the same eval_shape
     # plan_state_memory uses).
     hbm_budget_bytes: Optional[int] = None
+
+
+class _BucketPlan:
+    """Host-side bookkeeping for the bucketed-overlap step.
+
+    Built ONCE from the first step's concrete state (leaf structure is
+    static across a fit): the leaf buckets
+    (``collectives.plan_grad_buckets`` — reverse-backward order,
+    byte-balanced), per-bucket wire MB, and the opt-state split/merge
+    index maps.
+
+    The opt-state maps exploit that pytree flattening is DFS: the full
+    flatten of ``opt_state`` is the concatenation of each node's own
+    flatten, so every params-structured sub-tree (adam's ``mu``/``nu``)
+    occupies one CONTIGUOUS run of param-ordered leaf slots.  Walking
+    the nodes once (with the param treedef as the ``is_leaf`` match)
+    yields, per bucket, the flat indices its opt sub-state takes.
+    Leaves OUTSIDE param-structured sub-trees — step counts, injected
+    hyperparams — are SHARED: they ride along whole in every bucket's
+    sub-state (so ``tx.update`` sees a structurally-complete state) and
+    must never be donated (bucket b+1 still reads the buffer bucket b
+    was handed).  Merge takes each bucket's copy of its own param
+    leaves and any bucket's copy of the shared leaves (identical by
+    construction: every bucket computes them from the same inputs).
+    """
+
+    def __init__(self, state, k: int, world: int, wire: str):
+        params = state.params
+        self.treedef = jax.tree.structure(params)
+        self.n_leaves = self.treedef.num_leaves
+        self.buckets = collectives.plan_grad_buckets(params, k)
+        self.k = len(self.buckets)
+        p_flat = jax.tree.leaves(params)
+        self.bucket_mb = [
+            collectives.bucket_sync_wire_bytes(
+                [p_flat[i] for i in ix], world, wire) / 1e6
+            for ix in self.buckets]
+
+        pdef = self.treedef
+
+        def is_match(n):
+            return jax.tree.structure(n) == pdef
+
+        self._is_match = is_match
+        nodes = jax.tree.flatten(state.opt_state, is_leaf=is_match)[0]
+        ix: list = [[] for _ in self.buckets]
+        off = 0
+        for node in nodes:
+            if is_match(node):
+                for b, bix in enumerate(self.buckets):
+                    ix[b].extend(off + i for i in bix)
+                off += self.n_leaves
+            else:
+                for b in range(self.k):
+                    ix[b].append(off)
+                off += 1
+        self.opt_leaf_ix = ix
+        self.n_opt_leaves = off
+        assert off == jax.tree.structure(state.opt_state).num_leaves
+        self.bucket_opt_defs = []
+        for bix in self.buckets:
+            t = jax.tree.map(
+                lambda n, _bix=bix: (
+                    [pdef.flatten_up_to(n)[i] for i in _bix]
+                    if is_match(n) else n),
+                state.opt_state, is_leaf=is_match)
+            self.bucket_opt_defs.append(jax.tree.structure(t))
+
+    def split_opt(self, opt_state):
+        """Per-bucket opt sub-states (param sub-trees → bucket leaf
+        lists; shared leaves replicated into every bucket)."""
+        flat = jax.tree.leaves(opt_state)
+        return [d.unflatten([flat[j] for j in ixs])
+                for d, ixs in zip(self.bucket_opt_defs, self.opt_leaf_ix)]
+
+    def merge_opt(self, opt_state_template, outs):
+        """Reassemble the full new opt_state (``opt_state_template``'s
+        structure) from the per-bucket apply outputs.  Shared leaves are
+        written by every bucket with identical values; param leaves by
+        exactly their owning bucket."""
+        flat = [None] * self.n_opt_leaves
+        for ixs, out in zip(self.opt_leaf_ix, outs):
+            oflat = jax.tree.leaves(out)
+            for pos, j in enumerate(ixs):
+                flat[j] = oflat[pos]
+        return jax.tree.structure(opt_state_template).unflatten(flat)
+
+    def shardings_for(self, tree, bucket: int):
+        """Slice a params-structured sharding tree (or None) to one
+        bucket's leaf list."""
+        if tree is None:
+            return None
+        flat = self.treedef.flatten_up_to(tree)
+        return [flat[i] for i in self.buckets[bucket]]
 
 
 class Trainer:
@@ -192,6 +305,26 @@ class Trainer:
         # construction (the kill switch must win before the residual
         # leaves are compiled into the state).
         self.grad_quant = self._resolve_grad_quant(config, mesh)
+        # Bucketed comm/compute overlap: resolved once at construction
+        # like grad_quant (the kill switch picks the step builder).
+        self.grad_overlap = self._resolve_grad_overlap(config,
+                                                       self.grad_quant)
+
+    @staticmethod
+    def _resolve_grad_overlap(config: TrainerConfig, grad_quant: str) -> int:
+        k = int(config.grad_overlap)
+        if k < 0:
+            raise ValueError(f"grad_overlap must be >= 0, got {k}")
+        if grad_quant == "none" or k <= 1:
+            return 0
+        if os.environ.get("TTD_NO_GRAD_OVERLAP", "0") not in ("", "0"):
+            logger.warning(
+                "TTD_NO_GRAD_OVERLAP=1: bucketed comm/compute overlap "
+                "disabled — sequential three-program grad-quant pipeline "
+                "(set before Trainer construction; the choice compiles "
+                "in)")
+            return 0
+        return k
 
     @staticmethod
     def _resolve_grad_quant(config: TrainerConfig, mesh) -> str:
@@ -208,24 +341,11 @@ class Trainer:
                 "Trainer construction; the choice compiles in)")
             return "none"
         sizes = dict(mesh.shape)
-        others = {a: s for a, s in sizes.items()
-                  if a != "data" and s > 1}
-        if others:
-            raise ValueError(
-                f"grad_quant={gq!r} supports pure data-parallel meshes "
-                f"(the explicit pipeline manualizes only the data axis); "
-                f"mesh also shards {others} — drop grad-quant or the "
-                "model-parallel axes")
         if sizes.get("data", 1) <= 1:
             logger.warning(
                 "grad_quant=%r is a no-op on a data=1 mesh; using the "
                 "exact single-program step", gq)
             return "none"
-        if config.grad_accum > 1:
-            raise ValueError(
-                "grad_quant does not compose with grad_accum>1 yet "
-                "(the accumulation scan lives inside the single-program "
-                "step); drop one of the two")
         if config.steps_per_execution > 1:
             raise ValueError(
                 "grad_quant does not compose with steps_per_execution>1 "
@@ -512,12 +632,22 @@ class Trainer:
             batch,
         )
 
+        return self._grad_accum_scan(state.params, state.model_state,
+                                     state.loss_scale, micro, rng)
+
+    def _grad_accum_scan(self, params, model_state, loss_scale, micro, rng):
+        """Scan a pre-split microbatch axis, averaging grads in fp32 —
+        the shared core of the implicit path's ``_accumulated_grads``
+        and the quant pipeline's per-shard accumulation (which feeds
+        the ONE post-scan quantization, so accumulation never stacks
+        quantization error)."""
+        a = jax.tree.leaves(micro)[0].shape[0]
+
         def body(carry, xs):
             ms, acc = carry
             mb, idx = xs
             grads, loss, metrics, new_ms = self._microbatch_grads(
-                state.params, ms, mb, jax.random.fold_in(rng, idx),
-                state.loss_scale)
+                params, ms, mb, jax.random.fold_in(rng, idx), loss_scale)
             # Weighted-mean losses (Task contract): each microbatch's
             # gradient is d(weighted mean)/dp, so the global gradient is the
             # weight-weighted mean of microbatch gradients.
@@ -527,39 +657,86 @@ class Trainer:
             return (new_ms, acc), (loss, metrics, w)
 
         zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
         (new_ms, grads), (losses, stacked, ws) = jax.lax.scan(
-            body, (state.model_state, zeros), (micro, jnp.arange(a)))
+            body, (model_state, zeros), (micro, jnp.arange(a)))
         # Tasks report UNclamped weights (an all-pad batch is weight 0);
         # guard the division — zero-weight microbatches contribute 0·loss,
         # so the epsilon never changes a batch that has any real weight.
         w_total = jnp.maximum(jnp.sum(ws), 1e-6)
         grads = jax.tree.map(
-            lambda g, p: (g / w_total).astype(p.dtype), grads, state.params)
+            lambda g, p: (g / w_total).astype(p.dtype), grads, params)
         metrics = jax.tree.map(
             lambda m: jnp.sum(m * ws, axis=0) / w_total, stacked)
         if "loss_weight" in metrics:
             metrics["loss_weight"] = w_total  # total, as one big batch would
         return grads, jnp.sum(losses * ws) / w_total, metrics, new_ms
 
-    def _constrain_update(self, grads):
-        """Cross-replica sharded weight update, entry half: pin the
-        gradients to the per-leaf ``data``-sharded update shardings so
-        GSPMD turns the gradient all-reduce into reduce-scatter and the
-        optimizer math that follows runs on 1/N elements per replica
-        (arxiv 2004.13336).  No-op when ``sharded_update`` is off."""
-        if self._update_shardings is None:
-            return grads
-        return jax.tree.map(jax.lax.with_sharding_constraint, grads,
-                            self._update_shardings)
+    def _apply_grad_parts(self, params, opt_state, loss_scale, step, grads,
+                          finite, update_shardings, param_shardings):
+        """Tree-shape-agnostic core of the optimizer apply.
 
-    def _gather_updated(self, new_params):
-        """Cross-replica sharded weight update, exit half: all-gather
-        the shard-updated params back to their resting shardings."""
-        if self._param_shardings is None:
-            return new_params
-        return jax.tree.map(jax.lax.with_sharding_constraint, new_params,
-                            self._param_shardings)
+        ``params``/``grads`` (and the param-structured parts of
+        ``opt_state``) may be the full model tree or any bucket's leaf
+        list — optax transformations are pytree-generic, so per-leaf
+        optimizers (sgd/adam/adamw, per-value clipping) compute bitwise
+        the same values bucketed as whole; transforms that couple
+        leaves globally (clip_by_global_norm) are the documented
+        exception (their norm would be per-bucket — see the
+        ``grad_overlap`` config note).  ``update_shardings`` /
+        ``param_shardings`` are the cross-replica sharded-update
+        constraint trees matching ``params``' shape (None = replicated
+        apply).  Returns ``(new_params, new_opt, new_ls, metrics)``.
+        """
+        if update_shardings is not None:
+            # Cross-replica sharded weight update, entry half: pin the
+            # gradients to the per-leaf ``data``-sharded update
+            # shardings so GSPMD turns the gradient all-reduce into
+            # reduce-scatter and the optimizer math that follows runs
+            # on 1/N elements per replica (arxiv 2004.13336).
+            grads = jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                                 update_shardings)
+        metrics = {}
+        if loss_scale is not None:
+            if finite is None:
+                finite = mp.grads_finite(grads)
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # Skip the update entirely on overflow (LossScaleOptimizer
+            # contract: no param/opt-state change on non-finite grads).
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_params, params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_opt, opt_state)
+            new_ls = mp.update_loss_scale(loss_scale, finite, self.policy)
+            metrics = dict(metrics, loss_scale=new_ls.scale,
+                           grads_finite=finite.astype(jnp.float32))
+        else:
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_ls = None
+        if param_shardings is not None:
+            # Exit half: all-gather the shard-updated params back to
+            # their resting shardings.
+            new_params = jax.tree.map(jax.lax.with_sharding_constraint,
+                                      new_params, param_shardings)
+
+        if self.config.log_grad_norm:
+            metrics["grad_norm"] = optax.global_norm(grads)
+        if self.lr_schedule is not None:
+            metrics["lr"] = jnp.asarray(self.lr_schedule(step), jnp.float32)
+        else:
+            # Dynamic LR (inject_hyperparams + ReduceLROnPlateau): the LR
+            # lives in optimizer state — surface it so TensorBoard/JSONL
+            # keep an lr series exactly when it starts moving.
+            from tensorflow_train_distributed_tpu.training.callbacks import (
+                get_injected_hyperparam,
+            )
+
+            inj = get_injected_hyperparam(opt_state, "learning_rate")
+            if inj is not None:
+                metrics["lr"] = jnp.asarray(inj, jnp.float32)
+        return new_params, new_opt, new_ls, metrics
 
     def _apply_grads(self, state: TrainState, grads, finite=None):
         """The optimizer-apply half of a train step, shared VERBATIM by
@@ -574,48 +751,9 @@ class Trainer:
         ``(new_params, new_opt, new_ls, extra_metrics)``; the caller
         assembles the state (model_state/residual differ per path).
         """
-        grads = self._constrain_update(grads)
-        metrics = {}
-        if state.loss_scale is not None:
-            if finite is None:
-                finite = mp.grads_finite(grads)
-            updates, new_opt = self.tx.update(grads, state.opt_state,
-                                              state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            # Skip the update entirely on overflow (LossScaleOptimizer
-            # contract: no param/opt-state change on non-finite grads).
-            new_params = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_params, state.params)
-            new_opt = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state)
-            new_ls = mp.update_loss_scale(state.loss_scale, finite,
-                                          self.policy)
-            metrics = dict(metrics, loss_scale=new_ls.scale,
-                           grads_finite=finite.astype(jnp.float32))
-        else:
-            updates, new_opt = self.tx.update(grads, state.opt_state,
-                                              state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            new_ls = None
-        new_params = self._gather_updated(new_params)
-
-        if self.config.log_grad_norm:
-            metrics["grad_norm"] = optax.global_norm(grads)
-        if self.lr_schedule is not None:
-            metrics["lr"] = jnp.asarray(self.lr_schedule(state.step),
-                                        jnp.float32)
-        else:
-            # Dynamic LR (inject_hyperparams + ReduceLROnPlateau): the LR
-            # lives in optimizer state — surface it so TensorBoard/JSONL
-            # keep an lr series exactly when it starts moving.
-            from tensorflow_train_distributed_tpu.training.callbacks import (
-                get_injected_hyperparam,
-            )
-
-            inj = get_injected_hyperparam(state.opt_state, "learning_rate")
-            if inj is not None:
-                metrics["lr"] = jnp.asarray(inj, jnp.float32)
-        return new_params, new_opt, new_ls, metrics
+        return self._apply_grad_parts(
+            state.params, state.opt_state, state.loss_scale, state.step,
+            grads, finite, self._update_shardings, self._param_shardings)
 
     def _single_step(self, state: TrainState, batch):
         rng = jax.random.fold_in(jax.random.key(self.config.seed), state.step)
@@ -637,7 +775,7 @@ class Trainer:
         )
         return new_state, metrics
 
-    def _jit_step(self, fn, *, site, donate=()):
+    def _jit_step(self, fn, *, site, donate=(), max_compiles=8):
         """jit ``fn(*args)`` with the trainer's mesh + logical rules.
 
         set_mesh must wrap the *call* (it is illegal inside jit): it binds
@@ -656,7 +794,8 @@ class Trainer:
                 return fn(*args)
 
         jitted = compilecheck.jit(step, site=f"trainer.{site}",
-                                  group=self, donate_argnums=donate)
+                                  group=self, donate_argnums=donate,
+                                  max_compiles=max_compiles)
 
         def call(*args):
             with compat.set_mesh(self.mesh):
@@ -668,7 +807,9 @@ class Trainer:
         if self._train_step is not None:
             return self._train_step
         if self.grad_quant != "none":
-            self._train_step = self._build_quant_step()
+            self._train_step = (self._build_overlap_step()
+                                if self.grad_overlap > 1
+                                else self._build_quant_step())
             return self._train_step
         k = self.config.steps_per_execution
 
@@ -685,31 +826,41 @@ class Trainer:
 
     # -- quantized gradient collectives (grad_quant != "none") ---------------
 
-    def _build_quant_step(self):
-        """The explicit-gradient-exchange step: THREE jitted programs
-        instead of one, so the gradient communication is a separate
-        dispatch the flight recorder can meter (``train/grad_comm`` vs
-        ``train/optimizer_apply`` sub-spans inside ``step_dispatch``).
+    def _quant_model_axes(self) -> tuple:
+        """Model-parallel mesh axes (>1, not "data"): non-empty picks
+        the GSPMD row-vmap grad program over the fully-manual shard_map
+        one — on a pure data-parallel mesh this is empty and the
+        lowering stays byte-identical to the pre-overlap pipeline (the
+        kill-switch parity guarantee rides on that)."""
+        return tuple(a for a, s in dict(self.mesh.shape).items()
+                     if a != "data" and s > 1)
 
-        1. ``trainer.grad_step`` — fwd/bwd per data shard inside
-           shard_map (the loss is the LOCAL mean; no cross-replica
-           reduction happens here, unlike the implicit GSPMD step);
-           local grads leave with a leading per-replica dim, sharded.
-        2. ``trainer.grad_sync`` — ``collectives.ef_grad_sync``: the
-           error-feedback int8-wire allreduce (or the exact-psum f32
-           A/B leg).  The only cross-replica traffic of the step.
-           BOTH inputs are donated: the residual buffers alias their
-           outputs, or peak HBM grows by a full f32 param copy.
-        3. ``trainer.apply_step`` — the optimizer apply (with the
-           cross-replica sharded-update constraints when configured),
-           donating the state.
+    def _quant_grad_prog(self):
+        """Build ``grad_prog(state, batch) -> (local_grads, metrics)`` —
+        the fwd/bwd program shared by the sequential three-program
+        pipeline and the bucketed overlap step.  Local grads leave with
+        a leading per-data-replica dim (global ``[W, *shape]``, sharded
+        over "data"); no cross-"data" reduction happens here — that is
+        the sync program's job.  With ``grad_accum>1`` the local batch
+        is scanned in ``a`` microbatches, accumulating in fp32 with the
+        same weighted-mean algebra as ``_accumulated_grads`` — the wire
+        then sees ONE quantized sync of the accumulated gradient per
+        optimizer step.
 
-        The composite blocks at each program boundary so the sub-span
-        durations are real device time, not dispatch time — the price
-        of a meterable comm fraction (documented in README; the
-        ``none`` path keeps today's fully-async single dispatch).
+        Two lowerings, one contract:
+
+        - pure data-parallel mesh: per-shard code inside a fully-manual
+          shard_map (byte-identical to the pre-overlap pipeline).
+        - model-parallel axes present (dp×fsdp / dp×tp): a PLAIN GSPMD
+          jit — the batch is reshaped to ``(W, B/W)`` rows constrained
+          over "data" and the per-row gradient is vmapped, so GSPMD
+          keeps sharding params/activations over fsdp/tensor exactly as
+          in the implicit step (logical rules stay live; no manual
+          region).  Per-row grads are then constrained to ``P("data")``
+          — replicated over model axes, the layout the wire recipe and
+          the EF residual already use.
         """
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from tensorflow_train_distributed_tpu.parallel.sharding import (
             shard_batch_spec,
@@ -717,9 +868,27 @@ class Trainer:
 
         mesh = self.mesh
         W = mesh.shape["data"]
-        wire = self.grad_quant
         seed = self.config.seed
+        accum = self.config.grad_accum
+        model_axes = self._quant_model_axes()
         batch_spec = shard_batch_spec(mesh)
+
+        def local_accum(params, model_state, loss_scale, local_batch, rng):
+            bsz = jax.tree.leaves(local_batch)[0].shape[0]
+            if bsz % accum:
+                raise ValueError(
+                    f"per-shard batch size {bsz} not divisible by "
+                    f"grad_accum={accum}")
+            # No sharding re-pin here (unlike _accumulated_grads): the
+            # batch is already the shard-local slice.  The returned
+            # loss_weight is the shard's TOTAL weight, so the
+            # cross-shard pre-scaling below weights shards exactly as
+            # one big batch would.
+            micro = jax.tree.map(
+                lambda x: x.reshape((accum, bsz // accum) + x.shape[1:]),
+                local_batch)
+            return self._grad_accum_scan(params, model_state, loss_scale,
+                                         micro, rng)
 
         def per_shard_grads(params, model_state, loss_scale, step,
                             local_batch):
@@ -730,10 +899,16 @@ class Trainer:
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
             # Logical sharding rules are meaningless inside the manual
             # region (every >1 axis is manualized): null them so model
-            # constraint annotations no-op instead of naming manual axes.
+            # constraint annotations no-op instead of naming manual
+            # axes.  (With auto axes present GSPMD still propagates
+            # model-parallel shardings from the param inputs.)
             with nn.logical_axis_rules(()):
-                grads, loss, metrics, _ = self._microbatch_grads(
-                    params, model_state, local_batch, rng, loss_scale)
+                if accum > 1:
+                    grads, loss, metrics, _ = local_accum(
+                        params, model_state, loss_scale, local_batch, rng)
+                else:
+                    grads, loss, metrics, _ = self._microbatch_grads(
+                        params, model_state, local_batch, rng, loss_scale)
             metrics = dict(metrics, loss=loss)
             w = metrics.get("loss_weight")
             if w is None:
@@ -768,7 +943,111 @@ class Trainer:
             return sm(state.params, state.model_state, state.loss_scale,
                       state.step, batch)
 
+        if not model_axes:
+            return grad_prog
+
+        # GSPMD row-vmap lowering for dp×fsdp / dp×tp meshes: rows keep
+        # any fsdp batch split on dim 1; grads leave replicated over the
+        # model axes (the wire/EF-residual layout).
+        row_axes = tuple(a for a in ("fsdp",) if a in model_axes)
+        row_spec = P("data", row_axes) if row_axes else P("data")
+        grads_sharding = NamedSharding(mesh, P("data"))
+
+        def grad_prog_rows(state, batch):
+            bsz = jax.tree.leaves(batch)[0].shape[0]
+            if bsz % W:
+                raise ValueError(
+                    f"global batch size {bsz} not divisible by "
+                    f"data-parallel degree {W} (grad_quant pipeline)")
+            rows = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x.reshape((W, x.shape[0] // W) + x.shape[1:]),
+                    NamedSharding(mesh, row_spec)),
+                batch)
+            base = jax.random.fold_in(jax.random.key(seed), state.step)
+            rngs = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(W))
+
+            def one_row(row, rng):
+                if accum > 1:
+                    g, loss, m, _ = local_accum(
+                        state.params, state.model_state, state.loss_scale,
+                        row, rng)
+                else:
+                    g, loss, m, _ = self._microbatch_grads(
+                        state.params, state.model_state, row, rng,
+                        state.loss_scale)
+                return g, dict(m, loss=loss)
+
+            grads, metrics = jax.vmap(one_row)(rows, rngs)
+            w = metrics.get("loss_weight")
+            if w is None:
+                metrics = jax.tree.map(
+                    lambda m: jnp.mean(jnp.asarray(m, jnp.float32), axis=0),
+                    metrics)
+            else:
+                # Same weighted-mean pre-scaling as the manual path —
+                # row reductions instead of psum over "data".
+                w = jnp.asarray(w, jnp.float32)
+                w_total = jnp.maximum(jnp.sum(w), 1e-6)
+                scale = w * W / w_total
+                grads = jax.tree.map(
+                    lambda g: g * scale.reshape(
+                        (W,) + (1,) * (g.ndim - 1)).astype(g.dtype),
+                    grads)
+                metrics = {
+                    kk: (w_total if kk == "loss_weight"
+                         else jnp.sum(jnp.asarray(m, jnp.float32) * w,
+                                      axis=0) / w_total)
+                    for kk, m in metrics.items()}
+            grads = jax.tree.map(
+                lambda g: jax.lax.with_sharding_constraint(g,
+                                                           grads_sharding),
+                grads)
+            return grads, metrics
+
+        return grad_prog_rows
+
+    def _build_quant_step(self):
+        """The explicit-gradient-exchange step: THREE jitted programs
+        instead of one, so the gradient communication is a separate
+        dispatch the flight recorder can meter (``train/grad_comm`` vs
+        ``train/optimizer_apply`` sub-spans inside ``step_dispatch``).
+
+        1. ``trainer.grad_step`` — fwd/bwd per data shard inside
+           shard_map (the loss is the LOCAL mean; no cross-replica
+           reduction happens here, unlike the implicit GSPMD step);
+           local grads leave with a leading per-replica dim, sharded.
+        2. ``trainer.grad_sync`` — ``collectives.ef_grad_sync``: the
+           error-feedback int8-wire allreduce (or the exact-psum f32
+           A/B leg).  The only cross-replica traffic of the step.
+           BOTH inputs are donated: the residual buffers alias their
+           outputs, or peak HBM grows by a full f32 param copy.
+        3. ``trainer.apply_step`` — the optimizer apply (with the
+           cross-replica sharded-update constraints when configured),
+           donating the state.
+
+        The composite blocks at each program boundary so the sub-span
+        durations are real device time, not dispatch time — the price
+        of a meterable comm fraction (documented in README; the
+        ``none`` path keeps today's fully-async single dispatch).
+        ``grad_overlap>1`` swaps this builder for ``_build_overlap_step``
+        (bucketed, in-flight); this sequential form is the
+        ``TTD_NO_GRAD_OVERLAP=1`` / ``grad_overlap=0`` kill-switch path
+        and stays byte-for-byte the pre-overlap pipeline.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        W = mesh.shape["data"]
+        wire = self.grad_quant
+        grad_prog = self._quant_grad_prog()
+
         def sync_prog(local_grads, residual):
+            # Fully-manual even on model-parallel meshes: grads/residual
+            # arrive replicated over non-"data" axes (the grad program's
+            # output constraint), so every model shard runs the same
+            # wire math and the unmentioned manual axes stay replicated.
             sm = compat.shard_map(
                 lambda g, r: collectives.ef_grad_sync(g, r, "data",
                                                       wire=wire),
@@ -829,6 +1108,156 @@ class Trainer:
             metrics = dict(metrics, **extra)
             metrics["grad_comm_mb"] = wire_mb_cell[0]
             return new_lean.replace(grad_residual=new_residual), metrics
+
+        return step
+
+    def _build_overlap_step(self):
+        """Bucketed comm/compute overlap (ROADMAP item 3): the quant
+        pipeline with the grad tree split into K byte-balanced buckets
+        (reverse-backward layer order) and the per-bucket sync + apply
+        programs dispatched IN-FLIGHT.
+
+        The step dispatches 1 grad program, then K sync programs
+        (``collectives.ef_bucket_sync`` — leaf-aligned Q8 blocking, so
+        results are bitwise-invariant to the bucket partition), then K
+        apply programs (``_apply_grad_parts`` on bucket leaf lists, the
+        opt state split along param-structured sub-trees), WITHOUT
+        blocking between any of them — jax async dispatch queues all
+        2K+1 programs and XLA overlaps bucket b's collective with
+        bucket b+1's compute.  One barrier at step end
+        (``train/step_barrier``) replaces the sequential pipeline's
+        per-phase blocking: the ``train/grad_comm`` sub-spans now meter
+        DISPATCH time (near-zero — the acceptance metric: blocking
+        comm-fraction, vs the sequential pipeline where the span is the
+        full device sync time).
+
+        Donation: per-bucket grads and residual leaf lists alias
+        through sync as in the sequential path; params donate through
+        apply under ``donate_state``.  The opt sub-state is NOT donated
+        — its shared leaves (step count, injected hyperparams) are
+        handed to all K apply programs, and donating bucket 0's would
+        free buffers bucket 1 still reads.  Transient cost: one
+        bucket's worth (~1/K) of new moment buffers before the old full
+        moments release.
+
+        The loss-scale decision needs the GLOBAL finite flag, so every
+        bucket's apply takes all K per-bucket flags and ANDs them
+        in-graph (no host sync); each bucket's residual commit is gated
+        on its bucket-LOCAL flag inside ``ef_bucket_sync``.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        W = mesh.shape["data"]
+        wire = self.grad_quant
+        k_cfg = self.grad_overlap
+        budget = max(8, k_cfg + 2)
+
+        g_jit = self._jit_step(self._quant_grad_prog(), site="grad_step")
+
+        def sync_bucket_prog(grads_b, residual_b):
+            sm = compat.shard_map(
+                lambda g, r: collectives.ef_bucket_sync(g, r, "data",
+                                                        wire=wire),
+                mesh=mesh, in_specs=(P("data"), P("data")),
+                out_specs=(P(), P("data"), P()),
+                check_vma=False)
+            return sm(grads_b, residual_b)
+
+        # K distinct bucket shapes land on ONE site: the compile budget
+        # scales with the bucket count (bucket count is a static).
+        sync_donate = (0, 1) if self.config.donate_state else (0,)
+        sync_jit = self._jit_step(sync_bucket_prog, site="grad_sync_bucket",
+                                  donate=sync_donate, max_compiles=budget)
+
+        def make_apply(us_b, ps_b):
+            def apply_bucket(params_b, opt_b, loss_scale, step, grads_b,
+                             finites):
+                grads_b = [g.astype(p.dtype)
+                           for g, p in zip(grads_b, params_b)]
+                finite = jnp.stack(finites).all()
+                return self._apply_grad_parts(
+                    params_b, opt_b, loss_scale, step, grads_b, finite,
+                    us_b, ps_b)
+            return apply_bucket
+
+        apply_donate = (0, 4) if self.config.donate_state else (4,)
+        plan_cell: list = []
+
+        def _build_plan(state):
+            plan = _BucketPlan(state, k_cfg, W, wire)
+            apply_jits = [
+                self._jit_step(
+                    make_apply(plan.shardings_for(self._update_shardings, b),
+                               plan.shardings_for(self._param_shardings, b)),
+                    site="apply_step_bucket", donate=apply_donate,
+                    max_compiles=budget)
+                for b in range(plan.k)]
+            return plan, apply_jits
+
+        def step(state, batch):
+            if not plan_cell:
+                plan_cell.append(_build_plan(state))
+            plan, apply_jits = plan_cell[0]
+            residual = state.grad_residual
+            lean = state.replace(grad_residual=None)
+            with events.span("train/grad_fwdbwd", overlap=1):
+                local_grads, metrics = g_jit(lean, batch)
+            g_flat = jax.tree.leaves(local_grads)
+            r_flat = jax.tree.leaves(residual)
+            synced: list = [None] * plan.n_leaves
+            new_r: list = [None] * plan.n_leaves
+            finites = []
+            for b, ix in enumerate(plan.buckets):
+                with events.span("train/grad_comm", wire=wire,
+                                 mb=plan.bucket_mb[b], bucket=b,
+                                 buckets=plan.k):
+                    s_b, r_b, f_b = sync_jit([g_flat[i] for i in ix],
+                                             [r_flat[i] for i in ix])
+                for pos, i in enumerate(ix):
+                    synced[i] = s_b[pos]
+                    new_r[i] = r_b[pos]
+                finites.append(f_b)
+            p_flat = jax.tree.leaves(lean.params)
+            opt_bs = plan.split_opt(lean.opt_state)
+            new_p: list = [None] * plan.n_leaves
+            opt_outs = []
+            extras = []
+            new_ls = None
+            for b, ix in enumerate(plan.buckets):
+                with events.span("train/optimizer_apply", bucket=b,
+                                 buckets=plan.k):
+                    np_b, no_b, ls_b, m_b = apply_jits[b](
+                        [p_flat[i] for i in ix], opt_bs[b],
+                        lean.loss_scale, lean.step,
+                        [synced[i] for i in ix], finites)
+                for pos, i in enumerate(ix):
+                    new_p[i] = np_b[pos]
+                opt_outs.append(no_b)
+                extras.append(m_b)
+                if b == 0:
+                    new_ls = ls_b
+            new_state = lean.replace(
+                step=lean.step + 1,
+                params=plan.treedef.unflatten(new_p),
+                opt_state=plan.merge_opt(lean.opt_state, opt_outs),
+                loss_scale=new_ls,
+                grad_residual=plan.treedef.unflatten(new_r),
+            )
+            # THE step barrier: the only host-blocking point — everything
+            # above was async dispatch.  Its span is the realized
+            # overlapped device time.
+            with events.span("train/step_barrier", buckets=plan.k):
+                jax.block_until_ready((new_p, new_r, opt_outs))
+            extra = dict(extras[0])
+            if self.config.log_grad_norm and "grad_norm" in extra:
+                # Per-bucket norms combine exactly: ||g||² = Σ_b ||g_b||².
+                extra["grad_norm"] = jnp.sqrt(
+                    sum(m["grad_norm"] ** 2 for m in extras))
+            metrics = dict(metrics, **extra)
+            metrics["grad_comm_mb"] = float(sum(plan.bucket_mb))
+            metrics["grad_buckets"] = plan.k
+            return new_state, metrics
 
         return step
 

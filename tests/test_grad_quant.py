@@ -21,12 +21,15 @@ from tensorflow_train_distributed_tpu.parallel import collectives as coll
 from tensorflow_train_distributed_tpu.runtime.compat import shard_map
 
 
-def _sync_fn(mesh, wire="int8", min_quant_elems=0):
-    """Jitted ef_grad_sync over the 8-device mesh: grads/residual trees
-    of [W, *shape] leaves in, (mean_grads, new_residual, finite) out."""
+def _sync_fn(mesh, wire="int8", min_quant_elems=0, fn=None):
+    """Jitted ef_grad_sync (or ef_bucket_sync via ``fn``) over the
+    8-device mesh: grads/residual trees of [W, *shape] leaves in,
+    (mean_grads, new_residual, finite) out."""
+    sync = fn or coll.ef_grad_sync
+
     def per_shard(g, r):
-        return coll.ef_grad_sync(g, r, "data", wire=wire,
-                                 min_quant_elems=min_quant_elems)
+        return sync(g, r, "data", wire=wire,
+                    min_quant_elems=min_quant_elems)
 
     return jax.jit(shard_map(
         per_shard, mesh=mesh, in_specs=(P("data"), P("data")),
@@ -181,6 +184,146 @@ class TestEfGradSync:
                 == coll.grad_sync_wire_bytes(only_bias, 8, "f32"))
 
 
+class TestBucketSync:
+    """Bucketed overlap collective: planner invariants and the
+    partition-invariance contract (``ef_bucket_sync`` over any bucket
+    split == one call over the whole tree, bitwise)."""
+
+    def test_planner_returns_min_k_n_buckets(self):
+        tree = {f"l{i}": jax.ShapeDtypeStruct((2 ** i,), jnp.float32)
+                for i in range(6)}
+        for k in (1, 2, 3, 6, 9, 100):
+            buckets = coll.plan_grad_buckets(tree, k)
+            assert len(buckets) == min(k, 6), (k, buckets)
+            assert all(buckets), buckets          # no empty buckets
+            assert sorted(i for b in buckets for i in b) == list(range(6))
+
+    def test_planner_reverse_contiguous_dispatch_order(self):
+        """Bucket 0 holds the LAST flatten-order leaves (backward runs
+        last-layer-first); concatenating buckets in dispatch order and
+        reversing recovers ascending flatten order."""
+        tree = [jax.ShapeDtypeStruct((64,), jnp.float32)
+                for _ in range(7)]
+        buckets = coll.plan_grad_buckets(tree, 3)
+        assert max(buckets[0]) == 6
+        flat = [i for b in buckets for i in sorted(b, reverse=True)]
+        assert flat == list(range(7))[::-1]
+
+    def test_planner_skewed_sizes_keep_bucket_count(self):
+        """The regression case: one huge leaf early in reverse order
+        must not swallow the remaining buckets — skew degrades byte
+        balance, never the bucket count."""
+        # Reverse (dispatch) order sees sizes 1024, 16, 4096, 256.
+        tree = [jax.ShapeDtypeStruct((256,), jnp.float32),
+                jax.ShapeDtypeStruct((4096,), jnp.float32),
+                jax.ShapeDtypeStruct((16,), jnp.float32),
+                jax.ShapeDtypeStruct((1024,), jnp.float32)]
+        buckets = coll.plan_grad_buckets(tree, 3)
+        assert len(buckets) == 3, buckets
+        assert all(buckets), buckets
+
+    def test_planner_empty_and_abstract(self):
+        assert coll.plan_grad_buckets({}, 4) == []
+        one = coll.plan_grad_buckets(
+            {"w": jax.ShapeDtypeStruct((5, 3), jnp.float32)}, 4)
+        assert one == [[0]]
+
+    def _tree(self, rng, shapes):
+        g = {f"l{i}": (rng.standard_normal((8,) + s)
+                       * rng.choice([1e-3, 1.0, 30.0])
+                       ).astype(np.float32)
+             for i, s in enumerate(shapes)}
+        r = {k: (rng.standard_normal(v.shape) * 1e-2).astype(np.float32)
+             for k, v in g.items()}
+        return g, r
+
+    @pytest.mark.parametrize("mq", [0, 512])
+    def test_partition_invariance_bitwise(self, mesh8, mq):
+        """Syncing each bucket separately == syncing the whole tree in
+        one call, bitwise, for K ∈ {1, 3, n_leaves} — the property that
+        makes in-flight per-bucket dispatch numerically free."""
+        rng = np.random.default_rng(7)
+        shapes = [(1024,), (33, 5), (640,), (2048,), (7,)]
+        g, r = self._tree(rng, shapes)
+        sharding = NamedSharding(mesh8, P("data"))
+        put = lambda t: jax.device_put(t, sharding)  # noqa: E731
+        sync = _sync_fn(mesh8, min_quant_elems=mq, fn=coll.ef_bucket_sync)
+        whole_g, whole_r, whole_f = sync(put(g), put(r))
+        keys = sorted(g)
+        for k in (1, 3, len(shapes)):
+            buckets = coll.plan_grad_buckets(
+                {key: g[key][0] for key in keys}, k)
+            assert len(buckets) == min(k, len(shapes))
+            for b in buckets:
+                sub_g = {keys[i]: g[keys[i]] for i in b}
+                sub_r = {keys[i]: r[keys[i]] for i in b}
+                mg, nr, f = sync(put(sub_g), put(sub_r))
+                assert bool(f) == bool(whole_f)
+                for key in sub_g:
+                    np.testing.assert_array_equal(
+                        np.asarray(mg[key]), np.asarray(whole_g[key]),
+                        err_msg=f"mean k={k} leaf={key} mq={mq}")
+                    np.testing.assert_array_equal(
+                        np.asarray(nr[key]), np.asarray(whole_r[key]),
+                        err_msg=f"residual k={k} leaf={key} mq={mq}")
+
+    def test_int8_matches_unbucketed_semantics(self, mesh8):
+        """ef_bucket_sync approximates the true mean and feeds back,
+        same contract as ef_grad_sync (layout differs, recipe doesn't)."""
+        rng = np.random.default_rng(8)
+        g = {"w": rng.standard_normal((8, 1024)).astype(np.float32)}
+        r = jax.tree.map(np.zeros_like, g)
+        sharding = NamedSharding(mesh8, P("data"))
+        mg, nr, finite = _sync_fn(mesh8, fn=coll.ef_bucket_sync)(
+            jax.device_put(g, sharding), jax.device_put(r, sharding))
+        ref = g["w"].mean(0)
+        assert np.abs(np.asarray(mg["w"]) - ref).max() < 0.05
+        assert np.asarray(nr["w"]).any()
+        assert bool(finite)
+
+    def test_nonfinite_gating_is_bucket_local(self, mesh8):
+        """A non-finite grad poisons only ITS bucket's flag and freezes
+        only ITS bucket's residual; a clean sibling bucket commits."""
+        rng = np.random.default_rng(9)
+        bad = {"w": np.ones((8, 1024), np.float32)}
+        bad["w"][2, 11] = np.nan
+        bad_r = {"w": (rng.standard_normal((8, 1024)) * 1e-3
+                       ).astype(np.float32)}
+        good = {"v": rng.standard_normal((8, 1024)).astype(np.float32)}
+        good_r = jax.tree.map(np.zeros_like, good)
+        sharding = NamedSharding(mesh8, P("data"))
+        put = lambda t: jax.device_put(t, sharding)  # noqa: E731
+        sync = _sync_fn(mesh8, fn=coll.ef_bucket_sync)
+        _, bad_nr, bad_f = sync(put(bad), put(bad_r))
+        _, good_nr, good_f = sync(put(good), put(good_r))
+        assert not bool(bad_f)
+        np.testing.assert_array_equal(np.asarray(bad_nr["w"]),
+                                      bad_r["w"])
+        assert bool(good_f)
+        assert np.asarray(good_nr["v"]).any()
+
+    def test_bucket_wire_bytes_partition_invariant(self):
+        tree = {f"l{i}": jax.ShapeDtypeStruct((n,), jnp.float32)
+                for i, n in enumerate((4096, 1024, 640, 16, 2048))}
+        whole = coll.bucket_sync_wire_bytes(tree, 8)
+        keys = sorted(tree)
+        for k in (2, 3, 5):
+            buckets = coll.plan_grad_buckets(tree, k)
+            split = sum(coll.bucket_sync_wire_bytes(
+                {keys[i]: tree[keys[i]] for i in b}, 8)
+                for b in buckets)
+            assert split == whole, (k, split, whole)
+        # Leaf-aligned padding costs a premium over the concat layout
+        # (each quant leaf pads to W whole Q8 blocks — punishing for
+        # small leaves, vanishing for large ones) but still beats f32.
+        concat = coll.grad_sync_wire_bytes(tree, 8)
+        f32 = coll.grad_sync_wire_bytes(tree, 8, wire="f32")
+        assert concat <= whole < f32
+        big = {"w": jax.ShapeDtypeStruct((1 << 20,), jnp.float32)}
+        assert (coll.bucket_sync_wire_bytes(big, 8)
+                < coll.grad_sync_wire_bytes(big, 8, wire="f32") / 3)
+
+
 class TestErrorFeedback:
     """The EF correctness proof on the REAL 8-device sync pipeline:
     minimizing f(w) = mean_i 0.5||w - t_i||^2 with spread-out per-
@@ -190,12 +333,13 @@ class TestErrorFeedback:
     (~amax/254) dominates the signal: plain quantization stalls at
     that noise floor; carrying the residual converges through it."""
 
-    def _descend(self, mesh8, feedback: bool, steps=400, lr=0.3):
+    def _descend(self, mesh8, feedback: bool, steps=400, lr=0.3,
+                 fn=None):
         n = 256
         rng = np.random.default_rng(5)
         targets = (rng.standard_normal((8, n)) * 40.0).astype(np.float32)
         w_star = targets.mean(0)
-        sync = _sync_fn(mesh8, wire="int8", min_quant_elems=0)
+        sync = _sync_fn(mesh8, wire="int8", min_quant_elems=0, fn=fn)
         w = np.zeros(n, np.float32)
         r = jax.device_put({"w": np.zeros((8, n), np.float32)},
                            NamedSharding(mesh8, P("data")))
@@ -220,6 +364,19 @@ class TestErrorFeedback:
         converged = self._descend(mesh8, feedback=True)
         # Plain quantization parks at the quantization noise floor
         # (~40/254 ≈ 0.16 per coordinate); EF walks through it.
+        assert stalled > 0.02, stalled
+        assert converged < stalled / 10, (converged, stalled)
+        assert converged < 5e-3, converged
+
+    @pytest.mark.slow
+    def test_bucketed_sync_converges_with_feedback(self, mesh8):
+        """The same annealed-lr separation holds on the leaf-aligned
+        bucketed collective: EF under ef_bucket_sync walks through the
+        quantization noise floor that plain quantization parks at."""
+        stalled = self._descend(mesh8, feedback=False,
+                                fn=coll.ef_bucket_sync)
+        converged = self._descend(mesh8, feedback=True,
+                                  fn=coll.ef_bucket_sync)
         assert stalled > 0.02, stalled
         assert converged < stalled / 10, (converged, stalled)
         assert converged < 5e-3, converged
@@ -377,7 +534,11 @@ class TestTrainerGradQuant:
 
         rec = events.get_recorder()
         rec.clear()
-        _fit(mesh8, blobs_task, grad_quant="int8", steps=5)
+        # grad_overlap=0 pins the sequential three-program anatomy the
+        # report has always rendered; the bucketed spans get their own
+        # test below.
+        _fit(mesh8, blobs_task, grad_quant="int8", grad_overlap=0,
+             steps=5)
         names = {e[0] for e in rec.events()}
         assert {"train/step_dispatch", "train/grad_fwdbwd",
                 "train/grad_comm",
@@ -404,6 +565,92 @@ class TestTrainerGradQuant:
         out = capsys.readouterr().out
         assert "train step anatomy" in out
         assert "comm-frac" in out
+
+    def test_overlap_bucket_spans_and_report(self, mesh8, blobs_task,
+                                             capsys, tmp_path):
+        """The bucketed step emits one train/grad_comm span PER BUCKET
+        (tagged bucket=i) plus the single host-blocking
+        train/step_barrier span, and trace_report breaks the totals out
+        into per-bucket sub-rows."""
+        from tensorflow_train_distributed_tpu.runtime import events
+
+        rec = events.get_recorder()
+        rec.clear()
+        _, _, hist = _fit(mesh8, blobs_task, grad_quant="int8",
+                          grad_overlap=3, steps=5)
+        assert hist.history["grad_buckets"][-1] >= 2
+        evs = rec.events()
+        names = {e[0] for e in evs}
+        assert {"train/grad_fwdbwd", "train/grad_comm",
+                "train/optimizer_apply", "train/step_barrier"} <= names
+        buckets = {(e[5] or {}).get("bucket") for e in evs
+                   if e[0] == "train/grad_comm"}
+        buckets.discard(None)
+        assert len(buckets) >= 2, buckets
+        trace = tmp_path / "trace.json"
+        rec.save(str(trace))
+        import os
+        import sys
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        sys.path.insert(0, tools_dir)
+        try:
+            import trace_report
+        finally:
+            sys.path.remove(tools_dir)
+        trace_report.main([str(trace)])
+        out = capsys.readouterr().out
+        assert "train step anatomy" in out
+        assert "[bucket=" in out
+        assert "train/step_barrier" in out
+
+    def test_mesh_2d_composition(self, mesh_2d, blobs_task):
+        """grad_quant on a dp×tp mesh (the guard this PR lifts): the
+        row-vmap GSPMD grad program trains, and the bucketed overlap
+        step tracks the sequential one at int8-noise tolerance."""
+        _, s_state, s_hist = _fit(mesh_2d, blobs_task,
+                                  grad_quant="int8", grad_overlap=0)
+        _, o_state, o_hist = _fit(mesh_2d, blobs_task,
+                                  grad_quant="int8", grad_overlap=3)
+        seq, ovl = s_hist.history["loss"], o_hist.history["loss"]
+        assert seq[-1] < seq[0] * 0.6
+        assert ovl[-1] < ovl[0] * 0.6
+        assert max(abs(a - b) for a, b in zip(seq, ovl)) <= 1e-3
+        assert o_hist.history["grad_buckets"][-1] >= 2
+        assert s_state.grad_residual is not None
+        assert o_state.grad_residual is not None
+
+    def test_grad_accum_composition(self, mesh8, blobs_task):
+        """grad_accum>1 composes with grad_quant (the other lifted
+        guard): micro-grads accumulate in fp32 and quantize ONCE, so
+        accum=2 tracks accum=1 at fp-compounding tolerance."""
+        _, _, a1_hist = _fit(mesh8, blobs_task, grad_quant="int8",
+                             grad_overlap=0)
+        _, a2_state, a2_hist = _fit(mesh8, blobs_task, grad_quant="int8",
+                                    grad_overlap=0, grad_accum=2)
+        a1, a2 = a1_hist.history["loss"], a2_hist.history["loss"]
+        assert a2[-1] < a2[0] * 0.6
+        assert max(abs(a - b) for a, b in zip(a1, a2)) < 5e-2
+        assert a2_state.grad_residual is not None
+        # ...and the triple composition accum × quant × overlap trains.
+        _, _, ao_hist = _fit(mesh8, blobs_task, grad_quant="int8",
+                             grad_overlap=3, grad_accum=2)
+        ao = ao_hist.history["loss"]
+        assert ao[-1] < ao[0] * 0.6
+
+    def test_overlap_kill_switch_bitwise(self, mesh8, blobs_task,
+                                         monkeypatch):
+        """TTD_NO_GRAD_OVERLAP=1 + grad_overlap=K == grad_overlap=0 ==
+        the sequential three-program pipeline, bitwise."""
+        _, seq_state, seq_hist = _fit(mesh8, blobs_task,
+                                      grad_quant="int8", grad_overlap=0)
+        monkeypatch.setenv("TTD_NO_GRAD_OVERLAP", "1")
+        tr, ks_state, ks_hist = _fit(mesh8, blobs_task,
+                                     grad_quant="int8", grad_overlap=4)
+        assert tr.grad_overlap == 0
+        assert _params_equal(seq_state.params, ks_state.params)
+        assert seq_hist.history["loss"] == ks_hist.history["loss"]
 
     def test_restore_compat_old_checkpoint(self, mesh8, blobs_task,
                                            tmp_path):
@@ -487,13 +734,17 @@ class TestTrainerGradQuant:
             Trainer, TrainerConfig,
         )
 
-        with pytest.raises(ValueError, match="pure data-parallel"):
-            Trainer(blobs_task(), optax.adam(1e-2), mesh_2d,
-                    config=TrainerConfig(grad_quant="int8"))
-        with pytest.raises(ValueError, match="grad_accum"):
+        # The former pure-data-parallel and grad_accum guards are
+        # LIFTED: dp×fsdp / dp×tp meshes and grad_accum>1 now compose
+        # with grad_quant (exercised above); construction must succeed.
+        Trainer(blobs_task(), optax.adam(1e-2), mesh_2d,
+                config=TrainerConfig(grad_quant="int8"))
+        Trainer(blobs_task(), optax.adam(1e-2), mesh8,
+                config=TrainerConfig(grad_quant="int8", grad_accum=2))
+        with pytest.raises(ValueError, match="grad_overlap"):
             Trainer(blobs_task(), optax.adam(1e-2), mesh8,
                     config=TrainerConfig(grad_quant="int8",
-                                         grad_accum=2))
+                                         grad_overlap=-1))
         with pytest.raises(ValueError, match="steps_per_execution"):
             Trainer(blobs_task(), optax.adam(1e-2), mesh8,
                     config=TrainerConfig(grad_quant="int8",
@@ -512,9 +763,12 @@ def test_launch_cli_accepts_grad_quant_flags():
 
     args = build_parser().parse_args(
         ["--config", "mnist", "--grad-quant", "int8",
-         "--sharded-update"])
+         "--sharded-update", "--grad-overlap", "6"])
     assert args.grad_quant == "int8"
     assert args.sharded_update
+    assert args.grad_overlap == 6
+    assert build_parser().parse_args(
+        ["--config", "mnist"]).grad_overlap == 4
     with pytest.raises(SystemExit):
         build_parser().parse_args(
             ["--config", "mnist", "--grad-quant", "fp4"])
@@ -522,6 +776,8 @@ def test_launch_cli_accepts_grad_quant_flags():
 
 def test_kill_switch_env_spelled_for_lint():
     """The kill-switch checker wants every TTD_* flag test-exercised;
-    the real exercise is TestTrainerGradQuant.test_kill_switch_bitwise_
-    parity — this tier-1 stub pins the spelling and default-off."""
+    the real exercises are TestTrainerGradQuant.test_kill_switch_
+    bitwise_parity and test_overlap_kill_switch_bitwise — this tier-1
+    stub pins the spellings and default-off."""
     assert os.environ.get("TTD_NO_GRAD_QUANT", "0") in ("", "0")
+    assert os.environ.get("TTD_NO_GRAD_OVERLAP", "0") in ("", "0")

@@ -84,10 +84,27 @@ timeout 600 python tools/bench_allreduce.py --size-mb 64 2>>"$LOG" | tee -a "$LO
 timeout 600 python tools/bench_allreduce.py --size-mb 64 --quant int8 \
     2>>"$LOG" | tee -a "$LOG"
 
+say "--- 10. bucketed comm/compute overlap A/B (sequential int8 pipeline"
+say "    vs --grad-overlap K in-flight bucketed sync vs the"
+say "    TTD_NO_GRAD_OVERLAP kill switch; on real chips the fabric runs"
+say "    during backward so use the FULL model/batch — the CPU-sized"
+say "    --batch/--seq shrink in the committed record exists only"
+say "    because the virtual mesh shares one host core) ---"
+timeout 1200 python tools/bench_grad_quant.py --overlap --steps 30 \
+    2>>"$LOG" | tee -a "$LOG"
+# bucket-count sweep: K is a pure perf knob (results bitwise-invariant
+# to the partition) — keep the K with the lowest blocking comm-fraction:
+for K in 2 4 8; do
+    timeout 1200 python tools/bench_grad_quant.py --overlap \
+        --grad-overlap "$K" --steps 30 2>>"$LOG" | tee -a "$LOG"
+done
+
 say "=== playbook done $(date -u); results in $LOG ==="
 say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
 say "pallas verdict from 4 — keep whichever wins as the default;"
 say "fused/int8/growth verdicts from 7-8 -> append the TPU legs to"
 say "profiles/bench/fused_attn_ab.jsonl and keep the faster default;"
 say "grad-quant + busBW verdicts from 9 -> append the TPU legs to"
-say "profiles/bench/grad_quant_ab.jsonl)."
+say "profiles/bench/grad_quant_ab.jsonl; overlap verdict + best K from"
+say "10 -> append the TPU legs to profiles/bench/grad_overlap_ab.jsonl"
+say "and pin the winning --grad-overlap default)."

@@ -144,9 +144,12 @@ def migration_summary(evs: list) -> dict:
 
 
 #: The trainer's step sub-spans (grad-quant split step) plus the parent
-#: dispatch span — the denominator of the comm fraction.
+#: dispatch span — the denominator of the comm fraction.  The bucketed
+#: overlap step adds ``train/step_barrier`` (the single host-blocking
+#: point replacing the sequential pipeline's per-phase blocking).
 _TRAIN_STEP_SPANS = ("train/step_dispatch", "train/grad_fwdbwd",
-                     "train/grad_comm", "train/optimizer_apply")
+                     "train/grad_comm", "train/optimizer_apply",
+                     "train/step_barrier")
 
 
 def train_step_summary(evs: list) -> list:
@@ -161,8 +164,16 @@ def train_step_summary(evs: list) -> list:
     the comm-fraction number the grad-quant A/B
     (``tools/bench_grad_quant.py``) is judged on, visible in any
     ``/debug/trace`` window.  Empty when the window has no grad-comm
-    spans (unquantized trainer, or no training)."""
+    spans (unquantized trainer, or no training).
+
+    Under the bucketed overlap step (``grad_overlap>1``) the comm/apply
+    spans carry ``bucket=<i>, buckets=<K>`` attrs and meter DISPATCH
+    time only — the blocking device wait collapses into the single
+    ``train/step_barrier`` span, so the grad-comm fraction IS the
+    realized-overlap number.  Bucket-tagged spans additionally break
+    out as ``<span>[bucket=<i>]`` sub-rows under their total."""
     totals: dict = {}
+    per_bucket: dict = {}
     for e in evs:
         name = e.get("name", "")
         if e.get("ph") != "X" or name not in _TRAIN_STEP_SPANS:
@@ -170,15 +181,29 @@ def train_step_summary(evs: list) -> list:
         row = totals.setdefault(name, [0, 0.0])
         row[0] += 1
         row[1] += e.get("dur", 0.0) / 1e3
+        b = (e.get("args") or {}).get("bucket")
+        if b is not None:
+            brow = per_bucket.setdefault(name, {}).setdefault(
+                int(b), [0, 0.0])
+            brow[0] += 1
+            brow[1] += e.get("dur", 0.0) / 1e3
     if "train/grad_comm" not in totals:
         return []
     step_ms = totals.get("train/step_dispatch", [0, 0.0])[1]
     if step_ms <= 0:        # engine-level runs without the fit loop
-        step_ms = sum(ms for _, ms in totals.values())
-    return [(name, n, ms, (ms / step_ms if step_ms > 0 else 0.0))
-            for name in _TRAIN_STEP_SPANS
-            if name in totals
-            for n, ms in [totals[name]]]
+        step_ms = sum(ms for name, (_, ms) in totals.items()
+                      if name != "train/step_barrier")
+    rows = []
+    for name in _TRAIN_STEP_SPANS:
+        if name not in totals:
+            continue
+        n, ms = totals[name]
+        rows.append((name, n, ms, (ms / step_ms if step_ms > 0 else 0.0)))
+        for b in sorted(per_bucket.get(name, ())):
+            bn, bms = per_bucket[name][b]
+            rows.append((f"{name}[bucket={b}]", bn, bms,
+                         (bms / step_ms if step_ms > 0 else 0.0)))
+    return rows
 
 
 def memory_summary(evs: list) -> dict:
