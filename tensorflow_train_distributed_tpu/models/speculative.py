@@ -79,8 +79,12 @@ def _assemble_emit(d_block, a, final):
     d_0..d_{a-1}, then ``final`` at position a, zero-padding beyond."""
     k = d_block.shape[1]
     idx = jnp.arange(k + 1)[None, :]
+    # Explicit zero column (not ``zeros_like(d_block[:, :1])``): at
+    # k=0 — the serving engine's plain-decode depth bucket — d_block
+    # is [B, 0] and slicing it yields another empty column.
     d_pad = jnp.concatenate(
-        [d_block, jnp.zeros_like(d_block[:, :1])], axis=1)
+        [d_block, jnp.zeros((d_block.shape[0], 1), d_block.dtype)],
+        axis=1)
     return jnp.where(idx < a[:, None], d_pad,
                      jnp.where(idx == a[:, None], final[:, None], 0))
 
@@ -144,6 +148,142 @@ def sampled_accept(d_block, q, p, us, final_keys):
         fk, jnp.log(pr + 1e-38)))(final_keys, safe).astype(d_block.dtype)
     emit = _assemble_emit(d_block, a, final)
     return emit.astype(d_block.dtype), emitted, a, final
+
+
+class DepthController:
+    """Acceptance-adaptive draft-depth selector over a fixed bucket set.
+
+    The serving engine precompiles one speculative program per depth in
+    ``depths`` (``k`` is a static argument of its round program — the
+    controller only ever SELECTS among compiled programs, it never
+    changes any program's math).  Per harvested round the engine feeds
+    back how many tokens the draft proposed and how many the target
+    accepted; the controller keeps an EWMA of the acceptance rate and
+    walks the bucket ladder: deepen one bucket when acceptance holds
+    above ``deepen``, back off one when it collapses below ``backoff``,
+    and never move again within ``dwell`` rounds of the last move (the
+    hysteresis that bounds the switch rate — at most one switch per
+    ``dwell`` rounds).  Depth 0 (plain decode through the k=0 round
+    program, draft cache kept in lockstep) yields no acceptance signal,
+    so a deterministic PROBE fires every ``probe_every``-th round at
+    depth 0: one round at the shallowest nonzero depth, kept only if
+    its acceptance clears ``deepen``.
+
+    Decisions are a deterministic function of the observe() history
+    ONLY — round wall times are recorded per depth for telemetry
+    (gauges, trace timelines) but never consulted, so a forced-depth
+    engine replays bitwise regardless of host timing.
+    """
+
+    def __init__(self, depths, *, start=None, alpha=0.4,
+                 deepen=0.7, backoff=0.35, dwell=4, probe_every=16):
+        ds = sorted(set(int(d) for d in depths))
+        if not ds or ds[0] < 0:
+            raise ValueError(f"depths must be non-negative, got {depths}")
+        if len(ds) < 2:
+            raise ValueError(
+                f"need >= 2 depth buckets to adapt over, got {ds} "
+                "(a single depth is just the fixed engine)")
+        if ds[-1] < 1:
+            raise ValueError("need at least one nonzero depth")
+        if not 0.0 <= backoff < deepen <= 1.0:
+            raise ValueError(
+                f"need 0 <= backoff < deepen <= 1, got "
+                f"backoff={backoff}, deepen={deepen}")
+        self.depths = tuple(ds)
+        self.alpha = float(alpha)
+        self.deepen_at = float(deepen)
+        self.backoff_at = float(backoff)
+        self.dwell = max(1, int(dwell))
+        self.probe_every = max(2, int(probe_every))
+        if start is None:
+            start = ds[-1]
+        if start not in ds:
+            raise ValueError(f"start depth {start} not in buckets {ds}")
+        self._i = ds.index(start)
+        self._ewma = None           # no signal yet
+        self._since_switch = 0      # rounds at the current depth
+        self._zero_rounds = 0       # consecutive rounds at depth 0
+        self._probing = False       # current round is a depth-0 probe
+        self.rounds = 0
+        self.switches = 0
+        # Telemetry only: per-depth round counts and wall-time EWMAs.
+        self._stats = {d: {"rounds": 0, "wall_ewma": None,
+                           "acc_ewma": None} for d in self.depths}
+
+    def depth(self) -> int:
+        """Depth for the NEXT dispatched round."""
+        return self.depths[self._i]
+
+    def acceptance(self):
+        """Current acceptance-rate EWMA (None before any signal)."""
+        return self._ewma
+
+    def _move(self, i: int) -> None:
+        if i != self._i:
+            self._i = i
+            self.switches += 1
+            self._since_switch = 0
+            self._ewma = None       # judge the new depth on its own
+
+    def observe(self, drafted: int, accepted: int,
+                wall_s=None) -> None:
+        """Feed back one harvested round: ``drafted`` tokens proposed
+        across active slots (active * k), ``accepted`` of them kept."""
+        d = self.depths[self._i]
+        self.rounds += 1
+        self._since_switch += 1
+        st = self._stats[d]
+        st["rounds"] += 1
+        if wall_s is not None:
+            st["wall_ewma"] = (float(wall_s) if st["wall_ewma"] is None
+                               else (1 - self.alpha) * st["wall_ewma"]
+                               + self.alpha * float(wall_s))
+        if d > 0 and drafted > 0:
+            rate = accepted / drafted
+            self._ewma = (rate if self._ewma is None
+                          else (1 - self.alpha) * self._ewma
+                          + self.alpha * rate)
+            st["acc_ewma"] = self._ewma
+        if self._probing:
+            # One-round probe out of depth 0: keep the climb only if
+            # the probe's own acceptance clears the deepen bar.
+            self._probing = False
+            self._zero_rounds = 0
+            if self._ewma is None or self._ewma < self.deepen_at:
+                self._move(0)
+            return
+        if d == 0:
+            self._zero_rounds += 1
+            if self._zero_rounds >= self.probe_every:
+                self._probing = True
+                self._move(self._shallowest_nonzero())
+            return
+        if self._since_switch < self.dwell or self._ewma is None:
+            return
+        if self._ewma >= self.deepen_at and self._i + 1 < len(
+                self.depths):
+            self._move(self._i + 1)
+        elif self._ewma <= self.backoff_at and self._i > 0:
+            self._move(self._i - 1)
+
+    def _shallowest_nonzero(self) -> int:
+        for i, d in enumerate(self.depths):
+            if d > 0:
+                return i
+        raise AssertionError("ctor guarantees a nonzero depth")
+
+    def telemetry(self) -> dict:
+        """Controller snapshot (copies; exposure only): current depth,
+        total rounds/switches, acceptance EWMA, and per-depth round
+        counts / wall+acceptance EWMAs."""
+        return {
+            "depth": self.depth(),
+            "rounds": self.rounds,
+            "switches": self.switches,
+            "acceptance": self._ewma,
+            "per_depth": {d: dict(v) for d, v in self._stats.items()},
+        }
 
 
 def _set_cache_index(cache, value):

@@ -473,7 +473,11 @@ class ServingGateway:
                 replica_rss_fn=self.pool.replica_rss,
                 hbm_bytes_fn=self.pool.hbm_by_pool,
                 workers_by_role_fn=getattr(self.pool, "workers_by_role",
-                                           None))
+                                           None),
+                spec_depth_fn=self.pool.spec_depth,
+                spec_accepted_fn=self.pool.spec_accepted_tokens,
+                spec_drafted_fn=self.pool.spec_drafted_tokens,
+                hbm_autosized_fn=self.pool.hbm_autosized_bytes)
         else:
             one = [self.engine]
             self.metrics = GatewayMetrics(
@@ -492,7 +496,11 @@ class ServingGateway:
                 kv_prefix_hit_tokens_fn=_agg(one,
                                              "kv_prefix_hit_tokens"),
                 kv_evictions_fn=_agg(one, "kv_evictions"),
-                kv_pool_bytes_fn=_agg(one, "kv_pool_bytes"))
+                kv_pool_bytes_fn=_agg(one, "kv_pool_bytes"),
+                spec_depth_fn=_agg(one, "spec_depth"),
+                spec_accepted_fn=_agg(one, "spec_accepted_tokens"),
+                spec_drafted_fn=_agg(one, "spec_drafted_tokens"),
+                hbm_autosized_fn=_agg(one, "hbm_autosized_bytes"))
         self.driver.set_metrics(self.metrics)
         self._httpd = _GatewayHTTPServer((host, port), _Handler)
         self._httpd.gateway = self    # type: ignore[attr-defined]

@@ -289,7 +289,12 @@ class GatewayMetrics:
                  replica_rss_fn: Optional[Callable[[], dict]] = None,
                  hbm_bytes_fn: Optional[Callable[[], dict]] = None,
                  workers_by_role_fn: Optional[
-                     Callable[[], dict]] = None):
+                     Callable[[], dict]] = None,
+                 spec_depth_fn: Optional[Callable[[], float]] = None,
+                 spec_accepted_fn: Optional[Callable[[], int]] = None,
+                 spec_drafted_fn: Optional[Callable[[], int]] = None,
+                 hbm_autosized_fn: Optional[
+                     Callable[[], int]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -458,6 +463,38 @@ class GatewayMetrics:
             "Device bytes held by the paged KV block pools "
             "(0 = linear cache).",
             fn=kv_pool_bytes_fn)
+        # Acceptance-adaptive speculation (the telemetry loop closed):
+        # the draft depth the NEXT round dispatches at — constant for
+        # fixed-k engines, moving with measured acceptance under
+        # --spec-depth adaptive (a fleet mean over replicas) — and the
+        # accepted/drafted token pair whose ratio is the fleet
+        # acceptance rate the controller steers by.  All three scrape
+        # 0 for engines without a draft model.
+        self.spec_depth = r.gauge(
+            "ttd_engine_spec_depth",
+            "Draft depth the next speculative round runs at (fleet "
+            "mean; 0 = plain decode).",
+            fn=spec_depth_fn)
+        self.spec_accepted_tokens = r.fn_counter(
+            "ttd_engine_spec_accepted_tokens_total",
+            "Draft tokens accepted by target verification across "
+            "speculative rounds.",
+            fn=spec_accepted_fn)
+        self.spec_drafted_tokens = r.fn_counter(
+            "ttd_engine_spec_drafted_tokens_total",
+            "Draft tokens proposed across speculative rounds (the "
+            "acceptance-rate denominator).",
+            fn=spec_drafted_fn)
+        # Device-HBM autosizing: the byte budget the construction-time
+        # solve installed from the device's reported memory (0 when
+        # the engine was hand-sized or TTD_NO_HBM_AUTOSIZE=1 killed
+        # the solve) — compare against ttd_engine_hbm_bytes to see
+        # headroom actually held.
+        self.hbm_autosized_bytes = r.gauge(
+            "ttd_engine_hbm_autosized_bytes",
+            "HBM budget installed by kv_pool_blocks='auto' at engine "
+            "construction (0 = hand-sized).",
+            fn=hbm_autosized_fn)
         # Memory discipline (memcheck, the third lint vertical): live
         # bytes per DECLARED pool — the @memory_budget ledger sampled
         # at scrape time, labeled by pool name (kv_pool, draft_pool,

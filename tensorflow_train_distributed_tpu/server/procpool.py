@@ -86,6 +86,32 @@ def proc_replicas_killed() -> bool:
     return os.environ.get("TTD_NO_PROC_REPLICAS", "0") not in ("", "0")
 
 
+def worker_pack_cap(total_hbm_bytes, per_worker_bytes,
+                    headroom: float = 0.0) -> Optional[int]:
+    """Workers-per-host from the SAME arithmetic the engine's HBM
+    autosize uses: how many ``per_worker_bytes`` footprints (each
+    worker's HELLO-advertised engine budget — exact when autosized)
+    fit in ``total_hbm_bytes`` after ``headroom``.  None when either
+    side is unknown (no clamp); never below 1 otherwise (a fleet
+    cannot pack to zero — the over-budget single worker is the
+    engine ctor's refusal to make, not the scaler's)."""
+    if not total_hbm_bytes or not per_worker_bytes:
+        return None
+    usable = int(int(total_hbm_bytes) * (1.0 - float(headroom)))
+    return max(1, usable // int(per_worker_bytes))
+
+
+def _host_hbm_bytes() -> Optional[int]:
+    """The host's total accelerator memory for worker packing:
+    ``TTD_HBM_BYTES`` only — the parent process must not import jax
+    (workers own the devices), so without the env the cap is unknown
+    and the scaler trusts ``scale_max`` as configured."""
+    env = os.environ.get("TTD_HBM_BYTES", "")
+    if env not in ("", "0"):
+        return int(env)
+    return None
+
+
 @dataclasses.dataclass
 class WorkerSpec:
     """Everything needed to spawn one interchangeable worker.
@@ -131,6 +157,7 @@ class RemoteEngine:
         "pool_blocks": (None, "reader", "main"),
         "pid": (None, "reader", "main"),
         "role": (None, "reader", "main"),
+        "hbm_budget_bytes": (None, "reader", "scaler", "main"),
     }
 
     def __init__(self):
@@ -140,6 +167,10 @@ class RemoteEngine:
         self.paged = False
         self.pool_blocks: Optional[int] = None
         self.pid: Optional[int] = None
+        # Per-worker HBM footprint from the HELLO (the engine's byte
+        # budget; exact when autosized) — the worker-packing clamp's
+        # numerator-per-worker.
+        self.hbm_budget_bytes: Optional[int] = None
         # Disaggregated-serving role from the HELLO: ``prefill``
         # workers only stage+export KV, ``decode`` workers only take
         # placements, ``both`` (every pre-role worker) serves
@@ -158,6 +189,7 @@ class RemoteEngine:
         self.paged = bool(eng.get("paged"))
         self.pool_blocks = eng.get("pool_blocks")
         self.pid = body.get("pid")
+        self.hbm_budget_bytes = eng.get("hbm_budget_bytes")
         role = str(body.get("role") or "both")
         self.role = role if role in ("prefill", "decode", "both") \
             else "both"
@@ -211,6 +243,18 @@ class RemoteEngine:
 
     def prefill_stall_s(self) -> float:
         return self._g("prefill_stall_s")
+
+    def spec_depth(self) -> float:
+        return self._g("spec_depth")
+
+    def spec_accepted_tokens(self) -> float:
+        return self._g("spec_accepted_tokens")
+
+    def spec_drafted_tokens(self) -> float:
+        return self._g("spec_drafted_tokens")
+
+    def hbm_autosized_bytes(self) -> float:
+        return self._g("hbm_autosized_bytes")
 
     def validate_request(self, prompt, max_new: int,
                          seed: Optional[int] = None,
@@ -1153,6 +1197,19 @@ class ProcPool(ReplicaPool):
             except Exception:       # noqa: BLE001 — scaler must survive
                 logger.exception("proc-pool scaler pass failed")
 
+    def _hbm_scale_cap(self) -> int:
+        """The worker-packing half of the scale-up bound: workers
+        whose HELLO advertised an HBM budget divide into the host's
+        ``TTD_HBM_BYTES``; either side unknown → no clamp (a very
+        large sentinel, so ``min`` with scale_max is a no-op).  Uses
+        the LARGEST advertised budget — workers are interchangeable
+        (one shared spec), so any difference is transient handshake
+        skew and the conservative read wins."""
+        per = max((int(getattr(r.engine, "hbm_budget_bytes", 0) or 0)
+                   for r in self._replicas), default=0)
+        cap = worker_pack_cap(_host_hbm_bytes(), per)
+        return cap if cap is not None else sys.maxsize
+
     def _scale_once(self) -> None:
         now = time.monotonic()
         reps = self._replicas
@@ -1189,8 +1246,12 @@ class ProcPool(ReplicaPool):
             self._spawn("respawn")
             return
         self._respawn_streak = 0
-        # 2) Scale up under queue pressure.
-        if (len(accepting) < self._scale_max
+        # 2) Scale up under queue pressure — capped by BOTH the
+        # configured scale_max and the HBM worker-packing arithmetic
+        # (how many HELLO-advertised per-worker budgets fit the host's
+        # accelerator memory; unknown budgets leave scale_max alone).
+        if (len(accepting) < min(self._scale_max,
+                                 self._hbm_scale_cap())
                 and now - self._last_spawn_t >= self._spawn_cooldown_s
                 and self.waiting() > self._scale_up_queue
                 * max(1, len(accepting))):

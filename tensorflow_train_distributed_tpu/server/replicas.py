@@ -535,6 +535,21 @@ class ReplicaPool:
     def kv_pool_bytes(self) -> float:
         return self._engine_stat("kv_pool_bytes")
 
+    def spec_depth(self) -> float:
+        """Fleet draft depth (MEAN over usable replicas — they share
+        one spec, so a non-integer read means the controllers have
+        diverged on their own traffic, itself worth seeing)."""
+        return self._engine_stat("spec_depth", ratio=True)
+
+    def spec_accepted_tokens(self) -> float:
+        return self._engine_stat("spec_accepted_tokens")
+
+    def spec_drafted_tokens(self) -> float:
+        return self._engine_stat("spec_drafted_tokens")
+
+    def hbm_autosized_bytes(self) -> float:
+        return self._engine_stat("hbm_autosized_bytes")
+
     def hbm_by_pool(self) -> dict:
         """Live bytes per declared memcheck pool, for the labeled
         ``ttd_engine_hbm_bytes{pool=...}`` gauge.  Subprocess replicas

@@ -90,6 +90,7 @@ def engine_info(engine) -> dict:
     prefix-affinity keys)."""
     pool = getattr(engine, "_kv_pool", None)
     buckets = getattr(engine, "prompt_buckets", None)
+    auto = getattr(engine, "hbm_autosized_bytes", None)
     return {
         "slots": int(getattr(engine, "slots", 0)),
         "kv_block_size": int(getattr(engine, "kv_block_size", 16)),
@@ -98,6 +99,11 @@ def engine_info(engine) -> dict:
         "pool_blocks": (int(pool.n_blocks) if pool is not None
                         else None),
         "buckets": (list(buckets) if buckets else None),
+        # Per-worker HBM footprint (the engine's byte budget — exact
+        # when autosized): the parent's worker-packing arithmetic
+        # (ProcPool.worker_pack_cap) derives workers-per-host from it.
+        "hbm_budget_bytes": getattr(engine, "hbm_budget_bytes", None),
+        "hbm_autosized_bytes": (int(auto()) if callable(auto) else 0),
     }
 
 
@@ -228,7 +234,7 @@ _LLAMA_ENGINE_KWARGS = (
     "slots", "cache_len", "chunk", "temperature", "top_k", "top_p",
     "prefill_chunk", "prefill_budget", "overlap", "paged",
     "kv_block_size", "kv_pool_blocks", "prefix_cache_limit",
-    "hbm_budget_bytes",
+    "hbm_budget_bytes", "hbm_headroom", "spec_depths",
 )
 
 
@@ -473,7 +479,9 @@ def _engine_gauges(engine) -> dict:
     for name in ("kv_blocks_total", "kv_blocks_in_use",
                  "kv_prefix_hit_tokens", "kv_evictions",
                  "kv_pool_bytes", "kv_bytes_in_use", "overlap_ratio",
-                 "prefill_stall_s"):
+                 "prefill_stall_s", "spec_depth",
+                 "spec_accepted_tokens", "spec_drafted_tokens",
+                 "hbm_autosized_bytes"):
         fn = getattr(engine, name, None)
         if fn is None:
             continue

@@ -216,6 +216,39 @@ def _interleave_killed() -> bool:
     return os.environ.get("TTD_NO_INTERLEAVE", "0") not in ("", "0")
 
 
+def _adaptive_spec_killed() -> bool:
+    """``TTD_NO_ADAPTIVE_SPEC=1`` pins the draft depth back to the
+    fixed ``speculative_k`` bitwise (the controller is never built;
+    every round runs the same static-k program a fixed engine runs).
+    Read at construction — same no-redeploy contract as
+    ``TTD_NO_OVERLAP``."""
+    return os.environ.get("TTD_NO_ADAPTIVE_SPEC", "0") not in ("", "0")
+
+
+def _hbm_autosize_killed() -> bool:
+    """``TTD_NO_HBM_AUTOSIZE=1`` makes ``kv_pool_blocks='auto'`` fall
+    back to the default heuristic (slots x lanes) with no budget set —
+    bitwise the hand-tuned engine's defaults.  Read at construction."""
+    return os.environ.get("TTD_NO_HBM_AUTOSIZE", "0") not in ("", "0")
+
+
+def _device_hbm_bytes() -> Optional[int]:
+    """Per-device memory capacity in bytes, for the autosize solve:
+    ``TTD_HBM_BYTES=<bytes>`` overrides (tests and CPU hosts, where
+    jax reports no limit); otherwise the first local device's
+    ``memory_stats()['bytes_limit']`` (TPU/GPU backends report it;
+    CPU typically returns None → the caller refuses with a clear
+    error instead of guessing)."""
+    env = os.environ.get("TTD_HBM_BYTES", "")
+    if env not in ("", "0"):
+        return int(env)
+    dev = jax.local_devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    return int(stats.get("bytes_limit", 0) or 0) or None
+
+
 def _paged_killed() -> bool:
     """``TTD_NO_PAGED_KV=1`` restores the per-slot LINEAR cache
     byte-for-byte (contiguous ``cache_len`` rows per lane, manual
@@ -265,6 +298,7 @@ class ServingEngine:
         "prefill_stats": ("_stats_lock", "driver", "main"),
         "overlap_stats": ("_stats_lock", "driver", "main"),
         "spec_stats": ("_stats_lock", "driver", "main"),
+        "_spec_ctrl": ("_stats_lock", "driver", "main"),
     }
 
     def __init__(self, config, params, *, slots: int = 8,
@@ -277,14 +311,16 @@ class ServingEngine:
                  draft_config=None, draft_params=None,
                  draft_quant_scales=None,
                  speculative_k: int = 0,
+                 spec_depths=None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024),
                  overlap: Optional[bool] = None,
                  prefill_budget: Optional[int] = None,
                  paged: Optional[bool] = None,
                  kv_block_size: int = 16,
-                 kv_pool_blocks: Optional[int] = None,
+                 kv_pool_blocks=None,
                  prefix_cache_limit: int = 32,
-                 hbm_budget_bytes: Optional[int] = None):
+                 hbm_budget_bytes: Optional[int] = None,
+                 hbm_headroom: float = 0.1):
         # MoeConfig has no window knob; getattr keeps one check covering
         # both decoder families.  kv_cache_int8 configs SERVE here (the
         # per-slot and paged caches both quantize with the linear-cache
@@ -400,16 +436,40 @@ class ServingEngine:
         self._kv_nblk_lane = -(-self.cache_len // self.kv_block_size)
         self.paged = ((True if paged is None else bool(paged))
                       and not _paged_killed())
-        if kv_pool_blocks is None:
-            kv_pool_blocks = slots * self._kv_nblk_lane
-        if kv_pool_blocks < 1:
+        # ``kv_pool_blocks="auto"``: solve the pool size + HBM budget
+        # exactly from the device's reported memory and the memcheck
+        # projection (pool rows + batch-1 prefill transients + draft
+        # pools + ``hbm_headroom``) — one binary lands correctly sized
+        # on any chip.  The solve itself is DEFERRED below the draft
+        # section: it eval_shapes BOTH models' caches, so both
+        # variable trees must exist first.  ``TTD_NO_HBM_AUTOSIZE=1``
+        # (or a linear-cache engine, which has no pool) falls back to
+        # the default heuristic with no budget set — bitwise the
+        # hand-tuned defaults.
+        if not 0.0 <= hbm_headroom < 1.0:
             raise ValueError(
-                f"kv_pool_blocks must be >= 1, got {kv_pool_blocks}")
-        self._kv_pool = self._radix = None
-        if self.paged:
-            self._kv_pool = serving_kv.KVBlockPool(
-                kv_pool_blocks, self.kv_block_size)
-            self._radix = serving_kv.RadixPrefixIndex(self._kv_pool)
+                f"hbm_headroom must be in [0, 1), got {hbm_headroom}")
+        self._hbm_headroom = float(hbm_headroom)
+        self._hbm_autosized = 0
+        autosize = kv_pool_blocks == "auto"
+        if autosize:
+            if hbm_budget_bytes is not None:
+                raise ValueError(
+                    "kv_pool_blocks='auto' solves hbm_budget_bytes "
+                    "itself; pass one or the other")
+            if _hbm_autosize_killed() or not self.paged:
+                autosize = False
+                kv_pool_blocks = None
+        elif isinstance(kv_pool_blocks, str):
+            raise ValueError(
+                f"kv_pool_blocks must be an int or 'auto', got "
+                f"{kv_pool_blocks!r}")
+        if not autosize:
+            if kv_pool_blocks is None:
+                kv_pool_blocks = slots * self._kv_nblk_lane
+            if kv_pool_blocks < 1:
+                raise ValueError(
+                    f"kv_pool_blocks must be >= 1, got {kv_pool_blocks}")
         # kv_stats counts ENGINE-visible cache economics (the /metrics
         # feed): tokens of prefill skipped via radix prefix hits,
         # blocks LRU-evicted under allocation pressure, and admissions
@@ -422,11 +482,9 @@ class ServingEngine:
         # the slot-grid decode/verify/insert programs go paged.
         self._prefill_model = _decode_model(config, self.cache_len,
                                             slot_decode=True)
-        self._model = (_decode_model(
-            config, self.cache_len, slot_decode=True,
-            paged_kv_blocks=1 + kv_pool_blocks,
-            kv_block_size=self.kv_block_size)
-            if self.paged else self._prefill_model)
+        # (the slot-grid decode model is built below, once
+        # kv_pool_blocks has resolved — possibly via the autosize
+        # solve, which needs the draft variables prepared first)
         # Speculative decoding across ALL slots: each round the draft
         # proposes k tokens per slot, the target verifies the k+1 block
         # in one call, and each slot accepts its own prefix — the
@@ -485,6 +543,39 @@ class ServingEngine:
             # covers both pools; only the pool row shapes differ.
             self._draft_prefill_model = _decode_model(
                 draft_config, self.cache_len, slot_decode=True)
+        # Acceptance-adaptive speculation (opt-in): precompiled
+        # draft-depth buckets + a host-side controller that SELECTS
+        # among them per round from measured acceptance — it never
+        # changes any program's math (forced-depth parity pinned in
+        # tests/test_spec_adaptive.py).  ``TTD_NO_ADAPTIVE_SPEC=1``
+        # pins the fixed ``speculative_k`` program bitwise.
+        self._spec_ctrl = None
+        if spec_depths is not None:
+            if draft_config is None:
+                raise ValueError("spec_depths needs draft_config/params")
+            if not _adaptive_spec_killed():
+                from tensorflow_train_distributed_tpu.models.speculative import (  # noqa: E501
+                    DepthController,
+                )
+
+                self._spec_ctrl = DepthController(spec_depths)
+        # ── deferred pool sizing + slot-grid decode models ──
+        if autosize:
+            kv_pool_blocks, budget = self._solve_hbm_autosize(
+                config, draft_config)
+            self.hbm_budget_bytes = budget
+            self._hbm_autosized = budget
+        self._kv_pool = self._radix = None
+        if self.paged:
+            self._kv_pool = serving_kv.KVBlockPool(
+                kv_pool_blocks, self.kv_block_size)
+            self._radix = serving_kv.RadixPrefixIndex(self._kv_pool)
+        self._model = (_decode_model(
+            config, self.cache_len, slot_decode=True,
+            paged_kv_blocks=1 + kv_pool_blocks,
+            kv_block_size=self.kv_block_size)
+            if self.paged else self._prefill_model)
+        if draft_config is not None:
             self._draft_model = (_decode_model(
                 draft_config, self.cache_len, slot_decode=True,
                 paged_kv_blocks=1 + kv_pool_blocks,
@@ -509,7 +600,8 @@ class ServingEngine:
         # "slot_rounds" counts active slots across them — the
         # denominator for acceptance rates (accepted/(slot_rounds·k)).
         self.spec_stats = {"rounds": 0, "slot_rounds": 0,
-                           "drafted_accepted": 0, "emitted": 0}
+                           "drafted": 0, "drafted_accepted": 0,
+                           "emitted": 0}
         self._cache_shapes: dict = {}  # (draft, batch, grid) -> eval_shape
         self._moe_prefill_lens: set = set()  # distinct exact-prefill lens
         # Linear-path prefix caches (paged mode subsumes them via the
@@ -743,17 +835,20 @@ class ServingEngine:
         return vs["cache"]
 
     def _accept_block_sampled(self, d_block, q, logits, round_keys,
-                              dtype):
+                              dtype, k):
         """Engine face of the shared rejection rule
         (``models.speculative.sampled_accept``): filter/softmax the
         target's raw ``logits`` [B, k+1, V] with the engine's sampling
         knobs and derive the per-slot acceptance uniforms (draw index
-        k+1) and residual/bonus keys (k+2) from ``round_keys``."""
+        k+1) and residual/bonus keys (k+2) from ``round_keys``.  ``k``
+        is the ROUND's draft depth (a static under `_spec_round`'s
+        trace) — under adaptive speculation different rounds run
+        different depths, so the depth can no longer be read off
+        ``self``."""
         from tensorflow_train_distributed_tpu.models.speculative import (
             sampled_accept,
         )
 
-        k = self._spec_k
         p = jax.nn.softmax(filter_logits(
             logits, temperature=self.temperature, top_k=self.top_k,
             top_p=self.top_p), axis=-1)            # [B, k+1, V]
@@ -765,11 +860,11 @@ class ServingEngine:
             d_block, q, p, us, final_keys)
         return (emit.astype(dtype), emitted, a, final.astype(dtype))
 
-    @compile_site(buckets="slot-grid (shape-fixed per engine)",
-                  donates=(3, 4), statics=(0,), max_compiles=4)
-    @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
+    @compile_site(buckets="spec-depth buckets (one program per k)",
+                  donates=(3, 4), statics=(0, 8), max_compiles=8)
+    @partial(jax.jit, static_argnums=(0, 8), donate_argnums=(3, 4))
     def _spec_round(self, t_vars, d_vars, t_cache, d_cache, tok, seeds,
-                    counts):
+                    counts, k):
         """One speculative round for ALL slots: the draft proposes k
         tokens per slot (k+1 steps — the last append-only so both
         caches hold identical row sets), the target verifies each
@@ -777,6 +872,16 @@ class ServingEngine:
         longest matching prefix, and both cache indices rewind
         PER SLOT by k+1-emitted (rows beyond stay stale-but-invisible:
         masks are position-based and writes precede reads).
+
+        ``k`` is STATIC: each draft depth compiles its own program, so
+        the adaptive controller picks among a fixed bucket set
+        (``spec_depths``) without retracing — a fixed-depth engine only
+        ever calls one signature.  Depth 0 degenerates to plain decode
+        (one append-only draft step keeps the draft cache's row set in
+        lockstep for later deepening; the empty d_block accepts
+        trivially and the round emits exactly the target's own pick) —
+        greedy depth-0 rounds are token-identical to `_decode_chunk`
+        steps.
 
         Returns (t_cache, d_cache, emit [B, k+1], emitted [B],
         next_tok [B], accepted [B]).  Greedy: emitted tokens are
@@ -788,7 +893,6 @@ class ServingEngine:
         per-slot stream (``seeds``/``counts``) keys every draw, so a
         round is reproducible independent of slot placement.
         """
-        k = self._spec_k
         round_keys = jax.vmap(jax.random.fold_in)(
             jax.vmap(jax.random.key)(seeds.astype(jnp.uint32)), counts)
 
@@ -836,7 +940,7 @@ class ServingEngine:
         else:
             q = jnp.moveaxis(scanned[1], 0, 1)[:, :k]   # [B, k, V]
             emit, emitted, a, next_tok = self._accept_block_sampled(
-                d_block, q, logits, round_keys, tok.dtype)
+                d_block, q, logits, round_keys, tok.dtype, k)
 
         # Per-slot rewind: both caches advanced k+1 this round; the
         # accepted context is old + emitted, i.e. index -= k+1-emitted.
@@ -2016,11 +2120,125 @@ class ServingEngine:
             self._prefill_bytes_memo = n
         return self._prefill_bytes_memo
 
+    def hbm_autosized_bytes(self) -> int:
+        """The HBM budget the autosize solve installed (0 when the
+        engine was hand-sized or the solve was killed) — the
+        ``ttd_engine_hbm_autosized_bytes`` gauge feed.  Written once at
+        construction, so scrape threads read a plain int."""
+        return self._hbm_autosized
+
+    def _solve_hbm_autosize(self, config, draft_config):
+        """``kv_pool_blocks='auto'``: solve (kv_pool_blocks,
+        hbm_budget_bytes) EXACTLY from the device's reported HBM and
+        the memcheck projection.  Grid cache bytes are linear in the
+        block count (pool rows scale; block tables, indices, and
+        scratch rows don't), so two eval_shape probes (n=1, n=2) give
+        the intercept/slope, and the solve takes the largest n with
+
+            grid_bytes(n) + batch-1 prefill transients
+                <= avail * (1 - hbm_headroom)
+
+        The right-hand side becomes ``hbm_budget_bytes``, so the
+        ``@memory_budget`` ledger enforces the same arithmetic the
+        solve used: an autosized engine's own pools and admission
+        transients fit by construction (zero MemoryBudgetError — the
+        exactness tests/test_spec_adaptive.py pins).  Host-only
+        eval_shape traces; nothing allocates here.  Called from the
+        ctor BEFORE ``_cache_shapes`` exists, hence the direct
+        eval_shape instead of ``_cache_struct``."""
+        avail = _device_hbm_bytes()
+        if avail is None:
+            raise ValueError(
+                "kv_pool_blocks='auto' needs a device memory report "
+                "(device.memory_stats()) or TTD_HBM_BYTES=<bytes>")
+
+        def tree_b(model, variables, batch):
+            def shape_fn(v):
+                with quantized_inference():
+                    return model.apply(
+                        v, jnp.zeros((batch, 1), jnp.int32),
+                        mutable=["cache"])[1]["cache"]
+
+            return memcheck.tree_bytes(
+                jax.eval_shape(shape_fn, variables))
+
+        def grid_bytes(n):
+            b = tree_b(
+                _decode_model(config, self.cache_len, slot_decode=True,
+                              paged_kv_blocks=1 + n,
+                              kv_block_size=self.kv_block_size),
+                self._variables, self.slots)
+            if draft_config is not None:
+                b += tree_b(
+                    _decode_model(draft_config, self.cache_len,
+                                  slot_decode=True,
+                                  paged_kv_blocks=1 + n,
+                                  kv_block_size=self.kv_block_size),
+                    self._draft_variables, self.slots)
+            return b
+
+        trans = tree_b(self._prefill_model, self._variables, 1)
+        if draft_config is not None:
+            trans += tree_b(self._draft_prefill_model,
+                            self._draft_variables, 1)
+        b1, b2 = grid_bytes(1), grid_bytes(2)
+        slope, intercept = b2 - b1, 2 * b1 - b2
+        usable = int(avail * (1.0 - self._hbm_headroom))
+        n = (usable - intercept - trans) // slope
+        if n < 1:
+            raise ValueError(
+                f"kv_pool_blocks='auto': no pool fits — device HBM "
+                f"{avail} bytes minus {self._hbm_headroom:.0%} headroom "
+                f"leaves {usable}, but one block of pools plus batch-1 "
+                f"prefill transients needs "
+                f"{intercept + slope + trans} (shrink hbm_headroom, "
+                f"slots, or cache_len)")
+        return int(n), usable
+
     def fused_attn(self) -> bool:
         """Whether the decode programs were compiled with the fused
         paged-attention kernel (False on CPU, under a mesh, with the
         linear cache, or when TTD_NO_FUSED_ATTN killed it)."""
         return self._fused_attn
+
+    def _spec_depth(self) -> int:
+        """Draft depth the NEXT speculative round dispatches at: the
+        controller's pick under adaptive speculation, else the fixed
+        ``speculative_k`` (0 on a plain-decode engine).  Host int —
+        read BEFORE the dispatch window opens."""
+        with self._stats_lock:
+            ctrl = self._spec_ctrl
+            return self._spec_k if ctrl is None else ctrl.depth()
+
+    @thread_role("handler", "driver")
+    def spec_depth(self) -> int:
+        """Scrape face of ``_spec_depth`` — the
+        ``ttd_engine_spec_depth`` gauge feed (a fixed engine reports
+        its constant k; a plain-decode engine reports 0)."""
+        return self._spec_depth()
+
+    @thread_role("handler", "driver")
+    def spec_accepted_tokens(self) -> int:
+        """Cumulative draft tokens the target ACCEPTED across
+        speculative rounds (the numerator of the fleet acceptance
+        rate; ``ttd_engine_spec_accepted_tokens_total``)."""
+        with self._stats_lock:
+            return self.spec_stats["drafted_accepted"]
+
+    @thread_role("handler", "driver")
+    def spec_drafted_tokens(self) -> int:
+        """Cumulative draft tokens PROPOSED across speculative rounds
+        (k per slot-round at the round's dispatched depth — the
+        denominator; ``ttd_engine_spec_drafted_tokens_total``)."""
+        with self._stats_lock:
+            return self.spec_stats["drafted"]
+
+    def spec_telemetry(self) -> dict:
+        """Per-depth controller telemetry (rounds, acceptance EWMA) —
+        bench/debug surface; {} for fixed-depth engines."""
+        with self._stats_lock:
+            ctrl = self._spec_ctrl
+            return {} if ctrl is None else ctrl.telemetry()
 
     @thread_role("handler", "driver")
     def kv_prefix_hit_tokens(self) -> int:
@@ -2384,17 +2602,22 @@ class ServingEngine:
             self._consume(state, toks[slot])
             self._retire_if_done(slot, state)
 
-    def _harvest_spec(self, emit, emitted, next_tok, accepted,
+    def _harvest_spec(self, emit, emitted, next_tok, accepted, k,
                       rids=None):
         """Consume each slot's emitted prefix from a speculative round
         (variable per slot; budget/EOS via the shared consume rule),
         tracking acceptance stats.  The round's bonus token is the last
         emitted one, so a surviving slot's ``last_token`` already holds
-        ``next_tok`` after consuming.  ``rids``: the overlap trim
-        guard, same rule as ``_harvest``."""
+        ``next_tok`` after consuming.  ``k``: the depth the round was
+        DISPATCHED at (recorded in the in-flight dict — under adaptive
+        speculation the current pick may already differ); it sizes the
+        drafted-token denominator and feeds the controller's
+        acceptance observation.  ``rids``: the overlap trim guard,
+        same rule as ``_harvest``."""
         del next_tok  # == emit[slot, emitted-1], consumed above
         with self._stats_lock:
             self.spec_stats["rounds"] += 1  # engine, not slot-rounds
+        n_slots = acc_sum = 0
         for slot, state in enumerate(self._slot_states):
             if state is None:
                 continue
@@ -2402,11 +2625,23 @@ class ServingEngine:
                 continue
             before = len(state.tokens)
             self._consume(state, emit[slot, :int(emitted[slot])])
+            n_slots += 1
+            acc_sum += int(accepted[slot])
             with self._stats_lock:
                 self.spec_stats["slot_rounds"] += 1
+                self.spec_stats["drafted"] += k
                 self.spec_stats["drafted_accepted"] += int(accepted[slot])
                 self.spec_stats["emitted"] += len(state.tokens) - before
             self._retire_if_done(slot, state)
+        if self._spec_ctrl is not None:
+            # One observation per harvested round, aggregated over the
+            # slots that survived the trim guard (a fully-trimmed
+            # garbage round still advances the dwell clock — the
+            # controller's decisions stay a pure function of the
+            # request stream).  Wall time is NOT fed here: depth
+            # choices must be deterministic from acceptance alone.
+            with self._stats_lock:
+                self._spec_ctrl.observe(k * n_slots, acc_sum)
 
     def pending(self) -> int:
         """Requests not yet finished (queued + staged mid-prefill +
@@ -2482,10 +2717,15 @@ class ServingEngine:
             if state is not None:
                 seeds[slot] = state.seed
                 rids[slot] = state.request_id
+        # Depth for THIS round: the controller's pick (adaptive) or the
+        # fixed k.  Host ints end to end — read before the dispatch
+        # window opens (the controller is _stats_lock-guarded; the
+        # window must stay conversion- and contention-free).
+        k = self._spec_depth()
         with self._ctx(), events.span(
                 "decode/dispatch",
                 active=sum(r is not None for r in rids),
-                fused=self._fused_tag):
+                fused=self._fused_tag, spec_k=k):
             # Retired/cancelled lanes' tables must point at scratch
             # BEFORE this chunk: their freed blocks may already be
             # reallocated, and this chunk decodes them as garbage.
@@ -2496,13 +2736,13 @@ class ServingEngine:
                 (self._cache, self._d_cache, emit, emitted, next_tok,
                  acc, counts_next) = self._spec_round(
                     self._variables, self._draft_variables, self._cache,
-                    self._d_cache, tok, jseeds, counts)
+                    self._d_cache, tok, jseeds, counts, k)
                 # Continuing slots consumed exactly ``emitted`` tokens,
                 # so the device advances their rng counters itself —
                 # the property that lets round N+1 enqueue before round
                 # N's host copy exists.
                 self._carry = (next_tok, counts_next)
-                self._inflight = {"spec": True, "rids": rids,
+                self._inflight = {"spec": True, "rids": rids, "k": k,
                                   "emit": emit, "emitted": emitted,
                                   "next_tok": next_tok, "acc": acc}
             else:
@@ -2563,7 +2803,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         with events.span("decode/harvest", overlapped=overlapped):
             if inf["spec"]:
-                self._harvest_spec(*args, rids=rids)
+                self._harvest_spec(*args, inf["k"], rids=rids)
             else:
                 self._harvest(toks, rids=rids)
         dt = time.perf_counter() - t0
@@ -2710,15 +2950,16 @@ class ServingEngine:
                     counts[slot] = state.count
                     n_active += 1
             if self._draft_model is not None:
+                k = self._spec_depth()
                 with self._ctx(), events.span(
                         "decode/dispatch", active=n_active,
-                        fused=self._fused_tag):
+                        fused=self._fused_tag, spec_k=k):
                     self._flush_stale_lanes()
                     (self._cache, self._d_cache, emit, emitted,
                      next_tok, acc, _) = self._spec_round(
                         self._variables, self._draft_variables,
                         self._cache, self._d_cache, jnp.asarray(tok),
-                        jnp.asarray(seeds), jnp.asarray(counts))
+                        jnp.asarray(seeds), jnp.asarray(counts), k)
                 # decode/wait is the device block, decode/harvest the
                 # host pass — same split as the overlap path, so the
                 # two paths' traces are comparable span for span.
@@ -2726,7 +2967,7 @@ class ServingEngine:
                     args = (np.asarray(emit), np.asarray(emitted),
                             np.asarray(next_tok), np.asarray(acc))
                 with events.span("decode/harvest", overlapped=False):
-                    self._harvest_spec(*args)
+                    self._harvest_spec(*args, k)
             else:
                 with self._ctx(), events.span(
                         "decode/dispatch", active=n_active,

@@ -1,0 +1,376 @@
+"""Acceptance-adaptive speculative depth + device-HBM autosizing.
+
+Two invariants anchor the tentpole:
+
+- The DepthController only ever SELECTS among precompiled depth
+  programs — an engine whose controller is pinned to depth k is
+  BITWISE the fixed ``speculative_k=k`` engine (and depth 0 is plain
+  decode), so adaptivity is a latency lever, never a correctness knob.
+- ``kv_pool_blocks='auto'`` solves the pool size and HBM budget
+  EXACTLY from the memcheck projection: the solved pool plus batch-1
+  prefill transients fit under ``avail * (1 - headroom)`` and one more
+  block would not — construction never raises MemoryBudgetError on
+  any synthetic HBM size.
+
+Controller tests and autosize solves are host-only (no decode
+compiles) and run in tier-1; engine parity runs compile and sit in
+the full-suite tier.
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_train_distributed_tpu import serving
+from tensorflow_train_distributed_tpu.models.generate import generate
+from tensorflow_train_distributed_tpu.models.llama import (
+    LLAMA_PRESETS,
+    LlamaModel,
+)
+from tensorflow_train_distributed_tpu.models.speculative import (
+    DepthController,
+)
+from tensorflow_train_distributed_tpu.runtime.lint import memcheck
+from tensorflow_train_distributed_tpu.server.procpool import (
+    worker_pack_cap,
+)
+from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+CFG = LLAMA_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaModel(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ref(params, prompt, max_new):
+    return np.asarray(generate(
+        CFG, params, jnp.asarray([prompt], jnp.int32), max_new))[0].tolist()
+
+
+class TestDepthController:
+    """Synthetic acceptance traces: the controller's trajectory is a
+    deterministic function of the observe() history, so each trace
+    pins exact depths/switch counts."""
+
+    def _feed(self, ctrl, rounds, rate):
+        """``rounds`` rounds at the current depth's drafted volume
+        (k * slots, the engine's feed), ``rate`` of them accepted."""
+        for _ in range(rounds):
+            k = ctrl.depth()
+            drafted = k * 2
+            ctrl.observe(drafted, int(drafted * rate))
+
+    def test_ramp_deepens_one_bucket_per_dwell(self):
+        ctrl = DepthController((0, 2, 4, 8), start=2)
+        self._feed(ctrl, 20, 1.0)
+        assert ctrl.depth() == 8
+        assert ctrl.switches == 2          # 2 -> 4 -> 8, dwell-gated
+
+    def test_collapse_backs_off_to_plain_decode(self):
+        ctrl = DepthController((0, 2, 4, 8))
+        depths = []
+        for _ in range(60):
+            depths.append(ctrl.depth())
+            ctrl.observe(ctrl.depth() * 2, 0)
+        # Walked the ladder down without skipping buckets...
+        assert depths[0] == 8
+        for a, b in zip(depths, depths[1:]):
+            assert b in (a, 0, 2, 4, 8) and abs(
+                (0, 2, 4, 8).index(b) - (0, 2, 4, 8).index(a)) <= 1
+        # ...and settled at depth 0, where the only non-zero rounds
+        # are the deterministic probes (kept only on good acceptance,
+        # so with dead acceptance every probe snaps back next round).
+        assert ctrl.depth() == 0
+        probe_rounds = [d for d in depths[20:] if d != 0]
+        assert probe_rounds and set(probe_rounds) == {2}
+
+    def test_oscillation_hysteresis_bounds_switch_rate(self):
+        """Acceptance flapping 1.0/0.0 every round: the EWMA settles
+        between backoff and deepen, so after the transient the
+        controller STOPS switching — the flap never reaches the
+        programs."""
+        ctrl = DepthController((0, 2, 4, 8), start=4)
+        for i in range(100):
+            self._feed(ctrl, 1, 1.0 if i % 2 == 0 else 0.0)
+        assert ctrl.depth() == 4
+        assert ctrl.switches <= 4          # transient only
+        # Hard hysteresis bound regardless of trace: one move per
+        # dwell window plus probe round-trips.
+        assert ctrl.switches <= 100 // ctrl.dwell + 2 * (
+            100 // ctrl.probe_every + 1)
+
+    def test_probe_recovers_from_plain_decode(self):
+        ctrl = DepthController((0, 2, 4, 8))
+        self._feed(ctrl, 40, 0.0)
+        assert ctrl.depth() == 0
+        self._feed(ctrl, 30, 1.0)          # draft got good again
+        assert ctrl.depth() == 8           # probe kept, then climbed
+
+    def test_telemetry_counts_rounds_per_depth(self):
+        ctrl = DepthController((0, 4), start=4)
+        self._feed(ctrl, 10, 1.0)
+        t = ctrl.telemetry()
+        assert t["depth"] == 4 and t["rounds"] == 10
+        assert t["per_depth"][4]["rounds"] == 10
+        assert t["per_depth"][0]["rounds"] == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="buckets"):
+            DepthController((4,))
+        with pytest.raises(ValueError, match="non-negative"):
+            DepthController((-1, 4))
+        with pytest.raises(ValueError, match="buckets"):
+            DepthController((0, 0))       # dedupes to a single bucket
+        with pytest.raises(ValueError, match="backoff"):
+            DepthController((0, 4), deepen=0.3, backoff=0.5)
+        with pytest.raises(ValueError, match="start"):
+            DepthController((0, 4), start=3)
+
+
+class _PinnedDepth:
+    """Controller stub that always selects one depth — the forced-depth
+    harness proving the controller only SELECTS among programs."""
+
+    def __init__(self, k):
+        self._k = k
+        self.switches = 0
+
+    def depth(self):
+        return self._k
+
+    def observe(self, *a, **kw):
+        pass
+
+    def telemetry(self):
+        return {"depth": self._k, "rounds": 0, "switches": 0,
+                "acceptance": None, "per_depth": {}}
+
+
+@pytest.mark.slow
+class TestForcedDepthParity:
+    """Adaptive engine pinned to depth k == fixed speculative_k=k
+    engine, token for token; pinned depth 0 == the draft-free plain
+    engine."""
+
+    def _reqs(self, seed):
+        rng = np.random.default_rng(seed)
+        return [(list(rng.integers(1, 200, n)), m)
+                for n, m in [(5, 9), (3, 7), (6, 11), (4, 5)]]
+
+    def _serve(self, eng, reqs):
+        ids = [eng.submit(p, m) for p, m in reqs]
+        out = eng.run()
+        return [out[i] for i in ids]
+
+    def _engine(self, params, dcfg, dparams, *, pin=None, k=3, **kw):
+        depths = sorted({0, 3, k})
+        if pin is None:
+            eng = ServingEngine(CFG, params, slots=2, cache_len=48,
+                                chunk=3, prompt_buckets=(8,),
+                                draft_config=dcfg, draft_params=dparams,
+                                speculative_k=k, **kw)
+        else:
+            eng = ServingEngine(CFG, params, slots=2, cache_len=48,
+                                chunk=3, prompt_buckets=(8,),
+                                draft_config=dcfg, draft_params=dparams,
+                                speculative_k=k, spec_depths=depths,
+                                **kw)
+            assert eng._spec_ctrl is not None
+            eng._spec_ctrl = _PinnedDepth(pin)
+        return eng
+
+    def test_pinned_k_greedy_matches_fixed_k(self, params):
+        dcfg = LLAMA_PRESETS["llama_tiny_scan"]
+        dparams = LlamaModel(dcfg).init(
+            jax.random.PRNGKey(99), jnp.zeros((1, 4), jnp.int32))["params"]
+        reqs = self._reqs(30)
+        pinned = self._serve(self._engine(params, dcfg, dparams, pin=3),
+                             reqs)
+        fixed = self._serve(self._engine(params, dcfg, dparams), reqs)
+        assert pinned == fixed
+        for got, (p, m) in zip(pinned, reqs):
+            assert got == _ref(params, p, m)
+
+    def test_pinned_zero_is_plain_decode(self, params):
+        """Depth 0 through the k=0 round program (draft cache in
+        lockstep) emits exactly the plain engine's greedy tokens."""
+        reqs = self._reqs(31)
+        pinned = self._serve(
+            self._engine(params, CFG, params, pin=0), reqs)
+        plain = self._serve(
+            ServingEngine(CFG, params, slots=2, cache_len=48, chunk=3,
+                          prompt_buckets=(8,)), reqs)
+        assert pinned == plain
+
+    def test_pinned_k_sampled_matches_fixed_k(self, params):
+        """Per-request rng streams are depth-program-independent, so
+        the pinned and fixed engines draw identical tokens."""
+        reqs = self._reqs(32)
+        pinned = self._serve(
+            self._engine(params, CFG, params, pin=3,
+                         temperature=1.0, top_k=8), reqs)
+        fixed = self._serve(
+            self._engine(params, CFG, params,
+                         temperature=1.0, top_k=8), reqs)
+        assert pinned == fixed
+
+    def test_adaptive_spec_stats_flow(self, params):
+        """The live controller serves correctly and the scrape
+        accessors feed the gateway gauges."""
+        reqs = self._reqs(33)
+        eng = self._engine(params, CFG, params, pin=None, k=3)
+        eng2 = ServingEngine(CFG, params, slots=2, cache_len=48,
+                             chunk=3, prompt_buckets=(8,),
+                             draft_config=CFG, draft_params=params,
+                             speculative_k=3, spec_depths=(0, 3))
+        outs = self._serve(eng2, reqs)
+        for got, (p, m) in zip(outs, reqs):
+            assert got == _ref(params, p, m)
+        assert eng2.spec_depth() in (0, 3)
+        assert (eng2.spec_drafted_tokens()
+                >= eng2.spec_accepted_tokens() >= 0)
+        assert eng2.spec_telemetry()["rounds"] > 0
+
+
+@pytest.mark.slow
+class TestAdaptiveKillSwitch:
+    def test_kill_switch_pins_fixed_k_bitwise(self, params,
+                                              monkeypatch):
+        """TTD_NO_ADAPTIVE_SPEC=1: spec_depths is ignored, the
+        controller is never built, and the engine is the fixed
+        speculative_k engine token for token."""
+        rng = np.random.default_rng(40)
+        reqs = [(list(rng.integers(1, 200, n)), m)
+                for n, m in [(5, 9), (3, 7), (6, 11)]]
+
+        def serve(**kw):
+            eng = ServingEngine(CFG, params, slots=2, cache_len=48,
+                                chunk=3, prompt_buckets=(8,),
+                                draft_config=CFG, draft_params=params,
+                                speculative_k=3, **kw)
+            ids = [eng.submit(p, m) for p, m in reqs]
+            out = eng.run()
+            return eng, [out[i] for i in ids]
+
+        monkeypatch.setenv("TTD_NO_ADAPTIVE_SPEC", "1")
+        killed, killed_out = serve(spec_depths=(0, 2, 3))
+        assert killed._spec_ctrl is None
+        assert killed.spec_depth() == 3
+        monkeypatch.delenv("TTD_NO_ADAPTIVE_SPEC")
+        fixed, fixed_out = serve()
+        assert killed_out == fixed_out
+
+
+class TestHBMAutosize:
+    """Solve exactness on synthetic HBM sizes (TTD_HBM_BYTES): host
+    eval_shape arithmetic only, no decode compiles."""
+
+    SIZES = (32 << 20, 64 << 20, 128 << 20)
+
+    def _engine(self, params, **kw):
+        kw.setdefault("kv_pool_blocks", "auto")
+        return ServingEngine(CFG, params, slots=2, cache_len=48,
+                             chunk=3, prompt_buckets=(8,), **kw)
+
+    def _ref_ledger(self, eng, n):
+        """The memcheck projection the solve must agree with: full
+        grid cache bytes at ``n`` pool blocks plus one batch-1 prefill
+        pair — recomputed from the engine's own model/variables, NOT
+        from the solver."""
+        def tree_b(model, variables, batch):
+            def shape_fn(v):
+                with serving.quantized_inference():
+                    return model.apply(
+                        v, jnp.zeros((batch, 1), jnp.int32),
+                        mutable=["cache"])[1]["cache"]
+
+            return memcheck.tree_bytes(
+                jax.eval_shape(shape_fn, variables))
+
+        grid = tree_b(
+            serving._decode_model(CFG, eng.cache_len, slot_decode=True,
+                                  paged_kv_blocks=1 + n,
+                                  kv_block_size=eng.kv_block_size),
+            eng._variables, eng.slots)
+        trans = tree_b(eng._prefill_model, eng._variables, 1)
+        return grid + trans
+
+    def test_solve_exact_on_synthetic_sizes(self, params, monkeypatch):
+        solved = []
+        for avail in self.SIZES:
+            monkeypatch.setenv("TTD_HBM_BYTES", str(avail))
+            eng = self._engine(params)     # zero MemoryBudgetError
+            usable = int(avail * (1.0 - 0.1))
+            assert eng.hbm_budget_bytes == usable
+            assert eng.hbm_autosized_bytes() == usable
+            n = eng._kv_pool.n_blocks
+            assert n >= 1
+            # Ledger exactness: n fits under the budget, n+1 would
+            # not — the solve is the memcheck projection, maximal.
+            assert self._ref_ledger(eng, n) <= usable
+            assert self._ref_ledger(eng, n + 1) > usable
+            # Determinism: re-solving installs the same answer.
+            assert eng._solve_hbm_autosize(CFG, None) == (n, usable)
+            solved.append(n)
+        assert solved == sorted(solved) and solved[0] < solved[-1]
+
+    def test_headroom_scales_the_solve(self, params, monkeypatch):
+        monkeypatch.setenv("TTD_HBM_BYTES", str(self.SIZES[1]))
+        roomy = self._engine(params, hbm_headroom=0.0)
+        tight = self._engine(params, hbm_headroom=0.5)
+        assert tight.hbm_budget_bytes < roomy.hbm_budget_bytes
+        assert tight._kv_pool.n_blocks < roomy._kv_pool.n_blocks
+
+    def test_over_headroom_refusal(self, params, monkeypatch):
+        """A device too small for even one block under the headroom is
+        a construction-time refusal, not a runtime OOM."""
+        monkeypatch.setenv("TTD_HBM_BYTES", str(4 << 10))
+        with pytest.raises(ValueError, match="no pool fits"):
+            self._engine(params)
+
+    def test_kill_switch_falls_back_to_hand_sizing(self, params,
+                                                   monkeypatch):
+        monkeypatch.setenv("TTD_HBM_BYTES", str(self.SIZES[1]))
+        monkeypatch.setenv("TTD_NO_HBM_AUTOSIZE", "1")
+        eng = self._engine(params)
+        assert eng.hbm_autosized_bytes() == 0
+        assert eng.hbm_budget_bytes is None
+        # The default hand-sized pool: slots * ceil(cache_len/block).
+        assert eng._kv_pool.n_blocks == 2 * -(-48 // eng.kv_block_size)
+
+    def test_auto_and_budget_are_exclusive(self, params, monkeypatch):
+        monkeypatch.setenv("TTD_HBM_BYTES", str(self.SIZES[1]))
+        with pytest.raises(ValueError, match="one or the other"):
+            self._engine(params, hbm_budget_bytes=1 << 20)
+
+    def test_no_device_report_is_a_clear_error(self, params,
+                                               monkeypatch):
+        monkeypatch.delenv("TTD_HBM_BYTES", raising=False)
+        monkeypatch.setattr(serving, "_device_hbm_bytes", lambda: None)
+        with pytest.raises(ValueError, match="TTD_HBM_BYTES"):
+            self._engine(params)
+
+    def test_bad_headroom_rejected(self, params, monkeypatch):
+        monkeypatch.setenv("TTD_HBM_BYTES", str(self.SIZES[1]))
+        with pytest.raises(ValueError, match="headroom"):
+            self._engine(params, hbm_headroom=1.0)
+
+
+class TestWorkerPacking:
+    """ProcPool derives its worker cap from the same budget arithmetic
+    the engine advertises in HELLO."""
+
+    def test_pack_cap(self):
+        assert worker_pack_cap(100, 30) == 3
+        assert worker_pack_cap(100, 30, headroom=0.2) == 2
+        assert worker_pack_cap(10, 30) == 1     # never starve to zero
+        assert worker_pack_cap(None, 30) is None
+        assert worker_pack_cap(100, None) is None
+        assert worker_pack_cap(0, 30) is None

@@ -853,6 +853,181 @@ def bench_serving(preset, slots, chunk, n_requests, prompt_range,
     return rec
 
 
+def bench_spec_adaptive_ab(preset, draft_preset, slots, chunk,
+                           n_requests, prompt_range, new_range,
+                           cache_len, seed, depths=(0, 2, 4, 8),
+                           reps=3, wide_d_model=0):
+    """The acceptance-adaptive speculation A/B: adaptive depth
+    (``spec_depths`` buckets + DepthController) vs every FIXED depth
+    in the bucket set, on a MIXED workload no single fixed depth can
+    win — an easy phase (high-acceptance cheap draft: deep k
+    amortizes target steps) plus a hard phase (random-init draft:
+    acceptance ~0, every drafted token is wasted work and k=0 is
+    optimal).  A fixed depth is tuned for one phase and pays on the
+    other; the controller should ride each phase at its optimum, so
+    the bar is adaptive ~= best fixed (<= 2% behind) AND >= 1.15x the
+    worst fixed.
+
+    Speculation only pays when the draft step is much cheaper than
+    the target step, so the TARGET here is the preset deepened 4x
+    with the upper residual blocks' output projections ZEROED — every
+    upper block is x + 0 (an exact identity), so the deep model
+    computes the preset's function at 4x the preset's per-step cost.
+    The easy draft is the target's first quarter SHARING its weights:
+    same logits, ~unit acceptance, ~1/4 the step cost — a synthetic
+    stand-in for a well-trained draft (the 'self'/random bracket
+    bench_serving documents, collapsed to its interesting corner).
+    The hard draft is the same small config randomly initialized.
+
+    Each policy gets TWO engines (one per phase — the phase is a
+    property of the draft model, not the requests) warmed on its own
+    phase's requests, so the adaptive engines compile their depth
+    buckets outside the timed region (the hard engine walks
+    deepest->0 during warmup; the easy engine never leaves the
+    deepest bucket).  The fixed-0 comparator is a draft-free engine —
+    plain decode, the honest 'no speculation' leg.
+
+    Noise discipline: per ROUND, every policy runs its full mixed
+    pass back-to-back, with policy order alternating between rounds;
+    the headline is the MEDIAN over rounds of the per-round wall
+    ratio adaptive/best-fixed (best fixed = the depth with the lowest
+    median wall)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS, LlamaModel,
+    )
+    from tensorflow_train_distributed_tpu.serving import ServingEngine
+
+    draft_cfg = LLAMA_PRESETS[draft_preset or preset]
+    if wide_d_model:
+        # CPU-leg sizing: widen the preset until the target's weights
+        # spill the last-level cache — decode goes weight-streaming
+        # (bandwidth) bound, which is the regime where a multi-position
+        # verify costs ~one step and speculation pays at all.  TPU
+        # presets are already there; the tiny CPU preset is not.
+        draft_cfg = dataclasses.replace(
+            draft_cfg, d_model=wide_d_model,
+            ffn_size=wide_d_model * 11 // 4,
+            num_heads=8, num_kv_heads=4)
+    cfg = dataclasses.replace(draft_cfg,
+                              num_layers=4 * draft_cfg.num_layers)
+    params = LlamaModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def _zero_upper(path, leaf):
+        # Upper blocks become exact identities: zero the residual
+        # output projections (attention/out, mlp/wo), so the block
+        # adds exact 0.0 to the stream.
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if (keys and keys[0].startswith("layer_")
+                and int(keys[0][len("layer_"):]) >= draft_cfg.num_layers
+                and ("out" in keys or "wo" in keys)):
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    params = jax.tree_util.tree_map_with_path(_zero_upper, params)
+    # Easy draft = the target's first quarter, sharing its weights —
+    # the identity upper blocks make its logits the target's logits.
+    easy_draft_params = {
+        k: params[k] for k in
+        ["token_embed", "final_norm", "lm_head"]
+        + [f"layer_{i}" for i in range(draft_cfg.num_layers)]}
+    bad_draft_params = LlamaModel(draft_cfg).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    vocab = min(cfg.vocab_size, 30_000)
+    easy_reqs = _requests(n_requests, *prompt_range, *new_range,
+                          vocab, seed)
+    hard_reqs = _requests(n_requests, *prompt_range, *new_range,
+                          vocab, seed + 1)
+    gen_tokens = sum(m for _, m in easy_reqs + hard_reqs)
+    deepest = max(depths)
+
+    def make(policy, regime):
+        d_cfg, d_params = ((draft_cfg, easy_draft_params)
+                           if regime == "easy"
+                           else (draft_cfg, bad_draft_params))
+        if policy == "adaptive":
+            kw = dict(speculative_k=deepest, spec_depths=depths)
+        elif policy == 0:
+            d_cfg = d_params = None               # plain decode
+            kw = dict(speculative_k=0)
+        else:
+            kw = dict(speculative_k=policy)
+        eng = ServingEngine(cfg, params, slots=slots, chunk=chunk,
+                            cache_len=cache_len, draft_config=d_cfg,
+                            draft_params=d_params, **kw)
+        reqs = easy_reqs if regime == "easy" else hard_reqs
+        for pr, m in reqs:                        # warmup: compiles
+            eng.submit(pr, m)
+        eng.run()
+        return eng
+
+    policies = ["adaptive"] + [int(k) for k in depths]
+    engines = {p: {r: make(p, r) for r in ("easy", "hard")}
+               for p in policies}
+    walls = {p: [] for p in policies}
+    for i in range(max(1, reps)):
+        order = policies if i % 2 == 0 else list(reversed(policies))
+        for pol in order:
+            w = (_run_engine_timed(engines[pol]["easy"], easy_reqs)[0]
+                 + _run_engine_timed(engines[pol]["hard"], hard_reqs)[0])
+            walls[pol].append(w)
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    fixed = {k: median(walls[k]) for k in depths}
+    best_k = min(fixed, key=fixed.get)
+    worst_k = max(fixed, key=fixed.get)
+    vs_best = sorted(a / b for a, b in
+                     zip(walls["adaptive"], walls[best_k]))
+    vs_worst = sorted(b / a for a, b in
+                      zip(walls["adaptive"], walls[worst_k]))
+    tele = {r: engines["adaptive"][r].spec_telemetry()
+            for r in ("easy", "hard")}
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_serving_spec_adaptive_wall_ratio",
+        "value": round(median(vs_best), 4),
+        "unit": "x wall, adaptive depth vs best fixed depth on the "
+                "mixed easy/hard workload (median of per-round wall "
+                "ratios; <= 1.02 = within 2% of best fixed)",
+        "vs_worst_fixed_speedup": round(median(vs_worst), 4),
+        "best_fixed_k": best_k,
+        "worst_fixed_k": worst_k,
+        "depths": list(depths),
+        "pair_wall_ratios_vs_best": [round(r, 4) for r in vs_best],
+        "pair_wall_ratios_vs_worst": [round(r, 4) for r in vs_worst],
+        "per_policy": {
+            str(p): {
+                "wall_s_median": round(median(walls[p]), 3),
+                "tokens_per_sec": round(
+                    gen_tokens / median(walls[p]), 1),
+            } for p in policies},
+        "adaptive_depth_rounds": {
+            r: {str(d): v["rounds"]
+                for d, v in tele[r].get("per_depth", {}).items()}
+            for r in tele},
+        "adaptive_switches": {
+            r: tele[r].get("switches", 0) for r in tele},
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests_per_phase": n_requests,
+        "gen_tokens": gen_tokens,
+        "reps": reps,
+        "wide_d_model": wide_d_model,
+        "target_layers": cfg.num_layers,
+        "draft_layers": draft_cfg.num_layers,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--preset", default="llama_125m")
@@ -926,6 +1101,23 @@ def main(argv=None) -> int:
                         "TTD_NO_TRACE=1, reporting the tok/s overhead "
                         "percentage (committed record: "
                         "profiles/bench/trace_overhead_ab.jsonl)")
+    p.add_argument("--spec-adaptive-ab", action="store_true",
+                   help="acceptance-adaptive speculation A/B instead "
+                        "of the throughput run: adaptive depth vs "
+                        "every fixed depth in --spec-depths, on a "
+                        "mixed easy (self-draft) / hard (random-init "
+                        "draft) workload no single fixed depth wins "
+                        "(committed record: "
+                        "profiles/bench/spec_adaptive_ab.jsonl)")
+    p.add_argument("--spec-depths", default="0,2,4,8",
+                   help="--spec-adaptive-ab only: comma-separated "
+                        "depth buckets (also the fixed comparator "
+                        "set)")
+    p.add_argument("--spec-d-model", type=int, default=0,
+                   help="--spec-adaptive-ab only: widen the preset to "
+                        "this d_model so decode is weight-streaming "
+                        "bound (the CPU leg's sizing; 0 = preset "
+                        "unchanged, the TPU leg)")
     p.add_argument("--prefill-chunk", type=int, default=16,
                    help="--mixed only: prefill piece size (one budget "
                         "installment)")
@@ -974,6 +1166,16 @@ def main(argv=None) -> int:
                                      args.requests, prompt_range,
                                      new_range, args.cache_len or None,
                                      args.seed, reps=args.reps)
+            elif args.spec_adaptive_ab:
+                depths = tuple(int(x)
+                               for x in args.spec_depths.split(","))
+                draft = (args.speculative_draft
+                         if args.speculative_draft != "self" else "")
+                rec = bench_spec_adaptive_ab(
+                    args.preset, draft, args.slots, args.chunk,
+                    args.requests, prompt_range, new_range,
+                    args.cache_len or None, args.seed, depths,
+                    reps=args.reps, wide_d_model=args.spec_d_model)
             elif args.fused_ab:
                 sweep = ([int(s) for s in args.sweep_slots.split(",")]
                          if args.sweep_slots
@@ -1006,6 +1208,9 @@ def main(argv=None) -> int:
         elif args.trace_ab:
             metric = f"{args.preset}_serving_trace_overhead_pct"
             unit = "% tok/s lost, flight recorder on vs TTD_NO_TRACE=1"
+        elif args.spec_adaptive_ab:
+            metric = f"{args.preset}_serving_spec_adaptive_wall_ratio"
+            unit = "x wall, adaptive depth vs best fixed depth"
         elif args.fused_ab:
             metric = f"{args.preset}_serving_fused_attn_wall_ratio"
             unit = ("x wall, XLA block-gather leg vs fused "

@@ -99,6 +99,16 @@ for K in 2 4 8; do
         --grad-overlap "$K" --steps 30 2>>"$LOG" | tee -a "$LOG"
 done
 
+say "--- 11. acceptance-adaptive speculative depth A/B (adaptive"
+say "    controller vs every fixed depth in the bucket set, mixed"
+say "    easy/hard workload; on real chips the per-round dispatch"
+say "    overhead the controller amortizes is HBM-bound verify work,"
+say "    so the adaptive margin should widen vs the CPU record) ---"
+timeout 2400 python tools/bench_serving.py --preset llama_125m \
+    --spec-adaptive-ab --slots 16 --chunk 8 --requests 24 \
+    --prompt-range 16,120 --new-range 32,128 --cache-len 512 \
+    --reps 5 2>>"$LOG" | tee -a "$LOG"
+
 say "=== playbook done $(date -u); results in $LOG ==="
 say "NEXT: update PROFILE.md (bnsub vs s2d from step 2; no_ffn from 3;"
 say "pallas verdict from 4 — keep whichever wins as the default;"
@@ -107,4 +117,6 @@ say "profiles/bench/fused_attn_ab.jsonl and keep the faster default;"
 say "grad-quant + busBW verdicts from 9 -> append the TPU legs to"
 say "profiles/bench/grad_quant_ab.jsonl; overlap verdict + best K from"
 say "10 -> append the TPU legs to profiles/bench/grad_overlap_ab.jsonl"
-say "and pin the winning --grad-overlap default)."
+say "and pin the winning --grad-overlap default; adaptive-depth verdict"
+say "from 11 -> append the TPU leg to"
+say "profiles/bench/spec_adaptive_ab.jsonl)."

@@ -47,6 +47,35 @@ from sample import (  # noqa: E402 (tools/ sibling)
 )
 
 
+def _int_or_auto(v: str):
+    """``--kv-pool-blocks`` values: an int, or the literal 'auto'
+    (device-HBM autosizing — the engine solves the pool size and
+    budget from the chip's reported memory)."""
+    return v if v == "auto" else int(v)
+
+
+def parse_spec_depth_arg(arg: str, fixed_k: int):
+    """``--spec-depth`` → (speculative_k, spec_depths-or-None).
+
+    '' keeps today's fixed ``--speculative-k``; 'fixed:K' pins K
+    (bitwise the same engine); 'adaptive' uses the default bucket set
+    (0, 2, 4, 8); 'adaptive:K1,K2,...' sets the buckets.  Shared by
+    serve/serve_http/bench so every launcher parses the policy
+    identically."""
+    if not arg:
+        return fixed_k, None
+    if arg.startswith("fixed:"):
+        return int(arg.split(":", 1)[1]), None
+    if arg == "adaptive":
+        return fixed_k, (0, 2, 4, 8)
+    if arg.startswith("adaptive:"):
+        depths = tuple(int(x) for x in arg.split(":", 1)[1].split(","))
+        return fixed_k, depths
+    raise SystemExit(
+        f"--spec-depth must be 'fixed:K', 'adaptive', or "
+        f"'adaptive:K1,K2,...', got {arg!r}")
+
+
 def add_engine_args(p) -> None:
     """Engine/model flag surface SHARED with tools/serve_http.py: one
     definition, so the offline CLI and the online gateway always load
@@ -96,6 +125,17 @@ def add_engine_args(p) -> None:
                    help="orbax checkpoint dir for the draft's weights")
     p.add_argument("--speculative-k", type=int, default=4,
                    help="draft block length per round")
+    p.add_argument("--spec-depth", default="",
+                   help="draft-depth policy (needs the draft flags): "
+                        "'fixed:K' pins depth K bitwise (same as "
+                        "--speculative-k K); 'adaptive' precompiles "
+                        "depth buckets {0,2,4,8} and a controller "
+                        "picks per round from measured acceptance "
+                        "(deepen when high, back off to plain decode "
+                        "on collapse, hysteresis against thrash); "
+                        "'adaptive:K1,K2,...' sets the bucket list. "
+                        "TTD_NO_ADAPTIVE_SPEC=1 is the no-redeploy "
+                        "kill switch back to the fixed depth")
     p.add_argument("--dispatch", default="", choices=["", "dense", "gmm"],
                    help="MoE expert-dispatch override (MoE configs "
                         "only). 'gmm' is DROPLESS: routing — and "
@@ -142,13 +182,25 @@ def add_engine_args(p) -> None:
                         "Prefix sharing is block-granular, so shared "
                         "system prompts win most when their length is "
                         "a multiple of this")
-    p.add_argument("--kv-pool-blocks", type=int, default=None,
+    p.add_argument("--kv-pool-blocks", type=_int_or_auto, default=None,
                    help="paged KV cache: total physical blocks in the "
                         "pool (default: slots * ceil(cache_len / "
                         "block_size) — the linear cache's exact "
                         "memory). Admission is keyed on free blocks: "
                         "shrink to trade memory for queueing, grow to "
-                        "serve more/longer shared prefixes warm")
+                        "serve more/longer shared prefixes warm. "
+                        "'auto' solves the pool size AND "
+                        "--hbm-budget-bytes exactly from the device's "
+                        "reported memory (pool rows + prefill "
+                        "transients + draft pools + --hbm-headroom), "
+                        "so one binary lands correctly sized on any "
+                        "chip; TTD_NO_HBM_AUTOSIZE=1 restores the "
+                        "default heuristic")
+    p.add_argument("--hbm-headroom", type=float, default=0.1,
+                   help="fraction of device HBM the autosize solve "
+                        "leaves free (weights, activations, XLA "
+                        "scratch live outside the solved pools); only "
+                        "meaningful with --kv-pool-blocks auto")
     p.add_argument("--no-paged-kv", action="store_true",
                    help="serve on the per-slot LINEAR KV cache instead "
                         "of the paged block pool (no cross-request "
@@ -254,6 +306,12 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             draft_params, draft_quant_scales = quantize_params(
                 draft_params)
 
+    spec_k, spec_depths = parse_spec_depth_arg(
+        getattr(args, "spec_depth", "") or "",
+        getattr(args, "speculative_k", 4))
+    if spec_depths is not None and draft_cfg is None:
+        raise SystemExit("--spec-depth adaptive needs "
+                         "--speculative-draft-config")
     try:
         eng = ServingEngine(
             cfg, params, slots=args.slots, chunk=args.chunk,
@@ -262,8 +320,9 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             top_p=args.top_p, quant_scales=quant_scales,
             draft_config=draft_cfg, draft_params=draft_params,
             draft_quant_scales=draft_quant_scales,
-            speculative_k=(args.speculative_k
-                           if draft_cfg is not None else 0),
+            speculative_k=(spec_k if draft_cfg is not None else 0),
+            spec_depths=(spec_depths if draft_cfg is not None
+                         else None),
             overlap=not getattr(args, "no_overlap", False),
             prefill_chunk=getattr(args, "prefill_chunk", None),
             prefill_budget=(0 if getattr(args, "no_interleave", False)
@@ -271,7 +330,8 @@ def build_engine(args, cfg, is_moe, prefix_ids):
             paged=not getattr(args, "no_paged_kv", False),
             kv_block_size=getattr(args, "kv_block_size", 16),
             kv_pool_blocks=getattr(args, "kv_pool_blocks", None),
-            hbm_budget_bytes=getattr(args, "hbm_budget_bytes", None))
+            hbm_budget_bytes=getattr(args, "hbm_budget_bytes", None),
+            hbm_headroom=getattr(args, "hbm_headroom", 0.1))
         if prefix_ids:
             eng.preload_prefix(prefix_ids)
     except ValueError as e:

@@ -107,6 +107,38 @@ def kv_cache_summary(evs: list) -> dict:
     return out if seen else {}
 
 
+def spec_depth_summary(evs: list) -> dict:
+    """Speculative-depth timeline from the ``decode/dispatch`` spans'
+    ``spec_k`` arg (the depth the engine chose for that round — the
+    adaptive controller's decisions, or the constant ``--speculative-k``
+    on a fixed engine).  Returns ``{}`` when no dispatch span carries
+    ``spec_k`` (pre-adaptive trace).  ``segments`` collapses consecutive
+    same-depth rounds into ``(start_ms_rel, depth, rounds)`` rows, so an
+    oscillating controller is visible as a long segment list even when
+    the per-depth totals look calm."""
+    rounds = {}
+    segments = []
+    t0 = None
+    for e in evs:
+        if e.get("ph") != "X" or e.get("name") != "decode/dispatch":
+            continue
+        args = e.get("args") or {}
+        if "spec_k" not in args:
+            continue
+        k = args["spec_k"]
+        if t0 is None:
+            t0 = e["ts"]
+        rounds[k] = rounds.get(k, 0) + 1
+        if segments and segments[-1][1] == k:
+            segments[-1][2] += 1
+        else:
+            segments.append([(e["ts"] - t0) / 1e3, k, 1])
+    if not rounds:
+        return {}
+    return {"rounds": rounds, "segments": segments,
+            "switches": len(segments) - 1}
+
+
 def migration_summary(evs: list) -> dict:
     """Live-migration economics from the pool's flight-recorder
     instants: every ``request/migrate`` hop (who moved where, at which
@@ -380,6 +412,17 @@ def main(argv=None) -> int:
         print(f"  fused-attn dispatches {kv['fused_attn_dispatches']}"
               f"  (decode chunks through ops.pallas_kernels."
               f"paged_attention)")
+
+    spec = spec_depth_summary(evs)
+    if spec:
+        print("\n== speculative depth (spec_k on decode/dispatch)")
+        by_depth = " ".join(f"k={k}:{n}" for k, n
+                            in sorted(spec["rounds"].items()))
+        print(f"  rounds by depth    {by_depth}")
+        print(f"  depth switches     {spec['switches']}")
+        print(f"  {'start_ms':>10}  {'depth':>5}  {'rounds':>6}")
+        for start, k, n in spec["segments"]:
+            print(f"  {start:10.3f}  {k:5d}  {n:6d}")
 
     mig = migration_summary(evs)
     if mig:
