@@ -16,6 +16,11 @@ default and designed to stay on in production:
 - ``TTD_NO_TRACE=1`` is the kill switch: ``span()`` degrades to a
   shared no-op context manager and ``instant()`` to one dict lookup —
   an env flip, no redeploy (the ``TTD_NO_OVERLAP`` contract).
+- ``TTD_TRACE_SPOOL=<dir>`` (off by default) adds the crash-durable
+  layer: a flusher thread mirrors the ring into size-capped rotating
+  JSONL segments (``TTD_TRACE_SPOOL_BYTES``, default 64 MiB/process),
+  fsync-batched off the hot path, so the last seconds before a SIGKILL
+  survive for ``tools/trace_report.py --post-mortem``.
 
 Event model (exported as Chrome trace-event JSON, loadable in Perfetto
 or ``chrome://tracing``):
@@ -56,11 +61,42 @@ from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     concurrency_guarded,
+    locks_held,
+    thread_role,
 )
 
 _KILL_ENV = "TTD_NO_TRACE"
 _CAPACITY_ENV = "TTD_TRACE_CAPACITY"
 DEFAULT_CAPACITY = 65536
+
+# -- crash-durable spool knobs --------------------------------------------
+# ``TTD_TRACE_SPOOL=<dir>`` arms a per-process rotating JSONL spool: a
+# flusher thread drains the ring through ``events_after`` every
+# ``_SPOOL_FLUSH_S`` (write+flush per batch, fsync on the
+# ``_SPOOL_FSYNC_S`` clock), so the recording hot path stays a
+# deque.append and the disk sees the timeline at most one
+# flush interval behind the crash.  Off by default — the ring alone is
+# the production default; the spool is the post-mortem opt-in.
+_SPOOL_ENV = "TTD_TRACE_SPOOL"
+_SPOOL_BYTES_ENV = "TTD_TRACE_SPOOL_BYTES"
+DEFAULT_SPOOL_BYTES = 64 << 20
+_SPOOL_FLUSH_S = 0.25
+#: Segments rotate at cap/4 (floor 1 MiB) and the oldest own segment is
+#: unlinked once the per-process total would exceed the cap — disk use
+#: is O(cap) forever, like the ring is O(capacity).
+_SPOOL_MIN_SEGMENT = 1 << 20
+#: Events per ``{"b": [...]}`` spool line: large enough that the batch
+#: json.dumps amortizes (one C-level call per ~512 events, not one per
+#: event), small enough that a line stays ~100 KiB and segment caps
+#: are enforced at line granularity.
+_SPOOL_BATCH_EVENTS = 512
+#: fsync cadence.  Every batch is write()+flush()ed — that alone
+#: survives PROCESS death (the post-mortem case: the kernel still owns
+#: the pages when a worker is SIGKILLed); fsync only adds machine-
+#: death durability and costs milliseconds on ext4, so it runs on a
+#: clock instead of per batch.  Rotation and the final drain/SIGTERM
+#: flush always fsync.
+_SPOOL_FSYNC_S = 2.0
 
 # Event tuple layout (kept flat — one small allocation per event):
 # (name, ph, t0_monotonic_s, dur_s, tid, attrs_dict_or_None)
@@ -174,7 +210,10 @@ class Recorder:
 
     # Every thread role appends; every access locks (ttd-lint's
     # concurrency checker + TTD_LOCKCHECK=1 enforce it stays so).
-    _GUARDED_BY = {"_buf": ("_lock",), "_seq": ("_lock",)}
+    # The spool state dict is shared by the flusher thread and any
+    # thread calling flush_spool()/stop_spool() (worker drain, tests).
+    _GUARDED_BY = {"_buf": ("_lock",), "_seq": ("_lock",),
+                   "_spool": ("_spool_lock",)}
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         if capacity < 1:
@@ -194,6 +233,20 @@ class Recorder:
         # time (e.g. against a supervisor journal's ``time.time()``).
         self._anchor_mono = time.monotonic()
         self._anchor_wall = time.time()
+        # Crash-durable spool (None until armed).  Auto-arms when
+        # ``TTD_TRACE_SPOOL`` names a directory: subprocess workers
+        # inherit the env, so one flag spools the whole fleet — each
+        # process into its own pid-named segments.
+        self._spool: Optional[dict] = None
+        self._spool_lock = threading.Lock()
+        self._spool_stop = threading.Event()
+        if os.environ.get(_SPOOL_ENV, ""):
+            try:
+                self.start_spool()
+            except OSError:
+                # An unwritable spool dir must not take the process —
+                # the ring (the production surface) still works.
+                pass
 
     @property
     def enabled(self) -> bool:
@@ -282,7 +335,15 @@ class Recorder:
             if fresh <= 0:
                 return seq, []
             n = len(self._buf)
-            items = list(self._buf)[max(0, n - fresh):]
+            if fresh >= n:
+                items = list(self._buf)
+            else:
+                # O(tail) copy, not O(capacity): the stats loop and
+                # the spool flusher each poll a few times a second,
+                # and list(deque) walks the whole ring every poll.
+                rev = reversed(self._buf)
+                items = [next(rev) for _ in range(fresh)]
+                items.reverse()
         return seq, items
 
     def request_timeline(self, request_id: int) -> list:
@@ -396,6 +457,194 @@ class Recorder:
     def save(self, path: str, last_s: Optional[float] = None) -> None:
         with open(path, "w") as f:
             json.dump(self.export_chrome_trace(last_s), f)
+
+    # -- crash-durable spool ---------------------------------------------
+    #
+    # The ring answers "what happened" only while the process is alive
+    # to be asked.  The spool is the same timeline made to survive the
+    # asker: ``spool-<pid>-<n>.jsonl`` segments, each opened with a
+    # header line carrying the pid and the wall/monotonic anchor pair
+    # (so offline tooling can place a dead process's monotonic
+    # timestamps in real time), then one compact ``{"b": [...]}``
+    # batch line per flush — event arrays in ring-tuple order.  A
+    # flusher thread drains ``events_after`` every flush interval
+    # (write+flush per batch, fsync on a clock — see
+    # ``_SPOOL_FSYNC_S``); if the ring laps the flusher, an honest
+    # ``{"dropped": n}`` line marks the gap.
+    # ``tools/trace_report.py --post-mortem`` is the consumer.
+
+    def start_spool(self, directory: Optional[str] = None) -> Optional[str]:
+        """Arm the crash-durable spool into ``directory`` (default: the
+        ``TTD_TRACE_SPOOL`` env var; no-op returning None when unset).
+        Idempotent — a second call returns the armed directory."""
+        directory = directory or os.environ.get(_SPOOL_ENV, "")
+        if not directory:
+            return None
+        with self._spool_lock:
+            if self._spool is not None:
+                return self._spool["dir"]
+            os.makedirs(directory, exist_ok=True)
+            raw = os.environ.get(_SPOOL_BYTES_ENV, "")
+            cap = int(raw) if raw else DEFAULT_SPOOL_BYTES
+            self._spool = {
+                "dir": directory,
+                "cap": max(cap, 2 * _SPOOL_MIN_SEGMENT),
+                "seg_cap": max(cap // 4, _SPOOL_MIN_SEGMENT),
+                "cursor": 0,      # events_after sequence already spooled
+                "seg": 0,
+                "fh": None,
+                "path": "",
+                "written": 0,     # bytes in the open segment
+                "segments": [],   # [(path, bytes)] closed, oldest first
+                "dropped": 0,
+                "last_fsync": time.monotonic(),
+            }
+            self._spool_open_segment()
+        self._spool_stop.clear()
+        t = threading.Thread(target=self._spool_loop, name="trace-spool",
+                             daemon=True)
+        t.start()
+        return directory
+
+    @locks_held("_spool_lock")
+    def _spool_open_segment(self) -> None:
+        """Rotate to a fresh segment, then unlink our own oldest closed
+        segments until the per-process total fits the byte cap."""
+        st = self._spool
+        fh = st["fh"]
+        if fh is not None:
+            try:
+                fh.flush()
+                os.fsync(fh.fileno())
+                st["last_fsync"] = time.monotonic()
+                fh.close()
+            except OSError:
+                pass
+            st["segments"].append((st["path"], st["written"]))
+        st["seg"] += 1
+        path = os.path.join(
+            st["dir"], f"spool-{self.pid}-{st['seg']:04d}.jsonl")
+        fh = open(path, "wb")
+        header = json.dumps({
+            "spool": 1,
+            "pid": self.pid,
+            "segment": st["seg"],
+            "capacity": self.capacity,
+            "wall_anchor_s": self._anchor_wall,
+            "mono_anchor_s": self._anchor_mono,
+            "open_wall_s": time.time(),
+            "open_mono_s": time.monotonic(),
+        }, separators=(",", ":")).encode() + b"\n"
+        fh.write(header)
+        st["fh"], st["path"], st["written"] = fh, path, len(header)
+        total = st["written"] + sum(b for _, b in st["segments"])
+        while st["segments"] and total > st["cap"]:
+            old_path, old_bytes = st["segments"].pop(0)
+            try:
+                os.unlink(old_path)
+            except OSError:
+                pass
+            total -= old_bytes
+
+    @locks_held("_spool_lock")
+    def _spool_flush_once(self, force_fsync: bool = False) -> int:
+        """Drain the ring's new tail to disk (write+flush per batch,
+        fsync on the ``_SPOOL_FSYNC_S`` clock or when forced); returns
+        the number of events written.  An OSError (full disk, revoked
+        dir) disables the spool but must never take the process — the
+        ring keeps working."""
+        st = self._spool
+        if st is None or st["fh"] is None:
+            return 0
+        cursor, evs = self.events_after(st["cursor"])
+        fresh = cursor - st["cursor"]
+        st["cursor"] = cursor
+        if fresh <= 0:
+            return 0
+        chunks = []
+        if fresh > len(evs):
+            st["dropped"] += fresh - len(evs)
+            chunks.append(json.dumps(
+                {"dropped": fresh - len(evs),
+                 "mono_s": round(time.monotonic(), 6)},
+                separators=(",", ":")).encode() + b"\n")
+        # One dumps call per ``{"b": [[...], ...]}`` batch line,
+        # straight from the ring tuples: per-event dumps costs ~7 µs
+        # an event and the flusher shares a core (and a GIL) with the
+        # serving threads it is observing — on a small host that read
+        # as tok/s overhead in the --trace-fleet-ab bench.  Batches
+        # are sliced so one line stays line-sized and the segment cap
+        # is enforced between slices, not after a megabyte write.  A
+        # torn tail line loses at most one slice of one flush window
+        # (~0.25 s) — the window an unflushed ring loses anyway.
+        for lo in range(0, len(evs), _SPOOL_BATCH_EVENTS):
+            chunks.append(json.dumps(
+                {"b": evs[lo:lo + _SPOOL_BATCH_EVENTS]},
+                separators=(",", ":"), default=str).encode() + b"\n")
+        try:
+            for data in chunks:
+                if st["written"] >= st["seg_cap"]:
+                    self._spool_open_segment()
+                st["fh"].write(data)
+                st["written"] += len(data)
+            st["fh"].flush()
+            now = time.monotonic()
+            if force_fsync or now - st["last_fsync"] >= _SPOOL_FSYNC_S:
+                os.fsync(st["fh"].fileno())
+                st["last_fsync"] = now
+        except OSError:
+            try:
+                st["fh"].close()
+            except OSError:
+                pass
+            st["fh"] = None
+        return len(evs)
+
+    @thread_role("watchdog")
+    def _spool_loop(self) -> None:
+        while not self._spool_stop.wait(_SPOOL_FLUSH_S):
+            with self._spool_lock:
+                if self._spool is None or self._spool["fh"] is None:
+                    return
+                self._spool_flush_once()
+
+    def flush_spool(self) -> int:
+        """Synchronously drain the ring to the spool and fsync — the
+        worker's final-flush hook on drain/SIGTERM, and the test seam.
+        Returns events written (0 when the spool is not armed)."""
+        with self._spool_lock:
+            return self._spool_flush_once(force_fsync=True)
+
+    def stop_spool(self) -> None:
+        """Final flush, close the open segment, disarm."""
+        self._spool_stop.set()
+        with self._spool_lock:
+            self._spool_flush_once(force_fsync=True)
+            st = self._spool
+            if st is not None and st["fh"] is not None:
+                try:
+                    st["fh"].flush()
+                    os.fsync(st["fh"].fileno())
+                    st["fh"].close()
+                except OSError:
+                    pass
+                st["fh"] = None
+            self._spool = None
+
+    def spool_info(self) -> Optional[dict]:
+        """Armed-spool status for health surfaces (None when off)."""
+        with self._spool_lock:
+            st = self._spool
+            if st is None:
+                return None
+            return {
+                "dir": st["dir"],
+                "segment": st["seg"],
+                "written_bytes": st["written"],
+                "segments": len(st["segments"]) + 1,
+                "dropped": st["dropped"],
+                "active": st["fh"] is not None,
+            }
 
 
 # -- process-global recorder ---------------------------------------------

@@ -62,7 +62,9 @@ import inspect
 import itertools
 import os
 import threading
+import time
 import weakref
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -141,6 +143,24 @@ _COMPILES = 0
 _TOKENS = itertools.count(1)
 _TREE_UTIL = None               # lazy jax.tree_util (keep import light)
 
+# -- roofline telemetry ----------------------------------------------------
+# Per-site cost ledger the live mfu/mbu gauges read: at each COMPILING
+# dispatch the wrapper captures XLA's cost analysis (flops, bytes
+# accessed) for the new signature; every dispatch then adds its
+# signature's cost to the site's running totals and a bounded
+# (t, flops, bytes) window the scrape-time rate is computed over.
+# Updates are GIL-atomic dict/deque ops with no lock — a lost increment
+# under contention costs a gauge tick, never correctness — and happen
+# only when the sanitizer is armed (unarmed, the gauges truthfully
+# render no series, the ttd_engine_compiles_total contract).
+_COST_WINDOW_S = 10.0
+_PROGRAMS: Dict[str, dict] = {}
+# site -> {"dispatches": int, "flops": float, "bytes": float,
+#          "costs": {sig: (flops, bytes)}, "win": deque[(t, f, b)]}
+
+_PEAK_FLOPS_ENV = "TTD_PEAK_FLOPS"
+_PEAK_HBM_ENV = "TTD_PEAK_HBM_BYTES"
+
 
 def register_site(spec: SiteSpec) -> SiteSpec:
     with _STATE_LOCK:
@@ -175,10 +195,12 @@ def reset(site: Optional[str] = None) -> None:
     with _STATE_LOCK:
         if site is None:
             _GROUPS.clear()
+            _PROGRAMS.clear()
             _COMPILES = 0
         else:
             for key in [k for k in _GROUPS if k[0] == site]:
                 del _GROUPS[key]
+            _PROGRAMS.pop(site, None)
 
 
 @contextlib.contextmanager
@@ -360,6 +382,133 @@ def _observe(site: str, spec: SiteSpec, skey, sig) -> Optional[int]:
     return n
 
 
+# -- roofline bookkeeping --------------------------------------------------
+
+
+def _program(site: str) -> dict:
+    p = _PROGRAMS.get(site)
+    if p is None:
+        p = _PROGRAMS.setdefault(site, {
+            "dispatches": 0, "flops": 0.0, "bytes": 0.0,
+            "costs": {}, "win": deque(maxlen=8192)})
+    return p
+
+
+def _capture_cost(site: str, sig, fn, args, kwargs) -> None:
+    """After a compiling dispatch: ask XLA what the program it just
+    built costs (flops, bytes accessed) and remember it per signature.
+    ``fn.lower(...).compile()`` hits the executable cache the dispatch
+    populated, so the only real work is the trace — per NEW signature,
+    never per dispatch.  Anything at all going wrong records a zero
+    cost: the roofline is telemetry, a cost model must never take a
+    dispatch down."""
+    flops = nbytes = 0.0
+    try:
+        lowered = fn.lower(*args, **kwargs)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 — stubs/CPU backends may refuse
+        pass
+    _program(site)["costs"][sig] = (flops, nbytes)
+
+
+def _count_dispatch(site: str, sig) -> None:
+    p = _program(site)
+    f, b = p["costs"].get(sig) or (0.0, 0.0)
+    p["dispatches"] += 1
+    p["flops"] += f
+    p["bytes"] += b
+    p["win"].append((time.monotonic(), f, b))
+
+
+def program_stats() -> Dict[str, dict]:
+    """Per-site roofline counters: cumulative dispatch/flop/byte
+    totals plus flops_per_s / bytes_per_s over the trailing
+    ``_COST_WINDOW_S`` window — the numerators the mfu/mbu gauges (and
+    a worker's stats relay) consume.  Empty unless the sanitizer is
+    armed and an instrumented site has dispatched."""
+    now = time.monotonic()
+    cutoff = now - _COST_WINDOW_S
+    out: Dict[str, dict] = {}
+    for site, p in list(_PROGRAMS.items()):
+        wf = wb = 0.0
+        for t, f, b in list(p["win"]):
+            if t >= cutoff:
+                wf += f
+                wb += b
+        out[site] = {
+            "dispatches": p["dispatches"],
+            "flops_total": p["flops"],
+            "bytes_total": p["bytes"],
+            "flops_per_s": wf / _COST_WINDOW_S,
+            "bytes_per_s": wb / _COST_WINDOW_S,
+        }
+    return out
+
+
+def peak_flops_per_s() -> Optional[float]:
+    """The mfu denominator: ``TTD_PEAK_FLOPS`` when set (the CPU-test
+    and heterogeneous-fleet override), else the device's datasheet peak
+    from training.memory — None when unknown (gauges render no series
+    rather than a made-up percentage)."""
+    raw = os.environ.get(_PEAK_FLOPS_ENV, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+    try:        # lazy: scrape-time only, keeps this module import-light
+        import jax
+        from tensorflow_train_distributed_tpu.training import memory
+        tf = memory.peak_tflops(jax.devices()[0].device_kind)
+        return tf * 1e12 if tf else None
+    except Exception:  # noqa: BLE001 — no jax / no devices
+        return None
+
+
+def peak_hbm_bytes_per_s() -> Optional[float]:
+    """The mbu denominator: ``TTD_PEAK_HBM_BYTES`` (bytes/sec) when
+    set, else the device's datasheet HBM bandwidth — None when
+    unknown."""
+    raw = os.environ.get(_PEAK_HBM_ENV, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+    try:
+        import jax
+        from tensorflow_train_distributed_tpu.training import memory
+        return memory.hbm_bandwidth_bytes_per_sec(
+            jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def mfu_by_program() -> Dict[str, float]:
+    """``{site: achieved-flops %-of-peak}`` over the trailing window —
+    the ``ttd_engine_mfu_pct`` source.  Empty when the peak is unknown
+    or nothing dispatched."""
+    peak = peak_flops_per_s()
+    if not peak:
+        return {}
+    return {site: round(100.0 * s["flops_per_s"] / peak, 3)
+            for site, s in program_stats().items() if s["dispatches"]}
+
+
+def mbu_by_program() -> Dict[str, float]:
+    """``{site: achieved-HBM-bytes %-of-peak}`` over the trailing
+    window — the ``ttd_engine_mbu_pct`` source."""
+    peak = peak_hbm_bytes_per_s()
+    if not peak:
+        return {}
+    return {site: round(100.0 * s["bytes_per_s"] / peak, 3)
+            for site, s in program_stats().items() if s["dispatches"]}
+
+
 def _wrap(fn, spec: SiteSpec, group=None):
     """The armed wrapper: signature bookkeeping around every dispatch,
     a ``compile/<site>`` span around the compiling ones."""
@@ -382,17 +531,24 @@ def _wrap(fn, spec: SiteSpec, group=None):
         skey, sig = _signature(args, kwargs, static_pos, static_nm)
         if group_tok is not None:
             skey = (group_tok,) + skey
-        return _observe(site, spec, skey, sig)
+        return _observe(site, spec, skey, sig), sig
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if _vetoed():
             return fn(*args, **kwargs)
-        n = _observe_call(args, kwargs)
+        n, sig = _observe_call(args, kwargs)
         if n is None:
+            _count_dispatch(site, sig)
             return fn(*args, **kwargs)
         with events.span("compile/" + site, site=site, signature=n):
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+        # Roofline: price the program this dispatch just compiled,
+        # then count the dispatch at that price.
+        if hasattr(fn, "lower"):
+            _capture_cost(site, sig, fn, args, kwargs)
+        _count_dispatch(site, sig)
+        return out
 
     if hasattr(fn, "lower"):
         def lower(*args, **kwargs):
@@ -401,7 +557,7 @@ def _wrap(fn, spec: SiteSpec, group=None):
             here so the AOT proof and the live step share one site)."""
             if _vetoed():
                 return fn.lower(*args, **kwargs)
-            n = _observe_call(args, kwargs)
+            n, sig = _observe_call(args, kwargs)
             if n is None:
                 return fn.lower(*args, **kwargs)
             with events.span("compile/" + site, site=site, signature=n,

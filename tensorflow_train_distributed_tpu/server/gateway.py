@@ -48,6 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime import events
+from tensorflow_train_distributed_tpu.runtime.lint import compilecheck
 from tensorflow_train_distributed_tpu.runtime.lint.registry import thread_role
 from tensorflow_train_distributed_tpu.server.driver import (
     AdmissionFull,
@@ -222,8 +223,40 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(400, {
                     "error": "last_s must be a positive number"})
                 return
-        self._reply_json(
-            200, events.get_recorder().export_chrome_trace(last_s))
+        doc = events.get_recorder().export_chrome_trace(last_s)
+        gw = self.gateway
+        other = doc["otherData"]
+        # Fleet metadata: this trace is already fleet-JOINED (worker
+        # rings relay through stats frames and land here offset-
+        # corrected, tagged replica= and clock_conf_s=) — attach the
+        # per-replica states + clock-sync quality so offline tooling
+        # (trace_report --fleet) can annotate lanes without a second
+        # endpoint round-trip.
+        if gw.pool is not None:
+            other["fleet"] = gw.pool.replica_states()
+        # Live roofline snapshot (empty unless TTD_COMPILECHECK armed
+        # the dispatch wrappers): per-program dispatch/flop/byte rates
+        # plus %-of-peak when the device peak is known — the
+        # trace_report roofline table's source.
+        if gw.pool is not None:
+            programs = gw.pool.programs_by_site()
+            mfu = gw.pool.mfu_by_program()
+            mbu = gw.pool.mbu_by_program()
+        else:
+            programs = compilecheck.program_stats()
+            mfu = compilecheck.mfu_by_program()
+            mbu = compilecheck.mbu_by_program()
+        if programs:
+            for prog, stats in programs.items():
+                if prog in mfu:
+                    stats["mfu_pct"] = mfu[prog]
+                if prog in mbu:
+                    stats["mbu_pct"] = mbu[prog]
+            other["roofline"] = programs
+        spool = events.get_recorder().spool_info()
+        if spool is not None:
+            other["spool"] = spool
+        self._reply_json(200, doc)
 
     def _request_timeline(self, tail: str) -> None:
         """One request's recorded lifecycle + terminal status."""
@@ -477,7 +510,9 @@ class ServingGateway:
                 spec_depth_fn=self.pool.spec_depth,
                 spec_accepted_fn=self.pool.spec_accepted_tokens,
                 spec_drafted_fn=self.pool.spec_drafted_tokens,
-                hbm_autosized_fn=self.pool.hbm_autosized_bytes)
+                hbm_autosized_fn=self.pool.hbm_autosized_bytes,
+                mfu_fn=self.pool.mfu_by_program,
+                mbu_fn=self.pool.mbu_by_program)
         else:
             one = [self.engine]
             self.metrics = GatewayMetrics(

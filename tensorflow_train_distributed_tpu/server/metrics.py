@@ -294,7 +294,9 @@ class GatewayMetrics:
                  spec_accepted_fn: Optional[Callable[[], int]] = None,
                  spec_drafted_fn: Optional[Callable[[], int]] = None,
                  hbm_autosized_fn: Optional[
-                     Callable[[], int]] = None):
+                     Callable[[], int]] = None,
+                 mfu_fn: Optional[Callable[[], dict]] = None,
+                 mbu_fn: Optional[Callable[[], dict]] = None):
         self.registry = Registry()
         r = self.registry
         self.requests = r.counter(
@@ -522,6 +524,31 @@ class GatewayMetrics:
             "XLA compilations observed at instrumented jit sites "
             "(0 unless TTD_COMPILECHECK=1 arms the sanitizer).",
             fn=compilecheck.total_compiles)
+        # Live roofline per instrumented program: XLA's cost analysis
+        # (captured once per compiled signature) times the dispatch
+        # rate, against the device's datasheet peaks — the always-on
+        # version of the bench harness's decode_mbu_fields.  Labeled by
+        # jit site (under a replica pool, "<replica>/<site>" from each
+        # worker's relayed program stats).  No series unless
+        # TTD_COMPILECHECK=1 armed the dispatch wrapper AND a peak is
+        # known (datasheet TPU entry, or the TTD_PEAK_FLOPS /
+        # TTD_PEAK_HBM_BYTES overrides) — never a made-up percentage.
+        self.mfu_pct = r.labeled_gauge(
+            "ttd_engine_mfu_pct",
+            "Achieved model flops as % of device peak, per "
+            "instrumented jit program over a trailing window (no "
+            "series unless TTD_COMPILECHECK=1 and the peak is known).",
+            "program",
+            fn=(mfu_fn if mfu_fn is not None
+                else compilecheck.mfu_by_program))
+        self.mbu_pct = r.labeled_gauge(
+            "ttd_engine_mbu_pct",
+            "Achieved HBM bytes as % of device peak bandwidth, per "
+            "instrumented jit program over a trailing window (no "
+            "series unless TTD_COMPILECHECK=1 and the peak is known).",
+            "program",
+            fn=(mbu_fn if mbu_fn is not None
+                else compilecheck.mbu_by_program))
         # The queue-depth gauge's latency companion: how long admission
         # actually COSTS (admission → engine slot granted), observed by
         # the driver when engine.submit succeeds — queue depth alone

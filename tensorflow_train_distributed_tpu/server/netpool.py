@@ -148,6 +148,7 @@ class NetDriver(ProcDriver):
             logger.warning("net worker %s (%s) disconnected without "
                            "BYE", self._replica_id, self._addr)
         self._fail_handoffs()
+        self._corpse_snapshot(None)
         events.instant("replica/worker_eof", replica=self._replica_id,
                        addr=self._addr, drained=self._drained)
 

@@ -48,7 +48,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime import events
@@ -149,6 +149,7 @@ class RemoteEngine:
     _GUARDED_BY = {
         "_gauges": ("_lock",),
         "_hbm": ("_lock",),
+        "_programs": ("_lock",),
         "_rss": ("_lock",),
         "slots": (None, "reader", "main"),
         "kv_block_size": (None, "reader", "main"),
@@ -179,6 +180,7 @@ class RemoteEngine:
         self._lock = threading.Lock()
         self._gauges: dict = {}
         self._hbm: dict = {}
+        self._programs: dict = {}
         self._rss = 0
 
     @thread_role("reader")
@@ -202,6 +204,7 @@ class RemoteEngine:
         with self._lock:
             self._gauges = dict(body.get("gauges") or {})
             self._hbm = dict(body.get("hbm") or {})
+            self._programs = dict(body.get("programs") or {})
             self._rss = int(body.get("rss") or 0)
 
     def _g(self, name: str) -> float:
@@ -237,6 +240,15 @@ class RemoteEngine:
         ``ttd_engine_hbm_bytes`` gauge family."""
         with self._lock:
             return dict(self._hbm)
+
+    def program_stats(self) -> dict:
+        """The worker's roofline ledger from its latest stats frame
+        (``{site: {dispatches, flops_per_s, bytes_per_s, ...}}``;
+        empty unless the worker armed TTD_COMPILECHECK) — the
+        per-worker half of the ``ttd_engine_mfu_pct`` /
+        ``ttd_engine_mbu_pct`` gauge families."""
+        with self._lock:
+            return dict(self._programs)
 
     def overlap_ratio(self) -> float:
         return self._g("overlap_ratio")
@@ -291,6 +303,89 @@ class RemoteEngine:
         return prompt
 
 
+def clock_sync_killed() -> bool:
+    """``TTD_NO_CLOCK_SYNC=1`` disables the PING/PONG clock-sync
+    estimator: no PINGs are sent and relayed event timestamps keep the
+    HELLO's one-way offset guess — byte-for-byte the pre-sync
+    behavior (re-read per stats frame, an env flip suffices)."""
+    return os.environ.get("TTD_NO_CLOCK_SYNC", "0") not in ("", "0")
+
+
+class ClockSync:
+    """NTP-style monotonic-offset estimator over PING/PONG frames.
+
+    Monotonic clocks do not cross processes, and the HELLO's one-way
+    guess (``parent_now - worker_mono``) silently absorbs the FULL
+    transport + engine-build latency — microseconds over a socketpair,
+    but real milliseconds over TCP dial-in, enough to render negative
+    hop latencies in a fleet-joined timeline.  The classic two-stamp
+    exchange bounds the error instead: the parent stamps ``t0`` into a
+    PING, the worker echoes it back with its own ``mono`` (= t1), and
+    at receipt (``t3``) the parent has ``rtt = t3 - t0`` and the
+    midpoint estimate ``offset = (t0 + t3)/2 - t1`` whose error is at
+    most ``rtt/2`` regardless of clock skew (asymmetric transport
+    legs shift it by ``|d_up - d_down|/2``, still inside the bound).
+
+    Pure arithmetic, no I/O, no threads: one instance lives on each
+    driver and is touched ONLY by its reader thread (ping on every
+    STATS heartbeat, fold on every PONG).  Acceptance is min-RTT — a
+    congested sample never replaces a crisper one — with a drift
+    window: after ``DRIFT_WINDOW_S`` the next in-bound sample wins
+    even at a worse RTT, so slow clock drift between host crystals is
+    re-estimated instead of frozen at the best sample ever seen.
+    """
+
+    #: Replace the held sample after this long even at a worse RTT
+    #: (clocks drift ~ppm: a minute-old perfect sample can be further
+    #: from the truth than a fresh mediocre one).
+    DRIFT_WINDOW_S = 30.0
+
+    #: Samples slower than this are congestion noise, not clock data.
+    MAX_RTT_S = 5.0
+
+    __slots__ = ("offset", "rtt", "samples", "_accepted_at",
+                 "_next_id")
+
+    def __init__(self):
+        self.offset: Optional[float] = None   # worker mono -> parent
+        self.rtt: Optional[float] = None      # of the accepted sample
+        self.samples = 0                      # PONGs folded in
+        self._accepted_at: Optional[float] = None
+        self._next_id = 0
+
+    def ping(self, now: float) -> dict:
+        """Mint one PING payload (the parent's send stamp rides it —
+        the exchange is stateless, no pending table to leak)."""
+        self._next_id += 1
+        return {"id": self._next_id, "t": now}
+
+    def pong(self, body: dict, now: float) -> bool:
+        """Fold one PONG into the estimate; True iff the held sample
+        changed (the caller republishes the driver's offset)."""
+        try:
+            t0 = float(body["t"])
+            t1 = float(body["mono"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        rtt = now - t0
+        if rtt < 0.0 or rtt > self.MAX_RTT_S:
+            return False        # garbled echo or congestion outlier
+        self.samples += 1
+        stale = (self._accepted_at is not None
+                 and now - self._accepted_at >= self.DRIFT_WINDOW_S)
+        if self.rtt is not None and rtt > self.rtt and not stale:
+            return False        # min-RTT filter: keep the crisper one
+        self.offset = (t0 + now) / 2.0 - t1
+        self.rtt = rtt
+        self._accepted_at = now
+        return True
+
+    def confidence_s(self) -> Optional[float]:
+        """Worst-case error bound of the held offset (``rtt/2``), or
+        None before the first accepted sample."""
+        return self.rtt / 2.0 if self.rtt is not None else None
+
+
 class _ProcRequest:
     """Parent-side record of one live request on a worker."""
 
@@ -333,7 +428,9 @@ class ProcDriver:
     # submitters and the reader thread — every access locks.
     # Deliberately NOT declared (single-writer atomic publishes with
     # read-only consumers, the EngineDriver idiom): _failed, _vanished,
-    # _drained, _poisoned, _returncode, _stats, _stats_rx, _mono_offset.
+    # _drained, _poisoned, _returncode, _stats, _stats_rx,
+    # _mono_offset, _sync_rtt_s (reader-thread publishes; _clock's
+    # internals are reader-private, never read elsewhere).
     _GUARDED_BY = {
         "_recs": ("_lock",),
         "_terminal": ("_lock",),
@@ -370,6 +467,11 @@ class ProcDriver:
         self._sender: Optional[proto.FrameSender] = None
         self._ready = threading.Event()
         self._mono_offset: Optional[float] = None
+        # PING/PONG offset estimator (reader-thread-private state; the
+        # accepted offset/rtt are atomic-published into _mono_offset/
+        # _sync_rtt_s).  None rtt = still on the HELLO's one-way guess.
+        self._clock = ClockSync()
+        self._sync_rtt_s: Optional[float] = None
         # Latest stats frame (whole-dict atomic publish) + its arrival
         # time: the watchdog feed.  A wedged engine keeps heartbeating
         # a growing step_elapsed; a SIGKILLed worker stops entirely —
@@ -378,6 +480,11 @@ class ProcDriver:
                        "step_elapsed": 0.0, "in_step": False}
         self._stats_rx = time.monotonic()
         self._reader: Optional[threading.Thread] = None
+        # Reader-private relay accounting: how many worker events were
+        # folded into the parent ring and the last few of them — the
+        # corpse snapshot's "what was it doing when it died".
+        self._relay_count = 0
+        self._relay_tail: deque = deque(maxlen=128)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -541,6 +648,12 @@ class ProcDriver:
             self._fail_handoffs()
         elif ftype == proto.BYE:
             self._drained = True
+        elif ftype == proto.PONG:
+            # Clock sync: fold the echo into the min-RTT estimate and
+            # republish the offset relayed events are corrected by.
+            if self._clock.pong(body, time.monotonic()):
+                self._mono_offset = self._clock.offset
+                self._sync_rtt_s = self._clock.rtt
         # Unknown frame types are ignored (forward compatibility).
 
     def _retire(self, rid: int, status: str, error) -> None:
@@ -591,19 +704,92 @@ class ProcDriver:
             # (either side's drain flag settles it).
             self._failed = RuntimeError(
                 "worker's engine driver vanished (no corpse)")
+        # Clock sync rides the heartbeat: one PING per STATS frame, so
+        # the sampling cadence is the stats interval and no extra
+        # thread exists to manage.  The worker echoes from its own
+        # reader thread; the PONG resolves in _dispatch.
+        if not clock_sync_killed():
+            self._send(proto.PING, self._clock.ping(time.monotonic()))
         offset = self._mono_offset
         if offset is None:
             return
-        rec = events.get_recorder()
+        conf = self._sync_rtt_s
+        conf = round(conf / 2.0, 6) if conf is not None else None
         for ev in body.get("events") or ():
             try:
                 name, ph, t0, dur, attrs = ev
-                rec.record_at(str(name), str(ph), float(t0) + offset,
-                              float(dur), attrs if isinstance(
-                                  attrs, dict) else None)
+                attrs = dict(attrs) if isinstance(attrs, dict) else {}
+                # Fleet provenance: which worker's ring this event came
+                # from, and how trustworthy its corrected timestamp is
+                # (the offset's rtt/2 error bound; absent = still on
+                # the HELLO's one-way guess, trust accordingly).
+                if self._replica_id is not None:
+                    attrs.setdefault("replica", self._replica_id)
+                if conf is not None:
+                    attrs["clock_conf_s"] = conf
+                self._relay_event(str(name), str(ph),
+                                  float(t0) + offset, float(dur),
+                                  attrs or None)
             except (TypeError, ValueError):
                 continue          # one malformed event never kills the
                 #                   reader — frames were JSON-validated
+
+    def _relay_event(self, name: str, ph: str, t0: float, dur: float,
+                     attrs: Optional[dict]) -> None:
+        """One worker event into the parent ring, with a reader-private
+        tail kept for the corpse snapshot."""
+        events.get_recorder().record_at(name, ph, t0, dur, attrs)
+        self._relay_count += 1
+        self._relay_tail.append([name, ph, round(t0, 6),
+                                 round(dur, 6), attrs])
+
+    def clock_info(self) -> dict:
+        """The clock-sync state fleet-joined timelines annotate with:
+        the live offset, whether it came from PING/PONG sampling, and
+        the sample's error bound."""
+        d: dict = {"offset_s": self._mono_offset,
+                   "synced": self._sync_rtt_s is not None}
+        if self._sync_rtt_s is not None:
+            d["rtt_s"] = round(self._sync_rtt_s, 6)
+            d["conf_s"] = round(self._sync_rtt_s / 2.0, 6)
+        return d
+
+    def _corpse_snapshot(self, rc) -> None:
+        """When a worker vanishes and the trace spool is armed, write
+        what the parent last knew — pid, vanish classification, clock
+        offset, relay cursor, and the last relayed events (already
+        offset-corrected to THIS process's clock) — next to the spool
+        segments ``trace_report --post-mortem`` joins."""
+        spool_dir = os.environ.get("TTD_TRACE_SPOOL", "")
+        if not spool_dir:
+            return
+        snap = {
+            "corpse": 1,
+            "replica": self._replica_id,
+            "pid": self._engine.pid or (self._proc.pid if self._proc
+                                        else None),
+            "returncode": rc,
+            "reason": (self.vanish_reason() if self.vanished()
+                       else "drained" if self._drained else
+                       str(self._failed or "eof")),
+            "drained": self._drained,
+            "clock": self.clock_info(),
+            "events_relayed": self._relay_count,
+            "last_events": list(self._relay_tail),
+            "wall_s": time.time(),
+            "mono_s": time.monotonic(),
+        }
+        try:
+            os.makedirs(spool_dir, exist_ok=True)
+            path = os.path.join(
+                spool_dir, f"corpse-{self._replica_id}-{snap['pid']}"
+                           f"-{os.getpid()}.json")
+            with open(path, "w") as f:
+                json.dump(snap, f)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:          # a full disk must not take the
+            logger.warning("corpse snapshot failed: %s", e)  # reader
 
     def _on_eof(self) -> None:
         rc = None
@@ -625,6 +811,7 @@ class ProcDriver:
             logger.warning("worker %s (pid %s) vanished (rc=%s)",
                            self._replica_id, self._engine.pid, rc)
         self._fail_handoffs()
+        self._corpse_snapshot(rc)
         events.instant("replica/worker_exit",
                        replica=self._replica_id, returncode=rc,
                        drained=self._drained)
@@ -790,6 +977,8 @@ class ProcDriver:
         cls = self.failure_class()
         if cls is not None:
             d["failure_class"] = cls
+        if self._ready.is_set():
+            d["clock"] = self.clock_info()
         return d
 
     def step_elapsed(self) -> float:

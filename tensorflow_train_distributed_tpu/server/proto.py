@@ -64,6 +64,17 @@ Frame types (direction):
   that predates the frame ignores it (the exchange times out into the
   resume-from-token failover fallback — no migration is ever
   load-bearing for correctness).
+- ``PING``  parent → worker / ``PONG``  worker → parent: the NTP-style
+  clock-sync exchange.  A PING carries the parent's monotonic send
+  stamp ``t``; the worker echoes it back in a PONG together with its
+  own monotonic ``mono`` stamped at the reply.  The parent computes
+  ``rtt = t_recv - t`` and the midpoint offset estimate
+  ``(t + t_recv)/2 - mono`` whose error is bounded by ``rtt/2`` —
+  min-RTT samples replace the HELLO's one-way offset guess, which
+  silently absorbs the full transport latency.  Both frames are
+  stateless (the worker keeps nothing, the parent needs no pending
+  table) and OPTIONAL: a worker that predates them ignores PING, the
+  parent keeps the HELLO offset — no version bump.
 
 Everything here is pure framing — no sockets are owned, no threads
 are spawned: ``read_frame``/``write_frame`` work over any file-like
@@ -106,12 +117,14 @@ PREFILL = 10
 KV_HANDOFF = 11
 KV_ACK = 12
 MIGRATE = 13
+PING = 14
+PONG = 15
 
 FRAME_NAMES = {
     HELLO: "HELLO", SUBMIT: "SUBMIT", CHUNK: "CHUNK", RETIRE: "RETIRE",
     CANCEL: "CANCEL", DRAIN: "DRAIN", STATS: "STATS", BYE: "BYE",
     DIED: "DIED", PREFILL: "PREFILL", KV_HANDOFF: "KV_HANDOFF",
-    KV_ACK: "KV_ACK", MIGRATE: "MIGRATE",
+    KV_ACK: "KV_ACK", MIGRATE: "MIGRATE", PING: "PING", PONG: "PONG",
 }
 
 #: Frame types whose payload is ``type byte + 4-byte header length +

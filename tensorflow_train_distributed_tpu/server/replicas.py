@@ -575,6 +575,60 @@ class ReplicaPool:
             out = memcheck.live_by_pool()
         return out
 
+    def programs_by_site(self) -> dict:
+        """Fleet roofline numerators: each worker's relayed program
+        stats keyed ``<replica>/<site>`` (subprocess/TCP facades), or
+        this process's own compilecheck ledger for in-process replicas
+        — same shape as ``hbm_by_pool``, consumed by
+        ``mfu_by_program``/``mbu_by_program`` below."""
+        out: dict = {}
+        remote = False
+        for rep in self._replicas:
+            fn = getattr(rep.engine, "program_stats", None)
+            if fn is None or not rep.usable():
+                continue
+            remote = True
+            for site, stats in fn().items():
+                out[f"{rep.idx}/{site}"] = dict(stats)
+        if not remote:
+            from tensorflow_train_distributed_tpu.runtime.lint import (
+                compilecheck,
+            )
+
+            out = compilecheck.program_stats()
+        return out
+
+    def mfu_by_program(self) -> dict:
+        """Fleet ``ttd_engine_mfu_pct`` source: every replica's
+        achieved flop rate against THIS host's device peak (homogeneous
+        fleets; heterogeneous ones pin TTD_PEAK_FLOPS).  Empty when the
+        peak is unknown — no made-up percentages."""
+        from tensorflow_train_distributed_tpu.runtime.lint import (
+            compilecheck,
+        )
+
+        peak = compilecheck.peak_flops_per_s()
+        if not peak:
+            return {}
+        return {prog: round(100.0 * float(s.get("flops_per_s", 0.0))
+                            / peak, 3)
+                for prog, s in self.programs_by_site().items()
+                if s.get("dispatches")}
+
+    def mbu_by_program(self) -> dict:
+        """Fleet ``ttd_engine_mbu_pct`` source (see mfu_by_program)."""
+        from tensorflow_train_distributed_tpu.runtime.lint import (
+            compilecheck,
+        )
+
+        peak = compilecheck.peak_hbm_bytes_per_s()
+        if not peak:
+            return {}
+        return {prog: round(100.0 * float(s.get("bytes_per_s", 0.0))
+                            / peak, 3)
+                for prog, s in self.programs_by_site().items()
+                if s.get("dispatches")}
+
     def replica_rss(self) -> dict:
         """Per-replica resident-set bytes (``{replica: bytes}``) for
         engines that report it — subprocess facades do (from the stats
@@ -816,17 +870,30 @@ class ReplicaPool:
             return          # already warm there: placement wins as-is
         t0 = time.monotonic()
         for pre in self._prefill_workers():
-            try:
-                out = pre.driver.prefill_export(prompt)
-            except RuntimeError:
-                continue    # prefill worker died between scan and ask
+            # Spans, not just the terminal instant: the fleet waterfall
+            # (tools/trace_report.py --fleet) reads the export span's
+            # end → install span's start gap as the handoff's measured
+            # wire+queue hop, in the parent's own clock domain (no
+            # offset correction involved, so the hop is positive by
+            # construction and comparable across skewed workers).
+            with events.span("handoff/export",
+                             request_id=preq.handle.id,
+                             prefill_replica=pre.idx):
+                try:
+                    out = pre.driver.prefill_export(prompt)
+                except RuntimeError:
+                    out = None  # prefill worker died between scan/ask
             if out is None:
                 continue    # refusal (or death mid-export): next one
             meta, blob = out
-            try:
-                n = install(meta, blob)
-            except RuntimeError:
-                n = 0
+            with events.span("handoff/install",
+                             request_id=preq.handle.id,
+                             decode_replica=rep.idx,
+                             bytes=len(blob)):
+                try:
+                    n = install(meta, blob)
+                except RuntimeError:
+                    n = 0
             if n:
                 rep.note_affinity(preq.affinity_key)
                 m = self._metrics

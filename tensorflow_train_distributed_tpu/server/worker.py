@@ -42,6 +42,7 @@ import json
 import logging
 import os
 import resource
+import signal
 import socket
 import struct
 import sys
@@ -50,7 +51,10 @@ import time
 from typing import Optional
 
 from tensorflow_train_distributed_tpu.runtime import events, faults
-from tensorflow_train_distributed_tpu.runtime.lint import memcheck
+from tensorflow_train_distributed_tpu.runtime.lint import (
+    compilecheck,
+    memcheck,
+)
 from tensorflow_train_distributed_tpu.runtime.lint.registry import (
     thread_role,
 )
@@ -529,6 +533,13 @@ def _send_stats(driver: EngineDriver, engine, sender: proto.FrameSender,
         "hbm": memcheck.live_by_pool(),
         "events": batch,
     }
+    # Roofline numerators from THIS worker's instrumented jit sites
+    # (empty unless TTD_COMPILECHECK armed the wrapper): the parent
+    # renders them as ttd_engine_mfu_pct{program="<replica>/<site>"}
+    # against its own device peaks.
+    programs = compilecheck.program_stats()
+    if programs:
+        body["programs"] = programs
     if dropped:
         body["events_dropped"] = dropped
     sender.send(proto.STATS, body)
@@ -599,6 +610,10 @@ def run_worker(engine, sock: socket.socket, *,
                     break
             time.sleep(0.01)
         sender.send(proto.BYE, {})
+        # Final-ring flush: a drained worker's last events (the retires
+        # the relays just sent) must reach the spool before exit — the
+        # stats loop that would have flushed them is about to stop.
+        events.get_recorder().flush_spool()
         stop.set()
         try:
             sock.shutdown(socket.SHUT_RDWR)   # unblocks the read loop
@@ -693,6 +708,14 @@ def run_worker(engine, sock: socket.socket, *,
                         args=(hid, body, blob, driver, sender),
                         name=f"worker-migrate-in-{hid}",
                         daemon=True).start()
+            elif ftype == proto.PING:
+                # Clock sync: echo the parent's stamp back with our
+                # own monotonic, from the reader thread itself — any
+                # queueing would inflate the RTT the parent's min-RTT
+                # filter is trying to measure.
+                sender.send(proto.PONG, {
+                    "id": body.get("id"), "t": body.get("t"),
+                    "mono": time.monotonic()})
             elif ftype == proto.DRAIN:
                 threading.Thread(target=_drain_and_exit,
                                  name="worker-drain",
@@ -709,6 +732,9 @@ def run_worker(engine, sock: socket.socket, *,
         # of orphaning it mid-decode).
         driver.drain()
         driver.join(30.0)
+        # Whatever ended the loop (drain, parent EOF, protocol error),
+        # the ring's tail reaches the spool before the process goes.
+        events.get_recorder().flush_spool()
 
 
 # ── deliberately broken workers (protocol-hardening tests) ─────────────
@@ -842,6 +868,16 @@ def main(argv=None) -> int:
     # parent scopes a plan to one replica with replica=K, and killpid
     # entries deliver a REAL SIGKILL to exactly this process.
     faults.arm_from_env()
+    if os.environ.get("TTD_TRACE_SPOOL", ""):
+        # SIGTERM (supervisor scale-down, OS shutdown) would skip the
+        # drain path's final flush — get the ring's tail to the spool,
+        # then die with the default disposition so the exit code still
+        # reads as "terminated" (128+15) to whoever sent the signal.
+        def _flush_and_term(signum, frame):
+            events.get_recorder().flush_spool()
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        signal.signal(signal.SIGTERM, _flush_and_term)
     factory = resolve_factory(args.factory)
     try:
         spec = json.loads(args.json)

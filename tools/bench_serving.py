@@ -332,6 +332,181 @@ def bench_trace_ab(preset, slots, chunk, n_requests, prompt_range,
     }
 
 
+def bench_trace_fleet_ab(preset, slots, chunk, n_requests, prompt_range,
+                         new_range, cache_len, seed, reps=3,
+                         replicas=2):
+    """The FLEET observability overhead A/B: a real subprocess pool
+    (parent gateway process + ``replicas`` llama workers over the
+    frame protocol) serving the same request set under THREE legs —
+    ``off`` (``TTD_NO_TRACE=1`` + ``TTD_NO_CLOCK_SYNC=1``, no spool),
+    ``trace`` (the pre-fleet flight recorder alone: rings on, relay
+    on, sync killed, no spool), and ``full`` (the whole plane:
+    PING/PONG clock sync on the stats heartbeat plus the
+    crash-durable trace spool writing in parent and workers).  Two
+    headlines fall out: ``full/off`` is the total cost of always-on
+    fleet observability, and ``full/trace`` is the MARGINAL cost of
+    what this plane added on top of the recorder the repo already
+    shipped — the "spool+sync overhead" the tentpole's ≤2% bar
+    names.
+
+    Workers read their kill switches from their own environment, so
+    each leg is its own pool spawned with the leg's env overlaid on
+    the child; all pools are built and warmed up-front and the timed
+    passes run as leg-order-rotating rounds with the parent-side env
+    flipped around each pass, median of per-round wall ratios — the
+    --trace-ab noise discipline.  During a pass the other pools'
+    workers are idle (heartbeats only), which costs every leg the
+    same.  NOTE the observer and the observed share cores: on a
+    small host (the committed record's 1-CPU container) flusher and
+    relay threads displace decode compute directly, so these numbers
+    are an upper bound — on a multi-core host the plane rides spare
+    cores and only the serving-thread ring appends remain."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tensorflow_train_distributed_tpu.models.llama import (
+        LLAMA_PRESETS,
+    )
+    from tensorflow_train_distributed_tpu.runtime import events
+    from tensorflow_train_distributed_tpu.server.procpool import (
+        ProcPool, WorkerSpec,
+    )
+
+    cfg = LLAMA_PRESETS[preset]
+    reqs = _requests(n_requests, *prompt_range, *new_range,
+                     min(cfg.vocab_size, 30_000), seed)
+    gen_tokens = sum(m for _, m in reqs)
+    factory_json = dict(preset=preset, init_seed=0, slots=slots,
+                        chunk=chunk)
+    if cache_len:
+        factory_json["cache_len"] = cache_len
+    spool_dir = tempfile.mkdtemp(prefix="ttd-fleet-ab-spool-")
+    worker_env = {
+        "off": {"TTD_NO_TRACE": "1", "TTD_NO_CLOCK_SYNC": "1"},
+        "trace": {"TTD_NO_CLOCK_SYNC": "1"},
+        "full": {"TTD_TRACE_SPOOL": spool_dir},
+    }
+    saved = {k: os.environ.get(k) for k in
+             ("TTD_NO_TRACE", "TTD_NO_CLOCK_SYNC", "TTD_TRACE_SPOOL")}
+    # Spawn every pool from a NEUTRAL parent env: WorkerSpec.env
+    # OVERLAYS the inherited environment (it cannot unset keys), so a
+    # leak from the parent would silently arm the wrong leg's workers.
+    for k in saved:
+        os.environ.pop(k, None)
+
+    def arm(leg):
+        """Parent-side leg flip: recording, the ping mint, and the
+        parent spool all live in this process and re-read env (or are
+        armed explicitly) around each pass."""
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ.update(worker_env[leg])
+        if leg == "full":
+            events.get_recorder().start_spool(spool_dir)
+            # Drain the ring backlog NOW: re-arming resets the spool
+            # cursor, and the backlog serialize belongs to no leg.
+            events.get_recorder().flush_spool()
+        else:
+            events.get_recorder().stop_spool()
+
+    def timed_pass(pool):
+        t0 = time.perf_counter()
+        hs = [pool.submit(p, m) for p, m in reqs]
+        for h in hs:
+            h.result(timeout=600)
+        return time.perf_counter() - t0
+
+    legs = ("off", "trace", "full")
+    pools = {}
+    best = {leg: None for leg in legs}
+    rounds = []
+    sync_state = None
+    spool_files = 0
+    try:
+        for leg in legs:
+            spec = WorkerSpec(factory="llama", factory_json=factory_json,
+                              env=worker_env[leg])
+            pools[leg] = ProcPool(spec, replicas=replicas,
+                                  max_queue=4 * n_requests,
+                                  watchdog_timeout_s=300.0).start()
+        for leg in legs:                    # warmup: worker compiles
+            if not pools[leg].wait_ready(timeout=600):
+                raise RuntimeError("fleet AB pool never became ready")
+            arm(leg)
+            timed_pass(pools[leg])
+        for i in range(max(1, reps)):
+            walls = {}
+            for leg in (legs if i % 2 == 0 else legs[::-1]):
+                arm(leg)
+                w = timed_pass(pools[leg])
+                walls[leg] = w
+                if best[leg] is None or w < best[leg]:
+                    best[leg] = w
+            rounds.append(walls)
+        # Committed proof the full leg really ran the plane: clocks
+        # synced on every full-leg worker, spool segments on disk.
+        sync_state = [s.get("clock") for s in
+                      pools["full"].replica_states()]
+        events.get_recorder().flush_spool()
+        spool_files = len([n for n in os.listdir(spool_dir)
+                           if n.startswith("spool-")])
+    finally:
+        for pool in pools.values():
+            try:
+                pool.join(timeout=60)
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        events.get_recorder().stop_spool()
+        shutil.rmtree(spool_dir, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def med(pairs):
+        rs = sorted(pairs)
+        return rs[len(rs) // 2]
+
+    total = med([w["full"] / w["off"] for w in rounds])
+    trace_only = med([w["trace"] / w["off"] for w in rounds])
+    marginal = med([w["full"] / w["trace"] for w in rounds])
+    dev = jax.devices()[0]
+    return {
+        "metric": f"{preset}_serving_trace_fleet_overhead_pct",
+        "value": round(100.0 * (marginal - 1.0), 3),
+        "unit": "% tok/s lost to clock sync + crash-durable spool on "
+                "top of the flight recorder (full/trace, median of "
+                "per-round wall ratios over a subprocess worker pool)",
+        "fleet_total_overhead_pct":
+            round(100.0 * (total - 1.0), 3),
+        "trace_only_overhead_pct":
+            round(100.0 * (trace_only - 1.0), 3),
+        "round_wall_ratios_full_vs_trace":
+            sorted(round(w["full"] / w["trace"], 4) for w in rounds),
+        "round_wall_ratios_full_vs_off":
+            sorted(round(w["full"] / w["off"], 4) for w in rounds),
+        "fleet_full_tokens_per_sec": round(gen_tokens / best["full"], 1),
+        "fleet_off_tokens_per_sec": round(gen_tokens / best["off"], 1),
+        "fleet_full_wall_s": round(best["full"], 3),
+        "fleet_trace_wall_s": round(best["trace"], 3),
+        "fleet_off_wall_s": round(best["off"], 3),
+        "workers_synced": sum(1 for c in (sync_state or [])
+                              if c and c.get("synced")),
+        "spool_segments": spool_files,
+        "replicas": replicas,
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n_requests,
+        "gen_tokens": gen_tokens,
+        "reps": reps,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
 def bench_paged_kv_ab(preset, slots, chunk, n_requests, prefix_len,
                       cache_len, seed, kv_block_size, reps=3):
     """The --shared-prefix A/B: every request = one shared system
@@ -1101,6 +1276,17 @@ def main(argv=None) -> int:
                         "TTD_NO_TRACE=1, reporting the tok/s overhead "
                         "percentage (committed record: "
                         "profiles/bench/trace_overhead_ab.jsonl)")
+    p.add_argument("--trace-fleet-ab", action="store_true",
+                   help="FLEET observability overhead A/B: a parent + "
+                        "subprocess-worker pool serving with clock "
+                        "sync, event relay, and the crash-durable "
+                        "trace spool armed everywhere vs "
+                        "TTD_NO_TRACE=1 + TTD_NO_CLOCK_SYNC=1 and no "
+                        "spool (committed record: "
+                        "profiles/bench/trace_fleet_ab.jsonl)")
+    p.add_argument("--fleet-replicas", type=int, default=2,
+                   help="--trace-fleet-ab only: subprocess workers "
+                        "per pool leg")
     p.add_argument("--spec-adaptive-ab", action="store_true",
                    help="acceptance-adaptive speculation A/B instead "
                         "of the throughput run: adaptive depth vs "
@@ -1166,6 +1352,12 @@ def main(argv=None) -> int:
                                      args.requests, prompt_range,
                                      new_range, args.cache_len or None,
                                      args.seed, reps=args.reps)
+            elif args.trace_fleet_ab:
+                rec = bench_trace_fleet_ab(
+                    args.preset, args.slots, args.chunk,
+                    args.requests, prompt_range, new_range,
+                    args.cache_len or None, args.seed,
+                    reps=args.reps, replicas=args.fleet_replicas)
             elif args.spec_adaptive_ab:
                 depths = tuple(int(x)
                                for x in args.spec_depths.split(","))
@@ -1208,6 +1400,10 @@ def main(argv=None) -> int:
         elif args.trace_ab:
             metric = f"{args.preset}_serving_trace_overhead_pct"
             unit = "% tok/s lost, flight recorder on vs TTD_NO_TRACE=1"
+        elif args.trace_fleet_ab:
+            metric = f"{args.preset}_serving_trace_fleet_overhead_pct"
+            unit = ("% tok/s lost, clock sync + relay + spool armed "
+                    "fleet-wide vs all kill switches")
         elif args.spec_adaptive_ab:
             metric = f"{args.preset}_serving_spec_adaptive_wall_ratio"
             unit = "x wall, adaptive depth vs best fixed depth"

@@ -620,11 +620,26 @@ def run_serving_chaos_procs(*, sampling: bool = True,
             [(r[0] if r else "no result") for r in results]}
 
 
+def _post_mortem(spool_dir):
+    """tools/trace_report's post-mortem loader (imported by path so
+    the gate works both as a script and under pytest)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.post_mortem_report(spool_dir, last_s=120.0)
+
+
 def run_serving_chaos_disagg(*, sampling: bool = True,
                              n_requests: int = 6,
                              kill_dispatch: int = 2,
                              watchdog_timeout_s: float = 30.0,
-                             timeout_s: float = 600.0) -> dict:
+                             timeout_s: float = 600.0,
+                             spool_dir=None) -> dict:
     """The DISAGGREGATED leg of the serving chaos gate: a 1-prefill +
     2-decode TCP dial-in fleet (``server.netpool`` +
     ``tools/serve_worker.py``) under mixed long-prompt/short-prompt
@@ -683,6 +698,16 @@ def run_serving_chaos_disagg(*, sampling: bool = True,
     from tensorflow_train_distributed_tpu.serving import ServingEngine
 
     checks = {}
+    # Crash durability rides this leg: every process (parent + the
+    # three workers, two of which get SIGKILLed) spools its ring to
+    # the same directory, and the gate asserts the spool + corpse
+    # snapshots reconstruct the dead decode worker's final dispatches
+    # after the fact — the PR-20 post-mortem acceptance.
+    if spool_dir is None:
+        spool_dir = tempfile.mkdtemp(prefix="ttd-chaos-spool-")
+    spool_env_prev = os.environ.get("TTD_TRACE_SPOOL")
+    os.environ["TTD_TRACE_SPOOL"] = spool_dir
+    events.get_recorder().start_spool(spool_dir)
     kw = dict(slots=2, cache_len=64, chunk=4)
     if sampling:
         kw.update(temperature=0.8, top_k=40)
@@ -817,6 +842,35 @@ def run_serving_chaos_disagg(*, sampling: bool = True,
                 r.status == 200
                 and _json.loads(r.read())["status"]
                 in ("ok", "degraded"))
+        # Post-mortem reconstruction: the decode worker died to a
+        # REAL SIGKILL — no flush, no BYE — yet its fsynced spool
+        # segments plus the parent's corpse snapshot must still show
+        # what it was doing.  The parent's own spool carries the
+        # fleet view (handoff + failover) of the same death.
+        events.get_recorder().flush_spool()
+        pm = _post_mortem(spool_dir)
+        dead_pid = procs[1].pid
+        death = next((d for d in pm["deaths"]
+                      if d.get("pid") == dead_pid), None)
+        # Over TCP the parent can't waitpid a remote process: a
+        # SIGKILL renders as EOF-without-BYE ("disconnected"), the
+        # subprocess transport would say "killed" (rc -9).
+        checks["post_mortem_corpse_for_decode"] = (
+            death is not None and not death.get("drained")
+            and (death.get("reason") == "killed"
+                 or "disconnected" in str(death.get("reason"))))
+        names = []
+        if death is not None:
+            names = ([e["name"] for e in death["final_events"]]
+                     + [e[0] for e in death["last_relayed"]])
+        checks["post_mortem_final_dispatch"] = any(
+            n.startswith(("decode/", "prefill/", "engine/"))
+            for n in names)
+        parent_names = {e["name"] for e in pm["timeline"]
+                        if e["pid"] == os.getpid()}
+        checks["post_mortem_fleet_waterfall"] = (
+            "request/kv_handoff" in parent_names
+            and "request/failover" in parent_names)
     finally:
         gw.drain(timeout=60)
         for proc in procs:
@@ -825,8 +879,13 @@ def run_serving_chaos_disagg(*, sampling: bool = True,
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=15)
+        events.get_recorder().stop_spool()
+        if spool_env_prev is None:
+            os.environ.pop("TTD_TRACE_SPOOL", None)
+        else:
+            os.environ["TTD_TRACE_SPOOL"] = spool_env_prev
     return {"ok": all(checks.values()), "checks": checks,
-            "mode": "serving-disagg",
+            "mode": "serving-disagg", "spool_dir": spool_dir,
             "leg": "sampled" if sampling else "greedy",
             "failovers": gw.metrics.failovers.value(),
             "handoffs": handoffs,
@@ -992,11 +1051,21 @@ def run_serving_chaos_migrate(*, sampling: bool = True,
                                 # which case the dispatch fault never
                                 # fires); the lock serializes the
                                 # no-death check against concurrent
-                                # armers.
+                                # armers.  A FIRED plan also stops
+                                # re-arming: the kill has landed but
+                                # the death DECLARATION lags it, and
+                                # arming a fresh kill on a different
+                                # replica in that window cascades
+                                # until the whole fleet is dead.
                                 with kill_lock:
                                     if any(s["state"] == "dead"
                                            for s in
                                            gw.pool.replica_states()):
+                                        continue
+                                    cur = faults.plan()
+                                    if cur is not None and any(
+                                            e.fired
+                                            for e in cur.entries):
                                         continue
                                     preq = gw.pool._requests.get(rid)
                                     src = (preq.replica

@@ -19,6 +19,25 @@ Usage:
   python tools/trace_report.py /tmp/trace.json --requests \
       --journal /ckpt/supervisor.jsonl
 
+Fleet observability (PR 20) adds two more faces:
+
+- ``--fleet`` renders the CROSS-WORKER view of the same trace: the
+  parent's ring already holds every proc/TCP worker's relayed events,
+  offset-corrected by the PING/PONG clock sync and tagged
+  ``replica=``/``clock_conf_s=`` — this groups them into per-replica
+  lanes, prints each replica's clock-sync quality (from the export's
+  ``otherData.fleet``), and measures every prefill→decode KV-handoff
+  hop (``handoff/export`` span end → ``handoff/install`` span start)
+  plus migration/failover hops.  With ``--request N`` the waterfall
+  gains a lane column, so a disaggregated request reads top-to-bottom
+  across the fleet.
+- ``--post-mortem DIR`` reconstructs the last seconds before a death
+  from a ``TTD_TRACE_SPOOL`` directory: each process's rotating JSONL
+  segments (wall-anchored per segment header) joined with the parent's
+  ``corpse-*.json`` snapshots (exit reason, clock offset, the last
+  relayed events) and optionally ``--journal`` — the waterfall a
+  SIGKILLed worker can no longer serve from ``/debug/trace``.
+
 (The JSON itself also loads directly in Perfetto / chrome://tracing —
 this tool is for terminals and incident notes.)
 """
@@ -27,7 +46,9 @@ from __future__ import annotations
 
 import argparse
 import collections
+import glob
 import json
+import os
 import sys
 
 
@@ -45,6 +66,15 @@ def load_events(path: str) -> list:
     if not isinstance(evs, list):
         raise SystemExit(f"{path}: not a Chrome trace (no traceEvents)")
     return evs
+
+
+def load_other(path: str) -> dict:
+    """The export's ``otherData`` (fleet states, roofline snapshot,
+    spool status) — empty for bare event-array dumps."""
+    with open(path) as f:
+        obj = json.load(f)
+    return dict(obj.get("otherData") or {}) if isinstance(obj, dict) \
+        else {}
 
 
 def stage_table(evs: list) -> list:
@@ -361,6 +391,299 @@ def print_waterfall(evs: list, request_id: int) -> None:
         print(f"{(e['ts'] - t0) / 1e3:10.3f}  {dur}  {e['name']}{extra}")
 
 
+def fleet_lanes(evs: list) -> dict:
+    """Group events into per-replica lanes: ``replica`` from attrs
+    (the relay stamps every worker event; pool pump threads stamp the
+    parent's per-replica driver events), ``gateway`` for everything
+    unstamped.  Each lane reports its event count, span of activity,
+    and the worst clock-sync confidence seen (``clock_conf_s`` rides
+    every relayed event — None means the lane never crossed a process
+    boundary)."""
+    lanes: dict = {}
+    for e in evs:
+        args = e.get("args") or {}
+        lane = args.get("replica", "gateway")
+        row = lanes.setdefault(str(lane), {
+            "events": 0, "t_min": None, "t_max": None,
+            "clock_conf_s": None, "relayed": 0})
+        row["events"] += 1
+        ts = e.get("ts", 0.0)
+        row["t_min"] = ts if row["t_min"] is None else min(
+            row["t_min"], ts)
+        row["t_max"] = ts if row["t_max"] is None else max(
+            row["t_max"], ts)
+        conf = args.get("clock_conf_s")
+        if conf is not None:
+            row["relayed"] += 1
+            if row["clock_conf_s"] is None or conf > row["clock_conf_s"]:
+                row["clock_conf_s"] = conf
+    return lanes
+
+
+def fleet_hops(evs: list) -> list:
+    """Every cross-worker hop in the window, measured:
+
+    - ``kv_handoff``: the prefill→decode KV handoff — wire+install
+      latency is the gap from the ``handoff/export`` span's END to the
+      ``handoff/install`` span's START (both parent-recorded, one
+      clock domain, positive by construction) for the same request;
+    - ``migrate``: a live lane move (the instant's ``ms`` arg is the
+      measured move time);
+    - ``failover``: a re-admission on a survivor (no wire latency —
+      the dead replica shipped nothing).
+
+    Rows: (kind, request_id, from, to, hop_ms, detail)."""
+    exports: dict = {}      # request_id -> (end_ts, prefill_replica)
+    hops: list = []
+    for e in evs:
+        args = e.get("args") or {}
+        rid = args.get("request_id")
+        name = e.get("name", "")
+        if name == "handoff/export" and e.get("ph") == "X":
+            exports[rid] = (e["ts"] + e.get("dur", 0.0),
+                            args.get("prefill_replica"))
+        elif name == "handoff/install" and e.get("ph") == "X":
+            exp = exports.get(rid)
+            if exp is not None:
+                hop_ms = (e["ts"] - exp[0]) / 1e3
+                hops.append(("kv_handoff", rid, exp[1],
+                             args.get("decode_replica"), hop_ms,
+                             f"{args.get('bytes', 0)} bytes"))
+        elif name == "request/kv_handoff":
+            # Pre-span traces (or local installs): keep the terminal
+            # instant visible even without a measured hop.
+            if not any(h[0] == "kv_handoff" and h[1] == rid
+                       for h in hops):
+                hops.append(("kv_handoff", rid,
+                             args.get("prefill_replica"),
+                             args.get("decode_replica"), None,
+                             f"{args.get('bytes', 0)} bytes"))
+        elif name == "request/migrate":
+            hops.append(("migrate", rid, args.get("from_replica"),
+                         args.get("to_replica"), args.get("ms"),
+                         f"{args.get('bytes', 0)} KV bytes, resumed at "
+                         f"token {args.get('resumed_at')}"))
+        elif name == "request/failover":
+            hops.append(("failover", rid, args.get("from_replica"),
+                         args.get("to_replica"), None,
+                         f"resumed at token {args.get('resume_from')}"))
+    return hops
+
+
+def print_fleet(evs: list, other: dict,
+                request_id: "int | None" = None) -> None:
+    lanes = fleet_lanes(evs)
+    print(f"\n== fleet view: {len(lanes)} lanes")
+    states = {str(d.get("replica")): d for d in other.get("fleet", [])}
+    print(f"  {'lane':>8}  {'events':>7}  {'relayed':>7}  "
+          f"{'span_ms':>9}  {'clock_conf':>10}  state")
+    for lane in sorted(lanes, key=lambda x: (x == "gateway", x)):
+        row = lanes[lane]
+        span_ms = ((row["t_max"] - row["t_min"]) / 1e3
+                   if row["events"] else 0.0)
+        conf = (f"±{row['clock_conf_s'] * 1e3:.2f}ms"
+                if row["clock_conf_s"] is not None else "local")
+        st = states.get(lane, {})
+        extra = st.get("state", "")
+        clock = st.get("clock") or {}
+        if clock.get("synced"):
+            extra += (f"  offset={clock.get('offset_s', 0) * 1e3:+.3f}ms"
+                      f" rtt={clock.get('rtt_s', 0) * 1e3:.3f}ms")
+        print(f"  {lane:>8}  {row['events']:7d}  {row['relayed']:7d}  "
+              f"{span_ms:9.2f}  {conf:>10}  {extra}")
+    hops = fleet_hops(evs)
+    if hops:
+        print(f"\n== fleet hops: {len(hops)}")
+        print(f"  {'kind':>11}  {'request':>8}  {'from':>4}  {'to':>4}  "
+              f"{'hop_ms':>8}  detail")
+        for kind, rid, src, dst, ms, detail in hops:
+            ms_s = f"{ms:8.3f}" if ms is not None else "      --"
+            print(f"  {kind:>11}  {rid!s:>8}  {src!s:>4}  {dst!s:>4}  "
+                  f"{ms_s}  {detail}")
+    if request_id is not None:
+        wf = request_waterfall(evs, request_id)
+        if not wf:
+            print(f"\nrequest {request_id}: no events in this window")
+            return
+        t0 = wf[0]["ts"]
+        print(f"\n== request {request_id} fleet waterfall "
+              f"({len(wf)} events, lane column = replica)")
+        print(f"{'t_ms':>10}  {'dur_ms':>8}  {'lane':>8}  event")
+        for e in wf:
+            args = dict(e.get("args") or {})
+            args.pop("request_id", None)
+            lane = str(args.pop("replica", "gateway"))
+            conf = args.pop("clock_conf_s", None)
+            dur = f"{e['dur'] / 1e3:8.3f}" if "dur" in e else " " * 8
+            extra = " ".join(f"{k}={v}" for k, v in args.items())
+            if conf is not None:
+                extra += f" (±{conf * 1e3:.2f}ms)"
+            print(f"{(e['ts'] - t0) / 1e3:10.3f}  {dur}  {lane:>8}  "
+                  f"{e['name']}{'  ' + extra if extra else ''}")
+
+
+def roofline_table(other: dict) -> list:
+    """(program, dispatches, gflops_per_s, gbytes_per_s, mfu_pct,
+    mbu_pct) rows from the export's live roofline snapshot — empty
+    when the trace predates PR 20 or TTD_COMPILECHECK was unarmed."""
+    rows = []
+    for prog, s in sorted((other.get("roofline") or {}).items()):
+        rows.append((prog, s.get("dispatches", 0),
+                     s.get("flops_per_s", 0.0) / 1e9,
+                     s.get("bytes_per_s", 0.0) / 1e9,
+                     s.get("mfu_pct"), s.get("mbu_pct")))
+    return rows
+
+
+# -- post-mortem (TTD_TRACE_SPOOL + corpse snapshots) ----------------------
+
+
+def load_spool_dir(directory: str) -> dict:
+    """Parse a spool directory: per-pid event streams (wall-anchored
+    via each segment's header line) + the parent's corpse snapshots.
+
+    Returns ``{"procs": {pid: {"events": [...], "dropped": n,
+    "segments": n}}, "corpses": [...]}`` where each event is
+    ``{"name", "ph", "wall_s", "mono_s", "dur", "attrs"}``."""
+    procs: dict = {}
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "spool-*.jsonl"))):
+        anchor = None       # (wall_anchor_s, mono_anchor_s) of segment
+        pid = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue    # torn tail line: the crash wrote it
+                if isinstance(rec, dict) and rec.get("spool"):
+                    pid = rec.get("pid")
+                    anchor = (float(rec.get("wall_anchor_s", 0.0)),
+                              float(rec.get("mono_anchor_s", 0.0)))
+                    row = procs.setdefault(pid, {
+                        "events": [], "dropped": 0, "segments": 0})
+                    row["segments"] += 1
+                elif isinstance(rec, dict) and "dropped" in rec:
+                    if pid in procs:
+                        procs[pid]["dropped"] += int(rec["dropped"])
+                    continue
+                # One {"b": [...]} line per flush batch; bare event
+                # arrays accepted too (hand-written fixtures).
+                if anchor is None:
+                    continue
+                if isinstance(rec, dict):
+                    batch = rec.get("b") or []
+                elif isinstance(rec, list) and len(rec) >= 6:
+                    batch = [rec]
+                else:
+                    batch = []
+                for ev in batch:
+                    if not isinstance(ev, list) or len(ev) < 6:
+                        continue
+                    name, ph, t0, dur, _tid, attrs = ev[:6]
+                    procs[pid]["events"].append({
+                        "name": name, "ph": ph,
+                        "mono_s": t0,
+                        "wall_s": t0 - anchor[1] + anchor[0],
+                        "dur": dur, "attrs": attrs or {}})
+    corpses = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "corpse-*.json"))):
+        try:
+            with open(path) as f:
+                corpses.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    for row in procs.values():
+        row["events"].sort(key=lambda e: e["wall_s"])
+    return {"procs": procs, "corpses": corpses}
+
+
+def post_mortem_report(directory: str, last_s: float = 10.0) -> dict:
+    """The reconstruction the dead process can no longer serve: for
+    each corpse snapshot, the worker's own final ``last_s`` seconds of
+    spooled events joined with the parent's view (exit reason, clock
+    offset at death, the last relayed events).  ``timeline`` holds
+    every process's tail merged on wall clock (spool segment anchors),
+    tagged by pid."""
+    spool = load_spool_dir(directory)
+    deaths = []
+    for c in spool["corpses"]:
+        pid = c.get("pid")
+        proc = spool["procs"].get(pid, {})
+        evs = proc.get("events", [])
+        cutoff = (evs[-1]["wall_s"] - last_s) if evs else 0.0
+        deaths.append({
+            "replica": c.get("replica"),
+            "pid": pid,
+            "reason": c.get("reason"),
+            "returncode": c.get("returncode"),
+            "drained": c.get("drained"),
+            "clock": c.get("clock") or {},
+            "wall_s": c.get("wall_s"),
+            "events_relayed": c.get("events_relayed"),
+            "last_relayed": c.get("last_events") or [],
+            "final_events": [e for e in evs if e["wall_s"] >= cutoff],
+            "spool_segments": proc.get("segments", 0),
+            "spool_dropped": proc.get("dropped", 0),
+        })
+    timeline = []
+    for pid, proc in spool["procs"].items():
+        for e in proc["events"]:
+            timeline.append(dict(e, pid=pid))
+    timeline.sort(key=lambda e: e["wall_s"])
+    return {"deaths": deaths, "timeline": timeline,
+            "procs": sorted(spool["procs"]),
+            "corpses": len(spool["corpses"])}
+
+
+def print_post_mortem(directory: str, journal: "str | None" = None,
+                      last_s: float = 10.0) -> None:
+    rep = post_mortem_report(directory, last_s=last_s)
+    print(f"# post-mortem: {directory} — "
+          f"{len(rep['procs'])} spooled processes, "
+          f"{rep['corpses']} corpse snapshots")
+    if not rep["deaths"]:
+        print("  no corpse snapshots: nothing died while the parent "
+              "watched (or TTD_TRACE_SPOOL was unset in the parent)")
+    for d in rep["deaths"]:
+        clock = d["clock"] or {}
+        sync = (f"offset={clock.get('offset_s', 0) * 1e3:+.3f}ms "
+                f"±{clock.get('conf_s', 0) * 1e3:.2f}ms"
+                if clock.get("synced") else "unsynced (HELLO estimate)")
+        print(f"\n== death: replica={d['replica']} pid={d['pid']} "
+              f"reason={d['reason']} rc={d['returncode']} "
+              f"drained={d['drained']}")
+        print(f"   clock at death: {sync}; "
+              f"{d['events_relayed']} events relayed; spool: "
+              f"{d['spool_segments']} segments, "
+              f"{d['spool_dropped']} dropped")
+        if d["final_events"]:
+            t_end = d["final_events"][-1]["wall_s"]
+            print(f"   final {last_s:.0f}s from its own spool "
+                  f"({len(d['final_events'])} events, t=0 at death):")
+            for e in d["final_events"][-40:]:
+                attrs = e.get("attrs") or {}
+                extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+                dur = (f" dur={e['dur'] * 1e3:.3f}ms"
+                       if e.get("dur") else "")
+                print(f"   {e['wall_s'] - t_end:9.3f}s  {e['name']}"
+                      f"{dur}{'  ' + extra if extra else ''}")
+        elif d["last_relayed"]:
+            print(f"   no spool from the worker (its TTD_TRACE_SPOOL "
+                  f"was unset?); last {len(d['last_relayed'])} events "
+                  f"the parent relayed:")
+            for name, ph, t0, dur, attrs in d["last_relayed"][-20:]:
+                extra = " ".join(f"{k}={v}"
+                                 for k, v in (attrs or {}).items())
+                print(f"     {name}  {extra}")
+    if journal:
+        print_journal(journal)
+
+
 def print_journal(path: str) -> None:
     print(f"\n== supervisor journal: {path}")
     with open(path) as f:
@@ -376,18 +699,54 @@ def print_journal(path: str) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("trace", help="Chrome-trace JSON (GET /debug/trace "
-                                 "output or Recorder.save())")
+    p.add_argument("trace", nargs="?", default=None,
+                   help="Chrome-trace JSON (GET /debug/trace "
+                        "output or Recorder.save()); optional with "
+                        "--post-mortem")
     p.add_argument("--request", type=int, default=None,
                    help="render one request's waterfall")
     p.add_argument("--requests", action="store_true",
                    help="list request ids in the window with status")
+    p.add_argument("--fleet", action="store_true",
+                   help="cross-worker view: per-replica lanes, clock "
+                        "quality, measured handoff/migration hops")
+    p.add_argument("--post-mortem", default=None, metavar="DIR",
+                   help="reconstruct the last seconds before a death "
+                        "from a TTD_TRACE_SPOOL directory (spool "
+                        "segments + corpse snapshots)")
+    p.add_argument("--last-s", type=float, default=10.0,
+                   help="post-mortem tail length per death "
+                        "(default 10s)")
     p.add_argument("--journal", default=None,
                    help="supervisor JSONL to append as an attempt "
                         "timeline")
     args = p.parse_args(argv)
+    if args.post_mortem is not None:
+        print_post_mortem(args.post_mortem, journal=args.journal,
+                          last_s=args.last_s)
+        if args.trace is None:
+            return 0
+    if args.trace is None:
+        p.error("a trace file is required unless --post-mortem is "
+                "given")
     evs = load_events(args.trace)
+    other = load_other(args.trace)
     print(f"# {args.trace}: {len(evs)} events")
+    if args.fleet:
+        print_fleet(evs, other, request_id=args.request)
+        roof = roofline_table(other)
+        if roof:
+            print("\n== live roofline (per compiled program)")
+            print(f"  {'dispatches':>10}  {'gflop/s':>9}  {'gbyte/s':>9}"
+                  f"  {'mfu%':>6}  {'mbu%':>6}  program")
+            for prog, n, gf, gb, mfu, mbu in roof:
+                mfu_s = f"{mfu:6.2f}" if mfu is not None else "    --"
+                mbu_s = f"{mbu:6.2f}" if mbu is not None else "    --"
+                print(f"  {n:10d}  {gf:9.3f}  {gb:9.3f}  {mfu_s}  "
+                      f"{mbu_s}  {prog}")
+        if args.journal:
+            print_journal(args.journal)
+        return 0
 
     rows = stage_table(evs)
     if rows:
